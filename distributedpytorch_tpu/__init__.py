@@ -1,0 +1,28 @@
+"""distributedpytorch_tpu — a TPU-native training framework.
+
+A brand-new JAX/XLA framework with the capabilities of the reference repo
+``ahmedhshahin/distributedPyTorch`` (an interactive-segmentation training
+harness: instance-level Pascal VOC, extreme-point / n-ellipse guidance
+augmentation, DANet / DeepLabV3 segmentation models, data-parallel training,
+threshold-swept Jaccard evaluation, checkpointing and experiment logging),
+re-designed TPU-first:
+
+* compute path: flax/linen models traced to XLA, ``jax.jit`` / ``pjit`` over a
+  ``jax.sharding.Mesh`` (data/model axes) with compiler-inserted collectives —
+  replacing the reference's ``torch.nn.DataParallel`` (train_pascal.py:92) and
+  its never-finished NCCL/DDP plan (train_pascal.py:1-8);
+* input path: host-side numpy/cv2 transform kernels with explicit PRNG,
+  per-host sharded loading (the reference's missing "distributed sampler");
+* checkpoint/eval/logging subsystems the reference only sketched.
+
+Subpackages
+-----------
+``data``      dataset, transforms, guidance-map synthesis, loader
+``models``    ResNet backbones, DeepLabV3 and DANet heads
+``ops``       losses, metrics, attention primitives
+``parallel``  mesh construction, shardings, the pjit train step
+``train``     trainer loop, optimizer factory, checkpointing, evaluation
+``utils``     array helpers, logging, debug asserts, profiling
+"""
+
+__version__ = "0.1.0"

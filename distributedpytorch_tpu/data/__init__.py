@@ -1,0 +1,23 @@
+"""Data subsystem: dataset, transforms, guidance synthesis, sharded loading."""
+
+from . import guidance, transforms
+from .fake import make_fake_voc
+from .pipeline import (
+    DataLoader,
+    build_eval_transform,
+    build_train_transform,
+    collate,
+)
+from .voc import CATEGORY_NAMES, VOCInstanceSegmentation
+
+__all__ = [
+    "CATEGORY_NAMES",
+    "DataLoader",
+    "VOCInstanceSegmentation",
+    "build_eval_transform",
+    "build_train_transform",
+    "collate",
+    "guidance",
+    "make_fake_voc",
+    "transforms",
+]

@@ -1,0 +1,88 @@
+"""Synthetic tiny-VOC fixture.
+
+Generates an on-disk directory tree with the exact VOC2012 layout the dataset
+class reads (JPEGImages / SegmentationObject / SegmentationClass /
+ImageSets/Segmentation), populated with random multi-object scenes — the
+test-fixture replacement for the reference's MD5-verified 2 GB tar
+(SURVEY.md §4: "a tiny-fake-VOC fixture replacing the MD5'd tar").
+
+Objects are random filled ellipses/rectangles drawn back-to-front; the
+instance PNG stores object ids 1..N, the class PNG stores a category id per
+object, and a 255-valued void ring is drawn around each object boundary just
+like VOC's ignore regions.
+"""
+
+from __future__ import annotations
+
+import os
+
+import cv2
+import numpy as np
+from PIL import Image
+
+from .voc import BASE_DIR
+
+
+def make_fake_voc(
+    root: str,
+    n_images: int = 6,
+    size: tuple[int, int] = (120, 160),
+    max_objects: int = 3,
+    n_val: int = 2,
+    seed: int = 0,
+    void_ring: bool = True,
+) -> str:
+    """Create a fake VOC tree under ``root``; returns ``root``.
+
+    Image ids are ``fake_000000`` …; the first ``n_images - n_val`` go to the
+    ``train`` split, the rest to ``val``.
+    """
+    rng = np.random.default_rng(seed)
+    voc = os.path.join(root, BASE_DIR)
+    dirs = {
+        "img": os.path.join(voc, "JPEGImages"),
+        "inst": os.path.join(voc, "SegmentationObject"),
+        "cls": os.path.join(voc, "SegmentationClass"),
+        "sets": os.path.join(voc, "ImageSets", "Segmentation"),
+    }
+    for d in dirs.values():
+        os.makedirs(d, exist_ok=True)
+
+    h, w = size
+    ids = [f"fake_{i:06d}" for i in range(n_images)]
+    for im_id in ids:
+        img = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+        # Smooth it a bit so cubic warps behave like photos, not noise.
+        img = cv2.GaussianBlur(img, (7, 7), 0)
+        inst = np.zeros((h, w), dtype=np.uint8)
+        cls = np.zeros((h, w), dtype=np.uint8)
+        n_obj = int(rng.integers(1, max_objects + 1))
+        for obj in range(1, n_obj + 1):
+            cat = int(rng.integers(1, 21))
+            shape_mask = np.zeros((h, w), dtype=np.uint8)
+            cx = int(rng.integers(w // 4, 3 * w // 4))
+            cy = int(rng.integers(h // 4, 3 * h // 4))
+            ax = int(rng.integers(max(6, w // 10), w // 3))
+            ay = int(rng.integers(max(6, h // 10), h // 3))
+            if rng.random() < 0.5:
+                cv2.ellipse(shape_mask, (cx, cy), (ax, ay),
+                            float(rng.uniform(0, 180)), 0, 360, 1, -1)
+            else:
+                cv2.rectangle(shape_mask, (cx - ax, cy - ay), (cx + ax, cy + ay), 1, -1)
+            inst[shape_mask == 1] = obj
+            cls[shape_mask == 1] = cat
+            if void_ring:
+                ring = cv2.dilate(shape_mask, np.ones((3, 3), np.uint8)) - shape_mask
+                inst[ring == 1] = 255
+                cls[ring == 1] = 255
+
+        Image.fromarray(img).save(os.path.join(dirs["img"], im_id + ".jpg"))
+        Image.fromarray(inst).save(os.path.join(dirs["inst"], im_id + ".png"))
+        Image.fromarray(cls).save(os.path.join(dirs["cls"], im_id + ".png"))
+
+    n_train = n_images - n_val
+    with open(os.path.join(dirs["sets"], "train.txt"), "w") as f:
+        f.write("\n".join(ids[:n_train]) + "\n")
+    with open(os.path.join(dirs["sets"], "val.txt"), "w") as f:
+        f.write("\n".join(ids[n_train:]) + "\n")
+    return root

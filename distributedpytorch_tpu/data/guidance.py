@@ -1,0 +1,220 @@
+"""Guidance-map synthesis: extreme points, n-ellipses, confidence maps.
+
+The reference's guidance channel (the 4th input channel the model consumes,
+reference train_pascal.py:131-133) is produced by modules the author never
+committed (``dataloaders.nellipse``, ``dataloaders.skewed_axes_weight_map`` —
+SURVEY.md §2.4).  This module is a from-scratch design of that contract:
+
+* :func:`extreme_points` / :func:`extreme_points_fixed` — the 4 extreme pixels
+  of a binary mask (left/top/right/bottom), randomized vs deterministic
+  (contract at reference custom_transforms.py:19-21,40-42).
+* :func:`compute_nellipse` — a 4-focal *n-ellipse* (multifocal ellipse) soft
+  indicator through the extreme points (contract at custom_transforms.py:25).
+* :func:`compute_nellipse_gaussian_hm` — the n-ellipse plus a gaussian
+  point-heatmap, the pair combined by the NEllipseWithGaussians transform
+  (contract at custom_transforms.py:45) — this is the live guidance channel.
+* :func:`generate_mvgauss_image` / :func:`generate_mv_l1l2_image_skewed_axes`
+  / :func:`normalize_wt_map` — the confidence-map family behind the (inactive)
+  AddConfidenceMap transform (contract at custom_transforms.py:283-290).
+
+All functions are pure numpy with explicit ``np.random.Generator`` arguments —
+no hidden global RNG state, so data pipelines are reproducible per-sample and
+safe under multi-worker / multi-host sharding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.helpers import make_gaussian
+
+
+# ---------------------------------------------------------------------------
+# extreme points
+# ---------------------------------------------------------------------------
+
+def _find_point(ids_x, ids_y, selector) -> tuple[int, int]:
+    sel = selector(len(ids_x))
+    return int(ids_x[sel]), int(ids_y[sel])
+
+
+def _extreme_point_candidates(mask: np.ndarray, pert: int):
+    """For each side, the candidate pixel set within ``pert`` px of the extreme."""
+    ys, xs = np.where(mask > 0.5)
+    out = []
+    for vals, other, extreme in (
+        (xs, ys, xs.min()),   # leftmost
+        (ys, xs, ys.min()),   # topmost
+        (xs, ys, xs.max()),   # rightmost
+        (ys, xs, ys.max()),   # bottommost
+    ):
+        sel = np.abs(vals - extreme) <= pert
+        out.append((vals[sel], other[sel]))
+    return out
+
+
+def extreme_points(mask: np.ndarray, pert: int, rng: np.random.Generator | None = None) -> np.ndarray:
+    """Randomized 4 extreme points of ``mask`` as (4, 2) array of (x, y).
+
+    Among mask pixels within ``pert`` px of each side's extreme coordinate, one
+    is chosen uniformly at random — the training-time jitter of the reference's
+    ``extreme_points`` contract.
+    """
+    rng = rng or np.random.default_rng()
+    pts = []
+    for i, (vals, other) in enumerate(_extreme_point_candidates(mask, pert)):
+        k = int(rng.integers(0, len(vals)))
+        v, o = int(vals[k]), int(other[k])
+        pts.append((v, o) if i in (0, 2) else (o, v))  # (x, y) ordering
+    return np.asarray(pts, dtype=np.int64)
+
+
+def extreme_points_fixed(mask: np.ndarray, pert: int = 0) -> np.ndarray:
+    """Deterministic 4 extreme points (median candidate per side) — the
+    validation-time ``extreme_points_fixed`` contract."""
+    pts = []
+    for i, (vals, other) in enumerate(_extreme_point_candidates(mask, pert)):
+        k = len(vals) // 2
+        order = np.argsort(other)
+        v, o = int(vals[order[k]]), int(other[order[k]])
+        pts.append((v, o) if i in (0, 2) else (o, v))
+    return np.asarray(pts, dtype=np.int64)
+
+
+# ---------------------------------------------------------------------------
+# n-ellipse (multifocal ellipse) guidance
+# ---------------------------------------------------------------------------
+
+def _sum_of_distances(x_range, y_range, points) -> np.ndarray:
+    """d[i, j] = sum_k || (x_j, y_i) - p_k ||  over the focal points."""
+    xx = np.asarray(x_range, dtype=np.float32)
+    yy = np.asarray(y_range, dtype=np.float32)
+    X, Y = np.meshgrid(xx, yy)  # (len(y), len(x))
+    d = np.zeros_like(X)
+    for px, py in np.asarray(points, dtype=np.float32):
+        d += np.sqrt((X - px) ** 2 + (Y - py) ** 2)
+    return d
+
+
+def compute_nellipse(
+    x_range, y_range, points, softness: float = 0.05
+) -> np.ndarray:
+    """Soft indicator of the n-ellipse with foci at ``points``, in [0, 1].
+
+    The boundary is the multifocal-ellipse level set passing through the
+    outermost extreme point (so all four click points lie inside or on it);
+    the indicator decays smoothly across the boundary with relative width
+    ``softness``.  Mirrors the NEllipse transform's use at reference
+    custom_transforms.py:23-25 (x_range/y_range are pixel index ranges; the
+    caller scales the [0,1] map by 255).
+    """
+    points = np.asarray(points, dtype=np.float32)
+    d = _sum_of_distances(x_range, y_range, points)
+    # Sum-of-distances value at each focal point; the largest defines the
+    # boundary constant so every click point is enclosed.
+    per_point = [
+        sum(np.hypot(px - qx, py - qy) for qx, qy in points) for px, py in points
+    ]
+    c = float(max(per_point))
+    if c <= 0:  # degenerate: all four points coincide
+        z = np.zeros_like(d)
+        z[d == 0] = 1.0
+        return z
+    tau = softness * c
+    z = 1.0 / (1.0 + np.exp(np.clip((d - c) / tau, -50.0, 50.0)))
+    return z.astype(np.float32)
+
+
+def compute_nellipse_gaussian_hm(
+    x_range, y_range, points, sigma: float = 10.0, softness: float = 0.05
+) -> tuple[np.ndarray, np.ndarray]:
+    """(n-ellipse indicator, gaussian point heatmap), both in [0, 1].
+
+    The fast-variant contract at reference custom_transforms.py:45
+    (``compute_nellipse_gaussianHM_fast``): the pair is combined as
+    ``z1 + alpha * z2`` and rescaled by the NEllipseWithGaussians transform.
+    """
+    z1 = compute_nellipse(x_range, y_range, points, softness=softness)
+    size = (len(y_range), len(x_range))
+    z2 = np.zeros(size, dtype=np.float32)
+    for px, py in np.asarray(points, dtype=np.float32):
+        z2 = np.maximum(z2, make_gaussian(size, (px, py), sigma=sigma))
+    return z1, z2.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# confidence-map family (skewed-axes weight maps)
+# ---------------------------------------------------------------------------
+
+def normalize_wt_map(wt_map: np.ndarray) -> np.ndarray:
+    """Min-max normalize a weight map to [0, 1] (``normalize_wtMap`` contract)."""
+    lo, hi = float(wt_map.min()), float(wt_map.max())
+    return (wt_map - lo) / (hi - lo + 1e-10)
+
+
+def generate_mvgauss_image(
+    mask: np.ndarray, FULL_IMAGE_WEIGHTS: int = 1, tau: float = 0.5
+) -> np.ndarray:
+    """Multivariate gaussian confidence map fitted to the mask's pixel cloud.
+
+    Mean/covariance are the first/second moments of the foreground pixels; the
+    map is the (unnormalized) gaussian density over the full image raised to
+    ``tau`` (temperature).  Contract at reference custom_transforms.py:289.
+    """
+    ys, xs = np.where(mask > 0.5)
+    pts = np.stack([xs, ys], axis=1).astype(np.float64)
+    mean = pts.mean(axis=0)
+    cov = np.cov(pts.T) + np.eye(2) * 1e-3
+    icov = np.linalg.inv(cov)
+    h, w = mask.shape[:2]
+    X, Y = np.meshgrid(np.arange(w), np.arange(h))
+    dx = X - mean[0]
+    dy = Y - mean[1]
+    m = icov[0, 0] * dx * dx + (icov[0, 1] + icov[1, 0]) * dx * dy + icov[1, 1] * dy * dy
+    out = np.exp(-0.5 * tau * m)
+    if not FULL_IMAGE_WEIGHTS:
+        out = out * (mask > 0.5)
+    return out.astype(np.float32)
+
+
+def generate_mv_l1l2_image_skewed_axes(
+    mask: np.ndarray,
+    extreme_points: np.ndarray,
+    FULL_IMAGE_WEIGHTS: int = 1,
+    d2_THRESH: float | None = None,
+    tau: float = 1.0,
+):
+    """L1+L2 confidence map along the (possibly non-orthogonal) axes defined
+    by the extreme points.
+
+    The two skewed axes are left→right and top→bottom chords of the object;
+    each pixel gets affine coordinates (u, v) along those axes (|u|,|v| <= 1 on
+    the chords) and weight ``exp(-tau * ((|u|+|v|) + sqrt(u²+v²)) / 2)`` — an
+    L1/L2 blend.  Returns ``(h_map, d1, d2)`` matching the 3-tuple unpacking at
+    reference custom_transforms.py:283.
+    """
+    pts = np.asarray(extreme_points, dtype=np.float64)
+    left, top, right, bottom = pts[0], pts[1], pts[2], pts[3]
+    center = pts.mean(axis=0)
+    a1 = (right - left) / 2.0
+    a2 = (bottom - top) / 2.0
+    A = np.stack([a1, a2], axis=1)  # columns are the axes
+    if abs(np.linalg.det(A)) < 1e-6:
+        A = A + np.eye(2) * 1e-3
+    Ainv = np.linalg.inv(A)
+
+    h, w = mask.shape[:2]
+    X, Y = np.meshgrid(np.arange(w, dtype=np.float64), np.arange(h, dtype=np.float64))
+    dx = X - center[0]
+    dy = Y - center[1]
+    u = Ainv[0, 0] * dx + Ainv[0, 1] * dy
+    v = Ainv[1, 0] * dx + Ainv[1, 1] * dy
+
+    l1 = np.abs(u) + np.abs(v)
+    l2 = np.sqrt(u * u + v * v)
+    h_map = np.exp(-tau * (l1 + l2) / 2.0)
+    if d2_THRESH is not None:
+        h_map = np.where(l2 > d2_THRESH, 0.0, h_map)
+    if not FULL_IMAGE_WEIGHTS:
+        h_map = h_map * (mask > 0.5)
+    return h_map.astype(np.float32), u.astype(np.float32), v.astype(np.float32)

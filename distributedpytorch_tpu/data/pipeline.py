@@ -1,0 +1,257 @@
+"""Input pipelines: transform composition + per-host sharded batching.
+
+This is the framework's replacement for *both* ends of the reference's data
+story:
+
+* the transform stacks at reference train_pascal.py:123-145 (train: flip →
+  scale/rotate → crop+relax → 512² resize → n-ellipse+gaussian guidance →
+  concat; val: deterministic guidance and full-res gt/void passthrough for
+  full-image evaluation);
+* the ``DataLoader(..., num_workers=2, shuffle, drop_last)`` host parallelism
+  (train_pascal.py:161-162) **and** the distributed sampler the reference only
+  planned (train_pascal.py:3) — here every host reads only its
+  ``process_index``-th shard of each epoch's permutation, so a multi-host TPU
+  job feeds disjoint data with no coordination.
+
+Batches are dicts of stacked NHWC float32 numpy arrays, ready for
+``jax.device_put`` (or ``jax.make_array_from_process_local_data`` under a
+mesh).  Decoding/augmentation runs in a thread pool — cv2/PIL release the GIL
+for the heavy ops — with a bounded prefetch queue so host work overlaps device
+steps.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import queue
+import threading
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from . import transforms as T
+
+#: default guidance channel, matching the live reference pipeline
+GUIDANCE_KEY = "nellipseWithGaussians"
+
+
+def build_train_transform(
+    crop_size: tuple[int, int] = (512, 512),
+    relax: int = 50,
+    zero_pad: bool = True,
+    rots: tuple[float, float] = (-20, 20),
+    scales: tuple[float, float] = (0.75, 1.25),
+    alpha: float = 0.6,
+    guidance: str = "nellipse_gaussians",
+) -> T.Compose:
+    """The training augmentation stack (reference train_pascal.py:123-134)."""
+    chain: list[T.Transform] = [
+        T.RandomHorizontalFlip(),
+        T.ScaleNRotate(rots=rots, scales=scales),
+        T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
+                             relax=relax, zero_pad=zero_pad),
+        T.FixedResize(resolutions={"crop_image": crop_size, "crop_gt": crop_size}),
+    ]
+    chain += _guidance_stage(guidance, alpha, is_val=False)
+    chain.append(T.ToArray())
+    return T.Compose(chain)
+
+
+def build_eval_transform(
+    crop_size: tuple[int, int] = (512, 512),
+    relax: int = 50,
+    zero_pad: bool = True,
+    alpha: float = 0.6,
+    guidance: str = "nellipse_gaussians",
+    keep_fullres: bool = True,
+) -> T.Compose:
+    """The validation stack (reference train_pascal.py:135-145): deterministic
+    guidance; ``gt``/``void_pixels`` kept at full resolution (``None`` in the
+    resize map) so the evaluator can paste predictions back and score against
+    the original-size mask."""
+    resolutions = {"crop_image": crop_size, "crop_gt": crop_size}
+    if keep_fullres:
+        resolutions.update({"gt": None, "void_pixels": None})
+    chain: list[T.Transform] = [
+        T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
+                             relax=relax, zero_pad=zero_pad),
+        T.FixedResize(resolutions=resolutions),
+    ]
+    chain += _guidance_stage(guidance, alpha, is_val=True)
+    chain.append(T.ToArray())
+    return T.Compose(chain)
+
+
+def _guidance_stage(guidance: str, alpha: float, is_val: bool) -> list[T.Transform]:
+    """Guidance channel family selector; 'nellipse_gaussians' is the live
+    reference path, the others are its inventoried alternatives."""
+    if guidance == "nellipse_gaussians":
+        return [
+            T.NEllipseWithGaussians(alpha=alpha, is_val=is_val),
+            T.ConcatInputs(elems=("crop_image", GUIDANCE_KEY)),
+        ]
+    if guidance == "nellipse":
+        return [
+            T.NEllipse(is_val=is_val),
+            T.ConcatInputs(elems=("crop_image", "nellipse")),
+        ]
+    if guidance == "extreme_points":
+        return [
+            T.ExtremePoints(sigma=10, pert=0 if is_val else 5, elem="crop_gt",
+                            is_val=is_val),
+            T.ConcatInputs(elems=("crop_image", "extreme_points")),
+        ]
+    if guidance == "none":
+        return [T.ConcatInputs(elems=("crop_image",))]
+    raise ValueError(f"unknown guidance family: {guidance}")
+
+
+# ---------------------------------------------------------------------------
+# batching / sharding
+# ---------------------------------------------------------------------------
+
+#: keys that stay python lists in a batch (metadata; exact match — a substring
+#: test would wrongly catch 'vo*id*_pixels', see transforms._is_meta)
+_NO_STACK_KEYS = ("meta", "id", "crop_relax")
+
+
+def collate(samples: Sequence[dict]) -> dict:
+    """Stack a list of dict samples into a dict batch.
+
+    Fixed-shape keys stack on a new leading batch axis; ragged keys (full-res
+    ``gt``/``void_pixels`` at val, whose size varies per image) and metadata
+    stay as lists — they are consumed host-side by the evaluator, never
+    shipped to the device.
+    """
+    out: dict = {}
+    for key in samples[0]:
+        vals = [s[key] for s in samples]
+        if key in _NO_STACK_KEYS:
+            out[key] = vals
+            continue
+        shapes = {np.asarray(v).shape for v in vals}
+        if len(shapes) == 1:
+            out[key] = np.stack([np.asarray(v) for v in vals])
+        else:
+            out[key] = vals
+    return out
+
+
+class DataLoader:
+    """Sharded, shuffling, prefetching batch iterator over a random-access
+    dataset.
+
+    One instance per host: with ``num_shards = jax.process_count()`` and
+    ``shard_index = jax.process_index()``, each host walks only its slice of
+    the epoch permutation — the "distributed loader sampler" item of the
+    reference's DDP checklist (train_pascal.py:3), done the JAX way.
+
+    Every sample's RNG is ``default_rng((seed, epoch, index))``; shuffling is
+    ``default_rng((seed, epoch))`` over the global index set — identical data
+    order regardless of worker count or host count.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        seed: int = 0,
+        num_workers: int = 2,
+        shard_index: int = 0,
+        num_shards: int = 1,
+        prefetch: int = 2,
+    ):
+        if num_shards > 1 and not drop_last:
+            # Uneven shards would desynchronize collective step counts.
+            drop_last = True
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.num_workers = max(0, num_workers)
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+        self.prefetch = prefetch
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _epoch_indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            np.random.default_rng((self.seed, self.epoch)).shuffle(order)
+        # Per-host shard: contiguous strides of the permutation.
+        per_shard = n // self.num_shards if self.num_shards > 1 else n
+        if self.num_shards > 1:
+            order = order[self.shard_index * per_shard : (self.shard_index + 1) * per_shard]
+        return order
+
+    def __len__(self) -> int:
+        n = len(self._epoch_indices())
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _load_one(self, index: int) -> dict:
+        rng = np.random.default_rng((self.seed, self.epoch, int(index)))
+        return self.dataset.__getitem__(int(index), rng=rng)
+
+    def __iter__(self) -> Iterator[dict]:
+        order = self._epoch_indices()
+        nb = len(self)
+        batches = [order[i * self.batch_size : (i + 1) * self.batch_size] for i in range(nb)]
+        if self.num_workers == 0:
+            for idxs in batches:
+                yield collate([self._load_one(i) for i in idxs])
+            return
+        yield from self._iter_prefetched(batches)
+
+    def _iter_prefetched(self, batches: list[np.ndarray]) -> Iterator[dict]:
+        out_q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        sentinel = object()
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            """Bounded put that gives up when the consumer is gone — an
+            abandoned iterator (early break / exception in the train loop)
+            must not leave the producer blocked forever on a full queue."""
+            while not stop.is_set():
+                try:
+                    out_q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            with cf.ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+                try:
+                    for idxs in batches:
+                        if stop.is_set():
+                            return
+                        samples = list(pool.map(self._load_one, idxs))
+                        if not put(collate(samples)):
+                            return
+                except BaseException as e:  # surface worker errors to consumer
+                    put(e)
+                finally:
+                    put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = out_q.get()
+                if item is sentinel:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            t.join()

@@ -1,0 +1,5 @@
+"""Utilities: array helpers, logging, debug checks, profiling."""
+
+from . import helpers
+
+__all__ = ["helpers"]

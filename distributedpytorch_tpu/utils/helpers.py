@@ -1,0 +1,322 @@
+"""Array helpers for the segmentation data path.
+
+TPU-native re-design of the helper contract the reference consumes from its
+missing ``dataloaders.helpers`` module (inventoried in SURVEY.md §2.4 from the
+call sites in /root/reference/custom_transforms.py and
+/root/reference/train_pascal.py:286-291).  Everything here is host-side
+numpy/cv2: bounding boxes, mask crops and paste-backs are inherently
+dynamic-shape, so they stay off the accelerator; the device only ever sees
+fixed-shape (H, W, C) batches.
+
+Conventions
+-----------
+* images/masks are numpy arrays in HWC (or HW) layout — the TPU-preferred
+  layout; there is no CHW anywhere in this framework.
+* a bbox is ``(x_min, y_min, x_max, y_max)`` with **inclusive** max coords,
+  x = column, y = row.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Sequence
+
+import cv2
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# bounding boxes / crops
+# ---------------------------------------------------------------------------
+
+def get_bbox(mask: np.ndarray, points=None, pad: int = 0, zero_pad: bool = False):
+    """Tight bounding box of a binary mask (or point list), optionally padded.
+
+    Equivalent of the ``helpers.get_bbox`` contract at reference
+    custom_transforms.py:70,416 and train_pascal.py:287.
+
+    Returns ``(x_min, y_min, x_max, y_max)`` (inclusive), or ``None`` for an
+    empty mask.  With ``zero_pad=True`` the padded box may extend beyond the
+    image (callers zero-pad the out-of-bounds region); otherwise it is clamped
+    to the image bounds.
+    """
+    if points is not None:
+        inds = np.flipud(np.asarray(points).T)  # rows = (y, x)
+    else:
+        inds = np.where(mask > 0)
+        if inds[0].size == 0:
+            return None
+    h, w = mask.shape[:2]
+    if zero_pad:
+        x_min_bound, y_min_bound = -np.inf, -np.inf
+        x_max_bound, y_max_bound = np.inf, np.inf
+    else:
+        x_min_bound, y_min_bound = 0, 0
+        x_max_bound, y_max_bound = w - 1, h - 1
+
+    x_min = max(inds[1].min() - pad, x_min_bound)
+    y_min = max(inds[0].min() - pad, y_min_bound)
+    x_max = min(inds[1].max() + pad, x_max_bound)
+    y_max = min(inds[0].max() + pad, y_max_bound)
+    return int(x_min), int(y_min), int(x_max), int(y_max)
+
+
+def crop_from_bbox(img: np.ndarray, bbox, zero_pad: bool = False) -> np.ndarray:
+    """Crop ``img`` to ``bbox``; out-of-bounds area (zero_pad) is filled with 0."""
+    bounds = (0, 0, img.shape[1] - 1, img.shape[0] - 1)
+    # Valid (in-image) part of the requested box.
+    bbox_valid = (
+        max(bbox[0], bounds[0]),
+        max(bbox[1], bounds[1]),
+        min(bbox[2], bounds[2]),
+        min(bbox[3], bounds[3]),
+    )
+    if zero_pad:
+        crop_shape = (bbox[3] - bbox[1] + 1, bbox[2] - bbox[0] + 1) + img.shape[2:]
+        crop = np.zeros(crop_shape, dtype=img.dtype)
+        offsets = (-bbox[0], -bbox[1])
+    else:
+        assert bbox == bbox_valid, "out-of-bounds crop requires zero_pad=True"
+        crop_shape = (
+            bbox_valid[3] - bbox_valid[1] + 1,
+            bbox_valid[2] - bbox_valid[0] + 1,
+        ) + img.shape[2:]
+        crop = np.zeros(crop_shape, dtype=img.dtype)
+        offsets = (-bbox_valid[0], -bbox_valid[1])
+
+    inds_x = (bbox_valid[0] + offsets[0], bbox_valid[2] + offsets[0])
+    inds_y = (bbox_valid[1] + offsets[1], bbox_valid[3] + offsets[1])
+    crop[inds_y[0] : inds_y[1] + 1, inds_x[0] : inds_x[1] + 1, ...] = img[
+        bbox_valid[1] : bbox_valid[3] + 1, bbox_valid[0] : bbox_valid[2] + 1, ...
+    ]
+    return crop
+
+
+def crop_from_mask(
+    img: np.ndarray, mask: np.ndarray, relax: int = 0, zero_pad: bool = False
+) -> np.ndarray:
+    """Crop ``img`` to the bbox of ``mask`` expanded by ``relax`` pixels.
+
+    Equivalent of ``helpers.crop_from_mask`` (reference
+    custom_transforms.py:359,366,436,443).  If the mask resolution differs from
+    the image, the mask is nearest-resized to the image first.
+    """
+    if mask.shape[:2] != img.shape[:2]:
+        mask = cv2.resize(
+            mask, (img.shape[1], img.shape[0]), interpolation=cv2.INTER_NEAREST
+        )
+    bbox = get_bbox(mask, pad=relax, zero_pad=zero_pad)
+    if bbox is None:
+        return np.zeros(img.shape, dtype=img.dtype)
+    return crop_from_bbox(img, bbox, zero_pad=zero_pad)
+
+
+def fixed_resize(
+    sample: np.ndarray, resolution, flagval: int | None = None
+) -> np.ndarray:
+    """Resize to ``resolution`` (int => scale shortest side, tuple => (H, W)).
+
+    Equivalent of ``helpers.fixed_resize`` (reference
+    custom_transforms.py:186-193).  Interpolation default mirrors the
+    reference's convention: nearest for {0,1}/{0,255}-valued masks, cubic
+    otherwise.
+    """
+    if flagval is None:
+        if ((sample == 0) | (sample == 1)).all() or ((sample == 0) | (sample == 255)).all():
+            flagval = cv2.INTER_NEAREST
+        else:
+            flagval = cv2.INTER_CUBIC
+
+    if isinstance(resolution, int):
+        tmp = [resolution, resolution]
+        tmp[int(np.argmax(sample.shape[:2]))] = int(
+            round(resolution * np.max(sample.shape[:2]) / np.min(sample.shape[:2]))
+        )
+        resolution = tuple(tmp)
+
+    if sample.ndim == 2 or (sample.ndim == 3 and sample.shape[2] == 3):
+        sample = cv2.resize(
+            sample, (resolution[1], resolution[0]), interpolation=flagval
+        )
+    else:
+        tmp = sample
+        sample = np.zeros(
+            np.append(resolution, tmp.shape[2]).astype(np.int32), dtype=np.float32
+        )
+        for ii in range(sample.shape[2]):
+            sample[:, :, ii] = cv2.resize(
+                tmp[:, :, ii], (resolution[1], resolution[0]), interpolation=flagval
+            )
+    return sample
+
+
+def crop2fullmask(
+    crop_mask: np.ndarray,
+    bbox,
+    im_size: tuple[int, int],
+    zero_pad: bool = False,
+    relax: int = 0,
+    mask_relax: bool = True,
+    interpolation: int = cv2.INTER_CUBIC,
+) -> np.ndarray:
+    """Paste a crop-space prediction back into a full-image-sized mask.
+
+    Inverse of :func:`crop_from_mask`; equivalent of the ``crop2fullmask``
+    contract at reference train_pascal.py:290.  ``bbox`` must be the
+    (already relax-padded) box the crop was taken from; with ``mask_relax``
+    (default) predictions inside the relax border are zeroed after paste-back,
+    so only the un-padded object box contributes to the full-image mask.
+    """
+    if zero_pad:
+        # Mask the valid region in crop coordinates.
+        bounds = (0, 0, im_size[1] - 1, im_size[0] - 1)
+        bbox_valid = (
+            max(bbox[0], bounds[0]),
+            max(bbox[1], bounds[1]),
+            min(bbox[2], bounds[2]),
+            min(bbox[3], bounds[3]),
+        )
+        offsets = (-bbox[0], -bbox[1])
+    else:
+        bbox_valid = bbox
+        offsets = (-bbox[0], -bbox[1])
+
+    inds = tuple(map(int, (
+        bbox_valid[0] + offsets[0],
+        bbox_valid[1] + offsets[1],
+        bbox_valid[2] + offsets[0],
+        bbox_valid[3] + offsets[1],
+    )))
+
+    crop_h = bbox[3] - bbox[1] + 1
+    crop_w = bbox[2] - bbox[0] + 1
+    crop_mask = cv2.resize(
+        crop_mask.astype(np.float32), (crop_w, crop_h), interpolation=interpolation
+    )
+
+    result = np.zeros(im_size, dtype=crop_mask.dtype)
+    result[bbox_valid[1] : bbox_valid[3] + 1, bbox_valid[0] : bbox_valid[2] + 1] = (
+        crop_mask[inds[1] : inds[3] + 1, inds[0] : inds[2] + 1]
+    )
+
+    if mask_relax and relax > 0:
+        # Shave the relax border: keep only the un-padded object box.
+        inner = (
+            max(bbox[0] + relax, 0),
+            max(bbox[1] + relax, 0),
+            min(bbox[2] - relax, im_size[1] - 1),
+            min(bbox[3] - relax, im_size[0] - 1),
+        )
+        keep = np.zeros(im_size, dtype=bool)
+        if inner[2] >= inner[0] and inner[3] >= inner[1]:
+            keep[inner[1] : inner[3] + 1, inner[0] : inner[2] + 1] = True
+        result = np.where(keep, result, 0)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# tensor / layout conversion
+# ---------------------------------------------------------------------------
+
+def tens2image(tens) -> np.ndarray:
+    """Array (possibly batched / channel-first) -> HW(C) numpy image.
+
+    Equivalent of the ``tens2image`` contract at reference
+    train_pascal.py:286,288.  Accepts numpy or jax arrays of shape
+    (H, W), (H, W, C), (C, H, W), (1, ...) — squeezes the leading batch dim
+    and moves a small leading channel dim last.
+    """
+    arr = np.asarray(tens)
+    if arr.ndim == 4:
+        assert arr.shape[0] == 1, "tens2image expects batch size 1"
+        arr = arr[0]
+    if arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[0] < arr.shape[1]:
+        arr = np.moveaxis(arr, 0, -1)
+    if arr.ndim == 3 and arr.shape[2] == 1:
+        arr = arr[:, :, 0]
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# point heatmaps
+# ---------------------------------------------------------------------------
+
+def make_gaussian(size, center, sigma: float = 10.0) -> np.ndarray:
+    """2-D gaussian bump of ``size``=(H, W) centered at ``center``=(x, y)."""
+    x = np.arange(0, size[1], 1, float)
+    y = np.arange(0, size[0], 1, float)[:, np.newaxis]
+    x0, y0 = center[0], center[1]
+    return np.exp(-4 * np.log(2) * ((x - x0) ** 2 + (y - y0) ** 2) / sigma**2)
+
+
+def make_gt(
+    target: np.ndarray,
+    labels,
+    sigma: float = 10.0,
+    one_mask_per_point: bool = False,
+) -> np.ndarray:
+    """Gaussian heatmap image from a point list.
+
+    Equivalent of the ``helpers.make_gt`` contract at reference
+    custom_transforms.py:246 (used by the ExtremePoints transform).
+    """
+    h, w = target.shape[:2]
+    labels = np.asarray(labels)
+    if labels.ndim == 1:
+        labels = labels[np.newaxis]
+    if one_mask_per_point:
+        gt = np.zeros((h, w, labels.shape[0]), dtype=np.float32)
+        for ii in range(labels.shape[0]):
+            gt[:, :, ii] = make_gaussian((h, w), center=labels[ii], sigma=sigma)
+    else:
+        gt = np.zeros((h, w), dtype=np.float32)
+        for ii in range(labels.shape[0]):
+            gt = np.maximum(gt, make_gaussian((h, w), center=labels[ii], sigma=sigma))
+    return gt.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# visualization
+# ---------------------------------------------------------------------------
+
+def color_mask_with_alpha(
+    mask: np.ndarray, color: Sequence[float] = (1.0, 0.0, 0.0), transparency: float = 0.7
+) -> np.ndarray:
+    """Binary mask -> RGBA overlay image (contract of ``colorMaskWithAlpha``
+    at reference train_pascal.py:265)."""
+    out = np.zeros(mask.shape[:2] + (4,), dtype=np.float32)
+    for c in range(3):
+        out[..., c] = mask * color[c]
+    out[..., 3] = mask * transparency
+    return out
+
+
+def overlay_mask(img: np.ndarray, mask: np.ndarray, alpha: float = 0.5,
+                 color: Sequence[float] = (1.0, 0.0, 0.0)) -> np.ndarray:
+    """Blend a binary mask over an RGB image in [0,1] (contract of
+    ``helpers.overlay_mask`` at reference pascal.py:283)."""
+    img = np.asarray(img, dtype=np.float32)
+    mask = np.asarray(mask, dtype=np.float32)
+    out = img.copy()
+    for c in range(3):
+        out[..., c] = np.where(mask > 0.5, (1 - alpha) * img[..., c] + alpha * color[c], img[..., c])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+def generate_param_report(path: str, params: dict) -> None:
+    """Dump a hyperparameter dict to a text file (and a JSON sidecar).
+
+    Equivalent of the ``generate_param_report`` contract at reference
+    train_pascal.py:169.
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        for k, v in params.items():
+            f.write(f"{k}: {v}\n")
+    with open(os.path.splitext(path)[0] + ".json", "w") as f:
+        json.dump({k: str(v) for k, v in params.items()}, f, indent=2)

@@ -1,0 +1,34 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Must set XLA flags before jax initializes — this is the standard JAX idiom for
+exercising multi-device pjit/shard_map paths without TPU hardware
+(SURVEY.md §4).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from distributedpytorch_tpu.data import make_fake_voc  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def fake_voc_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("fake_voc")
+    return make_fake_voc(str(root), n_images=6, size=(120, 160), n_val=2, seed=0)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,178 @@
+"""Tests for the VOC instance dataset and the sharded DataLoader."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.data import (
+    DataLoader,
+    VOCInstanceSegmentation,
+    build_eval_transform,
+    build_train_transform,
+)
+
+
+@pytest.fixture(scope="module")
+def train_ds(fake_voc_root):
+    return VOCInstanceSegmentation(fake_voc_root, split="train")
+
+
+class TestDataset:
+    def test_instance_indexing(self, train_ds):
+        """len == number of objects, not images."""
+        assert len(train_ds) >= 4  # 4 train images, ≥1 object each
+        assert len(train_ds) == len(train_ds.obj_list)
+
+    def test_sample_contract(self, train_ds):
+        s = train_ds[0]
+        assert set(s) == {"image", "gt", "void_pixels", "meta"}
+        assert s["image"].ndim == 3 and s["image"].shape[2] == 3
+        assert s["image"].dtype == np.float32
+        assert set(np.unique(s["gt"])) <= {0.0, 1.0}
+        assert s["gt"].max() == 1.0  # the addressed object exists
+        assert s["meta"]["im_size"] == s["image"].shape[:2]
+
+    def test_void_pixels_disjoint_from_gt(self, train_ds):
+        s = train_ds[0]
+        assert (s["gt"] * s["void_pixels"]).sum() == 0
+
+    def test_single_object_per_sample(self, train_ds):
+        """Two samples of the same image address different objects."""
+        by_image = {}
+        for i in range(len(train_ds)):
+            im, obj = train_ds.obj_list[i]
+            by_image.setdefault(im, []).append(i)
+        multi = [v for v in by_image.values() if len(v) > 1]
+        if not multi:
+            pytest.skip("fixture produced no multi-object image")
+        a, b = multi[0][:2]
+        sa, sb = train_ds[a], train_ds[b]
+        assert not np.array_equal(sa["gt"], sb["gt"])
+
+    def test_preprocess_cache_written_and_reused(self, fake_voc_root, train_ds):
+        cache = train_ds.obj_list_file
+        assert os.path.isfile(cache)
+        obj_dict = json.load(open(cache))
+        assert sorted(obj_dict.keys()) == sorted(train_ds.im_ids)
+        # Second construction reuses the cache (and agrees).
+        ds2 = VOCInstanceSegmentation(fake_voc_root, split="train")
+        assert ds2.obj_dict == train_ds.obj_dict
+
+    def test_area_threshold_filters(self, fake_voc_root):
+        ds_all = VOCInstanceSegmentation(fake_voc_root, split="train")
+        ds_filtered = VOCInstanceSegmentation(
+            fake_voc_root, split="train", area_thres=10**6
+        )
+        assert len(ds_filtered) == 0
+        assert len(ds_all) > 0
+
+    def test_multi_split(self, fake_voc_root):
+        ds = VOCInstanceSegmentation(fake_voc_root, split=["train", "val"])
+        assert len(ds.im_ids) == 6
+
+    def test_missing_root_raises(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            VOCInstanceSegmentation(str(tmp_path / "nope"), split="train")
+
+    def test_str(self, train_ds):
+        assert "VOC2012" in str(train_ds)
+
+
+class TestDataLoader:
+    def test_batches_and_drop_last(self, fake_voc_root):
+        ds = VOCInstanceSegmentation(
+            fake_voc_root, split="train",
+            transform=build_train_transform(crop_size=(32, 32)),
+        )
+        loader = DataLoader(ds, batch_size=2, shuffle=True, drop_last=True,
+                            num_workers=2, seed=0)
+        batches = list(loader)
+        assert len(batches) == len(ds) // 2
+        b = batches[0]
+        assert b["concat"].shape == (2, 32, 32, 4)
+        assert b["crop_gt"].shape == (2, 32, 32, 1)
+        assert isinstance(b["meta"], list) and len(b["meta"]) == 2
+
+    def test_epoch_reshuffles_deterministically(self, fake_voc_root):
+        ds = VOCInstanceSegmentation(fake_voc_root, split="train")
+        loader = DataLoader(ds, batch_size=100, shuffle=True, seed=3, num_workers=0)
+        loader.set_epoch(0)
+        ids0 = [m["object"] for m in next(iter(loader))["meta"]]
+        im0 = [m["image"] for m in next(iter(loader))["meta"]]
+        loader.set_epoch(1)
+        im1 = [m["image"] for m in next(iter(loader))["meta"]]
+        loader.set_epoch(0)
+        assert [m["image"] for m in next(iter(loader))["meta"]] == im0
+        assert [m["object"] for m in next(iter(loader))["meta"]] == ids0
+        assert im0 != im1 or len(ds) <= 2
+
+    def test_host_sharding_disjoint_and_complete(self, fake_voc_root):
+        """Two shards cover disjoint index sets — the distributed sampler."""
+        ds = VOCInstanceSegmentation(fake_voc_root, split="train")
+        seen = []
+        for shard in range(2):
+            loader = DataLoader(ds, batch_size=1, shuffle=True, seed=5,
+                                shard_index=shard, num_shards=2, num_workers=0)
+            keys = [
+                (m["image"], m["object"])
+                for batch in loader
+                for m in batch["meta"]
+            ]
+            seen.append(set(keys))
+        assert seen[0].isdisjoint(seen[1])
+        assert len(seen[0]) == len(seen[1])  # balanced
+
+    def test_worker_parity(self, fake_voc_root):
+        """Same data regardless of worker count (explicit per-sample RNG)."""
+        ds = VOCInstanceSegmentation(
+            fake_voc_root, split="train",
+            transform=build_train_transform(crop_size=(32, 32)),
+        )
+        def run(workers):
+            loader = DataLoader(ds, batch_size=2, shuffle=True, drop_last=True,
+                                seed=0, num_workers=workers)
+            return [b["concat"] for b in loader]
+        a, b = run(0), run(3)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_eval_loader_ragged_fullres(self, fake_voc_root):
+        ds = VOCInstanceSegmentation(
+            fake_voc_root, split="val",
+            transform=build_eval_transform(crop_size=(32, 32)),
+        )
+        loader = DataLoader(ds, batch_size=1, num_workers=0)
+        b = next(iter(loader))
+        assert b["concat"].shape[0] == 1
+        assert b["gt"].shape[1:3] == (120, 160)  # full-res kept
+
+
+class TestLoaderRegressions:
+    def test_void_pixels_stacked(self, fake_voc_root):
+        """collate must not treat 'vo*id*_pixels' as a metadata key."""
+        ds = VOCInstanceSegmentation(
+            fake_voc_root, split="train",
+            transform=build_train_transform(crop_size=(32, 32)),
+        )
+        import numpy as np
+        from distributedpytorch_tpu.data import collate
+        batch = collate([ds.__getitem__(0, rng=np.random.default_rng(0)),
+                         ds.__getitem__(1, rng=np.random.default_rng(1))])
+        assert isinstance(batch["concat"], np.ndarray)
+
+    def test_abandoned_iterator_no_leak(self, fake_voc_root):
+        """Early break must terminate the producer thread."""
+        import threading
+        ds = VOCInstanceSegmentation(
+            fake_voc_root, split="train",
+            transform=build_train_transform(crop_size=(32, 32)),
+        )
+        before = threading.active_count()
+        for _ in range(5):
+            it = iter(DataLoader(ds, batch_size=1, num_workers=2, prefetch=1))
+            next(it)
+            it.close()  # abandon
+        after = threading.active_count()
+        assert after <= before + 1

@@ -1,0 +1,95 @@
+"""Unit tests for guidance-map synthesis (extreme points, n-ellipse, maps)."""
+
+import numpy as np
+
+from distributedpytorch_tpu.data import guidance
+
+
+def ellipse_mask(h=80, w=100, cy=40, cx=50, ay=20, ax=30):
+    Y, X = np.mgrid[0:h, 0:w]
+    return (((X - cx) / ax) ** 2 + ((Y - cy) / ay) ** 2 <= 1).astype(np.float32)
+
+
+class TestExtremePoints:
+    def test_fixed_deterministic(self):
+        m = ellipse_mask()
+        p1 = guidance.extreme_points_fixed(m)
+        p2 = guidance.extreme_points_fixed(m)
+        np.testing.assert_array_equal(p1, p2)
+
+    def test_fixed_on_boundary(self):
+        m = ellipse_mask()
+        pts = guidance.extreme_points_fixed(m)
+        # Points are mask pixels at the extreme coordinates.
+        assert {tuple(p) for p in pts} <= {
+            (x, y) for y, x in zip(*np.where(m > 0))
+        }
+        xs, ys = pts[:, 0], pts[:, 1]
+        assert xs.min() == 20 and xs.max() == 80  # cx ± ax
+        assert ys.min() == 20 and ys.max() == 60  # cy ± ay
+
+    def test_random_within_pert(self, rng):
+        m = ellipse_mask()
+        base = guidance.extreme_points_fixed(m)
+        for _ in range(5):
+            pts = guidance.extreme_points(m, pert=3, rng=rng)
+            # left x within pert of true min x, etc.
+            assert abs(pts[0, 0] - base[:, 0].min()) <= 3
+            assert abs(pts[2, 0] - base[:, 0].max()) <= 3
+            assert abs(pts[1, 1] - base[:, 1].min()) <= 3
+            assert abs(pts[3, 1] - base[:, 1].max()) <= 3
+
+    def test_random_reproducible(self):
+        m = ellipse_mask()
+        a = guidance.extreme_points(m, 5, rng=np.random.default_rng(7))
+        b = guidance.extreme_points(m, 5, rng=np.random.default_rng(7))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestNEllipse:
+    def test_values_in_01(self):
+        m = ellipse_mask()
+        pts = guidance.extreme_points_fixed(m)
+        z = guidance.compute_nellipse(np.arange(m.shape[1]), np.arange(m.shape[0]), pts)
+        assert z.shape == m.shape
+        assert 0.0 <= z.min() and z.max() <= 1.0
+
+    def test_high_inside_low_outside(self):
+        m = ellipse_mask()
+        pts = guidance.extreme_points_fixed(m)
+        z = guidance.compute_nellipse(np.arange(m.shape[1]), np.arange(m.shape[0]), pts)
+        assert z[40, 50] > 0.9   # center of object
+        assert z[0, 0] < 0.1     # far corner
+
+    def test_gaussian_hm_pair(self):
+        m = ellipse_mask()
+        pts = guidance.extreme_points_fixed(m)
+        z1, z2 = guidance.compute_nellipse_gaussian_hm(
+            np.arange(m.shape[1]), np.arange(m.shape[0]), pts
+        )
+        assert z1.shape == z2.shape == m.shape
+        # Gaussian heatmap peaks (≈1) at each extreme point.
+        for x, y in pts:
+            assert z2[y, x] > 0.99
+
+
+class TestConfidenceMaps:
+    def test_mvgauss_peak_near_center(self):
+        m = ellipse_mask()
+        out = guidance.generate_mvgauss_image(m)
+        assert out.shape == m.shape
+        peak = np.unravel_index(out.argmax(), out.shape)
+        assert abs(peak[0] - 40) < 3 and abs(peak[1] - 50) < 3
+
+    def test_l1l2_triple(self):
+        m = ellipse_mask()
+        pts = guidance.extreme_points_fixed(m)
+        h_map, d1, d2 = guidance.generate_mv_l1l2_image_skewed_axes(m, pts)
+        assert h_map.shape == d1.shape == d2.shape == m.shape
+        assert h_map[40, 50] > h_map[0, 0]
+
+    def test_normalize(self):
+        arr = np.array([[1.0, 3.0], [5.0, 2.0]])
+        out = guidance.normalize_wt_map(arr)
+        assert out.min() == 0.0
+        assert abs(out.max() - 1.0) < 1e-6

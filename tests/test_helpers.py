@@ -1,0 +1,158 @@
+"""Unit tests for utils.helpers: bbox, crops, resize, paste-back, heatmaps."""
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.utils import helpers
+
+
+def square_mask(h=40, w=60, y0=10, y1=20, x0=15, x1=30):
+    m = np.zeros((h, w), dtype=np.float32)
+    m[y0:y1, x0:x1] = 1.0
+    return m
+
+
+class TestGetBbox:
+    def test_tight(self):
+        m = square_mask()
+        assert helpers.get_bbox(m) == (15, 10, 29, 19)
+
+    def test_pad_clamped(self):
+        m = square_mask()
+        assert helpers.get_bbox(m, pad=100) == (0, 0, 59, 39)
+
+    def test_pad_zero_pad_unclamped(self):
+        m = square_mask()
+        assert helpers.get_bbox(m, pad=100, zero_pad=True) == (-85, -90, 129, 119)
+
+    def test_empty_mask(self):
+        assert helpers.get_bbox(np.zeros((5, 5))) is None
+
+    def test_from_points(self):
+        pts = [(3, 4), (10, 2), (7, 9)]
+        assert helpers.get_bbox(np.zeros((20, 20)), points=pts) == (3, 2, 10, 9)
+
+
+class TestCropFromMask:
+    def test_no_relax(self):
+        img = np.arange(40 * 60, dtype=np.float32).reshape(40, 60)
+        m = square_mask()
+        crop = helpers.crop_from_mask(img, m, relax=0)
+        np.testing.assert_array_equal(crop, img[10:20, 15:30])
+
+    def test_relax_zero_pad_shape(self):
+        img = np.ones((40, 60, 3), dtype=np.float32)
+        m = square_mask()
+        crop = helpers.crop_from_mask(img, m, relax=50, zero_pad=True)
+        # bbox (15,10,29,19) + 50 → size (10+100, 15+100)
+        assert crop.shape == (110, 115, 3)
+
+    def test_zero_pad_fills_zeros(self):
+        img = np.ones((40, 60), dtype=np.float32)
+        m = square_mask()
+        crop = helpers.crop_from_mask(img, m, relax=50, zero_pad=True)
+        assert crop[0, 0] == 0.0  # out-of-image corner
+        assert crop[50, 50] == 1.0  # in-image center
+
+    def test_empty_mask_returns_zeros(self):
+        img = np.ones((8, 8), dtype=np.float32)
+        crop = helpers.crop_from_mask(img, np.zeros((8, 8)), relax=2, zero_pad=True)
+        np.testing.assert_array_equal(crop, np.zeros_like(img))
+
+
+class TestFixedResize:
+    def test_binary_uses_nearest(self):
+        m = square_mask()
+        out = helpers.fixed_resize(m, (80, 120))
+        assert set(np.unique(out)) <= {0.0, 1.0}
+        assert out.shape == (80, 120)
+
+    def test_multichannel(self):
+        arr = np.random.default_rng(0).random((30, 40, 5)).astype(np.float32)
+        out = helpers.fixed_resize(arr, (60, 80))
+        assert out.shape == (60, 80, 5)
+
+    def test_int_resolution_keeps_aspect(self):
+        arr = np.zeros((50, 100), dtype=np.float32)
+        out = helpers.fixed_resize(arr, 64)
+        assert out.shape == (64, 128)
+
+
+class TestCrop2Fullmask:
+    def test_roundtrip(self):
+        """crop → paste-back reproduces the mask (the eval-path inverse)."""
+        full = square_mask(64, 64, 20, 40, 10, 50)
+        relax, zero_pad = 5, True
+        crop = helpers.crop_from_mask(full, full, relax=relax, zero_pad=zero_pad)
+        crop512 = helpers.fixed_resize(crop, (96, 96))
+        bbox = helpers.get_bbox(full, pad=relax, zero_pad=zero_pad)
+        back = helpers.crop2fullmask(crop512, bbox, full.shape, zero_pad=zero_pad,
+                                     relax=relax)
+        iou = ((back > 0.5) & (full > 0.5)).sum() / ((back > 0.5) | (full > 0.5)).sum()
+        assert iou > 0.95
+
+    def test_bbox_beyond_borders(self):
+        full = square_mask(32, 32, 0, 10, 0, 12)  # touches the top-left corner
+        bbox = helpers.get_bbox(full, pad=8, zero_pad=True)
+        assert bbox[0] < 0 and bbox[1] < 0
+        crop = helpers.crop_from_mask(full, full, relax=8, zero_pad=True)
+        back = helpers.crop2fullmask(crop, bbox, full.shape, zero_pad=True, relax=8)
+        iou = ((back > 0.5) & (full > 0.5)).sum() / ((back > 0.5) | (full > 0.5)).sum()
+        assert iou > 0.95
+
+
+class TestHeatmaps:
+    def test_make_gaussian_peak(self):
+        g = helpers.make_gaussian((21, 21), center=(10, 10), sigma=5)
+        assert g[10, 10] == pytest.approx(1.0)
+        assert g[0, 0] < 0.1
+
+    def test_make_gt_max_combine(self):
+        target = np.zeros((30, 30))
+        gt = helpers.make_gt(target, [(5, 5), (25, 25)], sigma=6)
+        assert gt.shape == (30, 30)
+        assert gt[5, 5] == pytest.approx(1.0, abs=1e-5)
+        assert gt[25, 25] == pytest.approx(1.0, abs=1e-5)
+
+    def test_make_gt_one_mask_per_point(self):
+        gt = helpers.make_gt(np.zeros((10, 10)), [(2, 2), (8, 8)], sigma=3,
+                             one_mask_per_point=True)
+        assert gt.shape == (10, 10, 2)
+
+
+class TestTens2Image:
+    def test_chw(self):
+        t = np.zeros((3, 8, 9))
+        assert helpers.tens2image(t).shape == (8, 9, 3)
+
+    def test_nchw(self):
+        t = np.zeros((1, 1, 8, 9))
+        assert helpers.tens2image(t).shape == (8, 9)
+
+    def test_hwc_passthrough(self):
+        t = np.zeros((8, 9, 3))
+        assert helpers.tens2image(t).shape == (8, 9, 3)
+
+
+def test_param_report(tmp_path):
+    path = str(tmp_path / "report.txt")
+    helpers.generate_param_report(path, {"lr": 5e-8, "epochs": 100})
+    text = open(path).read()
+    assert "lr" in text and "epochs" in text
+
+
+class TestCrop2FullmaskRelax:
+    def test_border_shaved(self):
+        """Predictions inside the relax border are dropped on paste-back."""
+        full = square_mask(64, 64, 20, 40, 10, 50)
+        relax = 6
+        bbox = helpers.get_bbox(full, pad=relax, zero_pad=True)
+        crop = np.ones((bbox[3] - bbox[1] + 1, bbox[2] - bbox[0] + 1), np.float32)
+        back = helpers.crop2fullmask(crop, bbox, full.shape, zero_pad=True,
+                                     relax=relax, mask_relax=True)
+        # Border region (outside the un-padded object bbox) must be zero.
+        assert back[bbox[1] + 1, bbox[0] + 1] == 0.0
+        assert back[25, 30] == 1.0  # object interior survives
+        no_shave = helpers.crop2fullmask(crop, bbox, full.shape, zero_pad=True,
+                                         relax=relax, mask_relax=False)
+        assert no_shave.sum() > back.sum()

@@ -1,0 +1,246 @@
+"""Tests for the transform library: numerics, key contract, pipeline shapes."""
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.data import transforms as T
+from distributedpytorch_tpu.data.pipeline import (
+    GUIDANCE_KEY,
+    build_eval_transform,
+    build_train_transform,
+)
+
+
+def make_sample(h=60, w=80):
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, (h, w, 3)).astype(np.float32)
+    gt = np.zeros((h, w), dtype=np.float32)
+    gt[20:40, 25:55] = 1.0
+    void = np.zeros((h, w), dtype=np.float32)
+    void[19:20, 25:55] = 1.0
+    return {
+        "image": img,
+        "gt": gt,
+        "void_pixels": void,
+        "meta": {"image": "x", "object": "0", "category": 1, "im_size": (h, w)},
+    }
+
+
+class TestRandomHorizontalFlip:
+    def test_flip_applied_consistently(self):
+        s = make_sample()
+        img0, gt0 = s["image"].copy(), s["gt"].copy()
+        # Find a seed that flips.
+        for seed in range(20):
+            rng = np.random.default_rng(seed)
+            if np.random.default_rng(seed).random() < 0.5:
+                out = T.RandomHorizontalFlip()(make_sample(), rng)
+                np.testing.assert_array_equal(out["image"], img0[:, ::-1])
+                np.testing.assert_array_equal(out["gt"], gt0[:, ::-1])
+                return
+        pytest.fail("no flipping seed found")
+
+    def test_meta_untouched(self):
+        s = T.RandomHorizontalFlip(p=1.0)(make_sample(), np.random.default_rng(0))
+        assert s["meta"]["image"] == "x"
+
+
+class TestScaleNRotate:
+    def test_gt_stays_binary(self, rng):
+        s = T.ScaleNRotate(rots=(-20, 20), scales=(0.75, 1.25))(make_sample(), rng)
+        assert set(np.unique(s["gt"])) <= {0, 1}
+
+    def test_shapes_preserved(self, rng):
+        s = T.ScaleNRotate()(make_sample(), rng)
+        assert s["image"].shape == (60, 80, 3)
+        assert s["gt"].shape == (60, 80)
+
+    def test_list_mode(self, rng):
+        s = T.ScaleNRotate(rots=[0], scales=[1.0])(make_sample(), rng)
+        # Identity warp on uint8-cast image.
+        np.testing.assert_allclose(s["gt"], make_sample()["gt"])
+
+    def test_mixed_args_rejected(self):
+        with pytest.raises(TypeError):
+            T.ScaleNRotate(rots=(0, 1), scales=[1.0])
+
+
+class TestCropFromMaskStatic:
+    def test_crop_keys_added(self):
+        s = T.CropFromMaskStatic(relax=10, zero_pad=True)(make_sample())
+        assert "crop_image" in s and "crop_gt" in s
+        # bbox (25,20,54,39) + 10 relax → (40, 50)
+        assert s["crop_gt"].shape == (40, 50)
+        assert s["crop_image"].shape == (40, 50, 3)
+
+    def test_empty_mask_zeros(self):
+        s = make_sample()
+        s["gt"] = np.zeros_like(s["gt"])
+        out = T.CropFromMaskStatic(relax=5, zero_pad=True)(s)
+        assert out["crop_gt"].max() == 0
+        assert out["crop_image"].shape == s["image"].shape
+
+
+class TestCropFromMaskDynamic:
+    def test_records_relax_and_crops(self, rng):
+        s = T.CropFromMask(d=64, is_val=True)(make_sample(), rng)
+        assert "crop_relax" in s and s["crop_relax"] >= 1
+        assert "crop_image" in s and "crop_gt" in s
+
+    def test_train_randomized(self):
+        outs = set()
+        for seed in range(5):
+            s = T.CropFromMask(d=64, is_val=False)(
+                make_sample(), np.random.default_rng(seed)
+            )
+            outs.add(s["crop_relax"])
+        assert len(outs) > 1
+
+
+class TestFixedResize:
+    def test_resize_and_prune(self):
+        s = make_sample()
+        s["crop_image"] = s["image"].copy()
+        s["crop_gt"] = s["gt"].copy()
+        out = T.FixedResize(resolutions={"crop_image": (32, 32), "crop_gt": (32, 32)})(s)
+        # Unlisted keys deleted (reference deletion rule), meta exempt.
+        assert set(out.keys()) == {"crop_image", "crop_gt", "meta"}
+        assert out["crop_image"].shape == (32, 32, 3)
+        assert out["crop_gt"].shape == (32, 32)
+
+    def test_none_passthrough(self):
+        s = make_sample()
+        out = T.FixedResize(resolutions={"gt": None, "image": (32, 32),
+                                         "void_pixels": None})(s)
+        assert out["gt"].shape == (60, 80)  # untouched
+        assert out["image"].shape == (32, 32, 3)
+
+    def test_list_stacking(self):
+        s = make_sample()
+        s["crop_gt"] = [s["gt"].copy(), s["gt"].copy()]
+        out = T.FixedResize(resolutions={"crop_gt": (16, 16)})(s)
+        assert out["crop_gt"].shape == (16, 16, 2)
+
+
+class TestGuidanceTransforms:
+    def _cropped(self):
+        s = make_sample()
+        s = T.CropFromMaskStatic(relax=10, zero_pad=True)(s)
+        return s
+
+    def test_nellipse_with_gaussians_range(self, rng):
+        s = T.NEllipseWithGaussians(alpha=0.6, is_val=True)(self._cropped(), rng)
+        z = s[GUIDANCE_KEY]
+        assert z.shape == s["crop_gt"].shape
+        assert z.max() == pytest.approx(255.0, rel=1e-5)
+        assert z.min() >= 0.0
+
+    def test_nellipse_empty_gt(self):
+        s = self._cropped()
+        s["crop_gt"] = np.zeros_like(s["crop_gt"])
+        out = T.NEllipseWithGaussians()(s)
+        assert out[GUIDANCE_KEY].max() == 0
+
+    def test_val_deterministic(self):
+        a = T.NEllipseWithGaussians(is_val=True)(self._cropped())[GUIDANCE_KEY]
+        b = T.NEllipseWithGaussians(is_val=True)(self._cropped())[GUIDANCE_KEY]
+        np.testing.assert_array_equal(a, b)
+
+    def test_extreme_points_transform(self, rng):
+        s = self._cropped()
+        out = T.ExtremePoints(sigma=10, pert=0, elem="crop_gt", is_val=True)(s, rng)
+        assert out["extreme_points"].shape == s["crop_gt"].shape
+        assert out["extreme_points"].max() == pytest.approx(1.0, abs=1e-4)
+
+    def test_confidence_map(self, rng):
+        s = self._cropped()
+        out = T.AddConfidenceMap(elem="crop_image", hm_type="gaussian")(s, rng)
+        assert out["with_hm"].shape[2] == 4
+
+
+class TestConcatToArray:
+    def test_concat_4ch(self):
+        s = make_sample()
+        s["hm"] = np.ones(s["gt"].shape, dtype=np.float32)
+        out = T.ConcatInputs(elems=("image", "hm"))(s)
+        assert out["concat"].shape == (60, 80, 4)
+
+    def test_concat_shape_mismatch(self):
+        s = make_sample()
+        s["hm"] = np.ones((10, 10), dtype=np.float32)
+        with pytest.raises(ValueError):
+            T.ConcatInputs(elems=("image", "hm"))(s)
+
+    def test_to_array_hwc(self):
+        s = make_sample()
+        out = T.ToArray()(s)
+        assert out["gt"].shape == (60, 80, 1)  # channel axis added
+        assert out["image"].dtype == np.float32
+        assert isinstance(out["meta"], dict)
+
+    def test_bb_mask(self):
+        out = T.CreateBBMask()(make_sample())
+        assert set(np.unique(out["bb_mask"])) == {0.0, 255.0}
+
+
+class TestPipelines:
+    def test_train_pipeline_contract(self, rng):
+        """End-to-end train stack reproduces the reference's batch contract:
+        'concat' (H,W,4) in [0,255] with non-degenerate channels, binary
+        'crop_gt' (the driver's data-sanity asserts, train_pascal.py:188-190)."""
+        tf = build_train_transform(crop_size=(64, 64))
+        s = tf(make_sample(), rng)
+        assert s["concat"].shape == (64, 64, 4)
+        assert s["crop_gt"].shape == (64, 64, 1)
+        assert 0 <= s["concat"].min() and s["concat"].max() <= 255
+        assert len(np.unique(s["concat"][..., :3])) > 2
+        assert set(np.unique(s["crop_gt"])) <= {0.0, 1.0}
+
+    def test_eval_pipeline_keeps_fullres(self, rng):
+        tf = build_eval_transform(crop_size=(64, 64))
+        s = tf(make_sample(), rng)
+        assert s["gt"].shape == (60, 80, 1)          # full-res kept for metric
+        assert s["void_pixels"].shape == (60, 80, 1)
+        assert s["concat"].shape == (64, 64, 4)
+
+    def test_eval_deterministic(self):
+        tf = build_eval_transform(crop_size=(64, 64))
+        a = tf(make_sample(), np.random.default_rng(0))
+        b = tf(make_sample(), np.random.default_rng(99))
+        np.testing.assert_array_equal(a["concat"], b["concat"])
+
+    def test_guidance_families(self, rng):
+        for fam, ch in [("nellipse", 4), ("extreme_points", 4), ("none", 3)]:
+            tf = build_train_transform(crop_size=(32, 32), guidance=fam)
+            s = tf(make_sample(), rng)
+            assert s["concat"].shape[2] == ch, fam
+
+
+class TestReviewRegressions:
+    """Regressions for the round-1 code-review findings."""
+
+    def test_create_bbmask_inclusive(self):
+        s = make_sample()
+        s["gt"] = np.zeros_like(s["gt"])
+        s["gt"][30, 40] = 1.0  # single pixel
+        out = T.CreateBBMask()(s)
+        assert out["bb_mask"][30, 40] == 0.0  # the pixel is inside its own box
+
+    def test_dynamic_crop_degenerate_keyset(self, rng):
+        tf = T.CropFromMask(crop_elems=("image", "gt", "void_pixels"), d=64, is_val=True)
+        s_ok = tf(make_sample(), rng)
+        s_empty = make_sample()
+        s_empty["gt"] = np.zeros_like(s_empty["gt"])
+        s_empty = tf(s_empty, rng)
+        assert set(s_ok.keys()) == set(s_empty.keys())
+        assert s_empty["crop_relax"] == 0
+
+    def test_extreme_points_coord_scaling(self):
+        s = {
+            "extreme_points_coord": np.array([[10, 5], [20, 15]]),
+            "bbox": np.array([0, 0, 39, 19]),  # 40 wide, 20 tall, inclusive
+        }
+        out = T.FixedResize(resolutions={"extreme_points_coord": (40, 80)})(dict(s))
+        # width doubles (40->80), height doubles (20->40)
+        np.testing.assert_array_equal(out["extreme_points_coord"],
+                                      [[20, 10], [40, 30]])
