@@ -164,7 +164,12 @@ def generate_mvgauss_image(
     ys, xs = np.where(mask > 0.5)
     pts = np.stack([xs, ys], axis=1).astype(np.float64)
     mean = pts.mean(axis=0)
-    cov = np.cov(pts.T) + np.eye(2) * 1e-3
+    if pts.shape[0] < 2:
+        # A single-pixel mask has no sample covariance (np.cov -> NaN);
+        # use an isotropic unit covariance centered on the pixel instead.
+        cov = np.eye(2)
+    else:
+        cov = np.cov(pts.T) + np.eye(2) * 1e-3
     icov = np.linalg.inv(cov)
     h, w = mask.shape[:2]
     X, Y = np.meshgrid(np.arange(w), np.arange(h))

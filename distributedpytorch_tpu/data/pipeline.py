@@ -163,9 +163,6 @@ class DataLoader:
         num_shards: int = 1,
         prefetch: int = 2,
     ):
-        if num_shards > 1 and not drop_last:
-            # Uneven shards would desynchronize collective step counts.
-            drop_last = True
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -185,17 +182,25 @@ class DataLoader:
         order = np.arange(n)
         if self.shuffle:
             np.random.default_rng((self.seed, self.epoch)).shuffle(order)
-        # Per-host shard: contiguous strides of the permutation.
-        per_shard = n // self.num_shards if self.num_shards > 1 else n
         if self.num_shards > 1:
+            # Pad the permutation (wrap-around) to a multiple of num_shards so
+            # every sample lands in some shard and all shards are equal-length
+            # — uneven shards would desynchronize collective step counts, and
+            # truncation would silently drop the tail from evaluation.
+            per_shard = -(-n // self.num_shards)
+            total = per_shard * self.num_shards
+            if total > n:
+                order = np.concatenate([order, order[: total - n]])
             order = order[self.shard_index * per_shard : (self.shard_index + 1) * per_shard]
         return order
 
-    def __len__(self) -> int:
-        n = len(self._epoch_indices())
+    def _num_batches(self, n_indices: int) -> int:
         if self.drop_last:
-            return n // self.batch_size
-        return (n + self.batch_size - 1) // self.batch_size
+            return n_indices // self.batch_size
+        return (n_indices + self.batch_size - 1) // self.batch_size
+
+    def __len__(self) -> int:
+        return self._num_batches(len(self._epoch_indices()))
 
     def _load_one(self, index: int) -> dict:
         rng = np.random.default_rng((self.seed, self.epoch, int(index)))
@@ -203,7 +208,7 @@ class DataLoader:
 
     def __iter__(self) -> Iterator[dict]:
         order = self._epoch_indices()
-        nb = len(self)
+        nb = self._num_batches(len(order))
         batches = [order[i * self.batch_size : (i + 1) * self.batch_size] for i in range(nb)]
         if self.num_workers == 0:
             for idxs in batches:

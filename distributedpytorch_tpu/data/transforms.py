@@ -267,7 +267,21 @@ class CropFromMaskStatic(Transform):
         self.zero_pad = zero_pad
 
     def __call__(self, sample, rng=None):
-        return _crop_elems(sample, self.crop_elems, self.mask_elem, self.relax, self.zero_pad)
+        sample = _crop_elems(sample, self.crop_elems, self.mask_elem,
+                             self.relax, self.zero_pad)
+        # Record the (relaxed) crop bbox: FixedResize rescales point
+        # coordinates by it, and the evaluator's crop->fullmask paste-back can
+        # reuse it instead of recomputing from the full-res gt.
+        mask = sample[self.mask_elem]
+        if mask.ndim == 3:
+            mask = mask[..., 0]
+        bbox = helpers.get_bbox(mask, pad=self.relax, zero_pad=self.zero_pad)
+        if bbox is None:
+            # Empty mask: the crop was a full-image passthrough of zeros;
+            # record the full-image box so batches keep a consistent key set.
+            bbox = (0, 0, mask.shape[1] - 1, mask.shape[0] - 1)
+        sample["bbox"] = np.asarray(bbox, dtype=np.int64)
+        return sample
 
     def __repr__(self):
         return (f"CropFromMaskStatic(elems={self.crop_elems}, relax={self.relax}, "
@@ -432,7 +446,7 @@ class AddConfidenceMap(Transform):
     confidence map appended as an extra channel -> ``sample['with_hm']``
     (reference custom_transforms.py:253-298; inactive in the live driver)."""
 
-    def __init__(self, elem="image", hm_type="l1l2", tau: float = 1.0,
+    def __init__(self, elem="crop_image", hm_type="l1l2", tau: float = 1.0,
                  pert: int = 0, is_val: bool = True):
         assert hm_type in ("l1l2", "gaussian")
         self.elem = elem

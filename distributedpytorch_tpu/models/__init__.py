@@ -1,0 +1,72 @@
+"""Model zoo: dilated ResNet backbones, DANet and DeepLabV3 heads.
+
+``build_model`` is the single factory the trainer and configs use — the
+framework equivalent of the reference's hardwired ``DANet(1, 'resnet101')``
+construction (reference train_pascal.py:86) plus its commented DeepLab
+alternative (train_pascal.py:85).
+
+Contract: every model's ``__call__(x_nhwc, train)`` returns a *tuple* of
+input-resolution logit maps, primary prediction first, so the multi-output
+loss and eval code are model-agnostic.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .danet import DANet, DANetHead
+from .deeplab import ASPP, DeepLabV3, FCNHead
+from .resnet import ResNet, resnet50, resnet101
+
+_BACKBONE_DEPTH = {"resnet18": 18, "resnet34": 34, "resnet50": 50,
+                   "resnet101": 101, "resnet152": 152}
+
+
+def build_model(
+    name: str = "danet",
+    nclass: int = 1,
+    backbone: str = "resnet101",
+    output_stride: int | None = None,
+    dtype: str | jnp.dtype = jnp.float32,
+    bn_cross_replica_axis: str | None = None,
+    **kw,
+):
+    """Construct a segmentation model by name.
+
+    ``dtype`` may be a string ('float32' / 'bfloat16') for config-file use.
+    """
+    if isinstance(dtype, str):
+        dtype = jnp.dtype(dtype)
+    depth = _BACKBONE_DEPTH[backbone]
+    if name == "danet":
+        return DANet(
+            nclass=nclass,
+            backbone_depth=depth,
+            output_stride=output_stride or 8,
+            dtype=dtype,
+            bn_cross_replica_axis=bn_cross_replica_axis,
+            **kw,
+        )
+    if name == "deeplabv3":
+        return DeepLabV3(
+            nclass=nclass,
+            backbone_depth=depth,
+            output_stride=output_stride or 16,
+            dtype=dtype,
+            bn_cross_replica_axis=bn_cross_replica_axis,
+            **kw,
+        )
+    raise ValueError(f"unknown model: {name!r} (danet | deeplabv3)")
+
+
+__all__ = [
+    "ASPP",
+    "DANet",
+    "DANetHead",
+    "DeepLabV3",
+    "FCNHead",
+    "ResNet",
+    "build_model",
+    "resnet50",
+    "resnet101",
+]

@@ -1,0 +1,32 @@
+"""Compute primitives: attention, losses, metrics.
+
+These are the pure-function kernels under the model layer — the part of the
+reference that lived in external CUDA packages (PyTorch-Encoding's DANet
+attention blocks, ``SegmentationMultiLosses``; reference train_pascal.py:32-33)
+re-expressed as XLA-compiled jnp (with Pallas variants for the hot attention
+path).
+"""
+
+from .attention import (
+    position_attention,
+    blocked_position_attention,
+    channel_attention,
+)
+from .losses import (
+    sigmoid_balanced_bce,
+    multi_output_loss,
+    softmax_xent_ignore,
+)
+from .metrics import jaccard, batched_jaccard, threshold_sweep_jaccard
+
+__all__ = [
+    "position_attention",
+    "blocked_position_attention",
+    "channel_attention",
+    "sigmoid_balanced_bce",
+    "multi_output_loss",
+    "softmax_xent_ignore",
+    "jaccard",
+    "batched_jaccard",
+    "threshold_sweep_jaccard",
+]

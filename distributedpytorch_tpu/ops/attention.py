@@ -1,0 +1,114 @@
+"""Attention primitives for the dual-attention segmentation head.
+
+The reference's DANet model (imported from PyTorch-Encoding at reference
+train_pascal.py:32,86) pairs a *position* attention module (full self-attention
+over the H/8 x W/8 spatial tokens) with a *channel* attention module (gram-matrix
+attention over feature channels).  Those live in external CUDA code there; here
+they are pure jnp functions the flax modules call, designed for the MXU:
+
+* everything is batched einsum — XLA tiles these straight onto the systolic
+  array; no python loops over tokens;
+* :func:`blocked_position_attention` is the same math with an online-softmax
+  scan over key/value blocks, so the N x N score matrix is never materialized.
+  This is the memory-bound form that scales to long token counts and is the
+  building block the ring/sequence-parallel path reuses (each ring hop feeds
+  one key/value block and carries the same running (max, sum, acc) state).
+
+Layouts: spatial features are (B, N, C) token-major — N = H*W spatial tokens —
+the natural NHWC flattening.  Scores accumulate in float32 regardless of input
+dtype (bf16-safe softmax).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def position_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Full position (spatial self-) attention.
+
+    ``q``/``k``: (B, N, Ck), ``v``: (B, N, Cv) -> (B, N, Cv).
+
+    Semantics of the reference DANet position-attention module (consumed via
+    the 3-tuple output indexed at reference train_pascal.py:258-260): raw
+    dot-product scores over all token pairs, softmax over keys, no scaling
+    term — DANet uses unscaled energies with a learned residual gate (the
+    gate lives in the calling flax module).
+    """
+    scores = jnp.einsum("bnc,bmc->bnm", q, k, preferred_element_type=jnp.float32)
+    attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bnm,bmc->bnc", attn, v)
+
+
+def blocked_position_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, block_size: int = 1024
+) -> jax.Array:
+    """Position attention with online softmax over key/value blocks.
+
+    Identical math to :func:`position_attention` but O(N * block) memory: a
+    ``lax.scan`` over K/V blocks carries running (row-max, row-sum, weighted
+    accumulator) state — the flash-attention recurrence.  Use when N*N scores
+    would not fit HBM (large crops / long sequences); also the per-hop kernel
+    of the ring-attention path (parallel.ring).
+    """
+    b, n, ck = q.shape
+    cv = v.shape[-1]
+    nb = -(-n // block_size)  # ceil
+    pad = nb * block_size - n
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(b, nb, block_size, ck)
+    vb = v.reshape(b, nb, block_size, cv)
+    # Mask padded keys with -inf scores so they never receive weight.
+    key_valid = (jnp.arange(nb * block_size) < n).reshape(nb, block_size)
+
+    def step(carry, blk):
+        m, s, acc = carry  # (B,N) running max, (B,N) running sum, (B,N,Cv)
+        kblk, vblk, valid = blk
+        scores = jnp.einsum(
+            "bnc,bmc->bnm", q, kblk, preferred_element_type=jnp.float32
+        )
+        scores = jnp.where(valid[None, None, :], scores, -jnp.inf)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # Rescale previous accumulator to the new max; exp(-inf - m) == 0
+        # handles the first block / fully-masked rows without special cases.
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        s_new = s * correction + p.sum(axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum(
+            "bnm,bmc->bnc", p, vblk.astype(jnp.float32)
+        )
+        return (m_new, s_new, acc_new), None
+
+    init = (
+        jnp.full((b, n), -jnp.inf, jnp.float32),
+        jnp.zeros((b, n), jnp.float32),
+        jnp.zeros((b, n, cv), jnp.float32),
+    )
+    (m, s, acc), _ = jax.lax.scan(
+        step,
+        init,
+        (kb.swapaxes(0, 1), vb.swapaxes(0, 1), key_valid),
+    )
+    return (acc / s[..., None]).astype(v.dtype)
+
+
+def channel_attention(x: jax.Array) -> jax.Array:
+    """Channel (gram-matrix) attention: (B, N, C) -> (B, N, C).
+
+    Semantics of the reference DANet channel-attention module (its map is the
+    4th visualization panel at reference train_pascal.py:260,274-275): the
+    C x C channel-affinity gram matrix, passed through the max-subtraction
+    trick (affinity' = rowmax - affinity) before softmax — attending to the
+    *least* similar channels, which is DANet's published CAM formulation —
+    then applied back over channels.  No projections; the learned residual
+    gate lives in the calling module.
+    """
+    xf = x.astype(jnp.float32)
+    energy = jnp.einsum("bni,bnj->bij", xf, xf)  # (B, C, C)
+    energy = energy.max(axis=-1, keepdims=True) - energy
+    attn = jax.nn.softmax(energy, axis=-1)
+    out = jnp.einsum("bij,bnj->bni", attn, xf)
+    return out.astype(x.dtype)

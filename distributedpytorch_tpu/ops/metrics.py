@@ -1,0 +1,75 @@
+"""Evaluation metrics: void-aware Jaccard (IoU) with threshold sweep.
+
+The reference's quality metric (its ``calc_jaccard`` from the missing
+``dataloaders.implementation`` module): per-sample IoU of the binarized
+prediction vs ground truth, excluding void pixels, evaluated at thresholds
+{0.3, 0.5, 0.8} with the best-threshold mean gating checkpoint saves
+(reference train_pascal.py:281,291,298-304).
+
+Two forms:
+
+* device-side (:func:`jaccard`, :func:`batched_jaccard`,
+  :func:`threshold_sweep_jaccard`) — jnp, fixed shapes, usable inside a jitted
+  eval step on crop-space predictions;
+* the full-resolution paste-back protocol (crop -> original image coords via
+  ``utils.helpers.crop2fullmask``) is ragged-shape and stays host-side in the
+  evaluator (``train.evaluate``), mirroring where the reference ran it (CPU,
+  train_pascal.py:283-291).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: the reference's eval threshold sweep (train_pascal.py:281)
+DEFAULT_THRESHOLDS = (0.3, 0.5, 0.8)
+
+
+def jaccard(
+    pred: jax.Array, gt: jax.Array, void: jax.Array | None = None
+) -> jax.Array:
+    """IoU of two binary masks, excluding void pixels.  Empty-union -> 1.0
+    (an empty prediction of an empty ground truth is a perfect match)."""
+    pred = pred.astype(jnp.bool_)
+    gt = gt.astype(jnp.bool_)
+    valid = (
+        jnp.ones_like(gt) if void is None else jnp.logical_not(void.astype(jnp.bool_))
+    )
+    inter = jnp.sum(pred & gt & valid)
+    union = jnp.sum((pred | gt) & valid)
+    return jnp.where(union == 0, 1.0, inter / jnp.maximum(union, 1))
+
+
+def batched_jaccard(
+    pred: jax.Array, gt: jax.Array, void: jax.Array | None = None
+) -> jax.Array:
+    """Per-sample IoU over a leading batch axis: (B, ...) -> (B,)."""
+    fn = jax.vmap(lambda p, g, v: jaccard(p, g, v))
+    if void is None:
+        void = jnp.zeros_like(gt)
+    return fn(pred, gt, void)
+
+
+def threshold_sweep_jaccard(
+    probs: jax.Array,
+    gt: jax.Array,
+    void: jax.Array | None = None,
+    thresholds: tuple[float, ...] = DEFAULT_THRESHOLDS,
+) -> jax.Array:
+    """IoU of ``probs > t`` for each threshold: (B, ...) -> (T, B)."""
+    return jnp.stack(
+        [batched_jaccard(probs > t, gt, void) for t in thresholds]
+    )
+
+
+def np_jaccard(pred: np.ndarray, gt: np.ndarray, void: np.ndarray | None = None) -> float:
+    """Host-side (numpy) twin of :func:`jaccard` for the ragged full-res
+    paste-back path — per-image sizes vary so this cannot be batched/jitted."""
+    pred = pred.astype(bool)
+    gt = gt.astype(bool)
+    valid = np.ones_like(gt) if void is None else ~void.astype(bool)
+    inter = int(np.sum(pred & gt & valid))
+    union = int(np.sum((pred | gt) & valid))
+    return 1.0 if union == 0 else inter / union

@@ -1,0 +1,117 @@
+"""Model-layer tests: shapes, output contracts, dtype policies, init/apply."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.models import DANet, DeepLabV3, ResNet, build_model
+
+
+def init_and_apply(model, x, train=False):
+    variables = model.init(
+        {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+        x, train=False)
+    out, mutated = model.apply(
+        variables, x, train=train,
+        mutable=["batch_stats"] if train else [],
+        rngs={"dropout": jax.random.key(2)} if train else None)
+    return variables, out
+
+
+class TestResNet:
+    @pytest.mark.parametrize("os_,expect", [(32, 2), (16, 4), (8, 8)])
+    def test_output_stride(self, os_, expect):
+        m = ResNet(depth=18, output_stride=os_, width=8)
+        x = jnp.zeros((1, 64, 64, 3))
+        _, feats = init_and_apply(m, x)
+        assert feats["c4"].shape[1] == expect  # 64 / output_stride
+
+    def test_four_channel_stem(self):
+        m = ResNet(depth=18, width=8)
+        x = jnp.zeros((1, 32, 32, 4))
+        _, feats = init_and_apply(m, x)
+        assert feats["c4"].shape[0] == 1
+
+    def test_bottleneck_expansion(self):
+        m = ResNet(depth=50, output_stride=32, width=8)
+        x = jnp.zeros((1, 32, 32, 3))
+        _, feats = init_and_apply(m, x)
+        assert feats["c4"].shape[-1] == 8 * 8 * 4  # width*2^3*expansion
+
+
+class TestDANet:
+    def test_three_tuple_output_at_input_res(self):
+        m = DANet(nclass=1, backbone_depth=18, output_stride=8)
+        x = jnp.zeros((2, 64, 64, 4))
+        _, out = init_and_apply(m, x)
+        assert isinstance(out, tuple) and len(out) == 3
+        for o in out:
+            assert o.shape == (2, 64, 64, 1)
+
+    def test_blocked_attention_matches_full(self):
+        """pam_block_size changes memory behavior, not numerics."""
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 32, 32, 4)),
+                        jnp.float32)
+        m_full = DANet(nclass=1, backbone_depth=18, output_stride=8)
+        m_blk = DANet(nclass=1, backbone_depth=18, output_stride=8,
+                      pam_block_size=5)
+        variables = m_full.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+            x, train=False)
+        a = m_full.apply(variables, x, train=False)
+        b = m_blk.apply(variables, x, train=False)
+        for oa, ob in zip(a, b):
+            np.testing.assert_allclose(np.asarray(oa), np.asarray(ob),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_train_mode_mutates_batch_stats(self):
+        m = DANet(nclass=1, backbone_depth=18)
+        x = jnp.ones((1, 32, 32, 4))
+        variables = m.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+            x, train=False)
+        _, mutated = m.apply(variables, x, train=True,
+                             mutable=["batch_stats"],
+                             rngs={"dropout": jax.random.key(2)})
+        assert "batch_stats" in mutated
+
+    def test_bf16_compute(self):
+        m = DANet(nclass=1, backbone_depth=18, dtype=jnp.bfloat16)
+        x = jnp.zeros((1, 32, 32, 4), jnp.bfloat16)
+        variables, out = init_and_apply(m, x)
+        assert out[0].dtype == jnp.bfloat16
+        # params stay f32
+        leaf = jax.tree_util.tree_leaves(variables["params"])[0]
+        assert leaf.dtype == jnp.float32
+
+
+class TestDeepLabV3:
+    def test_primary_output(self):
+        m = DeepLabV3(nclass=21, backbone_depth=18, output_stride=16)
+        x = jnp.zeros((1, 64, 64, 3))
+        _, out = init_and_apply(m, x)
+        assert isinstance(out, tuple) and len(out) == 1
+        assert out[0].shape == (1, 64, 64, 21)
+
+    def test_aux_head(self):
+        m = DeepLabV3(nclass=21, backbone_depth=18, aux_head=True)
+        x = jnp.zeros((1, 64, 64, 3))
+        _, out = init_and_apply(m, x)
+        assert len(out) == 2
+        assert out[1].shape == (1, 64, 64, 21)
+
+
+class TestFactory:
+    def test_build_danet(self):
+        m = build_model("danet", nclass=1, backbone="resnet101")
+        assert isinstance(m, DANet) and m.output_stride == 8
+
+    def test_build_deeplab_bf16(self):
+        m = build_model("deeplabv3", nclass=21, backbone="resnet50",
+                        dtype="bfloat16")
+        assert isinstance(m, DeepLabV3) and m.dtype == jnp.bfloat16
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            build_model("segformer")
