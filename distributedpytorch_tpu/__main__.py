@@ -1,0 +1,63 @@
+"""CLI driver: ``python -m distributedpytorch_tpu [--config c.json] [k=v ...]``.
+
+The runnable equivalent of ``python train_pascal.py`` (the reference's only
+entry point — a module-level script with inline constants,
+train_pascal.py:41-309), but configured by JSON + dotted-path overrides:
+
+    python -m distributedpytorch_tpu data.root=/data/voc optim.lr=1e-7
+    python -m distributedpytorch_tpu --config exp.json epochs=50
+    python -m distributedpytorch_tpu --fake-data epochs=2   # smoke run
+
+Multi-host: launch the same command on every host of the pod;
+``jax.distributed.initialize`` handles rendezvous, the loaders shard by
+process index, and only process 0 writes logs/checkpoint metadata.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .train import Config, Trainer, apply_overrides, from_json
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="distributedpytorch_tpu",
+        description="TPU-native interactive-segmentation training")
+    parser.add_argument("--config", help="JSON config file")
+    parser.add_argument("--fake-data", action="store_true",
+                        help="synthetic VOC fixture (smoke runs, no dataset)")
+    parser.add_argument("--validate-only", action="store_true",
+                        help="run the eval protocol once and exit")
+    parser.add_argument("--distributed", action="store_true",
+                        help="call jax.distributed.initialize() first "
+                             "(multi-host pods)")
+    parser.add_argument("overrides", nargs="*",
+                        help="dotted config overrides, e.g. optim.lr=1e-7")
+    args = parser.parse_args(argv)
+
+    if args.distributed:
+        import jax
+        jax.distributed.initialize()
+
+    cfg = from_json(args.config) if args.config else Config()
+    if args.fake_data:
+        cfg = apply_overrides(cfg, {"data.fake": True})
+    if args.overrides:
+        cfg = apply_overrides(cfg, args.overrides)
+
+    trainer = Trainer(cfg)
+    try:
+        if args.validate_only:
+            metrics = trainer.validate()
+            print({k: v for k, v in metrics.items() if k != "_first_batch"})
+        else:
+            trainer.fit()
+    finally:
+        trainer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+"""Parallelism subsystem: mesh topology, shardings, compiled train/eval steps.
+
+The TPU-native replacement for the reference's ``torch.nn.DataParallel``
+wrapper (reference train_pascal.py:92) and its planned-but-never-built
+NCCL/DDP backend (train_pascal.py:1-8).
+"""
+
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    batch_spec,
+    initialize_distributed,
+    make_mesh,
+    pad_to_multiple,
+    replicated_sharding,
+    replicated_spec,
+    shard_batch,
+)
+from .step import (
+    INPUT_KEY,
+    TARGET_KEY,
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "INPUT_KEY",
+    "TARGET_KEY",
+    "TrainState",
+    "batch_sharding",
+    "batch_spec",
+    "create_train_state",
+    "initialize_distributed",
+    "make_eval_step",
+    "make_mesh",
+    "make_train_step",
+    "pad_to_multiple",
+    "replicated_sharding",
+    "replicated_spec",
+    "shard_batch",
+]

@@ -1,0 +1,221 @@
+"""The compiled train/eval steps — the framework's hot loop.
+
+One function, ``make_train_step``, replaces the reference's whole per-batch
+body (reference train_pascal.py:185-226: H2D copy → ``DataParallel`` scatter →
+forward → multi-output loss → backward → SGD step) with a single ``jit``'d
+program over the mesh:
+
+* the batch arrives batch-dim sharded (``mesh.shard_batch``); every op on it
+  is partitioned by GSPMD, so the forward/backward run data-parallel with the
+  gradient all-reduce inserted by the compiler — the "DDP" of the reference's
+  checklist (train_pascal.py:1-8) with no NCCL code;
+* loss, grads, optimizer update and BatchNorm running-stat updates all happen
+  on device inside one XLA executable — nothing bounces to host between
+  micro-steps;
+* gradient accumulation (the reference's ``nAveGrad`` knob whose loop
+  machinery was commented out, train_pascal.py:67,215-225) is a
+  ``lax.scan`` over micro-batches inside the same program, so accumulation
+  costs no extra dispatches;
+* under batch sharding, BatchNorm's batch-mean is a mean over a
+  GSPMD-partitioned axis — the compiler turns it into a cross-replica
+  reduction automatically, so BN statistics are *global-batch* by
+  construction.  (The reference used per-replica BN only because syncing was
+  hard on GPUs — ``sync_bn=False``, train_pascal.py:85; on TPU the synced
+  version is the free default.)
+
+Donation: the previous ``TrainState`` buffers are donated to the step, so
+params/opt-state are updated in place in HBM — peak memory is one set of
+params + grads, not two.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.core import unfreeze
+
+from ..ops import multi_output_loss
+from . import mesh as mesh_lib
+
+Batch = Mapping[str, jax.Array]
+
+#: batch keys consumed by the step — the reference's stringly-typed contract
+#: (``sample['concat']`` / ``sample['crop_gt']``, train_pascal.py:187) made
+#: explicit in one place.
+INPUT_KEY = "concat"
+TARGET_KEY = "crop_gt"
+
+
+class TrainState(struct.PyTreeNode):
+    """Everything that evolves during training, as one pytree.
+
+    Unlike the reference — which persisted only ``net.state_dict()`` and lost
+    optimizer/epoch/RNG state on every restart (train_pascal.py:301-304, §3.5
+    of SURVEY.md) — the full state is one checkpointable object.
+    """
+
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: optax.OptState
+    rng: jax.Array
+
+
+def create_train_state(
+    rng: jax.Array,
+    model,
+    tx: optax.GradientTransformation,
+    input_shape: tuple[int, ...],
+) -> TrainState:
+    """Initialize params/batch-stats with a dummy batch and wrap with the
+    optimizer state.  ``input_shape`` is (N, H, W, C) — NHWC, the TPU-native
+    layout (the reference's NCHW ``ToTensor`` transpose has no analogue
+    here; conv layouts are XLA's concern)."""
+    init_rng, state_rng = jax.random.split(rng)
+    variables = model.init(init_rng, jnp.zeros(input_shape, jnp.float32),
+                           train=False)
+    params = unfreeze(variables["params"])
+    batch_stats = unfreeze(variables.get("batch_stats", {}))
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        rng=state_rng,
+    )
+
+
+def _targets_of(batch: Batch) -> tuple[jax.Array, jax.Array | None]:
+    """Pull (target, void) from a batch, channel-axis-normalized to the
+    model's (B, H, W, C) logit rank."""
+    inputs = batch[INPUT_KEY]
+    target = batch[TARGET_KEY]
+    void = batch.get("crop_void")
+    if target.ndim == inputs.ndim - 1:  # (B,H,W) masks vs (B,H,W,C) logits
+        target = target[..., None]
+    if void is not None and void.ndim == inputs.ndim - 1:
+        void = void[..., None]
+    return target, void
+
+
+def _loss_and_updates(model, params, batch_stats, batch: Batch, rng,
+                      loss_weights, train: bool):
+    """Forward + multi-output loss; returns (loss, new_batch_stats)."""
+    variables = {"params": params, "batch_stats": batch_stats}
+    inputs = batch[INPUT_KEY]
+    target, void = _targets_of(batch)
+    if train:
+        outputs, mutated = model.apply(
+            variables, inputs, train=True,
+            mutable=["batch_stats"], rngs={"dropout": rng},
+        )
+        new_stats = unfreeze(mutated["batch_stats"])
+    else:
+        outputs = model.apply(variables, inputs, train=False)
+        new_stats = batch_stats
+    loss = multi_output_loss(outputs, target, void=void, weights=loss_weights)
+    return loss, new_stats
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    loss_weights: tuple[float, ...] | None = None,
+    accum_steps: int = 1,
+    mesh=None,
+    donate: bool = True,
+) -> Callable[[TrainState, Batch], tuple[TrainState, jax.Array]]:
+    """Build the jitted ``(state, batch) -> (state, loss)`` train step.
+
+    With ``accum_steps > 1`` the global batch is split into that many
+    micro-batches and scanned, averaging gradients — BASELINE.md config 5's
+    "grad-accum to global batch 256" path.  The micro-batch dim stays sharded
+    over ``data``, so each scan iteration is itself data-parallel.
+    """
+
+    def grads_of(params, batch_stats, batch, rng):
+        def loss_fn(p):
+            return _loss_and_updates(model, p, batch_stats, batch, rng,
+                                     loss_weights, train=True)
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        return loss, new_stats, grads
+
+    def step_fn(state: TrainState, batch: Batch):
+        rng, new_rng = jax.random.split(state.rng)
+        if accum_steps == 1:
+            loss, new_stats, grads = grads_of(
+                state.params, state.batch_stats, batch, rng)
+        else:
+            # (B, ...) -> (accum, B/accum, ...): scan carries running grad
+            # sum + evolving BN stats; XLA keeps it one fused program.
+            def resh(x):
+                return x.reshape((accum_steps, x.shape[0] // accum_steps)
+                                 + x.shape[1:])
+            micro = jax.tree.map(resh, dict(batch))
+            rngs = jax.random.split(rng, accum_steps)
+            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+
+            def body(carry, xs):
+                gsum, stats = carry
+                mb, r = xs
+                loss, new_stats, g = grads_of(state.params, stats, mb, r)
+                gsum = jax.tree.map(jnp.add, gsum, g)
+                return (gsum, new_stats), loss
+
+            (gsum, new_stats), losses = jax.lax.scan(
+                body, (zero_grads, state.batch_stats), (micro, rngs))
+            grads = jax.tree.map(lambda g: g / accum_steps, gsum)
+            loss = losses.mean()
+
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+            rng=new_rng,
+        )
+        return new_state, loss
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+    repl = mesh_lib.replicated_sharding(mesh)
+    data = mesh_lib.batch_sharding(mesh)
+    return jax.jit(
+        step_fn,
+        in_shardings=(repl, data),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_eval_step(model, loss_weights: tuple[float, ...] | None = None,
+                   mesh=None):
+    """Jitted ``(state, batch) -> (outputs, loss)`` inference step
+    (reference val loop body, train_pascal.py:245-254).  Outputs are the
+    model's logit tuple; sigmoid/thresholding happen in the evaluator, which
+    needs probabilities host-side for the full-res paste-back anyway."""
+
+    def step_fn(state: TrainState, batch: Batch):
+        variables = {"params": state.params,
+                     "batch_stats": state.batch_stats}
+        outputs = model.apply(variables, batch[INPUT_KEY], train=False)
+        target, void = _targets_of(batch)
+        loss = multi_output_loss(outputs, target, void=void,
+                                 weights=loss_weights)
+        return outputs, loss
+
+    if mesh is None:
+        return jax.jit(step_fn)
+    repl = mesh_lib.replicated_sharding(mesh)
+    data = mesh_lib.batch_sharding(mesh)
+    return jax.jit(step_fn, in_shardings=(repl, data),
+                   out_shardings=(data, repl))

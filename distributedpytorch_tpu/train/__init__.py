@@ -1,0 +1,52 @@
+"""Training subsystem: config, optimizer, checkpointing, evaluation, trainer."""
+
+from .checkpoint import CheckpointManager, next_run_dir
+from .config import (
+    CheckpointConfig,
+    Config,
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    apply_overrides,
+    flatten,
+    from_json,
+    to_json,
+)
+from .evaluate import batch_debug_asserts, evaluate
+from .logging import (
+    ConsoleWriter,
+    JsonlWriter,
+    MetricWriter,
+    MultiWriter,
+    TensorBoardWriter,
+    make_val_panels,
+)
+from .optim import make_optimizer, make_schedule
+from .trainer import Trainer
+
+__all__ = [
+    "CheckpointConfig",
+    "CheckpointManager",
+    "Config",
+    "ConsoleWriter",
+    "DataConfig",
+    "JsonlWriter",
+    "MeshConfig",
+    "MetricWriter",
+    "ModelConfig",
+    "MultiWriter",
+    "OptimConfig",
+    "TensorBoardWriter",
+    "Trainer",
+    "apply_overrides",
+    "batch_debug_asserts",
+    "evaluate",
+    "flatten",
+    "from_json",
+    "make_optimizer",
+    "make_schedule",
+    "make_val_panels",
+    "next_run_dir",
+    "to_json",
+]

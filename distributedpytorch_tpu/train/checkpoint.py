@@ -1,0 +1,126 @@
+"""Checkpointing: Orbax-backed, full-state, async, with best/latest policies.
+
+The reference's checkpoint story (SURVEY.md §3.5) was whole-model
+``state_dict`` saves only: a best-on-metric save (train_pascal.py:301-304), a
+broken every-100-epoch snapshot (``modelName`` undefined, :229-230), a
+hardcoded warm-start load (:103), and resume scaffolding whose actual load
+was commented out (:93-102) — optimizer/RNG/epoch state were never persisted,
+so a crash lost them.  Here a checkpoint is the complete ``TrainState``
+(params, BN stats, optimizer state, RNG, step) plus the epoch and metric
+history; resume is exact.
+
+Run-dir management reproduces the reference's ``run_<N>`` auto-increment
+(train_pascal.py:73-82).  Saves are async (Orbax writes in a background
+thread while the next epoch trains) and, multi-host, coordinated so only one
+logical save happens — the "save if master process" item of the reference's
+DDP checklist (train_pascal.py:4), done the JAX way (every process
+participates in the barrier; Orbax writes each shard once).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+import jax
+import orbax.checkpoint as ocp
+
+from ..parallel import TrainState
+
+
+def next_run_dir(work_dir: str, resume_run: int | None = None) -> str:
+    """``work_dir/run_<N>`` with N = 1 + max existing (or the pinned resume
+    run — the reference pinned ``run_0`` when resuming, train_pascal.py:78)."""
+    if resume_run is not None:
+        path = os.path.join(work_dir, f"run_{resume_run}")
+        os.makedirs(path, exist_ok=True)
+        return path
+    runs = glob.glob(os.path.join(work_dir, "run_*"))
+    ids = [int(m.group(1)) for r in runs
+           if (m := re.search(r"run_(\d+)$", r))]
+    nxt = max(ids) + 1 if ids else 0
+    path = os.path.join(work_dir, f"run_{nxt}")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+class CheckpointManager:
+    """Latest-k rolling checkpoints + a separately-retained best-on-metric
+    checkpoint, both full ``TrainState``.
+
+    ``metric`` follows the reference's gate: threshold-max mean Jaccard, save
+    when it beats the best seen (train_pascal.py:298-304).
+    """
+
+    def __init__(self, directory: str, keep_latest: int = 3,
+                 best_metric_init: float = 0.0, async_save: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.best_metric = best_metric_init
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=keep_latest,
+            enable_async_checkpointing=async_save,
+            best_fn=None,
+        )
+        self._mgr = ocp.CheckpointManager(
+            os.path.join(self.directory, "latest"), options=options)
+        best_options = ocp.CheckpointManagerOptions(
+            max_to_keep=1, enable_async_checkpointing=async_save)
+        self._best = ocp.CheckpointManager(
+            os.path.join(self.directory, "best"), options=best_options)
+
+    def save(self, step: int, state: TrainState, metric: float | None = None,
+             extra: dict | None = None) -> bool:
+        """Save a rolling checkpoint; if ``metric`` improves on the best seen,
+        also save to the best slot.  Returns True when a new best was saved.
+
+        ``best_metric`` is updated *before* the meta is written, so the
+        checkpoint always records the post-save gate — resuming from it can
+        never re-admit a worse model as "best"."""
+        is_best = metric is not None and metric > self.best_metric
+        if is_best:
+            self.best_metric = float(metric)
+        payload = {"state": ocp.args.StandardSave(state)}
+        meta = {"step": int(step), "best_metric": self.best_metric}
+        if metric is not None:
+            meta["metric"] = float(metric)
+        if extra:
+            meta.update(extra)
+        payload["meta"] = ocp.args.JsonSave(meta)
+        self._mgr.save(step, args=ocp.args.Composite(**payload))
+        if is_best:
+            self._best.save(step, args=ocp.args.Composite(**payload))
+        return is_best
+
+    def restore(self, state: TrainState, step: int | None = None,
+                best: bool = False) -> tuple[TrainState, dict]:
+        """Restore ``(state, meta)``; ``state`` is the abstract target whose
+        shapes/shardings the restored arrays adopt (so a checkpoint written on
+        one mesh restores onto another — the multi-host resume path)."""
+        mgr = self._best if best else self._mgr
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        restored = mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(state),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        return restored["state"], restored["meta"]
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        """Block until async saves land (call before process exit)."""
+        self._mgr.wait_until_finished()
+        self._best.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._mgr.close()
+        self._best.close()

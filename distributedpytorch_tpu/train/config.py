@@ -1,0 +1,211 @@
+"""Experiment configuration.
+
+The reference had no config system: hyperparameters were module-level
+constants stuffed into an ``OrderedDict p`` (reference train_pascal.py:44-82),
+dataset roots hid in a machine-specific ``mypath`` module (pascal.py:13,33),
+checkpoint filenames were hardcoded (train_pascal.py:103,304) and a Comet API
+key was committed in source (train_pascal.py:41).  Here the whole experiment
+is one nested dataclass tree, JSON-serializable both ways, with dotted-path
+CLI overrides — and no secrets in code (anything secret comes from the
+environment).
+
+Defaults reproduce the reference's hyperparameter point
+(train_pascal.py:50-71): 100 epochs, train batch 16, val batch 1, 4-channel
+512² input, SGD lr=5e-8 / momentum 0.9 / wd 5e-4, constant LR (the poly
+scheduler existed but was commented out, train_pascal.py:34,164 — it is a
+first-class option here), eval every epoch, threshold sweep {0.3, 0.5, 0.8}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class DataConfig:
+    root: str = ""                      # dataset root (was: the mypath module)
+    fake: bool = False                  # synth fixture instead of real VOC
+    train_split: str = "train"
+    val_split: str = "val"
+    area_thres: int = 500               # instance area filter (pascal.py:36)
+    crop_size: tuple[int, int] = (512, 512)
+    relax: int = 50                     # bbox relax px (train_pascal.py:127)
+    zero_pad: bool = True
+    rots: tuple[float, float] = (-20.0, 20.0)
+    scales: tuple[float, float] = (0.75, 1.25)
+    guidance: str = "nellipse_gaussians"
+    guidance_alpha: float = 0.6         # z1 + alpha*z2 (custom_transforms.py:45)
+    train_batch: int = 16
+    val_batch: int = 1
+    num_workers: int = 2                # loader threads (train_pascal.py:161)
+    prefetch: int = 2
+
+
+@dataclass
+class ModelConfig:
+    name: str = "danet"                 # danet | deeplabv3
+    nclass: int = 1                     # binary/sigmoid head (DANet(1, ...))
+    backbone: str = "resnet101"
+    output_stride: int | None = None
+    in_channels: int = 4                # RGB + guidance heatmap
+    dtype: str = "float32"              # 'bfloat16' = BASELINE config 3
+    loss_weights: tuple[float, ...] | None = None
+    pam_block_size: int | None = None   # blocked position-attention
+
+
+@dataclass
+class OptimConfig:
+    lr: float = 5e-8
+    momentum: float = 0.9
+    weight_decay: float = 5e-4
+    schedule: str = "constant"          # constant | poly
+    poly_power: float = 0.9
+    warmup_steps: int = 0
+    accum_steps: int = 1                # the reference's nAveGrad knob
+    grad_clip_norm: float | None = None
+
+
+@dataclass
+class MeshConfig:
+    data: int | None = None             # None = all devices
+    model: int = 1
+
+
+@dataclass
+class CheckpointConfig:
+    keep_latest: int = 3
+    snapshot_every: int = 100           # epoch snapshots (train_pascal.py:56)
+    best_metric_init: float = 0.0       # reference pinned 0.913 (…:177)
+    async_save: bool = True
+
+
+@dataclass
+class Config:
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    epochs: int = 100
+    eval_every: int = 1                 # nTestInterval (train_pascal.py:62)
+    eval_thresholds: tuple[float, ...] = (0.3, 0.5, 0.8)
+    seed: int = 0
+    work_dir: str = "runs"              # run_<N> dirs created under this
+    resume: str | None = None           # checkpoint dir to resume from
+    debug_asserts: bool = False         # data-contract checks (…:188-190)
+    log_every_steps: int = 50
+    experiment_name: str = "experiment"
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _to_jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, tuple):
+        return list(obj)
+    return obj
+
+
+def _from_dict(cls, d: dict):
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in d:
+            continue
+        v = d[f.name]
+        if dataclasses.is_dataclass(f.type) or (
+                isinstance(f.type, type) and dataclasses.is_dataclass(f.type)):
+            v = _from_dict(f.type, v)
+        elif f.name in ("crop_size", "rots", "scales", "loss_weights",
+                        "eval_thresholds") and isinstance(v, list):
+            v = tuple(v)
+        kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+_SUBCONFIGS = {"data": DataConfig, "model": ModelConfig, "optim": OptimConfig,
+               "mesh": MeshConfig, "checkpoint": CheckpointConfig}
+
+
+def to_json(cfg: Config, path: str | None = None) -> str:
+    s = json.dumps(_to_jsonable(cfg), indent=2)
+    if path:
+        with open(path, "w") as f:
+            f.write(s + "\n")
+    return s
+
+
+def from_json(source: str) -> Config:
+    """Parse a JSON string or (if it names an existing file) a JSON file."""
+    import os
+    if os.path.exists(source):
+        with open(source) as f:
+            source = f.read()
+    d = json.loads(source)
+    kwargs = {}
+    for k, v in d.items():
+        if k in _SUBCONFIGS:
+            kwargs[k] = _from_dict(_SUBCONFIGS[k], v)
+        else:
+            kwargs[k] = v
+    base = Config()
+    for f in dataclasses.fields(Config):
+        if f.name not in kwargs:
+            kwargs[f.name] = getattr(base, f.name)
+        elif f.name in ("eval_thresholds",) and isinstance(kwargs[f.name], list):
+            kwargs[f.name] = tuple(kwargs[f.name])
+    return Config(**kwargs)
+
+
+def apply_overrides(cfg: Config, overrides: dict[str, Any] | list[str]) -> Config:
+    """Dotted-path overrides: ``{"optim.lr": 1e-3}`` or ``["optim.lr=1e-3"]``.
+
+    String values are JSON-decoded when possible so CLI args round-trip to
+    numbers/bools/lists.
+    """
+    if isinstance(overrides, list):
+        parsed = {}
+        for item in overrides:
+            k, _, v = item.partition("=")
+            parsed[k.strip()] = v.strip()
+        overrides = parsed
+    cfg = dataclasses.replace(cfg)  # shallow copy of the root
+    for path, value in overrides.items():
+        if isinstance(value, str):
+            try:
+                value = json.loads(value)
+            except (ValueError, TypeError):
+                pass
+        *parents, leaf = path.split(".")
+        node = cfg
+        trail = []
+        for p in parents:
+            trail.append((node, p))
+            node = getattr(node, p)
+        if not any(f.name == leaf for f in dataclasses.fields(node)):
+            raise KeyError(f"unknown config field: {path}")
+        if isinstance(getattr(node, leaf), tuple) and isinstance(value, list):
+            value = tuple(value)
+        new_leaf = dataclasses.replace(node, **{leaf: value})
+        for parent, name in reversed(trail):
+            new_leaf = dataclasses.replace(parent, **{name: new_leaf})
+        cfg = new_leaf
+    return cfg
+
+
+def flatten(cfg: Config) -> dict[str, Any]:
+    """Flat ``section.field -> value`` view — feeds the param report
+    (the reference's ``generate_param_report``, train_pascal.py:169)."""
+    out: dict[str, Any] = {}
+
+    def walk(prefix: str, obj: Any):
+        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+            for f in dataclasses.fields(obj):
+                walk(f"{prefix}{f.name}.", getattr(obj, f.name))
+        else:
+            out[prefix[:-1]] = obj
+
+    walk("", cfg)
+    return out

@@ -1,0 +1,191 @@
+"""Metric writers and visualization panels.
+
+The reference's observability was ad hoc (SURVEY.md §5.5): a Comet ML
+experiment receiving only matplotlib figures (train_pascal.py:41,276), scalar
+metrics only ``print``ed (:208-212,296-306), TensorBoard scaffolding fully
+commented out (:24,113-114,221,299-300), a hyperparameter text report
+(:169).  Here one small writer abstraction serves console, JSONL files and
+TensorBoard uniformly; the figure panels (image+gt overlay, prediction,
+position-attention map, channel-attention map — train_pascal.py:263-275) are
+reproduced as a pure function over the first val batch.
+
+No hosted-SaaS writer is built in (the reference committed its Comet API key
+in source, :41 — the anti-pattern this module exists to avoid); the
+``MetricWriter`` protocol is the extension point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Mapping
+
+import numpy as np
+
+
+class MetricWriter:
+    """Protocol: scalars / figures / hparams sinks."""
+
+    def scalars(self, metrics: Mapping[str, float], step: int) -> None: ...
+
+    def figure(self, name: str, fig, step: int) -> None: ...
+
+    def hparams(self, params: Mapping[str, Any]) -> None: ...
+
+    def flush(self) -> None: ...
+
+    def close(self) -> None:
+        self.flush()
+
+
+class ConsoleWriter(MetricWriter):
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+
+    def scalars(self, metrics, step):
+        body = "  ".join(f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+                         for k, v in metrics.items())
+        print(f"{self.prefix}[step {step}] {body}", flush=True)
+
+    def figure(self, name, fig, step):
+        pass
+
+    def hparams(self, params):
+        print(self.prefix + "hyperparameters:", flush=True)
+        for k, v in params.items():
+            print(f"{self.prefix}  {k}: {v}", flush=True)
+
+    def flush(self):
+        pass
+
+
+class JsonlWriter(MetricWriter):
+    """One JSONL stream of scalar events + PNG figures on disk — greppable,
+    diffable, no deps; the run directory becomes the experiment record."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._f = open(os.path.join(directory, "metrics.jsonl"), "a")
+
+    def scalars(self, metrics, step):
+        rec = {"step": int(step), "time": time.time()}
+        rec.update({k: (float(v) if isinstance(v, (int, float)) else v)
+                    for k, v in metrics.items()})
+        self._f.write(json.dumps(rec) + "\n")
+
+    def figure(self, name, fig, step):
+        path = os.path.join(self.directory, f"{name}_step{step}.png")
+        fig.savefig(path, dpi=100, bbox_inches="tight")
+
+    def hparams(self, params):
+        with open(os.path.join(self.directory, "hparams.json"), "w") as f:
+            json.dump({k: repr(v) if not isinstance(
+                v, (int, float, str, bool, type(None))) else v
+                for k, v in params.items()}, f, indent=2)
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self.flush()
+        self._f.close()
+
+
+class TensorBoardWriter(MetricWriter):
+    """TensorBoard events via torch's SummaryWriter (the scaffolding the
+    reference left commented out, train_pascal.py:24,113-114) — optional, the
+    import is deferred and failure degrades to a no-op."""
+
+    def __init__(self, directory: str):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._w = SummaryWriter(directory)
+        except Exception:
+            self._w = None
+
+    def scalars(self, metrics, step):
+        if self._w:
+            for k, v in metrics.items():
+                if isinstance(v, (int, float)):
+                    self._w.add_scalar(k, v, step)
+
+    def figure(self, name, fig, step):
+        if self._w:
+            self._w.add_figure(name, fig, step)
+
+    def hparams(self, params):
+        if self._w:
+            self._w.add_text("hparams", json.dumps(
+                {k: str(v) for k, v in params.items()}, indent=2), 0)
+
+    def flush(self):
+        if self._w:
+            self._w.flush()
+
+    def close(self):
+        if self._w:
+            self._w.close()
+
+
+class MultiWriter(MetricWriter):
+    def __init__(self, *writers: MetricWriter):
+        self.writers = [w for w in writers if w is not None]
+
+    def scalars(self, metrics, step):
+        for w in self.writers:
+            w.scalars(metrics, step)
+
+    def figure(self, name, fig, step):
+        for w in self.writers:
+            w.figure(name, fig, step)
+
+    def hparams(self, params):
+        for w in self.writers:
+            w.hparams(params)
+
+    def flush(self):
+        for w in self.writers:
+            w.flush()
+
+    def close(self):
+        for w in self.writers:
+            w.close()
+
+
+def make_val_panels(first_batch: dict, max_samples: int = 2):
+    """The reference's first-val-batch figure (train_pascal.py:257-278):
+    per sample a row of [input image + gt overlay, fused prediction,
+    position-attention prediction, channel-attention prediction].
+
+    ``first_batch`` is the ``_first_batch`` record from
+    :func:`evaluate.evaluate`.  Returns a matplotlib Figure (Agg backend —
+    never opens a display)."""
+    import matplotlib
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    from ..utils.helpers import overlay_mask, tens2image
+
+    batch = first_batch["batch"]
+    outputs = first_batch["outputs"]
+    n = min(outputs[0].shape[0], max_samples)
+    ncols = 1 + len(outputs)
+    fig, axes = plt.subplots(n, ncols, figsize=(3 * ncols, 3 * n),
+                             squeeze=False)
+    titles = ["image+gt", "fused", "pam", "cam"]
+    for i in range(n):
+        img = np.clip(tens2image(np.asarray(batch["concat"][i]))[..., :3],
+                      0, 255).astype("uint8")
+        gt = tens2image(np.asarray(batch["crop_gt"][i]))
+        axes[i][0].imshow(overlay_mask(img, gt > 0.5))
+        for k, out in enumerate(outputs):
+            prob = 1.0 / (1.0 + np.exp(-tens2image(out[i])))
+            axes[i][1 + k].imshow(prob, vmin=0, vmax=1)
+        for j, ax in enumerate(axes[i]):
+            ax.set_axis_off()
+            if i == 0 and j < len(titles):
+                ax.set_title(titles[j] if j < len(titles) else "")
+    fig.tight_layout()
+    return fig

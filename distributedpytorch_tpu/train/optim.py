@@ -1,0 +1,51 @@
+"""Optimizer and LR-schedule factory.
+
+The reference's optimizer point: ``SGD(lr=5e-8, momentum=0.9, wd=5e-4)``
+(train_pascal.py:118) with a poly LR scheduler imported but commented out so
+the run used a constant LR (train_pascal.py:34,164).  Both are first-class
+here; poly decay is the classic segmentation schedule
+``lr * (1 - step/total)^power`` the reference's ``LR_Scheduler('poly', …)``
+implemented externally.
+
+Weight decay note: torch SGD's ``weight_decay`` is L2-added-to-grad *before*
+momentum; ``optax.sgd`` has no wd, so we compose ``add_decayed_weights``
+ahead of the momentum trace to match torch semantics exactly.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from .config import OptimConfig
+
+
+def make_schedule(cfg: OptimConfig, total_steps: int) -> optax.Schedule:
+    if cfg.schedule == "constant":
+        sched = optax.constant_schedule(cfg.lr)
+    elif cfg.schedule == "poly":
+        # transition_begin stays 0: when joined behind a warmup phase,
+        # join_schedules already offsets the step count by the boundary.
+        sched = optax.polynomial_schedule(
+            init_value=cfg.lr, end_value=0.0, power=cfg.poly_power,
+            transition_steps=max(total_steps - cfg.warmup_steps, 1),
+        )
+    else:
+        raise ValueError(f"unknown schedule: {cfg.schedule!r}")
+    if cfg.warmup_steps > 0:
+        warm = optax.linear_schedule(0.0, cfg.lr, cfg.warmup_steps)
+        sched = optax.join_schedules([warm, sched], [cfg.warmup_steps])
+    return sched
+
+
+def make_optimizer(cfg: OptimConfig, total_steps: int
+                   ) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    """Returns ``(tx, schedule)``; the schedule is also returned separately so
+    the trainer can log the current LR."""
+    sched = make_schedule(cfg, total_steps)
+    parts = []
+    if cfg.grad_clip_norm:
+        parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    if cfg.weight_decay:
+        parts.append(optax.add_decayed_weights(cfg.weight_decay))
+    parts.append(optax.sgd(sched, momentum=cfg.momentum or None))
+    return optax.chain(*parts), sched

@@ -1,0 +1,283 @@
+"""The Trainer: end-to-end experiment driver.
+
+This is the framework's replacement for the whole of the reference's
+module-level script (train_pascal.py:41-309) — device setup, run-dir
+management, model/optimizer/loss construction, the epoch loop with per-epoch
+validation, best-checkpoint gating, metric logging and timing — rebuilt as a
+class over the TPU-native subsystems:
+
+* one ``jax.sharding.Mesh`` instead of ``nn.DataParallel`` (reference :92);
+* one jitted train step (forward+loss+backward+update, grad-accum inside)
+  instead of the eager per-batch body (:185-226);
+* per-host sharded loaders instead of the planned distributed sampler (:3);
+* Orbax full-state checkpoints instead of bare ``state_dict`` saves
+  (:229-230, :301-304), with exact resume (params, optimizer, RNG, epoch,
+  best-metric — all the state the reference lost on restart);
+* process-0-gated logging (the "save if master process" checklist item, :4).
+
+The default config reproduces the reference's experiment: DANet-ResNet101,
+4-channel 512² crops, SGD(5e-8, 0.9, 5e-4), batch 16, val every epoch with
+threshold-max Jaccard gating best saves.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import numpy as np
+
+from ..data import (
+    DataLoader,
+    VOCInstanceSegmentation,
+    build_eval_transform,
+    build_train_transform,
+    make_fake_voc,
+)
+from ..models import build_model
+from ..parallel import (
+    create_train_state,
+    make_eval_step,
+    make_mesh,
+    make_train_step,
+    shard_batch,
+)
+from ..utils.helpers import generate_param_report
+from . import config as config_lib
+from .checkpoint import CheckpointManager, next_run_dir
+from .evaluate import batch_debug_asserts, evaluate
+from .logging import (
+    ConsoleWriter,
+    JsonlWriter,
+    MetricWriter,
+    MultiWriter,
+    make_val_panels,
+)
+from .optim import make_optimizer
+
+
+class Trainer:
+    """Build once, ``fit()`` to train, ``validate()`` to eval.
+
+    All construction is lazy-free and explicit so tests can reach into any
+    piece (``trainer.state``, ``trainer.mesh``, ``trainer.train_step`` …).
+    """
+
+    def __init__(self, cfg: config_lib.Config,
+                 writers: MetricWriter | None = None):
+        self.cfg = cfg
+        self.is_main = jax.process_index() == 0
+
+        # --- run dir (reference run_<N> scheme, train_pascal.py:73-82)
+        self.run_dir = next_run_dir(cfg.work_dir)
+        if writers is not None:
+            self.writer = writers
+        elif self.is_main:
+            self.writer = MultiWriter(ConsoleWriter(),
+                                      JsonlWriter(self.run_dir))
+        else:
+            self.writer = MetricWriter()  # no-op on non-main hosts
+
+        # --- mesh
+        self.mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
+
+        # --- data
+        root = cfg.data.root
+        if cfg.data.fake:
+            root = root or os.path.join(self.run_dir, "fake_voc")
+            if not os.path.exists(os.path.join(root, "VOCdevkit")):
+                make_fake_voc(root, n_images=8, size=(96, 128), n_val=3,
+                              seed=cfg.seed)
+        train_tf = build_train_transform(
+            crop_size=cfg.data.crop_size, relax=cfg.data.relax,
+            zero_pad=cfg.data.zero_pad, rots=cfg.data.rots,
+            scales=cfg.data.scales, alpha=cfg.data.guidance_alpha,
+            guidance=cfg.data.guidance)
+        val_tf = build_eval_transform(
+            crop_size=cfg.data.crop_size, relax=cfg.data.relax,
+            zero_pad=cfg.data.zero_pad, alpha=cfg.data.guidance_alpha,
+            guidance=cfg.data.guidance)
+        self.train_set = VOCInstanceSegmentation(
+            root, split=cfg.data.train_split, transform=train_tf,
+            preprocess=True, area_thres=cfg.data.area_thres)
+        self.val_set = VOCInstanceSegmentation(
+            root, split=cfg.data.val_split, transform=val_tf,
+            preprocess=True, area_thres=cfg.data.area_thres)
+        self.train_loader = DataLoader(
+            self.train_set, cfg.data.train_batch, shuffle=True,
+            drop_last=True, seed=cfg.seed, num_workers=cfg.data.num_workers,
+            prefetch=cfg.data.prefetch,
+            num_shards=jax.process_count(), shard_index=jax.process_index())
+        self.val_loader = DataLoader(
+            self.val_set, cfg.data.val_batch, shuffle=False, drop_last=False,
+            seed=cfg.seed, num_workers=cfg.data.num_workers,
+            prefetch=cfg.data.prefetch,
+            num_shards=jax.process_count(), shard_index=jax.process_index())
+
+        # --- model / optimizer / state
+        self.model = build_model(
+            name=cfg.model.name, nclass=cfg.model.nclass,
+            backbone=cfg.model.backbone, output_stride=cfg.model.output_stride,
+            dtype=cfg.model.dtype, pam_block_size=cfg.model.pam_block_size)
+        steps_per_epoch = max(len(self.train_loader), 1)
+        total_steps = steps_per_epoch * cfg.epochs
+        self.tx, self.schedule = make_optimizer(cfg.optim, total_steps)
+        h, w = cfg.data.crop_size
+        with self.mesh:
+            self.state = create_train_state(
+                jax.random.PRNGKey(cfg.seed), self.model, self.tx,
+                (1, h, w, cfg.model.in_channels))
+        self.train_step = make_train_step(
+            self.model, self.tx, loss_weights=cfg.model.loss_weights,
+            accum_steps=cfg.optim.accum_steps, mesh=self.mesh)
+        self.eval_step = make_eval_step(
+            self.model, loss_weights=cfg.model.loss_weights, mesh=self.mesh)
+
+        # --- checkpointing
+        self.ckpt = CheckpointManager(
+            os.path.join(self.run_dir, "checkpoints"),
+            keep_latest=cfg.checkpoint.keep_latest,
+            best_metric_init=cfg.checkpoint.best_metric_init,
+            async_save=cfg.checkpoint.async_save)
+        self.start_epoch = 0
+        if cfg.resume:
+            self._resume(cfg.resume)
+
+        # --- param report (reference generate_param_report, :169)
+        if self.is_main:
+            flat = config_lib.flatten(cfg)
+            flat["n_params"] = self.n_params
+            flat["n_devices"] = self.mesh.devices.size
+            flat["train_set"] = str(self.train_set)
+            flat["val_set"] = str(self.val_set)
+            generate_param_report(
+                os.path.join(self.run_dir, f"{cfg.experiment_name}.txt"), flat)
+            config_lib.to_json(cfg, os.path.join(self.run_dir, "config.json"))
+            self.writer.hparams(flat)
+
+    @property
+    def n_params(self) -> int:
+        """Trainable parameter count (the reference printed this at startup,
+        train_pascal.py:105)."""
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(self.state.params))
+
+    def _resume(self, source: str) -> None:
+        mgr = CheckpointManager(source) if os.path.abspath(source) != \
+            os.path.abspath(os.path.join(self.run_dir, "checkpoints")) \
+            else self.ckpt
+        self.state, meta = mgr.restore(self.state)
+        self.start_epoch = int(meta.get("epoch", 0)) + 1
+        self.ckpt.best_metric = float(
+            meta.get("best_metric", self.ckpt.best_metric))
+        if self.is_main:
+            print(f"resumed from {source} at epoch {self.start_epoch} "
+                  f"(best={self.ckpt.best_metric:.4f})", flush=True)
+
+    # ------------------------------------------------------------------ train
+    def train_epoch(self, epoch: int) -> float:
+        """One epoch; returns mean train loss (the reference printed the
+        running loss once per epoch, train_pascal.py:207-212)."""
+        cfg = self.cfg
+        self.train_loader.set_epoch(epoch)
+        losses = []
+        t0 = time.perf_counter()
+        # Track the step as a python int (start + i): reading
+        # ``self.state.step`` every iteration would block on the device and
+        # serialize host data-prep against device compute.
+        step0 = int(self.state.step)
+        with self.mesh:
+            for i, batch in enumerate(self.train_loader):
+                if cfg.debug_asserts:
+                    batch_debug_asserts(batch)
+                device_batch = shard_batch(self.mesh, {
+                    k: v for k, v in batch.items()
+                    if k in ("concat", "crop_gt", "crop_void")})
+                self.state, loss = self.train_step(self.state, device_batch)
+                losses.append(loss)  # device array; sync deferred
+                step = step0 + i + 1
+                if self.is_main and step % cfg.log_every_steps == 0:
+                    self.writer.scalars(  # float(loss) syncs — log steps only
+                        {"train/loss": float(loss),
+                         "train/lr": float(self.schedule(step)),
+                         "train/epoch": epoch}, step)
+        mean_loss = float(np.mean([float(l) for l in losses])) if losses \
+            else float("nan")
+        dt = time.perf_counter() - t0
+        n_imgs = len(losses) * cfg.data.train_batch
+        if self.is_main:
+            self.writer.scalars(
+                {"train/epoch_loss": mean_loss,
+                 "train/imgs_per_sec": n_imgs / dt if dt > 0 else 0.0,
+                 "train/epoch_seconds": dt, "train/epoch": epoch},
+                int(self.state.step))
+        return mean_loss
+
+    # ------------------------------------------------------------------- eval
+    def validate(self, epoch: int | None = None, log_panels: bool = True
+                 ) -> dict:
+        self.val_loader.set_epoch(0)
+        with self.mesh:
+            metrics = evaluate(
+                self.eval_step, self.state, self.val_loader,
+                thresholds=self.cfg.eval_thresholds,
+                relax=self.cfg.data.relax, zero_pad=self.cfg.data.zero_pad,
+                mesh=self.mesh)
+        first = metrics.pop("_first_batch", None)
+        if self.is_main:
+            step = int(self.state.step)
+            flat = {"val/loss": metrics["loss"],
+                    "val/jaccard": metrics["jaccard"],
+                    "val/best_threshold": metrics["best_threshold"]}
+            for th, v in metrics["jaccard_per_threshold"].items():
+                flat[f"val/jaccard@{th}"] = v
+            if epoch is not None:
+                flat["val/epoch"] = epoch
+            self.writer.scalars(flat, step)
+            if log_panels and first is not None:
+                try:
+                    fig = make_val_panels(first)
+                    self.writer.figure("val_panels", fig, step)
+                    import matplotlib.pyplot as plt
+                    plt.close(fig)
+                except Exception:
+                    pass  # visualization must never kill training
+        return metrics
+
+    # -------------------------------------------------------------------- fit
+    def fit(self) -> dict:
+        """The full loop (reference train_pascal.py:180-308): train each
+        epoch; validate every ``eval_every``; snapshot every
+        ``snapshot_every``; save best on threshold-max Jaccard improvement."""
+        cfg = self.cfg
+        history = {"train_loss": [], "val": []}
+        for epoch in range(self.start_epoch, cfg.epochs):
+            t0 = time.perf_counter()
+            history["train_loss"].append(self.train_epoch(epoch))
+            step = int(self.state.step)
+            extra = {"epoch": epoch}
+            if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
+                metrics = self.validate(epoch)
+                history["val"].append(metrics)
+                is_best = self.ckpt.save(step, self.state,
+                                         metric=metrics["jaccard"],
+                                         extra=extra)
+                if is_best and self.is_main:
+                    self.writer.scalars(
+                        {"val/new_best_jaccard": metrics["jaccard"],
+                         "val/epoch": epoch}, step)
+            elif cfg.checkpoint.snapshot_every and \
+                    (epoch + 1) % cfg.checkpoint.snapshot_every == 0:
+                self.ckpt.save(step, self.state, extra=extra)
+            if self.is_main:
+                self.writer.scalars(
+                    {"epoch": epoch,
+                     "epoch_total_seconds": time.perf_counter() - t0}, step)
+        self.ckpt.wait()
+        self.writer.flush()
+        return history
+
+    def close(self) -> None:
+        self.ckpt.close()
+        self.writer.close()

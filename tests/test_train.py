@@ -1,0 +1,181 @@
+"""Train subsystem: config, optimizer, checkpointing, and an end-to-end
+Trainer.fit() on the fake-VOC fixture over the 8-device CPU mesh."""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributedpytorch_tpu.train import (
+    CheckpointManager,
+    Config,
+    Trainer,
+    apply_overrides,
+    flatten,
+    from_json,
+    make_optimizer,
+    make_schedule,
+    next_run_dir,
+    to_json,
+)
+from distributedpytorch_tpu.train.config import OptimConfig
+
+
+class TestConfig:
+    def test_defaults_match_reference_point(self):
+        cfg = Config()
+        assert cfg.optim.lr == 5e-8 and cfg.optim.momentum == 0.9
+        assert cfg.optim.weight_decay == 5e-4
+        assert cfg.data.train_batch == 16 and cfg.data.val_batch == 1
+        assert cfg.data.crop_size == (512, 512)
+        assert cfg.model.in_channels == 4 and cfg.model.nclass == 1
+        assert cfg.eval_thresholds == (0.3, 0.5, 0.8)
+        assert cfg.epochs == 100 and cfg.eval_every == 1
+
+    def test_json_roundtrip(self, tmp_path):
+        cfg = Config()
+        path = str(tmp_path / "c.json")
+        to_json(cfg, path)
+        cfg2 = from_json(path)
+        assert cfg2 == cfg
+
+    def test_overrides(self):
+        cfg = Config()
+        cfg2 = apply_overrides(cfg, ["optim.lr=0.001", "epochs=3",
+                                     "model.backbone=resnet18",
+                                     "data.crop_size=[64, 64]"])
+        assert cfg2.optim.lr == 0.001 and cfg2.epochs == 3
+        assert cfg2.model.backbone == "resnet18"
+        assert cfg2.data.crop_size == (64, 64)
+        assert cfg.optim.lr == 5e-8  # original untouched
+
+    def test_unknown_override_raises(self):
+        with pytest.raises(KeyError):
+            apply_overrides(Config(), ["optim.nope=1"])
+
+    def test_flatten(self):
+        flat = flatten(Config())
+        assert flat["optim.lr"] == 5e-8
+        assert flat["data.train_batch"] == 16
+
+
+class TestOptim:
+    def test_constant_schedule(self):
+        s = make_schedule(OptimConfig(lr=0.1, schedule="constant"), 100)
+        assert float(s(0)) == float(s(99)) == pytest.approx(0.1)
+
+    def test_poly_schedule_decays_to_zero(self):
+        s = make_schedule(OptimConfig(lr=0.1, schedule="poly"), 100)
+        assert float(s(0)) == pytest.approx(0.1)
+        assert 0 < float(s(50)) < 0.1
+        assert float(s(100)) == pytest.approx(0.0, abs=1e-9)
+
+    def test_warmup(self):
+        s = make_schedule(
+            OptimConfig(lr=0.1, schedule="poly", warmup_steps=10), 100)
+        assert float(s(0)) == pytest.approx(0.0)
+        assert float(s(10)) == pytest.approx(0.1)
+
+    def test_sgd_weight_decay_matches_torch_semantics(self):
+        # torch: grad <- grad + wd*p, then momentum buffer. One step from
+        # zero momentum: update = -lr * (g + wd*p).
+        cfg = OptimConfig(lr=0.1, momentum=0.9, weight_decay=0.01,
+                          schedule="constant")
+        tx, _ = make_optimizer(cfg, 10)
+        p = {"w": np.float32(2.0)}
+        g = {"w": np.float32(0.5)}
+        st = tx.init(p)
+        upd, _ = tx.update(g, st, p)
+        expected = -0.1 * (0.5 + 0.01 * 2.0)
+        np.testing.assert_allclose(float(upd["w"]), expected, rtol=1e-6)
+
+
+class TestRunDirs:
+    def test_auto_increment(self, tmp_path):
+        d = str(tmp_path)
+        assert next_run_dir(d).endswith("run_0")
+        assert next_run_dir(d).endswith("run_1")
+        assert next_run_dir(d, resume_run=0).endswith("run_0")
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        from distributedpytorch_tpu.parallel import create_train_state
+        import flax.linen as nn
+
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                return (nn.Dense(2)(x),)
+
+        tx = optax.sgd(0.1, momentum=0.9)
+        state = create_train_state(jax.random.PRNGKey(0), M(), tx, (1, 4))
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_latest=2,
+                                async_save=False)
+        assert mgr.save(1, state, metric=0.5)        # first best
+        assert not mgr.save(2, state, metric=0.4)    # not better
+        assert mgr.save(3, state, metric=0.7)        # new best
+        restored, meta = mgr.restore(state)
+        assert meta["step"] == 3
+        for a, b in zip(jax.tree.leaves(state.params),
+                        jax.tree.leaves(restored.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        best, bmeta = mgr.restore(state, best=True)
+        assert bmeta["metric"] == pytest.approx(0.7)
+        mgr.close()
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg(tmp_path_factory):
+    work = tmp_path_factory.mktemp("runs")
+    cfg = Config()
+    return dataclasses.replace(
+        cfg,
+        data=dataclasses.replace(
+            cfg.data, fake=True, train_batch=8, val_batch=2, num_workers=2,
+            crop_size=(64, 64), relax=10, area_thres=0),
+        model=dataclasses.replace(cfg.model, backbone="resnet18",
+                                  output_stride=8),
+        optim=dataclasses.replace(cfg.optim, lr=1e-4, schedule="poly"),
+        checkpoint=dataclasses.replace(cfg.checkpoint, async_save=False),
+        epochs=2, eval_every=1, seed=0, work_dir=str(work),
+        log_every_steps=1, debug_asserts=True,
+    )
+
+
+class TestTrainerEndToEnd:
+    def test_fit_runs_and_checkpoints(self, tiny_cfg):
+        tr = Trainer(tiny_cfg)
+        assert tr.n_params > 0
+        history = tr.fit()
+        assert len(history["train_loss"]) == 2
+        assert all(np.isfinite(l) for l in history["train_loss"])
+        assert len(history["val"]) == 2
+        m = history["val"][-1]
+        assert 0.0 <= m["jaccard"] <= 1.0
+        assert set(m["jaccard_per_threshold"]) == {"0.3", "0.5", "0.8"}
+        # artifacts: param report, config, metrics jsonl, checkpoints
+        files = os.listdir(tr.run_dir)
+        assert "config.json" in files and "experiment.txt" in files
+        assert "metrics.jsonl" in files
+        assert tr.ckpt.latest_step() == int(tr.state.step)
+        tr.close()
+
+    def test_resume_restores_exact_state(self, tiny_cfg):
+        tr = Trainer(tiny_cfg)
+        tr.fit()
+        step = int(tr.state.step)
+        ck_dir = os.path.join(tr.run_dir, "checkpoints")
+        tr.close()
+
+        cfg2 = dataclasses.replace(tiny_cfg, resume=ck_dir, epochs=2)
+        tr2 = Trainer(cfg2)
+        assert int(tr2.state.step) == step
+        assert tr2.start_epoch == 2  # both epochs done; nothing left to run
+        for a, b in zip(jax.tree.leaves(tr.state.params),
+                        jax.tree.leaves(tr2.state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        tr2.close()
