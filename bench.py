@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import json
 import os
-import time
+
 
 os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
 
@@ -62,22 +62,20 @@ def main() -> None:
         "crop_gt": (r.uniform(size=(BATCH * n_chips, SIZE, SIZE)) > 0.7
                     ).astype(np.float32),
     }
+    from distributedpytorch_tpu.utils import StepTimer
+
+    timer = StepTimer(warmup=WARMUP)
     with mesh:
         state = create_train_state(jax.random.PRNGKey(0), model, tx,
                                    (1, SIZE, SIZE, 4))
         step = make_train_step(model, tx, mesh=mesh)
         batch = shard_batch(mesh, host_batch)
-        for _ in range(WARMUP):
+        for _ in range(WARMUP + STEPS):
             state, loss = step(state, batch)
-        jax.block_until_ready(loss)
-        t0 = time.perf_counter()
-        for _ in range(STEPS):
-            state, loss = step(state, batch)
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
+            timer.tick(loss)
 
-    imgs_per_sec = STEPS * BATCH * n_chips / dt
-    per_chip = imgs_per_sec / n_chips
+    stats = timer.summary(items_per_step=BATCH * n_chips)
+    per_chip = stats["items_per_sec"] / n_chips
     print(json.dumps({
         "metric": f"danet_{BACKBONE}_{SIZE}px_b{BATCH}_train_step_throughput",
         "value": round(per_chip, 3),

@@ -38,6 +38,11 @@ def build_model(
     if isinstance(dtype, str):
         dtype = jnp.dtype(dtype)
     depth = _BACKBONE_DEPTH[backbone]
+    if name != "danet":
+        # PAM options are DANet-only; drop them (at their defaults they are
+        # inert) so one config schema can drive any model family.
+        kw.pop("pam_block_size", None)
+        kw.pop("pam_impl", None)
     if name == "danet":
         return DANet(
             nclass=nclass,

@@ -52,6 +52,7 @@ class PositionAttentionModule(nn.Module):
     norm: Any
     dtype: jnp.dtype = jnp.float32
     block_size: int | None = None  # None -> full attention
+    impl: str = "einsum"           # einsum | flash (pallas TPU kernel)
 
     @nn.compact
     def __call__(self, x):
@@ -60,10 +61,18 @@ class PositionAttentionModule(nn.Module):
         q = conv(self.channels // 8, (1, 1), name="query")(x).reshape(b, h * w, -1)
         k = conv(self.channels // 8, (1, 1), name="key")(x).reshape(b, h * w, -1)
         v = conv(self.channels, (1, 1), name="value")(x).reshape(b, h * w, -1)
-        if self.block_size is None:
-            out = position_attention(q, k, v)
+        if self.impl == "flash":
+            from ..ops.pallas_attention import flash_position_attention
+            blk = self.block_size or 256
+            out = flash_position_attention(q, k, v, blk, blk)
+        elif self.impl == "einsum":
+            if self.block_size is None:
+                out = position_attention(q, k, v)
+            else:
+                out = blocked_position_attention(q, k, v, self.block_size)
         else:
-            out = blocked_position_attention(q, k, v, self.block_size)
+            raise ValueError(
+                f"unknown attention impl: {self.impl!r} (einsum | flash)")
         out = out.reshape(b, h, w, self.channels)
         # Residual gate starts at 0: the module is an identity at init and
         # learns how much attention context to blend in.
@@ -94,6 +103,7 @@ class DANetHead(nn.Module):
     norm: Any
     dtype: jnp.dtype = jnp.float32
     pam_block_size: int | None = None
+    pam_impl: str = "einsum"
     dropout_rate: float = 0.1
 
     @nn.compact
@@ -114,7 +124,8 @@ class DANetHead(nn.Module):
         pa = conv_bn_relu(x, "pam_in")
         pa = PositionAttentionModule(
             channels=inter, norm=self.norm, dtype=self.dtype,
-            block_size=self.pam_block_size, name="pam")(pa)
+            block_size=self.pam_block_size, impl=self.pam_impl,
+            name="pam")(pa)
         pa = conv_bn_relu(pa, "pam_out")
 
         ca = conv_bn_relu(x, "cam_in")
@@ -141,6 +152,7 @@ class DANet(nn.Module):
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
     pam_block_size: int | None = None
+    pam_impl: str = "einsum"  # einsum | flash (ops.pallas_attention)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -158,6 +170,7 @@ class DANet(nn.Module):
             norm=norm,
             dtype=self.dtype,
             pam_block_size=self.pam_block_size,
+            pam_impl=self.pam_impl,
             name="head",
         )(feats["c4"], train=train)
         return tuple(_resize_bilinear(o, size) for o in outs)

@@ -12,6 +12,7 @@ from .attention import (
     blocked_position_attention,
     channel_attention,
 )
+from .pallas_attention import flash_position_attention
 from .losses import (
     sigmoid_balanced_bce,
     multi_output_loss,
@@ -23,6 +24,7 @@ __all__ = [
     "position_attention",
     "blocked_position_attention",
     "channel_attention",
+    "flash_position_attention",
     "sigmoid_balanced_bce",
     "multi_output_loss",
     "softmax_xent_ignore",

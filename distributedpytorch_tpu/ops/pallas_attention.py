@@ -1,0 +1,151 @@
+"""Pallas TPU flash-attention kernel for the position-attention hot path.
+
+The reference's position-attention module materializes the full
+(H·W/64)² score matrix in external CUDA code (PyTorch-Encoding's DANet head,
+reference train_pascal.py:32,86).  :func:`ops.attention.position_attention`
+is the XLA einsum re-expression; this module is the hand-scheduled form for
+when the fused-by-XLA version is memory- or bandwidth-bound: one kernel
+computes Q·Kᵀ on the MXU, the online softmax on the VPU, and the P·V matmul
+on the MXU per (Q-block, K-block) tile, keeping everything in VMEM and never
+writing an N×N intermediate to HBM.
+
+Grid layout: ``(batch, q_blocks, k_blocks)`` with the K dimension innermost;
+the running (max, sum, accumulator) state lives in VMEM scratch that persists
+across the K sweep for each Q block (the canonical flash-attention TPU
+schedule).  Block sizes default to 256×256, aligned to the (8,128) f32 tile.
+
+Backward: a ``jax.custom_vjp`` whose reverse pass recomputes attention with
+:func:`ops.attention.blocked_position_attention` (O(N·block) memory) and
+differentiates that — recompute-not-store, the standard flash trade.
+
+Tests run this kernel with ``interpret=True`` on CPU (pallas's interpreter
+executes the same program the Mosaic compiler lowers on TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import blocked_position_attention
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, s_ref, acc_ref,
+                  *, n_real: int, block_k: int, scale: float | None):
+    """One (q-block, k-block) tile of online-softmax attention."""
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        s_ref[:] = jnp.zeros_like(s_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]          # (bq, ck)
+    k = k_ref[0]          # (bk, ck)
+    v = v_ref[0]          # (bk, cv)
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (bq, bk)
+    if scale is not None:
+        scores = scores * scale
+    # Mask keys past the true token count (N was padded to a block multiple).
+    key_idx = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, 1)
+    scores = jnp.where(key_idx < n_real, scores, _NEG_INF)
+
+    m_prev = m_ref[:, :1]                            # (bq, 1)
+    m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)                      # (bq, bk)
+    s_new = s_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    s_ref[:] = jnp.broadcast_to(s_new, s_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(s_ref[:, :1], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, block_q: int, block_k: int,
+                   scale: float | None, interpret: bool | None):
+    if interpret is None:
+        # Mosaic compiles on TPU; everywhere else run the same program in
+        # the pallas interpreter (slow but correct — CI / CPU meshes).
+        interpret = jax.default_backend() != "tpu"
+    b, n, ck = q.shape
+    cv = v.shape[-1]
+    nq = pl.cdiv(n, block_q)
+    nk = pl.cdiv(n, block_k)
+    pad_q = nq * block_q - n
+    pad_k = nk * block_k - n
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+
+    kernel = functools.partial(_flash_kernel, n_real=n, block_k=block_k,
+                               scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, ck), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, ck), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, cv), lambda b_, i, j: (b_, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, cv), lambda b_, i, j: (b_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, nq * block_q, cv), v.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, cv), jnp.float32),    # accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :n, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_position_attention(q, k, v, block_q: int = 256, block_k: int = 256,
+                             scale: float | None = None,
+                             interpret: bool | None = None):
+    """Flash position attention: same math as
+    :func:`ops.attention.position_attention` (unscaled DANet energies unless
+    ``scale``), O(N·block) memory, MXU-scheduled.
+
+    ``q``/``k``: (B, N, Ck); ``v``: (B, N, Cv) -> (B, N, Cv).
+    """
+    return _flash_forward(q, k, v, block_q, block_k, scale, interpret)
+
+
+def _fwd(q, k, v, block_q, block_k, scale, interpret):
+    out = _flash_forward(q, k, v, block_q, block_k, scale, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(block_q, block_k, scale, interpret, res, g):
+    q, k, v = res
+    # Recompute with the O(N*block) jnp form and differentiate that — the
+    # flash backward without a second hand-written kernel.
+    def ref(q_, k_, v_):
+        if scale is not None:  # score scaling == scaling q
+            q_ = q_ * scale
+        return blocked_position_attention(q_, k_, v_, block_size=block_k)
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_position_attention.defvjp(_fwd, _bwd)

@@ -17,6 +17,7 @@ from .mesh import (
     replicated_spec,
     shard_batch,
 )
+from .ring import make_ring_attention, ring_attention_local
 from .step import (
     INPUT_KEY,
     TARGET_KEY,
@@ -38,7 +39,9 @@ __all__ = [
     "initialize_distributed",
     "make_eval_step",
     "make_mesh",
+    "make_ring_attention",
     "make_train_step",
+    "ring_attention_local",
     "pad_to_multiple",
     "replicated_sharding",
     "replicated_spec",

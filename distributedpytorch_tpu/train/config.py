@@ -54,6 +54,7 @@ class ModelConfig:
     dtype: str = "float32"              # 'bfloat16' = BASELINE config 3
     loss_weights: tuple[float, ...] | None = None
     pam_block_size: int | None = None   # blocked position-attention
+    pam_impl: str = "einsum"            # einsum | flash (pallas TPU kernel)
 
 
 @dataclass
@@ -110,14 +111,19 @@ def _to_jsonable(obj: Any) -> Any:
 
 
 def _from_dict(cls, d: dict):
+    # f.type is a *string* under `from __future__ import annotations`;
+    # resolve real types once so nested dataclasses recurse properly.
+    import typing
+    hints = typing.get_type_hints(cls)
     kwargs = {}
     for f in dataclasses.fields(cls):
         if f.name not in d:
             continue
         v = d[f.name]
-        if dataclasses.is_dataclass(f.type) or (
-                isinstance(f.type, type) and dataclasses.is_dataclass(f.type)):
-            v = _from_dict(f.type, v)
+        ftype = hints.get(f.name, f.type)
+        if isinstance(ftype, type) and dataclasses.is_dataclass(ftype) \
+                and isinstance(v, dict):
+            v = _from_dict(ftype, v)
         elif f.name in ("crop_size", "rots", "scales", "loss_weights",
                         "eval_thresholds") and isinstance(v, list):
             v = tuple(v)

@@ -1,5 +1,6 @@
 """Utilities: array helpers, logging, debug checks, profiling."""
 
-from . import helpers
+from . import helpers, profiling
+from .profiling import StepTimer, annotate, trace
 
-__all__ = ["helpers"]
+__all__ = ["StepTimer", "annotate", "helpers", "profiling", "trace"]

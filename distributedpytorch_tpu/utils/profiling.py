@@ -1,0 +1,86 @@
+"""Tracing / profiling utilities.
+
+The reference's only performance instrumentation was wall-clock epoch timing
+with ``timeit.default_timer`` printed to stdout (reference
+train_pascal.py:12,181,307-308) — no profiler, no NVTX, no per-step numbers
+(SURVEY.md §5.1).  TPU-native replacements:
+
+* :func:`trace` — context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable XPlane trace (op-level device timeline, HBM usage,
+  fusion view) for any code region;
+* :class:`StepTimer` — steady-state step timing that understands JAX's async
+  dispatch: it calls ``block_until_ready`` on a representative output before
+  reading the clock, so it measures device time rather than dispatch time,
+  and it skips warmup steps so compile time never pollutes the numbers;
+* :func:`annotate` — named ``TraceAnnotation`` regions that show up inside
+  the device trace (host-side markers).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import statistics
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Profile the enclosed region into ``log_dir`` (XPlane format;
+    `tensorboard --logdir` or xprof reads it)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region visible in profiler timelines."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Accumulates per-step wall times, async-dispatch-aware.
+
+    >>> timer = StepTimer(warmup=2)
+    >>> for batch in loader:
+    ...     state, loss = step(state, batch)
+    ...     timer.tick(loss)          # blocks on loss, records dt
+    >>> timer.summary()               # {'mean_s': ..., 'p50_s': ..., ...}
+    """
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self._seen = 0
+        self._last: float | None = None
+        self.times: list[float] = []
+
+    def tick(self, *outputs) -> float | None:
+        """Record one step boundary; pass any step outputs to block on."""
+        if outputs:
+            jax.block_until_ready(outputs)
+        now = time.perf_counter()
+        dt = None
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self.warmup:
+                dt = now - self._last
+                self.times.append(dt)
+        self._last = now
+        return dt
+
+    def summary(self, items_per_step: int | None = None) -> dict:
+        if not self.times:
+            return {"steps": 0}
+        out = {
+            "steps": len(self.times),
+            "mean_s": statistics.fmean(self.times),
+            "p50_s": statistics.median(self.times),
+            "min_s": min(self.times),
+            "max_s": max(self.times),
+        }
+        if items_per_step:
+            out["items_per_sec"] = items_per_step / out["mean_s"]
+        return out

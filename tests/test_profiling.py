@@ -1,0 +1,41 @@
+"""Profiling utilities: StepTimer semantics, annotate/trace no-crash."""
+
+import os
+
+import jax.numpy as jnp
+
+from distributedpytorch_tpu.utils import StepTimer, annotate, trace
+
+
+class TestStepTimer:
+    def test_warmup_skipped(self):
+        t = StepTimer(warmup=2)
+        for _ in range(5):
+            t.tick(jnp.zeros(()))
+        # 5 ticks = 4 intervals; first 2 are warmup
+        assert t.summary()["steps"] == 2
+
+    def test_items_per_sec(self):
+        t = StepTimer(warmup=0)
+        for _ in range(3):
+            t.tick()
+        s = t.summary(items_per_step=10)
+        assert s["steps"] == 2
+        assert s["items_per_sec"] > 0
+        assert s["min_s"] <= s["p50_s"] <= s["max_s"]
+
+    def test_empty_summary(self):
+        assert StepTimer().summary() == {"steps": 0}
+
+
+class TestTrace:
+    def test_annotate_context(self):
+        with annotate("region"):
+            x = jnp.ones((4,)) * 2
+        assert float(x.sum()) == 8.0
+
+    def test_trace_writes_files(self, tmp_path):
+        d = str(tmp_path / "prof")
+        with trace(d):
+            jnp.ones((8, 8)).sum().block_until_ready()
+        assert os.path.isdir(d) and len(os.listdir(d)) > 0
