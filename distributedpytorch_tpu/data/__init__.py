@@ -5,16 +5,25 @@ from .fake import make_fake_voc
 from .pipeline import (
     DataLoader,
     build_eval_transform,
+    build_semantic_eval_transform,
+    build_semantic_train_transform,
     build_train_transform,
     collate,
 )
-from .voc import CATEGORY_NAMES, VOCInstanceSegmentation
+from .voc import (
+    CATEGORY_NAMES,
+    VOCInstanceSegmentation,
+    VOCSemanticSegmentation,
+)
 
 __all__ = [
     "CATEGORY_NAMES",
     "DataLoader",
     "VOCInstanceSegmentation",
+    "VOCSemanticSegmentation",
     "build_eval_transform",
+    "build_semantic_eval_transform",
+    "build_semantic_train_transform",
     "build_train_transform",
     "collate",
     "guidance",

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..utils.helpers import make_gaussian
+from ..utils.helpers import make_gaussian, make_gt
 
 
 # ---------------------------------------------------------------------------
@@ -136,10 +136,9 @@ def compute_nellipse_gaussian_hm(
     """
     z1 = compute_nellipse(x_range, y_range, points, softness=softness)
     size = (len(y_range), len(x_range))
-    z2 = np.zeros(size, dtype=np.float32)
-    for px, py in np.asarray(points, dtype=np.float32):
-        z2 = np.maximum(z2, make_gaussian(size, (px, py), sigma=sigma))
-    return z1, z2.astype(np.float32)
+    # make_gt owns the max-combined gaussian (and its native dispatch).
+    z2 = make_gt(np.zeros(size, np.float32), points, sigma=sigma)
+    return z1, z2
 
 
 # ---------------------------------------------------------------------------

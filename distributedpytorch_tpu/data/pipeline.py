@@ -106,6 +106,38 @@ def _guidance_stage(guidance: str, alpha: float, is_val: bool) -> list[T.Transfo
     raise ValueError(f"unknown guidance family: {guidance}")
 
 
+def build_semantic_train_transform(
+    crop_size: tuple[int, int] = (513, 513),
+    rots: tuple[float, float] = (-10, 10),
+    scales: tuple[float, float] = (0.5, 2.0),
+) -> T.Compose:
+    """Multi-class semantic pipeline (the DeepLabV3 configs of BASELINE.md):
+    flip -> scale/rotate with nearest-warped class ids (``semseg=True``) ->
+    fixed resize (gt nearest, 255 void preserved in-band) -> rename onto the
+    step contract (``concat``/``crop_gt``)."""
+    return T.Compose([
+        T.RandomHorizontalFlip(),
+        T.ScaleNRotate(rots=rots, scales=scales, semseg=True),
+        T.FixedResize(resolutions={"image": crop_size, "gt": crop_size},
+                      flagvals={"image": None, "gt": 0}),
+        T.Rename({"image": "concat", "gt": "crop_gt"}),
+        T.ToArray(),
+    ])
+
+
+def build_semantic_eval_transform(
+    crop_size: tuple[int, int] = (513, 513),
+) -> T.Compose:
+    """Deterministic semantic eval: fixed resize only (gt nearest so class
+    ids and 255-void stay exact), renamed onto the step contract."""
+    return T.Compose([
+        T.FixedResize(resolutions={"image": crop_size, "gt": crop_size},
+                      flagvals={"image": None, "gt": 0}),
+        T.Rename({"image": "concat", "gt": "crop_gt"}),
+        T.ToArray(),
+    ])
+
+
 # ---------------------------------------------------------------------------
 # batching / sharding
 # ---------------------------------------------------------------------------

@@ -27,9 +27,9 @@ from __future__ import annotations
 
 from typing import Callable, Mapping, Sequence
 
-import cv2
 import numpy as np
 
+from .. import imaging
 from ..utils import helpers
 from . import guidance
 
@@ -91,7 +91,7 @@ class RandomHorizontalFlip(Transform):
         if rng.random() < self.p:
             for key, val in sample.items():
                 if not _is_meta(key):
-                    sample[key] = cv2.flip(val, flipCode=1)
+                    sample[key] = imaging.flip_h(val)
         return sample
 
     def __repr__(self):
@@ -103,10 +103,10 @@ def _warp_interpolation(key: str, arr: np.ndarray, semseg: bool) -> int:
     values are all in {0, 1, 255} (binary / void masks), nearest for gt under
     semantic-segmentation mode, cubic otherwise."""
     if ((arr == 0) | (arr == 1) | (arr == 255)).all():
-        return cv2.INTER_NEAREST
+        return imaging.NEAREST
     if semseg and "gt" in key:
-        return cv2.INTER_NEAREST
-    return cv2.INTER_CUBIC
+        return imaging.NEAREST
+    return imaging.CUBIC
 
 
 class ScaleNRotate(Transform):
@@ -144,11 +144,16 @@ class ScaleNRotate(Transform):
                 continue
             arr = sample[key]
             h, w = arr.shape[:2]
-            M = cv2.getRotationMatrix2D((w / 2, h / 2), rot, sc)
+            M = imaging.rotation_matrix((w / 2, h / 2), rot, sc)
             flag = _warp_interpolation(key, arr, self.semseg)
-            border = 255 if "bb_mask" in key else 0
-            sample[key] = cv2.warpAffine(
-                arr.astype(np.uint8), M, (w, h), flags=flag, borderValue=border
+            # Border fill: 255 for bb_mask (outside-bbox convention) AND for
+            # class-id gt under semseg — warped-out regions must become void
+            # (ignore_index), not background, or the CE loss would supervise
+            # synthetic class-0 pixels over black image padding.
+            border = 255 if ("bb_mask" in key or
+                             (self.semseg and "gt" in key)) else 0
+            sample[key] = imaging.warp_affine(
+                arr.astype(np.uint8), M, (h, w), flag, border
             )
         return sample
 
@@ -519,6 +524,24 @@ class ToImage(Transform):
             v = sample[elem]
             sample[elem] = self.custom_max * (v - v.min()) / (v.max() - v.min() + 1e-10)
         return sample
+
+
+class Rename(Transform):
+    """Rename sample keys (``{old: new}``) — adapter between pipelines with
+    different key contracts (e.g. the semantic pipeline's per-image
+    ``image``/``gt`` onto the step contract's ``concat``/``crop_gt``)."""
+
+    def __init__(self, mapping: Mapping[str, str]):
+        self.mapping = dict(mapping)
+
+    def __call__(self, sample, rng=None):
+        for old, new in self.mapping.items():
+            if old in sample:
+                sample[new] = sample.pop(old)
+        return sample
+
+    def __repr__(self):
+        return f"Rename({self.mapping})"
 
 
 class ToArray(Transform):

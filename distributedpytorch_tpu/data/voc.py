@@ -214,6 +214,75 @@ class VOCInstanceSegmentation:
         return f"VOC2012(split={self.split},area_thres={self.area_thres})"
 
 
+class VOCSemanticSegmentation:
+    """Per-image semantic VOC2012: class-id masks from ``SegmentationClass``.
+
+    The multi-class counterpart of :class:`VOCInstanceSegmentation` for the
+    DeepLabV3 semantic configs of BASELINE.md (configs 1 and 4).  The
+    reference never trained this mode — its dataset is instance-level — but
+    its class PNGs are read for the category cache (reference
+    pascal.py:171-176), and this class exposes them directly:
+
+        {'image': float32 (H, W, 3) RGB,
+         'gt':    float32 (H, W) class ids 0..20, void pixels = 255,
+         'meta':  {'image', 'im_size'}}                            # retname
+
+    Void stays *in-band* as 255 (torchvision convention): the softmax CE loss
+    masks it via ``ignore_index`` (ops.losses.softmax_xent_ignore) and the
+    mIoU metric drops those pixels, so no separate void channel is needed.
+    """
+
+    def __init__(self, root: str, split="val", transform=None,
+                 retname: bool = True):
+        self.root = root
+        self.transform = transform
+        self.retname = retname
+        self.split = sorted([split] if isinstance(split, str) else list(split))
+        self.nclass = len(CATEGORY_NAMES)
+
+        voc_root = os.path.join(root, BASE_DIR)
+        image_dir = os.path.join(voc_root, "JPEGImages")
+        cat_dir = os.path.join(voc_root, "SegmentationClass")
+        splits_dir = os.path.join(voc_root, "ImageSets", "Segmentation")
+        if not os.path.isdir(voc_root):
+            raise RuntimeError(f"VOC tree not found under {root!r}")
+
+        self.im_ids: list[str] = []
+        self.images: list[str] = []
+        self.categories: list[str] = []
+        for splt in self.split:
+            with open(os.path.join(splits_dir, splt + ".txt")) as f:
+                ids = f.read().splitlines()
+            for line in ids:
+                img = os.path.join(image_dir, line + ".jpg")
+                cat = os.path.join(cat_dir, line + ".png")
+                for p in (img, cat):
+                    if not os.path.isfile(p):
+                        raise FileNotFoundError(p)
+                self.im_ids.append(line)
+                self.images.append(img)
+                self.categories.append(cat)
+
+    def __len__(self) -> int:
+        return len(self.im_ids)
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        img = np.array(Image.open(self.images[index]).convert("RGB")
+                       ).astype(np.float32)
+        gt = np.array(Image.open(self.categories[index])).astype(np.float32)
+        sample = {"image": img, "gt": gt}
+        if self.retname:
+            sample["meta"] = {"image": self.im_ids[index],
+                              "im_size": (img.shape[0], img.shape[1])}
+        if self.transform is not None:
+            sample = self.transform(sample, rng)
+        return sample
+
+    def __str__(self) -> str:
+        return f"VOC2012Semantic(split={self.split})"
+
+
 def _md5(path: str) -> str:
     h = hashlib.md5()
     with open(path, "rb") as f:

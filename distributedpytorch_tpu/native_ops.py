@@ -1,0 +1,179 @@
+"""ctypes bindings for the native host-kernel library (native/image_ops.cpp).
+
+The reference consumed native code only through OpenCV (cv2.resize /
+cv2.warpAffine / cv2.flip inside its transforms — SURVEY.md §2, "Language
+note"); this module is the framework-owned replacement: the same hot
+per-sample CPU ops as an in-repo C++ library with pinned semantics, loaded
+via ctypes (no pybind11 dependency).
+
+Usage: the library auto-loads from ``native/libdptpu_host.so`` if built
+(``make -C native``) or from ``$DPTPU_NATIVE_LIB``; :func:`build` compiles it
+on demand.  ``available()`` gates every wrapper, so the pure-python/cv2 path
+keeps working without a compiler.  Hot rasterizers (``helpers.make_gt``)
+dispatch here automatically whenever the library is built — set
+``DPTPU_NATIVE=0`` to force the numpy path (:func:`enabled` is that gate);
+resize/warp/flip selection lives in :mod:`..imaging` (``DPTPU_IMAGING``).
+
+All wrappers take/return float32 numpy arrays (HW or HWC, C-contiguous).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_NAME = "libdptpu_host.so"
+
+NEAREST, BILINEAR, BICUBIC = 0, 1, 2
+
+_lib = None
+
+
+def _candidates():
+    env = os.environ.get("DPTPU_NATIVE_LIB")
+    if env:
+        yield env
+    yield os.path.join(_NATIVE_DIR, _LIB_NAME)
+
+
+def _bind(lib):
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i = ctypes.c_int
+    f = ctypes.c_float
+    lib.resize_f32.argtypes = [f32p, i, i, i, f32p, i, i, i]
+    lib.warp_affine_f32.argtypes = [f32p, i, i, i, f32p, i, i, f64p, i, f]
+    lib.hflip_f32.argtypes = [f32p, i, i, i, f32p]
+    lib.gaussian_hm_f32.argtypes = [f32p, i, i, i, f, f32p]
+    lib.nellipse_f32.argtypes = [f32p, i, i, i, f, f32p]
+    for fn in (lib.resize_f32, lib.warp_affine_f32, lib.hflip_f32,
+               lib.gaussian_hm_f32, lib.nellipse_f32):
+        fn.restype = None
+    return lib
+
+
+def load(path: str | None = None):
+    """Load (and cache) the shared library; returns None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    paths = [path] if path else list(_candidates())
+    for p in paths:
+        if p and os.path.exists(p):
+            _lib = _bind(ctypes.CDLL(p))
+            return _lib
+    return None
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def enabled() -> bool:
+    """Library built AND not disabled (``DPTPU_NATIVE=0`` forces numpy)."""
+    return os.environ.get("DPTPU_NATIVE") != "0" and available()
+
+
+_build_lock = threading.Lock()
+
+
+def build(force: bool = False) -> str:
+    """Compile the library with the in-repo Makefile; returns its path.
+
+    Thread-safe: loader worker threads may all hit the lazy-build path on
+    first use; only one runs make (a concurrent make would let another
+    thread CDLL a half-written .so).
+    """
+    target = os.path.join(_NATIVE_DIR, _LIB_NAME)
+    with _build_lock:
+        if force or not os.path.exists(target):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR] + (["-B"] if force else []),
+                    check=True, capture_output=True, text=True)
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    f"native build failed:\n{e.stderr}") from e
+        global _lib
+        _lib = None
+        load(target)
+    return target
+
+
+def _prep(arr: np.ndarray) -> tuple[np.ndarray, int, int, int, bool]:
+    """-> (contiguous f32 array, h, w, c, had_channel_dim)."""
+    a = np.ascontiguousarray(arr, dtype=np.float32)
+    if a.ndim == 2:
+        h, w = a.shape
+        return a, h, w, 1, False
+    if a.ndim == 3:
+        h, w, c = a.shape
+        return a, h, w, c, True
+    raise ValueError(f"expected HW or HWC array, got shape {arr.shape}")
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def resize(arr: np.ndarray, size: tuple[int, int],
+           mode: int = BILINEAR) -> np.ndarray:
+    """Resize to (H, W) with nearest/bilinear/bicubic (cv2 conventions)."""
+    lib = load()
+    a, h, w, c, chan = _prep(arr)
+    dh, dw = size
+    out = np.empty((dh, dw, c), np.float32)
+    lib.resize_f32(_ptr(a), h, w, c, _ptr(out), dh, dw, mode)
+    return out if chan else out[..., 0]
+
+
+def warp_affine(arr: np.ndarray, m: np.ndarray, size: tuple[int, int],
+                mode: int = BICUBIC, border: float = 0.0) -> np.ndarray:
+    """cv2.warpAffine-convention warp: ``m`` is the 2x3 forward matrix."""
+    lib = load()
+    a, h, w, c, chan = _prep(arr)
+    dh, dw = size
+    m64 = np.ascontiguousarray(m, dtype=np.float64).reshape(6)
+    out = np.empty((dh, dw, c), np.float32)
+    lib.warp_affine_f32(_ptr(a), h, w, c, _ptr(out), dh, dw,
+                        m64.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                        mode, border)
+    return out if chan else out[..., 0]
+
+
+def hflip(arr: np.ndarray) -> np.ndarray:
+    lib = load()
+    a, h, w, c, chan = _prep(arr)
+    out = np.empty_like(a).reshape(h, w, c)
+    lib.hflip_f32(_ptr(a), h, w, c, _ptr(out))
+    return out if chan else out[..., 0]
+
+
+def gaussian_hm(points_xy, size: tuple[int, int],
+                sigma: float = 10.0) -> np.ndarray:
+    """Max-combined FWHM-``sigma`` gaussian bumps (helpers.make_gt)."""
+    lib = load()
+    pts = np.ascontiguousarray(points_xy, dtype=np.float32).reshape(-1, 2)
+    h, w = size
+    out = np.empty((h, w), np.float32)
+    lib.gaussian_hm_f32(_ptr(pts), pts.shape[0], h, w, float(sigma),
+                        _ptr(out))
+    return out
+
+
+def nellipse(points_xy, size: tuple[int, int],
+             softness: float = 0.05) -> np.ndarray:
+    """Soft n-ellipse indicator (guidance.compute_nellipse)."""
+    lib = load()
+    pts = np.ascontiguousarray(points_xy, dtype=np.float32).reshape(-1, 2)
+    h, w = size
+    out = np.empty((h, w), np.float32)
+    lib.nellipse_f32(_ptr(pts), pts.shape[0], h, w, float(softness),
+                     _ptr(out))
+    return out

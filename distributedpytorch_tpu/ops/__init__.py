@@ -18,7 +18,13 @@ from .losses import (
     multi_output_loss,
     softmax_xent_ignore,
 )
-from .metrics import jaccard, batched_jaccard, threshold_sweep_jaccard
+from .metrics import (
+    batched_jaccard,
+    confusion_matrix,
+    jaccard,
+    miou_from_confusion,
+    threshold_sweep_jaccard,
+)
 
 __all__ = [
     "position_attention",
@@ -30,5 +36,7 @@ __all__ = [
     "softmax_xent_ignore",
     "jaccard",
     "batched_jaccard",
+    "confusion_matrix",
+    "miou_from_confusion",
     "threshold_sweep_jaccard",
 ]

@@ -73,3 +73,44 @@ def np_jaccard(pred: np.ndarray, gt: np.ndarray, void: np.ndarray | None = None)
     inter = int(np.sum(pred & gt & valid))
     union = int(np.sum((pred | gt) & valid))
     return 1.0 if union == 0 else inter / union
+
+
+# ---------------------------------------------------------------------------
+# multi-class semantic metrics (the DeepLabV3 "val mIoU" of BASELINE.md)
+# ---------------------------------------------------------------------------
+
+def confusion_matrix(
+    pred: jax.Array, label: jax.Array, nclass: int, ignore_index: int = 255
+) -> jax.Array:
+    """(C, C) confusion counts, rows = true class, cols = predicted class.
+
+    ``pred``/``label``: int arrays of any (equal) shape; ``ignore_index``
+    pixels are dropped (the in-band void convention of the semantic
+    pipeline).  Jit-safe: one bincount over ``true * C + pred``.
+    """
+    pred = pred.reshape(-1).astype(jnp.int32)
+    label = label.reshape(-1).astype(jnp.int32)
+    valid = label != ignore_index
+    idx = jnp.where(valid, label * nclass + pred, nclass * nclass)
+    counts = jnp.bincount(idx, length=nclass * nclass + 1)[:-1]
+    return counts.reshape(nclass, nclass)
+
+
+def miou_from_confusion(conf) -> dict:
+    """Per-class IoU / mean IoU / pixel accuracy from a (C, C) confusion.
+
+    Classes absent from both prediction and ground truth (union == 0) are
+    excluded from the mean, the standard VOC convention.
+    """
+    conf = np.asarray(conf, dtype=np.float64)
+    inter = np.diag(conf)
+    union = conf.sum(0) + conf.sum(1) - inter
+    with np.errstate(divide="ignore", invalid="ignore"):
+        iou = np.where(union > 0, inter / union, np.nan)
+    miou = float(np.nanmean(iou)) if np.any(union > 0) else 0.0
+    total = conf.sum()
+    return {
+        "miou": miou,
+        "per_class_iou": [None if np.isnan(v) else float(v) for v in iou],
+        "pixel_acc": float(inter.sum() / total) if total > 0 else 0.0,
+    }

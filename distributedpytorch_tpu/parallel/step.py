@@ -39,7 +39,7 @@ import optax
 from flax import struct
 from flax.core import unfreeze
 
-from ..ops import multi_output_loss
+from ..ops import multi_output_loss, softmax_xent_ignore
 from . import mesh as mesh_lib
 
 Batch = Mapping[str, jax.Array]
@@ -90,25 +90,42 @@ def create_train_state(
     )
 
 
-def _targets_of(batch: Batch) -> tuple[jax.Array, jax.Array | None]:
-    """Pull (target, void) from a batch, channel-axis-normalized to the
-    model's (B, H, W, C) logit rank."""
+def _compute_loss(outputs, batch: Batch, weights, loss_type: str):
+    """Loss over a model's output tuple.
+
+    ``multi_sigmoid`` — the reference's weighted multi-output balanced BCE
+    (binary interactive segmentation, SegmentationMultiLosses semantics).
+    ``multi_softmax`` — per-output softmax CE with ignore_index=255 (the
+    multi-class DeepLabV3 configs; aux outputs default to 0.4 weight).
+    """
     inputs = batch[INPUT_KEY]
     target = batch[TARGET_KEY]
     void = batch.get("crop_void")
-    if target.ndim == inputs.ndim - 1:  # (B,H,W) masks vs (B,H,W,C) logits
-        target = target[..., None]
-    if void is not None and void.ndim == inputs.ndim - 1:
-        void = void[..., None]
-    return target, void
+    if loss_type == "multi_sigmoid":
+        if target.ndim == inputs.ndim - 1:  # (B,H,W) vs (B,H,W,C) logits
+            target = target[..., None]
+        if void is not None and void.ndim == inputs.ndim - 1:
+            void = void[..., None]
+        return multi_output_loss(outputs, target, void=void, weights=weights)
+    if loss_type == "multi_softmax":
+        labels = target
+        if labels.ndim == outputs[0].ndim:  # squeeze trailing channel axis
+            labels = labels[..., 0]
+        labels = labels.astype(jnp.int32)
+        if weights is None:
+            weights = (1.0,) + (0.4,) * (len(outputs) - 1)
+        total = jnp.float32(0.0)
+        for out, w in zip(outputs, weights):
+            total = total + w * softmax_xent_ignore(out, labels)
+        return total
+    raise ValueError(f"unknown loss_type: {loss_type!r}")
 
 
 def _loss_and_updates(model, params, batch_stats, batch: Batch, rng,
-                      loss_weights, train: bool):
-    """Forward + multi-output loss; returns (loss, new_batch_stats)."""
+                      loss_weights, train: bool, loss_type: str):
+    """Forward + loss; returns (loss, new_batch_stats)."""
     variables = {"params": params, "batch_stats": batch_stats}
     inputs = batch[INPUT_KEY]
-    target, void = _targets_of(batch)
     if train:
         outputs, mutated = model.apply(
             variables, inputs, train=True,
@@ -118,7 +135,7 @@ def _loss_and_updates(model, params, batch_stats, batch: Batch, rng,
     else:
         outputs = model.apply(variables, inputs, train=False)
         new_stats = batch_stats
-    loss = multi_output_loss(outputs, target, void=void, weights=loss_weights)
+    loss = _compute_loss(outputs, batch, loss_weights, loss_type)
     return loss, new_stats
 
 
@@ -129,6 +146,7 @@ def make_train_step(
     accum_steps: int = 1,
     mesh=None,
     donate: bool = True,
+    loss_type: str = "multi_sigmoid",
 ) -> Callable[[TrainState, Batch], tuple[TrainState, jax.Array]]:
     """Build the jitted ``(state, batch) -> (state, loss)`` train step.
 
@@ -141,7 +159,8 @@ def make_train_step(
     def grads_of(params, batch_stats, batch, rng):
         def loss_fn(p):
             return _loss_and_updates(model, p, batch_stats, batch, rng,
-                                     loss_weights, train=True)
+                                     loss_weights, train=True,
+                                     loss_type=loss_type)
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         return loss, new_stats, grads
@@ -198,7 +217,7 @@ def make_train_step(
 
 
 def make_eval_step(model, loss_weights: tuple[float, ...] | None = None,
-                   mesh=None):
+                   mesh=None, loss_type: str = "multi_sigmoid"):
     """Jitted ``(state, batch) -> (outputs, loss)`` inference step
     (reference val loop body, train_pascal.py:245-254).  Outputs are the
     model's logit tuple; sigmoid/thresholding happen in the evaluator, which
@@ -208,9 +227,7 @@ def make_eval_step(model, loss_weights: tuple[float, ...] | None = None,
         variables = {"params": state.params,
                      "batch_stats": state.batch_stats}
         outputs = model.apply(variables, batch[INPUT_KEY], train=False)
-        target, void = _targets_of(batch)
-        loss = multi_output_loss(outputs, target, void=void,
-                                 weights=loss_weights)
+        loss = _compute_loss(outputs, batch, loss_weights, loss_type)
         return outputs, loss
 
     if mesh is None:

@@ -13,7 +13,7 @@ from .config import (
     from_json,
     to_json,
 )
-from .evaluate import batch_debug_asserts, evaluate
+from .evaluate import batch_debug_asserts, evaluate, evaluate_semantic
 from .logging import (
     ConsoleWriter,
     JsonlWriter,
@@ -42,6 +42,7 @@ __all__ = [
     "apply_overrides",
     "batch_debug_asserts",
     "evaluate",
+    "evaluate_semantic",
     "flatten",
     "from_json",
     "make_optimizer",

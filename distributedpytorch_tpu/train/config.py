@@ -85,6 +85,7 @@ class CheckpointConfig:
 
 @dataclass
 class Config:
+    task: str = "instance"              # instance (reference) | semantic
     data: DataConfig = field(default_factory=DataConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)

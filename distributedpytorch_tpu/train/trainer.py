@@ -31,7 +31,10 @@ import numpy as np
 from ..data import (
     DataLoader,
     VOCInstanceSegmentation,
+    VOCSemanticSegmentation,
     build_eval_transform,
+    build_semantic_eval_transform,
+    build_semantic_train_transform,
     build_train_transform,
     make_fake_voc,
 )
@@ -46,7 +49,7 @@ from ..parallel import (
 from ..utils.helpers import generate_param_report
 from . import config as config_lib
 from .checkpoint import CheckpointManager, next_run_dir
-from .evaluate import batch_debug_asserts, evaluate
+from .evaluate import batch_debug_asserts, evaluate, evaluate_semantic
 from .logging import (
     ConsoleWriter,
     JsonlWriter,
@@ -89,21 +92,35 @@ class Trainer:
             if not os.path.exists(os.path.join(root, "VOCdevkit")):
                 make_fake_voc(root, n_images=8, size=(96, 128), n_val=3,
                               seed=cfg.seed)
-        train_tf = build_train_transform(
-            crop_size=cfg.data.crop_size, relax=cfg.data.relax,
-            zero_pad=cfg.data.zero_pad, rots=cfg.data.rots,
-            scales=cfg.data.scales, alpha=cfg.data.guidance_alpha,
-            guidance=cfg.data.guidance)
-        val_tf = build_eval_transform(
-            crop_size=cfg.data.crop_size, relax=cfg.data.relax,
-            zero_pad=cfg.data.zero_pad, alpha=cfg.data.guidance_alpha,
-            guidance=cfg.data.guidance)
-        self.train_set = VOCInstanceSegmentation(
-            root, split=cfg.data.train_split, transform=train_tf,
-            preprocess=True, area_thres=cfg.data.area_thres)
-        self.val_set = VOCInstanceSegmentation(
-            root, split=cfg.data.val_split, transform=val_tf,
-            preprocess=True, area_thres=cfg.data.area_thres)
+        if cfg.task == "instance":
+            train_tf = build_train_transform(
+                crop_size=cfg.data.crop_size, relax=cfg.data.relax,
+                zero_pad=cfg.data.zero_pad, rots=cfg.data.rots,
+                scales=cfg.data.scales, alpha=cfg.data.guidance_alpha,
+                guidance=cfg.data.guidance)
+            val_tf = build_eval_transform(
+                crop_size=cfg.data.crop_size, relax=cfg.data.relax,
+                zero_pad=cfg.data.zero_pad, alpha=cfg.data.guidance_alpha,
+                guidance=cfg.data.guidance)
+            self.train_set = VOCInstanceSegmentation(
+                root, split=cfg.data.train_split, transform=train_tf,
+                preprocess=True, area_thres=cfg.data.area_thres)
+            self.val_set = VOCInstanceSegmentation(
+                root, split=cfg.data.val_split, transform=val_tf,
+                preprocess=True, area_thres=cfg.data.area_thres)
+        elif cfg.task == "semantic":
+            self.train_set = VOCSemanticSegmentation(
+                root, split=cfg.data.train_split,
+                transform=build_semantic_train_transform(
+                    crop_size=cfg.data.crop_size, rots=cfg.data.rots,
+                    scales=cfg.data.scales))
+            self.val_set = VOCSemanticSegmentation(
+                root, split=cfg.data.val_split,
+                transform=build_semantic_eval_transform(
+                    crop_size=cfg.data.crop_size))
+        else:
+            raise ValueError(
+                f"unknown task: {cfg.task!r} (instance | semantic)")
         self.train_loader = DataLoader(
             self.train_set, cfg.data.train_batch, shuffle=True,
             drop_last=True, seed=cfg.seed, num_workers=cfg.data.num_workers,
@@ -129,11 +146,15 @@ class Trainer:
             self.state = create_train_state(
                 jax.random.PRNGKey(cfg.seed), self.model, self.tx,
                 (1, h, w, cfg.model.in_channels))
+        loss_type = ("multi_softmax" if cfg.task == "semantic"
+                     else "multi_sigmoid")
         self.train_step = make_train_step(
             self.model, self.tx, loss_weights=cfg.model.loss_weights,
-            accum_steps=cfg.optim.accum_steps, mesh=self.mesh)
+            accum_steps=cfg.optim.accum_steps, mesh=self.mesh,
+            loss_type=loss_type)
         self.eval_step = make_eval_step(
-            self.model, loss_weights=cfg.model.loss_weights, mesh=self.mesh)
+            self.model, loss_weights=cfg.model.loss_weights, mesh=self.mesh,
+            loss_type=loss_type)
 
         # --- checkpointing
         self.ckpt = CheckpointManager(
@@ -190,7 +211,7 @@ class Trainer:
         step0 = int(self.state.step)
         with self.mesh:
             for i, batch in enumerate(self.train_loader):
-                if cfg.debug_asserts:
+                if cfg.debug_asserts and cfg.task == "instance":
                     batch_debug_asserts(batch)
                 device_batch = shard_batch(self.mesh, {
                     k: v for k, v in batch.items()
@@ -220,19 +241,28 @@ class Trainer:
                  ) -> dict:
         self.val_loader.set_epoch(0)
         with self.mesh:
-            metrics = evaluate(
-                self.eval_step, self.state, self.val_loader,
-                thresholds=self.cfg.eval_thresholds,
-                relax=self.cfg.data.relax, zero_pad=self.cfg.data.zero_pad,
-                mesh=self.mesh)
+            if self.cfg.task == "semantic":
+                metrics = evaluate_semantic(
+                    self.eval_step, self.state, self.val_loader,
+                    nclass=self.cfg.model.nclass, mesh=self.mesh)
+            else:
+                metrics = evaluate(
+                    self.eval_step, self.state, self.val_loader,
+                    thresholds=self.cfg.eval_thresholds,
+                    relax=self.cfg.data.relax,
+                    zero_pad=self.cfg.data.zero_pad, mesh=self.mesh)
         first = metrics.pop("_first_batch", None)
         if self.is_main:
             step = int(self.state.step)
             flat = {"val/loss": metrics["loss"],
-                    "val/jaccard": metrics["jaccard"],
-                    "val/best_threshold": metrics["best_threshold"]}
-            for th, v in metrics["jaccard_per_threshold"].items():
+                    "val/jaccard": metrics["jaccard"]}
+            if "best_threshold" in metrics:
+                flat["val/best_threshold"] = metrics["best_threshold"]
+            for th, v in metrics.get("jaccard_per_threshold", {}).items():
                 flat[f"val/jaccard@{th}"] = v
+            if "miou" in metrics:
+                flat["val/miou"] = metrics["miou"]
+                flat["val/pixel_acc"] = metrics["pixel_acc"]
             if epoch is not None:
                 flat["val/epoch"] = epoch
             self.writer.scalars(flat, step)

@@ -22,8 +22,9 @@ import json
 import os
 from typing import Sequence
 
-import cv2
 import numpy as np
+
+from .. import imaging
 
 
 # ---------------------------------------------------------------------------
@@ -103,8 +104,8 @@ def crop_from_mask(
     the image, the mask is nearest-resized to the image first.
     """
     if mask.shape[:2] != img.shape[:2]:
-        mask = cv2.resize(
-            mask, (img.shape[1], img.shape[0]), interpolation=cv2.INTER_NEAREST
+        mask = imaging.resize(
+            mask, (img.shape[0], img.shape[1]), imaging.NEAREST
         )
     bbox = get_bbox(mask, pad=relax, zero_pad=zero_pad)
     if bbox is None:
@@ -124,9 +125,9 @@ def fixed_resize(
     """
     if flagval is None:
         if ((sample == 0) | (sample == 1)).all() or ((sample == 0) | (sample == 255)).all():
-            flagval = cv2.INTER_NEAREST
+            flagval = imaging.NEAREST
         else:
-            flagval = cv2.INTER_CUBIC
+            flagval = imaging.CUBIC
 
     if isinstance(resolution, int):
         tmp = [resolution, resolution]
@@ -136,17 +137,15 @@ def fixed_resize(
         resolution = tuple(tmp)
 
     if sample.ndim == 2 or (sample.ndim == 3 and sample.shape[2] == 3):
-        sample = cv2.resize(
-            sample, (resolution[1], resolution[0]), interpolation=flagval
-        )
+        sample = imaging.resize(sample, tuple(resolution), flagval)
     else:
         tmp = sample
         sample = np.zeros(
             np.append(resolution, tmp.shape[2]).astype(np.int32), dtype=np.float32
         )
         for ii in range(sample.shape[2]):
-            sample[:, :, ii] = cv2.resize(
-                tmp[:, :, ii], (resolution[1], resolution[0]), interpolation=flagval
+            sample[:, :, ii] = imaging.resize(
+                tmp[:, :, ii], tuple(resolution), flagval
             )
     return sample
 
@@ -158,7 +157,7 @@ def crop2fullmask(
     zero_pad: bool = False,
     relax: int = 0,
     mask_relax: bool = True,
-    interpolation: int = cv2.INTER_CUBIC,
+    interpolation: int = imaging.CUBIC,
 ) -> np.ndarray:
     """Paste a crop-space prediction back into a full-image-sized mask.
 
@@ -191,8 +190,8 @@ def crop2fullmask(
 
     crop_h = bbox[3] - bbox[1] + 1
     crop_w = bbox[2] - bbox[0] + 1
-    crop_mask = cv2.resize(
-        crop_mask.astype(np.float32), (crop_w, crop_h), interpolation=interpolation
+    crop_mask = imaging.resize(
+        crop_mask.astype(np.float32), (crop_h, crop_w), interpolation
     )
 
     result = np.zeros(im_size, dtype=crop_mask.dtype)
@@ -270,6 +269,9 @@ def make_gt(
         for ii in range(labels.shape[0]):
             gt[:, :, ii] = make_gaussian((h, w), center=labels[ii], sigma=sigma)
     else:
+        from .. import native_ops
+        if native_ops.enabled():  # ~3x the numpy loop on 512^2 crops
+            return native_ops.gaussian_hm(labels[:, :2], (h, w), sigma)
         gt = np.zeros((h, w), dtype=np.float32)
         for ii in range(labels.shape[0]):
             gt = np.maximum(gt, make_gaussian((h, w), center=labels[ii], sigma=sigma))

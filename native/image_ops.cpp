@@ -1,0 +1,223 @@
+// Native host-side image kernels for the data pipeline.
+//
+// The reference framework's only native code surface was OpenCV's C++ backing
+// cv2.resize / cv2.warpAffine / cv2.flip inside its transform library
+// (reference custom_transforms.py:116-126,186-193,205-215 — see SURVEY.md §2,
+// "Language note").  This library is the framework-owned equivalent: the hot
+// per-sample CPU ops as a small C API consumed through ctypes, so the input
+// pipeline does not depend on OpenCV's dispatch layer and the semantics
+// (border handling, bicubic coefficients) are pinned in-repo.
+//
+// Conventions: float32, row-major, HW or HWC with a channel stride of 1;
+// coordinates are (x, y) with the cv2 pixel-center convention
+// (dst pixel i samples src at (i + 0.5) * scale - 0.5).
+// Bicubic uses the Catmull-Rom-style kernel with a = -0.75, cv2's choice.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+namespace {
+
+inline float clampf(float v, float lo, float hi) {
+  return v < lo ? lo : (v > hi ? hi : v);
+}
+
+inline int clampi(int v, int lo, int hi) {
+  return v < lo ? lo : (v > hi ? hi : v);
+}
+
+// cv2-compatible bicubic weight (a = -0.75).
+inline float cubic_w(float x) {
+  constexpr float a = -0.75f;
+  x = std::fabs(x);
+  if (x <= 1.0f) return ((a + 2.0f) * x - (a + 3.0f)) * x * x + 1.0f;
+  if (x < 2.0f) return (((x - 5.0f) * x + 8.0f) * x - 4.0f) * a;
+  return 0.0f;
+}
+
+}  // namespace
+
+// mode: 0 = nearest, 1 = bilinear, 2 = bicubic
+void resize_f32(const float* src, int sh, int sw, int c,
+                float* dst, int dh, int dw, int mode) {
+  const float sx = static_cast<float>(sw) / dw;
+  const float sy = static_cast<float>(sh) / dh;
+  for (int y = 0; y < dh; ++y) {
+    const float fy = (y + 0.5f) * sy - 0.5f;
+    for (int x = 0; x < dw; ++x) {
+      const float fx = (x + 0.5f) * sx - 0.5f;
+      float* out = dst + (static_cast<int64_t>(y) * dw + x) * c;
+      if (mode == 0) {
+        // cv2 INTER_NEAREST: floor(x * scale), no half-pixel shift.
+        const int xs = clampi(static_cast<int>(x * sx), 0, sw - 1);
+        const int ys = clampi(static_cast<int>(y * sy), 0, sh - 1);
+        const float* in = src + (static_cast<int64_t>(ys) * sw + xs) * c;
+        std::memcpy(out, in, sizeof(float) * c);
+      } else if (mode == 1) {
+        const int x0 = static_cast<int>(std::floor(fx));
+        const int y0 = static_cast<int>(std::floor(fy));
+        const float ax = fx - x0, ay = fy - y0;
+        const int x0c = clampi(x0, 0, sw - 1), x1c = clampi(x0 + 1, 0, sw - 1);
+        const int y0c = clampi(y0, 0, sh - 1), y1c = clampi(y0 + 1, 0, sh - 1);
+        for (int k = 0; k < c; ++k) {
+          const float v00 = src[(static_cast<int64_t>(y0c) * sw + x0c) * c + k];
+          const float v01 = src[(static_cast<int64_t>(y0c) * sw + x1c) * c + k];
+          const float v10 = src[(static_cast<int64_t>(y1c) * sw + x0c) * c + k];
+          const float v11 = src[(static_cast<int64_t>(y1c) * sw + x1c) * c + k];
+          out[k] = v00 * (1 - ax) * (1 - ay) + v01 * ax * (1 - ay) +
+                   v10 * (1 - ax) * ay + v11 * ax * ay;
+        }
+      } else {
+        const int x0 = static_cast<int>(std::floor(fx));
+        const int y0 = static_cast<int>(std::floor(fy));
+        float wx[4], wy[4];
+        for (int t = 0; t < 4; ++t) {
+          wx[t] = cubic_w(fx - (x0 - 1 + t));
+          wy[t] = cubic_w(fy - (y0 - 1 + t));
+        }
+        for (int k = 0; k < c; ++k) {
+          float acc = 0.0f;
+          for (int j = 0; j < 4; ++j) {
+            const int yy = clampi(y0 - 1 + j, 0, sh - 1);
+            float row = 0.0f;
+            for (int i = 0; i < 4; ++i) {
+              const int xx = clampi(x0 - 1 + i, 0, sw - 1);
+              row += wx[i] * src[(static_cast<int64_t>(yy) * sw + xx) * c + k];
+            }
+            acc += wy[j] * row;
+          }
+          out[k] = acc;
+        }
+      }
+    }
+  }
+}
+
+// Inverse-map affine warp: for each dst pixel, sample src at M^-1 * (x, y).
+// M is the 2x3 forward matrix (cv2.warpAffine convention); border is constant.
+// mode: 0 = nearest, 2 = bicubic.
+void warp_affine_f32(const float* src, int sh, int sw, int c,
+                     float* dst, int dh, int dw,
+                     const double* m, int mode, float border) {
+  // Invert [a b tx; d e ty].
+  const double a = m[0], b = m[1], tx = m[2];
+  const double d = m[3], e = m[4], ty = m[5];
+  const double det = a * e - b * d;
+  const double ia = e / det, ib = -b / det, id = -d / det, ie = a / det;
+  const double itx = -(ia * tx + ib * ty), ity = -(id * tx + ie * ty);
+
+  for (int y = 0; y < dh; ++y) {
+    for (int x = 0; x < dw; ++x) {
+      const float fx = static_cast<float>(ia * x + ib * y + itx);
+      const float fy = static_cast<float>(id * x + ie * y + ity);
+      float* out = dst + (static_cast<int64_t>(y) * dw + x) * c;
+      if (mode == 0) {
+        const int xs = static_cast<int>(std::lround(fx));
+        const int ys = static_cast<int>(std::lround(fy));
+        if (xs < 0 || xs >= sw || ys < 0 || ys >= sh) {
+          for (int k = 0; k < c; ++k) out[k] = border;
+        } else {
+          const float* in = src + (static_cast<int64_t>(ys) * sw + xs) * c;
+          std::memcpy(out, in, sizeof(float) * c);
+        }
+      } else {
+        const int x0 = static_cast<int>(std::floor(fx));
+        const int y0 = static_cast<int>(std::floor(fy));
+        float wx[4], wy[4];
+        for (int t = 0; t < 4; ++t) {
+          wx[t] = cubic_w(fx - (x0 - 1 + t));
+          wy[t] = cubic_w(fy - (y0 - 1 + t));
+        }
+        for (int k = 0; k < c; ++k) {
+          float acc = 0.0f;
+          for (int j = 0; j < 4; ++j) {
+            const int yy = y0 - 1 + j;
+            float row = 0.0f;
+            for (int i = 0; i < 4; ++i) {
+              const int xx = x0 - 1 + i;
+              const float v = (xx < 0 || xx >= sw || yy < 0 || yy >= sh)
+                                  ? border
+                                  : src[(static_cast<int64_t>(yy) * sw + xx) * c + k];
+              row += wx[i] * v;
+            }
+            acc += wy[j] * row;
+          }
+          out[k] = acc;
+        }
+      }
+    }
+  }
+}
+
+void hflip_f32(const float* src, int h, int w, int c, float* dst) {
+  for (int y = 0; y < h; ++y) {
+    for (int x = 0; x < w; ++x) {
+      const float* in = src + (static_cast<int64_t>(y) * w + (w - 1 - x)) * c;
+      float* out = dst + (static_cast<int64_t>(y) * w + x) * c;
+      std::memcpy(out, in, sizeof(float) * c);
+    }
+  }
+}
+
+// Max-combined Gaussian heatmap over n points — helpers.make_gt semantics:
+// each bump is exp(-4 ln2 * d^2 / sigma^2) (sigma is the FWHM).
+void gaussian_hm_f32(const float* pts_xy, int n, int h, int w,
+                     float sigma, float* dst) {
+  const float inv = 4.0f * 0.6931471805599453f / (sigma * sigma);
+  for (int y = 0; y < h; ++y) {
+    for (int x = 0; x < w; ++x) {
+      float best = 0.0f;
+      for (int p = 0; p < n; ++p) {
+        const float dx = x - pts_xy[2 * p];
+        const float dy = y - pts_xy[2 * p + 1];
+        const float v = std::exp(-(dx * dx + dy * dy) * inv);
+        best = std::max(best, v);
+      }
+      dst[static_cast<int64_t>(y) * w + x] = best;
+    }
+  }
+}
+
+// Soft n-ellipse indicator — guidance.compute_nellipse semantics:
+// d(x) = sum of distances to the foci; boundary constant c = the largest
+// focal-point sum (so every click point is enclosed); output
+// sigmoid((c - d) / (softness * c)), argument clipped to +-50.  Degenerate
+// (all foci coincident): 1 exactly at the focus, 0 elsewhere.
+void nellipse_f32(const float* pts_xy, int n, int h, int w,
+                  float softness, float* dst) {
+  double c = 0.0;
+  for (int p = 0; p < n; ++p) {
+    double s = 0.0;
+    for (int q = 0; q < n; ++q) {
+      const double dx = pts_xy[2 * p] - pts_xy[2 * q];
+      const double dy = pts_xy[2 * p + 1] - pts_xy[2 * q + 1];
+      s += std::sqrt(dx * dx + dy * dy);
+    }
+    c = std::max(c, s);
+  }
+  for (int y = 0; y < h; ++y) {
+    for (int x = 0; x < w; ++x) {
+      double d = 0.0;
+      for (int p = 0; p < n; ++p) {
+        const double dx = x - pts_xy[2 * p];
+        const double dy = y - pts_xy[2 * p + 1];
+        d += std::sqrt(dx * dx + dy * dy);
+      }
+      float v;
+      if (c <= 0.0) {
+        v = (d == 0.0) ? 1.0f : 0.0f;
+      } else {
+        const double t = clampf(static_cast<float>((d - c) / (softness * c)),
+                                -50.0f, 50.0f);
+        v = static_cast<float>(1.0 / (1.0 + std::exp(t)));
+      }
+      dst[static_cast<int64_t>(y) * w + x] = v;
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,102 @@
+"""Semantic (multi-class) segmentation mode: dataset, metrics, end-to-end.
+
+The DeepLabV3 configs of BASELINE.md (configs 1 and 4): per-image class-id
+masks with in-band 255 void, softmax CE with ignore_index, confusion-matrix
+mIoU gating checkpoints.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.data import (
+    DataLoader,
+    VOCSemanticSegmentation,
+    build_semantic_eval_transform,
+    build_semantic_train_transform,
+)
+from distributedpytorch_tpu.ops import confusion_matrix, miou_from_confusion
+from distributedpytorch_tpu.train import Config, Trainer, apply_overrides
+
+
+class TestSemanticDataset:
+    def test_samples(self, fake_voc_root):
+        ds = VOCSemanticSegmentation(fake_voc_root, split="train")
+        assert len(ds) > 0
+        s = ds[0]
+        assert s["image"].ndim == 3 and s["image"].shape[2] == 3
+        assert s["gt"].shape == s["image"].shape[:2]
+        vals = set(np.unique(s["gt"]).astype(int))
+        assert vals <= set(range(21)) | {255}
+        assert s["meta"]["image"]
+
+    def test_pipeline_batches(self, fake_voc_root):
+        ds = VOCSemanticSegmentation(
+            fake_voc_root, split="train",
+            transform=build_semantic_train_transform(crop_size=(64, 64)))
+        batch = next(iter(DataLoader(ds, batch_size=2, shuffle=True,
+                                     drop_last=True, num_workers=0)))
+        assert batch["concat"].shape == (2, 64, 64, 3)
+        gt = batch["crop_gt"]
+        assert gt.shape[:3] == (2, 64, 64)
+        # class ids survive the nearest-only warp/resize chain exactly
+        assert set(np.unique(gt).astype(int)) <= set(range(21)) | {255}
+
+    def test_eval_transform_deterministic(self, fake_voc_root):
+        ds = VOCSemanticSegmentation(
+            fake_voc_root, split="val",
+            transform=build_semantic_eval_transform(crop_size=(48, 48)))
+        a, b = ds[0], ds[0]
+        np.testing.assert_array_equal(a["crop_gt"], b["crop_gt"])
+
+
+class TestConfusionMetrics:
+    def test_perfect_prediction(self):
+        label = np.array([[0, 1], [2, 255]])
+        conf = confusion_matrix(np.array([[0, 1], [2, 9]]), label, nclass=3)
+        m = miou_from_confusion(conf)
+        assert m["miou"] == pytest.approx(1.0)
+        assert m["pixel_acc"] == pytest.approx(1.0)
+        assert np.asarray(conf).sum() == 3  # void pixel dropped
+
+    def test_known_iou(self):
+        # class 0: inter 1, union 2 -> 0.5 ; class 1: inter 1, union 2 -> 0.5
+        pred = np.array([0, 0, 1, 1])
+        gt = np.array([0, 1, 0, 1])
+        m = miou_from_confusion(confusion_matrix(pred, gt, nclass=2))
+        assert m["miou"] == pytest.approx(1 / 3)
+        assert m["per_class_iou"] == [pytest.approx(1 / 3)] * 2
+
+    def test_absent_class_excluded(self):
+        pred = np.array([0, 0])
+        gt = np.array([0, 0])
+        m = miou_from_confusion(confusion_matrix(pred, gt, nclass=3))
+        assert m["miou"] == pytest.approx(1.0)
+        assert m["per_class_iou"][1] is None
+
+
+class TestSemanticTrainerEndToEnd:
+    def test_fit_deeplab_semantic(self, tmp_path):
+        cfg = apply_overrides(Config(), [
+            # fake VOC train split has 5 images and the semantic set is
+            # per-image, so the batch must be <= 5 to survive drop_last
+            "task=semantic", "data.fake=true", "data.train_batch=4",
+            "data.val_batch=2", "data.crop_size=[64,64]",
+            "mesh.data=4", "mesh.model=2",  # batch 4 must divide the data axis
+            "model.name=deeplabv3", "model.nclass=21",
+            "model.backbone=resnet18", "model.in_channels=3",
+            "optim.lr=0.001", "optim.schedule=poly",
+            "checkpoint.async_save=false", "epochs=1", "eval_every=1",
+            "log_every_steps=1",
+        ])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        tr = Trainer(cfg)
+        hist = tr.fit()
+        assert np.isfinite(hist["train_loss"][0])
+        m = hist["val"][-1]
+        assert 0.0 <= m["miou"] <= 1.0
+        assert m["jaccard"] == m["miou"]  # uniform checkpoint gate
+        assert 0.0 <= m["pixel_acc"] <= 1.0
+        assert len(m["per_class_iou"]) == 21
+        tr.close()
