@@ -62,19 +62,29 @@ def main() -> None:
         "crop_gt": (r.uniform(size=(BATCH * n_chips, SIZE, SIZE)) > 0.7
                     ).astype(np.float32),
     }
-    from distributedpytorch_tpu.utils import StepTimer
+    from distributedpytorch_tpu.utils.profiling import throughput
 
-    timer = StepTimer(warmup=WARMUP)
     with mesh:
         state = create_train_state(jax.random.PRNGKey(0), model, tx,
                                    (1, SIZE, SIZE, 4))
         step = make_train_step(model, tx, mesh=mesh)
         batch = shard_batch(mesh, host_batch)
-        for _ in range(WARMUP + STEPS):
-            state, loss = step(state, batch)
-            timer.tick(loss)
 
-    stats = timer.summary(items_per_step=BATCH * n_chips)
+        state_box = [state]
+
+        def one_step():
+            state_box[0], loss = step(state_box[0], batch)
+            # Return the loss AND a param leaf: throughput() materializes the
+            # return value, so timing provably covers the optimizer update
+            # (loss alone completes before the update does).
+            return loss, jax.tree.leaves(state_box[0].params)[0]
+
+        # throughput() pipelines all dispatches and materializes once at the
+        # end — per-step host syncs through a tunneled device mismeasure
+        # badly, and block_until_ready can be a no-op there (see profiling).
+        stats = throughput(one_step, steps=STEPS, warmup=WARMUP,
+                           items_per_step=BATCH * n_chips)
+
     per_chip = stats["items_per_sec"] / n_chips
     print(json.dumps({
         "metric": f"danet_{BACKBONE}_{SIZE}px_b{BATCH}_train_step_throughput",

@@ -1,6 +1,7 @@
 """Data subsystem: dataset, transforms, guidance synthesis, sharded loading."""
 
 from . import guidance, transforms
+from .combine import CombinedDataset
 from .fake import make_fake_voc
 from .pipeline import (
     DataLoader,
@@ -18,6 +19,7 @@ from .voc import (
 
 __all__ = [
     "CATEGORY_NAMES",
+    "CombinedDataset",
     "DataLoader",
     "VOCInstanceSegmentation",
     "VOCSemanticSegmentation",

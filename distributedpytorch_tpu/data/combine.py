@@ -1,0 +1,74 @@
+"""Dataset combination with exclusion — the ``CombineDBs`` contract.
+
+The reference merged extra databases (SBD) into VOC train while excluding
+images present in the held-out sets: ``CombineDBs([train, sbd],
+excluded=[val])`` (reference train_pascal.py:27,150-154 — a dead path there
+because the ``import sbd`` was commented out, making ``use_sbd=True`` a
+``NameError``; SURVEY.md §2.4 inventories the contract).  Here it is a live,
+source-agnostic combinator: any datasets exposing ``__len__``,
+``__getitem__(i, rng)`` and ``sample_image_id(i)`` can be concatenated, and
+any sample whose image id appears in an ``excluded`` dataset is dropped —
+the standard guard against train/val leakage when mixing databases that
+share images.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+class CombinedDataset:
+    """Concatenation of datasets minus samples whose image id occurs in any
+    ``excluded`` dataset.  Each constituent keeps its own transform.
+
+    Constituents must yield the same sample schema (key set): ``collate``
+    stacks by the first sample's keys, so a mixed-schema batch would either
+    KeyError or silently drop keys.  The constructor probes one sample per
+    dataset and rejects mismatches unless ``allow_mixed_schemas=True``
+    (only sensible for unbatched / manually-batched access).
+    """
+
+    def __init__(self, datasets: Sequence, excluded: Sequence = (),
+                 allow_mixed_schemas: bool = False):
+        self.datasets = list(datasets)
+        if not allow_mixed_schemas and len(self.datasets) > 1:
+            probe_rng = np.random.default_rng(0)
+            schemas = [
+                (frozenset(ds.__getitem__(0, probe_rng).keys()) if len(ds)
+                 else frozenset())
+                for ds in self.datasets
+            ]
+            live = {s for s in schemas if s}
+            if len(live) > 1:
+                raise ValueError(
+                    "constituent datasets yield different sample schemas "
+                    f"({[sorted(s) for s in live]}); such a mix cannot be "
+                    "batched — pass allow_mixed_schemas=True only for "
+                    "unbatched access")
+        excluded_ids: set[str] = set()
+        for ds in excluded:
+            excluded_ids |= {ds.sample_image_id(i) for i in range(len(ds))}
+        #: flat index: (dataset position, local sample index)
+        self.index: list[tuple[int, int]] = []
+        for di, ds in enumerate(self.datasets):
+            for si in range(len(ds)):
+                if ds.sample_image_id(si) not in excluded_ids:
+                    self.index.append((di, si))
+
+    def __len__(self) -> int:
+        return len(self.index)
+
+    def sample_image_id(self, index: int) -> str:
+        di, si = self.index[index]
+        return self.datasets[di].sample_image_id(si)
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        di, si = self.index[index]
+        return self.datasets[di].__getitem__(si, rng)
+
+    def __str__(self) -> str:
+        parts = " + ".join(str(d) for d in self.datasets)
+        return f"Combined({parts}, n={len(self)})"

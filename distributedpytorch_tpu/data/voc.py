@@ -181,6 +181,10 @@ class VOCInstanceSegmentation:
     def __len__(self) -> int:
         return len(self.obj_list)
 
+    def sample_image_id(self, index: int) -> str:
+        """Image id owning sample ``index`` (CombinedDataset exclusion key)."""
+        return self.im_ids[self.obj_list[index][0]]
+
     def __getitem__(self, index: int, rng: np.random.Generator | None = None) -> dict:
         im_ii, obj_ii = self.obj_list[index]
         img, target, void = self._load_instance(im_ii, obj_ii)
@@ -265,6 +269,10 @@ class VOCSemanticSegmentation:
 
     def __len__(self) -> int:
         return len(self.im_ids)
+
+    def sample_image_id(self, index: int) -> str:
+        """Image id of sample ``index`` (CombinedDataset exclusion key)."""
+        return self.im_ids[index]
 
     def __getitem__(self, index: int,
                     rng: np.random.Generator | None = None) -> dict:

@@ -1,6 +1,7 @@
 """Utilities: array helpers, logging, debug checks, profiling."""
 
 from . import helpers, profiling
-from .profiling import StepTimer, annotate, trace
+from .profiling import StepTimer, annotate, throughput, trace
 
-__all__ = ["StepTimer", "annotate", "helpers", "profiling", "trace"]
+__all__ = ["StepTimer", "annotate", "helpers", "profiling", "throughput",
+           "trace"]

@@ -181,3 +181,39 @@ class TestLoaderRegressions:
             it.close()  # abandon
         after = threading.active_count()
         assert after <= before + 1
+
+
+class TestCombinedDataset:
+    """CombineDBs contract (reference train_pascal.py:150-154, SURVEY §2.4):
+    concatenate datasets, excluding samples whose image ids appear in the
+    excluded sets — the train/val leakage guard for multi-database merges."""
+
+    def test_concat_and_exclusion(self, fake_voc_root):
+        from distributedpytorch_tpu.data import (
+            CombinedDataset, VOCInstanceSegmentation)
+        train = VOCInstanceSegmentation(fake_voc_root, split="train")
+        val = VOCInstanceSegmentation(fake_voc_root, split="val")
+        both = CombinedDataset([train, val])
+        assert len(both) == len(train) + len(val)
+        # excluding val removes exactly the val-image samples
+        guarded = CombinedDataset([train, val], excluded=[val])
+        assert len(guarded) == len(train)
+        val_ids = {val.sample_image_id(i) for i in range(len(val))}
+        for i in range(len(guarded)):
+            assert guarded.sample_image_id(i) not in val_ids
+        s = guarded[0]
+        assert "image" in s and "gt" in s
+
+    def test_mixed_schema_rejected(self, fake_voc_root):
+        # instance samples carry void_pixels; semantic ones don't — collate
+        # can't batch the mix, so construction must fail fast.
+        import pytest
+        from distributedpytorch_tpu.data import (
+            CombinedDataset, VOCInstanceSegmentation, VOCSemanticSegmentation)
+        inst = VOCInstanceSegmentation(fake_voc_root, split="train")
+        sem = VOCSemanticSegmentation(fake_voc_root, split="train")
+        with pytest.raises(ValueError, match="schemas"):
+            CombinedDataset([inst, sem])
+        both = CombinedDataset([inst, sem], allow_mixed_schemas=True)
+        assert len(both) == len(inst) + len(sem)
+        assert str(both).startswith("Combined(")

@@ -2,6 +2,8 @@
 
 import os
 
+import pytest
+
 import jax.numpy as jnp
 
 from distributedpytorch_tpu.utils import StepTimer, annotate, trace
@@ -39,3 +41,18 @@ class TestTrace:
         with trace(d):
             jnp.ones((8, 8)).sum().block_until_ready()
         assert os.path.isdir(d) and len(os.listdir(d)) > 0
+
+
+class TestThroughput:
+    def test_counts_and_rate(self):
+        from distributedpytorch_tpu.utils.profiling import throughput
+        calls = []
+
+        def step():
+            calls.append(1)
+            return jnp.ones((2, 2)).sum()
+
+        s = throughput(step, steps=3, warmup=2, items_per_step=4)
+        assert len(calls) == 5  # warmup excluded from timing, included in calls
+        assert s["steps"] == 3 and s["total_s"] > 0
+        assert s["items_per_sec"] == pytest.approx(12 / s["total_s"])
