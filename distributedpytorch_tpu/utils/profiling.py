@@ -8,10 +8,11 @@ train_pascal.py:12,181,307-308) — no profiler, no NVTX, no per-step numbers
 * :func:`trace` — context manager around ``jax.profiler`` writing a
   TensorBoard-loadable XPlane trace (op-level device timeline, HBM usage,
   fusion view) for any code region;
-* :class:`StepTimer` — steady-state step timing that understands JAX's async
-  dispatch: it calls ``block_until_ready`` on a representative output before
-  reading the clock, so it measures device time rather than dispatch time,
-  and it skips warmup steps so compile time never pollutes the numbers;
+* :class:`StepTimer` — per-step *latency* timing (block on a representative
+  output, read the clock, skip warmup).  Measures launch + sync round-trip,
+  which is the right number for interactive latency but NOT for throughput —
+  on remote-tunneled devices ``block_until_ready`` can even be a no-op, so
+  for throughput always use :func:`throughput` instead;
 * :func:`annotate` — named ``TraceAnnotation`` regions that show up inside
   the device trace (host-side markers).
 """

@@ -198,3 +198,26 @@ class TestBf16Config:
         assert np.isfinite(hist["train_loss"][0])
         assert 0.0 <= hist["val"][-1]["jaccard"] <= 1.0
         tr.close()
+
+
+class TestValPanels:
+    """First-val-batch figure (reference train_pascal.py:257-278)."""
+
+    def test_panels_from_evaluate_record(self, tiny_cfg):
+        import matplotlib
+        matplotlib.use("Agg", force=True)
+        from distributedpytorch_tpu.train import evaluate, make_val_panels
+
+        tr = Trainer(dataclasses.replace(tiny_cfg, epochs=1))
+        with tr.mesh:
+            metrics = evaluate(tr.eval_step, tr.state, tr.val_loader,
+                               relax=tiny_cfg.data.relax, mesh=tr.mesh,
+                               max_batches=1)
+        first = metrics["_first_batch"]
+        assert first is not None
+        fig = make_val_panels(first, max_samples=2)
+        # one row per sample, 4 panels: image+gt, fused, pam, cam
+        assert len(fig.axes) % 4 == 0 and len(fig.axes) > 0
+        import matplotlib.pyplot as plt
+        plt.close(fig)
+        tr.close()
