@@ -66,7 +66,7 @@ def main() -> None:
 
     with mesh:
         state = create_train_state(jax.random.PRNGKey(0), model, tx,
-                                   (1, SIZE, SIZE, 4))
+                                   (1, SIZE, SIZE, 4), mesh=mesh)
         step = make_train_step(model, tx, mesh=mesh)
         batch = shard_batch(mesh, host_batch)
 

@@ -71,23 +71,37 @@ def create_train_state(
     model,
     tx: optax.GradientTransformation,
     input_shape: tuple[int, ...],
+    mesh=None,
 ) -> TrainState:
     """Initialize params/batch-stats with a dummy batch and wrap with the
     optimizer state.  ``input_shape`` is (N, H, W, C) — NHWC, the TPU-native
     layout (the reference's NCHW ``ToTensor`` transpose has no analogue
-    here; conv layouts are XLA's concern)."""
+    here; conv layouts are XLA's concern).
+
+    With ``mesh``, every leaf is created directly as a *global* replicated
+    array.  Multi-host this is required: a host-local single-device array is
+    neither a valid input to the replicated-sharded train step nor
+    serializable by Orbax's coordinated save.
+    """
     init_rng, state_rng = jax.random.split(rng)
-    variables = model.init(init_rng, jnp.zeros(input_shape, jnp.float32),
-                           train=False)
-    params = unfreeze(variables["params"])
-    batch_stats = unfreeze(variables.get("batch_stats", {}))
-    return TrainState(
-        step=jnp.zeros((), jnp.int32),
-        params=params,
-        batch_stats=batch_stats,
-        opt_state=tx.init(params),
-        rng=state_rng,
-    )
+
+    def make_state():
+        variables = model.init(init_rng, jnp.zeros(input_shape, jnp.float32),
+                               train=False)
+        params = unfreeze(variables["params"])
+        batch_stats = unfreeze(variables.get("batch_stats", {}))
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+            rng=state_rng,
+        )
+
+    if mesh is None:
+        return make_state()
+    return jax.jit(make_state,
+                   out_shardings=mesh_lib.replicated_sharding(mesh))()
 
 
 def _compute_loss(outputs, batch: Batch, weights, loss_type: str):

@@ -29,17 +29,33 @@ import orbax.checkpoint as ocp
 from ..parallel import TrainState
 
 
-def next_run_dir(work_dir: str, resume_run: int | None = None) -> str:
-    """``work_dir/run_<N>`` with N = 1 + max existing (or the pinned resume
-    run — the reference pinned ``run_0`` when resuming, train_pascal.py:78)."""
-    if resume_run is not None:
-        path = os.path.join(work_dir, f"run_{resume_run}")
-        os.makedirs(path, exist_ok=True)
-        return path
+def next_run_index(work_dir: str) -> int:
+    """1 + the highest existing ``run_<N>`` under ``work_dir`` (0 if none)."""
     runs = glob.glob(os.path.join(work_dir, "run_*"))
     ids = [int(m.group(1)) for r in runs
            if (m := re.search(r"run_(\d+)$", r))]
-    nxt = max(ids) + 1 if ids else 0
+    return max(ids) + 1 if ids else 0
+
+
+def next_run_dir(work_dir: str, resume_run: int | None = None) -> str:
+    """``work_dir/run_<N>`` with N = 1 + max existing (or the pinned resume
+    run — the reference pinned ``run_0`` when resuming, train_pascal.py:78).
+
+    Multi-process: every process must use the SAME run dir (Orbax's
+    multihost save coordinates on one path, and on a shared filesystem the
+    auto-increment would race), so process 0 picks the index and broadcasts
+    it.  Requires ``jax.distributed`` to be initialized first — true by the
+    time a multi-host ``Trainer`` constructs.
+    """
+    if resume_run is not None:
+        nxt = resume_run
+    elif jax.process_count() > 1:
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        local = next_run_index(work_dir) if jax.process_index() == 0 else 0
+        nxt = int(multihost_utils.broadcast_one_to_all(jnp.int32(local)))
+    else:
+        nxt = next_run_index(work_dir)
     path = os.path.join(work_dir, f"run_{nxt}")
     os.makedirs(path, exist_ok=True)
     return path

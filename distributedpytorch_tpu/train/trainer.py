@@ -121,16 +121,37 @@ class Trainer:
         else:
             raise ValueError(
                 f"unknown task: {cfg.task!r} (instance | semantic)")
+        # Batch sizes are GLOBAL (the reference's trainBatch=16 spans its 4
+        # GPUs; BASELINE speaks of global batches); each host's loader feeds
+        # its 1/process_count share, which shard_batch assembles into the
+        # global array.  The global batch must divide cleanly over BOTH the
+        # process count and the mesh data axis (and accum micro-batches) —
+        # catching it here beats an opaque uneven-sharding error at step 1.
+        n_proc = jax.process_count()
+        data_axis = self.mesh.devices.shape[0]
+        tb = cfg.data.train_batch
+        if tb % n_proc:
+            raise ValueError(f"global train batch {tb} not divisible by "
+                             f"{n_proc} processes")
+        if tb % (data_axis * cfg.optim.accum_steps):
+            raise ValueError(
+                f"global train batch {tb} not divisible by data axis "
+                f"{data_axis} x accum_steps {cfg.optim.accum_steps}")
+        vb_host = max(1, -(-cfg.data.val_batch // n_proc))  # ceil, >= 1
+        if self.is_main and vb_host * n_proc != cfg.data.val_batch:
+            print(f"note: global val batch rounded "
+                  f"{cfg.data.val_batch} -> {vb_host * n_proc} "
+                  f"({vb_host}/host x {n_proc} hosts)", flush=True)
         self.train_loader = DataLoader(
-            self.train_set, cfg.data.train_batch, shuffle=True,
+            self.train_set, tb // n_proc, shuffle=True,
             drop_last=True, seed=cfg.seed, num_workers=cfg.data.num_workers,
             prefetch=cfg.data.prefetch,
-            num_shards=jax.process_count(), shard_index=jax.process_index())
+            num_shards=n_proc, shard_index=jax.process_index())
         self.val_loader = DataLoader(
-            self.val_set, cfg.data.val_batch, shuffle=False, drop_last=False,
+            self.val_set, vb_host, shuffle=False, drop_last=False,
             seed=cfg.seed, num_workers=cfg.data.num_workers,
             prefetch=cfg.data.prefetch,
-            num_shards=jax.process_count(), shard_index=jax.process_index())
+            num_shards=n_proc, shard_index=jax.process_index())
 
         # --- model / optimizer / state
         self.model = build_model(
@@ -145,7 +166,7 @@ class Trainer:
         with self.mesh:
             self.state = create_train_state(
                 jax.random.PRNGKey(cfg.seed), self.model, self.tx,
-                (1, h, w, cfg.model.in_channels))
+                (1, h, w, cfg.model.in_channels), mesh=self.mesh)
         loss_type = ("multi_softmax" if cfg.task == "semantic"
                      else "multi_sigmoid")
         self.train_step = make_train_step(

@@ -1,0 +1,74 @@
+"""Worker for the 2-process multi-host integration test.
+
+Launched (twice) by tests/test_multihost.py with PROC_ID / NUM_PROCS /
+COORD_ADDR / WORK_DIR / DATA_ROOT in the environment.  Each process gets 4
+virtual CPU devices; ``jax.distributed.initialize`` joins them into one
+8-device 2-host system — the same code path a real TPU pod takes (per-host
+loader shards, ``make_array_from_process_local_data``, GSPMD collectives
+across hosts, cross-process metric reduction, coordinated Orbax saves).
+
+Prints one MULTIHOST_RESULT json line the parent asserts on.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    proc_id = int(os.environ["PROC_ID"])
+    num_procs = int(os.environ["NUM_PROCS"])
+    jax.distributed.initialize(
+        coordinator_address=os.environ["COORD_ADDR"],
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    assert jax.process_count() == num_procs
+    assert jax.device_count() == 4 * num_procs
+
+    import dataclasses
+
+    from distributedpytorch_tpu.train import Config, Trainer, apply_overrides
+
+    cfg = apply_overrides(Config(), [
+        "data.train_batch=8", "data.val_batch=2", "data.crop_size=[48,48]",
+        "data.relax=8", "data.area_thres=0", "data.num_workers=2",
+        "model.backbone=resnet18", "model.output_stride=8",
+        "optim.lr=1e-4", "checkpoint.async_save=false",
+        "epochs=1", "eval_every=1", "log_every_steps=1",
+    ])
+    cfg = dataclasses.replace(
+        cfg, work_dir=os.environ["WORK_DIR"],
+        data=dataclasses.replace(cfg.data, root=os.environ["DATA_ROOT"]))
+
+    trainer = Trainer(cfg)
+    history = trainer.fit()
+    metrics = history["val"][-1]
+    result = {
+        "proc": proc_id,
+        "run_dir": trainer.run_dir,
+        "n_local_devices": jax.local_device_count(),
+        "train_loss": round(float(history["train_loss"][0]), 8),
+        "jaccard": round(float(metrics["jaccard"]), 8),
+        "n_samples": metrics["n_samples"],
+        "ckpt_step": trainer.ckpt.latest_step(),
+        "train_batches": len(trainer.train_loader),
+    }
+    trainer.close()
+    print("MULTIHOST_RESULT " + json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()
