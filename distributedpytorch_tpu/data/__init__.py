@@ -3,6 +3,7 @@
 from . import guidance, transforms
 from .combine import CombinedDataset
 from .fake import make_fake_voc
+from .grain_pipeline import HAVE_GRAIN, make_grain_loader
 from .pipeline import (
     DataLoader,
     build_eval_transform,
@@ -23,6 +24,7 @@ __all__ = [
     "DataLoader",
     "VOCInstanceSegmentation",
     "VOCSemanticSegmentation",
+    "HAVE_GRAIN",
     "build_eval_transform",
     "build_semantic_eval_transform",
     "build_semantic_train_transform",
@@ -30,5 +32,6 @@ __all__ = [
     "collate",
     "guidance",
     "make_fake_voc",
+    "make_grain_loader",
     "transforms",
 ]

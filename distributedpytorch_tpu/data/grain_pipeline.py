@@ -1,0 +1,135 @@
+"""Grain-backed input pipeline — the north-star loader.
+
+``BASELINE.json``'s north star phrases the data story as "pascal.py and
+custom_transforms.py become a Grain input pipeline".  :mod:`.pipeline`'s
+``DataLoader`` is the framework's self-contained equivalent (threads +
+bounded prefetch + per-host shards); this module provides the same batches
+through `grain` proper, for deployments that want Grain's process-based
+workers, backpressure and checkpointable iterators:
+
+* the dataset (any random-access source from :mod:`.voc` / :mod:`.combine`)
+  is wrapped as a ``grain.RandomAccessDataSource``;
+* the transform chain runs inside a ``grain.MapTransform`` with the same
+  explicit per-sample RNG policy as ``DataLoader`` (``default_rng((seed,
+  epoch, index))`` — reproducible regardless of worker count);
+* sharding uses ``grain.ShardOptions(shard_index, shard_count)`` — the
+  per-host split the reference's DDP checklist called a "distributed
+  sampler" (reference train_pascal.py:3);
+* batches come out as the same dict-of-stacked-arrays ``collate`` produces,
+  so ``parallel.shard_batch`` and the evaluator consume either loader
+  interchangeably *single-host*.  Differences to know: multi-host sharding
+  drops the tail remainder for equal shard lengths (``DataLoader``
+  wrap-pads instead, so prefer it for multi-host *eval* where every sample
+  must be scored), and shuffle orders differ between the two loaders
+  (sample-level RNG is identical; batch order parity holds with
+  ``shuffle=False``).
+
+The transform is attached to the *loader*, not the dataset: pass a
+transform-free dataset here.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+try:
+    import grain.python as grain
+    HAVE_GRAIN = True
+except ImportError:  # pragma: no cover - grain is optional
+    grain = None
+    HAVE_GRAIN = False
+
+from .pipeline import collate
+
+
+class _Source:
+    """Random-access view of a dataset, transform applied per record with
+    the (seed, epoch, index)-derived RNG."""
+
+    def __init__(self, dataset, transform, seed: int, epoch: int = 0):
+        if transform is not None and getattr(dataset, "transform", None):
+            raise ValueError(
+                "dataset already has a transform; pass a transform-free "
+                "dataset to make_grain_loader (it would be applied twice)")
+        self.dataset = dataset
+        self.transform = transform
+        self.seed = seed
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def __getitem__(self, index: int) -> dict:
+        rng = np.random.default_rng((self.seed, self.epoch, int(index)))
+        sample = self.dataset.__getitem__(int(index), rng=rng)
+        if self.transform is not None:
+            sample = self.transform(sample, rng)
+        return sample
+
+
+class _CollateBatches:
+    """Grain legacy-Operation batching through our own :func:`collate` —
+    unlike ``grain.Batch`` (tree-map ``np.stack``) it handles the sample
+    dicts' ragged entries (per-image full-res ``gt``, ``meta`` dicts) by
+    keeping them as lists, so the *eval* pipeline works too."""
+
+    def __init__(self, batch_size: int, drop_remainder: bool):
+        self.batch_size = batch_size
+        self.drop_remainder = drop_remainder
+
+    def __call__(self, records):
+        buf, meta = [], None
+        for record in records:
+            buf.append(record.data)
+            meta = record.metadata
+            if len(buf) == self.batch_size:
+                yield grain.Record(metadata=meta, data=collate(buf))
+                buf, meta = [], None
+        if buf and not self.drop_remainder:
+            yield grain.Record(metadata=meta, data=collate(buf))
+
+
+def make_grain_loader(
+    dataset,
+    batch_size: int,
+    transform=None,
+    shuffle: bool = False,
+    drop_last: bool = False,
+    seed: int = 0,
+    epoch: int = 0,
+    num_workers: int = 0,
+    shard_index: int = 0,
+    num_shards: int = 1,
+):
+    """A ``grain.DataLoader`` yielding the same dict batches as
+    ``pipeline.DataLoader``.
+
+    ``num_workers=0`` runs in-process (deterministic, test-friendly);
+    ``> 0`` uses Grain's child processes.  Re-create the loader per epoch
+    (or use distinct ``epoch`` values) to reproduce ``DataLoader``'s
+    epoch-keyed sample RNG.
+    """
+    if not HAVE_GRAIN:
+        raise ImportError("grain is not installed; use data.DataLoader")
+    source = _Source(dataset, transform, seed, epoch)
+    # Mix (seed, epoch) collision-free — naive seed+epoch would give
+    # (7, epoch 1) and (8, epoch 0) identical shuffles.
+    shuffle_seed = int(np.random.SeedSequence([seed, epoch])
+                       .generate_state(1)[0]) & 0x7FFFFFFF
+    sampler = grain.IndexSampler(
+        num_records=len(source),
+        shuffle=shuffle,
+        seed=shuffle_seed,
+        shard_options=grain.ShardOptions(
+            shard_index=shard_index, shard_count=num_shards,
+            drop_remainder=num_shards > 1),
+        num_epochs=1,
+    )
+    return grain.DataLoader(
+        data_source=source,
+        sampler=sampler,
+        operations=[_CollateBatches(batch_size, drop_remainder=drop_last)],
+        worker_count=num_workers,
+    )

@@ -7,6 +7,7 @@ re-expressed as XLA-compiled jnp (with Pallas variants for the hot attention
 path).
 """
 
+from . import augment
 from .attention import (
     position_attention,
     blocked_position_attention,
@@ -27,6 +28,7 @@ from .metrics import (
 )
 
 __all__ = [
+    "augment",
     "position_attention",
     "blocked_position_attention",
     "channel_attention",

@@ -1,0 +1,139 @@
+"""On-device batch augmentation — jit-able, fused into the train step.
+
+The reference did all augmentation on CPU in loader workers
+(custom_transforms.py via cv2).  The geometry-heavy, mask-dependent parts
+(crop-from-mask, extreme points, n-ellipse) stay host-side here too (dynamic
+shapes, SURVEY §7 hard parts) — but the *fixed-shape* augmentations can run
+on device inside the compiled step, where they are effectively free (fused
+into the first conv's input read) and save host CPU for decoding:
+
+* :func:`random_hflip` — per-sample coin-flip horizontal mirror;
+* :func:`random_crop` — static-size random window (pad-then-crop jitter);
+* :func:`normalize` — channel mean/std normalization (the [0,255]->net-input
+  scaling the reference folded into its external model);
+* :func:`make_device_augment` — composes them into an
+  ``(batch, rng) -> batch`` fn accepted by ``make_train_step(augment=...)``.
+
+All take NHWC batches and a PRNG key; per-sample randomness comes from
+splitting the key over the batch dim.  Label-coupled ops transform ``concat``
+and ``crop_gt``/``crop_void`` consistently.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Batch = Mapping[str, jax.Array]
+
+#: keys flipped/cropped together (input + label + void must stay aligned)
+_SPATIAL_KEYS = ("concat", "crop_gt", "crop_void")
+
+
+def _spatial(batch: Batch) -> list[str]:
+    return [k for k in _SPATIAL_KEYS if k in batch]
+
+
+def random_hflip(batch: Batch, rng: jax.Array, p: float = 0.5) -> dict:
+    """Mirror each sample left-right with probability ``p`` — the device
+    form of transforms.RandomHorizontalFlip (same coin per sample across
+    input/label/void)."""
+    keys = _spatial(batch)
+    n = batch[keys[0]].shape[0]
+    coins = jax.random.uniform(rng, (n,)) < p
+    out = dict(batch)
+    for k in keys:
+        v = batch[k]
+        flipped = jnp.flip(v, axis=2 if v.ndim >= 3 else 1)
+        shape = (n,) + (1,) * (v.ndim - 1)
+        out[k] = jnp.where(coins.reshape(shape), flipped, v)
+    return out
+
+
+def random_crop(batch: Batch, rng: jax.Array, pad: int = 16) -> dict:
+    """Translation jitter: reflect-pad by ``pad`` then take a random
+    same-size window per sample.  Static output shapes (XLA-friendly);
+    label/void crop with the same offsets."""
+    keys = _spatial(batch)
+    n, h, w = batch[keys[0]].shape[:3]
+    oy = jax.random.randint(rng, (n,), 0, 2 * pad + 1)
+    ox = jax.random.randint(jax.random.fold_in(rng, 1), (n,), 0, 2 * pad + 1)
+    out = dict(batch)
+    for k in keys:
+        v = batch[k]
+        squeeze = v.ndim == 3
+        if squeeze:
+            v = v[..., None]
+        pw = ((0, 0), (pad, pad), (pad, pad), (0, 0))
+        vp = jnp.pad(v, pw, mode="reflect")
+
+        def crop_one(img, y, x):
+            return jax.lax.dynamic_slice(
+                img, (y, x, 0), (h, w, img.shape[-1]))
+
+        cropped = jax.vmap(crop_one)(vp, oy, ox)
+        out[k] = cropped[..., 0] if squeeze else cropped
+    return out
+
+
+def normalize(batch: Batch,
+              mean: Sequence[float] = (0.0,),
+              std: Sequence[float] = (255.0,)) -> dict:
+    """Channel-wise ``(x - mean) / std`` on the input only."""
+    out = dict(batch)
+    x = batch["concat"]
+    m = jnp.asarray(mean, x.dtype)
+    s = jnp.asarray(std, x.dtype)
+    out["concat"] = (x - m) / s
+    return out
+
+
+def make_preprocess(
+    mean: Sequence[float] = (0.0,),
+    std: Sequence[float] = (255.0,),
+) -> Callable[[Batch], dict]:
+    """Deterministic input preprocessing, shared by train AND eval.
+
+    Normalization must be identical on both paths — pass the result to
+    ``make_eval_step(preprocess=...)`` whenever the train augment includes
+    mean/std, or validation runs on out-of-distribution inputs and the
+    best-checkpoint gate is corrupted silently.
+    """
+
+    def preprocess(batch: Batch) -> dict:
+        return normalize(batch, mean, std)
+
+    return preprocess
+
+
+def make_device_augment(
+    hflip: bool = True,
+    crop_pad: int = 0,
+    mean: Sequence[float] | None = None,
+    std: Sequence[float] | None = None,
+) -> Callable[[Batch, jax.Array], dict]:
+    """Compose the enabled stages into one ``(batch, rng) -> batch`` fn for
+    ``make_train_step(augment=...)``.  Everything traces into the same XLA
+    program as the forward pass.
+
+    If ``mean``/``std`` are given, ALSO pass
+    ``make_preprocess(mean, std)`` to ``make_eval_step`` — see
+    :func:`make_preprocess`.  Omitted ``std`` defaults to 255 (the
+    documented [0,255] -> net-input scaling), matching :func:`normalize`.
+    """
+
+    def augment(batch: Batch, rng: jax.Array) -> dict:
+        b = dict(batch)
+        r1, r2 = jax.random.split(rng)
+        if hflip:
+            b = random_hflip(b, r1)
+        if crop_pad:
+            b = random_crop(b, r2, pad=crop_pad)
+        if mean is not None or std is not None:
+            b = normalize(b, mean if mean is not None else (0.0,),
+                          std if std is not None else (255.0,))
+        return b
+
+    return augment

@@ -161,6 +161,7 @@ def make_train_step(
     mesh=None,
     donate: bool = True,
     loss_type: str = "multi_sigmoid",
+    augment: Callable[[Batch, jax.Array], Batch] | None = None,
 ) -> Callable[[TrainState, Batch], tuple[TrainState, jax.Array]]:
     """Build the jitted ``(state, batch) -> (state, loss)`` train step.
 
@@ -168,6 +169,10 @@ def make_train_step(
     micro-batches and scanned, averaging gradients — BASELINE.md config 5's
     "grad-accum to global batch 256" path.  The micro-batch dim stays sharded
     over ``data``, so each scan iteration is itself data-parallel.
+
+    ``augment`` is an optional on-device ``(batch, rng) -> batch`` stage
+    (see ops.augment) traced into the same program — flip/crop/normalize
+    fuse into the forward pass and cost ~nothing.
     """
 
     def grads_of(params, batch_stats, batch, rng):
@@ -181,6 +186,9 @@ def make_train_step(
 
     def step_fn(state: TrainState, batch: Batch):
         rng, new_rng = jax.random.split(state.rng)
+        if augment is not None:
+            rng, aug_rng = jax.random.split(rng)
+            batch = augment(batch, aug_rng)
         if accum_steps == 1:
             loss, new_stats, grads = grads_of(
                 state.params, state.batch_stats, batch, rng)
@@ -231,13 +239,16 @@ def make_train_step(
 
 
 def make_eval_step(model, loss_weights: tuple[float, ...] | None = None,
-                   mesh=None, loss_type: str = "multi_sigmoid"):
+                   mesh=None, loss_type: str = "multi_sigmoid",
+                   preprocess: Callable[[Batch], Batch] | None = None):
     """Jitted ``(state, batch) -> (outputs, loss)`` inference step
     (reference val loop body, train_pascal.py:245-254).  Outputs are the
     model's logit tuple; sigmoid/thresholding happen in the evaluator, which
     needs probabilities host-side for the full-res paste-back anyway."""
 
     def step_fn(state: TrainState, batch: Batch):
+        if preprocess is not None:  # must mirror the train augment's
+            batch = preprocess(batch)  # deterministic normalization
         variables = {"params": state.params,
                      "batch_stats": state.batch_stats}
         outputs = model.apply(variables, batch[INPUT_KEY], train=False)
