@@ -20,9 +20,12 @@ workers, backpressure and checkpointable iterators:
   interchangeably *single-host*.  Differences to know: multi-host sharding
   drops the tail remainder for equal shard lengths (``DataLoader``
   wrap-pads instead, so prefer it for multi-host *eval* where every sample
-  must be scored), and shuffle orders differ between the two loaders
-  (sample-level RNG is identical; batch order parity holds with
-  ``shuffle=False``).
+  must be scored); shuffle orders differ between the two loaders; and with
+  ``num_workers > 0`` grain batches inside each worker over its every-Nth
+  record slice, so batch *composition* differs from ``num_workers=0`` (and
+  ``drop_last`` drops one remainder per worker).  Exact batch parity with
+  ``DataLoader`` holds for ``shuffle=False, num_workers=0``; per-sample
+  contents are bit-identical in every configuration.
 
 The transform is attached to the *loader*, not the dataset: pass a
 transform-free dataset here.
@@ -41,7 +44,7 @@ except ImportError:  # pragma: no cover - grain is optional
     grain = None
     HAVE_GRAIN = False
 
-from .pipeline import collate
+from .pipeline import collate, sample_rng
 
 
 class _Source:
@@ -62,7 +65,7 @@ class _Source:
         return len(self.dataset)
 
     def __getitem__(self, index: int) -> dict:
-        rng = np.random.default_rng((self.seed, self.epoch, int(index)))
+        rng = sample_rng(self.seed, self.epoch, index)
         sample = self.dataset.__getitem__(int(index), rng=rng)
         if self.transform is not None:
             sample = self.transform(sample, rng)
@@ -113,6 +116,12 @@ def make_grain_loader(
     """
     if not HAVE_GRAIN:
         raise ImportError("grain is not installed; use data.DataLoader")
+    if num_workers > 0 and drop_last:
+        import warnings
+        warnings.warn(
+            "grain batches inside each worker: drop_last discards up to "
+            "num_workers*(batch_size-1) samples per epoch (vs batch_size-1 "
+            "at num_workers=0)", stacklevel=2)
     source = _Source(dataset, transform, seed, epoch)
     # Mix (seed, epoch) collision-free — naive seed+epoch would give
     # (7, epoch 1) and (8, epoch 0) identical shuffles.

@@ -147,6 +147,15 @@ def build_semantic_eval_transform(
 _NO_STACK_KEYS = ("meta", "id", "crop_relax")
 
 
+def sample_rng(seed: int, epoch: int, index: int) -> np.random.Generator:
+    """THE per-sample RNG policy: ``default_rng((seed, epoch, index))``.
+
+    Single source of truth — both this module's ``DataLoader`` and the
+    grain loader derive sample randomness here, which is what makes their
+    samples bit-identical regardless of worker/host count."""
+    return np.random.default_rng((seed, epoch, int(index)))
+
+
 def collate(samples: Sequence[dict]) -> dict:
     """Stack a list of dict samples into a dict batch.
 
@@ -235,7 +244,7 @@ class DataLoader:
         return self._num_batches(len(self._epoch_indices()))
 
     def _load_one(self, index: int) -> dict:
-        rng = np.random.default_rng((self.seed, self.epoch, int(index)))
+        rng = sample_rng(self.seed, self.epoch, index)
         return self.dataset.__getitem__(int(index), rng=rng)
 
     def __iter__(self) -> Iterator[dict]:

@@ -1,7 +1,7 @@
 """Utilities: array helpers, logging, debug checks, profiling."""
 
-from . import helpers, profiling
+from . import helpers, profiling, torch_interop
 from .profiling import StepTimer, annotate, throughput, trace
 
 __all__ = ["StepTimer", "annotate", "helpers", "profiling", "throughput",
-           "trace"]
+           "torch_interop", "trace"]

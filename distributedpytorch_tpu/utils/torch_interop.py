@@ -1,0 +1,159 @@
+"""PyTorch checkpoint interop: state_dict <-> flax param trees.
+
+The reference's training always warm-started from a torch checkpoint
+(``torch.load('danet_1e-7_91.3.pth')``, reference train_pascal.py:103) and
+saved ``state_dict`` files its users have accumulated.  This module is the
+migration path: convert between torch ``state_dict`` tensors and this
+framework's ``(params, batch_stats)`` trees, handling the layout conventions
+that differ:
+
+| tensor              | torch               | flax/here            |
+|---------------------|---------------------|----------------------|
+| conv kernel         | (O, I, kH, kW)      | (kH, kW, I, O)       |
+| linear kernel       | (out, in)           | (in, out)            |
+| batchnorm scale     | ``weight``          | ``scale``            |
+| batchnorm stats     | ``running_mean/var``| batch_stats mean/var |
+
+Keys are this framework's own flattened paths (slashes -> dots), e.g.
+``head.pam.query.kernel``.  Checkpoints with other naming (torchvision,
+PyTorch-Encoding) are bridged with a ``rename`` callable that maps their
+keys onto ours — naming is the checkpoint owner's 10-line dictionary; the
+layout/transpose work (the error-prone part) lives here.
+
+No torch import is required for the conversion itself — state_dicts are
+treated as mappings of numpy-convertible arrays; :func:`load_torch_file`
+wraps ``torch.load`` for actual ``.pth`` files.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+import numpy as np
+from flax.traverse_util import flatten_dict, unflatten_dict
+
+#: flax leaf -> torch suffix.  Both conv/dense ``kernel`` and batchnorm
+#: ``scale`` become torch ``weight`` — no collision, a module has one or
+#: the other at a given path.
+_PARAM_SUFFIX = {"kernel": "weight", "scale": "weight", "bias": "bias"}
+_STATS_SUFFIX = {"mean": "running_mean", "var": "running_var"}
+
+
+def _to_torch_layout(path: tuple[str, ...], arr: np.ndarray) -> np.ndarray:
+    leaf = path[-1]
+    if leaf == "kernel":
+        if arr.ndim == 4:                       # conv HWIO -> OIHW
+            return np.transpose(arr, (3, 2, 0, 1))
+        if arr.ndim == 2:                       # dense (in,out) -> (out,in)
+            return arr.T
+    return arr
+
+
+def _from_torch_layout(path: tuple[str, ...], arr: np.ndarray,
+                       like: np.ndarray) -> np.ndarray:
+    leaf = path[-1]
+    if leaf == "kernel":
+        if like.ndim == 4:
+            arr = np.transpose(arr, (2, 3, 1, 0))   # OIHW -> HWIO
+        elif like.ndim == 2:
+            arr = arr.T
+    if arr.shape != like.shape:
+        raise ValueError(
+            f"shape mismatch at {'.'.join(path)}: checkpoint "
+            f"{arr.shape} vs model {like.shape}")
+    return arr.astype(like.dtype)
+
+
+def _torch_key(path: tuple[str, ...], is_stats: bool) -> str:
+    *mods, leaf = path
+    suffix = _STATS_SUFFIX if is_stats else _PARAM_SUFFIX
+    return ".".join((*mods, suffix.get(leaf, leaf)))
+
+
+def params_to_torch_state_dict(params, batch_stats=None) -> dict:
+    """Export ``(params, batch_stats)`` as a torch-convention state_dict
+    (numpy arrays; pass through ``torch.tensor`` to save with torch)."""
+    out: dict[str, np.ndarray] = {}
+    for path, arr in flatten_dict(params).items():
+        out[_torch_key(path, False)] = _to_torch_layout(
+            path, np.asarray(arr))
+    for path, arr in flatten_dict(batch_stats or {}).items():
+        out[_torch_key(path, True)] = np.asarray(arr)
+    return out
+
+
+def torch_state_dict_to_params(
+    state_dict: Mapping[str, np.ndarray],
+    params_template,
+    batch_stats_template=None,
+    rename: Callable[[str], str | None] | None = None,
+    allow_missing: bool = False,
+    allow_unused: bool = False,
+):
+    """Import a torch state_dict into ``(params, batch_stats)`` trees shaped
+    like the templates (e.g. from ``model.init``).
+
+    ``rename`` maps checkpoint keys to this framework's keys (return None to
+    drop a key — classifier heads, num_batches_tracked, ...).  Two
+    *independent* escape hatches (deliberately not one flag — a rename typo
+    shows up as BOTH a missing leaf and an unused key, and partial warm
+    starts must not mask it):
+
+    * ``allow_missing`` — template leaves absent from the checkpoint keep
+      their template values (the partial warm start);
+    * ``allow_unused`` — checkpoint keys matching no template leaf are
+      ignored instead of raising.
+    """
+    available: dict[str, np.ndarray] = {}
+    for k, v in state_dict.items():
+        k2 = rename(k) if rename else k
+        if k2 is not None:
+            available[k2] = np.asarray(v)
+
+    used = set()
+
+    def fill(template, is_stats: bool):
+        flat = flatten_dict(template)
+        out = {}
+        for path, like in flat.items():
+            key = _torch_key(path, is_stats)
+            if key in available:
+                out[path] = _from_torch_layout(path, available[key],
+                                               np.asarray(like))
+                used.add(key)
+            elif allow_missing:
+                out[path] = like
+            else:
+                raise KeyError(
+                    f"checkpoint missing {key!r} (template leaf "
+                    f"{'.'.join(path)}); pass allow_missing=True for a "
+                    "partial warm start")
+        return unflatten_dict(out)
+
+    new_params = fill(params_template, False)
+    new_stats = (fill(batch_stats_template, True)
+                 if batch_stats_template is not None else None)
+    leftovers = set(available) - used
+    if leftovers and not allow_unused:
+        raise KeyError(f"checkpoint keys unmatched by the model: "
+                       f"{sorted(leftovers)[:8]}{'...' if len(leftovers) > 8 else ''}")
+    return (new_params, new_stats) if new_stats is not None else new_params
+
+
+def load_torch_file(path: str) -> dict:
+    """``torch.load`` a ``.pth`` into a numpy state_dict (CPU, weights only;
+    strips a ``module.`` DataParallel prefix — the reference wrapped its net
+    in ``nn.DataParallel`` before saving, train_pascal.py:92,301-304)."""
+    import torch
+
+    raw = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(raw, dict) and "state_dict" in raw:
+        raw = raw["state_dict"]
+    out = {}
+    for k, v in raw.items():
+        if k.startswith("module."):
+            k = k[len("module."):]
+        if k.endswith("num_batches_tracked"):
+            continue
+        out[k] = v.detach().numpy() if hasattr(v, "detach") else np.asarray(v)
+    return out

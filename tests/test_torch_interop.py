@@ -1,0 +1,161 @@
+"""Torch checkpoint interop: layout conversions, round-trip, .pth loading.
+
+The forward-parity test is the load-bearing one: it runs the SAME weights
+through a real ``torch.nn`` Conv+BN+Linear stack and our flax modules and
+requires matching outputs — catching any transpose-convention mistake that
+a pure round-trip test would cancel out.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.models import DANet
+from distributedpytorch_tpu.utils.torch_interop import (
+    load_torch_file,
+    params_to_torch_state_dict,
+    torch_state_dict_to_params,
+)
+
+torch = pytest.importorskip("torch")
+
+
+class TestRoundTrip:
+    def test_danet_full_roundtrip(self):
+        m = DANet(nclass=1, backbone_depth=18, output_stride=8)
+        vs = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 4)),
+                    train=False)
+        sd = params_to_torch_state_dict(vs["params"], vs["batch_stats"])
+        assert all(isinstance(v, np.ndarray) for v in sd.values())
+        # conv kernels exported OIHW
+        k = sd["head.pam_in_conv.weight"]
+        assert k.shape[2:] == (3, 3)
+        params2, stats2 = torch_state_dict_to_params(
+            sd, vs["params"], vs["batch_stats"])
+        for a, b in zip(jax.tree.leaves(vs["params"]),
+                        jax.tree.leaves(params2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(vs["batch_stats"]),
+                        jax.tree.leaves(stats2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_missing_key_raises_unless_allowed(self):
+        m = DANet(nclass=1, backbone_depth=18, output_stride=8)
+        vs = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 4)),
+                    train=False)
+        sd = params_to_torch_state_dict(vs["params"], vs["batch_stats"])
+        key = next(iter(sd))
+        sd2 = {k: v for k, v in sd.items() if k != key}
+        with pytest.raises(KeyError):
+            torch_state_dict_to_params(sd2, vs["params"], vs["batch_stats"])
+        p, s = torch_state_dict_to_params(sd2, vs["params"],
+                                          vs["batch_stats"],
+                                          allow_missing=True)
+        assert p is not None and s is not None
+
+
+class TestForwardParity:
+    """Same weights, torch vs flax forward — validates the transposes."""
+
+    def test_conv_bn_linear(self):
+        import flax.linen as nn
+
+        class FlaxNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Conv(6, (3, 3), padding="SAME", name="conv")(x)
+                x = nn.BatchNorm(use_running_average=True, name="bn")(x)
+                x = nn.relu(x).mean(axis=(1, 2))
+                return nn.Dense(3, name="fc")(x)
+
+        fm = FlaxNet()
+        vs = fm.init(jax.random.PRNGKey(3), jnp.zeros((1, 8, 8, 4)))
+        # randomize BN stats so the test exercises running_mean/var too
+        r = np.random.RandomState(0)
+        stats = jax.tree.map(
+            lambda a: jnp.asarray(r.uniform(0.5, 1.5, a.shape),
+                                  jnp.float32),
+            vs["batch_stats"])
+        sd = params_to_torch_state_dict(vs["params"], stats)
+
+        tm = torch.nn.Sequential()
+        tm.add_module("conv", torch.nn.Conv2d(4, 6, 3, padding=1))
+        tm.add_module("bn", torch.nn.BatchNorm2d(6))
+        tm.add_module("fc", torch.nn.Linear(6, 3))
+        with torch.no_grad():
+            tm.conv.weight.copy_(torch.tensor(sd["conv.weight"]))
+            tm.conv.bias.copy_(torch.tensor(sd["conv.bias"]))
+            tm.bn.weight.copy_(torch.tensor(sd["bn.weight"]))
+            tm.bn.bias.copy_(torch.tensor(sd["bn.bias"]))
+            tm.bn.running_mean.copy_(torch.tensor(sd["bn.running_mean"]))
+            tm.bn.running_var.copy_(torch.tensor(sd["bn.running_var"]))
+            tm.fc.weight.copy_(torch.tensor(sd["fc.weight"]))
+            tm.fc.bias.copy_(torch.tensor(sd["fc.bias"]))
+        tm.eval()
+
+        x = r.uniform(-1, 1, (2, 8, 8, 4)).astype(np.float32)
+        ours = np.asarray(fm.apply({"params": vs["params"],
+                                    "batch_stats": stats}, jnp.asarray(x)))
+        with torch.no_grad():
+            xt = torch.tensor(np.transpose(x, (0, 3, 1, 2)))  # NHWC->NCHW
+            y = torch.relu(tm.bn(tm.conv(xt))).mean(dim=(2, 3))
+            theirs = tm.fc(y).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+class TestPthLoading:
+    def test_load_torch_file_strips_dataparallel_prefix(self, tmp_path):
+        # the reference saved nn.DataParallel-wrapped state_dicts, whose
+        # keys carry a 'module.' prefix (train_pascal.py:92,301-304)
+        sd = {"module.conv.weight": torch.zeros(2, 3, 1, 1),
+              "module.bn.num_batches_tracked": torch.tensor(5),
+              "module.bn.running_mean": torch.ones(2)}
+        path = str(tmp_path / "ckpt.pth")
+        torch.save(sd, path)
+        out = load_torch_file(path)
+        assert set(out) == {"conv.weight", "bn.running_mean"}
+        assert out["conv.weight"].shape == (2, 3, 1, 1)
+
+    def test_warm_start_into_model(self, tmp_path):
+        # full cycle: export DANet -> torch.save -> load -> import -> apply
+        m = DANet(nclass=1, backbone_depth=18, output_stride=8)
+        vs = m.init(jax.random.PRNGKey(1), jnp.zeros((1, 32, 32, 4)),
+                    train=False)
+        sd = {k: torch.tensor(v) for k, v in
+              params_to_torch_state_dict(vs["params"],
+                                         vs["batch_stats"]).items()}
+        path = str(tmp_path / "danet.pth")
+        torch.save(sd, path)
+        loaded = load_torch_file(path)
+        params, stats = torch_state_dict_to_params(
+            loaded, vs["params"], vs["batch_stats"])
+        out = m.apply({"params": params, "batch_stats": stats},
+                      jnp.zeros((1, 32, 32, 4)), train=False)
+        ref = m.apply(vs, jnp.zeros((1, 32, 32, 4)), train=False)
+        np.testing.assert_array_equal(np.asarray(out[0]),
+                                      np.asarray(ref[0]))
+
+
+class TestIndependentEscapeHatches:
+    def test_rename_typo_caught_even_with_allow_missing(self):
+        # a typo'd rename produces an unused checkpoint key; allow_missing
+        # must NOT silence that (independent allow_unused flag)
+        m = DANet(nclass=1, backbone_depth=18, output_stride=8)
+        vs = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 4)),
+                    train=False)
+        sd = params_to_torch_state_dict(vs["params"], vs["batch_stats"])
+        typo = {("head.pam.querry.weight" if k == "head.pam.query.weight"
+                 else k): v for k, v in sd.items()}
+        with pytest.raises(KeyError, match="unmatched"):
+            torch_state_dict_to_params(typo, vs["params"],
+                                       vs["batch_stats"],
+                                       allow_missing=True)
+        # both hatches open -> proceeds
+        p, s = torch_state_dict_to_params(typo, vs["params"],
+                                          vs["batch_stats"],
+                                          allow_missing=True,
+                                          allow_unused=True)
+        assert p is not None
