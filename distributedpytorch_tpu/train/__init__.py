@@ -23,6 +23,7 @@ from .logging import (
     make_val_panels,
 )
 from .optim import make_optimizer, make_schedule
+from .preemption import PreemptionGuard
 from .trainer import Trainer
 
 __all__ = [
@@ -37,6 +38,7 @@ __all__ = [
     "ModelConfig",
     "MultiWriter",
     "OptimConfig",
+    "PreemptionGuard",
     "TensorBoardWriter",
     "Trainer",
     "apply_overrides",

@@ -81,6 +81,8 @@ class CheckpointConfig:
     snapshot_every: int = 100           # epoch snapshots (train_pascal.py:56)
     best_metric_init: float = 0.0       # reference pinned 0.913 (…:177)
     async_save: bool = True
+    save_on_preempt: bool = True        # SIGTERM -> final full-state save
+    preempt_check_every: int = 32       # stop-consensus cadence (steps)
 
 
 @dataclass

@@ -22,6 +22,7 @@ threshold-max Jaccard gating best saves.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 
@@ -58,6 +59,7 @@ from .logging import (
     make_val_panels,
 )
 from .optim import make_optimizer
+from .preemption import PreemptionGuard
 
 
 class Trainer:
@@ -219,9 +221,13 @@ class Trainer:
                   f"(best={self.ckpt.best_metric:.4f})", flush=True)
 
     # ------------------------------------------------------------------ train
-    def train_epoch(self, epoch: int) -> float:
+    def train_epoch(self, epoch: int,
+                    guard: PreemptionGuard | None = None) -> float:
         """One epoch; returns mean train loss (the reference printed the
-        running loss once per epoch, train_pascal.py:207-212)."""
+        running loss once per epoch, train_pascal.py:207-212).
+
+        ``guard``: stop-consensus checked every ``preempt_check_every``
+        steps, so all hosts leave the loop at the same step."""
         cfg = self.cfg
         self.train_loader.set_epoch(epoch)
         losses = []
@@ -240,16 +246,24 @@ class Trainer:
                 self.state, loss = self.train_step(self.state, device_batch)
                 losses.append(loss)  # device array; sync deferred
                 step = step0 + i + 1
+                if guard is not None and guard.should_stop(step):
+                    interrupted = True
+                    break
                 if self.is_main and step % cfg.log_every_steps == 0:
                     self.writer.scalars(  # float(loss) syncs — log steps only
                         {"train/loss": float(loss),
                          "train/lr": float(self.schedule(step)),
                          "train/epoch": epoch}, step)
+            else:
+                interrupted = False
         mean_loss = float(np.mean([float(l) for l in losses])) if losses \
             else float("nan")
         dt = time.perf_counter() - t0
         n_imgs = len(losses) * cfg.data.train_batch
-        if self.is_main:
+        # An interrupted epoch logs no completed-epoch summary: its partial
+        # mean would skew per-epoch curves, and the replayed epoch will log
+        # the real one.
+        if self.is_main and not interrupted:
             self.writer.scalars(
                 {"train/epoch_loss": mean_loss,
                  "train/imgs_per_sec": n_imgs / dt if dt > 0 else 0.0,
@@ -298,36 +312,71 @@ class Trainer:
         return metrics
 
     # -------------------------------------------------------------------- fit
-    def fit(self) -> dict:
+    def fit(self, guard: PreemptionGuard | None = None) -> dict:
         """The full loop (reference train_pascal.py:180-308): train each
         epoch; validate every ``eval_every``; snapshot every
-        ``snapshot_every``; save best on threshold-max Jaccard improvement."""
+        ``snapshot_every``; save best on threshold-max Jaccard improvement.
+
+        Preemption: unless disabled (``checkpoint.save_on_preempt=false``),
+        SIGTERM/SIGINT triggers a consensus stop, one final full-state
+        checkpoint, and a clean return — ``history["preempted"]`` marks it.
+        The interrupted epoch is recorded as *not* completed, so a resumed
+        run replays it from its start (some batches train twice; none are
+        skipped).  Pass your own entered ``guard`` to drive stops
+        programmatically (e.g. a wall-clock watchdog calling ``trip()``)."""
         cfg = self.cfg
         history = {"train_loss": [], "val": []}
-        for epoch in range(self.start_epoch, cfg.epochs):
-            t0 = time.perf_counter()
-            history["train_loss"].append(self.train_epoch(epoch))
-            step = int(self.state.step)
-            extra = {"epoch": epoch}
-            if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
-                metrics = self.validate(epoch)
-                history["val"].append(metrics)
-                is_best = self.ckpt.save(step, self.state,
-                                         metric=metrics["jaccard"],
-                                         extra=extra)
-                if is_best and self.is_main:
+        with contextlib.ExitStack() as stack:
+            if guard is None and cfg.checkpoint.save_on_preempt:
+                guard = stack.enter_context(PreemptionGuard(
+                    check_every=cfg.checkpoint.preempt_check_every))
+            for epoch in range(self.start_epoch, cfg.epochs):
+                t0 = time.perf_counter()
+                epoch_loss = self.train_epoch(epoch, guard=guard)
+                step = int(self.state.step)
+                if guard is not None and guard.should_stop():
+                    # The partial epoch is not appended to history — it will
+                    # be replayed in full by the resumed run.
+                    history["preempted"] = True
+                    if self.ckpt.latest_step() != step:
+                        self.ckpt.save(step, self.state,
+                                       extra={"epoch": epoch - 1,
+                                              "interrupted_epoch": epoch,
+                                              "preempted": True})
+                    # Flush while the signal handlers are still installed: a
+                    # scheduler's follow-up SIGTERM during the async write
+                    # must not kill the very checkpoint this stop exists to
+                    # land (the second-delivery escalation in the guard fires
+                    # only after this wait returns).
+                    self.ckpt.wait()
+                    if self.is_main:
+                        self.writer.scalars(
+                            {"preempted_at_epoch": epoch}, step)
+                    break
+                history["train_loss"].append(epoch_loss)
+                extra = {"epoch": epoch}
+                if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
+                    metrics = self.validate(epoch)
+                    history["val"].append(metrics)
+                    is_best = self.ckpt.save(step, self.state,
+                                             metric=metrics["jaccard"],
+                                             extra=extra)
+                    if is_best and self.is_main:
+                        self.writer.scalars(
+                            {"val/new_best_jaccard": metrics["jaccard"],
+                             "val/epoch": epoch}, step)
+                elif cfg.checkpoint.snapshot_every and \
+                        (epoch + 1) % cfg.checkpoint.snapshot_every == 0:
+                    self.ckpt.save(step, self.state, extra=extra)
+                if self.is_main:
                     self.writer.scalars(
-                        {"val/new_best_jaccard": metrics["jaccard"],
-                         "val/epoch": epoch}, step)
-            elif cfg.checkpoint.snapshot_every and \
-                    (epoch + 1) % cfg.checkpoint.snapshot_every == 0:
-                self.ckpt.save(step, self.state, extra=extra)
-            if self.is_main:
-                self.writer.scalars(
-                    {"epoch": epoch,
-                     "epoch_total_seconds": time.perf_counter() - t0}, step)
-        self.ckpt.wait()
-        self.writer.flush()
+                        {"epoch": epoch,
+                         "epoch_total_seconds": time.perf_counter() - t0},
+                        step)
+            # Flush inside the stack: the graceful-stop handlers must stay
+            # installed until the last async save has committed.
+            self.ckpt.wait()
+            self.writer.flush()
         return history
 
     def close(self) -> None:
