@@ -18,6 +18,7 @@ from .mesh import (
     shard_batch,
 )
 from .ring import make_ring_attention, ring_attention_local
+from .ulysses import make_ulysses_attention, ulysses_attention_local
 from .step import (
     INPUT_KEY,
     TARGET_KEY,
@@ -40,8 +41,10 @@ __all__ = [
     "make_eval_step",
     "make_mesh",
     "make_ring_attention",
+    "make_ulysses_attention",
     "make_train_step",
     "ring_attention_local",
+    "ulysses_attention_local",
     "pad_to_multiple",
     "replicated_sharding",
     "replicated_spec",

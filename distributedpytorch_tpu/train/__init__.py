@@ -22,7 +22,7 @@ from .logging import (
     TensorBoardWriter,
     make_val_panels,
 )
-from .optim import make_optimizer, make_schedule
+from .optim import make_optimizer, make_param_labeler, make_schedule
 from .preemption import PreemptionGuard
 from .trainer import Trainer
 
@@ -48,6 +48,7 @@ __all__ = [
     "flatten",
     "from_json",
     "make_optimizer",
+    "make_param_labeler",
     "make_schedule",
     "make_val_panels",
     "next_run_dir",

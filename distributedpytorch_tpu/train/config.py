@@ -67,6 +67,8 @@ class OptimConfig:
     warmup_steps: int = 0
     accum_steps: int = 1                # the reference's nAveGrad knob
     grad_clip_norm: float | None = None
+    freeze: tuple[str, ...] = ()        # param-path prefixes to freeze
+    lr_mult: dict[str, float] | None = None  # per-prefix LR multipliers
 
 
 @dataclass
@@ -128,7 +130,7 @@ def _from_dict(cls, d: dict):
                 and isinstance(v, dict):
             v = _from_dict(ftype, v)
         elif f.name in ("crop_size", "rots", "scales", "loss_weights",
-                        "eval_thresholds") and isinstance(v, list):
+                        "eval_thresholds", "freeze") and isinstance(v, list):
             v = tuple(v)
         kwargs[f.name] = v
     return cls(**kwargs)

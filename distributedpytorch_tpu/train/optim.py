@@ -7,6 +7,14 @@ here; poly decay is the classic segmentation schedule
 ``lr * (1 - step/total)^power`` the reference's ``LR_Scheduler('poly', …)``
 implemented externally.
 
+Parameter groups: the reference experimented with freezing the backbone and
+with per-param-group LRs — both left as commented code (backbone
+``requires_grad=False`` loop, train_pascal.py:87-89; pretrained-vs-head LR
+groups, :90-91).  Here they are config knobs: ``freeze`` pins named subtrees
+(their updates are zeroed, momentum state carries nothing), ``lr_mult``
+scales the whole update of a named subtree — torch param-group semantics,
+expressed as an ``optax.multi_transform`` over path-prefix labels.
+
 Weight decay note: torch SGD's ``weight_decay`` is L2-added-to-grad *before*
 momentum; ``optax.sgd`` has no wd, so we compose ``add_decayed_weights``
 ahead of the momentum trace to match torch semantics exactly.
@@ -14,6 +22,7 @@ ahead of the momentum trace to match torch semantics exactly.
 
 from __future__ import annotations
 
+import jax
 import optax
 
 from .config import OptimConfig
@@ -37,15 +46,97 @@ def make_schedule(cfg: OptimConfig, total_steps: int) -> optax.Schedule:
     return sched
 
 
+def _dotted(path) -> str:
+    return ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def _matches(dotted: str, prefix: str) -> bool:
+    return dotted == prefix or dotted.startswith(prefix + ".")
+
+
+def make_param_labeler(freeze: tuple[str, ...],
+                       lr_mult: dict[str, float] | None):
+    """``params -> label pytree`` for ``optax.multi_transform``.
+
+    A parameter's label is ``"frozen"`` if any ``freeze`` prefix matches its
+    dotted path (e.g. ``backbone`` matches ``backbone.layer1.conv.kernel``),
+    else ``"mult:<prefix>"`` for the longest matching ``lr_mult`` prefix,
+    else ``"base"``.
+
+    Every prefix must match at least one parameter — a typo'd prefix that
+    silently trained an intended-frozen subtree would be invisible until
+    someone inspected the weights, so it raises instead.
+    """
+
+    def labeler(params):
+        matched: set[str] = set()
+
+        def label_of(path, _leaf):
+            dotted = _dotted(path)
+            frozen = False
+            for p in freeze:
+                if _matches(dotted, p):
+                    matched.add(p)
+                    frozen = True
+            best = ""
+            for p in (lr_mult or {}):
+                if _matches(dotted, p):
+                    matched.add(p)
+                    if len(p) > len(best):
+                        best = p
+            if frozen:
+                return "frozen"
+            return f"mult:{best}" if best else "base"
+
+        labels = jax.tree_util.tree_map_with_path(label_of, params)
+        missing = (set(freeze) | set(lr_mult or {})) - matched
+        if missing:
+            raise ValueError(
+                f"param-group prefixes matched no parameter: "
+                f"{sorted(missing)}")
+        return labels
+
+    return labeler
+
+
 def make_optimizer(cfg: OptimConfig, total_steps: int
                    ) -> tuple[optax.GradientTransformation, optax.Schedule]:
     """Returns ``(tx, schedule)``; the schedule is also returned separately so
     the trainer can log the current LR."""
     sched = make_schedule(cfg, total_steps)
-    parts = []
+
+    def sgd_update(mult: float = 1.0) -> optax.GradientTransformation:
+        parts = []
+        if cfg.weight_decay:
+            parts.append(optax.add_decayed_weights(cfg.weight_decay))
+        parts.append(optax.sgd(sched, momentum=cfg.momentum or None))
+        if mult != 1.0:  # torch param-group lr: scales the whole step
+            parts.append(optax.scale(mult))
+        return optax.chain(*parts)
+
+    labeler = None
+    if cfg.freeze or cfg.lr_mult:
+        labeler = make_param_labeler(tuple(cfg.freeze), cfg.lr_mult)
+        group_txs = {"base": sgd_update(), "frozen": optax.set_to_zero()}
+        for prefix, mult in (cfg.lr_mult or {}).items():
+            group_txs[f"mult:{prefix}"] = sgd_update(float(mult))
+        tx = optax.multi_transform(group_txs, labeler)
+    else:
+        tx = sgd_update()
     if cfg.grad_clip_norm:
-        parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
-    if cfg.weight_decay:
-        parts.append(optax.add_decayed_weights(cfg.weight_decay))
-    parts.append(optax.sgd(sched, momentum=cfg.momentum or None))
-    return optax.chain(*parts), sched
+        pre = []
+        if cfg.freeze:
+            # Frozen params contribute nothing to the step, so they must not
+            # contribute to the clip norm either (torch excludes
+            # requires_grad=False params from clip_grad_norm_): zero their
+            # grads ahead of the global-norm computation.
+            def frozen_mask(tree):
+                return jax.tree.map(lambda lb: lb == "frozen", labeler(tree))
+
+            pre.append(optax.masked(optax.set_to_zero(), frozen_mask))
+        # Global-norm clipping spans all (trainable) groups, so it sits
+        # ahead of the per-group split.
+        pre.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+        tx = optax.chain(*pre, tx)
+    return tx, sched

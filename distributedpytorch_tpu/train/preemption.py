@@ -18,6 +18,7 @@ flags.  (A signal delivered to one host stops the whole job cleanly.)
 
 from __future__ import annotations
 
+import contextlib
 import signal
 import threading
 
@@ -46,6 +47,7 @@ class PreemptionGuard:
         self._signals = tuple(signals)
         self._prev: dict[int, object] = {}
         self._flag = threading.Event()
+        self._shield_depth = 0
         self.check_every = max(1, int(check_every))
 
     # ------------------------------------------------------------ handlers
@@ -69,6 +71,11 @@ class PreemptionGuard:
 
     def _handle(self, signum, frame) -> None:
         if self._flag.is_set():
+            if self._shield_depth > 0:
+                # Inside a shield() block (the final checkpoint flush):
+                # stay graceful — dying here would lose the very write the
+                # graceful stop exists to land.
+                return
             # Second delivery: the user (or scheduler) means it.  Restore the
             # previous disposition and re-deliver, so a double Ctrl-C raises
             # KeyboardInterrupt as usual and a second SIGTERM terminates —
@@ -84,6 +91,17 @@ class PreemptionGuard:
                 signal.raise_signal(signum)
             return
         self._flag.set()
+
+    @contextlib.contextmanager
+    def shield(self):
+        """Critical section: while active, further signal deliveries never
+        escalate — they are absorbed so an in-flight final checkpoint write
+        completes.  Use around the post-stop flush only; keep it short."""
+        self._shield_depth += 1
+        try:
+            yield
+        finally:
+            self._shield_depth -= 1
 
     # ---------------------------------------------------------------- state
     def trip(self) -> None:

@@ -338,17 +338,17 @@ class Trainer:
                     # The partial epoch is not appended to history — it will
                     # be replayed in full by the resumed run.
                     history["preempted"] = True
-                    if self.ckpt.latest_step() != step:
-                        self.ckpt.save(step, self.state,
-                                       extra={"epoch": epoch - 1,
-                                              "interrupted_epoch": epoch,
-                                              "preempted": True})
-                    # Flush while the signal handlers are still installed: a
-                    # scheduler's follow-up SIGTERM during the async write
-                    # must not kill the very checkpoint this stop exists to
-                    # land (the second-delivery escalation in the guard fires
-                    # only after this wait returns).
-                    self.ckpt.wait()
+                    # shield(): signals delivered during the final save and
+                    # flush are absorbed (no escalation), so a scheduler's
+                    # follow-up SIGTERM cannot kill the very checkpoint this
+                    # stop exists to land.
+                    with guard.shield():
+                        if self.ckpt.latest_step() != step:
+                            self.ckpt.save(step, self.state,
+                                           extra={"epoch": epoch - 1,
+                                                  "interrupted_epoch": epoch,
+                                                  "preempted": True})
+                        self.ckpt.wait()
                     if self.is_main:
                         self.writer.scalars(
                             {"preempted_at_epoch": epoch}, step)
@@ -373,9 +373,11 @@ class Trainer:
                         {"epoch": epoch,
                          "epoch_total_seconds": time.perf_counter() - t0},
                         step)
-            # Flush inside the stack: the graceful-stop handlers must stay
-            # installed until the last async save has committed.
-            self.ckpt.wait()
+            # Flush inside the stack (and shielded): the graceful-stop
+            # handlers must stay installed, and escalation deferred, until
+            # the last async save has committed.
+            with guard.shield() if guard is not None else contextlib.nullcontext():
+                self.ckpt.wait()
             self.writer.flush()
         return history
 

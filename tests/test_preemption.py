@@ -60,6 +60,18 @@ class TestPreemptionGuard:
             with pytest.raises(KeyboardInterrupt):
                 signal.raise_signal(signal.SIGINT)  # second: escalates
 
+    def test_shield_absorbs_signals_during_flush(self):
+        with PreemptionGuard(signals=(signal.SIGINT,)) as guard:
+            signal.raise_signal(signal.SIGINT)   # graceful flag
+            with guard.shield():
+                # A delivery inside the critical section (the final
+                # checkpoint flush) must NOT escalate.
+                signal.raise_signal(signal.SIGINT)
+                assert guard.triggered
+            # Outside the shield, escalation applies again.
+            with pytest.raises(KeyboardInterrupt):
+                signal.raise_signal(signal.SIGINT)
+
     def test_usable_from_worker_thread(self):
         # signal.signal raises in non-main threads; the guard must still
         # work via trip() there.
