@@ -153,6 +153,7 @@ class DANet(nn.Module):
     bn_cross_replica_axis: str | None = None
     pam_block_size: int | None = None
     pam_impl: str = "einsum"  # einsum | flash (ops.pallas_attention)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -162,6 +163,7 @@ class DANet(nn.Module):
             output_stride=self.output_stride,
             dtype=self.dtype,
             bn_cross_replica_axis=self.bn_cross_replica_axis,
+            remat=self.remat,
             name="backbone",
         )(x, train=train)
         norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)

@@ -94,6 +94,7 @@ class DeepLabV3(nn.Module):
     aux_head: bool = False
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -106,6 +107,7 @@ class DeepLabV3(nn.Module):
             multi_grid=(1, 2, 4),
             dtype=self.dtype,
             bn_cross_replica_axis=self.bn_cross_replica_axis,
+            remat=self.remat,
             name="backbone",
         )(x, train=train)
         norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)

@@ -156,6 +156,7 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
     deep_stem: bool = False  # 3x 3x3 stem (encoding-style) vs single 7x7
+    remat: bool = False  # rematerialize blocks: trade FLOPs for HBM
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -164,6 +165,15 @@ class ResNet(nn.Module):
         block_cls = (
             BottleneckBlock if self.depth in BOTTLENECK_DEPTHS else BasicBlock
         )
+        # Explicit block names (matching linen's auto-numbering) keep the
+        # param tree identical whether or not remat is on — a checkpoint
+        # written either way restores either way.
+        block_name = block_cls.__name__
+        if self.remat:
+            # jax.checkpoint per residual block: the backward pass recomputes
+            # each block's activations instead of holding all ~100 of them in
+            # HBM — the standard way to fit bigger batches/crops per chip.
+            block_cls = nn.remat(block_cls)
         counts = RESNET_DEPTHS[self.depth]
         strides, dilations = _stage_plan(self.output_stride)
 
@@ -182,6 +192,7 @@ class ResNet(nn.Module):
 
         feats = {}
         filters = self.width
+        block_idx = 0
         for stage, n_blocks in enumerate(counts):
             for i in range(n_blocks):
                 dil = dilations[stage]
@@ -193,7 +204,9 @@ class ResNet(nn.Module):
                     strides=strides[stage] if i == 0 else 1,
                     dilation=dil,
                     dtype=self.dtype,
+                    name=f"{block_name}_{block_idx}",
                 )(x)
+                block_idx += 1
             feats[f"c{stage + 1}"] = x
             filters *= 2
         return feats

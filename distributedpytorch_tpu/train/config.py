@@ -55,6 +55,7 @@ class ModelConfig:
     loss_weights: tuple[float, ...] | None = None
     pam_block_size: int | None = None   # blocked position-attention
     pam_impl: str = "einsum"            # einsum | flash (pallas TPU kernel)
+    remat: bool = False                 # rematerialize backbone blocks
 
 
 @dataclass

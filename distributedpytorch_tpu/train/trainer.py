@@ -160,7 +160,7 @@ class Trainer:
             name=cfg.model.name, nclass=cfg.model.nclass,
             backbone=cfg.model.backbone, output_stride=cfg.model.output_stride,
             dtype=cfg.model.dtype, pam_block_size=cfg.model.pam_block_size,
-            pam_impl=cfg.model.pam_impl)
+            pam_impl=cfg.model.pam_impl, remat=cfg.model.remat)
         steps_per_epoch = max(len(self.train_loader), 1)
         total_steps = steps_per_epoch * cfg.epochs
         self.tx, self.schedule = make_optimizer(cfg.optim, total_steps)
