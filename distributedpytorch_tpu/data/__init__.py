@@ -16,6 +16,7 @@ from .voc import (
     CATEGORY_NAMES,
     VOCInstanceSegmentation,
     VOCSemanticSegmentation,
+    ensure_voc,
 )
 
 __all__ = [
@@ -23,6 +24,7 @@ __all__ = [
     "CombinedDataset",
     "DataLoader",
     "VOCInstanceSegmentation",
+    "ensure_voc",
     "VOCSemanticSegmentation",
     "HAVE_GRAIN",
     "build_eval_transform",

@@ -42,6 +42,35 @@ CATEGORY_NAMES = [
 ]
 
 
+def ensure_voc(root: str, download: bool = False) -> str:
+    """Ensure an extracted VOC2012 tree under ``root``; returns its path.
+
+    With ``download=True`` and no tree present, fetches the trainval tar and
+    **MD5-verifies it before extracting** — a truncated/tampered download
+    must never leave a half-extracted tree that the dir-exists check would
+    then trust forever.  Multi-process: call on process 0 only, then
+    barrier (the Trainer does this).
+    """
+    voc_root = os.path.join(root, BASE_DIR)
+    if os.path.isdir(voc_root):
+        return voc_root
+    if not download:
+        raise RuntimeError(
+            f"VOC tree not found under {voc_root}; pass download=True or "
+            "point root at an extracted VOCdevkit.")
+    os.makedirs(root, exist_ok=True)
+    fpath = os.path.join(root, FILE)
+    if not (os.path.isfile(fpath) and _md5(fpath) == MD5):
+        urllib.request.urlretrieve(URL, fpath)
+        got = _md5(fpath)
+        if got != MD5:
+            raise RuntimeError(
+                f"downloaded {FILE} is corrupt: md5 {got} != {MD5}")
+    with tarfile.open(fpath) as tar:
+        tar.extractall(root)
+    return voc_root
+
+
 class VOCInstanceSegmentation:
     """Random-access source of (image, single-object mask, void mask) samples.
 
@@ -88,13 +117,7 @@ class VOCInstanceSegmentation:
         self._cat_dir = os.path.join(voc_root, "SegmentationClass")
         splits_dir = os.path.join(voc_root, "ImageSets", "Segmentation")
 
-        if download:
-            self._download()
-        if not os.path.isdir(voc_root):
-            raise RuntimeError(
-                f"VOC tree not found under {voc_root}; pass download=True or "
-                "point root at an extracted VOCdevkit."
-            )
+        ensure_voc(root, download=download)
 
         area_suffix = f"_area_thres-{area_thres}" if area_thres else ""
         self.obj_list_file = os.path.join(
@@ -167,15 +190,6 @@ class VOCInstanceSegmentation:
         with open(self.obj_list_file, "w") as f:
             json.dump(self.obj_dict, f, indent=1)
 
-    def _download(self) -> None:
-        os.makedirs(self.root, exist_ok=True)
-        fpath = os.path.join(self.root, FILE)
-        if not (os.path.isfile(fpath) and _md5(fpath) == MD5):
-            urllib.request.urlretrieve(URL, fpath)
-        if not os.path.isdir(os.path.join(self.root, BASE_DIR)):
-            with tarfile.open(fpath) as tar:
-                tar.extractall(self.root)
-
     # -- sample access -------------------------------------------------------
 
     def __len__(self) -> int:
@@ -237,7 +251,7 @@ class VOCSemanticSegmentation:
     """
 
     def __init__(self, root: str, split="val", transform=None,
-                 retname: bool = True):
+                 retname: bool = True, download: bool = False):
         self.root = root
         self.transform = transform
         self.retname = retname
@@ -248,8 +262,7 @@ class VOCSemanticSegmentation:
         image_dir = os.path.join(voc_root, "JPEGImages")
         cat_dir = os.path.join(voc_root, "SegmentationClass")
         splits_dir = os.path.join(voc_root, "ImageSets", "Segmentation")
-        if not os.path.isdir(voc_root):
-            raise RuntimeError(f"VOC tree not found under {root!r}")
+        ensure_voc(root, download=download)
 
         self.im_ids: list[str] = []
         self.images: list[str] = []

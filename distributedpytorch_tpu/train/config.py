@@ -28,6 +28,7 @@ from typing import Any
 class DataConfig:
     root: str = ""                      # dataset root (was: the mypath module)
     fake: bool = False                  # synth fixture instead of real VOC
+    download: bool = False              # fetch + MD5-verify VOC if absent
     train_split: str = "train"
     val_split: str = "val"
     area_thres: int = 500               # instance area filter (pascal.py:36)

@@ -94,6 +94,16 @@ class Trainer:
             if not os.path.exists(os.path.join(root, "VOCdevkit")):
                 make_fake_voc(root, n_images=8, size=(96, 128), n_val=3,
                               seed=cfg.seed)
+        elif cfg.data.download:
+            # Fetch once, on process 0 only — N processes racing a 2 GB
+            # urlretrieve/extract into a shared root corrupts the tree —
+            # then barrier so the others construct against the final tree.
+            from ..data.voc import ensure_voc
+            if self.is_main:
+                ensure_voc(root, download=True)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("voc-download")
         if cfg.task == "instance":
             train_tf = build_train_transform(
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
@@ -104,6 +114,7 @@ class Trainer:
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                 zero_pad=cfg.data.zero_pad, alpha=cfg.data.guidance_alpha,
                 guidance=cfg.data.guidance)
+            # download (if requested) already happened above, gated+barriered
             self.train_set = VOCInstanceSegmentation(
                 root, split=cfg.data.train_split, transform=train_tf,
                 preprocess=True, area_thres=cfg.data.area_thres)

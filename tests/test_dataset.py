@@ -217,3 +217,39 @@ class TestCombinedDataset:
         both = CombinedDataset([inst, sem], allow_mixed_schemas=True)
         assert len(both) == len(inst) + len(sem)
         assert str(both).startswith("Combined(")
+
+
+class TestEnsureVoc:
+    """ensure_voc: the single download/verify gate both dataset classes and
+    the Trainer's process-0-gated fetch share."""
+
+    def test_existing_tree_returns_without_network(self, fake_voc_root):
+        from distributedpytorch_tpu.data import ensure_voc
+        path = ensure_voc(fake_voc_root, download=False)
+        assert path.endswith("VOCdevkit/VOC2012")
+
+    def test_missing_tree_no_download_raises(self, tmp_path):
+        from distributedpytorch_tpu.data import ensure_voc
+        with pytest.raises(RuntimeError, match="download=True"):
+            ensure_voc(str(tmp_path / "empty"))
+
+    def test_corrupt_fresh_download_rejected_before_extract(self, tmp_path,
+                                                            monkeypatch):
+        # A fetched tar whose MD5 mismatches must raise BEFORE extraction —
+        # never leave a half tree the dir-exists check would then trust.
+        from distributedpytorch_tpu.data import voc as voc_mod
+        root = str(tmp_path / "dl")
+
+        def fake_fetch(url, fpath):
+            with open(fpath, "wb") as f:
+                f.write(b"not a tar")
+        monkeypatch.setattr(voc_mod.urllib.request, "urlretrieve", fake_fetch)
+        with pytest.raises(RuntimeError, match="corrupt"):
+            voc_mod.ensure_voc(root, download=True)
+        assert not os.path.isdir(os.path.join(root, voc_mod.BASE_DIR))
+
+    def test_semantic_dataset_accepts_download_flag(self, fake_voc_root):
+        from distributedpytorch_tpu.data import VOCSemanticSegmentation
+        ds = VOCSemanticSegmentation(fake_voc_root, split="val",
+                                     download=False)
+        assert len(ds) > 0
