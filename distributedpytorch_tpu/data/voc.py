@@ -21,6 +21,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import shutil
 import tarfile
 import urllib.request
 
@@ -51,6 +52,10 @@ def ensure_voc(root: str, download: bool = False) -> str:
     then trust forever.  Multi-process: call on process 0 only, then
     barrier (the Trainer does this).
     """
+    if not root:
+        raise ValueError(
+            "data root is empty — set data.root to the directory that holds "
+            "(or should receive) the VOCdevkit tree")
     voc_root = os.path.join(root, BASE_DIR)
     if os.path.isdir(voc_root):
         return voc_root
@@ -66,8 +71,17 @@ def ensure_voc(root: str, download: bool = False) -> str:
         if got != MD5:
             raise RuntimeError(
                 f"downloaded {FILE} is corrupt: md5 {got} != {MD5}")
+    # Extract to a scratch dir and rename the finished tree into place: an
+    # interrupted extractall must never leave a partial VOC2012 that the
+    # dir-exists fast path above would then trust forever.
+    tmp_dir = os.path.join(root, ".voc_extract.tmp")
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    os.makedirs(tmp_dir)
     with tarfile.open(fpath) as tar:
-        tar.extractall(root)
+        tar.extractall(tmp_dir, filter="data")
+    os.makedirs(os.path.dirname(voc_root), exist_ok=True)
+    os.rename(os.path.join(tmp_dir, BASE_DIR), voc_root)
+    shutil.rmtree(tmp_dir, ignore_errors=True)
     return voc_root
 
 

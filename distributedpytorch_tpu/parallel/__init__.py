@@ -13,6 +13,7 @@ from .mesh import (
     initialize_distributed,
     make_mesh,
     pad_to_multiple,
+    prefetch_to_device,
     replicated_sharding,
     replicated_spec,
     shard_batch,
@@ -46,6 +47,7 @@ __all__ = [
     "ring_attention_local",
     "ulysses_attention_local",
     "pad_to_multiple",
+    "prefetch_to_device",
     "replicated_sharding",
     "replicated_spec",
     "shard_batch",

@@ -42,7 +42,8 @@ class DataConfig:
     train_batch: int = 16
     val_batch: int = 1
     num_workers: int = 2                # loader threads (train_pascal.py:161)
-    prefetch: int = 2
+    prefetch: int = 2                   # host-side decoded-batch buffer
+    device_prefetch: int = 2            # batches placed on-device ahead
 
 
 @dataclass

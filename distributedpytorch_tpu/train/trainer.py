@@ -45,7 +45,7 @@ from ..parallel import (
     make_eval_step,
     make_mesh,
     make_train_step,
-    shard_batch,
+    prefetch_to_device,
 )
 from ..utils.helpers import generate_param_report
 from . import config as config_lib
@@ -96,14 +96,27 @@ class Trainer:
                               seed=cfg.seed)
         elif cfg.data.download:
             # Fetch once, on process 0 only — N processes racing a 2 GB
-            # urlretrieve/extract into a shared root corrupts the tree —
-            # then barrier so the others construct against the final tree.
+            # urlretrieve/extract into a shared root corrupts the tree.
+            # Process 0's failure is caught and broadcast (the broadcast IS
+            # the barrier), so the other processes fail fast instead of
+            # hanging on a barrier process 0 never reaches.
             from ..data.voc import ensure_voc
+            err = ""
             if self.is_main:
-                ensure_voc(root, download=True)
+                try:
+                    ensure_voc(root, download=True)
+                except Exception as e:  # re-raised below, on every process
+                    err = f"{type(e).__name__}: {e}"
             if jax.process_count() > 1:
+                import jax.numpy as jnp
                 from jax.experimental import multihost_utils
-                multihost_utils.sync_global_devices("voc-download")
+                failed = int(multihost_utils.broadcast_one_to_all(
+                    jnp.int32(bool(err))))
+                if failed and not err:
+                    err = "see process 0 logs"
+            if err:
+                raise RuntimeError(f"VOC download failed on process 0 "
+                                   f"({err})")
         if cfg.task == "instance":
             train_tf = build_train_transform(
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
@@ -247,13 +260,20 @@ class Trainer:
         # ``self.state.step`` every iteration would block on the device and
         # serialize host data-prep against device compute.
         step0 = int(self.state.step)
-        with self.mesh:
-            for i, batch in enumerate(self.train_loader):
+
+        def host_batches():
+            for batch in self.train_loader:
                 if cfg.debug_asserts and cfg.task == "instance":
                     batch_debug_asserts(batch)
-                device_batch = shard_batch(self.mesh, {
-                    k: v for k, v in batch.items()
-                    if k in ("concat", "crop_gt", "crop_void")})
+                yield batch
+
+        with self.mesh:
+            # Async H2D overlap: up to device_prefetch batches are already
+            # placed (sharded) while the current step computes.
+            batches = prefetch_to_device(
+                host_batches(), self.mesh, size=cfg.data.device_prefetch,
+                keys=("concat", "crop_gt", "crop_void"))
+            for i, device_batch in enumerate(batches):
                 self.state, loss = self.train_step(self.state, device_batch)
                 losses.append(loss)  # device array; sync deferred
                 step = step0 + i + 1

@@ -253,3 +253,44 @@ class TestEnsureVoc:
         ds = VOCSemanticSegmentation(fake_voc_root, split="val",
                                      download=False)
         assert len(ds) > 0
+
+    def test_empty_root_raises_actionable_error(self):
+        from distributedpytorch_tpu.data import ensure_voc
+        with pytest.raises(ValueError, match="data.root"):
+            ensure_voc("", download=True)
+
+    def test_interrupted_extract_leaves_no_trusted_tree(self, tmp_path,
+                                                        monkeypatch):
+        # Extraction that dies mid-way must not leave VOCdevkit/VOC2012 for
+        # the dir-exists fast path to trust on the next call.
+        import tarfile as tarfile_mod
+        from distributedpytorch_tpu.data import voc as voc_mod
+        root = str(tmp_path / "dl")
+
+        def fake_fetch(url, fpath):
+            # A real (tiny) tar; pin the module MD5 to its actual hash.
+            src = tmp_path / "VOCdevkit" / "VOC2012"
+            os.makedirs(src, exist_ok=True)
+            (src / "marker.txt").write_text("x")
+            with tarfile_mod.open(fpath, "w") as t:
+                t.add(tmp_path / "VOCdevkit", arcname="VOCdevkit")
+            monkeypatch.setattr(voc_mod, "MD5", voc_mod._md5(fpath))
+        monkeypatch.setattr(voc_mod.urllib.request, "urlretrieve", fake_fetch)
+
+        orig_extract = tarfile_mod.TarFile.extractall
+
+        def dying_extract(self, path, *a, **k):
+            os.makedirs(os.path.join(path, "VOCdevkit", "VOC2012"),
+                        exist_ok=True)
+            raise OSError("disk full")
+        monkeypatch.setattr(voc_mod.tarfile.TarFile, "extractall",
+                            dying_extract)
+        with pytest.raises(OSError):
+            voc_mod.ensure_voc(root, download=True)
+        assert not os.path.isdir(os.path.join(root, voc_mod.BASE_DIR))
+
+        # With extraction restored, the same root completes and is trusted.
+        monkeypatch.setattr(voc_mod.tarfile.TarFile, "extractall",
+                            orig_extract)
+        path = voc_mod.ensure_voc(root, download=True)
+        assert os.path.isfile(os.path.join(path, "marker.txt"))

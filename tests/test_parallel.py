@@ -216,3 +216,27 @@ class TestGoldenLossRegression:
         _, l2 = step(s1, batch)
         np.testing.assert_allclose(float(l1), 33.4633789062, rtol=1e-5)
         np.testing.assert_allclose(float(l2), 4.4252347946, rtol=1e-5)
+
+
+class TestPrefetchToDevice:
+    def test_yields_all_batches_sharded_in_order(self):
+        from distributedpytorch_tpu.parallel import (
+            make_mesh, prefetch_to_device)
+        mesh = make_mesh()
+        n = 7
+        batches = [{"concat": np.full((8, 4), i, np.float32),
+                    "aux_list": [i]} for i in range(n)]
+        out = list(prefetch_to_device(iter(batches), mesh, size=2,
+                                      keys=("concat",)))
+        assert len(out) == n
+        for i, b in enumerate(out):
+            assert set(b) == {"concat"}          # keys filter applied
+            assert b["concat"].sharding.spec[0] == "data"
+            assert float(np.asarray(b["concat"])[0, 0]) == i  # order kept
+
+    def test_size_zero_is_synchronous(self):
+        from distributedpytorch_tpu.parallel import (
+            make_mesh, prefetch_to_device)
+        mesh = make_mesh()
+        batches = [{"concat": np.zeros((8, 4), np.float32)}] * 3
+        assert len(list(prefetch_to_device(iter(batches), mesh, 0))) == 3
