@@ -19,6 +19,7 @@ from .mesh import (
     shard_batch,
 )
 from .ring import make_ring_attention, ring_attention_local
+from .tp import state_shardings, tp_param_specs
 from .ulysses import make_ulysses_attention, ulysses_attention_local
 from .step import (
     INPUT_KEY,
@@ -51,4 +52,6 @@ __all__ = [
     "replicated_sharding",
     "replicated_spec",
     "shard_batch",
+    "state_shardings",
+    "tp_param_specs",
 ]

@@ -72,16 +72,22 @@ def create_train_state(
     tx: optax.GradientTransformation,
     input_shape: tuple[int, ...],
     mesh=None,
+    shard_params: bool = False,
 ) -> TrainState:
     """Initialize params/batch-stats with a dummy batch and wrap with the
     optimizer state.  ``input_shape`` is (N, H, W, C) — NHWC, the TPU-native
     layout (the reference's NCHW ``ToTensor`` transpose has no analogue
     here; conv layouts are XLA's concern).
 
-    With ``mesh``, every leaf is created directly as a *global* replicated
-    array.  Multi-host this is required: a host-local single-device array is
-    neither a valid input to the replicated-sharded train step nor
-    serializable by Orbax's coordinated save.
+    With ``mesh``, every leaf is created directly as a *global* array.
+    Multi-host this is required: a host-local single-device array is
+    neither a valid input to the sharded train step nor serializable by
+    Orbax's coordinated save.
+
+    ``shard_params=True`` turns on tensor parallelism: kernel output
+    channels are partitioned over the ``model`` axis (see
+    :mod:`parallel.tp`); momentum inherits the layout through propagation.
+    Default is fully replicated — the reference-parity data-parallel state.
     """
     init_rng, state_rng = jax.random.split(rng)
 
@@ -90,18 +96,39 @@ def create_train_state(
                                train=False)
         params = unfreeze(variables["params"])
         batch_stats = unfreeze(variables.get("batch_stats", {}))
+        opt_state = tx.init(params)
+        if mesh is not None and shard_params:
+            from .tp import constrain, tp_param_specs
+            params = constrain(params, mesh, tp_param_specs(params, mesh))
+            # Momentum traces share the kernels' shapes, so the same
+            # shape-based rule shards optimizer memory identically.
+            opt_state = constrain(opt_state, mesh,
+                                  tp_param_specs(opt_state, mesh))
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
             batch_stats=batch_stats,
-            opt_state=tx.init(params),
+            opt_state=opt_state,
             rng=state_rng,
         )
 
     if mesh is None:
         return make_state()
-    return jax.jit(make_state,
-                   out_shardings=mesh_lib.replicated_sharding(mesh))()
+    if not shard_params:
+        return jax.jit(make_state,
+                       out_shardings=mesh_lib.replicated_sharding(mesh))()
+    # TP: let XLA propagate the constrained param layout into the optimizer
+    # state; pin the small unconstrained leaves (step/rng/batch_stats) to
+    # replicated afterwards via an identity reshard where needed.
+    with mesh:
+        state = jax.jit(make_state)()
+    repl = mesh_lib.replicated_sharding(mesh)
+    return state.replace(
+        step=jax.device_put(state.step, repl),
+        rng=jax.device_put(state.rng, repl),
+        batch_stats=jax.tree.map(
+            lambda x: jax.device_put(x, repl), state.batch_stats),
+    )
 
 
 def _compute_loss(outputs, batch: Batch, weights, loss_type: str):
@@ -162,8 +189,13 @@ def make_train_step(
     donate: bool = True,
     loss_type: str = "multi_sigmoid",
     augment: Callable[[Batch, jax.Array], Batch] | None = None,
+    state_shardings=None,
 ) -> Callable[[TrainState, Batch], tuple[TrainState, jax.Array]]:
     """Build the jitted ``(state, batch) -> (state, loss)`` train step.
+
+    ``state_shardings``: a sharding pytree shaped like the state (e.g.
+    ``tp.state_shardings(state)``) for tensor-parallel layouts; ``None``
+    keeps the replicated data-parallel default.
 
     With ``accum_steps > 1`` the global batch is split into that many
     micro-batches and scanned, averaging gradients — BASELINE.md config 5's
@@ -230,17 +262,24 @@ def make_train_step(
 
     repl = mesh_lib.replicated_sharding(mesh)
     data = mesh_lib.batch_sharding(mesh)
+    if state_shardings is None:
+        state_in, state_out = repl, repl
+    else:
+        # TP (or any custom layout): consume and produce the state exactly
+        # as created — params stay model-axis sharded across steps.
+        state_in = state_out = state_shardings
     return jax.jit(
         step_fn,
-        in_shardings=(repl, data),
-        out_shardings=(repl, repl),
+        in_shardings=(state_in, data),
+        out_shardings=(state_out, repl),
         donate_argnums=(0,) if donate else (),
     )
 
 
 def make_eval_step(model, loss_weights: tuple[float, ...] | None = None,
                    mesh=None, loss_type: str = "multi_sigmoid",
-                   preprocess: Callable[[Batch], Batch] | None = None):
+                   preprocess: Callable[[Batch], Batch] | None = None,
+                   state_shardings=None):
     """Jitted ``(state, batch) -> (outputs, loss)`` inference step
     (reference val loop body, train_pascal.py:245-254).  Outputs are the
     model's logit tuple; sigmoid/thresholding happen in the evaluator, which
@@ -259,5 +298,6 @@ def make_eval_step(model, loss_weights: tuple[float, ...] | None = None,
         return jax.jit(step_fn)
     repl = mesh_lib.replicated_sharding(mesh)
     data = mesh_lib.batch_sharding(mesh)
-    return jax.jit(step_fn, in_shardings=(repl, data),
+    state_in = repl if state_shardings is None else state_shardings
+    return jax.jit(step_fn, in_shardings=(state_in, data),
                    out_shardings=(data, repl))

@@ -1,0 +1,67 @@
+"""Tensor parallelism: parameter sharding over the ``model`` mesh axis.
+
+The reference is pure data parallel (``nn.DataParallel``,
+train_pascal.py:92; SURVEY.md §2.5 marks TP "ABSENT"), and for its model
+sizes replication is the right call.  This module makes the mesh's reserved
+``model`` axis *live* for when it isn't: parameters whose output-channel
+dimension divides the axis size are sharded over it, and GSPMD partitions
+the matmuls/convs that consume them (each device holds and computes 1/Nth of
+the output channels) and inserts the boundary collectives.
+
+The GSPMD idiom, not a hand-sharded model: the model code is unchanged;
+sharding enters only as (a) ``PartitionSpec`` constraints on the parameter
+pytree at init (:func:`tp_param_specs` + ``create_train_state``) and (b) the
+train step's input shardings derived from the live state
+(:func:`state_shardings`).  Optimizer state (momentum) inherits the param
+layout through propagation, so optimizer memory is sharded too — the
+"ZeRO-3-ish for free" property of the XLA partitioner.
+
+Convnet reality check: with BatchNorm between layers, TP inserts an
+all-gather per BN boundary, so this pays off only for attention-heavy heads
+or very wide layers.  The knob (``mesh.shard_params``) defaults off; data
+parallel stays the reference-parity configuration.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import MODEL_AXIS
+
+
+def tp_param_specs(params: Any, mesh: Mesh, min_dim: int = 64) -> Any:
+    """PartitionSpec pytree for ``params``: shard the trailing
+    (output-channel) dim of every rank>=2 kernel over ``model`` when it
+    divides the axis size and is at least ``min_dim`` wide; everything else
+    (biases, BN scales, gammas) replicated.
+
+    ``params`` may be a pytree of arrays or of ``ShapeDtypeStruct``.
+    """
+    model = mesh.shape[MODEL_AXIS]
+
+    def spec_of(leaf):
+        shape = leaf.shape
+        if (model > 1 and len(shape) >= 2 and shape[-1] >= min_dim
+                and shape[-1] % model == 0):
+            return P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
+        return P()
+
+    return jax.tree.map(spec_of, params)
+
+
+def state_shardings(state) -> Any:
+    """The live state's sharding pytree — feed to ``make_train_step`` so the
+    compiled step consumes/produces exactly the layout ``create_train_state``
+    built (replicated for DP, param-sharded for TP)."""
+    return jax.tree.map(lambda x: x.sharding, state)
+
+
+def constrain(tree: Any, mesh: Mesh, specs: Any):
+    """``with_sharding_constraint`` a pytree with a matching spec pytree."""
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, s)),
+        tree, specs)

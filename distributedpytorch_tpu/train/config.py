@@ -77,7 +77,8 @@ class OptimConfig:
 @dataclass
 class MeshConfig:
     data: int | None = None             # None = all devices
-    model: int = 1
+    model: int = 1                      # tensor-parallel axis size
+    shard_params: bool = False          # TP: shard kernels over `model`
 
 
 @dataclass

@@ -46,6 +46,7 @@ from ..parallel import (
     make_mesh,
     make_train_step,
     prefetch_to_device,
+    state_shardings,
 )
 from ..utils.helpers import generate_param_report
 from . import config as config_lib
@@ -192,16 +193,19 @@ class Trainer:
         with self.mesh:
             self.state = create_train_state(
                 jax.random.PRNGKey(cfg.seed), self.model, self.tx,
-                (1, h, w, cfg.model.in_channels), mesh=self.mesh)
+                (1, h, w, cfg.model.in_channels), mesh=self.mesh,
+                shard_params=cfg.mesh.shard_params)
         loss_type = ("multi_softmax" if cfg.task == "semantic"
                      else "multi_sigmoid")
+        # TP layouts flow from the created state into the compiled steps.
+        st_sh = state_shardings(self.state) if cfg.mesh.shard_params else None
         self.train_step = make_train_step(
             self.model, self.tx, loss_weights=cfg.model.loss_weights,
             accum_steps=cfg.optim.accum_steps, mesh=self.mesh,
-            loss_type=loss_type)
+            loss_type=loss_type, state_shardings=st_sh)
         self.eval_step = make_eval_step(
             self.model, loss_weights=cfg.model.loss_weights, mesh=self.mesh,
-            loss_type=loss_type)
+            loss_type=loss_type, state_shardings=st_sh)
 
         # --- checkpointing
         self.ckpt = CheckpointManager(

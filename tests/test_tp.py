@@ -1,0 +1,134 @@
+"""Tensor parallelism over the ``model`` mesh axis (parallel/tp.py).
+
+The reference is pure data-parallel (SURVEY.md §2.5, TP "ABSENT"); here the
+reserved ``model`` axis is live: kernel output channels and momentum shard
+over it, GSPMD partitions the consuming convs, and the math must be
+indistinguishable from the replicated run."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributedpytorch_tpu.models import build_model
+from distributedpytorch_tpu.parallel import (
+    create_train_state,
+    make_eval_step,
+    make_mesh,
+    make_train_step,
+    shard_batch,
+    state_shardings,
+    tp_param_specs,
+)
+
+
+def tp_setup(model_axis=2, accum=1):
+    mesh = make_mesh(data=8 // model_axis, model=model_axis)
+    model = build_model("danet", nclass=1, backbone="resnet18",
+                        output_stride=8)
+    tx = optax.sgd(1e-3, momentum=0.9)
+    with mesh:
+        state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                                   (1, 32, 32, 4), mesh=mesh,
+                                   shard_params=True)
+    step = make_train_step(model, tx, mesh=mesh, accum_steps=accum,
+                           state_shardings=state_shardings(state))
+    return mesh, model, tx, state, step
+
+
+def batch_for(mesh, n=8, seed=0):
+    r = np.random.RandomState(seed)
+    return shard_batch(mesh, {
+        "concat": r.uniform(0, 255, (n, 32, 32, 4)).astype(np.float32),
+        "crop_gt": (r.uniform(size=(n, 32, 32)) > 0.7).astype(np.float32),
+    })
+
+
+def n_model_sharded(tree):
+    return sum(1 for x in jax.tree.leaves(tree)
+               if x.sharding.spec and x.sharding.spec[-1] == "model")
+
+
+class TestSpecs:
+    def test_rule_shards_wide_kernels_only(self):
+        mesh = make_mesh(data=4, model=2)
+        params = {
+            "conv": {"kernel": jnp.zeros((3, 3, 64, 128))},
+            "narrow": {"kernel": jnp.zeros((3, 3, 4, 8))},   # < min_dim
+            "odd": {"kernel": jnp.zeros((3, 3, 64, 65))},    # indivisible
+            "bias": {"bias": jnp.zeros((128,))},             # rank 1
+        }
+        specs = tp_param_specs(params, mesh)
+        assert specs["conv"]["kernel"] == P(None, None, None, "model")
+        assert specs["narrow"]["kernel"] == P()
+        assert specs["odd"]["kernel"] == P()
+        assert specs["bias"]["bias"] == P()
+
+    def test_model_axis_1_shards_nothing(self):
+        mesh = make_mesh(data=8, model=1)
+        specs = tp_param_specs({"k": jnp.zeros((3, 3, 64, 128))}, mesh)
+        assert specs["k"] == P()
+
+
+class TestTPState:
+    def test_params_and_momentum_shard(self):
+        _, _, _, state, _ = tp_setup()
+        assert n_model_sharded(state.params) > 0
+        # Optimizer memory shards identically (shape-based rule).
+        assert n_model_sharded(state.opt_state) == \
+            n_model_sharded(state.params)
+        # Small leaves stay replicated.
+        assert state.step.sharding.spec == P()
+        assert state.rng.sharding.spec == P()
+        for x in jax.tree.leaves(state.batch_stats):
+            assert x.sharding.spec == P()
+
+
+class TestTPTraining:
+    def test_step_preserves_layout_and_matches_dp(self):
+        mesh, model, tx, state, step = tp_setup()
+        batch = batch_for(mesh)
+        with mesh:
+            st2, tp_loss = step(state, batch)
+        assert n_model_sharded(st2.params) == n_model_sharded(state.params)
+
+        with mesh:
+            dp_state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                                          (1, 32, 32, 4), mesh=mesh)
+            dp_step = make_train_step(model, tx, mesh=mesh)
+            _, dp_loss = dp_step(dp_state, batch_for(mesh))
+        np.testing.assert_allclose(float(tp_loss), float(dp_loss),
+                                   rtol=1e-5)
+
+    def test_two_steps_match_dp_trajectory(self):
+        mesh, model, tx, state, step = tp_setup()
+        with mesh:
+            dp_state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                                          (1, 32, 32, 4), mesh=mesh)
+        dp_step = make_train_step(model, tx, mesh=mesh)
+        losses_tp, losses_dp = [], []
+        with mesh:
+            for i in range(2):
+                state, l1 = step(state, batch_for(mesh, seed=i))
+                dp_state, l2 = dp_step(dp_state, batch_for(mesh, seed=i))
+                losses_tp.append(float(l1))
+                losses_dp.append(float(l2))
+        np.testing.assert_allclose(losses_tp, losses_dp, rtol=1e-5)
+
+    def test_grad_accum_under_tp(self):
+        mesh, _, _, state, step = tp_setup(accum=2)
+        with mesh:
+            st2, loss = step(state, batch_for(mesh))
+        assert np.isfinite(float(loss))
+        assert n_model_sharded(st2.params) > 0
+
+    def test_eval_step_accepts_tp_state(self):
+        mesh, model, tx, state, _ = tp_setup()
+        ev = make_eval_step(model, mesh=mesh,
+                            state_shardings=state_shardings(state))
+        with mesh:
+            outputs, loss = ev(state, batch_for(mesh))
+        assert np.isfinite(float(loss))
+        assert outputs[0].shape == (8, 32, 32, 1)
