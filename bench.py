@@ -58,7 +58,10 @@ def _accelerator_healthy(timeout_s: int = 240) -> tuple[bool, str]:
 
 # An env-forced CPU run cannot exhibit the tunneled-plugin hang and the
 # fallback action is already in effect — skip the probe's startup cost.
-if os.environ.get("JAX_PLATFORMS") != "cpu":
+# DPTPU_BENCH_PROBE=0 skips it too (healthy hosts pay a second backend
+# init for the probe child; opt out when the accelerator is known good).
+if os.environ.get("DPTPU_BENCH_PROBE") != "0" and \
+        os.environ.get("JAX_PLATFORMS") != "cpu":
     _ok, _why = _accelerator_healthy()
     if not _ok:
         print(f"bench: default backend unhealthy ({_why}) — "

@@ -43,10 +43,15 @@ def build_train_transform(
     scales: tuple[float, float] = (0.75, 1.25),
     alpha: float = 0.6,
     guidance: str = "nellipse_gaussians",
+    flip: bool = True,
 ) -> T.Compose:
-    """The training augmentation stack (reference train_pascal.py:123-134)."""
+    """The training augmentation stack (reference train_pascal.py:123-134).
+
+    ``flip=False`` drops the host-side horizontal flip — used when the
+    on-device augmentation stage (ops.augment) owns flipping instead.
+    """
     chain: list[T.Transform] = [
-        T.RandomHorizontalFlip(),
+        *([T.RandomHorizontalFlip()] if flip else []),
         T.ScaleNRotate(rots=rots, scales=scales),
         T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
                              relax=relax, zero_pad=zero_pad),
@@ -110,13 +115,18 @@ def build_semantic_train_transform(
     crop_size: tuple[int, int] = (513, 513),
     rots: tuple[float, float] = (-10, 10),
     scales: tuple[float, float] = (0.5, 2.0),
+    flip: bool = True,
 ) -> T.Compose:
     """Multi-class semantic pipeline (the DeepLabV3 configs of BASELINE.md):
     flip -> scale/rotate with nearest-warped class ids (``semseg=True``) ->
     fixed resize (gt nearest, 255 void preserved in-band) -> rename onto the
-    step contract (``concat``/``crop_gt``)."""
+    step contract (``concat``/``crop_gt``).
+
+    ``flip=False`` drops the host flip when the on-device augmentation
+    stage owns it (``data.device_augment``).
+    """
     return T.Compose([
-        T.RandomHorizontalFlip(),
+        *([T.RandomHorizontalFlip()] if flip else []),
         T.ScaleNRotate(rots=rots, scales=scales, semseg=True),
         T.FixedResize(resolutions={"image": crop_size, "gt": crop_size},
                       flagvals={"image": None, "gt": 0}),

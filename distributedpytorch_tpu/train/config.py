@@ -44,6 +44,7 @@ class DataConfig:
     num_workers: int = 2                # loader threads (train_pascal.py:161)
     prefetch: int = 2                   # host-side decoded-batch buffer
     device_prefetch: int = 2            # batches placed on-device ahead
+    device_augment: bool = False        # flip on-device (fused into step)
 
 
 @dataclass

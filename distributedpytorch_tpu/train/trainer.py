@@ -123,7 +123,8 @@ class Trainer:
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                 zero_pad=cfg.data.zero_pad, rots=cfg.data.rots,
                 scales=cfg.data.scales, alpha=cfg.data.guidance_alpha,
-                guidance=cfg.data.guidance)
+                guidance=cfg.data.guidance,
+                flip=not cfg.data.device_augment)
             val_tf = build_eval_transform(
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                 zero_pad=cfg.data.zero_pad, alpha=cfg.data.guidance_alpha,
@@ -140,7 +141,8 @@ class Trainer:
                 root, split=cfg.data.train_split,
                 transform=build_semantic_train_transform(
                     crop_size=cfg.data.crop_size, rots=cfg.data.rots,
-                    scales=cfg.data.scales))
+                    scales=cfg.data.scales,
+                    flip=not cfg.data.device_augment))
             self.val_set = VOCSemanticSegmentation(
                 root, split=cfg.data.val_split,
                 transform=build_semantic_eval_transform(
@@ -179,6 +181,15 @@ class Trainer:
             seed=cfg.seed, num_workers=cfg.data.num_workers,
             prefetch=cfg.data.prefetch,
             num_shards=n_proc, shard_index=jax.process_index())
+        if len(self.train_loader) == 0:
+            # drop_last swallows a sub-batch-size dataset whole; training
+            # would silently run zero steps per epoch (NaN epoch loss).
+            raise ValueError(
+                f"train loader is empty: dataset has {len(self.train_set)} "
+                f"samples globally (~{len(self.train_set) // n_proc} on "
+                f"this host's shard) but the per-host batch is "
+                f"{tb // n_proc} with drop_last — lower data.train_batch or "
+                "enlarge the dataset")
 
         # --- model / optimizer / state
         self.model = build_model(
@@ -199,10 +210,14 @@ class Trainer:
                      else "multi_sigmoid")
         # TP layouts flow from the created state into the compiled steps.
         st_sh = state_shardings(self.state) if cfg.mesh.shard_params else None
+        augment = None
+        if cfg.data.device_augment:  # both tasks: flip owns the same keys
+            from ..ops.augment import make_device_augment
+            augment = make_device_augment(hflip=True)  # host flip disabled
         self.train_step = make_train_step(
             self.model, self.tx, loss_weights=cfg.model.loss_weights,
             accum_steps=cfg.optim.accum_steps, mesh=self.mesh,
-            loss_type=loss_type, state_shardings=st_sh)
+            loss_type=loss_type, state_shardings=st_sh, augment=augment)
         self.eval_step = make_eval_step(
             self.model, loss_weights=cfg.model.loss_weights, mesh=self.mesh,
             loss_type=loss_type, state_shardings=st_sh)

@@ -148,19 +148,20 @@ class TestTrainerPreemption:
         # Stop consensus landing on a step that already has a checkpoint
         # (the interrupted epoch contributed zero steps) must not re-save —
         # Orbax rejects duplicate steps.
-        # A global batch larger than the dataset + drop_last makes every
-        # train epoch empty: the step counter sits exactly on the manually
-        # checkpointed step when the stop consensus fires.
-        cfg = tiny_cfg(tmp_path, **{"epochs": 3, "data.train_batch": 512})
+        # The fake train set has exactly one batch per epoch, so a stop
+        # consensus after epoch 0 lands on step 1 — pre-save a checkpoint
+        # at that step and the preempt branch must skip the duplicate.
+        cfg = tiny_cfg(tmp_path, **{"epochs": 3})
         tr = Trainer(cfg)
-        step = int(tr.state.step)
-        tr.ckpt.save(step, tr.state, extra={"epoch": -1})
-        guard = PreemptionGuard(check_every=1)
+        assert len(tr.train_loader) == 1
+        landing_step = int(tr.state.step) + 1
+        tr.ckpt.save(landing_step, tr.state, extra={"epoch": -1})
+        guard = PreemptionGuard(check_every=10**9)  # stop only at boundary
         with guard:
             guard.trip()
             hist = tr.fit(guard)
         assert hist.get("preempted") is True
-        assert tr.ckpt.latest_step() == step      # no duplicate save
+        assert tr.ckpt.latest_step() == landing_step  # no duplicate save
         _, meta = tr.ckpt.restore(tr.state)
         assert "preempted" not in meta            # original meta untouched
         tr.close()

@@ -100,3 +100,33 @@ class TestSemanticTrainerEndToEnd:
         assert 0.0 <= m["pixel_acc"] <= 1.0
         assert len(m["per_class_iou"]) == 21
         tr.close()
+
+
+class TestSemanticDeviceAugment:
+    def test_fit_semantic_with_device_augment(self, tmp_path):
+        import dataclasses
+        from distributedpytorch_tpu.data import make_fake_voc
+        from distributedpytorch_tpu.data import transforms as T
+        from distributedpytorch_tpu.train import Config, Trainer, apply_overrides
+
+        # Per-image (semantic) samples: need >= train_batch images.
+        root = make_fake_voc(str(tmp_path / "voc"), n_images=12,
+                             size=(96, 128), n_val=3, seed=0)
+        cfg = dataclasses.replace(apply_overrides(Config(), [
+            "task=semantic", "model.name=deeplabv3", "model.nclass=21",
+            "model.backbone=resnet18", "model.in_channels=3",
+            "data.train_batch=8", "data.val_batch=2",
+            "data.crop_size=[48,48]", "optim.lr=1e-3",
+            "checkpoint.async_save=false", "epochs=1",
+            "log_every_steps=10000", "data.device_augment=true"]),
+            work_dir=str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, root=root))
+        tr = Trainer(cfg)
+        assert not any(isinstance(s, T.RandomHorizontalFlip)
+                       for s in tr.train_set.transform.transforms)
+        hist = tr.fit()
+        tr.close()
+        import numpy as np
+        assert np.isfinite(hist["train_loss"][0])
+        assert 0.0 <= hist["val"][-1]["miou"] <= 1.0

@@ -258,3 +258,38 @@ class TestCrashRecoveryTrajectory:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
         tr_a.close()
         tr_c.close()
+
+
+class TestDeviceAugment:
+    def test_host_flip_dropped_when_disabled(self):
+        from distributedpytorch_tpu.data import build_train_transform
+        from distributedpytorch_tpu.data import transforms as T
+        stages = build_train_transform(flip=False).transforms
+        assert not any(isinstance(s, T.RandomHorizontalFlip) for s in stages)
+        stages_on = build_train_transform(flip=True).transforms
+        assert any(isinstance(s, T.RandomHorizontalFlip) for s in stages_on)
+
+    def test_fit_with_device_augment(self, tiny_cfg, tmp_path):
+        cfg = dataclasses.replace(
+            tiny_cfg,
+            data=dataclasses.replace(tiny_cfg.data, device_augment=True),
+            epochs=1, work_dir=str(tmp_path / "runs"))
+        tr = Trainer(cfg)
+        # The host pipeline must not flip (device stage owns it) ...
+        from distributedpytorch_tpu.data import transforms as T
+        assert not any(isinstance(s, T.RandomHorizontalFlip)
+                       for s in tr.train_set.transform.transforms)
+        hist = tr.fit()
+        tr.close()
+        assert np.isfinite(hist["train_loss"][0])
+        assert 0.0 <= hist["val"][-1]["jaccard"] <= 1.0
+
+
+class TestEmptyLoaderGuard:
+    def test_oversized_batch_raises_at_construction(self, tiny_cfg, tmp_path):
+        cfg = dataclasses.replace(
+            tiny_cfg,
+            data=dataclasses.replace(tiny_cfg.data, train_batch=512),
+            work_dir=str(tmp_path / "runs"))
+        with pytest.raises(ValueError, match="train loader is empty"):
+            Trainer(cfg)
