@@ -109,6 +109,7 @@ class Config:
     debug_asserts: bool = False         # data-contract checks (…:188-190)
     log_every_steps: int = 50
     experiment_name: str = "experiment"
+    profile_epoch: int | None = None    # XPlane-trace this epoch (0-based)
 
 
 def _to_jsonable(obj: Any) -> Any:

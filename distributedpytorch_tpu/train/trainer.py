@@ -197,7 +197,7 @@ class Trainer:
             backbone=cfg.model.backbone, output_stride=cfg.model.output_stride,
             dtype=cfg.model.dtype, pam_block_size=cfg.model.pam_block_size,
             pam_impl=cfg.model.pam_impl, remat=cfg.model.remat)
-        steps_per_epoch = max(len(self.train_loader), 1)
+        steps_per_epoch = len(self.train_loader)  # > 0: guarded above
         total_steps = steps_per_epoch * cfg.epochs
         self.tx, self.schedule = make_optimizer(cfg.optim, total_steps)
         h, w = cfg.data.crop_size
@@ -376,13 +376,27 @@ class Trainer:
         programmatically (e.g. a wall-clock watchdog calling ``trip()``)."""
         cfg = self.cfg
         history = {"train_loss": [], "val": []}
+        if cfg.profile_epoch is not None and self.is_main and not \
+                (self.start_epoch <= cfg.profile_epoch < cfg.epochs):
+            print(f"warning: profile_epoch={cfg.profile_epoch} outside the "
+                  f"epoch range [{self.start_epoch}, {cfg.epochs}) — no "
+                  "trace will be written", flush=True)
         with contextlib.ExitStack() as stack:
             if guard is None and cfg.checkpoint.save_on_preempt:
                 guard = stack.enter_context(PreemptionGuard(
                     check_every=cfg.checkpoint.preempt_check_every))
             for epoch in range(self.start_epoch, cfg.epochs):
                 t0 = time.perf_counter()
-                epoch_loss = self.train_epoch(epoch, guard=guard)
+                if cfg.profile_epoch == epoch and self.is_main:
+                    # On-demand op-level device trace (SURVEY §5.1: the
+                    # reference had only wall-clock prints).  One epoch,
+                    # written under the run dir for tensorboard/xprof.
+                    from ..utils.profiling import trace
+                    ctx = trace(os.path.join(self.run_dir, "profile"))
+                else:
+                    ctx = contextlib.nullcontext()
+                with ctx:
+                    epoch_loss = self.train_epoch(epoch, guard=guard)
                 step = int(self.state.step)
                 if guard is not None and guard.should_stop():
                     # The partial epoch is not appended to history — it will

@@ -293,3 +293,19 @@ class TestEmptyLoaderGuard:
             work_dir=str(tmp_path / "runs"))
         with pytest.raises(ValueError, match="train loader is empty"):
             Trainer(cfg)
+
+
+class TestProfileEpoch:
+    def test_profile_epoch_writes_trace(self, tiny_cfg, tmp_path):
+        cfg = dataclasses.replace(
+            tiny_cfg, epochs=1, eval_every=0, work_dir=str(tmp_path / "runs"),
+            profile_epoch=0)
+        tr = Trainer(cfg)
+        tr.fit()
+        prof_dir = os.path.join(tr.run_dir, "profile")
+        tr.close()
+        assert os.path.isdir(prof_dir)
+        found = []
+        for dirpath, _, files in os.walk(prof_dir):
+            found += [f for f in files if f.endswith(".xplane.pb")]
+        assert found, "no xplane trace written"
