@@ -109,6 +109,22 @@ def compute_nellipse(
     caller scales the [0,1] map by 255).
     """
     points = np.asarray(points, dtype=np.float32)
+    if points.size == 0:
+        # Keep backends identical: the numpy path would raise from max([]),
+        # the native kernel would return an all-ones map.
+        raise ValueError("compute_nellipse requires at least one focal point")
+    xx = np.asarray(x_range)
+    yy = np.asarray(y_range)
+    from .. import native_ops
+    if (native_ops.enabled() and xx.ndim == 1 and yy.ndim == 1
+            and xx.size and yy.size
+            and np.array_equal(xx, np.arange(xx.size))
+            and np.array_equal(yy, np.arange(yy.size))):
+        # The hot path: full 0-based pixel grids (every transform call site)
+        # go to the native rasterizer — the numpy form below dominates the
+        # per-sample augmentation budget at 512² otherwise.
+        return native_ops.nellipse(points[:, :2], (yy.size, xx.size),
+                                   softness)
     d = _sum_of_distances(x_range, y_range, points)
     # Sum-of-distances value at each focal point; the largest defines the
     # boundary constant so every click point is enclosed.

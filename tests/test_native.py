@@ -64,12 +64,28 @@ class TestKernelParity:
             want = np.maximum(want, make_gaussian((37, 53), (px, py), 10.0))
         np.testing.assert_allclose(got, want, atol=1e-5)
 
-    def test_nellipse_matches_numpy(self):
+    def test_nellipse_matches_numpy(self, monkeypatch):
         from distributedpytorch_tpu.data.guidance import compute_nellipse
         pts = np.array([[10, 5], [40, 30], [5, 30], [25, 2]], np.float32)
         got = native_ops.nellipse(pts, (37, 53))
+        # compute_nellipse itself dispatches to native on pixel grids; force
+        # the numpy path so this stays a cross-implementation check.
+        monkeypatch.setenv("DPTPU_NATIVE", "0")
         want = compute_nellipse(np.arange(53), np.arange(37), pts)
         np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_compute_nellipse_dispatch_equals_numpy(self, monkeypatch):
+        # The guidance entry point must give the same map whichever backend
+        # serves it (including non-grid ranges, which always go numpy).
+        from distributedpytorch_tpu.data.guidance import compute_nellipse
+        pts = np.array([[100.5, 30.2], [400, 250], [60, 480], [300, 90]],
+                       np.float32)
+        monkeypatch.delenv("DPTPU_NATIVE", raising=False)
+        assert native_ops.enabled()  # else this test compares numpy to numpy
+        native = compute_nellipse(np.arange(512), np.arange(512), pts)
+        monkeypatch.setenv("DPTPU_NATIVE", "0")
+        ref = compute_nellipse(np.arange(512), np.arange(512), pts)
+        np.testing.assert_allclose(native, ref, atol=1e-4)
 
     def test_rotation_matrix_matches_cv2(self):
         cv2 = pytest.importorskip("cv2")
