@@ -40,31 +40,60 @@ def main() -> None:
 
     import dataclasses
 
-    from distributedpytorch_tpu.train import Config, Trainer, apply_overrides
+    from distributedpytorch_tpu.train import (
+        Config,
+        PreemptionGuard,
+        Trainer,
+        apply_overrides,
+    )
 
-    cfg = apply_overrides(Config(), [
+    mode = os.environ.get("MODE", "train")
+    overrides = [
         "data.train_batch=8", "data.val_batch=2", "data.crop_size=[48,48]",
         "data.relax=8", "data.area_thres=0", "data.num_workers=2",
         "model.backbone=resnet18", "model.output_stride=8",
         "optim.lr=1e-4", "checkpoint.async_save=false",
         "epochs=1", "eval_every=1", "log_every_steps=1",
-    ])
+    ]
+    if mode == "preempt":
+        overrides += ["epochs=200", "eval_every=0",
+                      "checkpoint.snapshot_every=0", "log_every_steps=10000"]
+    cfg = apply_overrides(Config(), overrides)
     cfg = dataclasses.replace(
         cfg, work_dir=os.environ["WORK_DIR"],
         data=dataclasses.replace(cfg.data, root=os.environ["DATA_ROOT"]))
 
     trainer = Trainer(cfg)
-    history = trainer.fit()
-    metrics = history["val"][-1]
+    if mode == "preempt":
+        # The "signal" lands on process 1 ONLY; the consensus allgather must
+        # stop BOTH processes at the same step, checkpoint once, and return.
+        guard = PreemptionGuard(check_every=1)
+        if proc_id == 1:
+            import threading
+            threading.Timer(8.0, guard.trip).start()
+        with guard:
+            history = trainer.fit(guard)
+        extra = {
+            "preempted": bool(history.get("preempted")),
+            "locally_tripped": guard.triggered,
+            "epochs_run": len(history["train_loss"]),
+            "state_step": int(trainer.state.step),
+        }
+    else:
+        history = trainer.fit()
+        metrics = history["val"][-1]
+        extra = {
+            "n_local_devices": jax.local_device_count(),
+            "train_loss": round(float(history["train_loss"][0]), 8),
+            "jaccard": round(float(metrics["jaccard"]), 8),
+            "n_samples": metrics["n_samples"],
+            "train_batches": len(trainer.train_loader),
+        }
     result = {
         "proc": proc_id,
         "run_dir": trainer.run_dir,
-        "n_local_devices": jax.local_device_count(),
-        "train_loss": round(float(history["train_loss"][0]), 8),
-        "jaccard": round(float(metrics["jaccard"]), 8),
-        "n_samples": metrics["n_samples"],
         "ckpt_step": trainer.ckpt.latest_step(),
-        "train_batches": len(trainer.train_loader),
+        **extra,
     }
     trainer.close()
     print("MULTIHOST_RESULT " + json.dumps(result), flush=True)

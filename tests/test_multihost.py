@@ -26,8 +26,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_training(tmp_path):
+def _run_two_workers(tmp_path, mode: str = "train") -> dict:
     data_root = make_fake_voc(str(tmp_path / "voc"), n_images=10,
                               size=(80, 100), n_val=3, seed=5)
     work_dir = str(tmp_path / "runs")
@@ -42,7 +41,7 @@ def test_two_process_training(tmp_path):
     for pid in range(2):
         env = dict(os.environ,
                    PROC_ID=str(pid), NUM_PROCS="2", COORD_ADDR=coord,
-                   WORK_DIR=work_dir, DATA_ROOT=data_root)
+                   WORK_DIR=work_dir, DATA_ROOT=data_root, MODE=mode)
         env.pop("XLA_FLAGS", None)  # worker sets its own device count
         log_path = tmp_path / f"worker{pid}.log"
         log_paths.append(log_path)
@@ -68,6 +67,12 @@ def test_two_process_training(tmp_path):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-4000:]}"
 
     assert set(results) == {0, 1}, f"missing results; logs: {logs}"
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_training(tmp_path):
+    results = _run_two_workers(tmp_path, mode="train")
     a, b = results[0], results[1]
     assert a["n_local_devices"] == b["n_local_devices"] == 4
     # both hosts agree on the run dir (broadcast-coordinated)
@@ -81,3 +86,20 @@ def test_two_process_training(tmp_path):
     assert a["ckpt_step"] == b["ckpt_step"] and a["ckpt_step"] is not None
     # each host walked its own disjoint train shard of the epoch
     assert a["train_batches"] == b["train_batches"] >= 1
+
+
+@pytest.mark.slow
+def test_two_process_preemption_consensus(tmp_path):
+    """A stop signal delivered to ONE process must stop BOTH at the same
+    step via the consensus allgather, land one coordinated final
+    checkpoint, and exit cleanly — no hung collectives."""
+    results = _run_two_workers(tmp_path, mode="preempt")
+    a, b = results[0], results[1]
+    # only process 1 received the "signal"...
+    assert not a["locally_tripped"] and b["locally_tripped"]
+    # ...but both stopped, at the same step, well before the 200 epochs
+    assert a["preempted"] and b["preempted"]
+    assert a["epochs_run"] == b["epochs_run"] < 200
+    assert a["state_step"] == b["state_step"] >= 1
+    assert a["ckpt_step"] == b["ckpt_step"] == a["state_step"]
+    assert a["run_dir"] == b["run_dir"]

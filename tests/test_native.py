@@ -76,16 +76,27 @@ class TestKernelParity:
 
     def test_compute_nellipse_dispatch_equals_numpy(self, monkeypatch):
         # The guidance entry point must give the same map whichever backend
-        # serves it (including non-grid ranges, which always go numpy).
+        # serves it.  Non-square grid so an h/w transposition in the
+        # dispatch could not hide.
         from distributedpytorch_tpu.data.guidance import compute_nellipse
-        pts = np.array([[100.5, 30.2], [400, 250], [60, 480], [300, 90]],
+        pts = np.array([[100.5, 30.2], [400, 250], [60, 300], [300, 90]],
                        np.float32)
         monkeypatch.delenv("DPTPU_NATIVE", raising=False)
         assert native_ops.enabled()  # else this test compares numpy to numpy
-        native = compute_nellipse(np.arange(512), np.arange(512), pts)
+        native = compute_nellipse(np.arange(512), np.arange(384), pts)
+        assert native.shape == (384, 512)
         monkeypatch.setenv("DPTPU_NATIVE", "0")
-        ref = compute_nellipse(np.arange(512), np.arange(512), pts)
+        ref = compute_nellipse(np.arange(512), np.arange(384), pts)
         np.testing.assert_allclose(native, ref, atol=1e-4)
+
+    def test_compute_nellipse_non_grid_range_goes_numpy(self):
+        # A non-0-based range must bypass the native kernel (which assumes
+        # pixel grids) and still compute correctly via numpy.
+        from distributedpytorch_tpu.data.guidance import compute_nellipse
+        pts = np.array([[5, 4], [20, 18], [3, 18], [12, 2]], np.float32)
+        shifted = compute_nellipse(np.arange(10, 40), np.arange(5, 30), pts)
+        full = compute_nellipse(np.arange(64), np.arange(64), pts)
+        np.testing.assert_allclose(shifted, full[5:30, 10:40], atol=1e-5)
 
     def test_rotation_matrix_matches_cv2(self):
         cv2 = pytest.importorskip("cv2")
