@@ -89,10 +89,12 @@ class TestKernelParity:
         ref = compute_nellipse(np.arange(512), np.arange(384), pts)
         np.testing.assert_allclose(native, ref, atol=1e-4)
 
-    def test_compute_nellipse_non_grid_range_goes_numpy(self):
+    def test_compute_nellipse_non_grid_range_goes_numpy(self, monkeypatch):
         # A non-0-based range must bypass the native kernel (which assumes
-        # pixel grids) and still compute correctly via numpy.
+        # pixel grids) and still compute correctly via numpy.  Pin BOTH
+        # calls to numpy so the identity is numpy-vs-numpy exact.
         from distributedpytorch_tpu.data.guidance import compute_nellipse
+        monkeypatch.setenv("DPTPU_NATIVE", "0")
         pts = np.array([[5, 4], [20, 18], [3, 18], [12, 2]], np.float32)
         shifted = compute_nellipse(np.arange(10, 40), np.arange(5, 30), pts)
         full = compute_nellipse(np.arange(64), np.arange(64), pts)

@@ -185,3 +185,46 @@ class TestTrainStepIntegration:
         head_same = jax.tree.map(np.array_equal, before["head"],
                                  after["head"])
         assert not all(jax.tree.leaves(head_same)), "head did not train"
+
+
+class TestTorchSGDParity:
+    """train/optim.py claims exact torch SGD semantics (wd added to grad
+    BEFORE momentum).  Lock it against real torch.optim.SGD."""
+
+    def test_three_steps_match_torch(self):
+        torch = pytest.importorskip("torch")
+
+        lr, mom, wd = 0.1, 0.9, 5e-4
+        w0 = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        grads = [np.random.RandomState(i + 1).randn(4, 3).astype(np.float32)
+                 for i in range(3)]
+
+        # torch reference
+        tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+        opt = torch.optim.SGD([tw], lr=lr, momentum=mom, weight_decay=wd)
+        for g in grads:
+            opt.zero_grad()
+            tw.grad = torch.tensor(g.copy())
+            opt.step()
+
+        # ours
+        cfg = OptimConfig(lr=lr, momentum=mom, weight_decay=wd)
+        tx, _ = make_optimizer(cfg, total_steps=10)
+        params = {"w": jnp.asarray(w0)}
+        state = tx.init(params)
+        for g in grads:
+            updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+            params = optax.apply_updates(params, updates)
+
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_poly_schedule_matches_torch_style_decay(self):
+        # poly: lr * (1 - step/total)^power — the reference's LR_Scheduler
+        # ('poly') contract.
+        from distributedpytorch_tpu.train import make_schedule
+        cfg = OptimConfig(lr=0.01, schedule="poly", poly_power=0.9)
+        sched = make_schedule(cfg, total_steps=100)
+        for step in (0, 10, 50, 99):
+            expect = 0.01 * (1 - step / 100) ** 0.9
+            np.testing.assert_allclose(float(sched(step)), expect, rtol=1e-5)
