@@ -18,6 +18,22 @@ from .mesh import (
     replicated_spec,
     shard_batch,
 )
+from .moe import (
+    EXPERT_AXIS,
+    MoEMlp,
+    ep_param_specs,
+    init_moe_params,
+    make_expert_mesh,
+    make_moe_apply,
+    moe_ffn,
+)
+from .pipeline import (
+    PIPE_AXIS,
+    make_pipe_mesh,
+    make_pipeline_apply,
+    make_pipeline_train_step,
+    stage_param_specs,
+)
 from .ring import make_ring_attention, ring_attention_local
 from .tp import state_shardings, tp_param_specs
 from .ulysses import make_ulysses_attention, ulysses_attention_local
@@ -32,10 +48,22 @@ from .step import (
 
 __all__ = [
     "DATA_AXIS",
+    "EXPERT_AXIS",
     "MODEL_AXIS",
+    "PIPE_AXIS",
     "INPUT_KEY",
     "TARGET_KEY",
+    "MoEMlp",
     "TrainState",
+    "ep_param_specs",
+    "init_moe_params",
+    "make_expert_mesh",
+    "make_moe_apply",
+    "make_pipe_mesh",
+    "make_pipeline_apply",
+    "make_pipeline_train_step",
+    "moe_ffn",
+    "stage_param_specs",
     "batch_sharding",
     "batch_spec",
     "create_train_state",

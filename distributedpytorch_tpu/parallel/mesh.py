@@ -56,6 +56,17 @@ def initialize_distributed(coordinator_address: str | None = None,
         )
 
 
+def make_mesh_1d(size: int, axis_name: str, devices=None) -> Mesh:
+    """A 1-D mesh of ``size`` devices over one named axis, in
+    ``jax.devices()`` order so neighbouring mesh coordinates are ICI
+    neighbours (single-hop ``ppermute``s for pipeline/ring schedules).
+    Backs ``pipeline.make_pipe_mesh`` and ``moe.make_expert_mesh``."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if devices.size != size:
+        raise ValueError(f"{devices.size} devices != {size} {axis_name}s")
+    return Mesh(devices.reshape(size), (axis_name,))
+
+
 def make_mesh(data: int | None = None, model: int = 1,
               devices=None) -> Mesh:
     """A 2-D ``(data, model)`` mesh over all (or the given) devices.

@@ -1,0 +1,213 @@
+"""Expert parallelism: a GShard/Switch-style Mixture-of-Experts layer.
+
+The reference has no MoE (SURVEY.md §2.5 marks EP "ABSENT"), but expert
+parallelism completes this framework's parallelism set (data — parallel.step,
+tensor — parallel.tp, pipeline — parallel.pipeline, sequence — parallel.ring
+/ parallel.ulysses).
+
+TPU-native design — the GShard dense-dispatch idiom, not dynamic routing:
+
+* routing is *static-shaped*: every token gets a one-hot dispatch tensor
+  (tokens × experts × capacity) built from a top-1 (Switch) or top-2 router
+  with a fixed per-expert capacity; overflow tokens are dropped (combine
+  weight 0) so no shape ever depends on the data — XLA requirement;
+* expert FFN parameters are one stacked pytree (E, d, h)/(E, h, d) whose
+  leading (expert) dim is sharded over an ``expert`` mesh axis; the dispatch/
+  combine einsums are partitioned by GSPMD, which inserts the all-to-alls
+  that move token slots to their expert's device and back — no hand-written
+  communication;
+* the router's load-balancing auxiliary loss (Shazeer et al.) keeps the
+  dispatch near-uniform so per-expert capacity (and thus per-device compute)
+  stays balanced.
+
+``MoEMlp`` wraps the functional core as a Flax module for use inside model
+heads; :func:`ep_param_specs` + :func:`make_moe_apply` give the meshed
+expert-parallel execution path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import make_mesh_1d
+
+#: canonical expert axis name
+EXPERT_AXIS = "expert"
+
+
+def make_expert_mesh(experts: int, devices=None) -> Mesh:
+    """A 1-D ``(expert,)`` mesh of ``experts`` devices — one expert each."""
+    return make_mesh_1d(experts, EXPERT_AXIS, devices)
+
+
+def expert_capacity(n_tokens: int, n_experts: int,
+                    capacity_factor: float) -> int:
+    """Per-expert token slots: ceil(tokens/experts · factor), min 1."""
+    return max(1, math.ceil(n_tokens / n_experts * capacity_factor))
+
+
+def router(x: jax.Array, w_gate: jax.Array, *, k: int,
+           capacity: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-``k`` token→expert routing with fixed capacity.
+
+    ``x``: (N, d) tokens; ``w_gate``: (d, E).  Returns
+    ``(dispatch, combine, aux_loss)`` with ``dispatch``: (N, E, C) one-hot
+    slot assignment, ``combine``: (N, E, C) gate-weighted dispatch, and the
+    load-balancing auxiliary loss (scalar, ≥ 1 at perfect balance for k=1).
+
+    Slot assignment is a cumsum over token order per expert (GShard's
+    position-in-expert); tokens past ``capacity`` get all-zero rows — dropped,
+    exactly like Switch's overflow (the caller's residual path carries them).
+    """
+    n, _ = x.shape
+    n_experts = w_gate.shape[-1]
+    logits = jnp.einsum("nd,de->ne", x, w_gate,
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
+
+    dispatch = jnp.zeros((n, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros((n, n_experts, capacity), jnp.float32)
+    # Slots consumed per expert by earlier-priority rounds, so the k=2 second
+    # choice allocates after the first choice's tokens.
+    prior_alloc = jnp.zeros((n_experts,), jnp.float32)
+    masked_probs = probs
+    frac_dispatched = jnp.zeros((n_experts,), jnp.float32)
+    for _ in range(k):
+        choice = jnp.argmax(masked_probs, axis=-1)  # (N,)
+        onehot = jax.nn.one_hot(choice, n_experts)  # (N, E)
+        gate = (probs * onehot).sum(-1)  # (N,)
+        # Slot index = same-expert tokens ahead of me (+ earlier-round
+        # claims); exclusive cumsum keeps it static-shaped.
+        ahead = jnp.cumsum(onehot, axis=0) - onehot + prior_alloc[None, :]
+        pos = (ahead * onehot).sum(-1).astype(jnp.int32)  # (N,)
+        # one_hot of an out-of-capacity position is the zero row — overflow
+        # tokens drop out of dispatch/combine with no dynamic shapes.
+        slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # (N, C)
+        d = onehot[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + gate[:, None, None] * d
+        frac_dispatched = frac_dispatched + onehot.mean(0)
+        prior_alloc = prior_alloc + onehot.sum(0)
+        # the next round must pick a different expert per token
+        masked_probs = jnp.where(onehot > 0, -jnp.inf, masked_probs)
+    # Load-balancing loss: E · Σ_e (token fraction to e) · (mean prob of e).
+    aux = n_experts * jnp.sum((frac_dispatched / k) * probs.mean(0))
+    return dispatch, combine, aux
+
+
+def moe_ffn(stacked: dict[str, jax.Array], x: jax.Array, *, k: int = 1,
+            capacity_factor: float = 1.25,
+            mesh: Mesh | None = None) -> tuple[jax.Array, jax.Array]:
+    """The functional MoE FFN: route, dispatch, per-expert MLP, combine.
+
+    ``stacked``: {'w_gate': (d, E), 'w1': (E, d, h), 'b1': (E, h),
+    'w2': (E, h, d), 'b2': (E, d)}.  ``x``: (N, d) tokens.  Returns
+    ``(y, aux_loss)`` with ``y``: (N, d); dropped tokens produce zero rows
+    (callers keep a residual connection, as in Switch).
+
+    With ``mesh``, expert-dim intermediates are sharding-constrained to the
+    ``expert`` axis so GSPMD runs each expert's matmuls on its own device and
+    inserts the dispatch/return all-to-alls.
+    """
+    n, d = x.shape
+    n_experts = stacked["w1"].shape[0]
+    capacity = expert_capacity(n, n_experts, capacity_factor)
+    dispatch, combine, aux = router(x, stacked["w_gate"], k=k,
+                                    capacity=capacity)
+    # (N,E,C)·(N,d) -> (E,C,d): the all-to-all boundary under EP.
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x,
+                           preferred_element_type=jnp.float32)
+    if mesh is not None:
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P(EXPERT_AXIS)))
+    h = jax.nn.relu(
+        jnp.einsum("ecd,edh->ech", expert_in, stacked["w1"],
+                   preferred_element_type=jnp.float32)
+        + stacked["b1"][:, None, :])
+    out = jnp.einsum("ech,ehd->ecd", h, stacked["w2"],
+                     preferred_element_type=jnp.float32) \
+        + stacked["b2"][:, None, :]
+    if mesh is not None:
+        out = jax.lax.with_sharding_constraint(
+            out, NamedSharding(mesh, P(EXPERT_AXIS)))
+    y = jnp.einsum("nec,ecd->nd", combine, out,
+                   preferred_element_type=jnp.float32)
+    return y.astype(x.dtype), aux
+
+
+def ep_param_specs(stacked: dict[str, Any]) -> dict[str, P]:
+    """PartitionSpec pytree: expert-stacked leaves sharded on their leading
+    (expert) dim; the router gate replicated."""
+    return {
+        k: (P() if k == "w_gate"
+            else P(*([EXPERT_AXIS] + [None] * (v.ndim - 1))))
+        for k, v in stacked.items()
+    }
+
+
+def make_moe_apply(mesh: Mesh, *, k: int = 1, capacity_factor: float = 1.25):
+    """Jitted expert-parallel ``(stacked_params, tokens) -> (y, aux)``:
+    expert-stacked params sharded over the ``expert`` axis, tokens
+    replicated in/out.  GSPMD owns the all-to-alls."""
+
+    def global_fn(stacked, x):
+        return moe_ffn(stacked, x, k=k, capacity_factor=capacity_factor,
+                       mesh=mesh)
+
+    def place(stacked):
+        specs = ep_param_specs(stacked)
+        return {kk: jax.device_put(v, NamedSharding(mesh, specs[kk]))
+                for kk, v in stacked.items()}
+
+    return jax.jit(global_fn), place
+
+
+def init_moe_params(rng: jax.Array, *, d: int, hidden: int,
+                    n_experts: int) -> dict[str, jax.Array]:
+    """LeCun-normal expert stacks + zero biases + small router."""
+    kg, k1, k2 = jax.random.split(rng, 3)
+    init = nn.initializers.lecun_normal()
+    return {
+        "w_gate": init(kg, (d, n_experts), jnp.float32),
+        "w1": init(k1, (n_experts, d, hidden), jnp.float32),
+        "b1": jnp.zeros((n_experts, hidden), jnp.float32),
+        "w2": init(k2, (n_experts, hidden, d), jnp.float32),
+        "b2": jnp.zeros((n_experts, d), jnp.float32),
+    }
+
+
+class MoEMlp(nn.Module):
+    """Flax wrapper: tokens (B, N, d) -> (B, N, d) with a residual carrying
+    dropped tokens; stores the aux loss in the ``losses`` collection so a
+    training loss can add ``aux_weight * moe_aux``."""
+
+    n_experts: int
+    hidden: int
+    k: int = 1
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x):
+        b, n, d = x.shape
+        stacked = {
+            "w_gate": self.param("w_gate", nn.initializers.lecun_normal(),
+                                 (d, self.n_experts)),
+            "w1": self.param("w1", nn.initializers.lecun_normal(),
+                             (self.n_experts, d, self.hidden)),
+            "b1": self.param("b1", nn.initializers.zeros,
+                             (self.n_experts, self.hidden)),
+            "w2": self.param("w2", nn.initializers.lecun_normal(),
+                             (self.n_experts, self.hidden, d)),
+            "b2": self.param("b2", nn.initializers.zeros,
+                             (self.n_experts, d)),
+        }
+        y, aux = moe_ffn(stacked, x.reshape(b * n, d), k=self.k,
+                         capacity_factor=self.capacity_factor)
+        self.sow("losses", "moe_aux", aux)
+        return x + y.reshape(b, n, d)

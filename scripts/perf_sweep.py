@@ -1,0 +1,74 @@
+"""Perf sweep on the real chip: bench.py's config across batch size and
+PAM attention implementations.  Prints one JSON line per variant."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+import optax
+
+from distributedpytorch_tpu.models import build_model
+from distributedpytorch_tpu.parallel import (
+    create_train_state,
+    make_mesh,
+    make_train_step,
+    shard_batch,
+)
+from distributedpytorch_tpu.utils.profiling import throughput
+
+SIZE = 512
+
+
+def run(batch: int, pam_impl: str, block: int | None, remat: bool) -> float:
+    mesh = make_mesh()
+    n = mesh.devices.size
+    model = build_model("danet", nclass=1, backbone="resnet101",
+                        output_stride=8, dtype="bfloat16",
+                        pam_impl=pam_impl, pam_block_size=block, remat=remat)
+    tx = optax.sgd(1e-3, momentum=0.9)
+    r = np.random.RandomState(0)
+    host = {
+        "concat": r.uniform(0, 255, (batch * n, SIZE, SIZE, 4)
+                            ).astype(np.float32),
+        "crop_gt": (r.uniform(size=(batch * n, SIZE, SIZE)) > 0.7
+                    ).astype(np.float32),
+    }
+    with mesh:
+        state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                                   (1, SIZE, SIZE, 4), mesh=mesh)
+        step = make_train_step(model, tx, mesh=mesh)
+        b = shard_batch(mesh, host)
+        box = [state]
+
+        def one():
+            box[0], loss = step(box[0], b)
+            return loss, jax.tree.leaves(box[0].params)[0]
+
+        stats = throughput(one, steps=20, warmup=3, items_per_step=batch * n)
+    return stats["items_per_sec"] / n
+
+
+if __name__ == "__main__":
+    variants = [
+        dict(batch=8, pam_impl="einsum", block=None, remat=False),
+        dict(batch=16, pam_impl="einsum", block=None, remat=False),
+        dict(batch=8, pam_impl="flash", block=512, remat=False),
+        dict(batch=16, pam_impl="flash", block=512, remat=False),
+        dict(batch=32, pam_impl="einsum", block=None, remat=False),
+    ]
+    sel = sys.argv[1:]
+    for i, v in enumerate(variants):
+        if sel and str(i) not in sel:
+            continue
+        try:
+            ips = run(**v)
+            print(json.dumps({**v, "imgs_per_sec_per_chip": round(ips, 2)}),
+                  flush=True)
+        except Exception as e:  # OOM etc.
+            print(json.dumps({**v, "error": str(e)[:200]}), flush=True)

@@ -91,13 +91,25 @@ class TestKernelParity:
 
     def test_compute_nellipse_non_grid_range_goes_numpy(self, monkeypatch):
         # A non-0-based range must bypass the native kernel (which assumes
-        # pixel grids) and still compute correctly via numpy.  Pin BOTH
-        # calls to numpy so the identity is numpy-vs-numpy exact.
-        from distributedpytorch_tpu.data.guidance import compute_nellipse
-        monkeypatch.setenv("DPTPU_NATIVE", "0")
+        # pixel grids) and still compute correctly via numpy.  With the
+        # native backend live, assert the shifted-range call never reaches
+        # the rasterizer; the numpy-vs-numpy identity is checked separately.
+        from distributedpytorch_tpu.data import guidance
         pts = np.array([[5, 4], [20, 18], [3, 18], [12, 2]], np.float32)
-        shifted = compute_nellipse(np.arange(10, 40), np.arange(5, 30), pts)
-        full = compute_nellipse(np.arange(64), np.arange(64), pts)
+
+        monkeypatch.delenv("DPTPU_NATIVE", raising=False)
+        if native_ops.enabled():
+            def boom(*a, **k):
+                raise AssertionError(
+                    "native nellipse called for a non-pixel-grid range")
+            monkeypatch.setattr(native_ops, "nellipse", boom)
+            guidance.compute_nellipse(np.arange(10, 40), np.arange(5, 30),
+                                      pts)
+
+        monkeypatch.setenv("DPTPU_NATIVE", "0")
+        shifted = guidance.compute_nellipse(np.arange(10, 40),
+                                            np.arange(5, 30), pts)
+        full = guidance.compute_nellipse(np.arange(64), np.arange(64), pts)
         np.testing.assert_allclose(shifted, full[5:30, 10:40], atol=1e-5)
 
     def test_rotation_matrix_matches_cv2(self):

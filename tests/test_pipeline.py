@@ -1,0 +1,136 @@
+"""Pipeline parallelism: GPipe schedule vs sequential ground truth."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedpytorch_tpu.parallel.pipeline import (
+    make_pipe_mesh,
+    make_pipeline_apply,
+    make_pipeline_train_step,
+    sequential_apply,
+    stage_param_specs,
+)
+
+STAGES = 4
+D = 16
+
+
+def residual_stage(params, x):
+    """Shape-preserving block: x + relu(x @ w + b)."""
+    return x + jax.nn.relu(x @ params["w"] + params["b"])
+
+
+def stacked_params(seed=0):
+    r = np.random.RandomState(seed)
+    return {
+        "w": jnp.asarray(r.normal(0, 0.3, (STAGES, D, D)).astype(np.float32)),
+        "b": jnp.asarray(r.normal(0, 0.1, (STAGES, D)).astype(np.float32)),
+    }
+
+
+@pytest.fixture(scope="module")
+def pipe_mesh():
+    return make_pipe_mesh(STAGES, devices=jax.devices()[:STAGES])
+
+
+def microbatches(seed=1, n_micro=6, mb=3):
+    r = np.random.RandomState(seed)
+    return jnp.asarray(r.normal(size=(n_micro, mb, D)).astype(np.float32))
+
+
+class TestPipelineForward:
+    def test_matches_sequential(self, pipe_mesh):
+        params = stacked_params()
+        x = microbatches()
+        out = make_pipeline_apply(pipe_mesh, residual_stage)(params, x)
+        ref = sequential_apply(residual_stage, params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_single_microbatch(self, pipe_mesh):
+        params = stacked_params()
+        x = microbatches(n_micro=1)
+        out = make_pipeline_apply(pipe_mesh, residual_stage)(params, x)
+        ref = sequential_apply(residual_stage, params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_stage_params_shardable(self, pipe_mesh):
+        from jax.sharding import NamedSharding
+
+        params = stacked_params()
+        specs = stage_param_specs(params)
+        placed = jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(pipe_mesh, s)),
+            params, specs)
+        # each device holds exactly one stage's slice
+        shard_shapes = {s.data.shape for s in placed["w"].addressable_shards}
+        assert shard_shapes == {(1, D, D)}
+        x = microbatches()
+        out = make_pipeline_apply(pipe_mesh, residual_stage)(placed, x)
+        ref = sequential_apply(residual_stage, params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestPipelineGrad:
+    def test_grads_match_sequential(self, pipe_mesh):
+        params = stacked_params()
+        x = microbatches()
+        y = jnp.ones_like(x)
+
+        pipe_fn = make_pipeline_apply(pipe_mesh, residual_stage)
+
+        def pipe_loss(p):
+            return jnp.mean((pipe_fn(p, x) - y) ** 2)
+
+        def seq_loss(p):
+            return jnp.mean((sequential_apply(residual_stage, p, x) - y) ** 2)
+
+        gp = jax.grad(pipe_loss)(params)
+        gs = jax.grad(seq_loss)(params)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(gs[k]),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestPipelineTrainStep:
+    def test_loss_decreases_and_matches_sequential(self, pipe_mesh):
+        params = stacked_params()
+        tx = optax.sgd(0.05, momentum=0.9)
+        opt_state = tx.init(params)
+        x = microbatches()
+        y = 0.5 * x
+
+        def loss_fn(pred, target):
+            return jnp.mean((pred - target) ** 2)
+
+        step = make_pipeline_train_step(pipe_mesh, residual_stage, loss_fn,
+                                        tx)
+        # sequential reference trained identically
+        ref_params, ref_opt = stacked_params(), tx.init(stacked_params())
+
+        @jax.jit
+        def ref_step(carry, mx, my):
+            p, o = carry
+
+            def obj(pp):
+                return loss_fn(sequential_apply(residual_stage, pp, mx), my)
+
+            loss, g = jax.value_and_grad(obj)(p)
+            up, o = tx.update(g, o, p)
+            return (optax.apply_updates(p, up), o), loss
+
+        carry = (params, opt_state)
+        ref_carry = (ref_params, ref_opt)
+        losses, ref_losses = [], []
+        for _ in range(5):
+            carry, loss = step(carry, x, y)
+            ref_carry, ref_loss = ref_step(ref_carry, x, y)
+            losses.append(float(loss))
+            ref_losses.append(float(ref_loss))
+        assert losses[-1] < losses[0]
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
