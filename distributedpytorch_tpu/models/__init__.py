@@ -39,10 +39,11 @@ def build_model(
         dtype = jnp.dtype(dtype)
     depth = _BACKBONE_DEPTH[backbone]
     if name != "danet":
-        # PAM options are DANet-only; drop them (at their defaults they are
-        # inert) so one config schema can drive any model family.
-        kw.pop("pam_block_size", None)
-        kw.pop("pam_impl", None)
+        # PAM/MoE options are DANet-only; drop them (at their defaults they
+        # are inert) so one config schema can drive any model family.
+        for k in ("pam_block_size", "pam_impl", "moe_experts", "moe_hidden",
+                  "moe_k", "moe_capacity_factor"):
+            kw.pop(k, None)
     if name == "danet":
         return DANet(
             nclass=nclass,

@@ -105,6 +105,10 @@ class DANetHead(nn.Module):
     pam_block_size: int | None = None
     pam_impl: str = "einsum"
     dropout_rate: float = 0.1
+    moe_experts: int = 0        # >0: MoE FFN on the fused features
+    moe_hidden: int | None = None
+    moe_k: int = 1
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -133,6 +137,26 @@ class DANetHead(nn.Module):
         ca = conv_bn_relu(ca, "cam_out")
 
         fused = pa + ca
+        if self.moe_experts > 0:
+            # Sparse capacity on the fused features: each spatial token is
+            # routed to 1/E of the FFN params.  In the trainer the expert
+            # stacks live like any other params (replicated under DP /
+            # model-axis-sharded under TP); the dedicated expert-parallel
+            # layout is the `make_moe_apply`/`make_expert_mesh` path in
+            # parallel/moe.py.  MoEMlp keeps the residual, so dropped tokens
+            # pass through, and sows the load-balancing aux loss for the
+            # train step to pick up.
+            from ..parallel.moe import MoEMlp
+
+            b, h, w, c = fused.shape
+            tokens = fused.astype(jnp.float32).reshape(b, h * w, c)
+            tokens = MoEMlp(
+                n_experts=self.moe_experts,
+                hidden=self.moe_hidden or c,
+                k=self.moe_k,
+                capacity_factor=self.moe_capacity_factor,
+                name="moe")(tokens)
+            fused = tokens.reshape(b, h, w, c).astype(fused.dtype)
         return (
             classifier(fused, "fused"),
             classifier(pa, "pam"),
@@ -154,6 +178,10 @@ class DANet(nn.Module):
     pam_block_size: int | None = None
     pam_impl: str = "einsum"  # einsum | flash (ops.pallas_attention)
     remat: bool = False
+    moe_experts: int = 0      # >0: MoE FFN in the head (see DANetHead)
+    moe_hidden: int | None = None
+    moe_k: int = 1
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -173,6 +201,10 @@ class DANet(nn.Module):
             dtype=self.dtype,
             pam_block_size=self.pam_block_size,
             pam_impl=self.pam_impl,
+            moe_experts=self.moe_experts,
+            moe_hidden=self.moe_hidden,
+            moe_k=self.moe_k,
+            moe_capacity_factor=self.moe_capacity_factor,
             name="head",
         )(feats["c4"], train=train)
         return tuple(_resize_bilinear(o, size) for o in outs)

@@ -67,6 +67,11 @@ def router(x: jax.Array, w_gate: jax.Array, *, k: int,
     """
     n, _ = x.shape
     n_experts = w_gate.shape[-1]
+    if k > n_experts:
+        # Beyond E rounds every expert is masked to -inf and argmax would
+        # silently re-pick expert 0, double-dispatching tokens.
+        raise ValueError(f"top-k routing needs k ({k}) <= experts "
+                         f"({n_experts})")
     logits = jnp.einsum("nd,de->ne", x, w_gate,
                         preferred_element_type=jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)  # (N, E)

@@ -163,20 +163,32 @@ def _compute_loss(outputs, batch: Batch, weights, loss_type: str):
 
 
 def _loss_and_updates(model, params, batch_stats, batch: Batch, rng,
-                      loss_weights, train: bool, loss_type: str):
-    """Forward + loss; returns (loss, new_batch_stats)."""
+                      loss_weights, train: bool, loss_type: str,
+                      aux_loss_weight: float = 0.0):
+    """Forward + loss; returns (loss, new_batch_stats).
+
+    ``aux_loss_weight`` scales any auxiliary losses the model ``sow``s into
+    its ``losses`` collection (e.g. the MoE router's load-balancing term,
+    parallel/moe.py) into the training objective.
+    """
     variables = {"params": params, "batch_stats": batch_stats}
     inputs = batch[INPUT_KEY]
     if train:
         outputs, mutated = model.apply(
             variables, inputs, train=True,
-            mutable=["batch_stats"], rngs={"dropout": rng},
+            mutable=["batch_stats", "losses"], rngs={"dropout": rng},
         )
         new_stats = unfreeze(mutated["batch_stats"])
+        aux = sum((jnp.sum(x) for x in
+                   jax.tree.leaves(mutated.get("losses", {}))),
+                  jnp.float32(0.0))
     else:
         outputs = model.apply(variables, inputs, train=False)
         new_stats = batch_stats
+        aux = jnp.float32(0.0)
     loss = _compute_loss(outputs, batch, loss_weights, loss_type)
+    if aux_loss_weight:
+        loss = loss + aux_loss_weight * aux
     return loss, new_stats
 
 
@@ -190,6 +202,7 @@ def make_train_step(
     loss_type: str = "multi_sigmoid",
     augment: Callable[[Batch, jax.Array], Batch] | None = None,
     state_shardings=None,
+    aux_loss_weight: float = 0.0,
 ) -> Callable[[TrainState, Batch], tuple[TrainState, jax.Array]]:
     """Build the jitted ``(state, batch) -> (state, loss)`` train step.
 
@@ -211,7 +224,8 @@ def make_train_step(
         def loss_fn(p):
             return _loss_and_updates(model, p, batch_stats, batch, rng,
                                      loss_weights, train=True,
-                                     loss_type=loss_type)
+                                     loss_type=loss_type,
+                                     aux_loss_weight=aux_loss_weight)
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         return loss, new_stats, grads

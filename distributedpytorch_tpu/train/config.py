@@ -59,6 +59,11 @@ class ModelConfig:
     pam_block_size: int | None = None   # blocked position-attention
     pam_impl: str = "einsum"            # einsum | flash (pallas TPU kernel)
     remat: bool = False                 # rematerialize backbone blocks
+    moe_experts: int = 0                # >0: MoE FFN in the DANet head
+    moe_hidden: int | None = None       # expert MLP width (default: channels)
+    moe_k: int = 1                      # top-k routing (1 = Switch)
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01        # load-balancing aux-loss weight
 
 
 @dataclass

@@ -196,7 +196,10 @@ class Trainer:
             name=cfg.model.name, nclass=cfg.model.nclass,
             backbone=cfg.model.backbone, output_stride=cfg.model.output_stride,
             dtype=cfg.model.dtype, pam_block_size=cfg.model.pam_block_size,
-            pam_impl=cfg.model.pam_impl, remat=cfg.model.remat)
+            pam_impl=cfg.model.pam_impl, remat=cfg.model.remat,
+            moe_experts=cfg.model.moe_experts,
+            moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
+            moe_capacity_factor=cfg.model.moe_capacity_factor)
         steps_per_epoch = len(self.train_loader)  # > 0: guarded above
         total_steps = steps_per_epoch * cfg.epochs
         self.tx, self.schedule = make_optimizer(cfg.optim, total_steps)
@@ -217,7 +220,9 @@ class Trainer:
         self.train_step = make_train_step(
             self.model, self.tx, loss_weights=cfg.model.loss_weights,
             accum_steps=cfg.optim.accum_steps, mesh=self.mesh,
-            loss_type=loss_type, state_shardings=st_sh, augment=augment)
+            loss_type=loss_type, state_shardings=st_sh, augment=augment,
+            aux_loss_weight=(cfg.model.moe_aux_weight
+                             if cfg.model.moe_experts else 0.0))
         self.eval_step = make_eval_step(
             self.model, loss_weights=cfg.model.loss_weights, mesh=self.mesh,
             loss_type=loss_type, state_shardings=st_sh)

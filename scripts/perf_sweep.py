@@ -9,6 +9,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Importing bench runs its bounded tunneled-backend health probe (with CPU
+# fallback) and sets the TPU memory fraction — without it, an unhealthy
+# tunnel wedges the sweep indefinitely at jax.devices().
+import bench  # noqa: F401
+
 import jax
 import numpy as np
 import optax

@@ -161,3 +161,57 @@ class TestRemat:
         for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-6, atol=1e-6)
+
+
+class TestDANetMoE:
+    """The MoE head variant: sparse FFN on fused features (parallel/moe.py)."""
+
+    def test_output_contract_unchanged(self):
+        m = DANet(nclass=1, backbone_depth=18, output_stride=8,
+                  moe_experts=4, moe_capacity_factor=2.0)
+        x = jnp.zeros((2, 64, 64, 4))
+        _, out = init_and_apply(m, x)
+        assert isinstance(out, tuple) and len(out) == 3
+        for o in out:
+            assert o.shape == (2, 64, 64, 1)
+
+    def test_moe_params_present_and_stacked(self):
+        m = DANet(nclass=1, backbone_depth=18, output_stride=8,
+                  moe_experts=4, moe_hidden=32)
+        x = jnp.zeros((1, 32, 32, 4))
+        variables = m.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+            x, train=False)
+        moe = variables["params"]["head"]["moe"]
+        c = moe["w_gate"].shape[0]
+        assert moe["w_gate"].shape == (c, 4)
+        assert moe["w1"].shape == (4, c, 32)
+        assert moe["w2"].shape == (4, 32, c)
+
+    def test_aux_loss_sown_in_train_step(self):
+        """make_train_step(aux_loss_weight=...) folds the router's
+        load-balancing loss into the objective."""
+        import optax
+
+        from distributedpytorch_tpu.parallel import (
+            create_train_state, make_train_step)
+
+        m = DANet(nclass=1, backbone_depth=18, output_stride=8,
+                  moe_experts=2, moe_hidden=16, moe_capacity_factor=2.0)
+        tx = optax.sgd(1e-3)
+        state = create_train_state(jax.random.PRNGKey(0), m, tx,
+                                   (1, 32, 32, 4))
+        r = np.random.RandomState(0)
+        batch = {
+            "concat": jnp.asarray(r.uniform(0, 255, (2, 32, 32, 4))
+                                  .astype(np.float32)),
+            "crop_gt": jnp.asarray((r.uniform(size=(2, 32, 32)) > 0.5)
+                                   .astype(np.float32)),
+        }
+        _, loss_no_aux = make_train_step(m, tx, donate=False)(state, batch)
+        _, loss_aux = make_train_step(m, tx, donate=False,
+                                      aux_loss_weight=1.0)(state, batch)
+        # aux (load-balance) loss is >= 1 for a top-1 router, so the
+        # weighted objective must be strictly larger.
+        assert float(loss_aux) > float(loss_no_aux) + 0.5
+        assert np.isfinite(float(loss_aux))

@@ -139,3 +139,9 @@ class TestMoEModule:
         assert np.isfinite(float(aux))
         # residual: output differs from input (experts fired)
         assert float(jnp.abs(y - x).max()) > 0
+
+
+class TestRouterValidation:
+    def test_k_exceeding_experts_raises(self, params):
+        with pytest.raises(ValueError, match="top-k"):
+            router(tokens(), params["w_gate"], k=E + 1, capacity=8)

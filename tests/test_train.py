@@ -309,3 +309,23 @@ class TestProfileEpoch:
         for dirpath, _, files in os.walk(prof_dir):
             found += [f for f in files if f.endswith(".xplane.pb")]
         assert found, "no xplane trace written"
+
+
+class TestMoEConfig:
+    """DANet-MoE variant end-to-end: router aux loss in the objective."""
+
+    def test_fit_one_epoch_moe(self, tiny_cfg):
+        cfg = dataclasses.replace(
+            tiny_cfg,
+            model=dataclasses.replace(tiny_cfg.model, moe_experts=2,
+                                      moe_hidden=32,
+                                      moe_capacity_factor=2.0),
+            epochs=1)
+        tr = Trainer(cfg)
+        # expert-stacked params exist in the live state
+        moe = tr.state.params["head"]["moe"]
+        assert moe["w1"].shape[0] == 2
+        history = tr.fit()
+        assert all(np.isfinite(l) for l in history["train_loss"])
+        assert 0.0 <= history["val"][-1]["jaccard"] <= 1.0
+        tr.close()
