@@ -20,53 +20,20 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
 
+# Bounded tunnel-health probe with retries + CPU fallback (shared with
+# scripts/perf_sweep.py) — must run BEFORE importing jax so the fallback's
+# JAX_PLATFORMS takes effect.
+from distributedpytorch_tpu.backend_health import (  # noqa: E402
+    ensure_backend_or_cpu_fallback,
+)
 
-def _accelerator_healthy(timeout_s: int = 240) -> tuple[bool, str]:
-    """Probe the default backend in a THROWAWAY subprocess.
-
-    A tunneled TPU plugin can hang indefinitely at backend init when the
-    tunnel is unhealthy (observed: >4 min on jax.devices()).  Probing in a
-    child process bounds the damage — on timeout/failure the benchmark
-    falls back to CPU and still prints its JSON line instead of wedging
-    the whole round.  Returns ``(healthy, reason)``.
-    """
-    try:
-        # The child pins any explicitly-requested platform via jax.config,
-        # exactly as the main process does below (a site-installed plugin
-        # may override the env var) — so the probe validates the backend
-        # the benchmark will actually run on.
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import os, jax;"
-             "p = os.environ.get('JAX_PLATFORMS');"
-             "p and jax.config.update('jax_platforms', p);"
-             "assert len(jax.devices()) >= 1"],
-            timeout=timeout_s, capture_output=True, text=True)
-        if probe.returncode == 0:
-            return True, ""
-        lines = (probe.stderr or "").strip().splitlines()
-        return False, lines[-1] if lines else "probe failed"
-    except subprocess.TimeoutExpired:
-        return False, f"backend init exceeded {timeout_s}s"
-
-
-# An env-forced CPU run cannot exhibit the tunneled-plugin hang and the
-# fallback action is already in effect — skip the probe's startup cost.
-# DPTPU_BENCH_PROBE=0 skips it too (healthy hosts pay a second backend
-# init for the probe child; opt out when the accelerator is known good).
-if os.environ.get("DPTPU_BENCH_PROBE") != "0" and \
-        os.environ.get("JAX_PLATFORMS") != "cpu":
-    _ok, _why = _accelerator_healthy()
-    if not _ok:
-        print(f"bench: default backend unhealthy ({_why}) — "
-              "falling back to CPU", file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
+ensure_backend_or_cpu_fallback()
 
 import jax  # noqa: E402
 
@@ -142,6 +109,8 @@ def main() -> None:
         "value": round(per_chip, 3),
         "unit": "imgs/sec/chip",
         "vs_baseline": round(per_chip / REFERENCE_IMGS_PER_SEC_PER_CHIP, 3),
+        # extra context for the record: a CPU-fallback run is not a TPU number
+        "platform": jax.devices()[0].platform,
     }))
 
 

@@ -1,0 +1,78 @@
+"""Bounded health probing for a (possibly tunneled) accelerator backend.
+
+A tunneled TPU plugin can hang indefinitely at backend init when the tunnel
+is unhealthy (observed: >4 min inside ``jax.devices()``).  Probing in a
+throwaway child process bounds the damage: on timeout/failure the caller
+falls back to CPU and still produces output instead of wedging.
+
+Import-light on purpose (no jax/numpy at module scope): callers run
+:func:`ensure_backend_or_cpu_fallback` BEFORE importing jax so the
+``JAX_PLATFORMS`` fallback takes effect.  Shared by ``bench.py`` and
+``scripts/perf_sweep.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+
+def accelerator_healthy(timeout_s: int = 240) -> tuple[bool, str]:
+    """Probe the default jax backend in a throwaway subprocess.
+
+    The child pins any explicitly-requested platform via jax.config exactly
+    as the parent will (a site-installed plugin may override the env var), so
+    the probe validates the backend the caller will actually run on.
+    Returns ``(healthy, reason)``.
+    """
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import os, jax;"
+             "p = os.environ.get('JAX_PLATFORMS');"
+             "p and jax.config.update('jax_platforms', p);"
+             "assert len(jax.devices()) >= 1"],
+            timeout=timeout_s, capture_output=True, text=True)
+        if probe.returncode == 0:
+            return True, ""
+        lines = (probe.stderr or "").strip().splitlines()
+        return False, lines[-1] if lines else "probe failed"
+    except subprocess.TimeoutExpired:
+        return False, f"backend init exceeded {timeout_s}s"
+
+
+def ensure_backend_or_cpu_fallback() -> bool:
+    """Probe (with retries) and fall back to CPU if the backend stays down.
+
+    Returns True when the default backend is usable (or the probe was
+    skipped), False when the fallback to CPU was taken.  Skipped entirely
+    when CPU is already forced (the hang cannot occur and the fallback is in
+    effect) or ``DPTPU_BENCH_PROBE=0`` (healthy hosts pay a second backend
+    init for the probe child; opt out when the accelerator is known good).
+
+    A wedged tunnel has been observed to recover within minutes, and a CPU
+    number can cost a whole benchmark round — so the probe retries
+    (``DPTPU_BENCH_PROBE_RETRIES``, default 3) with a pause in between
+    before giving up.
+    """
+    if os.environ.get("DPTPU_BENCH_PROBE") == "0" or \
+            os.environ.get("JAX_PLATFORMS") == "cpu":
+        return True
+    try:
+        retries = int(os.environ.get("DPTPU_BENCH_PROBE_RETRIES", "3"))
+    except ValueError:
+        retries = 3
+    retries = max(1, retries)
+    for attempt in range(retries):
+        ok, why = accelerator_healthy()
+        if ok:
+            return True
+        print(f"backend probe: unhealthy ({why}), "
+              f"attempt {attempt + 1}/{retries}", file=sys.stderr)
+        if attempt + 1 < retries:
+            time.sleep(60)
+    print("backend probe: falling back to CPU", file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return False

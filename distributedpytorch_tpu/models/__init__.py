@@ -39,11 +39,17 @@ def build_model(
         dtype = jnp.dtype(dtype)
     depth = _BACKBONE_DEPTH[backbone]
     if name != "danet":
-        # PAM/MoE options are DANet-only; drop them (at their defaults they
-        # are inert) so one config schema can drive any model family.
-        for k in ("pam_block_size", "pam_impl", "moe_experts", "moe_hidden",
-                  "moe_k", "moe_capacity_factor"):
-            kw.pop(k, None)
+        # PAM/MoE options are DANet-only.  One config schema drives every
+        # model family, so default values are silently dropped — but a
+        # non-default setting on another model is a misconfiguration, not
+        # something to train past.
+        danet_only = {"pam_block_size": None, "pam_impl": "einsum",
+                      "moe_experts": 0, "moe_hidden": None, "moe_k": 1,
+                      "moe_capacity_factor": 1.25}
+        for k, default in danet_only.items():
+            if k in kw and kw.pop(k) != default:
+                raise ValueError(
+                    f"{k} is DANet-only; model {name!r} does not support it")
     if name == "danet":
         return DANet(
             nclass=nclass,

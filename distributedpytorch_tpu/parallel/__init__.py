@@ -29,6 +29,8 @@ from .moe import (
 )
 from .pipeline import (
     PIPE_AXIS,
+    flax_stage_fn,
+    init_stacked_stage_params,
     make_pipe_mesh,
     make_pipeline_apply,
     make_pipeline_train_step,
@@ -56,7 +58,9 @@ __all__ = [
     "MoEMlp",
     "TrainState",
     "ep_param_specs",
+    "flax_stage_fn",
     "init_moe_params",
+    "init_stacked_stage_params",
     "make_expert_mesh",
     "make_moe_apply",
     "make_pipe_mesh",

@@ -152,6 +152,33 @@ def sequential_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     return x
 
 
+def init_stacked_stage_params(rng: jax.Array, block, n_stages: int,
+                              sample_input: jax.Array) -> Any:
+    """Stacked params for ``n_stages`` copies of a Flax ``block``: every leaf
+    gains a leading stage dim (shard it with :func:`stage_param_specs`).
+
+    Each stage gets its own init key.  The block must be shape-preserving
+    and stateless (no BatchNorm running stats — use GroupNorm/LayerNorm in
+    pipelined bodies); pair with :func:`flax_stage_fn`.
+    """
+    rngs = jax.random.split(rng, n_stages)
+
+    def init_one(r):
+        return block.init(r, sample_input)["params"]
+
+    return jax.vmap(init_one)(rngs)
+
+
+def flax_stage_fn(block) -> Callable[[Any, jax.Array], jax.Array]:
+    """Adapt a Flax module to the ``(stage_params, x) -> y`` contract of
+    :func:`make_pipeline_apply` / :func:`make_pipeline_train_step`."""
+
+    def stage_fn(params, x):
+        return block.apply({"params": params}, x)
+
+    return stage_fn
+
+
 def make_pipeline_train_step(mesh: Mesh,
                              stage_fn: Callable[[Any, jax.Array], jax.Array],
                              loss_fn: Callable[[jax.Array, jax.Array],

@@ -1,5 +1,10 @@
 """Perf sweep on the real chip: bench.py's config across batch size and
-PAM attention implementations.  Prints one JSON line per variant."""
+PAM attention implementations.  Prints one JSON line per variant.
+
+TPU-only: the variants are full-size DANet-R101 512px configs that would
+take hours per step on CPU, so unlike bench.py (which downsizes and still
+reports), the sweep exits when no TPU is available.
+"""
 
 from __future__ import annotations
 
@@ -9,12 +14,29 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Importing bench runs its bounded tunneled-backend health probe (with CPU
-# fallback) and sets the TPU memory fraction — without it, an unhealthy
-# tunnel wedges the sweep indefinitely at jax.devices().
-import bench  # noqa: F401
+os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
+
+# Bounded tunnel-health probe (shared with bench.py) — without it an
+# unhealthy tunnel wedges the sweep indefinitely at jax.devices().
+from distributedpytorch_tpu.backend_health import (  # noqa: E402
+    ensure_backend_or_cpu_fallback,
+)
+
+ensure_backend_or_cpu_fallback()
 
 import jax
+
+_req_platform = os.environ.get("JAX_PLATFORMS")
+if _req_platform:
+    # Pin whatever the env requests: a site-installed plugin may have
+    # overridden the env var during interpreter startup.
+    jax.config.update("jax_platforms", _req_platform)
+
+if not any(d.platform == "tpu" for d in jax.devices()):
+    print(json.dumps({"error": "no TPU available (sweep is TPU-only; "
+                      "bench.py covers the CPU-fallback path)"}))
+    sys.exit(1)
+
 import numpy as np
 import optax
 

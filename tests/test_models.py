@@ -215,3 +215,12 @@ class TestDANetMoE:
         # weighted objective must be strictly larger.
         assert float(loss_aux) > float(loss_no_aux) + 0.5
         assert np.isfinite(float(loss_aux))
+
+    def test_non_danet_rejects_moe_options(self):
+        with pytest.raises(ValueError, match="DANet-only"):
+            build_model("deeplabv3", nclass=21, backbone="resnet50",
+                        moe_experts=8)
+        # defaults pass through silently (one config schema, any family)
+        m = build_model("deeplabv3", nclass=21, backbone="resnet50",
+                        moe_experts=0, pam_impl="einsum")
+        assert m is not None
