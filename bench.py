@@ -31,17 +31,14 @@ os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
 # JAX_PLATFORMS takes effect.
 from distributedpytorch_tpu.backend_health import (  # noqa: E402
     ensure_backend_or_cpu_fallback,
+    pin_requested_platform,
 )
 
 ensure_backend_or_cpu_fallback()
 
 import jax  # noqa: E402
 
-_req_platform = os.environ.get("JAX_PLATFORMS")
-if _req_platform:
-    # Pin whatever the env requests: a site-installed plugin may have
-    # overridden the env var during interpreter startup.
-    jax.config.update("jax_platforms", _req_platform)
+pin_requested_platform()
 
 import numpy as np  # noqa: E402
 import optax  # noqa: E402

@@ -19,21 +19,37 @@ import sys
 import time
 
 
+def pin_requested_platform() -> None:
+    """Re-pin an env-requested platform via jax.config, AFTER importing jax.
+
+    A site-installed plugin (sitecustomize) may override ``JAX_PLATFORMS``
+    during interpreter startup; the explicit config update restores what the
+    environment asked for.  Shared by bench.py, scripts/perf_sweep.py, and
+    the probe child below — one owner for the pinning rule.
+    """
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        import jax
+
+        jax.config.update("jax_platforms", p)
+
+
 def accelerator_healthy(timeout_s: int = 240) -> tuple[bool, str]:
     """Probe the default jax backend in a throwaway subprocess.
 
-    The child pins any explicitly-requested platform via jax.config exactly
-    as the parent will (a site-installed plugin may override the env var), so
-    the probe validates the backend the caller will actually run on.
-    Returns ``(healthy, reason)``.
+    The child pins any explicitly-requested platform exactly as the parent
+    will (:func:`pin_requested_platform`), so the probe validates the
+    backend the caller will actually run on.  Returns ``(healthy, reason)``.
     """
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     try:
         probe = subprocess.run(
             [sys.executable, "-c",
-             "import os, jax;"
-             "p = os.environ.get('JAX_PLATFORMS');"
-             "p and jax.config.update('jax_platforms', p);"
-             "assert len(jax.devices()) >= 1"],
+             f"import sys; sys.path.insert(0, {root!r});"
+             "from distributedpytorch_tpu.backend_health import "
+             "pin_requested_platform;"
+             "pin_requested_platform();"
+             "import jax; assert len(jax.devices()) >= 1"],
             timeout=timeout_s, capture_output=True, text=True)
         if probe.returncode == 0:
             return True, ""

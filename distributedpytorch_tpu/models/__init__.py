@@ -44,6 +44,7 @@ def build_model(
         # non-default setting on another model is a misconfiguration, not
         # something to train past.
         danet_only = {"pam_block_size": None, "pam_impl": "einsum",
+                      "pam_sp_mesh": None, "pam_sp_axis": "model",
                       "moe_experts": 0, "moe_hidden": None, "moe_k": 1,
                       "moe_capacity_factor": 1.25}
         for k, default in danet_only.items():

@@ -52,7 +52,9 @@ class PositionAttentionModule(nn.Module):
     norm: Any
     dtype: jnp.dtype = jnp.float32
     block_size: int | None = None  # None -> full attention
-    impl: str = "einsum"           # einsum | flash (pallas TPU kernel)
+    impl: str = "einsum"           # einsum | flash | ring
+    sp_mesh: Any = None            # ring: mesh to shard the token axis over
+    sp_axis: str = "model"         # ring: mesh axis carrying the tokens
 
     @nn.compact
     def __call__(self, x):
@@ -65,6 +67,33 @@ class PositionAttentionModule(nn.Module):
             from ..ops.pallas_attention import flash_position_attention
             blk = self.block_size or 256
             out = flash_position_attention(q, k, v, blk, blk)
+        elif self.impl == "ring":
+            # Sequence parallelism live in the model: the spatial-token axis
+            # is sharded over ``sp_axis`` and attention runs as a ppermute
+            # ring (parallel/ring.py) — each device holds N/axis tokens and
+            # no full N x N score matrix exists on any chip.  Requires
+            # h*w % axis_size == 0 (and batch % data-axis == 0 when the
+            # mesh also has a data axis).
+            if self.sp_mesh is None:
+                raise ValueError("impl='ring' needs sp_mesh (the mesh whose "
+                                 f"'{self.sp_axis}' axis shards the tokens)")
+            from ..parallel.mesh import DATA_AXIS
+            from ..parallel.ring import make_ring_attention_inline
+
+            sizes = dict(zip(self.sp_mesh.axis_names,
+                             self.sp_mesh.devices.shape))
+            if (h * w) % sizes[self.sp_axis]:
+                raise ValueError(
+                    f"impl='ring' needs the token count ({h}*{w}={h * w}) "
+                    f"divisible by the '{self.sp_axis}' axis size "
+                    f"({sizes[self.sp_axis]})")
+            # Shard the batch over the data axis only when it divides (the
+            # init dummy batch is 1 and must stay replicated).
+            batch_ax = (DATA_AXIS if sizes.get(DATA_AXIS, 1) > 1
+                        and b % sizes[DATA_AXIS] == 0 else None)
+            ring = make_ring_attention_inline(
+                self.sp_mesh, self.sp_axis, batch_axis=batch_ax)
+            out = ring(q, k, v)
         elif self.impl == "einsum":
             if self.block_size is None:
                 out = position_attention(q, k, v)
@@ -72,7 +101,8 @@ class PositionAttentionModule(nn.Module):
                 out = blocked_position_attention(q, k, v, self.block_size)
         else:
             raise ValueError(
-                f"unknown attention impl: {self.impl!r} (einsum | flash)")
+                f"unknown attention impl: {self.impl!r} "
+                "(einsum | flash | ring)")
         out = out.reshape(b, h, w, self.channels)
         # Residual gate starts at 0: the module is an identity at init and
         # learns how much attention context to blend in.
@@ -104,6 +134,8 @@ class DANetHead(nn.Module):
     dtype: jnp.dtype = jnp.float32
     pam_block_size: int | None = None
     pam_impl: str = "einsum"
+    pam_sp_mesh: Any = None
+    pam_sp_axis: str = "model"
     dropout_rate: float = 0.1
     moe_experts: int = 0        # >0: MoE FFN on the fused features
     moe_hidden: int | None = None
@@ -129,6 +161,7 @@ class DANetHead(nn.Module):
         pa = PositionAttentionModule(
             channels=inter, norm=self.norm, dtype=self.dtype,
             block_size=self.pam_block_size, impl=self.pam_impl,
+            sp_mesh=self.pam_sp_mesh, sp_axis=self.pam_sp_axis,
             name="pam")(pa)
         pa = conv_bn_relu(pa, "pam_out")
 
@@ -176,7 +209,9 @@ class DANet(nn.Module):
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
     pam_block_size: int | None = None
-    pam_impl: str = "einsum"  # einsum | flash (ops.pallas_attention)
+    pam_impl: str = "einsum"  # einsum | flash | ring (sequence-parallel)
+    pam_sp_mesh: Any = None   # ring: mesh whose axis shards the tokens
+    pam_sp_axis: str = "model"
     remat: bool = False
     moe_experts: int = 0      # >0: MoE FFN in the head (see DANetHead)
     moe_hidden: int | None = None
@@ -201,6 +236,8 @@ class DANet(nn.Module):
             dtype=self.dtype,
             pam_block_size=self.pam_block_size,
             pam_impl=self.pam_impl,
+            pam_sp_mesh=self.pam_sp_mesh,
+            pam_sp_axis=self.pam_sp_axis,
             moe_experts=self.moe_experts,
             moe_hidden=self.moe_hidden,
             moe_k=self.moe_k,

@@ -36,7 +36,11 @@ from .pipeline import (
     make_pipeline_train_step,
     stage_param_specs,
 )
-from .ring import make_ring_attention, ring_attention_local
+from .ring import (
+    make_ring_attention,
+    make_ring_attention_inline,
+    ring_attention_local,
+)
 from .tp import state_shardings, tp_param_specs
 from .ulysses import make_ulysses_attention, ulysses_attention_local
 from .step import (
@@ -75,6 +79,7 @@ __all__ = [
     "make_eval_step",
     "make_mesh",
     "make_ring_attention",
+    "make_ring_attention_inline",
     "make_ulysses_attention",
     "make_train_step",
     "ring_attention_local",

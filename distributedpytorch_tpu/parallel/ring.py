@@ -94,6 +94,25 @@ def ring_attention_local(q, k, v, axis_name: str = DATA_AXIS,
     return (acc / jnp.maximum(s, 1e-30)).astype(v.dtype)
 
 
+def make_ring_attention_inline(mesh: Mesh, axis_name: str = DATA_AXIS,
+                               scale: float | None = None,
+                               batch_axis: str | None = None):
+    """Unjitted shard_map ring attention, for embedding inside a larger
+    traced program (e.g. the DANet head's ``pam_impl='ring'`` path).
+
+    ``batch_axis`` optionally shards the leading batch dim over a second
+    mesh axis (the flagship's ``data`` axis); token axis rides
+    ``axis_name``.
+    """
+    spec = P(batch_axis, axis_name, None)
+    return jax.shard_map(
+        functools.partial(ring_attention_local, axis_name=axis_name,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+
+
 def make_ring_attention(mesh: Mesh, axis_name: str = DATA_AXIS,
                         scale: float | None = None):
     """Jitted ``(q, k, v) -> out`` with the token axis sharded over
@@ -103,13 +122,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = DATA_AXIS,
     exact attention while each device only ever materializes its
     N/axis_size token slice of K/V — the long-context configuration.
     """
-    spec = P(None, axis_name, None)
-    fn = jax.shard_map(
-        functools.partial(ring_attention_local, axis_name=axis_name,
-                          scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
-    )
-    sharding = NamedSharding(mesh, spec)
+    fn = make_ring_attention_inline(mesh, axis_name, scale)
+    sharding = NamedSharding(mesh, P(None, axis_name, None))
     return jax.jit(fn, in_shardings=(sharding,) * 3,
                    out_shardings=sharding)

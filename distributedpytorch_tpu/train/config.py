@@ -57,7 +57,9 @@ class ModelConfig:
     dtype: str = "float32"              # 'bfloat16' = BASELINE config 3
     loss_weights: tuple[float, ...] | None = None
     pam_block_size: int | None = None   # blocked position-attention
-    pam_impl: str = "einsum"            # einsum | flash (pallas TPU kernel)
+    pam_impl: str = "einsum"            # einsum | flash (pallas) | ring
+                                        # (ring = sequence-parallel PAM over
+                                        # the mesh's model axis)
     remat: bool = False                 # rematerialize backbone blocks
     moe_experts: int = 0                # >0: MoE FFN in the DANet head
     moe_hidden: int | None = None       # expert MLP width (default: channels)

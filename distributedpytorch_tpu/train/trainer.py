@@ -196,7 +196,10 @@ class Trainer:
             name=cfg.model.name, nclass=cfg.model.nclass,
             backbone=cfg.model.backbone, output_stride=cfg.model.output_stride,
             dtype=cfg.model.dtype, pam_block_size=cfg.model.pam_block_size,
-            pam_impl=cfg.model.pam_impl, remat=cfg.model.remat,
+            pam_impl=cfg.model.pam_impl,
+            # ring PAM shards the spatial tokens over this mesh's model axis
+            pam_sp_mesh=(self.mesh if cfg.model.pam_impl == "ring" else None),
+            remat=cfg.model.remat,
             moe_experts=cfg.model.moe_experts,
             moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
             moe_capacity_factor=cfg.model.moe_capacity_factor)

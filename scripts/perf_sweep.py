@@ -20,17 +20,14 @@ os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
 # unhealthy tunnel wedges the sweep indefinitely at jax.devices().
 from distributedpytorch_tpu.backend_health import (  # noqa: E402
     ensure_backend_or_cpu_fallback,
+    pin_requested_platform,
 )
 
 ensure_backend_or_cpu_fallback()
 
 import jax
 
-_req_platform = os.environ.get("JAX_PLATFORMS")
-if _req_platform:
-    # Pin whatever the env requests: a site-installed plugin may have
-    # overridden the env var during interpreter startup.
-    jax.config.update("jax_platforms", _req_platform)
+pin_requested_platform()
 
 if not any(d.platform == "tpu" for d in jax.devices()):
     print(json.dumps({"error": "no TPU available (sweep is TPU-only; "
