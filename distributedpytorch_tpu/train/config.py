@@ -94,6 +94,10 @@ class CheckpointConfig:
     keep_latest: int = 3
     snapshot_every: int = 100           # epoch snapshots (train_pascal.py:56)
     best_metric_init: float = 0.0       # reference pinned 0.913 (…:177)
+    warm_start: str | None = None       # .pth to import weights from (the
+                                        # reference's unconditional torch
+                                        # warm start, train_pascal.py:103)
+    warm_start_partial: bool = False    # tolerate missing/unused keys
     async_save: bool = True
     save_on_preempt: bool = True        # SIGTERM -> final full-state save
     preempt_check_every: int = 32       # stop-consensus cadence (steps)

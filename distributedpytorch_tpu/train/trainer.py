@@ -27,6 +27,7 @@ import os
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..data import (
@@ -84,6 +85,16 @@ class Trainer:
                                       JsonlWriter(self.run_dir))
         else:
             self.writer = MetricWriter()  # no-op on non-main hosts
+
+        if cfg.task == "instance" and cfg.model.nclass != 1:
+            # The instance protocol is binary by construction (sigmoid
+            # prediction pasted back per object, reference
+            # train_pascal.py:262,283-291); a multi-channel head would fail
+            # opaquely inside the evaluator's paste-back.
+            raise ValueError(
+                f"task='instance' requires model.nclass=1 (binary sigmoid "
+                f"head), got {cfg.model.nclass}; use task='semantic' for "
+                "multi-class")
 
         # --- mesh
         self.mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
@@ -237,6 +248,9 @@ class Trainer:
             best_metric_init=cfg.checkpoint.best_metric_init,
             async_save=cfg.checkpoint.async_save)
         self.start_epoch = 0
+        if cfg.checkpoint.warm_start:
+            self._warm_start(cfg.checkpoint.warm_start,
+                             cfg.checkpoint.warm_start_partial)
         if cfg.resume:
             self._resume(cfg.resume)
 
@@ -258,6 +272,39 @@ class Trainer:
         train_pascal.py:105)."""
         return sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(self.state.params))
+
+    def _warm_start(self, path: str, partial: bool) -> None:
+        """Import model weights from a torch ``.pth`` state_dict — the
+        reference's unconditional warm start (train_pascal.py:103) as a
+        config knob.  Only params/batch-stats are imported (the reference
+        never persisted optimizer state, SURVEY.md §3.5); step/opt-state/RNG
+        stay fresh.  Use ``resume`` for full-state Orbax restarts."""
+        from ..utils.torch_interop import (
+            load_torch_file,
+            torch_state_dict_to_params,
+        )
+
+        sd = load_torch_file(path)
+        # Shape/dtype-only templates: the live state may be sharded across
+        # processes, and describing shapes must not gather it to host.
+        as_struct = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
+        params, stats = torch_state_dict_to_params(
+            sd, as_struct(self.state.params), as_struct(self.state.batch_stats),
+            allow_missing=partial, allow_unused=partial)
+
+        def place(new, old):
+            if isinstance(new, jax.ShapeDtypeStruct):
+                return old  # leaf absent from the checkpoint (partial)
+            # numpy -> sharded device array in one hop, preserving the
+            # leaf's existing mesh placement (replicated or TP-sharded)
+            return jax.device_put(np.asarray(new), old.sharding)
+
+        self.state = self.state.replace(
+            params=jax.tree.map(place, params, self.state.params),
+            batch_stats=jax.tree.map(place, stats, self.state.batch_stats))
+        if self.is_main:
+            print(f"warm-started weights from {path}", flush=True)
 
     def _resume(self, source: str) -> None:
         mgr = CheckpointManager(source) if os.path.abspath(source) != \

@@ -93,14 +93,19 @@ def torch_state_dict_to_params(
     """Import a torch state_dict into ``(params, batch_stats)`` trees shaped
     like the templates (e.g. from ``model.init``).
 
+    Templates only need ``.shape``/``.ndim``/``.dtype`` per leaf —
+    ``jax.ShapeDtypeStruct`` trees work, so callers with sharded live states
+    never have to gather arrays to host just to describe shapes.
+
     ``rename`` maps checkpoint keys to this framework's keys (return None to
     drop a key — classifier heads, num_batches_tracked, ...).  Two
     *independent* escape hatches (deliberately not one flag — a rename typo
     shows up as BOTH a missing leaf and an unused key, and partial warm
     starts must not mask it):
 
-    * ``allow_missing`` — template leaves absent from the checkpoint keep
-      their template values (the partial warm start);
+    * ``allow_missing`` — template leaves absent from the checkpoint (or
+      present with a mismatched shape, e.g. a re-sized classifier head)
+      keep their template values (the partial warm start);
     * ``allow_unused`` — checkpoint keys matching no template leaf are
       ignored instead of raising.
     """
@@ -118,9 +123,17 @@ def torch_state_dict_to_params(
         for path, like in flat.items():
             key = _torch_key(path, is_stats)
             if key in available:
-                out[path] = _from_torch_layout(path, available[key],
-                                               np.asarray(like))
-                used.add(key)
+                try:
+                    out[path] = _from_torch_layout(path, available[key],
+                                                   like)
+                    used.add(key)
+                except ValueError:
+                    # shape mismatch (e.g. a re-sized head): under a partial
+                    # warm start keep the template leaf; the checkpoint key
+                    # stays un-"used" so allow_unused still governs it.
+                    if not allow_missing:
+                        raise
+                    out[path] = like
             elif allow_missing:
                 out[path] = like
             else:

@@ -159,3 +159,30 @@ class TestIndependentEscapeHatches:
                                           allow_missing=True,
                                           allow_unused=True)
         assert p is not None
+
+
+class TestPartialWarmStart:
+    def test_shape_mismatch_kept_under_partial(self):
+        """A re-sized head (same key, different shape) keeps the template
+        leaf under allow_missing, instead of raising."""
+        import jax
+
+        template = {"head": {"kernel": jax.ShapeDtypeStruct((1, 1, 8, 2),
+                                                            np.float32)}}
+        sd = {"head.weight": np.zeros((1, 8, 1, 1), np.float32)}  # nclass=1
+        with pytest.raises(ValueError, match="shape mismatch"):
+            torch_state_dict_to_params(sd, template, allow_unused=True)
+        out = torch_state_dict_to_params(sd, template, allow_missing=True,
+                                         allow_unused=True)
+        assert isinstance(out["head"]["kernel"], jax.ShapeDtypeStruct)
+
+    def test_struct_templates_no_materialization(self):
+        """ShapeDtypeStruct trees are valid templates (no host gather)."""
+        import jax
+
+        template = {"conv": {"kernel": jax.ShapeDtypeStruct((3, 3, 4, 8),
+                                                            np.float32)}}
+        sd = {"conv.weight": np.ones((8, 4, 3, 3), np.float32)}
+        out = torch_state_dict_to_params(sd, template)
+        assert out["conv"]["kernel"].shape == (3, 3, 4, 8)
+        assert isinstance(out["conv"]["kernel"], np.ndarray)
