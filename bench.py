@@ -101,14 +101,20 @@ def main() -> None:
                            items_per_step=BATCH * n_chips)
 
     per_chip = stats["items_per_sec"] / n_chips
-    print(json.dumps({
+    record = {
         "metric": f"danet_{BACKBONE}_{SIZE}px_b{BATCH}_train_step_throughput",
         "value": round(per_chip, 3),
         "unit": "imgs/sec/chip",
         "vs_baseline": round(per_chip / REFERENCE_IMGS_PER_SEC_PER_CHIP, 3),
         # extra context for the record: a CPU-fallback run is not a TPU number
         "platform": jax.devices()[0].platform,
-    }))
+    }
+    from distributedpytorch_tpu.utils.profiling import device_memory_stats
+
+    peak = device_memory_stats()["peak_bytes_in_use"]
+    if peak:
+        record["peak_hbm_gb"] = round(peak / 2**30, 2)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -120,7 +120,6 @@ class Trainer:
                 except Exception as e:  # re-raised below, on every process
                     err = f"{type(e).__name__}: {e}"
             if jax.process_count() > 1:
-                import jax.numpy as jnp
                 from jax.experimental import multihost_utils
                 failed = int(multihost_utils.broadcast_one_to_all(
                     jnp.int32(bool(err))))
@@ -293,9 +292,13 @@ class Trainer:
             sd, as_struct(self.state.params), as_struct(self.state.batch_stats),
             allow_missing=partial, allow_unused=partial)
 
+        imported = [0, 0]  # [loaded from checkpoint, kept template]
+
         def place(new, old):
             if isinstance(new, jax.ShapeDtypeStruct):
+                imported[1] += 1
                 return old  # leaf absent from the checkpoint (partial)
+            imported[0] += 1
             # numpy -> sharded device array in one hop, preserving the
             # leaf's existing mesh placement (replicated or TP-sharded)
             return jax.device_put(np.asarray(new), old.sharding)
@@ -303,8 +306,17 @@ class Trainer:
         self.state = self.state.replace(
             params=jax.tree.map(place, params, self.state.params),
             batch_stats=jax.tree.map(place, stats, self.state.batch_stats))
+        if imported[0] == 0:
+            # Every leaf fell through allow_missing: a key-naming mismatch,
+            # not a warm start.  Silently training from fresh init is the
+            # masking torch_interop's two separate flags exist to prevent.
+            raise ValueError(
+                f"warm start from {path} imported 0 of "
+                f"{imported[1]} leaves — checkpoint keys do not match this "
+                "model; check the architecture/naming")
         if self.is_main:
-            print(f"warm-started weights from {path}", flush=True)
+            print(f"warm-started {imported[0]} leaves from {path} "
+                  f"({imported[1]} kept from fresh init)", flush=True)
 
     def _resume(self, source: str) -> None:
         mgr = CheckpointManager(source) if os.path.abspath(source) != \

@@ -1,7 +1,8 @@
 """Utilities: array helpers, logging, debug checks, profiling."""
 
 from . import helpers, profiling, torch_interop
-from .profiling import StepTimer, annotate, throughput, trace
+from .profiling import (StepTimer, annotate, device_memory_stats,
+                        throughput, trace)
 
-__all__ = ["StepTimer", "annotate", "helpers", "profiling", "throughput",
-           "torch_interop", "trace"]
+__all__ = ["StepTimer", "annotate", "device_memory_stats", "helpers",
+           "profiling", "throughput", "torch_interop", "trace"]

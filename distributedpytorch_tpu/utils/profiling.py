@@ -118,3 +118,20 @@ class StepTimer:
         if items_per_step:
             out["items_per_sec"] = items_per_step / out["mean_s"]
         return out
+
+
+def device_memory_stats(device=None) -> dict:
+    """HBM usage of one device, normalized to a small stable dict.
+
+    Returns ``{bytes_in_use, peak_bytes_in_use, bytes_limit}`` (zeros for
+    backends that expose no stats, e.g. CPU) — the TPU-side answer to "does
+    this config fit", which the reference left to CUDA OOMs and hand-tuned
+    batch sizes (SURVEY.md §2.5 note on activation memory).
+    """
+    device = device or jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)() or {}
+    return {
+        "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+        "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+        "bytes_limit": int(stats.get("bytes_limit", 0)),
+    }

@@ -56,3 +56,11 @@ class TestThroughput:
         assert len(calls) == 5  # warmup excluded from timing, included in calls
         assert s["steps"] == 3 and s["total_s"] > 0
         assert s["items_per_sec"] == pytest.approx(12 / s["total_s"])
+
+
+def test_device_memory_stats_shape():
+    from distributedpytorch_tpu.utils.profiling import device_memory_stats
+
+    stats = device_memory_stats()
+    assert set(stats) == {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}
+    assert all(isinstance(v, int) and v >= 0 for v in stats.values())

@@ -373,3 +373,17 @@ class TestTorchWarmStart:
             tiny_cfg, model=dataclasses.replace(tiny_cfg.model, nclass=2))
         with pytest.raises(ValueError, match="nclass=1"):
             Trainer(cfg)
+
+    def test_warm_start_zero_matches_raises(self, tiny_cfg, tmp_path):
+        import torch
+
+        pth = str(tmp_path / "alien.pth")
+        torch.save({"some.alien.weight": torch.zeros(3, 3)}, pth)
+        cfg = dataclasses.replace(
+            tiny_cfg,
+            checkpoint=dataclasses.replace(tiny_cfg.checkpoint,
+                                           warm_start=pth,
+                                           warm_start_partial=True),
+            epochs=1)
+        with pytest.raises(ValueError, match="imported 0"):
+            Trainer(cfg)
