@@ -153,28 +153,35 @@ def sequential_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
 
 def init_stacked_stage_params(rng: jax.Array, block, n_stages: int,
-                              sample_input: jax.Array) -> Any:
+                              sample_input: jax.Array,
+                              all_collections: bool = False) -> Any:
     """Stacked params for ``n_stages`` copies of a Flax ``block``: every leaf
     gains a leading stage dim (shard it with :func:`stage_param_specs`).
 
-    Each stage gets its own init key.  The block must be shape-preserving
-    and stateless (no BatchNorm running stats — use GroupNorm/LayerNorm in
-    pipelined bodies); pair with :func:`flax_stage_fn`.
+    Each stage gets its own init key; the block must be shape-preserving.
+    ``all_collections=True`` stacks the block's full variables dict (params
+    AND e.g. frozen BatchNorm ``batch_stats``) — how the real backbone's
+    bottleneck blocks pipeline in inference mode; the default stacks only
+    ``params`` (stateless blocks: GroupNorm/LayerNorm).  Pair with
+    :func:`flax_stage_fn` using the same flag.
     """
     rngs = jax.random.split(rng, n_stages)
 
     def init_one(r):
-        return block.init(r, sample_input)["params"]
+        variables = block.init(r, sample_input)
+        return dict(variables) if all_collections else variables["params"]
 
     return jax.vmap(init_one)(rngs)
 
 
-def flax_stage_fn(block) -> Callable[[Any, jax.Array], jax.Array]:
+def flax_stage_fn(block, all_collections: bool = False
+                  ) -> Callable[[Any, jax.Array], jax.Array]:
     """Adapt a Flax module to the ``(stage_params, x) -> y`` contract of
     :func:`make_pipeline_apply` / :func:`make_pipeline_train_step`."""
 
     def stage_fn(params, x):
-        return block.apply({"params": params}, x)
+        variables = params if all_collections else {"params": params}
+        return block.apply(variables, x)
 
     return stage_fn
 
@@ -188,10 +195,22 @@ def make_pipeline_train_step(mesh: Mesh,
     ((params, opt_state), loss)`` step: forward through the GPipe schedule,
     backward through its transpose, optimizer update on each stage's own
     parameter shard (optimizer state inherits the stage sharding — per-stage
-    optimizer memory, the PP analogue of tp.py's sharded momentum)."""
+    optimizer memory, the PP analogue of tp.py's sharded momentum).
+
+    Every leaf of the stage params is trained — pass only the ``params``
+    collection (stateless-norm blocks).  ``all_collections=True`` stacks are
+    inference-only and rejected here: the optimizer would silently update
+    the frozen BatchNorm running stats they carry.
+    """
 
     def step(carry, micro_x, micro_y):
         params, opt_state = carry
+        if isinstance(params, dict) and "batch_stats" in params:
+            raise ValueError(
+                "stage params contain a 'batch_stats' collection "
+                "(all_collections=True stack) — the optimizer would update "
+                "frozen BN statistics; train with the 'params' collection "
+                "only (use stateless norms in pipelined blocks)")
 
         def objective(p):
             return loss_fn(_meshed_apply(mesh, stage_fn, p, micro_x,

@@ -204,3 +204,52 @@ class TestFlaxStagePipeline:
             first = float(loss) if first is None else first
             last = float(loss)
         assert last < first
+
+    def test_real_bottleneck_blocks_pipeline(self, pipe_mesh):
+        """The flagship backbone's own BottleneckBlock (frozen-BN inference
+        mode) pipelines exactly — PP applies to the real model's repeated
+        blocks, not just toy stages."""
+        from distributedpytorch_tpu.models.resnet import (
+            BottleneckBlock,
+            make_norm,
+        )
+        from distributedpytorch_tpu.parallel.pipeline import (
+            flax_stage_fn,
+            init_stacked_stage_params,
+        )
+
+        block = BottleneckBlock(filters=8, norm=make_norm(train=False))
+        sample = jnp.zeros((2, 8, 8, 32), jnp.float32)  # C = filters*4
+        params = init_stacked_stage_params(
+            jax.random.PRNGKey(0), block, STAGES, sample,
+            all_collections=True)
+        assert "batch_stats" in params  # frozen BN stats stacked too
+        stage_fn = flax_stage_fn(block, all_collections=True)
+        x = jnp.asarray(np.random.RandomState(2).normal(
+            size=(6, 2, 8, 8, 32)).astype(np.float32))
+        out = make_pipeline_apply(pipe_mesh, stage_fn)(params, x)
+        ref = sequential_apply(stage_fn, params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_train_step_rejects_all_collections_stack(self, pipe_mesh):
+        from distributedpytorch_tpu.models.resnet import (
+            BottleneckBlock,
+            make_norm,
+        )
+        from distributedpytorch_tpu.parallel.pipeline import (
+            flax_stage_fn,
+            init_stacked_stage_params,
+        )
+
+        block = BottleneckBlock(filters=8, norm=make_norm(train=False))
+        params = init_stacked_stage_params(
+            jax.random.PRNGKey(0), block, STAGES,
+            jnp.zeros((2, 8, 8, 32), jnp.float32), all_collections=True)
+        tx = optax.sgd(0.1)
+        step = make_pipeline_train_step(
+            pipe_mesh, flax_stage_fn(block, all_collections=True),
+            lambda p, t: jnp.mean((p - t) ** 2), tx)
+        x = jnp.zeros((4, 2, 8, 8, 32), jnp.float32)
+        with pytest.raises(ValueError, match="batch_stats"):
+            step((params, tx.init(params)), x, x)
