@@ -18,10 +18,16 @@ from __future__ import annotations
 import argparse
 import sys
 
+from .backend_health import pin_requested_platform
 from .train import Config, Trainer, apply_overrides, from_json
 
 
 def main(argv: list[str] | None = None) -> int:
+    # An env-requested platform (JAX_PLATFORMS=cpu for smoke runs) can be
+    # overridden by a site-installed accelerator plugin during interpreter
+    # startup; re-pin it before any backend init, or the run hangs trying to
+    # reach an accelerator the user explicitly opted out of.
+    pin_requested_platform()
     parser = argparse.ArgumentParser(
         prog="distributedpytorch_tpu",
         description="TPU-native interactive-segmentation training")

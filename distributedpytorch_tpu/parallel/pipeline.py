@@ -204,13 +204,20 @@ def make_pipeline_train_step(mesh: Mesh,
     """
 
     def step(carry, micro_x, micro_y):
+        import collections.abc
+
         params, opt_state = carry
-        if isinstance(params, dict) and "batch_stats" in params:
+        # A full variables stack (dict OR FrozenDict) always carries a
+        # top-level 'params' collection; a bare params tree never does
+        # (flax auto-names are Conv_0/BatchNorm_0/...).  Rejecting on that
+        # key covers batch_stats and any other non-trainable collection.
+        if isinstance(params, collections.abc.Mapping) and "params" in params:
             raise ValueError(
-                "stage params contain a 'batch_stats' collection "
+                "stage params look like a full variables dict "
                 "(all_collections=True stack) — the optimizer would update "
-                "frozen BN statistics; train with the 'params' collection "
-                "only (use stateless norms in pipelined blocks)")
+                "its non-trainable collections (e.g. frozen BN "
+                "batch_stats); train with the 'params' collection only "
+                "(use stateless norms in pipelined blocks)")
 
         def objective(p):
             return loss_fn(_meshed_apply(mesh, stage_fn, p, micro_x,

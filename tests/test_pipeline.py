@@ -251,5 +251,10 @@ class TestFlaxStagePipeline:
             pipe_mesh, flax_stage_fn(block, all_collections=True),
             lambda p, t: jnp.mean((p - t) ** 2), tx)
         x = jnp.zeros((4, 2, 8, 8, 32), jnp.float32)
-        with pytest.raises(ValueError, match="batch_stats"):
+        with pytest.raises(ValueError, match="all_collections"):
             step((params, tx.init(params)), x, x)
+        # a FrozenDict stack must not bypass the guard
+        from flax.core import freeze
+        frozen = freeze(params)
+        with pytest.raises(ValueError, match="all_collections"):
+            step((frozen, tx.init(params)), x, x)

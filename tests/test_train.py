@@ -387,3 +387,29 @@ class TestTorchWarmStart:
             epochs=1)
         with pytest.raises(ValueError, match="imported 0"):
             Trainer(cfg)
+
+
+class TestCli:
+    @pytest.mark.slow
+    def test_module_cli_end_to_end(self, tmp_path):
+        """python -m distributedpytorch_tpu must run on a forced-CPU env even
+        when a site accelerator plugin overrides JAX_PLATFORMS."""
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                   PYTHONPATH=repo)
+        r = subprocess.run(
+            [sys.executable, "-m", "distributedpytorch_tpu", "--fake-data",
+             "epochs=1", "data.train_batch=8", "data.val_batch=2",
+             "data.crop_size=[64,64]", "data.relax=10", "data.area_thres=0",
+             "model.backbone=resnet18", "model.output_stride=8",
+             "optim.lr=1e-4", "checkpoint.async_save=false",
+             f"work_dir={tmp_path}"],
+            env=env, cwd=repo, capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, r.stderr[-2000:]
+        run_dir = os.path.join(tmp_path, "run_0")
+        assert os.path.exists(os.path.join(run_dir, "config.json"))
+        assert os.path.exists(os.path.join(run_dir, "metrics.jsonl"))
