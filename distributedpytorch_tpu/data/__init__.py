@@ -3,7 +3,8 @@
 from . import guidance, transforms
 from .combine import CombinedDataset
 from .fake import make_fake_voc
-from .grain_pipeline import HAVE_GRAIN, make_grain_loader
+from .grain_pipeline import (GrainDataLoader, HAVE_GRAIN,
+                             make_grain_loader)
 from .pipeline import (
     DataLoader,
     build_eval_transform,
@@ -34,6 +35,7 @@ __all__ = [
     "collate",
     "guidance",
     "make_fake_voc",
+    "GrainDataLoader",
     "make_grain_loader",
     "transforms",
 ]

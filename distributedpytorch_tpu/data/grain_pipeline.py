@@ -142,3 +142,58 @@ def make_grain_loader(
         operations=[_CollateBatches(batch_size, drop_remainder=drop_last)],
         worker_count=num_workers,
     )
+
+
+class GrainDataLoader:
+    """Drop-in replacement for :class:`pipeline.DataLoader` backed by grain
+    (same ``set_epoch`` / ``__len__`` / ``__iter__`` surface, same dict
+    batches), selected in the trainer with ``data.loader=grain``.
+
+    Epoch semantics match ``DataLoader``'s RNG policy: each ``__iter__``
+    builds a fresh grain loader keyed on the current epoch, so shuffle
+    order and per-sample augmentation RNG both reproduce.
+    """
+
+    def __init__(self, dataset, batch_size: int, *, transform=None,
+                 shuffle: bool = False, drop_last: bool = False,
+                 seed: int = 0, num_workers: int = 0, num_shards: int = 1,
+                 shard_index: int = 0):
+        if not HAVE_GRAIN:  # fail at construction, not at first iteration
+            raise ImportError("grain is not installed; use data.DataLoader "
+                              "(data.loader=threads)")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.transform = transform
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.num_workers = num_workers
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.num_shards > 1:  # ShardOptions(drop_remainder=True)
+            n = n // self.num_shards
+        if self.num_workers > 0:
+            # grain batches inside each worker over its round-robin record
+            # slice, so each worker drops (or pads) its own remainder — the
+            # batch count is the sum over per-worker slice lengths.
+            w = self.num_workers
+            counts = [n // w + (1 if i < n % w else 0) for i in range(w)]
+        else:
+            counts = [n]
+        if self.drop_last:
+            return sum(c // self.batch_size for c in counts)
+        return sum(-(-c // self.batch_size) for c in counts if c)
+
+    def __iter__(self):
+        return iter(make_grain_loader(
+            self.dataset, self.batch_size, transform=self.transform,
+            shuffle=self.shuffle, drop_last=self.drop_last, seed=self.seed,
+            epoch=self._epoch, num_workers=self.num_workers,
+            shard_index=self.shard_index, num_shards=self.num_shards))

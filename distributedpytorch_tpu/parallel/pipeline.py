@@ -37,6 +37,7 @@ cheap and replicated).
 from __future__ import annotations
 
 import functools
+from collections.abc import Mapping
 from typing import Any, Callable
 
 import jax
@@ -204,14 +205,12 @@ def make_pipeline_train_step(mesh: Mesh,
     """
 
     def step(carry, micro_x, micro_y):
-        import collections.abc
-
         params, opt_state = carry
         # A full variables stack (dict OR FrozenDict) always carries a
         # top-level 'params' collection; a bare params tree never does
         # (flax auto-names are Conv_0/BatchNorm_0/...).  Rejecting on that
         # key covers batch_stats and any other non-trainable collection.
-        if isinstance(params, collections.abc.Mapping) and "params" in params:
+        if isinstance(params, Mapping) and "params" in params:
             raise ValueError(
                 "stage params look like a full variables dict "
                 "(all_collections=True stack) — the optimizer would update "

@@ -41,6 +41,9 @@ class DataConfig:
     guidance_alpha: float = 0.6         # z1 + alpha*z2 (custom_transforms.py:45)
     train_batch: int = 16
     val_batch: int = 1
+    loader: str = "threads"             # threads | grain (train loader;
+                                        # eval always uses threads, which
+                                        # wrap-pads so every sample scores)
     num_workers: int = 2                # loader threads (train_pascal.py:161)
     prefetch: int = 2                   # host-side decoded-batch buffer
     device_prefetch: int = 2            # batches placed on-device ahead

@@ -181,11 +181,26 @@ class Trainer:
             print(f"note: global val batch rounded "
                   f"{cfg.data.val_batch} -> {vb_host * n_proc} "
                   f"({vb_host}/host x {n_proc} hosts)", flush=True)
-        self.train_loader = DataLoader(
-            self.train_set, tb // n_proc, shuffle=True,
-            drop_last=True, seed=cfg.seed, num_workers=cfg.data.num_workers,
-            prefetch=cfg.data.prefetch,
-            num_shards=n_proc, shard_index=jax.process_index())
+        if cfg.data.loader == "grain":
+            # Grain train loader (process workers, checkpointable iterators);
+            # eval stays on the thread loader, which wrap-pads the final
+            # batch so every sample is scored (grain's multi-host sharding
+            # drops remainders instead — fine for training, wrong for eval).
+            from ..data import GrainDataLoader
+            self.train_loader = GrainDataLoader(
+                self.train_set, tb // n_proc, shuffle=True, drop_last=True,
+                seed=cfg.seed, num_workers=cfg.data.num_workers,
+                num_shards=n_proc, shard_index=jax.process_index())
+        elif cfg.data.loader == "threads":
+            self.train_loader = DataLoader(
+                self.train_set, tb // n_proc, shuffle=True,
+                drop_last=True, seed=cfg.seed,
+                num_workers=cfg.data.num_workers,
+                prefetch=cfg.data.prefetch,
+                num_shards=n_proc, shard_index=jax.process_index())
+        else:
+            raise ValueError(f"unknown data.loader: {cfg.data.loader!r} "
+                             "(threads | grain)")
         self.val_loader = DataLoader(
             self.val_set, vb_host, shuffle=False, drop_last=False,
             seed=cfg.seed, num_workers=cfg.data.num_workers,

@@ -167,3 +167,54 @@ class TestEvalPreprocess:
         (o1, _), (o2, _) = plain(state, batch), prep(state, batch)
         np.testing.assert_allclose(np.asarray(o1[0]), 255.0)
         np.testing.assert_allclose(np.asarray(o2[0]), 1.0)
+
+
+class TestGrainInTrainer:
+    def test_fit_with_grain_loader(self, fake_voc_root):
+        import dataclasses
+        import tempfile
+
+        from distributedpytorch_tpu.train import Config, Trainer, apply_overrides
+
+        cfg = apply_overrides(Config(), [
+            "data.fake=true", "data.loader=grain", "data.train_batch=8",
+            "data.val_batch=2", "data.crop_size=[64,64]", "data.relax=10",
+            "data.area_thres=0", "data.num_workers=0",
+            "model.backbone=resnet18", "model.output_stride=8",
+            "optim.lr=1e-4", "checkpoint.async_save=false", "epochs=1"])
+        with tempfile.TemporaryDirectory() as work:
+            cfg = dataclasses.replace(cfg, work_dir=work)
+            tr = Trainer(cfg)
+            assert type(tr.train_loader).__name__ == "GrainDataLoader"
+            hist = tr.fit()
+            assert all(np.isfinite(l) for l in hist["train_loss"])
+            assert 0.0 <= hist["val"][-1]["jaccard"] <= 1.0
+            tr.close()
+
+    def test_unknown_loader_rejected(self, tmp_path):
+        import dataclasses
+        import pytest as _pytest
+
+        from distributedpytorch_tpu.train import Config, Trainer, apply_overrides
+
+        cfg = apply_overrides(Config(), ["data.fake=true",
+                                         "data.loader=spark"])
+        with _pytest.raises(ValueError, match="data.loader"):
+            Trainer(dataclasses.replace(cfg, work_dir=str(tmp_path)))
+
+    def test_len_accounts_for_per_worker_batching(self, fake_voc_root):
+        from distributedpytorch_tpu.data import (
+            GrainDataLoader,
+            VOCInstanceSegmentation,
+        )
+        from distributedpytorch_tpu.data.pipeline import build_train_transform
+
+        ds = VOCInstanceSegmentation(
+            fake_voc_root, split="train",
+            transform=build_train_transform(crop_size=(64, 64)))
+        n = len(ds)
+        for workers, bs, drop in [(0, 2, True), (2, 2, True), (2, 2, False),
+                                  (3, 2, True)]:
+            gl = GrainDataLoader(ds, bs, shuffle=False, drop_last=drop,
+                                 num_workers=workers)
+            assert len(gl) == sum(1 for _ in gl), (workers, bs, drop, n)
