@@ -169,6 +169,7 @@ class TestEvalPreprocess:
         np.testing.assert_allclose(np.asarray(o2[0]), 1.0)
 
 
+@pytest.mark.skipif(not HAVE_GRAIN, reason="grain not installed")
 class TestGrainInTrainer:
     def test_fit_with_grain_loader(self, fake_voc_root):
         import dataclasses

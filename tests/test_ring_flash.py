@@ -180,3 +180,39 @@ class TestRingPAMInModel:
         with pytest.raises(ValueError, match="divisible"):
             m.init({"params": jax.random.key(0),
                     "dropout": jax.random.key(1)}, x, train=False)
+
+    def test_ring_pam_composes_with_tensor_parallel(self):
+        """SP (ring PAM over `model`) + TP (params sharded over `model`) in
+        the same compiled step — the manual shard_map region must coexist
+        with GSPMD-partitioned convolutions."""
+        import optax
+
+        from distributedpytorch_tpu.models import DANet
+        from distributedpytorch_tpu.parallel import (
+            create_train_state,
+            make_mesh,
+            make_train_step,
+            shard_batch,
+            state_shardings,
+        )
+
+        mesh = make_mesh(data=2, model=4)
+        m = DANet(nclass=1, backbone_depth=18, output_stride=8,
+                  pam_impl="ring", pam_sp_mesh=mesh)
+        tx = optax.sgd(1e-3, momentum=0.9)
+        r = np.random.RandomState(0)
+        with mesh:
+            state = create_train_state(jax.random.PRNGKey(0), m, tx,
+                                       (1, 32, 32, 4), mesh=mesh,
+                                       shard_params=True)
+            step = make_train_step(
+                m, tx, mesh=mesh, state_shardings=state_shardings(state))
+            batch = shard_batch(mesh, {
+                "concat": r.uniform(0, 255, (4, 32, 32, 4)
+                                    ).astype(np.float32),
+                "crop_gt": (r.uniform(size=(4, 32, 32)) > 0.7
+                            ).astype(np.float32),
+            })
+            state, loss = step(state, batch)
+            jax.block_until_ready(loss)
+        assert np.isfinite(float(loss))
