@@ -172,13 +172,14 @@ class DANetHead(nn.Module):
         fused = pa + ca
         if self.moe_experts > 0:
             # Sparse capacity on the fused features: each spatial token is
-            # routed to 1/E of the FFN params.  In the trainer the expert
-            # stacks live like any other params (replicated under DP /
-            # model-axis-sharded under TP); the dedicated expert-parallel
-            # layout is the `make_moe_apply`/`make_expert_mesh` path in
-            # parallel/moe.py.  MoEMlp keeps the residual, so dropped tokens
-            # pass through, and sows the load-balancing aux loss for the
-            # train step to pick up.
+            # routed to 1/E of the FFN params.  Under the trainer's
+            # `mesh.shard_params=true`, tp_param_specs shards these expert
+            # stacks one-group-per-device over the model axis (expert
+            # parallelism in the flagship step); otherwise they replicate
+            # like any other params.  The standalone EP path is
+            # `make_moe_apply`/`make_expert_mesh` in parallel/moe.py.
+            # MoEMlp keeps the residual, so dropped tokens pass through,
+            # and sows the load-balancing aux loss for the train step.
             from ..parallel.moe import MoEMlp
 
             b, h, w, c = fused.shape

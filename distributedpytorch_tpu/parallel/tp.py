@@ -38,18 +38,37 @@ def tp_param_specs(params: Any, mesh: Mesh, min_dim: int = 64) -> Any:
     divides the axis size and is at least ``min_dim`` wide; everything else
     (biases, BN scales, gammas) replicated.
 
+    MoE exception (expert parallelism in the trainer): leaves under a
+    ``moe`` module shard their *leading* (expert) dim over ``model`` when it
+    divides — one expert group per device slice, matching
+    :mod:`parallel.moe`'s EP layout — so ``mesh.shard_params=true`` with
+    ``model.moe_experts`` gives expert-sharded FFN stacks and GSPMD inserts
+    the dispatch all-to-alls.  The router gate stays replicated.
+
     ``params`` may be a pytree of arrays or of ``ShapeDtypeStruct``.
     """
     model = mesh.shape[MODEL_AXIS]
+    # MoEMlp's expert-stacked leaves, by name (mirrors moe.ep_param_specs'
+    # w_gate exclusion) — the EP rule must not sweep up other params that
+    # merely live under a module named "moe".
+    moe_expert_leaves = {"w1", "b1", "w2", "b2"}
 
-    def spec_of(leaf):
+    def spec_of(path, leaf):
         shape = leaf.shape
+        in_moe = any(getattr(k, "key", None) == "moe" for k in path)
+        leaf_name = getattr(path[-1], "key", None) if path else None
+        if (in_moe and leaf_name in moe_expert_leaves and model > 1
+                and len(shape) >= 1 and shape[0] % model == 0):
+            return P(*([MODEL_AXIS] + [None] * (len(shape) - 1)))
+        # Generic trailing-dim rule — also the fallback when the expert
+        # count does not divide the axis (keeps the wide FFN dims sharded
+        # instead of silently replicating the whole expert stack).
         if (model > 1 and len(shape) >= 2 and shape[-1] >= min_dim
                 and shape[-1] % model == 0):
             return P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
         return P()
 
-    return jax.tree.map(spec_of, params)
+    return jax.tree_util.tree_map_with_path(spec_of, params)
 
 
 def state_shardings(state) -> Any:
