@@ -57,6 +57,12 @@ def tp_param_specs(params: Any, mesh: Mesh, min_dim: int = 64) -> Any:
         shape = leaf.shape
         in_moe = any(getattr(k, "key", None) == "moe" for k in path)
         leaf_name = getattr(path[-1], "key", None) if path else None
+        if in_moe and leaf_name == "w_gate":
+            # The router gate is always replicated (every device routes all
+            # its tokens) — without this, a wide (d, E>=min_dim) gate would
+            # fall through to the trailing-dim rule and split the expert
+            # logits across devices.
+            return P()
         if (in_moe and leaf_name in moe_expert_leaves and model > 1
                 and len(shape) >= 1 and shape[0] % model == 0):
             return P(*([MODEL_AXIS] + [None] * (len(shape) - 1)))

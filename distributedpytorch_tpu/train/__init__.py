@@ -1,6 +1,7 @@
 """Training subsystem: config, optimizer, checkpointing, evaluation, trainer."""
 
-from .checkpoint import CheckpointManager, next_run_dir
+from .checkpoint import (CheckpointManager, latest_checkpoint_dir,
+                         next_run_dir)
 from .config import (
     CheckpointConfig,
     Config,
@@ -51,6 +52,7 @@ __all__ = [
     "make_param_labeler",
     "make_schedule",
     "make_val_panels",
+    "latest_checkpoint_dir",
     "next_run_dir",
     "to_json",
 ]

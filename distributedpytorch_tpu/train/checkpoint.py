@@ -140,3 +140,43 @@ class CheckpointManager:
         self.wait()
         self._mgr.close()
         self._best.close()
+
+
+def latest_checkpoint_dir(work_dir: str,
+                          exclude_run: str | None = None) -> str | None:
+    """The ``checkpoints`` dir of the highest-numbered ``run_<N>`` that has
+    a restorable step — the target of ``resume=auto`` (restart-and-continue
+    without knowing the run index; the reference hardcoded ``run_0``,
+    train_pascal.py:78-79).  ``exclude_run`` skips the caller's own
+    freshly-created run dir (whose manager metadata makes the directory
+    non-empty before any step is saved).  None when no run qualifies."""
+    def scan() -> int:
+        runs = glob.glob(os.path.join(work_dir, "run_*"))
+        indexed = sorted(
+            (int(m.group(1)), r) for r in runs
+            if (m := re.search(r"run_(\d+)$", r)))
+        skip = os.path.abspath(exclude_run) if exclude_run else None
+        for idx, run in reversed(indexed):
+            if skip and os.path.abspath(run) == skip:
+                continue
+            # a restorable run has a numeric step dir in its "latest" slot
+            # (CheckpointManager layout: checkpoints/latest/<step>)
+            latest = os.path.join(run, "checkpoints", "latest")
+            if os.path.isdir(latest) and any(
+                    d.isdigit() for d in os.listdir(latest)):
+                return idx
+        return -1
+
+    if jax.process_count() > 1:
+        # Same race as next_run_dir: filesystem views can differ across
+        # hosts (attribute caching, concurrent saves) and divergent resume
+        # sources would deadlock the first collective — process 0 decides.
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        local = scan() if jax.process_index() == 0 else -1
+        idx = int(multihost_utils.broadcast_one_to_all(jnp.int32(local)))
+    else:
+        idx = scan()
+    if idx < 0:
+        return None
+    return os.path.join(work_dir, f"run_{idx}", "checkpoints")

@@ -119,7 +119,9 @@ class Config:
     eval_thresholds: tuple[float, ...] = (0.3, 0.5, 0.8)
     seed: int = 0
     work_dir: str = "runs"              # run_<N> dirs created under this
-    resume: str | None = None           # checkpoint dir to resume from
+    resume: str | None = None           # checkpoint dir to resume from, or
+                                        # 'auto' = newest prior run under
+                                        # work_dir with a saved step
     debug_asserts: bool = False         # data-contract checks (…:188-190)
     log_every_steps: int = 50
     experiment_name: str = "experiment"

@@ -50,8 +50,13 @@ from ..parallel import (
     state_shardings,
 )
 from ..utils.helpers import generate_param_report
+from ..utils.profiling import device_memory_stats
 from . import config as config_lib
-from .checkpoint import CheckpointManager, next_run_dir
+from .checkpoint import (
+    CheckpointManager,
+    latest_checkpoint_dir,
+    next_run_dir,
+)
 from .evaluate import batch_debug_asserts, evaluate, evaluate_semantic
 from .logging import (
     ConsoleWriter,
@@ -265,7 +270,18 @@ class Trainer:
         if cfg.checkpoint.warm_start:
             self._warm_start(cfg.checkpoint.warm_start,
                              cfg.checkpoint.warm_start_partial)
-        if cfg.resume:
+        if cfg.resume == "auto":
+            # Continue from the newest prior run with checkpoints (the
+            # reference's pinned-run_0 resume, without knowing the index).
+            src = latest_checkpoint_dir(cfg.work_dir,
+                                        exclude_run=self.run_dir)
+            if src is None:
+                if self.is_main:
+                    print("resume=auto: no prior checkpoints under "
+                          f"{cfg.work_dir}; starting fresh", flush=True)
+            else:
+                self._resume(src)
+        elif cfg.resume:
             self._resume(cfg.resume)
 
         # --- param report (reference generate_param_report, :169)
@@ -396,11 +412,13 @@ class Trainer:
         # mean would skew per-epoch curves, and the replayed epoch will log
         # the real one.
         if self.is_main and not interrupted:
-            self.writer.scalars(
-                {"train/epoch_loss": mean_loss,
-                 "train/imgs_per_sec": n_imgs / dt if dt > 0 else 0.0,
-                 "train/epoch_seconds": dt, "train/epoch": epoch},
-                int(self.state.step))
+            scalars = {"train/epoch_loss": mean_loss,
+                       "train/imgs_per_sec": n_imgs / dt if dt > 0 else 0.0,
+                       "train/epoch_seconds": dt, "train/epoch": epoch}
+            peak = device_memory_stats()["peak_bytes_in_use"]
+            if peak:  # backends without stats (CPU) report zero
+                scalars["train/peak_hbm_gb"] = round(peak / 2**30, 3)
+            self.writer.scalars(scalars, int(self.state.step))
         return mean_loss
 
     # ------------------------------------------------------------------- eval

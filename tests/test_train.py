@@ -128,9 +128,8 @@ class TestCheckpoint:
         mgr.close()
 
 
-@pytest.fixture(scope="module")
-def tiny_cfg(tmp_path_factory):
-    work = tmp_path_factory.mktemp("runs")
+def make_tiny_cfg(work: str):
+    """The canonical tiny trainer config every e2e test builds on."""
     cfg = Config()
     return dataclasses.replace(
         cfg,
@@ -141,9 +140,14 @@ def tiny_cfg(tmp_path_factory):
                                   output_stride=8),
         optim=dataclasses.replace(cfg.optim, lr=1e-4, schedule="poly"),
         checkpoint=dataclasses.replace(cfg.checkpoint, async_save=False),
-        epochs=2, eval_every=1, seed=0, work_dir=str(work),
+        epochs=2, eval_every=1, seed=0, work_dir=work,
         log_every_steps=1, debug_asserts=True,
     )
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg(tmp_path_factory):
+    return make_tiny_cfg(str(tmp_path_factory.mktemp("runs")))
 
 
 class TestTrainerEndToEnd:
@@ -413,3 +417,24 @@ class TestCli:
         run_dir = os.path.join(tmp_path, "run_0")
         assert os.path.exists(os.path.join(run_dir, "config.json"))
         assert os.path.exists(os.path.join(run_dir, "metrics.jsonl"))
+
+
+class TestAutoResume:
+    def test_resume_auto_finds_latest_run(self, tiny_cfg):
+        work = tiny_cfg.work_dir
+        tr = Trainer(dataclasses.replace(tiny_cfg, epochs=1))
+        tr.fit()
+        step = int(tr.state.step)
+        tr.close()
+
+        tr2 = Trainer(dataclasses.replace(tiny_cfg, epochs=2, resume="auto"))
+        assert int(tr2.state.step) == step
+        assert tr2.start_epoch == 1
+        tr2.close()
+
+    def test_resume_auto_fresh_when_no_checkpoints(self, tmp_path):
+        cfg = dataclasses.replace(
+            make_tiny_cfg(str(tmp_path)), epochs=1, resume="auto")
+        tr = Trainer(cfg)
+        assert int(tr.state.step) == 0 and tr.start_epoch == 0
+        tr.close()
