@@ -44,18 +44,25 @@ def build_train_transform(
     alpha: float = 0.6,
     guidance: str = "nellipse_gaussians",
     flip: bool = True,
+    geom: bool = True,
 ) -> T.Compose:
     """The training augmentation stack (reference train_pascal.py:123-134).
 
     ``flip=False`` drops the host-side horizontal flip — used when the
-    on-device augmentation stage (ops.augment) owns flipping instead.
+    on-device augmentation stage (ops.augment) owns flipping instead;
+    ``geom=False`` likewise drops the host ScaleNRotate when the device
+    stage owns rotation/scale (ops.augment.random_scale_rotate — note the
+    device form rotates the fixed-size crop rather than the full image).
     """
     chain: list[T.Transform] = [
         *([T.RandomHorizontalFlip()] if flip else []),
-        T.ScaleNRotate(rots=rots, scales=scales),
+        *([T.ScaleNRotate(rots=rots, scales=scales)] if geom else []),
         T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
                              relax=relax, zero_pad=zero_pad),
         T.FixedResize(resolutions={"crop_image": crop_size, "crop_gt": crop_size}),
+        # without ScaleNRotate's uint8 cast upstream, cubic resize can
+        # overshoot the [0,255] contract — clamp explicitly
+        *([T.ClampRange(("crop_image",))] if not geom else []),
     ]
     chain += _guidance_stage(guidance, alpha, is_val=False)
     chain.append(T.ToArray())
@@ -116,6 +123,7 @@ def build_semantic_train_transform(
     rots: tuple[float, float] = (-10, 10),
     scales: tuple[float, float] = (0.5, 2.0),
     flip: bool = True,
+    geom: bool = True,
 ) -> T.Compose:
     """Multi-class semantic pipeline (the DeepLabV3 configs of BASELINE.md):
     flip -> scale/rotate with nearest-warped class ids (``semseg=True``) ->
@@ -123,11 +131,13 @@ def build_semantic_train_transform(
     step contract (``concat``/``crop_gt``).
 
     ``flip=False`` drops the host flip when the on-device augmentation
-    stage owns it (``data.device_augment``).
+    stage owns it (``data.device_augment``); ``geom=False`` likewise drops
+    the host ScaleNRotate for ``data.device_augment_geom``.
     """
     return T.Compose([
         *([T.RandomHorizontalFlip()] if flip else []),
-        T.ScaleNRotate(rots=rots, scales=scales, semseg=True),
+        *([T.ScaleNRotate(rots=rots, scales=scales, semseg=True)]
+          if geom else []),
         T.FixedResize(resolutions={"image": crop_size, "gt": crop_size},
                       flagvals={"image": None, "gt": 0}),
         T.Rename({"image": "concat", "gt": "crop_gt"}),

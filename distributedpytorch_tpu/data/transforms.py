@@ -544,6 +544,32 @@ class Rename(Transform):
         return f"Rename({self.mapping})"
 
 
+class ClampRange(Transform):
+    """Clamp named elements into ``[lo, hi]``.
+
+    Cubic resampling overshoots value ranges near edges; in the reference
+    chain that was masked by ScaleNRotate's uint8 cast
+    (custom_transforms.py:124-126) upstream of the resize.  When the
+    geometric stage moves on-device (``build_train_transform(geom=False)``)
+    the float image reaches ``FixedResize`` unquantized, so the [0,255]
+    data contract (reference train_pascal.py:188) needs this explicit
+    clamp."""
+
+    def __init__(self, elems: Sequence[str], lo: float = 0.0,
+                 hi: float = 255.0):
+        self.elems = tuple(elems)
+        self.lo, self.hi = lo, hi
+
+    def __call__(self, sample, rng=None):
+        for k in self.elems:
+            if k in sample:
+                sample[k] = np.clip(sample[k], self.lo, self.hi)
+        return sample
+
+    def __repr__(self):
+        return f"ClampRange({self.elems}, {self.lo}, {self.hi})"
+
+
 class ToArray(Transform):
     """Terminal transform: every array key -> float32 **HWC** numpy; 2-D
     arrays get a channel axis.

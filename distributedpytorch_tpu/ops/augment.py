@@ -78,6 +78,77 @@ def random_crop(batch: Batch, rng: jax.Array, pad: int = 16) -> dict:
     return out
 
 
+def random_scale_rotate(batch: Batch, rng: jax.Array,
+                        rots: tuple[float, float] = (-20.0, 20.0),
+                        scales: tuple[float, float] = (0.75, 1.25),
+                        semantic: bool = False) -> dict:
+    """Random rotation+scale about the center, on device — the fixed-shape
+    form of transforms.ScaleNRotate (reference custom_transforms.py:76-142:
+    per-sample angle/scale, cv2.warpAffine per key).
+
+    Per-sample angle ~ U(rots), scale ~ U(scales), shared across the
+    sample's keys; inverse-mapped sampling via
+    ``jax.scipy.ndimage.map_coordinates`` — bilinear for the continuous
+    input channels, nearest for ``crop_gt``/``crop_void`` masks, matching
+    the host transform's per-key interpolation choice.  Binary masks
+    (``semantic=False``) fill warped-out regions with 0 and re-binarize;
+    ``semantic=True`` keeps exact class ids (order-0 samples are exact
+    input values) and fills warped-out gt with 255 void so the loss
+    ignores it — the host ``ScaleNRotate(semseg=True)`` border rule.
+    Image channels always fill with 0 (the warpAffine default border).
+    """
+    keys = _spatial(batch)
+    n, h, w = batch[keys[0]].shape[:3]
+    k1, k2 = jax.random.split(rng)
+    angles = jnp.deg2rad(jax.random.uniform(
+        k1, (n,), minval=rots[0], maxval=rots[1]))
+    scale = jax.random.uniform(k2, (n,), minval=scales[0], maxval=scales[1])
+
+    yy, xx = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+
+    def src_coords(angle, s):
+        # inverse map: rotate by -angle, scale by 1/s, about the center
+        cos, sin = jnp.cos(angle) / s, jnp.sin(angle) / s
+        sy = cy + (-sin) * (xx - cx) + cos * (yy - cy)
+        sx = cx + cos * (xx - cx) + sin * (yy - cy)
+        return sy, sx
+
+    out = dict(batch)
+    for k in keys:
+        v = batch[k]
+        squeeze = v.ndim == 3
+        vv = v[..., None] if squeeze else v
+        is_mask = k in ("crop_gt", "crop_void", "gt", "void_pixels")
+        order = 0 if is_mask else 1
+        # semantic gt: warped-out ring becomes void (ignored by the loss),
+        # not class-0 background — the host semseg border rule
+        cval = 255.0 if (is_mask and semantic and k in ("crop_gt", "gt")) \
+            else 0.0
+
+        def warp_one(img, angle, s, order=order, cval=cval):
+            sy, sx = src_coords(angle, s)
+
+            def chan(c):
+                return jax.scipy.ndimage.map_coordinates(
+                    c, [sy, sx], order=order, mode="constant", cval=cval)
+
+            return jnp.stack([chan(img[..., i])
+                              for i in range(img.shape[-1])], axis=-1)
+
+        warped = jax.vmap(warp_one)(vv.astype(jnp.float32), angles, scale)
+        if is_mask and not semantic:
+            # order-0 samples are exact input values; the threshold only
+            # normalizes float noise in binary {0,1} masks.  Semantic ids
+            # must pass through untouched.
+            warped = (warped > 0.5).astype(v.dtype)
+        else:
+            warped = warped.astype(v.dtype)
+        out[k] = warped[..., 0] if squeeze else warped
+    return out
+
+
 def normalize(batch: Batch,
               mean: Sequence[float] = (0.0,),
               std: Sequence[float] = (255.0,)) -> dict:
@@ -111,6 +182,10 @@ def make_preprocess(
 def make_device_augment(
     hflip: bool = True,
     crop_pad: int = 0,
+    scale_rotate: bool = False,
+    rots: tuple[float, float] = (-20.0, 20.0),
+    scales: tuple[float, float] = (0.75, 1.25),
+    semantic: bool = False,
     mean: Sequence[float] | None = None,
     std: Sequence[float] | None = None,
 ) -> Callable[[Batch, jax.Array], dict]:
@@ -126,9 +201,12 @@ def make_device_augment(
 
     def augment(batch: Batch, rng: jax.Array) -> dict:
         b = dict(batch)
-        r1, r2 = jax.random.split(rng)
+        r1, r2, r3 = jax.random.split(rng, 3)
         if hflip:
             b = random_hflip(b, r1)
+        if scale_rotate:
+            b = random_scale_rotate(b, r3, rots=rots, scales=scales,
+                                    semantic=semantic)
         if crop_pad:
             b = random_crop(b, r2, pad=crop_pad)
         if mean is not None or std is not None:

@@ -48,6 +48,9 @@ class DataConfig:
     prefetch: int = 2                   # host-side decoded-batch buffer
     device_prefetch: int = 2            # batches placed on-device ahead
     device_augment: bool = False        # flip on-device (fused into step)
+    device_augment_geom: bool = False   # rotation/scale on-device too (the
+                                        # device form warps the fixed crop,
+                                        # not the pre-crop full image)
 
 
 @dataclass

@@ -139,7 +139,9 @@ class Trainer:
                 zero_pad=cfg.data.zero_pad, rots=cfg.data.rots,
                 scales=cfg.data.scales, alpha=cfg.data.guidance_alpha,
                 guidance=cfg.data.guidance,
-                flip=not cfg.data.device_augment)
+                flip=not cfg.data.device_augment,
+                geom=not (cfg.data.device_augment
+                          and cfg.data.device_augment_geom))
             val_tf = build_eval_transform(
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                 zero_pad=cfg.data.zero_pad, alpha=cfg.data.guidance_alpha,
@@ -157,7 +159,9 @@ class Trainer:
                 transform=build_semantic_train_transform(
                     crop_size=cfg.data.crop_size, rots=cfg.data.rots,
                     scales=cfg.data.scales,
-                    flip=not cfg.data.device_augment))
+                    flip=not cfg.data.device_augment,
+                    geom=not (cfg.data.device_augment
+                              and cfg.data.device_augment_geom)))
             self.val_set = VOCSemanticSegmentation(
                 root, split=cfg.data.val_split,
                 transform=build_semantic_eval_transform(
@@ -249,7 +253,11 @@ class Trainer:
         augment = None
         if cfg.data.device_augment:  # both tasks: flip owns the same keys
             from ..ops.augment import make_device_augment
-            augment = make_device_augment(hflip=True)  # host flip disabled
+            augment = make_device_augment(  # host flip (+geom) disabled
+                hflip=True,
+                scale_rotate=cfg.data.device_augment_geom,
+                rots=cfg.data.rots, scales=cfg.data.scales,
+                semantic=cfg.task == "semantic")
         self.train_step = make_train_step(
             self.model, self.tx, loss_weights=cfg.model.loss_weights,
             accum_steps=cfg.optim.accum_steps, mesh=self.mesh,

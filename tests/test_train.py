@@ -438,3 +438,16 @@ class TestAutoResume:
         tr = Trainer(cfg)
         assert int(tr.state.step) == 0 and tr.start_epoch == 0
         tr.close()
+
+
+class TestDeviceGeomAugment:
+    def test_fit_with_on_device_scale_rotate(self, tiny_cfg):
+        cfg = dataclasses.replace(
+            tiny_cfg,
+            data=dataclasses.replace(tiny_cfg.data, device_augment=True,
+                                     device_augment_geom=True),
+            epochs=1)
+        tr = Trainer(cfg)
+        hist = tr.fit()
+        assert all(np.isfinite(l) for l in hist["train_loss"])
+        tr.close()
