@@ -36,12 +36,46 @@ def main(argv: list[str] | None = None) -> int:
                         help="synthetic VOC fixture (smoke runs, no dataset)")
     parser.add_argument("--validate-only", action="store_true",
                         help="run the eval protocol once and exit")
+    parser.add_argument("--predict", metavar="IMAGE",
+                        help="inference mode: segment IMAGE from --points "
+                             "clicks using the run in --run-dir")
+    parser.add_argument("--run-dir",
+                        help="training run dir (config.json + checkpoints/) "
+                             "for --predict")
+    parser.add_argument("--points",
+                        help='4 extreme-point clicks "x1,y1 x2,y2 x3,y3 '
+                             'x4,y4" for --predict')
+    parser.add_argument("--out", default="mask.png",
+                        help="output mask PNG for --predict")
+    parser.add_argument("--overlay",
+                        help="also write an RGB overlay PNG (--predict)")
+    parser.add_argument("--threshold", type=float, default=0.5,
+                        help="binarization threshold for --predict")
     parser.add_argument("--distributed", action="store_true",
                         help="call jax.distributed.initialize() first "
                              "(multi-host pods)")
     parser.add_argument("overrides", nargs="*",
                         help="dotted config overrides, e.g. optim.lr=1e-7")
     args = parser.parse_args(argv)
+
+    # Predict mode first: it must not fall into the multi-host rendezvous
+    # below (jax.distributed.initialize() blocks waiting for peers).
+    if args.predict:
+        if not (args.run_dir and args.points):
+            parser.error("--predict requires --run-dir and --points")
+        if args.config or args.fake_data or args.validate_only \
+                or args.distributed or args.overrides:
+            parser.error(
+                "--predict reads its configuration from <run-dir>/"
+                "config.json; --config/--fake-data/--validate-only/"
+                "--distributed/overrides do not apply (got "
+                f"{args.overrides or 'training-mode flags'})")
+        from .predict import predict_cli
+        summary = predict_cli(args.run_dir, args.predict, args.points,
+                              args.out, threshold=args.threshold,
+                              overlay_path=args.overlay)
+        print(summary)
+        return 0
 
     if args.distributed:
         import jax

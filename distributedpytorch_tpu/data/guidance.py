@@ -157,6 +157,54 @@ def compute_nellipse_gaussian_hm(
     return z1, z2
 
 
+def nellipse_map(shape_hw: tuple[int, int], points) -> np.ndarray:
+    """The plain n-ellipse guidance channel, float32 in [0, 255].
+
+    Single owner of the NEllipse transform's scaling rule (reference
+    custom_transforms.py:9-27: [0,1] indicator x 255) — shared by the
+    training transform and the inference path (predict.py).
+    """
+    h, w = shape_hw
+    z = compute_nellipse(np.arange(w), np.arange(h),
+                         np.asarray(points, np.float64))
+    return (z * 255.0).astype(np.float32)
+
+
+def extreme_points_map(shape_hw: tuple[int, int], points,
+                       sigma: float = 10.0) -> np.ndarray:
+    """The DEXTR gaussian-heatmap guidance channel, float32 in [0, 1].
+
+    Single owner of the ExtremePoints transform's map (reference
+    custom_transforms.py:221-251: max-combined gaussians, UNSCALED — the
+    one guidance family the reference kept in [0, 1]) — shared by the
+    training transform and the inference path (predict.py).
+    """
+    return make_gt(np.zeros(shape_hw, np.float32), points, sigma=sigma)
+
+
+def nellipse_gaussians_map(
+    shape_hw: tuple[int, int], points, alpha: float = 0.6,
+    sigma: float = 10.0
+) -> np.ndarray:
+    """The live guidance channel as one map: ``z1 + alpha*z2`` rescaled to
+    peak at exactly 255, float32 in [0, 255].
+
+    Single owner of the combine/rescale rule at reference
+    custom_transforms.py:45-50 — both the ``NEllipseWithGaussians`` training
+    transform and the inference path (predict.py) call this, so the two can
+    never drift apart.  The [0, 255] range is a hard input contract (driver
+    asserts, reference train_pascal.py:188).
+    """
+    h, w = shape_hw
+    z1, z2 = compute_nellipse_gaussian_hm(
+        np.arange(w), np.arange(h), np.asarray(points, np.float64),
+        sigma=sigma)
+    z = z1 * 255.0 + z2 * 255.0 * alpha
+    z *= 255.0 / z.max()
+    # float32 rounding can overshoot 255 by an ulp; clip to the contract.
+    return np.clip(z, 0.0, 255.0).astype(np.float32)
+
+
 # ---------------------------------------------------------------------------
 # confidence-map family (skewed-axes weight maps)
 # ---------------------------------------------------------------------------

@@ -384,10 +384,7 @@ class NEllipse(Transform):
             sample["nellipse"] = np.zeros(target.shape, dtype=target.dtype)
             return sample
         pts = _pick_points(target, 0, self.is_val, rng)
-        z = guidance.compute_nellipse(
-            np.arange(target.shape[1]), np.arange(target.shape[0]), pts
-        )
-        sample["nellipse"] = z * 255.0
+        sample["nellipse"] = guidance.nellipse_map(target.shape[:2], pts)
         return sample
 
 
@@ -406,14 +403,8 @@ class NEllipseWithGaussians(Transform):
             sample["nellipseWithGaussians"] = np.zeros(target.shape, dtype=target.dtype)
             return sample
         pts = _pick_points(target, 0, self.is_val, rng)
-        z1, z2 = guidance.compute_nellipse_gaussian_hm(
-            np.arange(target.shape[1]), np.arange(target.shape[0]), pts
-        )
-        z = z1 * 255.0 + z2 * 255.0 * self.alpha
-        z *= 255.0 / z.max()
-        # float32 rounding can overshoot 255 by an ulp; the [0,255] range is a
-        # hard input contract (driver asserts, reference train_pascal.py:188).
-        sample["nellipseWithGaussians"] = np.clip(z, 0.0, 255.0).astype(np.float32)
+        sample["nellipseWithGaussians"] = guidance.nellipse_gaussians_map(
+            target.shape[:2], pts, alpha=self.alpha)
         return sample
 
     def __repr__(self):
@@ -440,9 +431,8 @@ class ExtremePoints(Transform):
             sample["extreme_points"] = np.zeros(target.shape, dtype=target.dtype)
             return sample
         pts = _pick_points(target, self.pert, self.is_val, rng)
-        sample["extreme_points"] = helpers.make_gt(
-            target, pts, sigma=self.sigma, one_mask_per_point=False
-        )
+        sample["extreme_points"] = guidance.extreme_points_map(
+            target.shape[:2], pts, sigma=self.sigma)
         return sample
 
 

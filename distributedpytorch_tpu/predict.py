@@ -1,0 +1,278 @@
+"""Interactive-segmentation inference: extreme-point clicks -> full-res mask.
+
+The reference trains a click-guided (DEXTR-style) binary segmenter but ships
+no inference entry point — its val loop (reference train_pascal.py:233-308)
+is the only consumer of the trained model.  This module completes that user
+story: given an RGB image and the 4 extreme points of the object (the same
+guidance the model was trained on, reference custom_transforms.py:30-51), it
+runs the full preprocessing -> model -> paste-back chain and returns a
+full-resolution probability mask.
+
+The preprocessing mirrors the *val* transform pipeline exactly
+(reference train_pascal.py:135-145), with the clicked points standing in for
+the gt-derived deterministic extreme points:
+
+    points -> relax-padded bbox        (CropFromMaskStatic semantics, relax=50)
+           -> zero-padded crop         (helpers.crop_from_mask)
+           -> fixed resize             (FixedResize, cubic, 512x512)
+           -> n-ellipse + gaussians    (NEllipseWithGaussians, z1 + alpha*z2,
+                                        rescaled to peak 255)
+           -> RGB(3) + guidance(1)     (ConcatInputs -> 'concat', [0,255])
+
+and the postprocessing mirrors the val metric path (train_pascal.py:283-290):
+sigmoid of the fused head, ``crop2fullmask`` paste-back with the relax border
+shaved.
+
+Device work is one jitted forward at a fixed (resolution, 4) shape, so every
+click/image after the first reuses the same compiled program — the
+interactive-latency design point.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import imaging
+from .data import guidance as guidance_lib
+from .utils.helpers import crop2fullmask, crop_from_bbox, get_bbox
+
+
+def guidance_from_points(
+    shape_hw: tuple[int, int], points: np.ndarray, alpha: float = 0.6,
+    family: str = "nellipse_gaussians"
+) -> np.ndarray:
+    """Crop-space guidance map from extreme points, float32.
+
+    ``family`` selects the same guidance channel the run was trained with
+    (``data.guidance`` in the config; pipeline.py:_guidance_stage), computed
+    from the clicked points instead of gt-derived ones:
+
+    * ``nellipse_gaussians`` — n-ellipse + alpha-scaled gaussian bumps,
+      peak-rescaled to 255 (the live reference path,
+      custom_transforms.py:45-50; owned by
+      ``guidance.nellipse_gaussians_map`` so training and inference share
+      one implementation);
+    * ``nellipse`` — n-ellipse indicator scaled to [0, 255]
+      (custom_transforms.py:9-27);
+    * ``extreme_points`` — DEXTR gaussian heatmap in [0, 1], matching the
+      ExtremePoints transform's unscaled output
+      (custom_transforms.py:221-251).
+    """
+    points = np.asarray(points, np.float64)
+    if family == "nellipse_gaussians":
+        return guidance_lib.nellipse_gaussians_map(shape_hw, points,
+                                                   alpha=alpha)
+    if family == "nellipse":
+        return guidance_lib.nellipse_map(shape_hw, points)
+    if family == "extreme_points":
+        return guidance_lib.extreme_points_map(shape_hw, points)
+    raise ValueError(f"unknown guidance family: {family!r} "
+                     "(nellipse_gaussians | nellipse | extreme_points)")
+
+
+def prepare_input(
+    image: np.ndarray,
+    points: np.ndarray,
+    relax: int = 50,
+    zero_pad: bool = True,
+    resolution: tuple[int, int] = (512, 512),
+    alpha: float = 0.6,
+    guidance: str = "nellipse_gaussians",
+) -> tuple[np.ndarray, tuple[int, int, int, int]]:
+    """Image + clicks -> (``concat`` (H, W, 4) float32, crop bbox).
+
+    ``image`` is (H, W, 3) RGB, any dtype, values in [0, 255]; ``points`` is
+    (4, 2) xy in full-image coordinates.  Returns the network input at
+    ``resolution`` and the (relax-padded) bbox needed to paste the prediction
+    back with :func:`predict` / ``crop2fullmask``.  ``guidance`` must match
+    the family the checkpoint was trained with (see
+    :func:`guidance_from_points`).
+    """
+    image = np.asarray(image, np.float32)
+    if image.ndim != 3 or image.shape[-1] != 3:
+        raise ValueError(f"expected (H, W, 3) RGB image, got {image.shape}")
+    points = np.asarray(points, np.float64)
+    if points.shape != (4, 2):
+        raise ValueError(f"expected 4 xy extreme points, got {points.shape}")
+    h, w = image.shape[:2]
+    if (points[:, 0].max() >= w or points[:, 1].max() >= h
+            or points.min() < 0):
+        raise ValueError(f"points {points.tolist()} outside image {w}x{h}")
+
+    # get_bbox only reads .shape when points are given; a broadcast stub
+    # avoids allocating an image-sized array per click.
+    shape_stub = np.broadcast_to(np.uint8(0), (h, w))
+    bbox = get_bbox(shape_stub, points=points, pad=relax, zero_pad=zero_pad)
+    crop = crop_from_bbox(image, bbox, zero_pad=zero_pad)
+    res_h, res_w = resolution
+    crop = imaging.resize(crop, (res_h, res_w), imaging.CUBIC)
+    # Points into resized-crop coordinates (the FixedResize scaling rule for
+    # point coords, reference custom_transforms.py:168-173).
+    scale = np.array([res_w / (bbox[2] - bbox[0] + 1),
+                      res_h / (bbox[3] - bbox[1] + 1)])
+    crop_pts = (points - np.array([bbox[0], bbox[1]])) * scale
+    crop_pts = np.clip(crop_pts, 0, [res_w - 1, res_h - 1])
+    heat = guidance_from_points((res_h, res_w), crop_pts, alpha=alpha,
+                                family=guidance)
+    concat = np.concatenate(
+        [np.clip(crop, 0.0, 255.0), heat[..., None]], axis=-1)
+    return concat.astype(np.float32), bbox
+
+
+class Predictor:
+    """Reusable click-to-mask inference on one model + checkpoint.
+
+    >>> p = Predictor.from_run("work/run_0")          # config.json + ckpt
+    >>> prob = p.predict(image, points)               # (H, W) in [0, 1]
+    >>> mask = prob > 0.5
+
+    One compiled forward per (resolution, channels) shape; subsequent calls
+    are dispatch-only.
+    """
+
+    def __init__(self, model, params, batch_stats,
+                 resolution: tuple[int, int] = (512, 512),
+                 relax: int = 50, zero_pad: bool = True,
+                 alpha: float = 0.6,
+                 guidance: str = "nellipse_gaussians",
+                 mean: Sequence[float] | None = None,
+                 std: Sequence[float] | None = None):
+        self.model = model
+        self.resolution = tuple(resolution)
+        self.relax = relax
+        self.zero_pad = zero_pad
+        self.alpha = alpha
+        self.guidance = guidance
+        variables = {"params": params, "batch_stats": batch_stats}
+
+        def forward(x):
+            if mean is not None or std is not None:
+                from .ops.augment import normalize
+                x = normalize({"concat": x}, mean or (0.0,),
+                              std or (255.0,))["concat"]
+            outputs = model.apply(variables, x, train=False)
+            # Fused (primary) head only — the tuple's first element, the one
+            # the reference's metric consumes (train_pascal.py:283).
+            return jax.nn.sigmoid(outputs[0].astype(jnp.float32))
+
+        self._forward = jax.jit(forward)
+
+    @classmethod
+    def from_run(cls, run_dir: str, best: bool = True, **kwargs) -> "Predictor":
+        """Build from a training run directory (``config.json`` +
+        ``checkpoints/``), restoring the best-metric checkpoint by default
+        (falls back to latest when no best exists)."""
+        from .models import build_model
+        from .parallel import create_train_state
+        from .train import config as config_lib
+        from .train.checkpoint import CheckpointManager
+        from .train.optim import make_optimizer
+
+        cfg = config_lib.from_json(os.path.join(run_dir, "config.json"))
+        if cfg.task != "instance":
+            raise ValueError(
+                f"Predictor is the click-guided instance path; this run was "
+                f"trained with task={cfg.task!r} (use the semantic eval "
+                f"protocol, train/evaluate.py:evaluate_semantic)")
+        if cfg.data.guidance == "none":
+            raise ValueError(
+                "this run was trained without a guidance channel "
+                "(data.guidance='none'); click-based prediction does not "
+                "apply to it")
+        # Mirror the Trainer's build_model call (trainer.py) minus the mesh
+        # couplings: ring PAM needs a sequence-parallel mesh, so inference
+        # falls back to the numerically identical einsum form.  The moe_*
+        # options shape the param tree and MUST match or restore fails.
+        model = build_model(
+            name=cfg.model.name, nclass=cfg.model.nclass,
+            backbone=cfg.model.backbone,
+            output_stride=cfg.model.output_stride, dtype=cfg.model.dtype,
+            pam_block_size=cfg.model.pam_block_size,
+            pam_impl="einsum" if cfg.model.pam_impl == "ring"
+            else cfg.model.pam_impl,
+            remat=cfg.model.remat,
+            moe_experts=cfg.model.moe_experts,
+            moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
+            moe_capacity_factor=cfg.model.moe_capacity_factor)
+        h, w = cfg.data.crop_size
+        # The template's opt_state tree must match what the run saved, so
+        # rebuild the optimizer from the run's own config (total_steps only
+        # shapes the schedule, not the state tree).  eval_shape keeps the
+        # template abstract — Orbax restores onto ShapeDtypeStructs, so no
+        # throwaway second copy of R101 params is ever materialized.
+        tx, _ = make_optimizer(cfg.optim, total_steps=1)
+        template = jax.eval_shape(
+            lambda: create_train_state(jax.random.PRNGKey(0), model, tx,
+                                       (1, h, w, cfg.model.in_channels)))
+        mgr = CheckpointManager(os.path.join(run_dir, "checkpoints"),
+                                async_save=False)
+        try:
+            if best:
+                try:
+                    state, _ = mgr.restore(template, best=True)
+                except FileNotFoundError:  # no best slot yet: use latest
+                    state, _ = mgr.restore(template, best=False)
+            else:
+                state, _ = mgr.restore(template, best=False)
+        finally:
+            mgr.close()
+        kwargs.setdefault("resolution", tuple(cfg.data.crop_size))
+        kwargs.setdefault("relax", cfg.data.relax)
+        kwargs.setdefault("zero_pad", cfg.data.zero_pad)
+        kwargs.setdefault("alpha", cfg.data.guidance_alpha)
+        kwargs.setdefault("guidance", cfg.data.guidance)
+        return cls(model, state.params, state.batch_stats, **kwargs)
+
+    def predict(self, image: np.ndarray, points: Any) -> np.ndarray:
+        """(H, W, 3) image + (4, 2) xy clicks -> (H, W) float32 probability
+        mask in full-image coordinates (relax border shaved, as in the val
+        metric path, reference train_pascal.py:290)."""
+        concat, bbox = prepare_input(
+            image, points, relax=self.relax, zero_pad=self.zero_pad,
+            resolution=self.resolution, alpha=self.alpha,
+            guidance=self.guidance)
+        prob = np.asarray(self._forward(concat[None]))[0, ..., 0]
+        full = crop2fullmask(prob, bbox, image.shape[:2],
+                             zero_pad=self.zero_pad, relax=self.relax)
+        # crop2fullmask's cubic resize can overshoot [0, 1] by a few percent;
+        # clamp so the public contract really is a probability map.
+        return np.clip(full, 0.0, 1.0)
+
+
+def parse_points(spec: str) -> np.ndarray:
+    """CLI point syntax: ``"x1,y1 x2,y2 x3,y3 x4,y4"`` (or ;-separated)."""
+    parts = spec.replace(";", " ").split()
+    try:
+        pts = np.array([[float(v) for v in p.split(",")] for p in parts])
+    except ValueError as e:
+        raise ValueError(f"bad --points {spec!r}: {e}") from e
+    if pts.shape != (4, 2):
+        raise ValueError(
+            f"--points needs exactly 4 x,y pairs, got shape {pts.shape}")
+    return pts
+
+
+def predict_cli(run_dir: str, image_path: str, points_spec: str,
+                out_path: str, threshold: float = 0.5,
+                overlay_path: str | None = None) -> dict:
+    """The ``--predict`` CLI body; returns a small summary dict."""
+    from PIL import Image
+
+    image = np.asarray(Image.open(image_path).convert("RGB"))
+    predictor = Predictor.from_run(run_dir)
+    prob = predictor.predict(image, parse_points(points_spec))
+    mask = prob > threshold
+    Image.fromarray((mask * 255).astype(np.uint8)).save(out_path)
+    if overlay_path:
+        from .utils.helpers import overlay_mask
+        over = overlay_mask(image.astype(np.float32) / 255.0,
+                            mask.astype(np.float32))
+        Image.fromarray(
+            (np.clip(over, 0, 1) * 255).astype(np.uint8)).save(overlay_path)
+    return {"pixels": int(mask.sum()), "threshold": threshold,
+            "max_prob": float(prob.max()), "out": out_path}

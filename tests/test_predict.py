@@ -1,0 +1,252 @@
+"""Inference path: clicks -> guidance -> forward -> full-res paste-back.
+
+The reference shipped no inference entry point (its val loop was the only
+consumer of the trained model, reference train_pascal.py:233-308); predict.py
+completes that story, so these tests pin its contracts: preprocessing parity
+with the val transform pipeline, output geometry, and the CLI body.
+"""
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.data import transforms as T
+from distributedpytorch_tpu.predict import (
+    Predictor,
+    guidance_from_points,
+    parse_points,
+    prepare_input,
+)
+
+
+def _image(h=90, w=120, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (h, w, 3)).astype(np.uint8)
+
+
+def _points(w=120, h=90):
+    # left, right, top, bottom extremes of a central object
+    return np.array([[30.0, 45.0], [95.0, 40.0], [60.0, 20.0], [55.0, 75.0]])
+
+
+class TestPrepareInput:
+    def test_shapes_and_ranges(self):
+        concat, bbox = prepare_input(_image(), _points(), relax=10,
+                                     resolution=(64, 64))
+        assert concat.shape == (64, 64, 4)
+        assert concat.dtype == np.float32
+        assert concat.min() >= 0.0 and concat.max() <= 255.0
+        # guidance channel peaks at exactly 255 (driver input contract,
+        # reference train_pascal.py:188)
+        assert concat[..., 3].max() == pytest.approx(255.0)
+        # bbox covers the points expanded by relax
+        x0, y0, x1, y1 = bbox
+        pts = _points()
+        assert x0 <= pts[:, 0].min() - 10 + 1 and x1 >= pts[:, 0].max() + 9
+        assert y0 <= pts[:, 1].min() - 10 + 1 and y1 >= pts[:, 1].max() + 9
+
+    def test_guidance_matches_val_transform(self):
+        """Clicks at the gt's deterministic extreme points must produce the
+        same guidance map the val pipeline computes from the gt itself."""
+        h = w = 48
+        gt = np.zeros((h, w), np.float32)
+        gt[10:38, 14:42] = 1.0
+        from distributedpytorch_tpu.data.guidance import extreme_points_fixed
+        pts = extreme_points_fixed(gt, 0).astype(np.float64)
+        expected = T.NEllipseWithGaussians(alpha=0.6, is_val=True)(
+            {"crop_gt": gt})["nellipseWithGaussians"]
+        got = guidance_from_points((h, w), pts, alpha=0.6)
+        np.testing.assert_allclose(got, expected, atol=1e-4)
+
+    def test_guidance_families_match_transforms(self):
+        """Each selectable family reproduces its training transform's map
+        when the clicks are the gt's deterministic extreme points."""
+        h = w = 48
+        gt = np.zeros((h, w), np.float32)
+        gt[10:38, 14:42] = 1.0
+        from distributedpytorch_tpu.data.guidance import extreme_points_fixed
+        pts = extreme_points_fixed(gt, 0).astype(np.float64)
+        np.testing.assert_allclose(
+            guidance_from_points((h, w), pts, family="nellipse"),
+            T.NEllipse(is_val=True)({"crop_gt": gt})["nellipse"], atol=1e-4)
+        np.testing.assert_allclose(
+            guidance_from_points((h, w), pts, family="extreme_points"),
+            T.ExtremePoints(pert=0, elem="crop_gt", is_val=True)(
+                {"crop_gt": gt})["extreme_points"], atol=1e-4)
+        with pytest.raises(ValueError, match="unknown guidance"):
+            guidance_from_points((h, w), pts, family="bogus")
+
+    def test_rejects_bad_inputs(self):
+        with pytest.raises(ValueError, match="RGB"):
+            prepare_input(np.zeros((8, 8)), _points())
+        with pytest.raises(ValueError, match="4 xy"):
+            prepare_input(_image(), np.zeros((3, 2)))
+        with pytest.raises(ValueError, match="outside"):
+            prepare_input(_image(), np.array([[0, 0], [1, 1], [2, 2],
+                                              [500, 500]]))
+
+
+class TestParsePoints:
+    def test_formats(self):
+        a = parse_points("1,2 3,4 5,6 7,8")
+        b = parse_points("1,2;3,4;5,6;7,8")
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (4, 2)
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            parse_points("1,2 3,4")
+        with pytest.raises(ValueError):
+            parse_points("1,2 3,4 5,6 seven,8")
+
+
+def _tiny_predictor(res=64):
+    import jax
+    import optax
+
+    from distributedpytorch_tpu.models import build_model
+    from distributedpytorch_tpu.parallel import create_train_state
+
+    model = build_model("danet", nclass=1, backbone="resnet18",
+                        output_stride=8)
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(1e-3), (1, res, res, 4))
+    return model, state, Predictor(model, state.params, state.batch_stats,
+                                   resolution=(res, res), relax=10)
+
+
+class TestPredictor:
+    def test_full_res_probability_mask(self):
+        _, _, p = _tiny_predictor()
+        img = _image()
+        prob = p.predict(img, _points())
+        assert prob.shape == img.shape[:2]
+        assert prob.dtype == np.float32
+        assert 0.0 <= prob.min() and prob.max() <= 1.0
+
+    def test_relax_border_shaved(self):
+        """Predictions outside the un-padded click box are zero (the val
+        metric's mask_relax paste-back, reference train_pascal.py:290)."""
+        _, _, p = _tiny_predictor()
+        prob = p.predict(_image(), _points())
+        pts = _points()
+        x0, y0 = pts[:, 0].min(), pts[:, 1].min()
+        x1, y1 = pts[:, 0].max(), pts[:, 1].max()
+        outside = np.ones_like(prob, bool)
+        outside[int(y0):int(y1) + 1, int(x0):int(x1) + 1] = False
+        assert prob[outside].max() == 0.0
+
+    def test_deterministic_and_reusable(self):
+        _, _, p = _tiny_predictor()
+        img = _image()
+        a = p.predict(img, _points())
+        b = p.predict(img, _points())
+        np.testing.assert_array_equal(a, b)
+        # different image through the same compiled forward
+        c = p.predict(_image(seed=1), _points())
+        assert c.shape == a.shape
+
+
+class TestPredictCli:
+    def test_end_to_end_from_run_dir(self, tmp_path):
+        """Round-trip: save a tiny run (config.json + checkpoint), then
+        segment a PNG through the CLI body."""
+        import jax
+        from PIL import Image
+
+        from distributedpytorch_tpu.models import build_model
+        from distributedpytorch_tpu.parallel import create_train_state
+        from distributedpytorch_tpu.predict import predict_cli
+        from distributedpytorch_tpu.train import Config, config as config_lib
+        from distributedpytorch_tpu.train.checkpoint import CheckpointManager
+        from distributedpytorch_tpu.train.optim import make_optimizer
+
+        res = 64
+        cfg = Config()
+        cfg.model.backbone = "resnet18"
+        cfg.data.crop_size = (res, res)
+        cfg.data.relax = 10
+        run = tmp_path / "run_0"
+        run.mkdir()
+        config_lib.to_json(cfg, str(run / "config.json"))
+
+        model = build_model("danet", nclass=1, backbone="resnet18",
+                            output_stride=8)
+        tx, _ = make_optimizer(cfg.optim, total_steps=1)
+        state = create_train_state(jax.random.PRNGKey(0), model,
+                                   tx, (1, res, res, 4))
+        mgr = CheckpointManager(str(run / "checkpoints"), async_save=False)
+        mgr.save(0, state, metric=0.5)
+        mgr.close()
+
+        img_path = tmp_path / "img.png"
+        Image.fromarray(_image()).save(img_path)
+        out_path = tmp_path / "mask.png"
+        overlay_path = tmp_path / "overlay.png"
+        summary = predict_cli(str(run), str(img_path),
+                              "30,45 95,40 60,20 55,75", str(out_path),
+                              overlay_path=str(overlay_path))
+        assert out_path.exists() and overlay_path.exists()
+        mask = np.asarray(Image.open(out_path))
+        assert mask.shape == (90, 120)
+        assert set(np.unique(mask)) <= {0, 255}
+        assert summary["pixels"] == int((mask == 255).sum())
+
+    def test_from_run_restores_moe_param_tree(self, tmp_path):
+        """MoE options shape the param tree; from_run must rebuild the model
+        with them or the Orbax restore structure-mismatches."""
+        import jax
+
+        from distributedpytorch_tpu.models import build_model
+        from distributedpytorch_tpu.parallel import create_train_state
+        from distributedpytorch_tpu.train import Config, config as config_lib
+        from distributedpytorch_tpu.train.checkpoint import CheckpointManager
+        from distributedpytorch_tpu.train.optim import make_optimizer
+
+        res = 64
+        cfg = Config()
+        cfg.model.backbone = "resnet18"
+        cfg.model.moe_experts = 2
+        cfg.data.crop_size = (res, res)
+        cfg.data.relax = 10
+        run = tmp_path / "run_moe"
+        run.mkdir()
+        config_lib.to_json(cfg, str(run / "config.json"))
+        model = build_model("danet", nclass=1, backbone="resnet18",
+                            output_stride=8, moe_experts=2)
+        tx, _ = make_optimizer(cfg.optim, total_steps=1)
+        state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                                   (1, res, res, 4))
+        mgr = CheckpointManager(str(run / "checkpoints"), async_save=False)
+        mgr.save(0, state, metric=0.1)
+        mgr.close()
+
+        p = Predictor.from_run(str(run))
+        prob = p.predict(_image(), _points())
+        assert prob.shape == (90, 120)
+
+    def test_cli_rejects_training_flags_in_predict_mode(self, capsys):
+        from distributedpytorch_tpu.__main__ import main
+
+        with pytest.raises(SystemExit):
+            main(["--predict", "img.png", "--run-dir", "r", "--points",
+                  "1,1 2,2 3,3 4,4", "optim.lr=1e-3"])
+        assert "config.json" in capsys.readouterr().err
+
+    def test_from_run_rejects_incompatible_configs(self, tmp_path):
+        from distributedpytorch_tpu.train import Config, config as config_lib
+
+        for overrides, msg in [
+            ({"task": "semantic", "model_nclass": 21}, "task"),
+            ({"guidance": "none"}, "guidance"),
+        ]:
+            run = tmp_path / f"run_{msg}"
+            run.mkdir()
+            cfg = Config()
+            if "task" in overrides:
+                cfg.task = overrides["task"]
+                cfg.model.nclass = overrides["model_nclass"]
+            if "guidance" in overrides:
+                cfg.data.guidance = overrides["guidance"]
+            config_lib.to_json(cfg, str(run / "config.json"))
+            with pytest.raises(ValueError, match=msg):
+                Predictor.from_run(str(run))
