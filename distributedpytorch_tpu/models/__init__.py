@@ -60,16 +60,18 @@ def build_model(
             bn_cross_replica_axis=bn_cross_replica_axis,
             **kw,
         )
-    if name == "deeplabv3":
+    if name in ("deeplabv3", "deeplabv3plus"):
         return DeepLabV3(
             nclass=nclass,
             backbone_depth=depth,
             output_stride=output_stride or 16,
+            decoder=(name == "deeplabv3plus"),
             dtype=dtype,
             bn_cross_replica_axis=bn_cross_replica_axis,
             **kw,
         )
-    raise ValueError(f"unknown model: {name!r} (danet | deeplabv3)")
+    raise ValueError(
+        f"unknown model: {name!r} (danet | deeplabv3 | deeplabv3plus)")
 
 
 __all__ = [

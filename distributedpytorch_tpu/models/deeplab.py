@@ -83,6 +83,34 @@ class FCNHead(nn.Module):
         return nn.Conv(self.nclass, (1, 1), dtype=self.dtype)(y)
 
 
+class DecoderV3Plus(nn.Module):
+    """DeepLabV3+ decoder: ASPP features upsampled to stride 4 and fused
+    with 1x1-projected low-level (c1) features, refined by two 3x3 convs.
+
+    Recovers the object-boundary detail the os=16 encoder path loses —
+    the standard accuracy upgrade over plain V3 at the same encoder cost."""
+
+    channels: int
+    low_channels: int
+    norm: Any
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, y, low, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, padding="SAME",
+                       dtype=self.dtype)
+        low = conv(self.low_channels, (1, 1), name="low_proj")(low)
+        low = self.norm(name="low_bn")(low)
+        low = nn.relu(low)
+        y = _resize_bilinear(y, low.shape[1:3])
+        y = jnp.concatenate([y, low], axis=-1)
+        for i in range(2):
+            y = conv(self.channels, (3, 3), name=f"refine{i}_conv")(y)
+            y = self.norm(name=f"refine{i}_bn")(y)
+            y = nn.relu(y)
+        return y
+
+
 class DeepLabV3(nn.Module):
     """Dilated ResNet + ASPP; ``__call__(x, train)`` -> (logits,) or
     (logits, aux_logits) at input resolution."""
@@ -92,6 +120,7 @@ class DeepLabV3(nn.Module):
     output_stride: int = 16
     aspp_channels: int = 256
     aux_head: bool = False
+    decoder: bool = False     # True = DeepLabV3+ (low-level c1 skip fusion)
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
     remat: bool = False
@@ -113,6 +142,10 @@ class DeepLabV3(nn.Module):
         norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
         y = ASPP(channels=self.aspp_channels, rates=rates, norm=norm,
                  dtype=self.dtype, name="aspp")(feats["c4"], train=train)
+        if self.decoder:
+            y = DecoderV3Plus(channels=self.aspp_channels, low_channels=48,
+                              norm=norm, dtype=self.dtype,
+                              name="decoder")(y, feats["c1"], train=train)
         y = nn.Conv(self.nclass, (1, 1), dtype=self.dtype, name="classifier")(y)
         outs = [_resize_bilinear(y, size)]
         if self.aux_head:

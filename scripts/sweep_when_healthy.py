@@ -1,0 +1,96 @@
+"""Poll the TPU tunnel; when it heals, run the pending PAM-variant sweep.
+
+One-shot session utility around scripts/perf_sweep.py's `run()`: the axon
+tunnel wedges for hours at a time (BASELINE.md), so chip experiments queue
+here instead of blocking a session.  Each probe is a subprocess with a hard
+timeout — a wedged backend init cannot take the poller down with it.
+
+Writes one JSON line per variant to --out as results land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE = """
+import sys
+sys.path.insert(0, %r)
+from distributedpytorch_tpu.backend_health import ensure_backend_or_cpu_fallback
+ensure_backend_or_cpu_fallback()
+import jax
+print("TPU" if any(d.platform == "tpu" for d in jax.devices()) else "CPU")
+""" % REPO
+
+# Reuse perf_sweep.run() — one benchmark definition (per-chip normalized,
+# device-count-scaled batch); importing perf_sweep also runs its bounded
+# backend probe and exits non-zero when no TPU is reachable, which is
+# exactly the child behavior this poller wants.
+VARIANT = """
+import json, sys
+sys.path.insert(0, %(scripts)r)
+sys.path.insert(0, %(repo)r)
+from perf_sweep import run
+v = run(batch=%(batch)d, pam_impl=%(impl)r, block=%(block)r, remat=False)
+print(json.dumps({"impl": %(impl)r, "block": %(block)r, "batch": %(batch)d,
+                  "imgs_per_sec_per_chip": v}))
+"""
+
+VARIANTS = [
+    {"impl": "einsum", "block": 2048, "batch": 8},
+    {"impl": "einsum", "block": 1024, "batch": 8},
+    {"impl": "flash", "block": 1024, "batch": 8},
+    {"impl": "flash", "block": 256, "batch": 8},
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/pam_sweep_results.jsonl")
+    ap.add_argument("--poll-seconds", type=int, default=600)
+    ap.add_argument("--max-hours", type=float, default=8.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    while time.time() < deadline:
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", PROBE], capture_output=True,
+                text=True, timeout=180)
+            healthy = probe.stdout.strip().endswith("TPU")
+        except subprocess.TimeoutExpired:
+            healthy = False
+        if healthy:
+            break
+        time.sleep(args.poll_seconds)
+    else:
+        print("tunnel never healed within the window")
+        return 1
+
+    with open(args.out, "a") as f:
+        for v in VARIANTS:
+            code = VARIANT % {"repo": REPO,
+                              "scripts": os.path.join(REPO, "scripts"), **v}
+            try:
+                r = subprocess.run([sys.executable, "-c", code],
+                                   capture_output=True, text=True,
+                                   timeout=900)
+                line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+                if r.returncode != 0:
+                    line = json.dumps({**v, "error": r.stderr[-300:]})
+            except subprocess.TimeoutExpired:
+                line = json.dumps({**v, "error": "timeout"})
+            print(line)
+            f.write(line + "\n")
+            f.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,18 @@ class TestDeepLabV3:
         assert len(out) == 2
         assert out[1].shape == (1, 64, 64, 21)
 
+    def test_v3plus_decoder(self):
+        """decoder=True fuses stride-4 c1 features (DeepLabV3+); output
+        contract and shapes are unchanged, param tree gains the decoder."""
+        m = DeepLabV3(nclass=21, backbone_depth=18, output_stride=16,
+                      decoder=True)
+        x = jnp.zeros((1, 64, 64, 3))
+        variables, out = init_and_apply(m, x)
+        assert out[0].shape == (1, 64, 64, 21)
+        assert "decoder" in variables["params"]
+        low = variables["params"]["decoder"]["low_proj"]["kernel"]
+        assert low.shape[-1] == 48  # the standard low-level projection width
+
 
 class TestFactory:
     def test_build_danet(self):
@@ -111,6 +123,11 @@ class TestFactory:
         m = build_model("deeplabv3", nclass=21, backbone="resnet50",
                         dtype="bfloat16")
         assert isinstance(m, DeepLabV3) and m.dtype == jnp.bfloat16
+        assert not m.decoder
+
+    def test_build_deeplabv3plus(self):
+        m = build_model("deeplabv3plus", nclass=21, backbone="resnet50")
+        assert isinstance(m, DeepLabV3) and m.decoder
 
     def test_unknown_raises(self):
         with pytest.raises(ValueError):
