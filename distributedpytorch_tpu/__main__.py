@@ -44,7 +44,8 @@ def main(argv: list[str] | None = None) -> int:
                              "for --predict")
     parser.add_argument("--points",
                         help='4 extreme-point clicks "x1,y1 x2,y2 x3,y3 '
-                             'x4,y4" for --predict')
+                             'x4,y4" for --predict on instance-task runs '
+                             "(semantic runs segment the whole image)")
     parser.add_argument("--out", default="mask.png",
                         help="output mask PNG for --predict")
     parser.add_argument("--overlay",
@@ -61,8 +62,9 @@ def main(argv: list[str] | None = None) -> int:
     # Predict mode first: it must not fall into the multi-host rendezvous
     # below (jax.distributed.initialize() blocks waiting for peers).
     if args.predict:
-        if not (args.run_dir and args.points):
-            parser.error("--predict requires --run-dir and --points")
+        if not args.run_dir:
+            parser.error("--predict requires --run-dir (--points too for "
+                         "instance-task runs)")
         if args.config or args.fake_data or args.validate_only \
                 or args.distributed or args.overrides:
             parser.error(
@@ -71,9 +73,12 @@ def main(argv: list[str] | None = None) -> int:
                 "--distributed/overrides do not apply (got "
                 f"{args.overrides or 'training-mode flags'})")
         from .predict import predict_cli
-        summary = predict_cli(args.run_dir, args.predict, args.points,
-                              args.out, threshold=args.threshold,
-                              overlay_path=args.overlay)
+        try:
+            summary = predict_cli(args.run_dir, args.predict, args.points,
+                                  args.out, threshold=args.threshold,
+                                  overlay_path=args.overlay)
+        except ValueError as e:  # missing points / bad clicks / wrong task
+            parser.error(str(e))
         print(summary)
         return 0
 

@@ -124,6 +124,69 @@ def prepare_input(
     return concat.astype(np.float32), bbox
 
 
+def load_run_config(run_dir: str):
+    """The run's saved ``Config`` (cheap — no checkpoint IO), so callers can
+    validate task/guidance compatibility before paying for the restore."""
+    from .train import config as config_lib
+
+    return config_lib.from_json(os.path.join(run_dir, "config.json"))
+
+
+def load_run(run_dir: str, best: bool = True, cfg=None):
+    """Load ``(cfg, model, state)`` from a training run directory.
+
+    ``cfg``: pass the run's already-loaded config (from
+    :func:`load_run_config`) to skip re-reading it.
+
+    Rebuilds the model exactly as the Trainer did (minus mesh couplings:
+    ring PAM needs a sequence-parallel mesh, so inference falls back to the
+    numerically identical einsum form; the moe_* options shape the param
+    tree and MUST match or restore fails), then restores the best-metric
+    checkpoint (falling back to latest) onto an abstract ``eval_shape``
+    template — Orbax restores onto ShapeDtypeStructs, so no throwaway
+    second copy of the params is ever materialized.
+    """
+    from .models import build_model
+    from .parallel import create_train_state
+    from .train.checkpoint import CheckpointManager
+    from .train.optim import make_optimizer
+
+    if cfg is None:
+        cfg = load_run_config(run_dir)
+    model = build_model(
+        name=cfg.model.name, nclass=cfg.model.nclass,
+        backbone=cfg.model.backbone,
+        output_stride=cfg.model.output_stride, dtype=cfg.model.dtype,
+        pam_block_size=cfg.model.pam_block_size,
+        pam_impl="einsum" if cfg.model.pam_impl == "ring"
+        else cfg.model.pam_impl,
+        remat=cfg.model.remat,
+        moe_experts=cfg.model.moe_experts,
+        moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
+        moe_capacity_factor=cfg.model.moe_capacity_factor)
+    h, w = cfg.data.crop_size
+    # The template's opt_state tree must match what the run saved, so the
+    # optimizer comes from the run's own config (total_steps only shapes
+    # the schedule, not the state tree).
+    tx, _ = make_optimizer(cfg.optim, total_steps=1)
+    template = jax.eval_shape(
+        lambda: create_train_state(jax.random.PRNGKey(0), model, tx,
+                                   (1, h, w, cfg.model.in_channels)))
+    mgr = CheckpointManager(os.path.join(run_dir, "checkpoints"),
+                            async_save=False)
+    try:
+        if best:
+            try:
+                state, _ = mgr.restore(template, best=True)
+            except FileNotFoundError:  # no best slot yet: use latest
+                state, _ = mgr.restore(template, best=False)
+        else:
+            state, _ = mgr.restore(template, best=False)
+    finally:
+        mgr.close()
+    return cfg, model, state
+
+
 class Predictor:
     """Reusable click-to-mask inference on one model + checkpoint.
 
@@ -167,60 +230,17 @@ class Predictor:
         """Build from a training run directory (``config.json`` +
         ``checkpoints/``), restoring the best-metric checkpoint by default
         (falls back to latest when no best exists)."""
-        from .models import build_model
-        from .parallel import create_train_state
-        from .train import config as config_lib
-        from .train.checkpoint import CheckpointManager
-        from .train.optim import make_optimizer
-
-        cfg = config_lib.from_json(os.path.join(run_dir, "config.json"))
+        cfg = load_run_config(run_dir)
         if cfg.task != "instance":
             raise ValueError(
                 f"Predictor is the click-guided instance path; this run was "
-                f"trained with task={cfg.task!r} (use the semantic eval "
-                f"protocol, train/evaluate.py:evaluate_semantic)")
+                f"trained with task={cfg.task!r} (use SemanticPredictor)")
         if cfg.data.guidance == "none":
             raise ValueError(
                 "this run was trained without a guidance channel "
                 "(data.guidance='none'); click-based prediction does not "
                 "apply to it")
-        # Mirror the Trainer's build_model call (trainer.py) minus the mesh
-        # couplings: ring PAM needs a sequence-parallel mesh, so inference
-        # falls back to the numerically identical einsum form.  The moe_*
-        # options shape the param tree and MUST match or restore fails.
-        model = build_model(
-            name=cfg.model.name, nclass=cfg.model.nclass,
-            backbone=cfg.model.backbone,
-            output_stride=cfg.model.output_stride, dtype=cfg.model.dtype,
-            pam_block_size=cfg.model.pam_block_size,
-            pam_impl="einsum" if cfg.model.pam_impl == "ring"
-            else cfg.model.pam_impl,
-            remat=cfg.model.remat,
-            moe_experts=cfg.model.moe_experts,
-            moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
-            moe_capacity_factor=cfg.model.moe_capacity_factor)
-        h, w = cfg.data.crop_size
-        # The template's opt_state tree must match what the run saved, so
-        # rebuild the optimizer from the run's own config (total_steps only
-        # shapes the schedule, not the state tree).  eval_shape keeps the
-        # template abstract — Orbax restores onto ShapeDtypeStructs, so no
-        # throwaway second copy of R101 params is ever materialized.
-        tx, _ = make_optimizer(cfg.optim, total_steps=1)
-        template = jax.eval_shape(
-            lambda: create_train_state(jax.random.PRNGKey(0), model, tx,
-                                       (1, h, w, cfg.model.in_channels)))
-        mgr = CheckpointManager(os.path.join(run_dir, "checkpoints"),
-                                async_save=False)
-        try:
-            if best:
-                try:
-                    state, _ = mgr.restore(template, best=True)
-                except FileNotFoundError:  # no best slot yet: use latest
-                    state, _ = mgr.restore(template, best=False)
-            else:
-                state, _ = mgr.restore(template, best=False)
-        finally:
-            mgr.close()
+        cfg, model, state = load_run(run_dir, best=best, cfg=cfg)
         kwargs.setdefault("resolution", tuple(cfg.data.crop_size))
         kwargs.setdefault("relax", cfg.data.relax)
         kwargs.setdefault("zero_pad", cfg.data.zero_pad)
@@ -244,6 +264,69 @@ class Predictor:
         return np.clip(full, 0.0, 1.0)
 
 
+class SemanticPredictor:
+    """Whole-image multi-class inference for ``task='semantic'`` runs.
+
+    Mirrors the semantic eval pipeline (pipeline.py:
+    build_semantic_eval_transform): fixed resize to the training crop size,
+    forward, per-pixel argmax of the primary head, nearest-resize of the
+    class map back to the input size (class ids must stay exact).
+
+    >>> p = SemanticPredictor.from_run("work/run_0")
+    >>> classes = p.predict(image)       # (H, W) uint8 class ids
+    """
+
+    def __init__(self, model, params, batch_stats,
+                 resolution: tuple[int, int] = (513, 513),
+                 mean: Sequence[float] | None = None,
+                 std: Sequence[float] | None = None):
+        self.model = model
+        self.resolution = tuple(resolution)
+        variables = {"params": params, "batch_stats": batch_stats}
+
+        def forward(x):
+            if mean is not None or std is not None:
+                from .ops.augment import normalize
+                x = normalize({"concat": x}, mean or (0.0,),
+                              std or (255.0,))["concat"]
+            outputs = model.apply(variables, x, train=False)
+            # Argmax on device: one (H, W) int map crosses the wire, not
+            # the (H, W, C) logits.
+            return jnp.argmax(outputs[0], axis=-1).astype(jnp.int32)
+
+        self._forward = jax.jit(forward)
+
+    @classmethod
+    def from_run(cls, run_dir: str, best: bool = True,
+                 **kwargs) -> "SemanticPredictor":
+        cfg = load_run_config(run_dir)
+        if cfg.task != "semantic":
+            raise ValueError(
+                f"SemanticPredictor is the whole-image multi-class path; "
+                f"this run was trained with task={cfg.task!r} (use "
+                f"Predictor for click-guided instance runs)")
+        cfg, model, state = load_run(run_dir, best=best, cfg=cfg)
+        kwargs.setdefault("resolution", tuple(cfg.data.crop_size))
+        return cls(model, state.params, state.batch_stats, **kwargs)
+
+    def predict(self, image: np.ndarray) -> np.ndarray:
+        """(H, W, 3) RGB in [0, 255] -> (H, W) class-id map.
+
+        uint8 when the model's class count fits (the PNG-writable common
+        case); int32 otherwise — never a silent modulo-256 wrap."""
+        image = np.asarray(image, np.float32)
+        if image.ndim != 3 or image.shape[-1] != 3:
+            raise ValueError(f"expected (H, W, 3) RGB image, got "
+                             f"{image.shape}")
+        resized = imaging.resize(np.clip(image, 0.0, 255.0),
+                                 self.resolution, imaging.CUBIC)
+        classes = np.asarray(self._forward(resized[None]))[0]
+        full = imaging.resize(classes.astype(np.float32), image.shape[:2],
+                              imaging.NEAREST)
+        dtype = np.uint8 if self.model.nclass <= 256 else np.int32
+        return full.astype(dtype)
+
+
 def parse_points(spec: str) -> np.ndarray:
     """CLI point syntax: ``"x1,y1 x2,y2 x3,y3 x4,y4"`` (or ;-separated)."""
     parts = spec.replace(";", " ").split()
@@ -257,22 +340,47 @@ def parse_points(spec: str) -> np.ndarray:
     return pts
 
 
-def predict_cli(run_dir: str, image_path: str, points_spec: str,
+def predict_cli(run_dir: str, image_path: str, points_spec: str | None,
                 out_path: str, threshold: float = 0.5,
                 overlay_path: str | None = None) -> dict:
-    """The ``--predict`` CLI body; returns a small summary dict."""
+    """The ``--predict`` CLI body; dispatches on the run's task.
+
+    Instance runs need ``points_spec`` (the 4 clicks) and write a binary
+    mask PNG; semantic runs take the whole image and write a class-id PNG.
+    Returns a small summary dict either way.
+    """
     from PIL import Image
 
+    from .utils.helpers import overlay_mask
+
+    cfg = load_run_config(run_dir)
     image = np.asarray(Image.open(image_path).convert("RGB"))
-    predictor = Predictor.from_run(run_dir)
-    prob = predictor.predict(image, parse_points(points_spec))
+
+    if cfg.task == "semantic":
+        classes = SemanticPredictor.from_run(run_dir).predict(image)
+        Image.fromarray(classes).save(out_path)
+        fg = classes > 0
+        if overlay_path:
+            over = overlay_mask(image.astype(np.float32) / 255.0,
+                                fg.astype(np.float32))
+            Image.fromarray((np.clip(over, 0, 1) * 255).astype(np.uint8)
+                            ).save(overlay_path)
+        present = {int(c): int(n) for c, n in
+                   zip(*np.unique(classes, return_counts=True))}
+        return {"task": "semantic", "classes": present, "out": out_path}
+
+    if not points_spec:
+        raise ValueError("this run is task='instance': --points (the 4 "
+                         "extreme-point clicks) is required")
+    prob = Predictor.from_run(run_dir).predict(image,
+                                               parse_points(points_spec))
     mask = prob > threshold
     Image.fromarray((mask * 255).astype(np.uint8)).save(out_path)
     if overlay_path:
-        from .utils.helpers import overlay_mask
         over = overlay_mask(image.astype(np.float32) / 255.0,
                             mask.astype(np.float32))
         Image.fromarray(
             (np.clip(over, 0, 1) * 255).astype(np.uint8)).save(overlay_path)
-    return {"pixels": int(mask.sum()), "threshold": threshold,
-            "max_prob": float(prob.max()), "out": out_path}
+    return {"task": "instance", "pixels": int(mask.sum()),
+            "threshold": threshold, "max_prob": float(prob.max()),
+            "out": out_path}

@@ -12,6 +12,7 @@ import pytest
 from distributedpytorch_tpu.data import transforms as T
 from distributedpytorch_tpu.predict import (
     Predictor,
+    SemanticPredictor,
     guidance_from_points,
     parse_points,
     prepare_input,
@@ -191,6 +192,10 @@ class TestPredictCli:
         assert set(np.unique(mask)) <= {0, 255}
         assert summary["pixels"] == int((mask == 255).sum())
 
+        # an instance run without points must fail loudly, not segment
+        with pytest.raises(ValueError, match="--points"):
+            predict_cli(str(run), str(img_path), None, str(out_path))
+
     def test_from_run_restores_moe_param_tree(self, tmp_path):
         """MoE options shape the param tree; from_run must rebuild the model
         with them or the Orbax restore structure-mismatches."""
@@ -231,6 +236,59 @@ class TestPredictCli:
             main(["--predict", "img.png", "--run-dir", "r", "--points",
                   "1,1 2,2 3,3 4,4", "optim.lr=1e-3"])
         assert "config.json" in capsys.readouterr().err
+
+    def test_semantic_run_roundtrip(self, tmp_path):
+        """A semantic-task run dir predicts a whole-image class map, both
+        through SemanticPredictor and the task-dispatching CLI body."""
+        import jax
+        from PIL import Image
+
+        from distributedpytorch_tpu.models import build_model
+        from distributedpytorch_tpu.parallel import create_train_state
+        from distributedpytorch_tpu.predict import predict_cli
+        from distributedpytorch_tpu.train import Config, config as config_lib
+        from distributedpytorch_tpu.train.checkpoint import CheckpointManager
+        from distributedpytorch_tpu.train.optim import make_optimizer
+
+        res, nclass = 64, 7
+        cfg = Config()
+        cfg.task = "semantic"
+        cfg.model.name = "deeplabv3"
+        cfg.model.nclass = nclass
+        cfg.model.backbone = "resnet18"
+        cfg.model.output_stride = 16
+        cfg.model.in_channels = 3
+        cfg.data.crop_size = (res, res)
+        run = tmp_path / "run_sem"
+        run.mkdir()
+        config_lib.to_json(cfg, str(run / "config.json"))
+        model = build_model("deeplabv3", nclass=nclass, backbone="resnet18",
+                            output_stride=16)
+        tx, _ = make_optimizer(cfg.optim, total_steps=1)
+        state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                                   (1, res, res, 3))
+        mgr = CheckpointManager(str(run / "checkpoints"), async_save=False)
+        mgr.save(0, state, metric=0.1)
+        mgr.close()
+
+        p = SemanticPredictor.from_run(str(run))
+        classes = p.predict(_image())
+        assert classes.shape == (90, 120) and classes.dtype == np.uint8
+        assert classes.max() < nclass
+
+        # the instance Predictor must refuse this run
+        with pytest.raises(ValueError, match="instance"):
+            Predictor.from_run(str(run))
+
+        # CLI dispatch: no --points needed for a semantic run
+        img_path = tmp_path / "img.png"
+        Image.fromarray(_image()).save(img_path)
+        out_path = tmp_path / "classes.png"
+        summary = predict_cli(str(run), str(img_path), None, str(out_path))
+        assert summary["task"] == "semantic"
+        saved = np.asarray(Image.open(out_path))
+        np.testing.assert_array_equal(saved, classes)
+        assert summary["classes"]  # per-class pixel counts present
 
     def test_from_run_rejects_incompatible_configs(self, tmp_path):
         from distributedpytorch_tpu.train import Config, config as config_lib
