@@ -50,8 +50,9 @@ def main(argv: list[str] | None = None) -> int:
                         help="output mask PNG for --predict")
     parser.add_argument("--overlay",
                         help="also write an RGB overlay PNG (--predict)")
-    parser.add_argument("--threshold", type=float, default=0.5,
-                        help="binarization threshold for --predict")
+    parser.add_argument("--threshold", type=float, default=None,
+                        help="binarization threshold for --predict on "
+                             "instance-task runs (default 0.5)")
     parser.add_argument("--distributed", action="store_true",
                         help="call jax.distributed.initialize() first "
                              "(multi-host pods)")

@@ -132,28 +132,14 @@ def load_run_config(run_dir: str):
     return config_lib.from_json(os.path.join(run_dir, "config.json"))
 
 
-def load_run(run_dir: str, best: bool = True, cfg=None):
-    """Load ``(cfg, model, state)`` from a training run directory.
-
-    ``cfg``: pass the run's already-loaded config (from
-    :func:`load_run_config`) to skip re-reading it.
-
-    Rebuilds the model exactly as the Trainer did (minus mesh couplings:
+def model_from_config(cfg):
+    """Rebuild the model exactly as the Trainer did, minus mesh couplings:
     ring PAM needs a sequence-parallel mesh, so inference falls back to the
-    numerically identical einsum form; the moe_* options shape the param
-    tree and MUST match or restore fails), then restores the best-metric
-    checkpoint (falling back to latest) onto an abstract ``eval_shape``
-    template — Orbax restores onto ShapeDtypeStructs, so no throwaway
-    second copy of the params is ever materialized.
-    """
+    numerically identical einsum form.  The moe_* options shape the param
+    tree and MUST match or checkpoint restore fails."""
     from .models import build_model
-    from .parallel import create_train_state
-    from .train.checkpoint import CheckpointManager
-    from .train.optim import make_optimizer
 
-    if cfg is None:
-        cfg = load_run_config(run_dir)
-    model = build_model(
+    return build_model(
         name=cfg.model.name, nclass=cfg.model.nclass,
         backbone=cfg.model.backbone,
         output_stride=cfg.model.output_stride, dtype=cfg.model.dtype,
@@ -164,6 +150,26 @@ def load_run(run_dir: str, best: bool = True, cfg=None):
         moe_experts=cfg.model.moe_experts,
         moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
         moe_capacity_factor=cfg.model.moe_capacity_factor)
+
+
+def load_run(run_dir: str, best: bool = True, cfg=None):
+    """Load ``(cfg, model, state)`` from a training run directory.
+
+    ``cfg``: pass the run's already-loaded config (from
+    :func:`load_run_config`) to skip re-reading it.
+
+    Restores the best-metric checkpoint (falling back to latest) onto an
+    abstract ``eval_shape`` template — Orbax restores onto
+    ShapeDtypeStructs, so no throwaway second copy of the params is ever
+    materialized.
+    """
+    from .parallel import create_train_state
+    from .train.checkpoint import CheckpointManager
+    from .train.optim import make_optimizer
+
+    if cfg is None:
+        cfg = load_run_config(run_dir)
+    model = model_from_config(cfg)
     h, w = cfg.data.crop_size
     # The template's opt_state tree must match what the run saved, so the
     # optimizer comes from the run's own config (total_steps only shapes
@@ -226,11 +232,14 @@ class Predictor:
         self._forward = jax.jit(forward)
 
     @classmethod
-    def from_run(cls, run_dir: str, best: bool = True, **kwargs) -> "Predictor":
+    def from_run(cls, run_dir: str, best: bool = True, cfg=None,
+                 **kwargs) -> "Predictor":
         """Build from a training run directory (``config.json`` +
         ``checkpoints/``), restoring the best-metric checkpoint by default
-        (falls back to latest when no best exists)."""
-        cfg = load_run_config(run_dir)
+        (falls back to latest when no best exists).  ``cfg`` skips
+        re-reading an already-loaded run config."""
+        if cfg is None:
+            cfg = load_run_config(run_dir)
         if cfg.task != "instance":
             raise ValueError(
                 f"Predictor is the click-guided instance path; this run was "
@@ -247,6 +256,76 @@ class Predictor:
         kwargs.setdefault("alpha", cfg.data.guidance_alpha)
         kwargs.setdefault("guidance", cfg.data.guidance)
         return cls(model, state.params, state.batch_stats, **kwargs)
+
+    @classmethod
+    def from_torch(cls, path: str, cfg=None, partial: bool = False,
+                   rename=None, **kwargs) -> "Predictor":
+        """Serve a torch ``.pth`` state_dict directly — no training run
+        needed.  The reference's own accumulated checkpoints (it always
+        warm-started from one, train_pascal.py:103) become TPU predictors
+        in one call.
+
+        ``cfg`` defaults to :class:`train.Config`'s reference hyperparameter
+        point (DANet-R101, 4-channel 512² input) — the architecture the
+        reference's checkpoints were trained on.  ``rename`` maps foreign
+        key naming onto this framework's (see utils.torch_interop);
+        ``partial=True`` tolerates missing/extra keys (e.g. a re-sized
+        head), keeping fresh-init values for the gaps.
+        """
+        from .train.config import Config
+        from .utils.torch_interop import (
+            load_torch_file,
+            torch_state_dict_to_params,
+        )
+
+        cfg = cfg or Config()
+        if cfg.task != "instance":
+            raise ValueError("Predictor.from_torch serves the click-guided "
+                             f"instance path; got task={cfg.task!r}")
+        if cfg.data.guidance == "none":
+            raise ValueError(
+                "cfg has no guidance channel (data.guidance='none'); "
+                "click-based prediction does not apply to it")
+        model = model_from_config(cfg)
+        h, w = cfg.data.crop_size
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, h, w, cfg.model.in_channels), jnp.float32),
+            train=False)
+        init_params = variables["params"]
+        init_stats = variables.get("batch_stats", {})
+
+        # Shape-only templates so imported-vs-kept is distinguishable
+        # (a concrete template leaf and a kept leaf would look identical).
+        as_struct = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
+        params, stats = torch_state_dict_to_params(
+            load_torch_file(path), as_struct(init_params),
+            as_struct(init_stats), rename=rename,
+            allow_missing=partial, allow_unused=partial)
+
+        imported = [0, 0]  # [from checkpoint, kept fresh-init]
+
+        def place(new, old):
+            if isinstance(new, jax.ShapeDtypeStruct):
+                imported[1] += 1
+                return old
+            imported[0] += 1
+            return jnp.asarray(new)
+
+        params = jax.tree.map(place, params, init_params)
+        stats = jax.tree.map(place, stats, init_stats)
+        if imported[0] == 0:
+            raise ValueError(
+                f"warm start from {path} imported 0 of {imported[1]} "
+                "leaves — checkpoint keys do not match this model; check "
+                "the architecture/naming (or pass a rename callable)")
+        kwargs.setdefault("resolution", tuple(cfg.data.crop_size))
+        kwargs.setdefault("relax", cfg.data.relax)
+        kwargs.setdefault("zero_pad", cfg.data.zero_pad)
+        kwargs.setdefault("alpha", cfg.data.guidance_alpha)
+        kwargs.setdefault("guidance", cfg.data.guidance)
+        return cls(model, params, stats, **kwargs)
 
     def predict(self, image: np.ndarray, points: Any) -> np.ndarray:
         """(H, W, 3) image + (4, 2) xy clicks -> (H, W) float32 probability
@@ -297,9 +376,10 @@ class SemanticPredictor:
         self._forward = jax.jit(forward)
 
     @classmethod
-    def from_run(cls, run_dir: str, best: bool = True,
+    def from_run(cls, run_dir: str, best: bool = True, cfg=None,
                  **kwargs) -> "SemanticPredictor":
-        cfg = load_run_config(run_dir)
+        if cfg is None:
+            cfg = load_run_config(run_dir)
         if cfg.task != "semantic":
             raise ValueError(
                 f"SemanticPredictor is the whole-image multi-class path; "
@@ -341,13 +421,14 @@ def parse_points(spec: str) -> np.ndarray:
 
 
 def predict_cli(run_dir: str, image_path: str, points_spec: str | None,
-                out_path: str, threshold: float = 0.5,
+                out_path: str, threshold: float | None = None,
                 overlay_path: str | None = None) -> dict:
     """The ``--predict`` CLI body; dispatches on the run's task.
 
     Instance runs need ``points_spec`` (the 4 clicks) and write a binary
-    mask PNG; semantic runs take the whole image and write a class-id PNG.
-    Returns a small summary dict either way.
+    mask PNG (``threshold`` defaults to 0.5); semantic runs take the whole
+    image and write a class-id PNG — passing clicks or a threshold to one
+    is an error, not a silent drop.  Returns a small summary dict.
     """
     from PIL import Image
 
@@ -357,7 +438,11 @@ def predict_cli(run_dir: str, image_path: str, points_spec: str | None,
     image = np.asarray(Image.open(image_path).convert("RGB"))
 
     if cfg.task == "semantic":
-        classes = SemanticPredictor.from_run(run_dir).predict(image)
+        if points_spec or threshold is not None:
+            raise ValueError(
+                "this run is task='semantic' (whole-image class map): "
+                "--points/--threshold do not apply")
+        classes = SemanticPredictor.from_run(run_dir, cfg=cfg).predict(image)
         Image.fromarray(classes).save(out_path)
         fg = classes > 0
         if overlay_path:
@@ -372,8 +457,9 @@ def predict_cli(run_dir: str, image_path: str, points_spec: str | None,
     if not points_spec:
         raise ValueError("this run is task='instance': --points (the 4 "
                          "extreme-point clicks) is required")
-    prob = Predictor.from_run(run_dir).predict(image,
-                                               parse_points(points_spec))
+    threshold = 0.5 if threshold is None else threshold
+    prob = Predictor.from_run(run_dir, cfg=cfg).predict(
+        image, parse_points(points_spec))
     mask = prob > threshold
     Image.fromarray((mask * 255).astype(np.uint8)).save(out_path)
     if overlay_path:

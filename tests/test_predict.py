@@ -147,6 +147,57 @@ class TestPredictor:
         assert c.shape == a.shape
 
 
+class TestFromTorch:
+    def test_roundtrip_matches_native_predictor(self, tmp_path):
+        """A torch .pth exported from this framework's own params serves
+        identical predictions through Predictor.from_torch."""
+        import jax
+        import torch
+
+        from distributedpytorch_tpu.train import Config
+        from distributedpytorch_tpu.utils.torch_interop import (
+            params_to_torch_state_dict,
+        )
+
+        res = 64
+        cfg = Config()
+        cfg.model.backbone = "resnet18"
+        cfg.data.crop_size = (res, res)
+        cfg.data.relax = 10
+        from distributedpytorch_tpu.predict import model_from_config
+        model = model_from_config(cfg)
+        variables = model.init(jax.random.PRNGKey(3),
+                               np.zeros((1, res, res, 4), np.float32),
+                               train=False)
+        sd = params_to_torch_state_dict(variables["params"],
+                                        variables["batch_stats"])
+        pth = tmp_path / "export.pth"
+        torch.save({k: torch.from_numpy(np.asarray(v)) for k, v in sd.items()},
+                   str(pth))
+
+        p_torch = Predictor.from_torch(str(pth), cfg=cfg)
+        p_native = Predictor(model, variables["params"],
+                             variables["batch_stats"],
+                             resolution=(res, res), relax=10)
+        img = _image()
+        np.testing.assert_allclose(p_torch.predict(img, _points()),
+                                   p_native.predict(img, _points()),
+                                   atol=1e-5)
+
+    def test_zero_match_raises(self, tmp_path):
+        import torch
+
+        from distributedpytorch_tpu.train import Config
+
+        cfg = Config()
+        cfg.model.backbone = "resnet18"
+        cfg.data.crop_size = (64, 64)
+        pth = tmp_path / "junk.pth"
+        torch.save({"foo.weight": torch.zeros(3, 3)}, str(pth))
+        with pytest.raises(ValueError, match="imported 0"):
+            Predictor.from_torch(str(pth), cfg=cfg, partial=True)
+
+
 class TestPredictCli:
     def test_end_to_end_from_run_dir(self, tmp_path):
         """Round-trip: save a tiny run (config.json + checkpoint), then
@@ -289,6 +340,14 @@ class TestPredictCli:
         saved = np.asarray(Image.open(out_path))
         np.testing.assert_array_equal(saved, classes)
         assert summary["classes"]  # per-class pixel counts present
+
+        # clicks/threshold on a semantic run error loudly, never drop
+        with pytest.raises(ValueError, match="do not apply"):
+            predict_cli(str(run), str(img_path), "1,1 2,2 3,3 4,4",
+                        str(out_path))
+        with pytest.raises(ValueError, match="do not apply"):
+            predict_cli(str(run), str(img_path), None, str(out_path),
+                        threshold=0.9)
 
     def test_from_run_rejects_incompatible_configs(self, tmp_path):
         from distributedpytorch_tpu.train import Config, config as config_lib
