@@ -331,16 +331,33 @@ class Predictor:
         """(H, W, 3) image + (4, 2) xy clicks -> (H, W) float32 probability
         mask in full-image coordinates (relax border shaved, as in the val
         metric path, reference train_pascal.py:290)."""
-        concat, bbox = prepare_input(
-            image, points, relax=self.relax, zero_pad=self.zero_pad,
-            resolution=self.resolution, alpha=self.alpha,
-            guidance=self.guidance)
-        prob = np.asarray(self._forward(concat[None]))[0, ..., 0]
-        full = crop2fullmask(prob, bbox, image.shape[:2],
-                             zero_pad=self.zero_pad, relax=self.relax)
-        # crop2fullmask's cubic resize can overshoot [0, 1] by a few percent;
-        # clamp so the public contract really is a probability map.
-        return np.clip(full, 0.0, 1.0)
+        return self.predict_batch(image, [points])[0]
+
+    def predict_batch(self, image: np.ndarray,
+                      points_list: Sequence[Any]) -> list[np.ndarray]:
+        """Segment N objects of one image in a single device dispatch.
+
+        ``points_list``: N click sets -> list of N full-res probability
+        masks (same contract as :meth:`predict`).  All N crops go through
+        one batched forward — the all-objects-of-an-image labeling case at
+        1/N the dispatch overhead.  One compile per distinct N; reuse the
+        same N (padding with repeats if needed) to stay dispatch-only.
+        """
+        if len(points_list) == 0:  # not `not points_list`: ndarray-safe
+            return []
+        prepared = [prepare_input(image, pts, relax=self.relax,
+                                  zero_pad=self.zero_pad,
+                                  resolution=self.resolution,
+                                  alpha=self.alpha, guidance=self.guidance)
+                    for pts in points_list]
+        concat = np.stack([c for c, _ in prepared])
+        probs = np.asarray(self._forward(concat))[..., 0]
+        return [
+            np.clip(crop2fullmask(probs[i], bbox, image.shape[:2],
+                                  zero_pad=self.zero_pad, relax=self.relax),
+                    0.0, 1.0)
+            for i, (_, bbox) in enumerate(prepared)
+        ]
 
 
 class SemanticPredictor:

@@ -136,6 +136,22 @@ class TestPredictor:
         outside[int(y0):int(y1) + 1, int(x0):int(x1) + 1] = False
         assert prob[outside].max() == 0.0
 
+    def test_predict_batch_matches_singles(self):
+        """N objects in one dispatch == N single predicts, exactly."""
+        _, _, p = _tiny_predictor()
+        img = _image()
+        pts_a = _points()
+        pts_b = pts_a + np.array([5.0, -3.0])
+        batched = p.predict_batch(img, [pts_a, pts_b])
+        assert len(batched) == 2
+        # batch-size-dependent XLA fusion order gives float32 ulp-level
+        # differences; semantically identical
+        np.testing.assert_allclose(batched[0], p.predict(img, pts_a),
+                                   atol=1e-5)
+        np.testing.assert_allclose(batched[1], p.predict(img, pts_b),
+                                   atol=1e-5)
+        assert p.predict_batch(img, []) == []
+
     def test_deterministic_and_reusable(self):
         _, _, p = _tiny_predictor()
         img = _image()
