@@ -176,8 +176,10 @@ def make_val_panels(first_batch: dict, max_samples: int = 2):
                              squeeze=False)
     titles = ["image+gt", "fused", "pam", "cam"]
     for i in range(n):
+        # overlay_mask blends in [0, 1] (and imshow clips floats there) —
+        # feed it the normalized image, not raw [0, 255] channels.
         img = np.clip(tens2image(np.asarray(batch["concat"][i]))[..., :3],
-                      0, 255).astype("uint8")
+                      0, 255) / 255.0
         gt = tens2image(np.asarray(batch["crop_gt"][i]))
         axes[i][0].imshow(overlay_mask(img, gt > 0.5))
         for k, out in enumerate(outputs):

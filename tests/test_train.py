@@ -222,6 +222,11 @@ class TestValPanels:
         fig = make_val_panels(first, max_samples=2)
         # one row per sample, 4 panels: image+gt, fused, pam, cam
         assert len(fig.axes) % 4 == 0 and len(fig.axes) > 0
+        # the image+gt overlay must be in imshow's float [0, 1] range — a
+        # [0, 255] overlay clips to an all-white panel (regression)
+        overlay = fig.axes[0].get_images()[0].get_array()
+        assert float(overlay.max()) <= 1.0 + 1e-6
+        assert float(overlay.min()) >= 0.0
         import matplotlib.pyplot as plt
         plt.close(fig)
         tr.close()
