@@ -113,6 +113,16 @@ def _guidance_stage(guidance: str, alpha: float, is_val: bool) -> list[T.Transfo
                             is_val=is_val),
             T.ConcatInputs(elems=("crop_image", "extreme_points")),
         ]
+    if guidance in ("confidence_l1l2", "confidence_gaussian"):
+        # The reference's commented confidence-map alternative
+        # (custom_transforms.py:253-298, driver lines 132/143): the transform
+        # appends the map to the image itself -> rename onto the contract.
+        return [
+            T.AddConfidenceMap(elem="crop_image",
+                               hm_type=guidance.removeprefix("confidence_"),
+                               pert=0 if is_val else 5, is_val=is_val),
+            T.Rename({"with_hm": "concat"}),
+        ]
     if guidance == "none":
         return [T.ConcatInputs(elems=("crop_image",))]
     raise ValueError(f"unknown guidance family: {guidance}")

@@ -42,6 +42,29 @@ from .data import guidance as guidance_lib
 from .utils.helpers import crop2fullmask, crop_from_bbox, get_bbox
 
 
+#: guidance families computable from the 4 clicks alone — the ones
+#: click-based inference can serve (confidence maps need the gt mask,
+#: 'none' has no channel).  Single source of truth: the pre-restore guards
+#: in ``Predictor.from_run``/``from_torch`` AND ``guidance_from_points``'
+#: dispatch both read this table, so a family cannot be accepted at
+#: construction yet unknown at predict time.
+_POINT_GUIDANCE = {
+    # the live reference path (custom_transforms.py:45-50); owned by
+    # guidance.nellipse_gaussians_map so training and inference share one
+    # implementation
+    "nellipse_gaussians":
+        lambda shape, pts, alpha: guidance_lib.nellipse_gaussians_map(
+            shape, pts, alpha=alpha),
+    # n-ellipse indicator scaled to [0, 255] (custom_transforms.py:9-27)
+    "nellipse":
+        lambda shape, pts, alpha: guidance_lib.nellipse_map(shape, pts),
+    # DEXTR gaussian heatmap in [0, 1], matching the ExtremePoints
+    # transform's unscaled output (custom_transforms.py:221-251)
+    "extreme_points":
+        lambda shape, pts, alpha: guidance_lib.extreme_points_map(shape, pts),
+}
+
+
 def guidance_from_points(
     shape_hw: tuple[int, int], points: np.ndarray, alpha: float = 0.6,
     family: str = "nellipse_gaussians"
@@ -50,29 +73,17 @@ def guidance_from_points(
 
     ``family`` selects the same guidance channel the run was trained with
     (``data.guidance`` in the config; pipeline.py:_guidance_stage), computed
-    from the clicked points instead of gt-derived ones:
-
-    * ``nellipse_gaussians`` — n-ellipse + alpha-scaled gaussian bumps,
-      peak-rescaled to 255 (the live reference path,
-      custom_transforms.py:45-50; owned by
-      ``guidance.nellipse_gaussians_map`` so training and inference share
-      one implementation);
-    * ``nellipse`` — n-ellipse indicator scaled to [0, 255]
-      (custom_transforms.py:9-27);
-    * ``extreme_points`` — DEXTR gaussian heatmap in [0, 1], matching the
-      ExtremePoints transform's unscaled output
-      (custom_transforms.py:221-251).
+    from the clicked points instead of gt-derived ones — one of
+    ``_POINT_GUIDANCE``.
     """
     points = np.asarray(points, np.float64)
-    if family == "nellipse_gaussians":
-        return guidance_lib.nellipse_gaussians_map(shape_hw, points,
-                                                   alpha=alpha)
-    if family == "nellipse":
-        return guidance_lib.nellipse_map(shape_hw, points)
-    if family == "extreme_points":
-        return guidance_lib.extreme_points_map(shape_hw, points)
-    raise ValueError(f"unknown guidance family: {family!r} "
-                     "(nellipse_gaussians | nellipse | extreme_points)")
+    try:
+        build = _POINT_GUIDANCE[family]
+    except KeyError:
+        raise ValueError(
+            f"unknown guidance family: {family!r} "
+            f"({' | '.join(_POINT_GUIDANCE)})") from None
+    return build(shape_hw, points, alpha)
 
 
 def prepare_input(
@@ -244,11 +255,12 @@ class Predictor:
             raise ValueError(
                 f"Predictor is the click-guided instance path; this run was "
                 f"trained with task={cfg.task!r} (use SemanticPredictor)")
-        if cfg.data.guidance == "none":
+        if cfg.data.guidance not in _POINT_GUIDANCE:
             raise ValueError(
-                "this run was trained without a guidance channel "
-                "(data.guidance='none'); click-based prediction does not "
-                "apply to it")
+                f"this run's guidance family ({cfg.data.guidance!r}) is not "
+                "derivable from clicks alone (confidence maps need the gt "
+                "mask; 'none' has no channel) — click-based prediction does "
+                "not apply to it")
         cfg, model, state = load_run(run_dir, best=best, cfg=cfg)
         kwargs.setdefault("resolution", tuple(cfg.data.crop_size))
         kwargs.setdefault("relax", cfg.data.relax)
@@ -282,10 +294,11 @@ class Predictor:
         if cfg.task != "instance":
             raise ValueError("Predictor.from_torch serves the click-guided "
                              f"instance path; got task={cfg.task!r}")
-        if cfg.data.guidance == "none":
+        if cfg.data.guidance not in _POINT_GUIDANCE:
             raise ValueError(
-                "cfg has no guidance channel (data.guidance='none'); "
-                "click-based prediction does not apply to it")
+                f"cfg's guidance family ({cfg.data.guidance!r}) is not "
+                "derivable from clicks alone; click-based prediction does "
+                "not apply to it")
         model = model_from_config(cfg)
         h, w = cfg.data.crop_size
         variables = model.init(

@@ -210,10 +210,25 @@ class TestPipelines:
         np.testing.assert_array_equal(a["concat"], b["concat"])
 
     def test_guidance_families(self, rng):
-        for fam, ch in [("nellipse", 4), ("extreme_points", 4), ("none", 3)]:
+        for fam, ch in [("nellipse", 4), ("extreme_points", 4), ("none", 3),
+                        ("confidence_l1l2", 4), ("confidence_gaussian", 4)]:
             tf = build_train_transform(crop_size=(32, 32), guidance=fam)
             s = tf(make_sample(), rng)
             assert s["concat"].shape[2] == ch, fam
+            assert s["concat"].dtype == np.float32, fam
+
+    def test_confidence_guidance_range_and_determinism(self):
+        """The confidence families land on the step contract with the RGB
+        channels untouched and the map in [0, 255] (reference
+        custom_transforms.py:283-290: normalized x 255)."""
+        for fam in ("confidence_l1l2", "confidence_gaussian"):
+            tf = build_eval_transform(crop_size=(32, 32), guidance=fam)
+            a = tf(make_sample(), np.random.default_rng(0))
+            b = tf(make_sample(), np.random.default_rng(7))
+            assert a["concat"].shape == (32, 32, 4), fam
+            hm = a["concat"][..., 3]
+            assert 0.0 <= hm.min() and hm.max() <= 255.0, fam
+            np.testing.assert_array_equal(a["concat"], b["concat"])
 
 
 class TestReviewRegressions:
