@@ -85,6 +85,13 @@ if __name__ == "__main__":
         dict(batch=8, pam_impl="flash", block=512, remat=False),
         dict(batch=16, pam_impl="flash", block=512, remat=False),
         dict(batch=32, pam_impl="einsum", block=None, remat=False),
+        # online-softmax blocked einsum (no N x N scores materialized) and
+        # alternate flash tiles — 2026-07-30 sweep data: full einsum b8 67.5
+        # beat flash(512) 62.2; these probe whether other tilings close it
+        dict(batch=8, pam_impl="einsum", block=2048, remat=False),
+        dict(batch=8, pam_impl="einsum", block=1024, remat=False),
+        dict(batch=8, pam_impl="flash", block=1024, remat=False),
+        dict(batch=8, pam_impl="flash", block=256, remat=False),
     ]
     sel = sys.argv[1:]
     for i, v in enumerate(variants):
