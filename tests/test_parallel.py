@@ -101,6 +101,36 @@ class TestTrainStep:
         assert leaf.sharding.is_equivalent_to(replicated_sharding(mesh),
                                               leaf.ndim)
 
+    def test_overfits_fixed_batch(self, mesh, state_and_model):
+        """The can-it-learn signal: repeated steps on one fixed batch must
+        drive the loss well below its starting point (not merely move
+        params).  Guards the whole grads->update->BN-stats chain against
+        sign/wiring bugs that leave everything finite but untrainable.
+
+        Targets are smooth blobs, not per-pixel noise: the head predicts at
+        output_stride and upsamples, so random masks have an irreducible
+        ~0.86 loss floor regardless of training (measured) — a plateau that
+        would mask real learning."""
+        _, model, _ = state_and_model
+        tx = optax.sgd(0.05, momentum=0.9)
+        state = create_train_state(jax.random.PRNGKey(1), model, tx,
+                                   (1, 32, 32, 4))
+        step = make_train_step(model, tx, mesh=mesh, donate=False)
+        batch = tiny_batch(n=8)
+        yy, xx = np.mgrid[:32, :32]
+        centers = [(8 + 2 * i, 24 - 2 * i) for i in range(8)]
+        batch["crop_gt"] = np.stack([
+            (((yy - cy) ** 2 + (xx - cx) ** 2) < 64).astype(np.float32)
+            for cy, cx in centers])
+        batch = shard_batch(mesh, batch)
+        state, first = step(state, batch)
+        last = first
+        for _ in range(29):
+            state, last = step(state, batch)
+        assert float(last) < 0.5 * float(first), (
+            f"loss did not drop overfitting one batch: "
+            f"{float(first):.4f} -> {float(last):.4f}")
+
     def test_batch_stats_update(self, mesh, state_and_model):
         state, model, tx = state_and_model
         step = make_train_step(model, tx, mesh=mesh, donate=False)
