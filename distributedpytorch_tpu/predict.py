@@ -221,13 +221,15 @@ class Predictor:
                  alpha: float = 0.6,
                  guidance: str = "nellipse_gaussians",
                  mean: Sequence[float] | None = None,
-                 std: Sequence[float] | None = None):
+                 std: Sequence[float] | None = None,
+                 mesh=None):
         self.model = model
         self.resolution = tuple(resolution)
         self.relax = relax
         self.zero_pad = zero_pad
         self.alpha = alpha
         self.guidance = guidance
+        self.mesh = mesh
         variables = {"params": params, "batch_stats": batch_stats}
 
         def forward(x):
@@ -240,7 +242,24 @@ class Predictor:
             # the reference's metric consumes (train_pascal.py:283).
             return jax.nn.sigmoid(outputs[0].astype(jnp.float32))
 
-        self._forward = jax.jit(forward)
+        if mesh is None:
+            self._forward = jax.jit(forward)
+        else:
+            # Distributed inference: crops shard over the mesh's data axis
+            # (GSPMD partitions the forward, same as the train step); the
+            # probability maps come back replicated for the host paste-back.
+            # Single-process only: shard_batch's multi-process branch treats
+            # the input as a per-host shard, which would duplicate the whole
+            # crop batch on every host here.
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "Predictor(mesh=...) is single-process (all local "
+                    "devices); multi-host serving should shard requests "
+                    "across processes instead")
+            from .parallel.mesh import batch_sharding, replicated_sharding
+            self._forward = jax.jit(
+                forward, in_shardings=batch_sharding(mesh),
+                out_shardings=replicated_sharding(mesh))
 
     @classmethod
     def from_run(cls, run_dir: str, best: bool = True, cfg=None,
@@ -355,6 +374,8 @@ class Predictor:
         one batched forward — the all-objects-of-an-image labeling case at
         1/N the dispatch overhead.  One compile per distinct N; reuse the
         same N (padding with repeats if needed) to stay dispatch-only.
+        With a ``mesh``, the crop batch shards over the data axis (padded
+        to the device count) — multi-chip inference with no other changes.
         """
         if len(points_list) == 0:  # not `not points_list`: ndarray-safe
             return []
@@ -364,7 +385,14 @@ class Predictor:
                                   alpha=self.alpha, guidance=self.guidance)
                     for pts in points_list]
         concat = np.stack([c for c, _ in prepared])
-        probs = np.asarray(self._forward(concat))[..., 0]
+        if self.mesh is not None:
+            from .parallel.mesh import pad_to_multiple, shard_batch
+            padded, n = pad_to_multiple({"concat": concat},
+                                        self.mesh.devices.size)
+            x = shard_batch(self.mesh, padded)["concat"]
+            probs = np.asarray(self._forward(x))[:n, ..., 0]
+        else:
+            probs = np.asarray(self._forward(concat))[..., 0]
         return [
             np.clip(crop2fullmask(probs[i], bbox, image.shape[:2],
                                   zero_pad=self.zero_pad, relax=self.relax),
