@@ -109,6 +109,13 @@ def main() -> None:
         # extra context for the record: a CPU-fallback run is not a TPU number
         "platform": jax.devices()[0].platform,
     }
+    if not ON_TPU:
+        # The axon tunnel wedges for hours at a time; when the round-end run
+        # lands in such a window this records the downsized CPU config, not
+        # the chip.  Point the reader at the measured TPU numbers.
+        record["note"] = ("CPU fallback (downsized config), not a TPU "
+                          "number — see BASELINE.md for the measured "
+                          "on-chip results")
     from distributedpytorch_tpu.utils.profiling import device_memory_stats
 
     peak = device_memory_stats()["peak_bytes_in_use"]

@@ -375,7 +375,7 @@ class Predictor:
         1/N the dispatch overhead.  One compile per distinct N; reuse the
         same N (padding with repeats if needed) to stay dispatch-only.
         With a ``mesh``, the crop batch shards over the data axis (padded
-        to the device count) — multi-chip inference with no other changes.
+        to its extent) — multi-chip inference with no other changes.
         """
         if len(points_list) == 0:  # not `not points_list`: ndarray-safe
             return []
@@ -386,11 +386,12 @@ class Predictor:
                     for pts in points_list]
         concat = np.stack([c for c, _ in prepared])
         if self.mesh is not None:
-            from .parallel.mesh import pad_to_multiple, shard_batch
+            # Pad to the data-axis extent only (a model axis does not shard
+            # the batch); the jit's in_shardings owns the device placement.
+            from .parallel.mesh import DATA_AXIS, pad_to_multiple
             padded, n = pad_to_multiple({"concat": concat},
-                                        self.mesh.devices.size)
-            x = shard_batch(self.mesh, padded)["concat"]
-            probs = np.asarray(self._forward(x))[:n, ..., 0]
+                                        self.mesh.shape[DATA_AXIS])
+            probs = np.asarray(self._forward(padded["concat"]))[:n, ..., 0]
         else:
             probs = np.asarray(self._forward(concat))[..., 0]
         return [

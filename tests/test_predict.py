@@ -159,7 +159,9 @@ class TestPredictor:
         from distributedpytorch_tpu.parallel import make_mesh
 
         model, state, p_single = _tiny_predictor()
-        mesh = make_mesh()
+        # (data=4, model=2): the batch pads/shards over the 4-wide data
+        # axis only, not the full 8-device count
+        mesh = make_mesh(data=4, model=2)
         p_mesh = Predictor(model, state.params, state.batch_stats,
                            resolution=(64, 64), relax=10, mesh=mesh)
         img = _image()
