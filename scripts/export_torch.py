@@ -1,0 +1,51 @@
+"""Export a training run's weights to a torch ``state_dict`` ``.pth``.
+
+The reverse of ``checkpoint.warm_start`` / ``Predictor.from_torch``: users
+migrating to this framework keep a way back to their torch tooling (the
+reference ecosystem's checkpoint format, train_pascal.py:103).  Layout
+conversion (HWIO->OIHW convs, BN naming) lives in utils/torch_interop.
+
+    python scripts/export_torch.py work/run_0 danet_export.pth [--latest]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("run_dir", help="training run dir (config.json + "
+                                    "checkpoints/)")
+    ap.add_argument("out", help="output .pth path")
+    ap.add_argument("--latest", action="store_true",
+                    help="export the latest checkpoint instead of the "
+                         "best-metric one")
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # weights-only host job
+
+    import numpy as np
+    import torch
+
+    from distributedpytorch_tpu.predict import load_run
+    from distributedpytorch_tpu.utils.torch_interop import (
+        params_to_torch_state_dict,
+    )
+
+    _, _, state = load_run(args.run_dir, best=not args.latest)
+    sd = params_to_torch_state_dict(state.params, state.batch_stats)
+    torch.save({k: torch.from_numpy(np.asarray(v)) for k, v in sd.items()},
+               args.out)
+    print(f"exported {len(sd)} tensors (step {int(state.step)}) "
+          f"-> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -221,6 +221,54 @@ class TestFromTorch:
                                    p_native.predict(img, _points()),
                                    atol=1e-5)
 
+    def test_export_torch_script_roundtrip(self, tmp_path):
+        """run dir -> scripts/export_torch.py -> .pth -> from_torch gives
+        the same predictions as from_run (full interop loop)."""
+        import os
+        import subprocess
+        import sys
+
+        import jax
+
+        from distributedpytorch_tpu.models import build_model
+        from distributedpytorch_tpu.parallel import create_train_state
+        from distributedpytorch_tpu.train import Config, config as config_lib
+        from distributedpytorch_tpu.train.checkpoint import CheckpointManager
+        from distributedpytorch_tpu.train.optim import make_optimizer
+
+        res = 64
+        cfg = Config()
+        cfg.model.backbone = "resnet18"
+        cfg.data.crop_size = (res, res)
+        cfg.data.relax = 10
+        run = tmp_path / "run_0"
+        run.mkdir()
+        config_lib.to_json(cfg, str(run / "config.json"))
+        model = build_model("danet", nclass=1, backbone="resnet18",
+                            output_stride=8)
+        tx, _ = make_optimizer(cfg.optim, total_steps=1)
+        state = create_train_state(jax.random.PRNGKey(5), model, tx,
+                                   (1, res, res, 4))
+        mgr = CheckpointManager(str(run / "checkpoints"), async_save=False)
+        mgr.save(0, state, metric=0.2)
+        mgr.close()
+
+        pth = tmp_path / "export.pth"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts", "export_torch.py"),
+             str(run), str(pth)],
+            capture_output=True, text=True, timeout=600,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 0, r.stderr[-1500:]
+        assert pth.exists() and "exported" in r.stdout
+
+        img = _image()
+        np.testing.assert_allclose(
+            Predictor.from_torch(str(pth), cfg=cfg).predict(img, _points()),
+            Predictor.from_run(str(run)).predict(img, _points()),
+            atol=1e-5)
+
     def test_zero_match_raises(self, tmp_path):
         import torch
 
