@@ -192,16 +192,35 @@ def load_run(run_dir: str, best: bool = True, cfg=None):
     mgr = CheckpointManager(os.path.join(run_dir, "checkpoints"),
                             async_save=False)
     try:
-        if best:
-            try:
-                state, _ = mgr.restore(template, best=True)
-            except FileNotFoundError:  # no best slot yet: use latest
-                state, _ = mgr.restore(template, best=False)
-        else:
-            state, _ = mgr.restore(template, best=False)
+        try:
+            state, _ = mgr.restore(template, best=best)
+        except FileNotFoundError:
+            if not best:
+                raise
+            state, _ = mgr.restore(template, best=False)  # no best slot yet
     finally:
         mgr.close()
     return cfg, model, state
+
+
+def _apply_with_normalize(model, variables, mean, std, x):
+    """Optional mean/std normalization + model apply — the shared first
+    half of both predictors' compiled forwards."""
+    if mean is not None or std is not None:
+        from .ops.augment import normalize
+        x = normalize({"concat": x}, mean or (0.0,),
+                      std or (255.0,))["concat"]
+    return model.apply(variables, x, train=False)
+
+
+def _click_kwargs_from_cfg(cfg, kwargs: dict) -> dict:
+    """Default the click-predictor constructor kwargs from a run config."""
+    kwargs.setdefault("resolution", tuple(cfg.data.crop_size))
+    kwargs.setdefault("relax", cfg.data.relax)
+    kwargs.setdefault("zero_pad", cfg.data.zero_pad)
+    kwargs.setdefault("alpha", cfg.data.guidance_alpha)
+    kwargs.setdefault("guidance", cfg.data.guidance)
+    return kwargs
 
 
 class Predictor:
@@ -233,11 +252,7 @@ class Predictor:
         variables = {"params": params, "batch_stats": batch_stats}
 
         def forward(x):
-            if mean is not None or std is not None:
-                from .ops.augment import normalize
-                x = normalize({"concat": x}, mean or (0.0,),
-                              std or (255.0,))["concat"]
-            outputs = model.apply(variables, x, train=False)
+            outputs = _apply_with_normalize(model, variables, mean, std, x)
             # Fused (primary) head only — the tuple's first element, the one
             # the reference's metric consumes (train_pascal.py:283).
             return jax.nn.sigmoid(outputs[0].astype(jnp.float32))
@@ -281,12 +296,8 @@ class Predictor:
                 "mask; 'none' has no channel) — click-based prediction does "
                 "not apply to it")
         cfg, model, state = load_run(run_dir, best=best, cfg=cfg)
-        kwargs.setdefault("resolution", tuple(cfg.data.crop_size))
-        kwargs.setdefault("relax", cfg.data.relax)
-        kwargs.setdefault("zero_pad", cfg.data.zero_pad)
-        kwargs.setdefault("alpha", cfg.data.guidance_alpha)
-        kwargs.setdefault("guidance", cfg.data.guidance)
-        return cls(model, state.params, state.batch_stats, **kwargs)
+        return cls(model, state.params, state.batch_stats,
+                   **_click_kwargs_from_cfg(cfg, kwargs))
 
     @classmethod
     def from_torch(cls, path: str, cfg=None, partial: bool = False,
@@ -352,12 +363,8 @@ class Predictor:
                 f"warm start from {path} imported 0 of {imported[1]} "
                 "leaves — checkpoint keys do not match this model; check "
                 "the architecture/naming (or pass a rename callable)")
-        kwargs.setdefault("resolution", tuple(cfg.data.crop_size))
-        kwargs.setdefault("relax", cfg.data.relax)
-        kwargs.setdefault("zero_pad", cfg.data.zero_pad)
-        kwargs.setdefault("alpha", cfg.data.guidance_alpha)
-        kwargs.setdefault("guidance", cfg.data.guidance)
-        return cls(model, params, stats, **kwargs)
+        return cls(model, params, stats,
+                   **_click_kwargs_from_cfg(cfg, kwargs))
 
     def predict(self, image: np.ndarray, points: Any) -> np.ndarray:
         """(H, W, 3) image + (4, 2) xy clicks -> (H, W) float32 probability
@@ -423,11 +430,7 @@ class SemanticPredictor:
         variables = {"params": params, "batch_stats": batch_stats}
 
         def forward(x):
-            if mean is not None or std is not None:
-                from .ops.augment import normalize
-                x = normalize({"concat": x}, mean or (0.0,),
-                              std or (255.0,))["concat"]
-            outputs = model.apply(variables, x, train=False)
+            outputs = _apply_with_normalize(model, variables, mean, std, x)
             # Argmax on device: one (H, W) int map crosses the wire, not
             # the (H, W, C) logits.
             return jnp.argmax(outputs[0], axis=-1).astype(jnp.int32)
@@ -496,6 +499,13 @@ def predict_cli(run_dir: str, image_path: str, points_spec: str | None,
     cfg = load_run_config(run_dir)
     image = np.asarray(Image.open(image_path).convert("RGB"))
 
+    def write_overlay(mask: np.ndarray) -> None:
+        if overlay_path:
+            over = overlay_mask(image.astype(np.float32) / 255.0,
+                                mask.astype(np.float32))
+            Image.fromarray((np.clip(over, 0, 1) * 255).astype(np.uint8)
+                            ).save(overlay_path)
+
     if cfg.task == "semantic":
         if points_spec or threshold is not None:
             raise ValueError(
@@ -503,12 +513,7 @@ def predict_cli(run_dir: str, image_path: str, points_spec: str | None,
                 "--points/--threshold do not apply")
         classes = SemanticPredictor.from_run(run_dir, cfg=cfg).predict(image)
         Image.fromarray(classes).save(out_path)
-        fg = classes > 0
-        if overlay_path:
-            over = overlay_mask(image.astype(np.float32) / 255.0,
-                                fg.astype(np.float32))
-            Image.fromarray((np.clip(over, 0, 1) * 255).astype(np.uint8)
-                            ).save(overlay_path)
+        write_overlay(classes > 0)
         present = {int(c): int(n) for c, n in
                    zip(*np.unique(classes, return_counts=True))}
         return {"task": "semantic", "classes": present, "out": out_path}
@@ -521,11 +526,7 @@ def predict_cli(run_dir: str, image_path: str, points_spec: str | None,
         image, parse_points(points_spec))
     mask = prob > threshold
     Image.fromarray((mask * 255).astype(np.uint8)).save(out_path)
-    if overlay_path:
-        over = overlay_mask(image.astype(np.float32) / 255.0,
-                            mask.astype(np.float32))
-        Image.fromarray(
-            (np.clip(over, 0, 1) * 255).astype(np.uint8)).save(overlay_path)
+    write_overlay(mask)
     return {"task": "instance", "pixels": int(mask.sum()),
             "threshold": threshold, "max_prob": float(prob.max()),
             "out": out_path}

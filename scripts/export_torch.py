@@ -38,6 +38,11 @@ def main() -> int:
         params_to_torch_state_dict,
     )
 
+    # load_run restores the full TrainState (params + BN stats + SGD
+    # momentum); the momentum copy is discarded below.  A params-only
+    # partial Orbax restore would save ~1x params of IO/host memory but
+    # needs version-sensitive restore plumbing — not worth it for an
+    # offline export job.
     _, _, state = load_run(args.run_dir, best=not args.latest)
     sd = params_to_torch_state_dict(state.params, state.batch_stats)
     torch.save({k: torch.from_numpy(np.asarray(v)) for k, v in sd.items()},
