@@ -40,6 +40,12 @@ import jax  # noqa: E402
 
 pin_requested_platform()
 
+# Persistent compile cache: the driver re-runs this benchmark every round;
+# caching the (identical) XLA program cuts its warmup on repeat runs.
+from distributedpytorch_tpu.backend_health import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 import numpy as np  # noqa: E402
 import optax  # noqa: E402
 

@@ -92,3 +92,18 @@ def ensure_backend_or_cpu_fallback() -> bool:
     print("backend probe: falling back to CPU", file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
     return False
+
+
+def enable_compile_cache(root: str | None = None) -> None:
+    """Turn on JAX's persistent compilation cache under ``<root>/.jax_cache``
+    (default: the repo root).  One owner for every entry point — the test
+    suite, bench.py, and the perf sweep all recompile identical programs
+    run-to-run; caching them cuts minutes of XLA work per invocation.
+    Call after ``import jax`` and before the first compilation."""
+    import jax
+
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(root, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)

@@ -29,6 +29,10 @@ import jax
 
 pin_requested_platform()
 
+from distributedpytorch_tpu.backend_health import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 if not any(d.platform == "tpu" for d in jax.devices()):
     print(json.dumps({"error": "no TPU available (sweep is TPU-only; "
                       "bench.py covers the CPU-fallback path)"}))

@@ -24,6 +24,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's wall-time is dominated by XLA
+# recompiles of the same programs run-to-run; cache them across sessions.
+from distributedpytorch_tpu.backend_health import (  # noqa: E402
+    enable_compile_cache,
+)
+
+enable_compile_cache()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
