@@ -51,6 +51,14 @@ class DataConfig:
     device_augment_geom: bool = False   # rotation/scale on-device too (the
                                         # device form warps the fixed crop,
                                         # not the pre-crop full image)
+    echo: int = 1                       # data echoing (Choi et al. 2019,
+                                        # arXiv:1907.05550): step each loaded
+                                        # batch this many times — recovers
+                                        # throughput when the host input
+                                        # pipeline, not the chip, is the
+                                        # bottleneck.  With device_augment
+                                        # each echo draws fresh augmentation
+                                        # randomness.
 
 
 @dataclass

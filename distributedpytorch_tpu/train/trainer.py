@@ -100,6 +100,8 @@ class Trainer:
                 f"task='instance' requires model.nclass=1 (binary sigmoid "
                 f"head), got {cfg.model.nclass}; use task='semantic' for "
                 "multi-class")
+        if cfg.data.echo < 1:
+            raise ValueError(f"data.echo must be >= 1, got {cfg.data.echo}")
 
         # --- mesh
         self.mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
@@ -238,7 +240,10 @@ class Trainer:
             moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
             moe_capacity_factor=cfg.model.moe_capacity_factor)
         steps_per_epoch = len(self.train_loader)  # > 0: guarded above
-        total_steps = steps_per_epoch * cfg.epochs
+        # Each loaded batch is stepped data.echo times, so schedules (poly
+        # decay, warmup fractions) must span echo x the loader length or
+        # they exhaust early and clamp the LR.
+        total_steps = steps_per_epoch * cfg.epochs * cfg.data.echo
         self.tx, self.schedule = make_optimizer(cfg.optim, total_steps)
         h, w = cfg.data.crop_size
         with self.mesh:
@@ -392,12 +397,23 @@ class Trainer:
                     batch_debug_asserts(batch)
                 yield batch
 
+        def echoed(it):
+            # Data echoing (config.py: data.echo): repeat each already-placed
+            # device batch — zero extra host decode or H2D traffic per echo;
+            # the step's advancing RNG gives each echo fresh on-device
+            # augmentation when enabled.
+            for b in it:
+                for _ in range(cfg.data.echo):
+                    yield b
+
         with self.mesh:
             # Async H2D overlap: up to device_prefetch batches are already
             # placed (sharded) while the current step computes.
             batches = prefetch_to_device(
                 host_batches(), self.mesh, size=cfg.data.device_prefetch,
                 keys=("concat", "crop_gt", "crop_void"))
+            if cfg.data.echo > 1:
+                batches = echoed(batches)
             for i, device_batch in enumerate(batches):
                 self.state, loss = self.train_step(self.state, device_batch)
                 losses.append(loss)  # device array; sync deferred
@@ -415,7 +431,9 @@ class Trainer:
         mean_loss = float(np.mean([float(l) for l in losses])) if losses \
             else float("nan")
         dt = time.perf_counter() - t0
-        n_imgs = len(losses) * cfg.data.train_batch
+        # Distinct images ingested — echoed repeats of a batch are not fresh
+        # data; reporting them would make any echo setting look like a win.
+        n_imgs = len(losses) * cfg.data.train_batch / cfg.data.echo
         # An interrupted epoch logs no completed-epoch summary: its partial
         # mean would skew per-epoch curves, and the replayed epoch will log
         # the real one.
