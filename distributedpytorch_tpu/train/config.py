@@ -51,6 +51,11 @@ class DataConfig:
     device_augment_geom: bool = False   # rotation/scale on-device too (the
                                         # device form warps the fixed crop,
                                         # not the pre-crop full image)
+    decode_cache: int = 0               # decode-once LRU over this many
+                                        # images (FFCV-style; instance mode
+                                        # revisits an image once per object
+                                        # per epoch).  ~0.7 MB/image host
+                                        # RAM; 0 = off.
     echo: int = 1                       # data echoing (Choi et al. 2019,
                                         # arXiv:1907.05550): step each loaded
                                         # batch this many times — recovers

@@ -151,10 +151,12 @@ class Trainer:
             # download (if requested) already happened above, gated+barriered
             self.train_set = VOCInstanceSegmentation(
                 root, split=cfg.data.train_split, transform=train_tf,
-                preprocess=True, area_thres=cfg.data.area_thres)
+                preprocess=True, area_thres=cfg.data.area_thres,
+                decode_cache=cfg.data.decode_cache)
             self.val_set = VOCInstanceSegmentation(
                 root, split=cfg.data.val_split, transform=val_tf,
-                preprocess=True, area_thres=cfg.data.area_thres)
+                preprocess=True, area_thres=cfg.data.area_thres,
+                decode_cache=cfg.data.decode_cache)
         elif cfg.task == "semantic":
             self.train_set = VOCSemanticSegmentation(
                 root, split=cfg.data.train_split,
@@ -163,11 +165,13 @@ class Trainer:
                     scales=cfg.data.scales,
                     flip=not cfg.data.device_augment,
                     geom=not (cfg.data.device_augment
-                              and cfg.data.device_augment_geom)))
+                              and cfg.data.device_augment_geom)),
+                decode_cache=cfg.data.decode_cache)
             self.val_set = VOCSemanticSegmentation(
                 root, split=cfg.data.val_split,
                 transform=build_semantic_eval_transform(
-                    crop_size=cfg.data.crop_size))
+                    crop_size=cfg.data.crop_size),
+                decode_cache=cfg.data.decode_cache)
         else:
             raise ValueError(
                 f"unknown task: {cfg.task!r} (instance | semantic)")

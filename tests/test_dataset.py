@@ -80,6 +80,65 @@ class TestDataset:
         assert "VOC2012" in str(train_ds)
 
 
+class TestDecodeCache:
+    """FFCV-style decode-once LRU (data.decode_cache)."""
+
+    def test_cached_samples_identical(self, fake_voc_root):
+        plain = VOCInstanceSegmentation(fake_voc_root, split="train")
+        cached = VOCInstanceSegmentation(fake_voc_root, split="train",
+                                         decode_cache=64)
+        for i in range(len(plain)):
+            a, b = plain[i], cached[i]
+            for k in ("image", "gt", "void_pixels"):
+                np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+            # second fetch hits the cache; still identical and unmutated
+            c = cached[i]
+            for k in ("image", "gt", "void_pixels"):
+                np.testing.assert_array_equal(a[k], c[k], err_msg=k)
+
+    def test_lru_evicts_to_cap(self, fake_voc_root):
+        ds = VOCInstanceSegmentation(fake_voc_root, split="train",
+                                     decode_cache=2)
+        for i in range(len(ds)):
+            ds[i]
+        assert len(ds._cache._d) <= 2
+
+    def test_picklable_with_cache(self, fake_voc_root):
+        """Grain process workers pickle the dataset; the cache's lock must
+        not ship (each worker rebuilds an empty independent cache)."""
+        import pickle
+
+        ds = VOCInstanceSegmentation(fake_voc_root, split="train",
+                                     decode_cache=8)
+        ds[0]  # populate, then roundtrip
+        clone = pickle.loads(pickle.dumps(ds))
+        assert len(clone._cache._d) == 0
+        np.testing.assert_array_equal(clone[0]["image"], ds[0]["image"])
+
+    def test_semantic_cache_identical(self, fake_voc_root):
+        from distributedpytorch_tpu.data import VOCSemanticSegmentation
+
+        plain = VOCSemanticSegmentation(fake_voc_root, split="val")
+        cached = VOCSemanticSegmentation(fake_voc_root, split="val",
+                                         decode_cache=8)
+        for i in range(len(plain)):
+            for k in ("image", "gt"):
+                np.testing.assert_array_equal(plain[i][k], cached[i][k])
+                np.testing.assert_array_equal(plain[i][k], cached[i][k])
+
+    def test_threaded_access_consistent(self, fake_voc_root):
+        from concurrent.futures import ThreadPoolExecutor
+
+        ds = VOCInstanceSegmentation(fake_voc_root, split="train",
+                                     decode_cache=8)
+        want = [ds[i]["image"].sum() for i in range(len(ds))]
+        with ThreadPoolExecutor(4) as ex:
+            got = list(ex.map(
+                lambda i: ds[i]["image"].sum(),
+                list(range(len(ds))) * 4))
+        assert got == want * 4
+
+
 class TestDataLoader:
     def test_batches_and_drop_last(self, fake_voc_root):
         ds = VOCInstanceSegmentation(
