@@ -167,11 +167,14 @@ class Trainer:
                     geom=not (cfg.data.device_augment
                               and cfg.data.device_augment_geom)),
                 decode_cache=cfg.data.decode_cache)
+            # No val cache: semantic val is one sample per image scanned
+            # sequentially — an LRU smaller than the split gets zero hits
+            # and would only double the RAM budget.  (Instance val keeps
+            # it: every image is decoded once per *object*.)
             self.val_set = VOCSemanticSegmentation(
                 root, split=cfg.data.val_split,
                 transform=build_semantic_eval_transform(
-                    crop_size=cfg.data.crop_size),
-                decode_cache=cfg.data.decode_cache)
+                    crop_size=cfg.data.crop_size))
         else:
             raise ValueError(
                 f"unknown task: {cfg.task!r} (instance | semantic)")

@@ -1,0 +1,73 @@
+"""Host input-pipeline throughput: decode -> augment -> guidance -> batch.
+
+The device step consumes batches faster than one host core can produce them
+(bench.py: ~68 imgs/s/chip on the v5e for DANet-R101 512²), so the host
+pipeline's imgs/sec bounds end-to-end training unless loader workers +
+decode caching + native kernels close the gap.  This script measures that
+bound on VOC-sized synthetic images across the pipeline's own knobs.
+
+Prints one JSON line per variant:
+    {"variant": "...", "imgs_per_sec": N}
+
+CPU-only by design — no accelerator is touched.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedpytorch_tpu import native_ops  # noqa: E402
+from distributedpytorch_tpu.data import (  # noqa: E402
+    DataLoader,
+    VOCInstanceSegmentation,
+    build_train_transform,
+    make_fake_voc,
+)
+
+
+def measure(ds, batch: int, workers: int, epochs: int = 2) -> float:
+    loader = DataLoader(ds, batch_size=batch, shuffle=True, drop_last=True,
+                        num_workers=workers)
+    n = 0
+    t0 = time.perf_counter()
+    for epoch in range(epochs):
+        loader.set_epoch(epoch)
+        for b in loader:
+            n += b["concat"].shape[0]
+    return n / (time.perf_counter() - t0)
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as tmp:
+        # VOC-realistic image sizes; enough images that the LRU matters and
+        # enough objects that instance indexing revisits images.
+        root = make_fake_voc(os.path.join(tmp, "voc"), n_images=24,
+                             size=(375, 500), n_val=4, seed=0)
+        tf = build_train_transform(crop_size=(512, 512))
+
+        def ds(cache: int):
+            return VOCInstanceSegmentation(root, split="train", transform=tf,
+                                           decode_cache=cache)
+
+        variants = [
+            ("workers2", dict(cache=0, workers=2)),
+            ("workers2+decode_cache", dict(cache=64, workers=2)),
+            ("workers4+decode_cache", dict(cache=64, workers=4)),
+            ("workers0", dict(cache=0, workers=0)),
+        ]
+        for name, v in variants:
+            ips = measure(ds(v["cache"]), batch=8, workers=v["workers"])
+            print(json.dumps({"variant": name,
+                              "native_kernels": native_ops.enabled(),
+                              "imgs_per_sec": round(ips, 2)}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

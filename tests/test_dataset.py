@@ -122,9 +122,11 @@ class TestDecodeCache:
         cached = VOCSemanticSegmentation(fake_voc_root, split="val",
                                          decode_cache=8)
         for i in range(len(plain)):
+            a, b = plain[i], cached[i]
+            c = cached[i]  # second fetch hits the cache
             for k in ("image", "gt"):
-                np.testing.assert_array_equal(plain[i][k], cached[i][k])
-                np.testing.assert_array_equal(plain[i][k], cached[i][k])
+                np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+                np.testing.assert_array_equal(a[k], c[k], err_msg=k)
 
     def test_threaded_access_consistent(self, fake_voc_root):
         from concurrent.futures import ThreadPoolExecutor
