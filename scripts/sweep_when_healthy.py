@@ -37,16 +37,18 @@ import json, sys
 sys.path.insert(0, %(scripts)r)
 sys.path.insert(0, %(repo)r)
 from perf_sweep import run
-v = run(batch=%(batch)d, pam_impl=%(impl)r, block=%(block)r, remat=False)
+v = run(batch=%(batch)d, pam_impl=%(impl)r, block=%(block)r, remat=False,
+        os_=%(os_)d)
 print(json.dumps({"impl": %(impl)r, "block": %(block)r, "batch": %(batch)d,
-                  "imgs_per_sec_per_chip": v}))
+                  "os": %(os_)d, "imgs_per_sec_per_chip": v}))
 """
 
 VARIANTS = [
-    {"impl": "einsum", "block": 2048, "batch": 8},
-    {"impl": "einsum", "block": 1024, "batch": 8},
-    {"impl": "flash", "block": 1024, "batch": 8},
-    {"impl": "flash", "block": 256, "batch": 8},
+    {"impl": "einsum", "block": 2048, "batch": 8, "os_": 8},
+    {"impl": "einsum", "block": 1024, "batch": 8, "os_": 8},
+    {"impl": "flash", "block": 1024, "batch": 8, "os_": 8},
+    {"impl": "flash", "block": 256, "batch": 8, "os_": 8},
+    {"impl": "einsum", "block": None, "batch": 8, "os_": 16},
 ]
 
 
@@ -77,15 +79,19 @@ def main() -> int:
         for v in VARIANTS:
             code = VARIANT % {"repo": REPO,
                               "scripts": os.path.join(REPO, "scripts"), **v}
+            # error lines share the success lines' key schema ("os", not
+            # the python-keyword-dodging "os_")
+            rec = {**v, "os": v["os_"]}
+            del rec["os_"]
             try:
                 r = subprocess.run([sys.executable, "-c", code],
                                    capture_output=True, text=True,
                                    timeout=900)
                 line = (r.stdout.strip().splitlines() or ["{}"])[-1]
                 if r.returncode != 0:
-                    line = json.dumps({**v, "error": r.stderr[-300:]})
+                    line = json.dumps({**rec, "error": r.stderr[-300:]})
             except subprocess.TimeoutExpired:
-                line = json.dumps({**v, "error": "timeout"})
+                line = json.dumps({**rec, "error": "timeout"})
             print(line)
             f.write(line + "\n")
             f.flush()
