@@ -1,0 +1,63 @@
+"""Visual dataset smoke: overlay masks on a few samples, write PNGs.
+
+The reference's only self-check was a matplotlib loop showing 4 samples
+with mask overlays and category titles (reference pascal.py:269-290).
+Headless equivalent: PNGs into --out, category in the filename.
+
+    python scripts/visualize_samples.py --out /tmp/vis            # fake fixture
+    python scripts/visualize_samples.py --out vis --root /data/voc --split val
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+from PIL import Image
+
+from distributedpytorch_tpu.data import VOCInstanceSegmentation, make_fake_voc
+from distributedpytorch_tpu.data.voc import CATEGORY_NAMES
+from distributedpytorch_tpu.utils.helpers import overlay_mask
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--out", required=True, help="output dir for PNGs")
+    ap.add_argument("--root", help="VOC root (default: synthetic fixture)")
+    ap.add_argument("--split", default="train")
+    ap.add_argument("--n", type=int, default=4,
+                    help="samples to render (reference showed 4)")
+    args = ap.parse_args()
+
+    tmp = None
+    root = args.root
+    if root is None:
+        tmp = tempfile.mkdtemp()
+        # size the fixture so the REQUESTED split holds >= n images
+        n_val = max(args.n, 2) if args.split == "val" else 2
+        root = make_fake_voc(os.path.join(tmp, "voc"),
+                             n_images=max(args.n, 4) + n_val,
+                             size=(240, 320), n_val=n_val, seed=0)
+    ds = VOCInstanceSegmentation(root, split=args.split)
+    os.makedirs(args.out, exist_ok=True)
+    for i in range(min(args.n, len(ds))):
+        s = ds[i]
+        cat = CATEGORY_NAMES[int(s["meta"]["category"])]
+        over = overlay_mask(s["image"] / 255.0, s["gt"] > 0.5)
+        name = f"{i:02d}_{s['meta']['image']}_obj{s['meta']['object']}_{cat}.png"
+        Image.fromarray((np.clip(over, 0, 1) * 255).astype(np.uint8)
+                        ).save(os.path.join(args.out, name))
+        print(name)
+    if tmp:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
