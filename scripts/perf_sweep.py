@@ -92,7 +92,10 @@ if __name__ == "__main__":
         dict(batch=32, pam_impl="einsum", block=None, remat=False),
         # online-softmax blocked einsum (no N x N scores materialized) and
         # alternate flash tiles — 2026-07-30 sweep data: full einsum b8 67.5
-        # beat flash(512) 62.2; these probe whether other tilings close it
+        # beat flash(512) 62.2; these probe whether other tilings close it.
+        # (measured 2026-07-31: blocked 2048/1024 -> 62.5/63.9, flash
+        # 1024/256 -> 62.3/63.2 vs in-run einsum 66.4 — they don't; at 4096
+        # tokens the N x N scores fit HBM fine and XLA's fusion wins)
         dict(batch=8, pam_impl="einsum", block=2048, remat=False),
         dict(batch=8, pam_impl="einsum", block=1024, remat=False),
         dict(batch=8, pam_impl="flash", block=1024, remat=False),
