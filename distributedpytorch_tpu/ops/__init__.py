@@ -8,6 +8,7 @@ path).
 """
 
 from . import augment
+from . import guidance_device
 from .attention import (
     position_attention,
     blocked_position_attention,
@@ -29,6 +30,7 @@ from .metrics import (
 
 __all__ = [
     "augment",
+    "guidance_device",
     "position_attention",
     "blocked_position_attention",
     "channel_attention",

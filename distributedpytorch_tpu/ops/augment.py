@@ -188,10 +188,17 @@ def make_device_augment(
     semantic: bool = False,
     mean: Sequence[float] | None = None,
     std: Sequence[float] | None = None,
+    guidance_fn: Callable[[Batch, jax.Array], dict] | None = None,
 ) -> Callable[[Batch, jax.Array], dict]:
     """Compose the enabled stages into one ``(batch, rng) -> batch`` fn for
     ``make_train_step(augment=...)``.  Everything traces into the same XLA
     program as the forward pass.
+
+    ``guidance_fn`` (see ops.guidance_device.make_device_guidance) runs
+    after the geometric stages — the reference's stage order puts guidance
+    synthesis after flip/rotate/crop (train_pascal.py:123-134), so the
+    channel is derived from the label the model actually sees — and before
+    normalization.
 
     If ``mean``/``std`` are given, ALSO pass
     ``make_preprocess(mean, std)`` to ``make_eval_step`` — see
@@ -209,6 +216,10 @@ def make_device_augment(
                                     semantic=semantic)
         if crop_pad:
             b = random_crop(b, r2, pad=crop_pad)
+        if guidance_fn is not None:
+            # fold_in (not a wider split) keeps r1-r3 streams identical to
+            # guidance-less configs — same flips/rotations either way
+            b = guidance_fn(b, jax.random.fold_in(rng, 3))
         if mean is not None or std is not None:
             b = normalize(b, mean if mean is not None else (0.0,),
                           std if std is not None else (255.0,))

@@ -51,6 +51,10 @@ class DataConfig:
     device_augment_geom: bool = False   # rotation/scale on-device too (the
                                         # device form warps the fixed crop,
                                         # not the pre-crop full image)
+    device_guidance: bool = False       # synthesize the guidance channel
+                                        # on-device from crop_gt (the most
+                                        # expensive host transform; instance
+                                        # task, all five guidance families)
     decode_cache: int = 0               # decode-once LRU over this many
                                         # images (FFCV-style; instance mode
                                         # revisits an image once per object

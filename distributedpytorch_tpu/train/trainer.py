@@ -135,12 +135,25 @@ class Trainer:
             if err:
                 raise RuntimeError(f"VOC download failed on process 0 "
                                    f"({err})")
+        if cfg.data.device_guidance:
+            from ..ops.guidance_device import FAMILIES as _DEV_FAM
+            if cfg.task != "instance":
+                raise ValueError("data.device_guidance applies to the "
+                                 "instance task only (semantic has no "
+                                 "guidance channel)")
+            if cfg.data.guidance not in _DEV_FAM:
+                raise ValueError(
+                    f"data.device_guidance supports {_DEV_FAM}, not "
+                    f"{cfg.data.guidance!r}")
         if cfg.task == "instance":
             train_tf = build_train_transform(
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                 zero_pad=cfg.data.zero_pad, rots=cfg.data.rots,
                 scales=cfg.data.scales, alpha=cfg.data.guidance_alpha,
-                guidance=cfg.data.guidance,
+                # device guidance: host delivers bare image channels as
+                # 'concat'; the fused stage appends the map from crop_gt
+                guidance=("none" if cfg.data.device_guidance
+                          else cfg.data.guidance),
                 flip=not cfg.data.device_augment,
                 geom=not (cfg.data.device_augment
                           and cfg.data.device_augment_geom))
@@ -263,13 +276,20 @@ class Trainer:
         # TP layouts flow from the created state into the compiled steps.
         st_sh = state_shardings(self.state) if cfg.mesh.shard_params else None
         augment = None
-        if cfg.data.device_augment:  # both tasks: flip owns the same keys
+        if cfg.data.device_augment or cfg.data.device_guidance:
             from ..ops.augment import make_device_augment
+            guidance_fn = None
+            if cfg.data.device_guidance:  # validated above: instance task
+                from ..ops.guidance_device import make_device_guidance
+                guidance_fn = make_device_guidance(
+                    family=cfg.data.guidance, alpha=cfg.data.guidance_alpha)
             augment = make_device_augment(  # host flip (+geom) disabled
-                hflip=True,
-                scale_rotate=cfg.data.device_augment_geom,
+                hflip=cfg.data.device_augment,
+                scale_rotate=(cfg.data.device_augment
+                              and cfg.data.device_augment_geom),
                 rots=cfg.data.rots, scales=cfg.data.scales,
-                semantic=cfg.task == "semantic")
+                semantic=cfg.task == "semantic",
+                guidance_fn=guidance_fn)
         self.train_step = make_train_step(
             self.model, self.tx, loss_weights=cfg.model.loss_weights,
             accum_steps=cfg.optim.accum_steps, mesh=self.mesh,

@@ -50,9 +50,13 @@ def main() -> int:
         root = make_fake_voc(os.path.join(tmp, "voc"), n_images=24,
                              size=(375, 500), n_val=4, seed=0)
         tf = build_train_transform(crop_size=(512, 512))
+        # the host side of data.device_guidance=true: guidance + concat
+        # move into the compiled step, the host stops at the 512² crops
+        tf_devg = build_train_transform(crop_size=(512, 512),
+                                        guidance="none")
 
-        def ds(cache: int):
-            return VOCInstanceSegmentation(root, split="train", transform=tf,
+        def ds(cache: int, t):
+            return VOCInstanceSegmentation(root, split="train", transform=t,
                                            decode_cache=cache)
 
         variants = [
@@ -60,9 +64,12 @@ def main() -> int:
             ("workers2+decode_cache", dict(cache=64, workers=2)),
             ("workers4+decode_cache", dict(cache=64, workers=4)),
             ("workers0", dict(cache=0, workers=0)),
+            ("workers2+device_guidance", dict(cache=0, workers=2, t=tf_devg)),
+            ("workers0+device_guidance", dict(cache=0, workers=0, t=tf_devg)),
         ]
         for name, v in variants:
-            ips = measure(ds(v["cache"]), batch=8, workers=v["workers"])
+            ips = measure(ds(v["cache"], v.get("t", tf)), batch=8,
+                          workers=v["workers"])
             print(json.dumps({"variant": name,
                               "native_kernels": native_ops.enabled(),
                               "imgs_per_sec": round(ips, 2)}), flush=True)

@@ -1,0 +1,268 @@
+"""On-device guidance synthesis (ops/guidance_device.py) vs the host path.
+
+The device stage must reproduce the host guidance semantics
+(data/guidance.py, data/transforms.py): same extreme-point contracts, same
+map math, same empty-mask rule — so `data.device_guidance` changes where the
+channel is computed, not what the model sees.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.data import guidance as host
+from distributedpytorch_tpu.data import transforms as T
+from distributedpytorch_tpu.ops import guidance_device as dev
+
+
+def blob_mask(seed: int, h: int = 64, w: int = 80) -> np.ndarray:
+    """A random filled ellipse-ish blob, guaranteed non-empty."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    cy, cx = rng.integers(h // 4, 3 * h // 4), rng.integers(w // 4, 3 * w // 4)
+    ry = rng.integers(3, max(4, h // 4))
+    rx = rng.integers(3, max(4, w // 4))
+    ang = rng.uniform(0, np.pi)
+    u = (xx - cx) * np.cos(ang) + (yy - cy) * np.sin(ang)
+    v = -(xx - cx) * np.sin(ang) + (yy - cy) * np.cos(ang)
+    return ((u / rx) ** 2 + (v / ry) ** 2 <= 1.0).astype(np.float32)
+
+
+class TestExtremePoints:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+    def test_fixed_matches_host(self, seed):
+        mask = blob_mask(seed)
+        got = np.asarray(dev.extreme_points_fixed(jnp.asarray(mask)))
+        want = host.extreme_points_fixed(mask, pert=0)
+        np.testing.assert_array_equal(got, want.astype(np.float32))
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_points_are_valid_candidates(self, seed):
+        mask = blob_mask(seed)
+        pts = np.asarray(dev.extreme_points_random(
+            jnp.asarray(mask), jax.random.PRNGKey(seed), pert=0)).astype(int)
+        ys, xs = np.where(mask > 0.5)
+        for i, (x, y) in enumerate(pts):
+            assert mask[y, x] > 0.5, f"point {i} off the mask"
+        assert pts[0, 0] == xs.min()   # left
+        assert pts[1, 1] == ys.min()   # top
+        assert pts[2, 0] == xs.max()   # right
+        assert pts[3, 1] == ys.max()   # bottom
+
+    def test_random_choice_covers_ties(self):
+        # a full rectangle: every side has many tied extreme pixels — the
+        # random variant must actually spread over them
+        mask = np.zeros((32, 32), np.float32)
+        mask[8:24, 8:24] = 1.0
+        m = jnp.asarray(mask)
+        ys = {int(dev.extreme_points_random(m, jax.random.PRNGKey(s))[0, 1])
+              for s in range(12)}
+        assert len(ys) > 1, "left point never varied across seeds"
+
+    @pytest.mark.parametrize("pert", [1, 3])
+    def test_pert_window(self, pert):
+        mask = blob_mask(7)
+        ys, xs = np.where(mask > 0.5)
+        pts = np.asarray(dev.extreme_points_random(
+            jnp.asarray(mask), jax.random.PRNGKey(0), pert=pert)).astype(int)
+        assert abs(pts[0, 0] - xs.min()) <= pert
+        assert abs(pts[1, 1] - ys.min()) <= pert
+        assert abs(pts[2, 0] - xs.max()) <= pert
+        assert abs(pts[3, 1] - ys.max()) <= pert
+
+
+class TestMaps:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_nellipse_gaussians_matches_host(self, seed):
+        mask = blob_mask(seed)
+        pts = host.extreme_points_fixed(mask, pert=0)
+        want = host.nellipse_gaussians_map(mask.shape, pts, alpha=0.6)
+        got = np.asarray(dev.guidance_map(jnp.asarray(mask), is_val=True))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=0.5)  # [0,255] scale
+        assert got.max() == pytest.approx(255.0, abs=0.01)
+
+    def test_nellipse_family_matches_host(self):
+        mask = blob_mask(5)
+        pts = host.extreme_points_fixed(mask, pert=0)
+        want = host.nellipse_map(mask.shape, pts)
+        got = np.asarray(dev.guidance_map(
+            jnp.asarray(mask), family="nellipse", is_val=True))
+        np.testing.assert_allclose(got, want, atol=0.5)
+
+    def test_extreme_points_family_matches_host(self):
+        mask = blob_mask(6)
+        pts = host.extreme_points_fixed(mask, pert=0)
+        want = host.extreme_points_map(mask.shape, pts, sigma=10.0)
+        got = np.asarray(dev.guidance_map(
+            jnp.asarray(mask), family="extreme_points", pert=0, is_val=True))
+        np.testing.assert_allclose(got, want, atol=2e-3)  # [0,1] scale
+
+    def test_empty_mask_zero_map(self):
+        got = np.asarray(dev.guidance_map(
+            jnp.zeros((32, 40)), jax.random.PRNGKey(0)))
+        np.testing.assert_array_equal(got, 0.0)
+
+    def test_single_pixel_mask_finite(self):
+        mask = np.zeros((32, 40), np.float32)
+        mask[10, 12] = 1.0
+        got = np.asarray(dev.guidance_map(jnp.asarray(mask),
+                                          jax.random.PRNGKey(0)))
+        assert np.isfinite(got).all()
+        assert got.max() == pytest.approx(255.0, abs=0.01)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_confidence_l1l2_matches_host(self, seed):
+        mask = blob_mask(seed)
+        pts = host.extreme_points_fixed(mask.astype(bool), pert=0)
+        h_map, _, _ = host.generate_mv_l1l2_image_skewed_axes(
+            mask.astype(bool), extreme_points=pts, FULL_IMAGE_WEIGHTS=1,
+            d2_THRESH=None, tau=1.0)
+        want = host.normalize_wt_map(h_map) * 255.0
+        got = np.asarray(dev.guidance_map(
+            jnp.asarray(mask), family="confidence_l1l2", pert=0,
+            is_val=True))
+        np.testing.assert_allclose(got, want, atol=0.5)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_confidence_gaussian_matches_host(self, seed):
+        mask = blob_mask(seed)
+        h_map = host.generate_mvgauss_image(mask.astype(bool),
+                                            FULL_IMAGE_WEIGHTS=1, tau=0.5)
+        want = host.normalize_wt_map(h_map) * 255.0
+        got = np.asarray(dev.guidance_map(
+            jnp.asarray(mask), family="confidence_gaussian", is_val=True))
+        np.testing.assert_allclose(got, want, atol=0.5)
+
+    def test_confidence_uniform_mask_zero(self):
+        # host AddConfidenceMap zeroes on len(unique(mask)) == 1 — both
+        # the empty AND the all-foreground mask
+        full = jnp.ones((24, 24))
+        got = np.asarray(dev.guidance_map(full, family="confidence_gaussian",
+                                          is_val=True))
+        np.testing.assert_array_equal(got, 0.0)
+        # ...whereas the point families still fire on a full mask
+        ell = np.asarray(dev.guidance_map(full, is_val=True))
+        assert ell.max() > 0
+
+    def test_unknown_family_raises(self):
+        with pytest.raises(ValueError):
+            dev.guidance_map(jnp.zeros((8, 8)), family="nope")
+        with pytest.raises(ValueError):
+            dev.make_device_guidance(family="nope")
+
+
+class TestStage:
+    def test_stage_matches_host_transform(self):
+        # full-stage parity: host NEllipseWithGaussians(is_val) + Concat vs
+        # the device stage on the same crop — identical 'concat' contract
+        mask = blob_mask(3, 48, 56)
+        img = np.random.default_rng(0).uniform(
+            0, 255, (48, 56, 3)).astype(np.float32)
+        sample = {"crop_image": img.copy(), "crop_gt": mask.copy()}
+        sample = T.NEllipseWithGaussians(alpha=0.6, is_val=True)(sample)
+        sample = T.ConcatInputs(elems=("crop_image", "nellipseWithGaussians"))(
+            sample)
+        want = sample["concat"]
+
+        stage = dev.make_device_guidance(is_val=True)
+        batch = {"concat": jnp.asarray(img)[None],
+                 "crop_gt": jnp.asarray(mask)[None]}
+        got = np.asarray(stage(batch, jax.random.PRNGKey(0))["concat"][0])
+        assert got.shape == want.shape == (48, 56, 4)
+        np.testing.assert_allclose(got[..., :3], want[..., :3])
+        np.testing.assert_allclose(got[..., 3], want[..., 3], atol=0.5)
+
+    def test_stage_is_jittable_and_batched(self):
+        stage = dev.make_device_guidance()
+        masks = np.stack([blob_mask(s, 32, 32) for s in range(4)])
+        batch = {"concat": jnp.zeros((4, 32, 32, 3)),
+                 "crop_gt": jnp.asarray(masks)}
+        out = jax.jit(stage)(batch, jax.random.PRNGKey(1))
+        assert out["concat"].shape == (4, 32, 32, 4)
+        m = np.asarray(out["concat"][..., 3])
+        assert np.isfinite(m).all()
+        for i in range(4):
+            assert m[i].max() == pytest.approx(255.0, abs=0.01)
+
+    def test_channel_dim_gt_accepted(self):
+        stage = dev.make_device_guidance()
+        batch = {"concat": jnp.zeros((2, 16, 16, 3)),
+                 "crop_gt": jnp.asarray(
+                     np.stack([blob_mask(s, 16, 16) for s in range(2)])
+                 )[..., None]}
+        out = stage(batch, jax.random.PRNGKey(0))
+        assert out["concat"].shape == (2, 16, 16, 4)
+
+
+def guidance_cfg(work: str, **data_kw):
+    import dataclasses
+
+    from distributedpytorch_tpu.train import Config
+
+    cfg = Config()
+    return dataclasses.replace(
+        cfg,
+        data=dataclasses.replace(
+            cfg.data, fake=True, train_batch=8, val_batch=2, num_workers=2,
+            crop_size=(64, 64), relax=10, area_thres=0,
+            device_guidance=True, **data_kw),
+        model=dataclasses.replace(cfg.model, backbone="resnet18",
+                                  output_stride=8),
+        checkpoint=dataclasses.replace(cfg.checkpoint, async_save=False),
+        epochs=1, eval_every=1, seed=0, work_dir=work,
+    )
+
+
+class TestTrainerIntegration:
+    def test_e2e_device_guidance(self, tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+
+        tr = Trainer(guidance_cfg(str(tmp_path)))
+        # the host pipeline must deliver bare-image 'concat' (3ch)
+        batch = next(iter(tr.train_loader))
+        assert batch["concat"].shape[-1] == 3
+        history = tr.fit()
+        assert all(np.isfinite(l) for l in history["train_loss"])
+        # val still runs the host (deterministic) guidance — 4ch eval input
+        assert len(history["val"]) == 1
+
+    def test_e2e_device_guidance_with_device_augment(self, tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+
+        tr = Trainer(guidance_cfg(str(tmp_path), device_augment=True,
+                                  device_augment_geom=True))
+        history = tr.fit()
+        assert all(np.isfinite(l) for l in history["train_loss"])
+
+    def test_semantic_task_rejected(self, tmp_path):
+        import dataclasses
+
+        from distributedpytorch_tpu.train import Trainer
+
+        cfg = guidance_cfg(str(tmp_path))
+        cfg = dataclasses.replace(
+            cfg, task="semantic",
+            model=dataclasses.replace(cfg.model, nclass=21, in_channels=3))
+        with pytest.raises(ValueError, match="instance task"):
+            Trainer(cfg)
+
+    def test_unknown_family_rejected(self, tmp_path):
+        import dataclasses
+
+        from distributedpytorch_tpu.train import Trainer
+
+        cfg = guidance_cfg(str(tmp_path))
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, guidance="nope"))
+        with pytest.raises(ValueError, match="device_guidance supports"):
+            Trainer(cfg)
+
+    def test_e2e_confidence_family(self, tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+
+        tr = Trainer(guidance_cfg(str(tmp_path),
+                                  guidance="confidence_l1l2"))
+        history = tr.fit()
+        assert all(np.isfinite(l) for l in history["train_loss"])
