@@ -1,0 +1,102 @@
+"""Elastic resume: a checkpoint written under one mesh layout restores under
+another.
+
+The reference could not survive any topology change (its checkpoint was a raw
+``state_dict`` whose consumer hardcoded 4 GPUs, train_pascal.py:92,103).  Here
+the checkpoint stores abstract arrays and ``CheckpointManager.restore`` adopts
+the *target* state's shardings (checkpoint.py:112-129), so the same run can
+continue on a different device count or a different parallelism layout — the
+TPU-native equivalent of elastic recovery (SURVEY §5.3: absent in the
+reference).
+"""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.train import Trainer
+
+from test_train import make_tiny_cfg
+
+
+def mesh_cfg(base, data: int, model: int, shard_params: bool, **kw):
+    return dataclasses.replace(
+        base,
+        mesh=dataclasses.replace(base.mesh, data=data, model=model,
+                                 shard_params=shard_params),
+        **kw)
+
+
+class TestElasticResume:
+    @pytest.fixture(scope="class")
+    def first_run(self, tmp_path_factory):
+        """One epoch trained on a (data=4, model=2) tensor-parallel mesh."""
+        work = str(tmp_path_factory.mktemp("elastic"))
+        cfg = mesh_cfg(make_tiny_cfg(work), data=4, model=2,
+                       shard_params=True, epochs=1)
+        tr = Trainer(cfg)
+        tr.fit()
+        params = jax.tree.map(np.asarray, tr.state.params)
+        step = int(tr.state.step)
+        ck = os.path.join(tr.run_dir, "checkpoints")
+        tr.close()
+        return cfg, params, step, ck
+
+    def test_tp_checkpoint_resumes_on_pure_dp(self, first_run):
+        cfg, params, step, ck = first_run
+        # same work_dir, resume=auto, but an (8, 1) replicated layout
+        cfg2 = mesh_cfg(cfg, data=8, model=1, shard_params=False,
+                        resume="auto", epochs=2)
+        tr2 = Trainer(cfg2)
+        assert int(tr2.state.step) == step
+        assert tr2.start_epoch == 1
+        for a, b in zip(jax.tree.leaves(params),
+                        jax.tree.leaves(tr2.state.params)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        # and it trains on from there under the new layout
+        hist = tr2.fit()
+        assert all(np.isfinite(l) for l in hist["train_loss"])
+        assert int(tr2.state.step) > step
+        tr2.close()
+
+    def test_tp_checkpoint_resumes_on_wider_tp(self, first_run):
+        cfg, params, step, ck = first_run
+        # (2, 4): different model-axis extent — kernels re-shard 2-way -> 4-way
+        cfg2 = mesh_cfg(cfg, data=2, model=4, shard_params=True,
+                        resume=ck, epochs=1)
+        tr2 = Trainer(cfg2)
+        assert int(tr2.state.step) == step
+        for a, b in zip(jax.tree.leaves(params),
+                        jax.tree.leaves(tr2.state.params)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        # the restored params must ADOPT the new mesh's sharding, not the
+        # checkpoint's: find a conv kernel and check its model-axis spec
+        specs = jax.tree.map(lambda x: x.sharding.spec, tr2.state.params)
+        assert any("model" in str(s) for s in jax.tree.leaves(
+            specs, is_leaf=lambda s: hasattr(s, "index"))), specs
+        tr2.close()
+
+    def test_dp_checkpoint_resumes_on_tp(self, tmp_path):
+        """Reverse direction: replicated checkpoint -> sharded restore."""
+        work = str(tmp_path)
+        cfg = mesh_cfg(make_tiny_cfg(work), data=8, model=1,
+                       shard_params=False, epochs=1)
+        tr = Trainer(cfg)
+        tr.fit()
+        params = jax.tree.map(np.asarray, tr.state.params)
+        step = int(tr.state.step)
+        tr.close()
+
+        cfg2 = mesh_cfg(cfg, data=4, model=2, shard_params=True,
+                        resume="auto", epochs=2)
+        tr2 = Trainer(cfg2)
+        assert int(tr2.state.step) == step
+        for a, b in zip(jax.tree.leaves(params),
+                        jax.tree.leaves(tr2.state.params)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        hist = tr2.fit()
+        assert all(np.isfinite(l) for l in hist["train_loss"])
+        tr2.close()
