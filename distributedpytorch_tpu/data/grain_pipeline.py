@@ -171,9 +171,16 @@ class GrainDataLoader:
         self.num_shards = num_shards
         self.shard_index = shard_index
         self._epoch = 0
+        self._start_batch = 0
 
-    def set_epoch(self, epoch: int) -> None:
+    def set_epoch(self, epoch: int, start_batch: int = 0) -> None:
+        """Position the loader; ``start_batch`` skips that many batches —
+        the exact-mid-epoch-resume hook (pipeline.DataLoader surface).
+        Grain owns the record order internally, so the skip is an islice
+        over produced batches (the skipped ones are still decoded; resume
+        is rare enough that correctness beats cleverness here)."""
         self._epoch = int(epoch)
+        self._start_batch = int(start_batch)
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -192,8 +199,12 @@ class GrainDataLoader:
         return sum(-(-c // self.batch_size) for c in counts if c)
 
     def __iter__(self):
-        return iter(make_grain_loader(
+        it = iter(make_grain_loader(
             self.dataset, self.batch_size, transform=self.transform,
             shuffle=self.shuffle, drop_last=self.drop_last, seed=self.seed,
             epoch=self._epoch, num_workers=self.num_workers,
             shard_index=self.shard_index, num_shards=self.num_shards))
+        if self._start_batch:
+            import itertools
+            return itertools.islice(it, self._start_batch, None)
+        return it

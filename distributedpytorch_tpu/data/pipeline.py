@@ -244,9 +244,16 @@ class DataLoader:
         self.num_shards = num_shards
         self.prefetch = prefetch
         self.epoch = 0
+        self.start_batch = 0
 
-    def set_epoch(self, epoch: int) -> None:
+    def set_epoch(self, epoch: int, start_batch: int = 0) -> None:
+        """Position the loader; ``start_batch`` skips that many batches of
+        the epoch's (deterministic) order — the exact-mid-epoch-resume hook
+        (a resumed run continues where the preempted one stopped instead of
+        replaying the epoch).  ``__len__`` still reports the full epoch so
+        schedules and resume math are unaffected."""
         self.epoch = epoch
+        self.start_batch = start_batch
 
     def _epoch_indices(self) -> np.ndarray:
         n = len(self.dataset)
@@ -281,6 +288,9 @@ class DataLoader:
         order = self._epoch_indices()
         nb = self._num_batches(len(order))
         batches = [order[i * self.batch_size : (i + 1) * self.batch_size] for i in range(nb)]
+        if self.start_batch:
+            # index-level skip: the skipped batches cost nothing (no decode)
+            batches = batches[self.start_batch:]
         if self.num_workers == 0:
             for idxs in batches:
                 yield collate([self._load_one(i) for i in idxs])

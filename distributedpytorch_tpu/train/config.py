@@ -124,6 +124,11 @@ class CheckpointConfig:
     async_save: bool = True
     save_on_preempt: bool = True        # SIGTERM -> final full-state save
     preempt_check_every: int = 32       # stop-consensus cadence (steps)
+    exact_resume: bool = True           # continue a preempted epoch at the
+                                        # batch it stopped (no batch trains
+                                        # twice); false = replay the epoch
+                                        # from its start (batches repeat,
+                                        # none skipped)
 
 
 @dataclass

@@ -307,6 +307,7 @@ class Trainer:
             best_metric_init=cfg.checkpoint.best_metric_init,
             async_save=cfg.checkpoint.async_save)
         self.start_epoch = 0
+        self._resume_start_batch = 0  # exact mid-epoch resume offset
         if cfg.checkpoint.warm_start:
             self._warm_start(cfg.checkpoint.warm_start,
                              cfg.checkpoint.warm_start_partial)
@@ -397,20 +398,53 @@ class Trainer:
         self.start_epoch = int(meta.get("epoch", 0)) + 1
         self.ckpt.best_metric = float(
             meta.get("best_metric", self.ckpt.best_metric))
+        interrupted = meta.get("interrupted_epoch")
+        if interrupted is not None and self.cfg.checkpoint.exact_resume:
+            # Exact mid-epoch resume: the preempt save recorded how many
+            # steps of the interrupted epoch already trained; the epoch's
+            # batch order is deterministic given (seed, epoch), so continue
+            # at that batch instead of replaying the epoch.  A batch
+            # interrupted mid-echo replays its echoes (rounded down).
+            saved_shards = int(meta.get("num_shards", jax.process_count()))
+            if saved_shards != jax.process_count():
+                # Per-shard batch order depends on the host count; an offset
+                # recorded under a different count indexes a different
+                # sample order.  Replaying the epoch is the layout-safe
+                # fallback (batches repeat, none skipped).
+                if self.is_main:
+                    print(f"exact_resume: checkpoint written with "
+                          f"{saved_shards} processes, now "
+                          f"{jax.process_count()} — replaying the "
+                          "interrupted epoch instead", flush=True)
+            else:
+                done = int(meta.get("epoch_steps_done", 0)) \
+                    // max(1, self.cfg.data.echo)
+                if done >= len(self.train_loader):
+                    self.start_epoch = int(interrupted) + 1  # nothing left
+                else:
+                    self.start_epoch = int(interrupted)
+                    self._resume_start_batch = done
         if self.is_main:
-            print(f"resumed from {source} at epoch {self.start_epoch} "
+            at = f"epoch {self.start_epoch}"
+            if self._resume_start_batch:
+                at += f" batch {self._resume_start_batch}"
+            print(f"resumed from {source} at {at} "
                   f"(best={self.ckpt.best_metric:.4f})", flush=True)
 
     # ------------------------------------------------------------------ train
     def train_epoch(self, epoch: int,
-                    guard: PreemptionGuard | None = None) -> float:
+                    guard: PreemptionGuard | None = None,
+                    start_batch: int = 0) -> float:
         """One epoch; returns mean train loss (the reference printed the
         running loss once per epoch, train_pascal.py:207-212).
 
         ``guard``: stop-consensus checked every ``preempt_check_every``
-        steps, so all hosts leave the loop at the same step."""
+        steps, so all hosts leave the loop at the same step.
+        ``start_batch``: skip the first batches of the epoch's deterministic
+        order — the exact-resume continuation of a preempted epoch (the
+        returned mean covers only the batches actually trained)."""
         cfg = self.cfg
-        self.train_loader.set_epoch(epoch)
+        self.train_loader.set_epoch(epoch, start_batch=start_batch)
         losses = []
         t0 = time.perf_counter()
         # Track the step as a python int (start + i): reading
@@ -468,6 +502,8 @@ class Trainer:
             scalars = {"train/epoch_loss": mean_loss,
                        "train/imgs_per_sec": n_imgs / dt if dt > 0 else 0.0,
                        "train/epoch_seconds": dt, "train/epoch": epoch}
+            if start_batch:
+                scalars["train/resumed_at_batch"] = start_batch
             peak = device_memory_stats()["peak_bytes_in_use"]
             if peak:  # backends without stats (CPU) report zero
                 scalars["train/peak_hbm_gb"] = round(peak / 2**30, 3)
@@ -523,9 +559,16 @@ class Trainer:
         Preemption: unless disabled (``checkpoint.save_on_preempt=false``),
         SIGTERM/SIGINT triggers a consensus stop, one final full-state
         checkpoint, and a clean return — ``history["preempted"]`` marks it.
-        The interrupted epoch is recorded as *not* completed, so a resumed
-        run replays it from its start (some batches train twice; none are
-        skipped).  Pass your own entered ``guard`` to drive stops
+        The save records the epoch position (``epoch_steps_done``); with
+        ``checkpoint.exact_resume`` (default) the resumed run continues the
+        interrupted epoch at exactly that batch — no batch trains twice and
+        none are skipped (the epoch's order is deterministic given
+        (seed, epoch)).  Exactness is at batch granularity: a stop landing
+        mid-echo (``data.echo > 1``) replays that batch's echoes, and a
+        resume under a different process count replays the whole epoch
+        (per-shard order depends on host count).  ``exact_resume=false``
+        replays the epoch from its start unconditionally (batches repeat,
+        none skipped).  Pass your own entered ``guard`` to drive stops
         programmatically (e.g. a wall-clock watchdog calling ``trip()``)."""
         cfg = self.cfg
         history = {"train_loss": [], "val": []}
@@ -540,6 +583,9 @@ class Trainer:
                     check_every=cfg.checkpoint.preempt_check_every))
             for epoch in range(self.start_epoch, cfg.epochs):
                 t0 = time.perf_counter()
+                sb = self._resume_start_batch  # only the run's first epoch
+                self._resume_start_batch = 0
+                estep0 = int(self.state.step)
                 if cfg.profile_epoch == epoch and self.is_main:
                     # On-demand op-level device trace (SURVEY §5.1: the
                     # reference had only wall-clock prints).  One epoch,
@@ -549,11 +595,13 @@ class Trainer:
                 else:
                     ctx = contextlib.nullcontext()
                 with ctx:
-                    epoch_loss = self.train_epoch(epoch, guard=guard)
+                    epoch_loss = self.train_epoch(epoch, guard=guard,
+                                                  start_batch=sb)
                 step = int(self.state.step)
                 if guard is not None and guard.should_stop():
-                    # The partial epoch is not appended to history — it will
-                    # be replayed in full by the resumed run.
+                    # The partial epoch is not appended to history; the
+                    # resumed run continues it at the recorded batch
+                    # (checkpoint.exact_resume) or replays it in full.
                     history["preempted"] = True
                     # shield(): signals delivered during the final save and
                     # flush are absorbed (no escalation), so a scheduler's
@@ -561,10 +609,21 @@ class Trainer:
                     # stop exists to land.
                     with guard.shield():
                         if self.ckpt.latest_step() != step:
-                            self.ckpt.save(step, self.state,
-                                           extra={"epoch": epoch - 1,
-                                                  "interrupted_epoch": epoch,
-                                                  "preempted": True})
+                            self.ckpt.save(
+                                step, self.state,
+                                extra={"epoch": epoch - 1,
+                                       "interrupted_epoch": epoch,
+                                       # epoch position in steps, counting
+                                       # what an earlier partial run of this
+                                       # same epoch already consumed
+                                       "epoch_steps_done":
+                                           sb * cfg.data.echo
+                                           + (step - estep0),
+                                       # shard order depends on host count;
+                                       # _resume falls back to replay on a
+                                       # mismatch
+                                       "num_shards": jax.process_count(),
+                                       "preempted": True})
                         self.ckpt.wait()
                     if self.is_main:
                         self.writer.scalars(

@@ -1,6 +1,8 @@
 """Preemption / graceful-stop subsystem (SURVEY.md §5.3: absent in the
 reference — a mid-run kill lost optimizer state entirely; here it lands a
-final full-state checkpoint and resume replays the interrupted epoch)."""
+final full-state checkpoint recording the epoch position, and resume
+continues the interrupted epoch at exactly that batch
+(checkpoint.exact_resume) or replays it from the start)."""
 
 import dataclasses
 import signal
@@ -88,36 +90,110 @@ class TestPreemptionGuard:
         assert out["stopped"]
 
 
+def big_fake_root(tmp_path):
+    """A fake VOC large enough that one epoch spans several batches (the
+    trainer's own fixture makes ~1 batch at bs 8 — too small to stop
+    mid-epoch)."""
+    from distributedpytorch_tpu.data import make_fake_voc
+    return make_fake_voc(str(tmp_path / "voc"), n_images=32, size=(96, 128),
+                         n_val=2, seed=0)
+
+
 class TestTrainerPreemption:
-    def test_preempt_mid_run_saves_and_resume_replays_epoch(self, tmp_path):
-        cfg = tiny_cfg(tmp_path)
+    def test_preempt_mid_run_saves_and_exact_resume_continues(self, tmp_path):
+        cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path),
+                                    "epochs": 2,
+                                    "checkpoint.preempt_check_every": 3})
         tr = Trainer(cfg)
-        guard = PreemptionGuard(check_every=1)
+        nb = len(tr.train_loader)
+        assert nb > 3  # the stop must land mid-epoch
+        guard = PreemptionGuard(check_every=3)
         with guard:
-            # Deliver the "signal" before epoch 1 starts: epoch 0 runs to
-            # completion... no — check_every=1 stops at its first step.
-            guard.trip()
+            guard.trip()  # consensus at the first cadence step: step 3
             hist = tr.fit(guard)
         assert hist.get("preempted") is True
         assert hist["train_loss"] == []   # partial epoch 0 not recorded
         step = tr.ckpt.latest_step()
-        assert step is not None and step >= 1
+        assert step == 3
         _, meta = tr.ckpt.restore(tr.state)
         assert meta.get("preempted") is True
         assert meta["interrupted_epoch"] == 0
         assert meta["epoch"] == -1                 # epoch 0 NOT completed
+        assert meta["epoch_steps_done"] == 3
         ckpt_dir = tr.ckpt.directory
         tr.close()
 
-        # Resume: replays the interrupted epoch from its start.
+        # Exact resume: continue epoch 0 at batch 3.
         cfg2 = dataclasses.replace(cfg, resume=ckpt_dir)
         tr2 = Trainer(cfg2)
         assert tr2.start_epoch == 0
+        assert tr2._resume_start_batch == 3
         assert int(tr2.state.step) == step
         hist2 = tr2.fit()
         tr2.close()
         assert "preempted" not in hist2
         assert len(hist2["train_loss"]) == cfg.epochs
+        # THE exactness property: total steps across both runs equals one
+        # full schedule — no batch trained twice, none skipped.
+        assert int(tr2.state.step) == cfg.epochs * nb
+
+    def test_exact_resume_off_replays_epoch(self, tmp_path):
+        cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path),
+                                    "epochs": 2,
+                                    "checkpoint.preempt_check_every": 3,
+                                    "checkpoint.exact_resume": False})
+        tr = Trainer(cfg)
+        nb = len(tr.train_loader)
+        guard = PreemptionGuard(check_every=3)
+        with guard:
+            guard.trip()
+            tr.fit(guard)
+        ckpt_dir = tr.ckpt.directory
+        tr.close()
+
+        cfg2 = dataclasses.replace(cfg, resume=ckpt_dir)
+        tr2 = Trainer(cfg2)
+        assert tr2.start_epoch == 0
+        assert tr2._resume_start_batch == 0   # replay from the start
+        tr2.fit()
+        tr2.close()
+        # the 3 pre-preempt steps repeat on top of the full schedule
+        assert int(tr2.state.step) == cfg.epochs * nb + 3
+
+    def test_loader_start_batch_is_the_tail(self, tmp_path):
+        cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path)})
+        tr = Trainer(cfg)
+        import numpy as np
+        loader = tr.train_loader
+        loader.set_epoch(1)
+        full = [b["concat"] for b in loader]
+        loader.set_epoch(1, start_batch=2)
+        tail = [b["concat"] for b in loader]
+        assert len(tail) == len(full) - 2
+        for a, b in zip(tail, full[2:]):
+            np.testing.assert_array_equal(a, b)
+        # set_epoch without start_batch resets the skip
+        loader.set_epoch(1)
+        assert len([1 for _ in loader]) == len(full)
+        tr.close()
+
+    def test_grain_loader_start_batch_is_the_tail(self, tmp_path):
+        from distributedpytorch_tpu.data.grain_pipeline import HAVE_GRAIN
+        if not HAVE_GRAIN:
+            pytest.skip("grain not installed")
+        cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path),
+                                    "data.loader": "grain"})
+        tr = Trainer(cfg)
+        import numpy as np
+        loader = tr.train_loader
+        loader.set_epoch(1)
+        full = [b["concat"] for b in loader]
+        loader.set_epoch(1, start_batch=2)
+        tail = [b["concat"] for b in loader]
+        assert len(tail) == len(full) - 2
+        for a, b in zip(tail, full[2:]):
+            np.testing.assert_array_equal(a, b)
+        tr.close()
 
     def test_signal_during_fit_stops_cleanly(self, tmp_path):
         cfg = tiny_cfg(tmp_path, **{"epochs": 50})
