@@ -351,18 +351,47 @@ class Trainer:
         never persisted optimizer state, SURVEY.md §3.5); step/opt-state/RNG
         stay fresh.  Use ``resume`` for full-state Orbax restarts."""
         from ..utils.torch_interop import (
+            inflate_stem_channels,
+            is_torchvision_resnet,
             load_torch_file,
             torch_state_dict_to_params,
+            torchvision_resnet_depth,
+            torchvision_resnet_rename,
         )
 
         sd = load_torch_file(path)
+        rename = None
+        if is_torchvision_resnet(sd):
+            # An ImageNet-pretrained torchvision backbone (the reference's
+            # model lineage): bridge the naming, widen the RGB stem to this
+            # model's input channels, and import partially (the seg head
+            # isn't in a classification checkpoint).
+            bb = self.cfg.model.backbone
+            if not bb.startswith("resnet"):
+                raise ValueError(
+                    f"{path} looks like a torchvision ResNet checkpoint "
+                    f"but model.backbone={bb!r}")
+            depth = torchvision_resnet_depth(sd)
+            if depth != int(bb[len("resnet"):]):
+                # a partial import would silently leave most of the deeper
+                # net at fresh init — refuse instead
+                raise ValueError(
+                    f"{path} is a torchvision resnet{depth} checkpoint "
+                    f"but model.backbone={bb!r}")
+            sd = inflate_stem_channels(sd, self.cfg.model.in_channels)
+            rename = torchvision_resnet_rename(depth)
+            partial = True
+            if self.is_main:
+                print(f"warm start: torchvision ResNet naming detected in "
+                      f"{path}; importing as pretrained backbone",
+                      flush=True)
         # Shape/dtype-only templates: the live state may be sharded across
         # processes, and describing shapes must not gather it to host.
         as_struct = lambda t: jax.tree.map(  # noqa: E731
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
         params, stats = torch_state_dict_to_params(
             sd, as_struct(self.state.params), as_struct(self.state.batch_stats),
-            allow_missing=partial, allow_unused=partial)
+            rename=rename, allow_missing=partial, allow_unused=partial)
 
         imported = [0, 0]  # [loaded from checkpoint, kept template]
 
@@ -405,25 +434,34 @@ class Trainer:
             # batch order is deterministic given (seed, epoch), so continue
             # at that batch instead of replaying the epoch.  A batch
             # interrupted mid-echo replays its echoes (rounded down).
-            saved_shards = int(meta.get("num_shards", jax.process_count()))
-            if saved_shards != jax.process_count():
-                # Per-shard batch order depends on the host count; an offset
-                # recorded under a different count indexes a different
-                # sample order.  Replaying the epoch is the layout-safe
-                # fallback (batches repeat, none skipped).
+            # The recorded offset indexes THE batch order it was written
+            # under; anything that changes that order (host count, batch
+            # size, seed) or the steps-per-batch accounting (echo) makes it
+            # meaningless.  Replaying the epoch is the layout-safe fallback
+            # (batches repeat, none skipped).
+            now = {"num_shards": jax.process_count(),
+                   "echo": self.cfg.data.echo,
+                   "train_batch": self.cfg.data.train_batch,
+                   "seed": self.cfg.seed}
+            stale = {k: (meta.get(k, v), v) for k, v in now.items()
+                     if int(meta.get(k, v)) != v}
+            if stale:
                 if self.is_main:
-                    print(f"exact_resume: checkpoint written with "
-                          f"{saved_shards} processes, now "
-                          f"{jax.process_count()} — replaying the "
-                          "interrupted epoch instead", flush=True)
+                    diffs = ", ".join(f"{k}: {a} -> {b}"
+                                      for k, (a, b) in stale.items())
+                    print(f"exact_resume: data-order config changed "
+                          f"({diffs}) — replaying the interrupted epoch "
+                          "instead", flush=True)
             else:
                 done = int(meta.get("epoch_steps_done", 0)) \
                     // max(1, self.cfg.data.echo)
-                if done >= len(self.train_loader):
-                    self.start_epoch = int(interrupted) + 1  # nothing left
-                else:
-                    self.start_epoch = int(interrupted)
-                    self._resume_start_batch = done
+                # A stop landing exactly on the epoch's last step still
+                # needs the epoch-end bookkeeping (validation, best gate,
+                # checkpoint) the preempt skipped — replay the final batch
+                # so the epoch completes through the normal path.
+                done = min(done, len(self.train_loader) - 1)
+                self.start_epoch = int(interrupted)
+                self._resume_start_batch = done
         if self.is_main:
             at = f"epoch {self.start_epoch}"
             if self._resume_start_batch:
@@ -564,12 +602,15 @@ class Trainer:
         interrupted epoch at exactly that batch — no batch trains twice and
         none are skipped (the epoch's order is deterministic given
         (seed, epoch)).  Exactness is at batch granularity: a stop landing
-        mid-echo (``data.echo > 1``) replays that batch's echoes, and a
-        resume under a different process count replays the whole epoch
-        (per-shard order depends on host count).  ``exact_resume=false``
-        replays the epoch from its start unconditionally (batches repeat,
-        none skipped).  Pass your own entered ``guard`` to drive stops
-        programmatically (e.g. a wall-clock watchdog calling ``trip()``)."""
+        mid-echo (``data.echo > 1``) replays that batch's echoes, a stop on
+        the epoch's last step replays the final batch (so epoch-end
+        validation/best-gating still run), and a resume whose data-order
+        config changed (process count, train batch, seed, echo) replays the
+        whole epoch — the recorded offset indexes an order that no longer
+        exists.  ``exact_resume=false`` replays the epoch from its start
+        unconditionally (batches repeat, none skipped).  Pass your own
+        entered ``guard`` to drive stops programmatically (e.g. a
+        wall-clock watchdog calling ``trip()``)."""
         cfg = self.cfg
         history = {"train_loss": [], "val": []}
         if cfg.profile_epoch is not None and self.is_main and not \
@@ -619,10 +660,14 @@ class Trainer:
                                        "epoch_steps_done":
                                            sb * cfg.data.echo
                                            + (step - estep0),
-                                       # shard order depends on host count;
-                                       # _resume falls back to replay on a
-                                       # mismatch
+                                       # the batch order's identity; a
+                                       # change in any of these makes the
+                                       # offset stale -> _resume falls back
+                                       # to replay
                                        "num_shards": jax.process_count(),
+                                       "echo": cfg.data.echo,
+                                       "train_batch": cfg.data.train_batch,
+                                       "seed": cfg.seed,
                                        "preempted": True})
                         self.ckpt.wait()
                     if self.is_main:

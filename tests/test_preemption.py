@@ -241,3 +241,71 @@ class TestTrainerPreemption:
         _, meta = tr.ckpt.restore(tr.state)
         assert "preempted" not in meta            # original meta untouched
         tr.close()
+
+
+class TestExactResumeFallbacks:
+    def _preempt(self, cfg):
+        tr = Trainer(cfg)
+        nb = len(tr.train_loader)
+        guard = PreemptionGuard(check_every=3)
+        with guard:
+            guard.trip()
+            tr.fit(guard)
+        ckpt_dir = tr.ckpt.directory
+        tr.close()
+        return nb, ckpt_dir
+
+    def test_changed_echo_falls_back_to_replay(self, tmp_path):
+        cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path),
+                                    "epochs": 2,
+                                    "checkpoint.preempt_check_every": 3})
+        nb, ckpt_dir = self._preempt(cfg)
+        import dataclasses as dc
+        cfg2 = dc.replace(cfg, resume=ckpt_dir,
+                          data=dc.replace(cfg.data, echo=2))
+        tr2 = Trainer(cfg2)
+        # stale offset (recorded under echo=1) -> layout-safe replay
+        assert tr2._resume_start_batch == 0
+        assert tr2.start_epoch == 0
+        tr2.close()
+
+    def test_changed_batch_falls_back_to_replay(self, tmp_path):
+        cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path),
+                                    "epochs": 2,
+                                    "checkpoint.preempt_check_every": 3})
+        nb, ckpt_dir = self._preempt(cfg)
+        import dataclasses as dc
+        cfg2 = dc.replace(cfg, resume=ckpt_dir,
+                          data=dc.replace(cfg.data, train_batch=16))
+        tr2 = Trainer(cfg2)
+        assert tr2._resume_start_batch == 0
+        tr2.close()
+
+    def test_boundary_stop_replays_final_batch_and_validates(self, tmp_path):
+        # stop consensus landing exactly on the epoch's last step: resume
+        # must replay the final batch so epoch-end validation still runs
+        cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path),
+                                    "epochs": 1, "eval_every": 1})
+        tr = Trainer(cfg)
+        nb = len(tr.train_loader)
+        guard = PreemptionGuard(check_every=nb)  # cadence == epoch length
+        with guard:
+            guard.trip()
+            hist = tr.fit(guard)
+        assert hist.get("preempted") is True
+        _, meta = tr.ckpt.restore(tr.state)
+        assert meta["epoch_steps_done"] == nb
+        ckpt_dir = tr.ckpt.directory
+        tr.close()
+
+        cfg2 = dataclasses.replace(cfg, resume=ckpt_dir)
+        tr2 = Trainer(cfg2)
+        assert tr2.start_epoch == 0
+        assert tr2._resume_start_batch == nb - 1
+        hist2 = tr2.fit()
+        tr2.close()
+        # the completed epoch got its validation + history entry after all
+        assert len(hist2["val"]) == 1
+        assert len(hist2["train_loss"]) == 1
+        import numpy as np
+        assert np.isfinite(hist2["train_loss"][0])
