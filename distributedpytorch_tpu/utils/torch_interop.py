@@ -170,3 +170,114 @@ def load_torch_file(path: str) -> dict:
             continue
         out[k] = v.detach().numpy() if hasattr(v, "detach") else np.asarray(v)
     return out
+
+
+# ---------------------------------------------------------------------------
+# torchvision ResNet checkpoints (ImageNet-pretrained backbones)
+# ---------------------------------------------------------------------------
+#
+# The reference's model lineage started from an ImageNet-pretrained ResNet
+# (PyTorch-Encoding's DANet builds on one; the warm-start .pth at reference
+# train_pascal.py:103 descends from it, with the stem widened to 4 input
+# channels).  torchvision's ResNet state_dicts are the canonical source of
+# those backbones, so their naming gets a ready-made bridge here:
+#
+#   torchvision                      this framework
+#   conv1.weight                     backbone.Conv_0.weight
+#   bn1.*                            backbone.BatchNorm_0.*
+#   layer{s}.{i}.conv{k}.weight      backbone.<Block>_{flat}.Conv_{k-1}.weight
+#   layer{s}.{i}.bn{k}.*             backbone.<Block>_{flat}.BatchNorm_{k-1}.*
+#   layer{s}.{i}.downsample.0/1.*    backbone.<Block>_{flat}.Conv_K/BatchNorm_K.*
+#   fc.*                             (dropped — no classifier here)
+#
+# where <Block> is BottleneckBlock (50/101/152) or BasicBlock (18/34), flat
+# is the global block index (our blocks number across stages), and K is the
+# block's downsample slot (3 for bottleneck, 2 for basic).
+
+def is_torchvision_resnet(state_dict: Mapping[str, np.ndarray]) -> bool:
+    """Heuristic: torchvision ResNet naming, not this framework's export."""
+    keys = state_dict.keys()
+    return ("conv1.weight" in keys
+            and any(k.startswith("layer1.0.conv") for k in keys)
+            and not any("Block_" in k for k in keys))
+
+
+def torchvision_resnet_rename(depth: int, prefix: str = "backbone"
+                              ) -> Callable[[str], str | None]:
+    """Key-rename callable for ``torch_state_dict_to_params`` importing a
+    torchvision ResNet-``depth`` state_dict into the ``prefix`` submodule."""
+    from ..models.resnet import BOTTLENECK_DEPTHS, RESNET_DEPTHS
+
+    counts = RESNET_DEPTHS[depth]
+    bottleneck = depth in BOTTLENECK_DEPTHS
+    block = "BottleneckBlock" if bottleneck else "BasicBlock"
+    down_slot = 3 if bottleneck else 2
+    stage_base = [sum(counts[:s]) for s in range(len(counts))]
+
+    def rename(key: str) -> str | None:
+        parts = key.split(".")
+        if parts[0] == "fc" or parts[-1] == "num_batches_tracked":
+            return None
+        if parts[0] == "conv1":
+            return f"{prefix}.Conv_0.{parts[1]}"
+        if parts[0] == "bn1":
+            return f"{prefix}.BatchNorm_0.{parts[1]}"
+        if parts[0].startswith("layer"):
+            stage = int(parts[0][len("layer"):]) - 1
+            flat = stage_base[stage] + int(parts[1])
+            mod = f"{prefix}.{block}_{flat}"
+            if parts[2] == "downsample":
+                kind = "Conv" if parts[3] == "0" else "BatchNorm"
+                return f"{mod}.{kind}_{down_slot}.{parts[4]}"
+            if parts[2].startswith("conv"):
+                return f"{mod}.Conv_{int(parts[2][4:]) - 1}.{parts[3]}"
+            if parts[2].startswith("bn"):
+                return f"{mod}.BatchNorm_{int(parts[2][2:]) - 1}.{parts[3]}"
+        return key  # unknown keys surface through allow_unused
+
+    return rename
+
+
+def inflate_stem_channels(state_dict: Mapping[str, np.ndarray],
+                          in_channels: int,
+                          key: str = "conv1.weight") -> dict:
+    """Zero-pad the stem conv's input channels (OIHW dim 1) to
+    ``in_channels`` — the standard 3->4-channel inflation for adding a
+    guidance channel to an RGB-pretrained backbone (the extra channel starts
+    contributing zero; RGB filters are untouched).  The reference's 4-channel
+    DANet stem was produced by exactly this kind of external surgery
+    (SURVEY.md §2.4)."""
+    out = dict(state_dict)
+    w = np.asarray(out[key])
+    have = w.shape[1]
+    if have > in_channels:
+        raise ValueError(f"stem has {have} input channels; cannot shrink "
+                         f"to {in_channels}")
+    if have < in_channels:
+        pad = np.zeros((w.shape[0], in_channels - have) + w.shape[2:],
+                       dtype=w.dtype)
+        out[key] = np.concatenate([w, pad], axis=1)
+    return out
+
+
+def torchvision_resnet_depth(state_dict: Mapping[str, np.ndarray]) -> int:
+    """Infer the ResNet depth a torchvision state_dict was saved from, by
+    stage block counts + block type.  Raises on unrecognized layouts —
+    importing a wrong-depth checkpoint partially would silently produce a
+    half-pretrained backbone."""
+    from ..models.resnet import BOTTLENECK_DEPTHS, RESNET_DEPTHS
+
+    counts = []
+    for s in (1, 2, 3, 4):
+        n = 0
+        while f"layer{s}.{n}.conv1.weight" in state_dict:
+            n += 1
+        counts.append(n)
+    bottleneck = any(".conv3." in k for k in state_dict)
+    for depth, c in RESNET_DEPTHS.items():
+        if (tuple(c) == tuple(counts)
+                and (depth in BOTTLENECK_DEPTHS) == bottleneck):
+            return depth
+    raise ValueError(
+        f"unrecognized torchvision ResNet layout: stage counts {counts}, "
+        f"{'bottleneck' if bottleneck else 'basic'} blocks")
