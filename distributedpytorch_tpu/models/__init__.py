@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .danet import DANet, DANetHead
-from .deeplab import ASPP, DeepLabV3, FCNHead
+from .deeplab import ASPP, DeepLabV3, FCN, FCNHead
 from .resnet import ResNet, resnet50, resnet101
 
 _BACKBONE_DEPTH = {"resnet18": 18, "resnet34": 34, "resnet50": 50,
@@ -70,8 +70,17 @@ def build_model(
             bn_cross_replica_axis=bn_cross_replica_axis,
             **kw,
         )
+    if name == "fcn":
+        return FCN(
+            nclass=nclass,
+            backbone_depth=depth,
+            output_stride=output_stride or 8,
+            dtype=dtype,
+            bn_cross_replica_axis=bn_cross_replica_axis,
+            **kw,
+        )
     raise ValueError(
-        f"unknown model: {name!r} (danet | deeplabv3 | deeplabv3plus)")
+        f"unknown model: {name!r} (danet | deeplabv3 | deeplabv3plus | fcn)")
 
 
 __all__ = [
@@ -79,6 +88,7 @@ __all__ = [
     "DANet",
     "DANetHead",
     "DeepLabV3",
+    "FCN",
     "FCNHead",
     "ResNet",
     "build_model",

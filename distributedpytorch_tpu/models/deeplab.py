@@ -111,6 +111,47 @@ class DecoderV3Plus(nn.Module):
         return y
 
 
+class FCN(nn.Module):
+    """Fully-convolutional network (Long et al., CVPR'15, the torchvision
+    ``fcn_resnet50/101`` structure): dilated ResNet + FCNHead on c4,
+    bilinear upsample to input resolution.  ``__call__(x, train)`` ->
+    (logits,) or (logits, aux_logits).
+
+    The smallest member of the model zoo — same backbone (so torchvision's
+    ImageNet checkpoints warm-start it via ``checkpoint.warm_start``), no
+    ASPP/attention context module; the accuracy-per-FLOP baseline the
+    fancier heads are judged against."""
+
+    nclass: int = 21
+    backbone_depth: int = 50
+    output_stride: int = 8     # torchvision dilates stages 3+4
+    aux_head: bool = False
+    dtype: jnp.dtype = jnp.float32
+    bn_cross_replica_axis: str | None = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        feats = ResNet(
+            depth=self.backbone_depth,
+            output_stride=self.output_stride,
+            dtype=self.dtype,
+            bn_cross_replica_axis=self.bn_cross_replica_axis,
+            remat=self.remat,
+            name="backbone",
+        )(x, train=train)
+        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
+        y = FCNHead(nclass=self.nclass, norm=norm, dtype=self.dtype,
+                    name="head")(feats["c4"], train=train)
+        outs = [_resize_bilinear(y, size)]
+        if self.aux_head:
+            aux = FCNHead(nclass=self.nclass, norm=norm, dtype=self.dtype,
+                          name="aux")(feats["c3"], train=train)
+            outs.append(_resize_bilinear(aux, size))
+        return tuple(outs)
+
+
 class DeepLabV3(nn.Module):
     """Dilated ResNet + ASPP; ``__call__(x, train)`` -> (logits,) or
     (logits, aux_logits) at input resolution."""

@@ -392,6 +392,28 @@ class Trainer:
         params, stats = torch_state_dict_to_params(
             sd, as_struct(self.state.params), as_struct(self.state.batch_stats),
             rename=rename, allow_missing=partial, allow_unused=partial)
+        if rename is not None:
+            # Torchvision mode forces partial (the seg head isn't in a
+            # classification checkpoint), but the BACKBONE must import
+            # completely — width variants (wide_resnet, resnext) share a
+            # plain resnet's layer counts and would otherwise fall through
+            # the shape-mismatch path leaf by leaf, leaving a silently
+            # half-pretrained backbone.
+            from flax.traverse_util import flatten_dict
+            missing = [
+                ".".join(p)
+                for tree in (params.get("backbone", {}),
+                             stats.get("backbone", {}))
+                for p, v in flatten_dict(tree).items()
+                if isinstance(v, jax.ShapeDtypeStruct)
+            ]
+            if missing:
+                raise ValueError(
+                    f"torchvision import left {len(missing)} backbone "
+                    f"leaves at fresh init (e.g. backbone.{missing[0]}): "
+                    f"tensor shapes in {path} do not match a plain "
+                    f"resnet{torchvision_resnet_depth(sd)} (wide_resnet / "
+                    "resnext variants are not supported)")
 
         imported = [0, 0]  # [loaded from checkpoint, kept template]
 

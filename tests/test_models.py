@@ -5,7 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributedpytorch_tpu.models import DANet, DeepLabV3, ResNet, build_model
+from distributedpytorch_tpu.models import (DANet, DeepLabV3, FCN,
+                                           ResNet, build_model)
 
 
 def init_and_apply(model, x, train=False):
@@ -114,7 +115,39 @@ class TestDeepLabV3:
         assert low.shape[-1] == 48  # the standard low-level projection width
 
 
+class TestFCN:
+    def test_primary_output(self):
+        m = FCN(nclass=21, backbone_depth=18, output_stride=8)
+        x = jnp.zeros((1, 64, 64, 3))
+        variables, out = init_and_apply(m, x)
+        assert isinstance(out, tuple) and len(out) == 1
+        assert out[0].shape == (1, 64, 64, 21)
+        # FCNHead only — no ASPP/attention context module
+        assert set(variables["params"]) == {"backbone", "head"}
+
+    def test_aux_head(self):
+        m = FCN(nclass=21, backbone_depth=18, aux_head=True)
+        x = jnp.zeros((1, 64, 64, 3))
+        _, out = init_and_apply(m, x)
+        assert len(out) == 2
+        assert out[1].shape == (1, 64, 64, 21)
+
+    def test_torchvision_backbone_warm_start_fits(self):
+        """The importer's naming bridge reaches FCN's backbone too."""
+        from distributedpytorch_tpu.utils.torch_interop import (
+            params_to_torch_state_dict,
+        )
+        m = FCN(nclass=21, backbone_depth=18)
+        variables, _ = init_and_apply(m, jnp.zeros((1, 64, 64, 3)))
+        keys = params_to_torch_state_dict(variables["params"]).keys()
+        assert any(k.startswith("backbone.BasicBlock_0.Conv_0") for k in keys)
+
+
 class TestFactory:
+    def test_build_fcn(self):
+        m = build_model("fcn", nclass=21, backbone="resnet50")
+        assert isinstance(m, FCN) and m.output_stride == 8
+
     def test_build_danet(self):
         m = build_model("danet", nclass=1, backbone="resnet101")
         assert isinstance(m, DANet) and m.output_stride == 8

@@ -102,6 +102,25 @@ class TestSemanticTrainerEndToEnd:
         tr.close()
 
 
+class TestFCNSemantic:
+    def test_fit_fcn_semantic(self, tmp_path):
+        cfg = apply_overrides(Config(), [
+            "task=semantic", "data.fake=true", "data.train_batch=4",
+            "data.val_batch=2", "data.crop_size=[64,64]",
+            "mesh.data=4", "mesh.model=2",
+            "model.name=fcn", "model.nclass=21",
+            "model.backbone=resnet18", "model.in_channels=3",
+            "optim.lr=0.001", "checkpoint.async_save=false",
+            "epochs=1", "eval_every=1",
+        ])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        tr = Trainer(cfg)
+        hist = tr.fit()
+        assert np.isfinite(hist["train_loss"][0])
+        assert 0.0 <= hist["val"][-1]["miou"] <= 1.0
+        tr.close()
+
+
 class TestSemanticDeviceAugment:
     def test_fit_semantic_with_device_augment(self, tmp_path):
         import dataclasses

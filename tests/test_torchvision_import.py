@@ -223,3 +223,31 @@ class TestDepthInference:
         with pytest.raises(ValueError, match="unrecognized"):
             torchvision_resnet_depth(
                 {"layer1.0.conv1.weight": np.zeros((1,))})
+
+
+class TestWidthVariants:
+    def test_widened_checkpoint_rejected(self, tmp_path):
+        # wide_resnet/resnext share a plain resnet's stage counts; their
+        # widened tensors must be refused, not silently part-imported
+        torch = pytest.importorskip("torch")
+        from distributedpytorch_tpu.train import (
+            Config,
+            Trainer,
+            apply_overrides,
+        )
+
+        _, _, _, tv = model_and_tv_sd("resnet18")
+        w = np.asarray(tv["layer1.0.conv1.weight"])
+        tv["layer1.0.conv1.weight"] = np.concatenate([w, w], axis=0)
+        pth = os.path.join(str(tmp_path), "wide.pth")
+        torch.save({k: torch.tensor(np.asarray(v)) for k, v in tv.items()},
+                   pth)
+        cfg = apply_overrides(Config(), {
+            "data.fake": True, "data.train_batch": 8, "data.val_batch": 2,
+            "data.crop_size": (64, 64), "data.area_thres": 0,
+            "model.backbone": "resnet18", "model.output_stride": 8,
+            "checkpoint.async_save": False,
+            "checkpoint.warm_start": pth})
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        with pytest.raises(ValueError, match="not supported"):
+            Trainer(cfg)
