@@ -143,6 +143,11 @@ class Config:
     epochs: int = 100
     eval_every: int = 1                 # nTestInterval (train_pascal.py:62)
     eval_thresholds: tuple[float, ...] = (0.3, 0.5, 0.8)
+    eval_tta_scales: tuple[float, ...] = ()  # semantic TTA: average softmax
+                                        # probs over these input scales
+                                        # (1.0 = the base pass)
+    eval_tta_flip: bool = False         # semantic TTA: also average the
+                                        # horizontal flip
     seed: int = 0
     work_dir: str = "runs"              # run_<N> dirs created under this
     resume: str | None = None           # checkpoint dir to resume from, or
@@ -178,7 +183,8 @@ def _from_dict(cls, d: dict):
                 and isinstance(v, dict):
             v = _from_dict(ftype, v)
         elif f.name in ("crop_size", "rots", "scales", "loss_weights",
-                        "eval_thresholds", "freeze") and isinstance(v, list):
+                        "eval_thresholds", "eval_tta_scales",
+                        "freeze") and isinstance(v, list):
             v = tuple(v)
         kwargs[f.name] = v
     return cls(**kwargs)
@@ -213,7 +219,8 @@ def from_json(source: str) -> Config:
     for f in dataclasses.fields(Config):
         if f.name not in kwargs:
             kwargs[f.name] = getattr(base, f.name)
-        elif f.name in ("eval_thresholds",) and isinstance(kwargs[f.name], list):
+        elif f.name in ("eval_thresholds", "eval_tta_scales") \
+                and isinstance(kwargs[f.name], list):
             kwargs[f.name] = tuple(kwargs[f.name])
     return Config(**kwargs)
 

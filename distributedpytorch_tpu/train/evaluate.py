@@ -190,6 +190,8 @@ def evaluate_semantic(
     ignore_index: int = 255,
     mesh=None,
     max_batches: int | None = None,
+    tta_scales: tuple[float, ...] = (),
+    tta_flip: bool = False,
 ) -> dict:
     """Multi-class semantic validation: confusion-matrix mIoU.
 
@@ -198,34 +200,105 @@ def evaluate_semantic(
     (one bincount — no NxC transfers); the (C, C) counts accumulate on host
     and reduce across processes, so the protocol is multi-host-safe the same
     way :func:`evaluate` is.
+
+    ``tta_scales``/``tta_flip``: the standard DeepLab test-time-augmentation
+    protocol — softmax probabilities averaged over the listed input scales
+    (each a fixed shape, so each costs exactly one extra compiled program),
+    with ``tta_flip`` adding the horizontal flip AT EVERY scale; argmax of
+    the average.  The votes are exactly scales x flips as configured (a list
+    omitting 1.0 does not vote the base pass); ``loss`` always reports the
+    plain single-scale pass.  Empty/false = the plain protocol, on the
+    unchanged fast path (device-side argmax, no NxC transfer).
     """
     import jax.numpy as jnp
 
+    from .. import imaging
     from ..ops.metrics import miou_from_confusion
+    from ..utils.helpers import fixed_resize
 
+    if len(set(tta_scales)) != len(tta_scales):
+        raise ValueError(f"duplicate tta_scales {tta_scales} would "
+                         "double-weight votes")
     n_dev = mesh.devices.size if mesh is not None else 1
+    tta = bool(tta_flip or any(s != 1.0 for s in tta_scales))
+    scale_list = list(tta_scales) if tta_scales else [1.0]
     conf = np.zeros((nclass, nclass), np.int64)
     loss_sum, n_batches = 0.0, 0
     t0 = time.perf_counter()
+
+    def forward_probs(inp: np.ndarray, gt: np.ndarray):
+        """One padded+sharded eval pass -> (softmax probs for the n real
+        rows, loss).  Softmax runs on device; one D2H transfer."""
+        padded, _ = pad_to_multiple({INPUT_KEY: inp, "crop_gt": gt}, n_dev)
+        if mesh is not None:
+            padded = shard_batch(mesh, padded)
+        outputs, loss = eval_step(state, padded)
+        probs = jax.nn.softmax(
+            jnp.asarray(outputs[0]).astype(jnp.float32), axis=-1)
+        return _local_rows(probs)[: inp.shape[0]], loss
+
     for bi, batch in enumerate(loader):
         if max_batches is not None and bi >= max_batches:
             break
         n = batch[INPUT_KEY].shape[0]
-        device_keys = {k: v for k, v in batch.items()
-                       if k in (INPUT_KEY, "crop_gt")}
-        padded, _ = pad_to_multiple(device_keys, n_dev)
-        if mesh is not None:
-            padded = shard_batch(mesh, padded)
-        outputs, loss = eval_step(state, padded)
+        if not tta:
+            device_keys = {k: v for k, v in batch.items()
+                           if k in (INPUT_KEY, "crop_gt")}
+            padded, _ = pad_to_multiple(device_keys, n_dev)
+            if mesh is not None:
+                padded = shard_batch(mesh, padded)
+            outputs, loss = eval_step(state, padded)
+            loss_sum += float(loss)
+            n_batches += 1
+            # Padding repeats real samples; drop them from the counts by
+            # scoring only the first n rows (host-local multi-host).
+            out0 = _local_rows(outputs[0])[:n]
+            labels = _local_rows(padded["crop_gt"])[:n]
+            conf += np.asarray(_batch_confusion(
+                jnp.asarray(out0), jnp.asarray(labels), nclass,
+                ignore_index), np.int64)
+            continue
+
+        inp = np.asarray(batch[INPUT_KEY])
+        gt = np.asarray(batch["crop_gt"])
+        h, w = inp.shape[1:3]
+        # the plain pass always runs — it is THE reported loss; it votes
+        # only if 1.0 is a configured scale
+        base_probs, loss = forward_probs(inp, gt)
         loss_sum += float(loss)
         n_batches += 1
-        # Padding repeats real samples; drop them from the counts by scoring
-        # only the first n rows (host-local in the multi-host case).
-        out0 = _local_rows(outputs[0])[:n]
-        labels = _local_rows(padded["crop_gt"])[:n]
+        probs = np.zeros_like(base_probs)
+        votes = 0
+        for s in scale_list:
+            if s == 1.0:
+                inp_s, gt_s = inp, gt
+                p = base_probs
+            else:
+                hs, ws = max(1, round(h * s)), max(1, round(w * s))
+                inp_s = np.stack([
+                    fixed_resize(im, (hs, ws), flagval=imaging.LINEAR)
+                    for im in inp])
+                gt_s = np.stack([
+                    fixed_resize(g, (hs, ws), flagval=imaging.NEAREST)
+                    for g in gt])
+                p_s, _ = forward_probs(inp_s, gt_s)
+                p = np.stack([
+                    fixed_resize(pp, (h, w), flagval=imaging.LINEAR)
+                    for pp in p_s])
+            probs += p
+            votes += 1
+            if tta_flip:
+                p_f, _ = forward_probs(inp_s[:, :, ::-1], gt_s[:, :, ::-1])
+                p_f = p_f[:, :, ::-1]
+                if s != 1.0:
+                    p_f = np.stack([
+                        fixed_resize(pp, (h, w), flagval=imaging.LINEAR)
+                        for pp in p_f])
+                probs += p_f
+                votes += 1
         conf += np.asarray(_batch_confusion(
-            jnp.asarray(out0), jnp.asarray(labels), nclass, ignore_index),
-            np.int64)
+            jnp.asarray(probs / votes), jnp.asarray(gt), nclass,
+            ignore_index), np.int64)
 
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils

@@ -102,6 +102,12 @@ class Trainer:
                 "multi-class")
         if cfg.data.echo < 1:
             raise ValueError(f"data.echo must be >= 1, got {cfg.data.echo}")
+        if (cfg.eval_tta_scales or cfg.eval_tta_flip) \
+                and cfg.task != "semantic":
+            raise ValueError(
+                "eval_tta_scales/eval_tta_flip apply to the semantic task "
+                "only (the instance protocol is the reference's fixed "
+                "threshold sweep)")
 
         # --- mesh
         self.mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
@@ -578,7 +584,9 @@ class Trainer:
             if self.cfg.task == "semantic":
                 metrics = evaluate_semantic(
                     self.eval_step, self.state, self.val_loader,
-                    nclass=self.cfg.model.nclass, mesh=self.mesh)
+                    nclass=self.cfg.model.nclass, mesh=self.mesh,
+                    tta_scales=self.cfg.eval_tta_scales,
+                    tta_flip=self.cfg.eval_tta_flip)
             else:
                 metrics = evaluate(
                     self.eval_step, self.state, self.val_loader,

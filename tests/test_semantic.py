@@ -149,3 +149,134 @@ class TestSemanticDeviceAugment:
         import numpy as np
         assert np.isfinite(hist["train_loss"][0])
         assert 0.0 <= hist["val"][-1]["miou"] <= 1.0
+
+
+class TestSemanticTTA:
+    """Multi-scale + flip test-time augmentation (evaluate_semantic)."""
+
+    def _trained(self, tmp_path, overrides=()):
+        cfg = apply_overrides(Config(), [
+            "task=semantic", "data.fake=true", "data.train_batch=4",
+            "data.val_batch=2", "data.crop_size=[64,64]",
+            "mesh.data=4", "mesh.model=2",
+            "model.name=deeplabv3", "model.nclass=21",
+            "model.backbone=resnet18", "model.in_channels=3",
+            "checkpoint.async_save=false", "epochs=1", "eval_every=0",
+            *overrides,
+        ])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        return Trainer(cfg)
+
+    def test_trivial_tta_matches_base_exactly(self, tmp_path):
+        # scales (1.0,) + no flip adds zero extra passes: argmax of the
+        # softmax equals argmax of the logits, so the confusion matrix (and
+        # mIoU) must be IDENTICAL to the fast path
+        from distributedpytorch_tpu.train.evaluate import evaluate_semantic
+
+        tr = self._trained(tmp_path)
+        base = evaluate_semantic(tr.eval_step, tr.state, tr.val_loader,
+                                 nclass=21, mesh=tr.mesh)
+        triv = evaluate_semantic(tr.eval_step, tr.state, tr.val_loader,
+                                 nclass=21, mesh=tr.mesh,
+                                 tta_scales=(1.0,), tta_flip=False)
+        np.testing.assert_array_equal(base["per_class_iou"],
+                                      triv["per_class_iou"])
+        assert base["miou"] == triv["miou"]
+        tr.close()
+
+    def test_full_tta_runs_and_scores(self, tmp_path):
+        from distributedpytorch_tpu.train.evaluate import evaluate_semantic
+
+        tr = self._trained(tmp_path)
+        m = evaluate_semantic(tr.eval_step, tr.state, tr.val_loader,
+                              nclass=21, mesh=tr.mesh,
+                              tta_scales=(0.5, 1.0, 1.5), tta_flip=True)
+        assert 0.0 <= m["miou"] <= 1.0
+        assert np.isfinite(m["loss"])
+        tr.close()
+
+    def test_flip_plumbing_unflips(self):
+        # Stub model: logits depend on the input's horizontal position, so a
+        # correct flip TTA (flip input, flip probs back) must agree with the
+        # base pass; forgetting the un-flip would disagree on every column.
+        from distributedpytorch_tpu.train.evaluate import evaluate_semantic
+
+        w = 8
+        ramp = np.tile(np.arange(w, dtype=np.float32), (1, w, 1))[..., None]
+
+        def eval_step(state, batch):
+            x = np.asarray(batch["concat"])  # (N,H,W,1)
+            logits = np.concatenate([x, -x], axis=-1)  # class1 right of mid
+            return (jnp.asarray(logits),), jnp.float32(0.0)
+
+        import jax.numpy as jnp
+        batch = {"concat": ramp, "crop_gt": (ramp[..., 0] > w / 2
+                                             ).astype(np.float32)}
+        base = evaluate_semantic(eval_step, None, [batch], nclass=2)
+        flip = evaluate_semantic(eval_step, None, [batch], nclass=2,
+                                 tta_flip=True)
+        np.testing.assert_array_equal(base["per_class_iou"],
+                                      flip["per_class_iou"])
+
+    def test_e2e_trainer_with_tta(self, tmp_path):
+        tr = self._trained(tmp_path, overrides=(
+            "eval_tta_scales=[0.5,1.0]", "eval_tta_flip=true",
+            "eval_every=1"))
+        hist = tr.fit()
+        assert 0.0 <= hist["val"][-1]["miou"] <= 1.0
+        tr.close()
+
+    def test_instance_task_rejects_tta(self, tmp_path):
+        cfg = apply_overrides(Config(), [
+            "data.fake=true", "eval_tta_flip=true",
+        ])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        with pytest.raises(ValueError, match="semantic task"):
+            Trainer(cfg)
+
+
+class TestTTAPassStructure:
+    """The vote set is exactly scales x flips; the base pass is loss-only
+    unless 1.0 is listed."""
+
+    def _counting_step(self):
+        import jax.numpy as jnp
+        calls = []
+
+        def eval_step(state, batch):
+            x = np.asarray(batch["concat"])
+            calls.append(x.shape[1:3])
+            logits = np.concatenate([x, -x], axis=-1)
+            return (jnp.asarray(logits),), jnp.float32(0.0)
+
+        return eval_step, calls
+
+    def _batch(self, w=8):
+        ramp = np.tile(np.arange(w, dtype=np.float32), (1, w, 1))[..., None]
+        return {"concat": ramp,
+                "crop_gt": (ramp[..., 0] > w / 2).astype(np.float32)}
+
+    def test_scale_list_without_base_runs_loss_pass_unvoted(self):
+        from distributedpytorch_tpu.train.evaluate import evaluate_semantic
+
+        step, calls = self._counting_step()
+        evaluate_semantic(step, None, [self._batch()], nclass=2,
+                          tta_scales=(0.5,))
+        # base (loss-only) + the single 0.5x vote
+        assert calls == [(8, 8), (4, 4)]
+
+    def test_flip_applies_at_every_scale(self):
+        from distributedpytorch_tpu.train.evaluate import evaluate_semantic
+
+        step, calls = self._counting_step()
+        evaluate_semantic(step, None, [self._batch()], nclass=2,
+                          tta_scales=(0.5, 1.0), tta_flip=True)
+        # base (reused as the 1.0 vote) + 1.0-flip + 0.5 + 0.5-flip
+        assert sorted(calls) == sorted([(8, 8), (8, 8), (4, 4), (4, 4)])
+
+    def test_duplicate_scales_rejected(self):
+        from distributedpytorch_tpu.train.evaluate import evaluate_semantic
+
+        with pytest.raises(ValueError, match="duplicate"):
+            evaluate_semantic(lambda s, b: None, None, [], nclass=2,
+                              tta_scales=(1.0, 1.0))
