@@ -16,12 +16,14 @@ from .config import (
 )
 from .evaluate import batch_debug_asserts, evaluate, evaluate_semantic
 from .logging import (
+    CometWriter,
     ConsoleWriter,
     JsonlWriter,
     MetricWriter,
     MultiWriter,
     TensorBoardWriter,
     make_val_panels,
+    make_writer,
 )
 from .optim import make_optimizer, make_param_labeler, make_schedule
 from .preemption import PreemptionGuard
@@ -31,6 +33,7 @@ __all__ = [
     "CheckpointConfig",
     "CheckpointManager",
     "Config",
+    "CometWriter",
     "ConsoleWriter",
     "DataConfig",
     "JsonlWriter",
@@ -52,6 +55,7 @@ __all__ = [
     "make_param_labeler",
     "make_schedule",
     "make_val_panels",
+    "make_writer",
     "latest_checkpoint_dir",
     "next_run_dir",
     "to_json",

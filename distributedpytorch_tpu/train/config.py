@@ -156,6 +156,11 @@ class Config:
     debug_asserts: bool = False         # data-contract checks (…:188-190)
     log_every_steps: int = 50
     experiment_name: str = "experiment"
+    log_writers: tuple[str, ...] = ("console", "jsonl")
+                                        # console | jsonl | tensorboard |
+                                        # comet (key from COMET_API_KEY)
+    comet_project: str = ""             # reference used 'Attention' (:41)
+    comet_workspace: str = ""
     profile_epoch: int | None = None    # XPlane-trace this epoch (0-based)
 
 
@@ -219,7 +224,8 @@ def from_json(source: str) -> Config:
     for f in dataclasses.fields(Config):
         if f.name not in kwargs:
             kwargs[f.name] = getattr(base, f.name)
-        elif f.name in ("eval_thresholds", "eval_tta_scales") \
+        elif f.name in ("eval_thresholds", "eval_tta_scales",
+                        "log_writers") \
                 and isinstance(kwargs[f.name], list):
             kwargs[f.name] = tuple(kwargs[f.name])
     return Config(**kwargs)

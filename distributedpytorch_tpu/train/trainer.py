@@ -59,11 +59,10 @@ from .checkpoint import (
 )
 from .evaluate import batch_debug_asserts, evaluate, evaluate_semantic
 from .logging import (
-    ConsoleWriter,
-    JsonlWriter,
     MetricWriter,
     MultiWriter,
     make_val_panels,
+    make_writer,
 )
 from .optim import make_optimizer
 from .preemption import PreemptionGuard
@@ -86,8 +85,12 @@ class Trainer:
         if writers is not None:
             self.writer = writers
         elif self.is_main:
-            self.writer = MultiWriter(ConsoleWriter(),
-                                      JsonlWriter(self.run_dir))
+            self.writer = MultiWriter(*[
+                make_writer(name, self.run_dir,
+                            experiment_name=cfg.experiment_name,
+                            comet_project=cfg.comet_project or None,
+                            comet_workspace=cfg.comet_workspace or None)
+                for name in cfg.log_writers])
         else:
             self.writer = MetricWriter()  # no-op on non-main hosts
 
