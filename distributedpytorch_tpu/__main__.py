@@ -50,6 +50,9 @@ def main(argv: list[str] | None = None) -> int:
                         help="output mask PNG for --predict")
     parser.add_argument("--overlay",
                         help="also write an RGB overlay PNG (--predict)")
+    parser.add_argument("--slide", action="store_true",
+                        help="semantic runs: sliding-window full-resolution "
+                             "inference instead of whole-image resize")
     parser.add_argument("--threshold", type=float, default=None,
                         help="binarization threshold for --predict on "
                              "instance-task runs (default 0.5)")
@@ -77,7 +80,8 @@ def main(argv: list[str] | None = None) -> int:
         try:
             summary = predict_cli(args.run_dir, args.predict, args.points,
                                   args.out, threshold=args.threshold,
-                                  overlay_path=args.overlay)
+                                  overlay_path=args.overlay,
+                                  slide=args.slide)
         except ValueError as e:  # missing points / bad clicks / wrong task
             parser.error(str(e))
         print(summary)

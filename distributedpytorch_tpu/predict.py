@@ -435,7 +435,12 @@ class SemanticPredictor:
             # the (H, W, C) logits.
             return jnp.argmax(outputs[0], axis=-1).astype(jnp.int32)
 
+        def forward_probs(x):
+            outputs = _apply_with_normalize(model, variables, mean, std, x)
+            return jax.nn.softmax(outputs[0].astype(jnp.float32), axis=-1)
+
         self._forward = jax.jit(forward)
+        self._forward_probs = jax.jit(forward_probs)
 
     @classmethod
     def from_run(cls, run_dir: str, best: bool = True, cfg=None,
@@ -451,8 +456,19 @@ class SemanticPredictor:
         kwargs.setdefault("resolution", tuple(cfg.data.crop_size))
         return cls(model, state.params, state.batch_stats, **kwargs)
 
-    def predict(self, image: np.ndarray) -> np.ndarray:
+    def predict(self, image: np.ndarray, mode: str = "resize",
+                overlap: float = 0.5) -> np.ndarray:
         """(H, W, 3) RGB in [0, 255] -> (H, W) class-id map.
+
+        ``mode='resize'`` (default): squeeze the whole image to the training
+        resolution and nearest-resize the class map back — the eval
+        pipeline's protocol, one forward.  ``mode='slide'``: tile the image
+        at native resolution with training-crop-sized windows (stride =
+        ``(1 - overlap) * crop``), average the softmax probabilities where
+        windows overlap, argmax once — the standard full-resolution protocol
+        for images larger than the crop.  Every window is the same fixed
+        shape, so sliding costs ONE compiled program regardless of image
+        size.
 
         uint8 when the model's class count fits (the PNG-writable common
         case); int32 otherwise — never a silent modulo-256 wrap."""
@@ -460,13 +476,42 @@ class SemanticPredictor:
         if image.ndim != 3 or image.shape[-1] != 3:
             raise ValueError(f"expected (H, W, 3) RGB image, got "
                              f"{image.shape}")
-        resized = imaging.resize(np.clip(image, 0.0, 255.0),
-                                 self.resolution, imaging.CUBIC)
-        classes = np.asarray(self._forward(resized[None]))[0]
-        full = imaging.resize(classes.astype(np.float32), image.shape[:2],
-                              imaging.NEAREST)
         dtype = np.uint8 if self.model.nclass <= 256 else np.int32
-        return full.astype(dtype)
+        if mode == "resize":
+            resized = imaging.resize(np.clip(image, 0.0, 255.0),
+                                     self.resolution, imaging.CUBIC)
+            classes = np.asarray(self._forward(resized[None]))[0]
+            full = imaging.resize(classes.astype(np.float32),
+                                  image.shape[:2], imaging.NEAREST)
+            return full.astype(dtype)
+        if mode != "slide":
+            raise ValueError(f"unknown mode {mode!r} (resize | slide)")
+        if not 0.0 <= overlap < 1.0:
+            raise ValueError(f"overlap must be in [0, 1), got {overlap}")
+        ch, cw = self.resolution
+        h, w = image.shape[:2]
+        hp, wp = max(h, ch), max(w, cw)
+        padded = np.zeros((hp, wp, 3), np.float32)
+        padded[:h, :w] = np.clip(image, 0.0, 255.0)
+
+        def starts(full: int, crop: int, stride: int) -> list[int]:
+            s = list(range(0, full - crop + 1, stride))
+            if s[-1] != full - crop:  # final window flush to the edge
+                s.append(full - crop)
+            return s
+
+        sh = max(1, int(ch * (1.0 - overlap)))
+        sw = max(1, int(cw * (1.0 - overlap)))
+        probs = np.zeros((hp, wp, self.model.nclass), np.float32)
+        for y in starts(hp, ch, sh):
+            for x in starts(wp, cw, sw):
+                win = padded[y:y + ch, x:x + cw]
+                p = np.asarray(self._forward_probs(win[None]))[0]
+                probs[y:y + ch, x:x + cw] += p
+        # summed probs suffice: the per-pixel hit count is a positive scalar
+        # across the class axis, so dividing by it cannot change the argmax
+        classes = np.argmax(probs, axis=-1)
+        return classes[:h, :w].astype(dtype)
 
 
 def parse_points(spec: str) -> np.ndarray:
@@ -484,7 +529,8 @@ def parse_points(spec: str) -> np.ndarray:
 
 def predict_cli(run_dir: str, image_path: str, points_spec: str | None,
                 out_path: str, threshold: float | None = None,
-                overlay_path: str | None = None) -> dict:
+                overlay_path: str | None = None,
+                slide: bool = False) -> dict:
     """The ``--predict`` CLI body; dispatches on the run's task.
 
     Instance runs need ``points_spec`` (the 4 clicks) and write a binary
@@ -511,13 +557,18 @@ def predict_cli(run_dir: str, image_path: str, points_spec: str | None,
             raise ValueError(
                 "this run is task='semantic' (whole-image class map): "
                 "--points/--threshold do not apply")
-        classes = SemanticPredictor.from_run(run_dir, cfg=cfg).predict(image)
+        classes = SemanticPredictor.from_run(run_dir, cfg=cfg).predict(
+            image, mode="slide" if slide else "resize")
         Image.fromarray(classes).save(out_path)
         write_overlay(classes > 0)
         present = {int(c): int(n) for c, n in
                    zip(*np.unique(classes, return_counts=True))}
-        return {"task": "semantic", "classes": present, "out": out_path}
+        return {"task": "semantic", "classes": present, "out": out_path,
+                "mode": "slide" if slide else "resize"}
 
+    if slide:
+        raise ValueError("this run is task='instance' (click-guided crop "
+                         "inference): --slide does not apply")
     if not points_spec:
         raise ValueError("this run is task='instance': --points (the 4 "
                          "extreme-point clicks) is required")

@@ -160,14 +160,27 @@ class CometWriter(MetricWriter):
         except Exception as e:
             print(f"CometWriter disabled: {e}", flush=True)
 
+    #: consecutive runtime failures tolerated before giving up on the SDK
+    _MAX_FAILS = 5
+
     def _guarded(self, call) -> None:
         """A live-experiment SDK/network error must degrade, not abort the
-        training run (the 'never kills a run' contract of __init__)."""
+        training run (the 'never kills a run' contract of __init__).
+        Transient blips are survived; only _MAX_FAILS consecutive errors
+        disable the writer (a permanently dead uplink should not print
+        per-step tracebacks forever)."""
         try:
             call()
+            self._fails = 0
         except Exception as e:
-            print(f"CometWriter error (disabled): {e}", flush=True)
-            self._exp = None
+            self._fails = getattr(self, "_fails", 0) + 1
+            if self._fails >= self._MAX_FAILS:
+                print(f"CometWriter error (disabled after "
+                      f"{self._fails} consecutive failures): {e}",
+                      flush=True)
+                self._exp = None
+            else:
+                print(f"CometWriter error (will retry): {e}", flush=True)
 
     def scalars(self, metrics, step):
         if self._exp:

@@ -149,3 +149,38 @@ class TestTrainerWiring:
         assert exp.figures, "val panels should reach Comet (the " \
             "reference's exp.log_figure flow)"
         assert exp.ended
+
+
+class TestCometTransientErrors:
+    def test_transient_error_retries_then_recovers(self, fake_comet, capsys):
+        w = CometWriter()
+        exp = FakeExperiment.instances[0]
+        calls = {"n": 0}
+
+        def flaky(d, step=None):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise ConnectionError("blip")
+            exp.metrics.append((dict(d), step))
+
+        exp.log_metrics = flaky
+        w.scalars({"a": 1.0}, 1)   # fails
+        w.scalars({"a": 2.0}, 2)   # fails
+        w.scalars({"a": 3.0}, 3)   # recovers
+        assert w._exp is not None, "two blips must not disable the writer"
+        assert exp.metrics == [({"a": 3.0}, 3)]
+        assert "will retry" in capsys.readouterr().out
+
+    def test_persistent_errors_disable_after_threshold(self, fake_comet,
+                                                       capsys):
+        w = CometWriter()
+        exp = FakeExperiment.instances[0]
+
+        def dead(d, step=None):
+            raise ConnectionError("down")
+
+        exp.log_metrics = dead
+        for i in range(CometWriter._MAX_FAILS):
+            w.scalars({"a": float(i)}, i)
+        assert w._exp is None
+        assert "disabled after" in capsys.readouterr().out

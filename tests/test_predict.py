@@ -452,3 +452,85 @@ class TestPredictCli:
             config_lib.to_json(cfg, str(run / "config.json"))
             with pytest.raises(ValueError, match=msg):
                 Predictor.from_run(str(run))
+
+
+class TestSlidingWindow:
+    """SemanticPredictor mode='slide': full-resolution tiled inference."""
+
+    def _predictor(self, res=64, nclass=7):
+        import jax
+
+        from distributedpytorch_tpu.models import build_model
+        from distributedpytorch_tpu.parallel import create_train_state
+        from distributedpytorch_tpu.predict import SemanticPredictor
+
+        model = build_model("deeplabv3", nclass=nclass, backbone="resnet18",
+                            output_stride=16)
+        import optax
+        state = create_train_state(jax.random.PRNGKey(0), model,
+                                   optax.sgd(1e-3), (1, res, res, 3))
+        return SemanticPredictor(model, state.params, state.batch_stats,
+                                 resolution=(res, res))
+
+    def test_crop_sized_image_matches_resize_mode(self):
+        # at exactly crop size both modes see the identical single window
+        p = self._predictor()
+        rng = np.random.default_rng(0)
+        img = rng.uniform(0, 255, (64, 64, 3)).astype(np.float32)
+        np.testing.assert_array_equal(p.predict(img, mode="resize"),
+                                      p.predict(img, mode="slide"))
+
+    def test_larger_image_full_resolution_output(self):
+        p = self._predictor()
+        rng = np.random.default_rng(1)
+        img = rng.uniform(0, 255, (96, 150, 3)).astype(np.float32)
+        out = p.predict(img, mode="slide", overlap=0.5)
+        assert out.shape == (96, 150)
+        assert out.max() < 7
+        # deterministic: same windows, same average
+        np.testing.assert_array_equal(
+            out, p.predict(img, mode="slide", overlap=0.5))
+
+    def test_smaller_image_pads_and_crops_back(self):
+        p = self._predictor()
+        img = np.random.default_rng(2).uniform(
+            0, 255, (40, 50, 3)).astype(np.float32)
+        out = p.predict(img, mode="slide")
+        assert out.shape == (40, 50)
+
+    def test_bad_mode_and_overlap_raise(self):
+        p = self._predictor()
+        img = np.zeros((64, 64, 3), np.float32)
+        with pytest.raises(ValueError, match="unknown mode"):
+            p.predict(img, mode="tiles")
+        with pytest.raises(ValueError, match="overlap"):
+            p.predict(img, mode="slide", overlap=1.0)
+
+    def test_hit_normalization_no_seams(self):
+        # stub the per-window probs with a constant one-hot: whatever the
+        # overlap pattern, the averaged argmax must be that class at every
+        # pixel — seams would mean the hit-count normalization is wrong
+        p = self._predictor()
+        onehot = np.zeros((1, 64, 64, 7), np.float32)
+        onehot[..., 3] = 1.0
+        p._forward_probs = lambda x: onehot
+        img = np.zeros((100, 130, 3), np.float32)
+        out = p.predict(img, mode="slide", overlap=0.25)
+        assert (out == 3).all()
+
+
+class TestSlideInstanceGuard:
+    def test_instance_run_rejects_slide(self, tmp_path, monkeypatch):
+        from PIL import Image
+
+        from distributedpytorch_tpu import predict as predict_mod
+        from distributedpytorch_tpu.train import Config
+
+        img_path = tmp_path / "img.png"
+        Image.fromarray(np.zeros((32, 32, 3), np.uint8)).save(img_path)
+        monkeypatch.setattr(predict_mod, "load_run_config",
+                            lambda run_dir: Config())  # task='instance'
+        with pytest.raises(ValueError, match="--slide does not apply"):
+            predict_mod.predict_cli("unused", str(img_path),
+                                    "1,1 2,2 3,3 4,4", str(tmp_path / "o.png"),
+                                    slide=True)
