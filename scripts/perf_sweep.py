@@ -54,7 +54,7 @@ SIZE = 512
 
 
 def run(batch: int, pam_impl: str, block: int | None, remat: bool,
-        os_: int = 8) -> float:
+        os_: int = 8, device_guidance: bool = False) -> float:
     mesh = make_mesh()
     n = mesh.devices.size
     model = build_model("danet", nclass=1, backbone="resnet101",
@@ -62,16 +62,23 @@ def run(batch: int, pam_impl: str, block: int | None, remat: bool,
                         pam_impl=pam_impl, pam_block_size=block, remat=remat)
     tx = optax.sgd(1e-3, momentum=0.9)
     r = np.random.RandomState(0)
+    in_ch = 3 if device_guidance else 4
     host = {
-        "concat": r.uniform(0, 255, (batch * n, SIZE, SIZE, 4)
+        "concat": r.uniform(0, 255, (batch * n, SIZE, SIZE, in_ch)
                             ).astype(np.float32),
         "crop_gt": (r.uniform(size=(batch * n, SIZE, SIZE)) > 0.7
                     ).astype(np.float32),
     }
+    augment = None
+    if device_guidance:  # the fused 4th-channel synthesis (ops/guidance_device)
+        from distributedpytorch_tpu.ops.guidance_device import (
+            make_device_guidance,
+        )
+        augment = make_device_guidance()
     with mesh:
         state = create_train_state(jax.random.PRNGKey(0), model, tx,
                                    (1, SIZE, SIZE, 4), mesh=mesh)
-        step = make_train_step(model, tx, mesh=mesh)
+        step = make_train_step(model, tx, mesh=mesh, augment=augment)
         b = shard_batch(mesh, host)
         box = [state]
 
@@ -104,6 +111,11 @@ if __name__ == "__main__":
         # and the dilated-stage activation footprint (PAM scores 1024^2
         # instead of 4096^2)
         dict(batch=8, pam_impl="einsum", block=None, remat=False, os_=16),
+        # on-device guidance synthesis fused into the step (measured
+        # 2026-07-31: 65.4 vs 66.1 plain — ~1% for a 2.3x host-pipeline
+        # rate; the host-side win is measured by scripts/bench_input.py)
+        dict(batch=8, pam_impl="einsum", block=None, remat=False,
+             device_guidance=True),
     ]
     sel = sys.argv[1:]
     for i, v in enumerate(variants):
@@ -113,6 +125,7 @@ if __name__ == "__main__":
         # dodging "os_" kwarg never leaks into the JSONL)
         rec = {k: val for k, val in v.items() if k != "os_"}
         rec["os"] = v.get("os_", 8)
+        rec["device_guidance"] = v.get("device_guidance", False)
         try:
             ips = run(**v)
             print(json.dumps({**rec, "imgs_per_sec_per_chip": round(ips, 2)}),
