@@ -52,6 +52,10 @@ def build_model(
                 raise ValueError(
                     f"{k} is DANet-only; model {name!r} does not support it")
     if name == "danet":
+        if kw.pop("aux_head", False):
+            raise ValueError("aux_head is a DeepLabV3/FCN option; DANet's "
+                             "three heads already provide multi-output "
+                             "supervision")
         return DANet(
             nclass=nclass,
             backbone_depth=depth,

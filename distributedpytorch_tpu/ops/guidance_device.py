@@ -50,7 +50,12 @@ Batch = Mapping[str, jax.Array]
 FAMILIES = ("nellipse_gaussians", "nellipse", "extreme_points",
             "confidence_l1l2", "confidence_gaussian")
 
-_BIG = jnp.int32(1 << 30)
+# Plain python int, NOT jnp.int32(...): a module-level jnp call executes a
+# primitive at import time, which initializes the default backend — on a
+# tunneled-TPU host that can block every `import distributedpytorch_tpu`
+# for minutes when the tunnel is unhealthy (observed via faulthandler).
+# Inside the jitted functions the weak int promotes to int32 as before.
+_BIG = 1 << 30
 
 
 def _side_candidates(mask: jax.Array, pert: int):

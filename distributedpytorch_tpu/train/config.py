@@ -90,6 +90,9 @@ class ModelConfig:
     moe_k: int = 1                      # top-k routing (1 = Switch)
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01        # load-balancing aux-loss weight
+    aux_head: bool = False              # DeepLabV3/FCN: auxiliary FCN head
+                                        # on c3 (second output; weight it
+                                        # via loss_weights, e.g. [1.0,0.4])
 
 
 @dataclass

@@ -1,0 +1,29 @@
+"""Importing the package must NEVER initialize the jax backend.
+
+On a tunneled-TPU host an unhealthy accelerator makes backend init block
+for minutes; every entry point (bench.py, the CLI, __graft_entry__) is
+built around probing/pinning BEFORE the first device touch.  One stray
+module-level ``jnp.<type>(...)`` constant silently breaks all of that by
+executing a primitive at import time (regression: ops/guidance_device.py
+once held ``_BIG = jnp.int32(1 << 30)``, observed hanging the CLI for the
+full tunnel-wedge duration).
+"""
+
+import subprocess
+import sys
+
+
+def test_package_import_does_not_init_backend():
+    code = (
+        "import distributedpytorch_tpu.train, distributedpytorch_tpu.ops, "
+        "distributedpytorch_tpu.parallel, distributedpytorch_tpu.predict, "
+        "distributedpytorch_tpu.data\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge.backends_are_initialized(), "
+        "'package import executed a jax primitive (module-level jnp call?)'\n"
+        "print('lazy-ok')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=240)
+    assert out.returncode == 0, out.stderr
+    assert "lazy-ok" in out.stdout

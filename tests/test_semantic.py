@@ -280,3 +280,30 @@ class TestTTAPassStructure:
         with pytest.raises(ValueError, match="duplicate"):
             evaluate_semantic(lambda s, b: None, None, [], nclass=2,
                               tta_scales=(1.0, 1.0))
+
+
+class TestAuxHead:
+    def test_fit_deeplab_with_aux_head(self, tmp_path):
+        cfg = apply_overrides(Config(), [
+            "task=semantic", "data.fake=true", "data.train_batch=4",
+            "data.val_batch=2", "data.crop_size=[64,64]",
+            "mesh.data=4", "mesh.model=2",
+            "model.name=deeplabv3", "model.nclass=21",
+            "model.backbone=resnet18", "model.in_channels=3",
+            "model.aux_head=true", "model.loss_weights=[1.0,0.4]",
+            "checkpoint.async_save=false", "epochs=1", "eval_every=1",
+        ])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        tr = Trainer(cfg)
+        # the aux FCN head exists in the param tree and trains
+        assert "aux" in tr.state.params
+        hist = tr.fit()
+        assert np.isfinite(hist["train_loss"][0])
+        tr.close()
+
+    def test_danet_rejects_aux_head(self):
+        from distributedpytorch_tpu.models import build_model
+
+        with pytest.raises(ValueError, match="aux_head"):
+            build_model("danet", nclass=1, backbone="resnet18",
+                        aux_head=True)
