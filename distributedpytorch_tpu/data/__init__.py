@@ -2,7 +2,8 @@
 
 from . import guidance, transforms
 from .combine import CombinedDataset
-from .fake import make_fake_voc
+from .fake import make_fake_sbd, make_fake_voc
+from .sbd import SBDInstanceSegmentation
 from .grain_pipeline import (GrainDataLoader, HAVE_GRAIN,
                              make_grain_loader)
 from .pipeline import (
@@ -34,6 +35,8 @@ __all__ = [
     "build_train_transform",
     "collate",
     "guidance",
+    "SBDInstanceSegmentation",
+    "make_fake_sbd",
     "make_fake_voc",
     "GrainDataLoader",
     "make_grain_loader",

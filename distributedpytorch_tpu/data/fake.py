@@ -86,3 +86,77 @@ def make_fake_voc(
     with open(os.path.join(dirs["sets"], "val.txt"), "w") as f:
         f.write("\n".join(ids[n_train:]) + "\n")
     return root
+
+
+def make_fake_sbd(
+    root: str,
+    n_images: int = 4,
+    size: tuple[int, int] = (120, 160),
+    max_objects: int = 3,
+    n_val: int = 1,
+    seed: int = 0,
+    overlap_ids: list[str] | None = None,
+) -> str:
+    """Create a fake SBD tree (benchmark_RELEASE/dataset layout, .mat
+    structs) under ``root``; returns ``root``.
+
+    ``overlap_ids`` names extra images to ALSO emit under these exact ids —
+    the SBD-overlaps-VOC-val situation the reference's ``CombineDBs``
+    exclusion list existed for (train_pascal.py:152).
+    """
+    import scipy.io
+
+    from .sbd import BASE_DIR as SBD_BASE
+
+    rng = np.random.default_rng(seed)
+    base = os.path.join(root, SBD_BASE)
+    img_dir = os.path.join(base, "img")
+    inst_dir = os.path.join(base, "inst")
+    cls_dir = os.path.join(base, "cls")
+    for d in (img_dir, inst_dir, cls_dir):
+        os.makedirs(d, exist_ok=True)
+
+    h, w = size
+    base_ids = [f"sbd_{i:06d}" for i in range(n_images)]
+    # overlap ids always land in TRAIN — they exist to exercise the
+    # CombinedDataset exclusion, which reads the train split
+    train_ids = base_ids[: n_images - n_val] + list(overlap_ids or [])
+    val_ids = base_ids[n_images - n_val:] if n_val else []
+    ids = train_ids + val_ids
+    for im_id in ids:
+        img = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+        img = cv2.GaussianBlur(img, (7, 7), 0)
+        inst = np.zeros((h, w), dtype=np.uint8)
+        cls = np.zeros((h, w), dtype=np.uint8)
+        n_obj = int(rng.integers(1, max_objects + 1))
+        cats = []
+        for obj in range(1, n_obj + 1):
+            cat = int(rng.integers(1, 21))
+            cats.append(cat)
+            shape_mask = np.zeros((h, w), dtype=np.uint8)
+            cx = int(rng.integers(w // 4, 3 * w // 4))
+            cy = int(rng.integers(h // 4, 3 * h // 4))
+            ax = int(rng.integers(max(6, w // 10), w // 3))
+            ay = int(rng.integers(max(6, h // 10), h // 3))
+            cv2.ellipse(shape_mask, (cx, cy), (ax, ay),
+                        float(rng.uniform(0, 180)), 0, 360, 1, -1)
+            inst[shape_mask == 1] = obj
+            cls[shape_mask == 1] = cat
+            ring = cv2.dilate(shape_mask, np.ones((3, 3), np.uint8)) \
+                - shape_mask
+            inst[ring == 1] = 255
+            cls[ring == 1] = 255
+
+        Image.fromarray(img).save(os.path.join(img_dir, im_id + ".jpg"))
+        # the GTinst/GTcls struct layout scipy round-trips (dict -> struct)
+        scipy.io.savemat(os.path.join(inst_dir, im_id + ".mat"),
+                         {"GTinst": {"Segmentation": inst,
+                                     "Categories": np.array(cats)}})
+        scipy.io.savemat(os.path.join(cls_dir, im_id + ".mat"),
+                         {"GTcls": {"Segmentation": cls}})
+
+    with open(os.path.join(base, "train.txt"), "w") as f:
+        f.write("\n".join(train_ids) + "\n" if train_ids else "")
+    with open(os.path.join(base, "val.txt"), "w") as f:
+        f.write("\n".join(val_ids) + "\n" if val_ids else "")
+    return root

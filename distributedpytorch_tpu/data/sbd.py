@@ -1,0 +1,175 @@
+"""SBD (Semantic Boundaries Dataset) instance-segmentation source.
+
+The reference's dataset-merge path combined VOC with SBD via ``CombineDBs``
+(reference train_pascal.py:150-154) but was dead code: ``import sbd`` stayed
+commented (:29), so ``use_sbd=True`` raised ``NameError``.  This module is
+the live SBD side of that contract — the same sample schema as
+:class:`.voc.VOCInstanceSegmentation` (one sample per (image, object);
+``{'image','gt','void_pixels','meta'}``) read from SBD's Matlab layout::
+
+    <root>/benchmark_RELEASE/dataset/
+        train.txt  val.txt
+        img/<id>.jpg
+        inst/<id>.mat     # GTinst struct: Segmentation (H,W ids), Categories
+        cls/<id>.mat      # GTcls  struct: Segmentation (class ids) [unused]
+
+so ``CombinedDataset([voc_train, sbd], excluded=[voc_val])`` finally works
+as the reference intended (SBD training images overlap VOC val — exclusion
+is load-bearing, combine.py).
+
+scipy reads the .mat structs; like everything else in the data layer the
+import is deferred so environments without scipy only pay when SBD is used.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+from PIL import Image
+
+#: the tarball's internal prefix, matching the VOC BASE_DIR convention
+BASE_DIR = os.path.join("benchmark_RELEASE", "dataset")
+
+
+def _load_mat_struct(path: str, key: str):
+    import scipy.io
+
+    return scipy.io.loadmat(path, squeeze_me=True,
+                            struct_as_record=False)[key]
+
+
+class SBDInstanceSegmentation:
+    """Instance-indexed SBD with the VOC sample contract.
+
+    Constructor surface mirrors ``VOCInstanceSegmentation`` (split(s),
+    area_thres, retname, suppress_void_pixels, per-sample ``rng``
+    pass-through to the transform); the per-image object categories come
+    from ``GTinst.Categories`` and are cached to the same JSON scheme as
+    VOC's preprocess cache (reference pascal.py:154-195 semantics).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        split="train",
+        transform=None,
+        preprocess: bool = False,
+        area_thres: int = 0,
+        retname: bool = True,
+        suppress_void_pixels: bool = True,
+        decode_cache: int = 0,
+    ):
+        self.root = root
+        self.transform = transform
+        self.area_thres = area_thres
+        self.retname = retname
+        self.suppress_void_pixels = suppress_void_pixels
+        from .voc import _DecodeCache
+        #: decode-once LRU over (jpeg, GTinst) per image — SBD is visited
+        #: once per OBJECT per epoch, same access pattern VOC caches for
+        self._cache = _DecodeCache(decode_cache) if decode_cache > 0 else None
+        self.split = sorted([split] if isinstance(split, str)
+                            else list(split))
+
+        base = os.path.join(root, BASE_DIR)
+        self._image_dir = os.path.join(base, "img")
+        self._inst_dir = os.path.join(base, "inst")
+
+        self.im_ids: list[str] = []
+        for splt in self.split:
+            with open(os.path.join(base, splt + ".txt")) as f:
+                ids = [l for l in f.read().splitlines() if l.strip()]
+            for line in ids:
+                for p in (os.path.join(self._image_dir, line + ".jpg"),
+                          os.path.join(self._inst_dir, line + ".mat")):
+                    if not os.path.isfile(p):
+                        raise FileNotFoundError(p)
+                self.im_ids.append(line)
+
+        area_suffix = f"_area_thres-{area_thres}" if area_thres else ""
+        self.obj_list_file = os.path.join(
+            base, "_".join(self.split) + "_instances" + area_suffix + ".txt")
+        if preprocess or not self._load_obj_cache():
+            self._preprocess()
+
+        self.obj_list: list[tuple[int, int]] = []
+        for ii, im_id in enumerate(self.im_ids):
+            self.obj_list.extend(
+                (ii, jj) for jj, cat in enumerate(self.obj_dict[im_id])
+                if cat != -1)
+
+    def _load_obj_cache(self) -> bool:
+        if not os.path.isfile(self.obj_list_file):
+            return False
+        with open(self.obj_list_file) as f:
+            self.obj_dict = json.load(f)
+        return sorted(self.obj_dict.keys()) == sorted(self.im_ids)
+
+    def _preprocess(self) -> None:
+        """Scan every GTinst once: object count + per-object category, with
+        the VOC area filter (objects at or under ``area_thres`` px -> -1)."""
+        self.obj_dict = {}
+        for ii, im_id in enumerate(self.im_ids):
+            gt = _load_mat_struct(
+                os.path.join(self._inst_dir, im_id + ".mat"), "GTinst")
+            inst = np.asarray(gt.Segmentation)
+            cats = np.atleast_1d(np.asarray(gt.Categories)).astype(int)
+            cat_ids = []
+            for jj, cat in enumerate(cats):
+                if int((inst == jj + 1).sum()) > self.area_thres:
+                    cat_ids.append(int(cat))
+                else:
+                    cat_ids.append(-1)
+            self.obj_dict[im_id] = cat_ids
+        with open(self.obj_list_file, "w") as f:
+            json.dump(self.obj_dict, f, indent=1)
+
+    def __len__(self) -> int:
+        return len(self.obj_list)
+
+    def sample_image_id(self, index: int) -> str:
+        """Image id owning sample ``index`` — the CombinedDataset exclusion
+        key (SBD ids are VOC-style ``2008_000123`` strings, so VOC-val
+        exclusion matches directly)."""
+        return self.im_ids[self.obj_list[index][0]]
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        im_ii, obj_ii = self.obj_list[index]
+        im_id = self.im_ids[im_ii]
+
+        def decode():
+            img8 = np.array(Image.open(os.path.join(
+                self._image_dir, im_id + ".jpg")).convert("RGB"), np.uint8)
+            gt = _load_mat_struct(
+                os.path.join(self._inst_dir, im_id + ".mat"), "GTinst")
+            return img8, np.asarray(gt.Segmentation)
+
+        img8, inst_raw = (self._cache.get(im_ii, decode)
+                          if self._cache is not None else decode())
+        # astype COPIES — cached arrays are never mutated downstream
+        img = img8.astype(np.float32)
+        inst = inst_raw.astype(np.float32)
+        void = inst == 255
+        if self.suppress_void_pixels:
+            inst = np.where(void, 0.0, inst)
+        sample = {
+            "image": img,
+            "gt": (inst == obj_ii + 1).astype(np.float32),
+            "void_pixels": void.astype(np.float32),
+        }
+        if self.retname:
+            sample["meta"] = {
+                "image": im_id,
+                "object": str(obj_ii),
+                "category": self.obj_dict[im_id][obj_ii],
+                "im_size": (img.shape[0], img.shape[1]),
+            }
+        if self.transform is not None:
+            sample = self.transform(sample, rng)
+        return sample
+
+    def __str__(self) -> str:
+        return f"SBD(split={self.split},area_thres={self.area_thres})"
