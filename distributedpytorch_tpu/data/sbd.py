@@ -23,7 +23,6 @@ import is deferred so environments without scipy only pay when SBD is used.
 
 from __future__ import annotations
 
-import json
 import os
 
 import numpy as np
@@ -101,11 +100,12 @@ class SBDInstanceSegmentation:
                 if cat != -1)
 
     def _load_obj_cache(self) -> bool:
-        if not os.path.isfile(self.obj_list_file):
+        from .voc import load_obj_cache
+        obj = load_obj_cache(self.obj_list_file, self.im_ids)
+        if obj is None:
             return False
-        with open(self.obj_list_file) as f:
-            self.obj_dict = json.load(f)
-        return sorted(self.obj_dict.keys()) == sorted(self.im_ids)
+        self.obj_dict = obj
+        return True
 
     def _preprocess(self) -> None:
         """Scan every GTinst once: object count + per-object category, with
@@ -123,8 +123,8 @@ class SBDInstanceSegmentation:
                 else:
                     cat_ids.append(-1)
             self.obj_dict[im_id] = cat_ids
-        with open(self.obj_list_file, "w") as f:
-            json.dump(self.obj_dict, f, indent=1)
+        from .voc import write_obj_cache
+        write_obj_cache(self.obj_list_file, self.obj_dict)
 
     def __len__(self) -> int:
         return len(self.obj_list)

@@ -87,6 +87,30 @@ def ensure_voc(root: str, download: bool = False) -> str:
     return voc_root
 
 
+def load_obj_cache(path: str, im_ids: list[str]) -> dict | None:
+    """Read a JSON instance cache; valid iff its key set matches ``im_ids``
+    exactly (reference pascal.py:154-161).  Tolerates a concurrently
+    half-written file (treated as absent) — see :func:`write_obj_cache`."""
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None
+    return obj if sorted(obj.keys()) == sorted(im_ids) else None
+
+
+def write_obj_cache(path: str, obj_dict: dict) -> None:
+    """Atomic JSON cache write: temp file + rename, so concurrent builders
+    (every process of a multi-host run scans on first use) can never leave
+    a truncated cache for a reader to crash on — last writer wins whole."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj_dict, f, indent=1)
+    os.replace(tmp, path)
+
+
 class _DecodeCache:
     """Thread-safe LRU of decoded images keyed by image index.
 
@@ -220,13 +244,11 @@ class VOCInstanceSegmentation:
     # -- construction helpers ------------------------------------------------
 
     def _load_obj_cache(self) -> bool:
-        """Reference pascal.py:154-161: the cache is valid iff its key set
-        matches the split's image ids exactly."""
-        if not os.path.isfile(self.obj_list_file):
+        obj = load_obj_cache(self.obj_list_file, self.im_ids)
+        if obj is None:
             return False
-        with open(self.obj_list_file) as f:
-            self.obj_dict = json.load(f)
-        return sorted(self.obj_dict.keys()) == sorted(self.im_ids)
+        self.obj_dict = obj
+        return True
 
     def _preprocess(self) -> None:
         """One-time scan: decode every instance + class PNG, area-filter each
@@ -246,8 +268,7 @@ class VOCInstanceSegmentation:
                 else:
                     cat_ids.append(-1)
             self.obj_dict[im_id] = cat_ids
-        with open(self.obj_list_file, "w") as f:
-            json.dump(self.obj_dict, f, indent=1)
+        write_obj_cache(self.obj_list_file, self.obj_dict)
 
     # -- sample access -------------------------------------------------------
 

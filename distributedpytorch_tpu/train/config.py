@@ -27,6 +27,11 @@ from typing import Any
 @dataclass
 class DataConfig:
     root: str = ""                      # dataset root (was: the mypath module)
+    sbd_root: str = ""                  # set: merge SBD into instance
+                                        # training via CombinedDataset,
+                                        # excluding VOC-val overlap (the
+                                        # reference's use_sbd recipe,
+                                        # train_pascal.py:150-154)
     fake: bool = False                  # synth fixture instead of real VOC
     download: bool = False              # fetch + MD5-verify VOC if absent
     train_split: str = "train"

@@ -111,6 +111,9 @@ class Trainer:
                 "eval_tta_scales/eval_tta_flip apply to the semantic task "
                 "only (the instance protocol is the reference's fixed "
                 "threshold sweep)")
+        if cfg.data.sbd_root and cfg.task != "instance":
+            raise ValueError("data.sbd_root merges SBD instances into the "
+                             "instance task only")
 
         # --- mesh
         self.mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
@@ -179,6 +182,17 @@ class Trainer:
                 root, split=cfg.data.val_split, transform=val_tf,
                 preprocess=True, area_thres=cfg.data.area_thres,
                 decode_cache=cfg.data.decode_cache)
+            if cfg.data.sbd_root:
+                # the reference's use_sbd recipe (train_pascal.py:150-154),
+                # live: merge SBD train, drop its VOC-val overlap
+                from ..data import CombinedDataset, SBDInstanceSegmentation
+                sbd = SBDInstanceSegmentation(
+                    cfg.data.sbd_root, split="train", transform=train_tf,
+                    preprocess=True,  # same always-rebuild policy as VOC
+                    area_thres=cfg.data.area_thres,
+                    decode_cache=cfg.data.decode_cache)
+                self.train_set = CombinedDataset(
+                    [self.train_set, sbd], excluded=[self.val_set])
         elif cfg.task == "semantic":
             self.train_set = VOCSemanticSegmentation(
                 root, split=cfg.data.train_split,

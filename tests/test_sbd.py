@@ -67,9 +67,11 @@ class TestSBDDataset:
         sbd = SBDInstanceSegmentation(sbd_root, split="train",
                                       preprocess=True, decode_cache=8)
         a = sbd[0]["image"]
+        want = a.copy()
+        a[:] = -1.0  # vandalize the returned array...
         b = sbd[0]["image"]
-        np.testing.assert_array_equal(a, b)
-        assert a is not b  # cache hands out copies, never aliases
+        # ...a fresh fetch must be untouched: the cache hands out copies
+        np.testing.assert_array_equal(b, want)
 
     def test_empty_val_split_is_empty_not_crash(self, tmp_path):
         root = make_fake_sbd(str(tmp_path / "s"), n_images=2, n_val=0,
@@ -91,6 +93,19 @@ class TestSBDDataset:
         big_only = len(SBDInstanceSegmentation(sbd_root, split="train",
                                                area_thres=10**6))
         assert big_only == 0 < all_objs
+
+    def test_cache_survives_truncated_file(self, tmp_path):
+        # a reader racing a writer (or a killed run) must rebuild, not crash
+        import os
+        root = make_fake_sbd(str(tmp_path / "s"), n_images=2, n_val=0,
+                             size=(64, 80), seed=0)
+        sbd = SBDInstanceSegmentation(root, split="train")
+        with open(sbd.obj_list_file, "w") as f:
+            f.write('{"sbd_000000": [1')  # truncated mid-dump
+        again = SBDInstanceSegmentation(root, split="train")
+        assert len(again) == len(sbd)
+        # and the rebuild repaired the file atomically
+        assert os.path.getsize(again.obj_list_file) > 20
 
     def test_str_for_param_report(self, sbd_root):
         assert "SBD(split=['train']" in str(
@@ -127,3 +142,56 @@ class TestReferenceMergeFlow:
         batch = next(iter(loader))
         assert batch["concat"].shape == (2, 64, 64, 4)
         assert np.isfinite(batch["concat"]).all()
+
+
+class TestTrainerSBDMerge:
+    def test_trainer_sbd_root_merges_and_trains(self, tmp_path):
+        import dataclasses
+
+        from distributedpytorch_tpu.train import (
+            Config,
+            Trainer,
+            apply_overrides,
+        )
+
+        voc_root = make_fake_voc(str(tmp_path / "voc"), n_images=8,
+                                 size=(96, 128), n_val=3, seed=0)
+        val_ids = VOCInstanceSegmentation(voc_root, split="val",
+                                          preprocess=True).im_ids
+        sbd_root = make_fake_sbd(str(tmp_path / "sbd"), n_images=4,
+                                 size=(96, 128), n_val=0, seed=7,
+                                 overlap_ids=[val_ids[0]])
+        cfg = apply_overrides(Config(), [
+            "data.fake=true", "data.train_batch=8", "data.val_batch=2",
+            "data.crop_size=[48,48]", "data.relax=10", "data.area_thres=0",
+            "data.num_workers=0", "model.backbone=resnet18",
+            "model.output_stride=8", "checkpoint.async_save=false",
+            "epochs=1", "eval_every=1",
+            f"data.root={voc_root}", f"data.sbd_root={sbd_root}",
+        ])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        tr = Trainer(cfg)
+        from distributedpytorch_tpu.data import CombinedDataset
+        assert isinstance(tr.train_set, CombinedDataset)
+        for i in range(len(tr.train_set)):
+            assert tr.train_set.sample_image_id(i) not in val_ids
+        hist = tr.fit()
+        assert all(np.isfinite(l) for l in hist["train_loss"])
+        tr.close()
+
+    def test_semantic_task_rejects_sbd_root(self, tmp_path):
+        import dataclasses
+
+        from distributedpytorch_tpu.train import (
+            Config,
+            Trainer,
+            apply_overrides,
+        )
+
+        cfg = apply_overrides(Config(), [
+            "task=semantic", "data.fake=true", "model.nclass=21",
+            "model.in_channels=3", "data.sbd_root=/nope",
+        ])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        with pytest.raises(ValueError, match="instance task"):
+            Trainer(cfg)
