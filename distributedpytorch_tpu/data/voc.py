@@ -19,11 +19,13 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import collections
+import contextlib
 import hashlib
 import json
 import os
 import shutil
 import tarfile
+import tempfile
 import threading
 import urllib.request
 
@@ -96,7 +98,10 @@ def load_obj_cache(path: str, im_ids: list[str]) -> dict | None:
     try:
         with open(path) as f:
             obj = json.load(f)
-    except (json.JSONDecodeError, OSError):
+    # ValueError covers JSONDecodeError AND UnicodeDecodeError (binary junk)
+    except (ValueError, OSError):
+        return None
+    if not isinstance(obj, dict):
         return None
     return obj if sorted(obj.keys()) == sorted(im_ids) else None
 
@@ -105,10 +110,24 @@ def write_obj_cache(path: str, obj_dict: dict) -> None:
     """Atomic JSON cache write: temp file + rename, so concurrent builders
     (every process of a multi-host run scans on first use) can never leave
     a truncated cache for a reader to crash on — last writer wins whole."""
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(obj_dict, f, indent=1)
-    os.replace(tmp, path)
+    # mkstemp, not a pid-suffixed name: pids collide across the hosts of a
+    # multi-host run sharing the dataset root over NFS/fuse.
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".tmp.",
+        dir=os.path.dirname(path) or ".")
+    try:
+        # mkstemp creates 0600; publish with umask-honoring permissions so
+        # other users of a shared dataset root can read the cache.
+        umask = os.umask(0)
+        os.umask(umask)
+        os.fchmod(fd, 0o666 & ~umask)
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj_dict, f, indent=1)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
 
 
 class _DecodeCache:

@@ -184,10 +184,11 @@ class Trainer:
                 decode_cache=cfg.data.decode_cache)
             if cfg.data.sbd_root:
                 # the reference's use_sbd recipe (train_pascal.py:150-154),
-                # live: merge SBD train, drop its VOC-val overlap
+                # live: merge SBD train+val, drop its VOC-val overlap
                 from ..data import CombinedDataset, SBDInstanceSegmentation
                 sbd = SBDInstanceSegmentation(
-                    cfg.data.sbd_root, split="train", transform=train_tf,
+                    cfg.data.sbd_root, split=["train", "val"],
+                    transform=train_tf,
                     preprocess=True,  # same always-rebuild policy as VOC
                     area_thres=cfg.data.area_thres,
                     decode_cache=cfg.data.decode_cache)

@@ -1,0 +1,522 @@
+"""Behavioral parity against the ACTUAL reference code.
+
+Every other test in this suite checks our implementations against *specified*
+behavior (SURVEY.md's analysis of the reference).  This module goes one step
+further: it imports the reference's own modules from ``/root/reference``
+(read-only), satisfies the dependency modules the reference author never
+committed (``dataloaders.helpers``, ``dataloaders.nellipse``,
+``dataloaders.skewed_axes_weight_map``, ``mypath`` — SURVEY.md §2.4) with
+THIS framework's implementations, and asserts our transforms/dataset produce
+the same arrays the reference code produces on the same inputs.
+
+Deterministic paths only: the reference draws from the global numpy RNG
+(``import numpy.random as random``), ours from explicit per-sample
+generators, so random *draw sequences* are not comparable.  Every case below
+is configured so no random draw affects the output: val-mode guidance
+(``extreme_points_fixed``), single-element rot/scale lists (the reference's
+list variant indexes with ``randint(0, 1) == 0``), ``pert=0``.
+
+``train_pascal.py`` is not importable — the reference's abandoned
+``train_epoch`` refactor left it syntactically invalid (SURVEY.md §0) — so
+driver-level parity stays covered by the survey-specified tests elsewhere.
+
+Skipped entirely when ``/root/reference`` is not mounted.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import types
+from copy import deepcopy
+
+import cv2
+import numpy as np
+import pytest
+
+REF_DIR = "/root/reference"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF_DIR), reason="reference repo not mounted"
+)
+
+
+# ---------------------------------------------------------------------------
+# dependency stubs: the modules the reference imports but never committed,
+# filled with this framework's implementations (the §2.4 contract table)
+# ---------------------------------------------------------------------------
+
+def _install_stubs() -> None:
+    if "dataloaders" in sys.modules:
+        return
+    from distributedpytorch_tpu.data import guidance as G
+    from distributedpytorch_tpu.utils import helpers as H
+
+    dataloaders = types.ModuleType("dataloaders")
+    dataloaders.__path__ = []  # mark as package
+
+    helpers = types.ModuleType("dataloaders.helpers")
+    for name in (
+        "get_bbox", "crop_from_mask", "fixed_resize", "make_gt",
+        "crop2fullmask", "tens2image", "overlay_mask",
+    ):
+        setattr(helpers, name, getattr(H, name))
+
+    nellipse = types.ModuleType("dataloaders.nellipse")
+    nellipse.extreme_points = G.extreme_points
+    nellipse.extreme_points_fixed = G.extreme_points_fixed
+    nellipse.compute_nellipse = G.compute_nellipse
+    # the reference's "fast" name for the (ellipse, gaussian-heatmap) pair
+    nellipse.compute_nellipse_gaussianHM_fast = G.compute_nellipse_gaussian_hm
+
+    skewed = types.ModuleType("dataloaders.skewed_axes_weight_map")
+    skewed.generate_mvL1L2_image_skewed_axes = G.generate_mv_l1l2_image_skewed_axes
+    skewed.generate_mvgauss_image = G.generate_mvgauss_image
+    skewed.normalize_wtMap = G.normalize_wt_map
+
+    mypath = types.ModuleType("mypath")
+
+    class Path:  # noqa: D401 - the reference's machine-local path registry
+        @staticmethod
+        def db_root_dir(db: str) -> str:
+            return os.path.join("/tmp", "ref_db_unused", db)
+
+    mypath.Path = Path
+
+    sys.modules.update({
+        "dataloaders": dataloaders,
+        "dataloaders.helpers": helpers,
+        "dataloaders.nellipse": nellipse,
+        "dataloaders.skewed_axes_weight_map": skewed,
+        "mypath": mypath,
+    })
+
+
+def _load_ref_module(name: str, filename: str):
+    _install_stubs()
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REF_DIR, filename))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def ref_ct():
+    """The reference's transform library, executing its real code."""
+    # numpy<1.20 aliases the reference's era assumed (np.int/np.bool were
+    # removed in numpy 2.x; the reference uses both)
+    if not hasattr(np, "int"):
+        np.int = int  # noqa: NPY001
+    if not hasattr(np, "bool"):
+        np.bool = bool  # noqa: NPY001
+    return _load_ref_module("_ref_custom_transforms", "custom_transforms.py")
+
+
+@pytest.fixture(scope="module")
+def ref_pascal():
+    if not hasattr(np, "int"):
+        np.int = int  # noqa: NPY001
+    return _load_ref_module("_ref_pascal", "pascal.py")
+
+
+# ---------------------------------------------------------------------------
+# shared inputs
+# ---------------------------------------------------------------------------
+
+def _make_sample(h: int = 80, w: int = 96, seed: int = 3) -> dict:
+    """An image + one-object mask + void ring, reference sample schema."""
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 256, (h, w, 3)).astype(np.uint8)
+    img = cv2.GaussianBlur(img, (5, 5), 0).astype(np.float32)
+    gt = np.zeros((h, w), np.uint8)
+    cv2.ellipse(gt, (52, 38), (25, 16), 30.0, 0, 360, 1, -1)
+    void = (cv2.dilate(gt, np.ones((3, 3), np.uint8)) - gt).astype(np.float32)
+    return {"image": img, "gt": gt.astype(np.float32), "void_pixels": void}
+
+
+def _clone(sample: dict) -> dict:
+    return {k: deepcopy(v) for k, v in sample.items()}
+
+
+def _assert_samples_equal(ours: dict, ref: dict, atol: float = 0.0) -> None:
+    assert set(ours.keys()) == set(ref.keys())
+    for key in ref:
+        if key == "meta":
+            continue
+        a, b = ours[key], ref[key]
+        if isinstance(b, list):
+            assert isinstance(a, list) and len(a) == len(b)
+            for x, y in zip(a, b):
+                np.testing.assert_allclose(x, y, atol=atol, err_msg=key)
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a, np.float64), np.asarray(b, np.float64),
+                atol=atol, err_msg=key)
+
+
+# ---------------------------------------------------------------------------
+# transform parity
+# ---------------------------------------------------------------------------
+
+class TestTransformParity:
+    def test_scale_n_rotate_fixed_choice(self, ref_ct):
+        """Single-element rot/scale lists make the reference's list variant
+        deterministic (randint(0,1)==0); the warp itself is the reference's
+        own cv2 path — a fully independent check of our warp semantics
+        (uint8 cast, per-key interpolation).
+
+        ``void_pixels`` is compared separately: the reference's meta-key
+        exemption is a substring test (``'id' in elem``,
+        custom_transforms.py:108) and ``'id' in 'vo_id_pixels'`` — so the
+        reference never warps the void mask at all, leaving it misaligned
+        with the warped gt.  We deliberately do not reproduce that (exact
+        key match in ``transforms._is_meta``): our void mask must track gt
+        for the void-aware loss to mean anything."""
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = _make_sample()
+        ref_out = ref_ct.ScaleNRotate(rots=[17], scales=[0.9])(_clone(sample))
+        ours_out = T.ScaleNRotate(rots=[17], scales=[0.9])(
+            _clone(sample), np.random.default_rng(0))
+        for key in ("image", "gt"):
+            np.testing.assert_array_equal(
+                np.asarray(ours_out[key]), np.asarray(ref_out[key]),
+                err_msg=key)
+        # documented divergence: reference void is untouched (the 'id'
+        # substring quirk); ours is warped in lockstep with gt
+        np.testing.assert_array_equal(ref_out["void_pixels"],
+                                      sample["void_pixels"])
+        assert not np.array_equal(ours_out["void_pixels"],
+                                  sample["void_pixels"])
+        import cv2 as _cv2
+        h, w = sample["void_pixels"].shape
+        m = _cv2.getRotationMatrix2D((w / 2, h / 2), 17, 0.9)
+        expected_void = _cv2.warpAffine(
+            sample["void_pixels"].astype(np.uint8), m, (w, h),
+            flags=_cv2.INTER_NEAREST)
+        np.testing.assert_array_equal(ours_out["void_pixels"], expected_void)
+
+    def test_scale_n_rotate_bb_mask_border(self, ref_ct):
+        """bb_mask warps with borderValue=255 in the reference — the border
+        must stay 'outside the box' under rotation."""
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = _make_sample()
+        del sample["void_pixels"]  # the 'id'-substring quirk, tested above
+        bb = np.ones_like(sample["gt"]) * 255.0
+        bb[10:60, 20:80] = 0.0
+        sample["bb_mask"] = bb
+        ref_out = ref_ct.ScaleNRotate(rots=[25], scales=[1.1])(_clone(sample))
+        ours_out = T.ScaleNRotate(rots=[25], scales=[1.1])(
+            _clone(sample), np.random.default_rng(0))
+        _assert_samples_equal(ours_out, ref_out)
+
+    def test_crop_from_mask_static(self, ref_ct):
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = _make_sample()
+        kw = dict(crop_elems=("image", "gt", "void_pixels"), mask_elem="gt",
+                  relax=50, zero_pad=True)
+        ref_out = ref_ct.CropFromMaskStatic(**kw)(_clone(sample))
+        ours_out = T.CropFromMaskStatic(**kw)(
+            _clone(sample), np.random.default_rng(0))
+        for key in ("crop_image", "crop_gt", "crop_void_pixels"):
+            np.testing.assert_array_equal(
+                np.asarray(ours_out[key]), np.asarray(ref_out[key]), err_msg=key)
+
+    def test_crop_from_mask_static_empty_mask(self, ref_ct):
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = _make_sample()
+        sample["gt"] = np.zeros_like(sample["gt"])
+        kw = dict(crop_elems=("image", "gt"), mask_elem="gt", relax=50,
+                  zero_pad=True)
+        ref_out = ref_ct.CropFromMaskStatic(**kw)(_clone(sample))
+        ours_out = T.CropFromMaskStatic(**kw)(
+            _clone(sample), np.random.default_rng(0))
+        for key in ("crop_image", "crop_gt"):
+            np.testing.assert_array_equal(
+                np.asarray(ours_out[key]), np.asarray(ref_out[key]), err_msg=key)
+
+    def test_fixed_resize_quirks(self, ref_ct):
+        """None = passthrough; unlisted keys deleted — the two load-bearing
+        quirks (SURVEY.md §2.3) — plus the plain resize path, against the
+        reference's own loop."""
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = _make_sample()
+        sample["extra_debug"] = np.ones((7, 7), np.float32)  # must be pruned
+        res = {"image": (64, 64), "gt": (64, 64), "void_pixels": None}
+        ref_out = ref_ct.FixedResize(resolutions=dict(res))(_clone(sample))
+        ours_out = T.FixedResize(resolutions=dict(res))(
+            _clone(sample), np.random.default_rng(0))
+        _assert_samples_equal(ours_out, ref_out)
+        assert "extra_debug" not in ours_out
+
+    def test_fixed_resize_list_stacking(self, ref_ct):
+        """List-valued entries resize elementwise and stack on a trailing
+        axis (reference custom_transforms.py:177-188)."""
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = {"crops": [np.float32(np.eye(20) * 200),
+                            np.float32(np.ones((20, 20)) * 55)]}
+        res = {"crops": (32, 32)}
+        ref_out = ref_ct.FixedResize(resolutions=dict(res))(_clone(sample))
+        ours_out = T.FixedResize(resolutions=dict(res))(
+            _clone(sample), np.random.default_rng(0))
+        np.testing.assert_allclose(ours_out["crops"], ref_out["crops"])
+
+    def _square_crop_gt(self) -> dict:
+        """The n-ellipse transforms run strictly AFTER the 512x512
+        FixedResize in both reference pipelines (train_pascal.py:127-131,
+        138-142), so square crops are the only shapes the reference ever
+        feeds them.  On non-square inputs the never-committed
+        ``compute_nellipse``'s (x_range, y_range) orientation is unknowable;
+        on square inputs both orientations agree, so parity is well-defined
+        exactly on the reference's live domain."""
+        gt = np.asarray(
+            _make_sample(h=72, w=72, seed=5)["gt"], np.float32)
+        assert gt.max() > 0
+        return {"crop_gt": gt}
+
+    def test_nellipse_val(self, ref_ct):
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = self._square_crop_gt()
+        ref_out = ref_ct.NEllipse(is_val=True)(_clone(sample))
+        ours_out = T.NEllipse(is_val=True)(_clone(sample))
+        np.testing.assert_allclose(
+            ours_out["nellipse"], ref_out["nellipse"], atol=1e-3)
+
+    def test_nellipse_with_gaussians_val(self, ref_ct):
+        """The live guidance channel: the z1 + alpha*z2 combination and the
+        rescale-to-255 are the reference's own arithmetic here."""
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = self._square_crop_gt()
+        ref_out = ref_ct.NEllipseWithGaussians(alpha=0.6, is_val=True)(
+            _clone(sample))
+        ours_out = T.NEllipseWithGaussians(alpha=0.6, is_val=True)(
+            _clone(sample))
+        np.testing.assert_allclose(
+            ours_out["nellipseWithGaussians"],
+            ref_out["nellipseWithGaussians"], atol=1e-3)
+
+    def test_nellipse_empty_mask(self, ref_ct):
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = {"crop_gt": np.zeros((40, 50), np.float32)}
+        ref_out = ref_ct.NEllipseWithGaussians(is_val=True)(_clone(sample))
+        ours_out = T.NEllipseWithGaussians(is_val=True)(_clone(sample))
+        np.testing.assert_array_equal(
+            ours_out["nellipseWithGaussians"],
+            ref_out["nellipseWithGaussians"])
+
+    def test_extreme_points_heatmap(self, ref_ct):
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = {"gt": _make_sample()["gt"]}
+        ref_out = ref_ct.ExtremePoints(sigma=10, pert=0, elem="gt",
+                                       is_val=True)(_clone(sample))
+        ours_out = T.ExtremePoints(sigma=10, pert=0, elem="gt", is_val=True)(
+            _clone(sample))
+        np.testing.assert_allclose(
+            ours_out["extreme_points"], ref_out["extreme_points"], atol=1e-5)
+
+    def test_create_bb_mask(self, ref_ct):
+        """The reference zeroes ``[bbox[1]:bbox[3], bbox[0]:bbox[2]]`` —
+        exclusive upper bounds over whatever convention its never-committed
+        ``get_bbox`` used.  Ours is inclusive (+1) over our inclusive
+        ``get_bbox`` (the DEXTR-lineage convention every other call site
+        here shares).  Parity: the masks agree everywhere except possibly
+        the one-pixel inclusive boundary band (the max row / max col)."""
+        from distributedpytorch_tpu.data import transforms as T
+        from distributedpytorch_tpu.utils.helpers import get_bbox
+
+        sample = _make_sample()
+        ref_out = ref_ct.CreateBBMask()(_clone(sample))
+        ours_out = T.CreateBBMask()(_clone(sample))
+        ours = np.asarray(ours_out["bb_mask"])
+        ref = np.asarray(ref_out["bb_mask"])
+        diff_rows, diff_cols = np.nonzero(ours != ref)
+        x_min, y_min, x_max, y_max = get_bbox(sample["gt"])
+        assert diff_rows.size > 0  # the band exists for a non-empty mask
+        assert np.all((diff_rows == y_max) | (diff_cols == x_max))
+        # inside the band-free interior the masks are identical
+        np.testing.assert_array_equal(ours[:y_max, :x_max], ref[:y_max, :x_max])
+
+    def test_concat_inputs(self, ref_ct):
+        """Independent parity: the reference's concat is raw numpy."""
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = _make_sample()
+        sample["heat"] = np.linspace(
+            0, 255, sample["gt"].size, dtype=np.float32
+        ).reshape(sample["gt"].shape)
+        ref_out = ref_ct.ConcatInputs(elems=("image", "heat"))(_clone(sample))
+        ours_out = T.ConcatInputs(elems=("image", "heat"))(_clone(sample))
+        np.testing.assert_array_equal(ours_out["concat"], ref_out["concat"])
+        assert ours_out["concat"].shape[-1] == 4
+
+    def test_to_image_normalization(self, ref_ct):
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = {"image": np.float32([[1.0, 3.0], [5.0, 9.0]])}
+        ref_out = ref_ct.ToImage(norm_elem="image", custom_max=255.0)(
+            _clone(sample))
+        ours_out = T.ToImage(norm_elem="image", custom_max=255.0)(
+            _clone(sample))
+        np.testing.assert_allclose(ours_out["image"], ref_out["image"],
+                                   rtol=1e-6)
+
+    def test_to_tensor_layout_equivalence(self, ref_ct):
+        """The reference emits CHW torch tensors; we emit HWC float32 arrays
+        (the TPU layout).  Content must match modulo the transpose."""
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = _make_sample()
+        ref_out = ref_ct.ToTensor()(_clone(sample))
+        ours_out = T.ToArray()(_clone(sample), np.random.default_rng(0))
+        for key in ("image", "gt"):
+            ref_np = ref_out[key].numpy()  # (C, H, W)
+            np.testing.assert_allclose(
+                ours_out[key], np.transpose(ref_np, (1, 2, 0)), err_msg=key)
+            assert ours_out[key].dtype == np.float32
+        # the 'id'-substring quirk again: the reference's ToTensor skips
+        # 'vo_id_pixels' entirely (it reaches collate as a raw numpy array);
+        # ours converts it like every other array key
+        assert isinstance(ref_out["void_pixels"], np.ndarray)
+        np.testing.assert_allclose(
+            ours_out["void_pixels"][..., 0], ref_out["void_pixels"])
+        assert ours_out["void_pixels"].dtype == np.float32
+
+
+# ---------------------------------------------------------------------------
+# full-pipeline parity: the reference driver's exact val composition
+# ---------------------------------------------------------------------------
+
+class TestValPipelineParity:
+    def test_val_pipeline_end_to_end(self, ref_ct):
+        """The reference's val transform chain (train_pascal.py:135-145),
+        deterministic end to end, reference code vs ours — including the
+        FixedResize key-pruning that shapes the final sample."""
+        from distributedpytorch_tpu.data import transforms as T
+
+        sample = _make_sample(h=100, w=120, seed=11)
+        res = {
+            "void_pixels": None, "gt": None,
+            "crop_image": (64, 64), "crop_gt": (64, 64),
+        }
+
+        ref_chain = [
+            ref_ct.CropFromMaskStatic(
+                crop_elems=("image", "gt"), mask_elem="gt", relax=50,
+                zero_pad=True),
+            ref_ct.FixedResize(resolutions=dict(res)),
+            ref_ct.NEllipseWithGaussians(alpha=0.6, is_val=True),
+            ref_ct.ConcatInputs(elems=("crop_image", "nellipseWithGaussians")),
+        ]
+        ref_out = _clone(sample)
+        for t in ref_chain:
+            ref_out = t(ref_out)
+
+        ours_chain = [
+            T.CropFromMaskStatic(
+                crop_elems=("image", "gt"), mask_elem="gt", relax=50,
+                zero_pad=True),
+            T.FixedResize(resolutions=dict(res)),
+            T.NEllipseWithGaussians(alpha=0.6, is_val=True),
+            T.ConcatInputs(elems=("crop_image", "nellipseWithGaussians")),
+        ]
+        ours_out = _clone(sample)
+        rng = np.random.default_rng(0)
+        for t in ours_chain:
+            ours_out = t(ours_out, rng)
+
+        # documented addition: our CropFromMaskStatic records the crop bbox
+        # (the evaluator pastes back from it; the reference recomputed the
+        # bbox from the full-res gt at eval time, train_pascal.py:287 — its
+        # `relaxes[jj]` latent-bug zone).  Not part of the reference sample.
+        ours_out.pop("bbox")
+        _assert_samples_equal(ours_out, ref_out, atol=1e-3)
+        assert ours_out["concat"].shape == (64, 64, 4)
+
+
+# ---------------------------------------------------------------------------
+# dataset parity: the reference's VOCSegmentation, run on the fake fixture
+# ---------------------------------------------------------------------------
+
+def _ref_dataset(ref_pascal, root: str, **kw):
+    """Instantiate the reference dataset on a local tree: integrity is the
+    official 2 GB tar's MD5 (pascal.py:142-152), patched out for the
+    fixture."""
+    cls = ref_pascal.VOCSegmentation
+    orig = cls._check_integrity
+    cls._check_integrity = lambda self: True
+    try:
+        return cls(root=root, **kw)
+    finally:
+        cls._check_integrity = orig
+
+
+class TestDatasetParity:
+    @pytest.fixture(scope="class")
+    def voc_tree(self, tmp_path_factory):
+        from distributedpytorch_tpu.data.fake import make_fake_voc
+        root = str(tmp_path_factory.mktemp("ref_parity_voc"))
+        make_fake_voc(root, n_images=6, size=(72, 88), max_objects=3, n_val=2)
+        return root
+
+    def test_samples_match_and_cache_interop_ref_first(
+            self, ref_pascal, voc_tree):
+        """Reference preprocesses first (writes its JSON cache); our dataset
+        must validate + load that cache (same filename, same key-set rule)
+        and then produce identical samples."""
+        from distributedpytorch_tpu.data.voc import VOCInstanceSegmentation
+
+        ref_ds = _ref_dataset(ref_pascal, voc_tree, split="train",
+                              area_thres=50, retname=True)
+        ours_ds = VOCInstanceSegmentation(root=voc_tree, split="train",
+                                          area_thres=50, retname=True)
+        assert len(ours_ds) == len(ref_ds)
+        assert ours_ds.obj_dict == {
+            k: list(v) for k, v in ref_ds.obj_dict.items()}
+        for idx in range(len(ref_ds)):
+            ref_s = ref_ds[idx]
+            our_s = ours_ds[idx]
+            for key in ("image", "gt", "void_pixels"):
+                np.testing.assert_array_equal(
+                    np.asarray(our_s[key]), np.asarray(ref_s[key]),
+                    err_msg=f"{key}[{idx}]")
+            assert our_s["meta"]["image"] == ref_s["meta"]["image"]
+            assert str(our_s["meta"]["object"]) == str(ref_s["meta"]["object"])
+            assert int(our_s["meta"]["category"]) == int(
+                ref_s["meta"]["category"])
+
+    def test_cache_interop_ours_first(self, ref_pascal, tmp_path):
+        """Our preprocess cache, read back by the reference's
+        ``_check_preprocess`` (json.load + key-set comparison)."""
+        from distributedpytorch_tpu.data.fake import make_fake_voc
+        from distributedpytorch_tpu.data.voc import VOCInstanceSegmentation
+
+        root = str(tmp_path / "voc")
+        make_fake_voc(root, n_images=5, size=(64, 80), max_objects=2, n_val=1)
+        ours_ds = VOCInstanceSegmentation(root=root, split="train",
+                                          area_thres=50, retname=True)
+        ref_ds = _ref_dataset(ref_pascal, root, split="train", area_thres=50,
+                              retname=True)
+        assert {k: list(v) for k, v in ref_ds.obj_dict.items()} \
+            == ours_ds.obj_dict
+        assert len(ref_ds) == len(ours_ds)
+
+    def test_str_matches(self, ref_pascal, voc_tree):
+        from distributedpytorch_tpu.data.voc import VOCInstanceSegmentation
+
+        ref_ds = _ref_dataset(ref_pascal, voc_tree, split="train",
+                              area_thres=50)
+        ours_ds = VOCInstanceSegmentation(root=voc_tree, split="train",
+                                          area_thres=50)
+        assert str(ours_ds) == str(ref_ds)
