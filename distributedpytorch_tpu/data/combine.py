@@ -21,7 +21,11 @@ import numpy as np
 
 class CombinedDataset:
     """Concatenation of datasets minus samples whose image id occurs in any
-    ``excluded`` dataset.  Each constituent keeps its own transform.
+    ``excluded`` dataset, deduplicated across constituents by image id
+    (first dataset listing an image contributes its samples; later
+    constituents' copies are dropped — the CombineDBs rule that keeps
+    VOC-train images from also entering via their SBD copies).  Each
+    constituent keeps its own transform.
 
     Constituents must yield the same sample schema (key set): ``collate``
     stacks by the first sample's keys, so a mixed-schema batch would either
@@ -31,7 +35,7 @@ class CombinedDataset:
     """
 
     def __init__(self, datasets: Sequence, excluded: Sequence = (),
-                 allow_mixed_schemas: bool = False):
+                 allow_mixed_schemas: bool = False, dedupe: bool = True):
         self.datasets = list(datasets)
         if not allow_mixed_schemas and len(self.datasets) > 1:
             probe_rng = np.random.default_rng(0)
@@ -52,10 +56,23 @@ class CombinedDataset:
             excluded_ids |= {ds.sample_image_id(i) for i in range(len(ds))}
         #: flat index: (dataset position, local sample index)
         self.index: list[tuple[int, int]] = []
+        # Cross-constituent dedup, first dataset wins: VOC train overlaps
+        # SBD train+val on ~1300 images, and the CombineDBs contract adds
+        # each image once (its objects come from whichever dataset listed
+        # the image first) — without this, overlapping images train twice
+        # per epoch.
+        # ``dedupe=False`` keeps every copy — for merging different VIEWS of
+        # the same images (e.g. instance + semantic over one VOC root).
+        seen_ids: set[str] = set()  # ids from earlier constituents
         for di, ds in enumerate(self.datasets):
+            ds_ids = set()
             for si in range(len(ds)):
-                if ds.sample_image_id(si) not in excluded_ids:
-                    self.index.append((di, si))
+                im_id = ds.sample_image_id(si)
+                ds_ids.add(im_id)
+                if im_id in excluded_ids or (dedupe and im_id in seen_ids):
+                    continue
+                self.index.append((di, si))
+            seen_ids |= ds_ids
 
     def __len__(self) -> int:
         return len(self.index)

@@ -574,7 +574,10 @@ class Trainer:
                          "train/epoch": epoch}, step)
             else:
                 interrupted = False
-        mean_loss = float(np.mean([float(l) for l in losses])) if losses \
+        # One bulk readback, not one float() per step: each scalar fetch is a
+        # full host<->device round trip (~70ms through a tunneled chip — per-
+        # step syncs would dwarf the epoch itself).
+        mean_loss = float(np.mean(jax.device_get(losses))) if losses \
             else float("nan")
         dt = time.perf_counter() - t0
         # Distinct images ingested — echoed repeats of a batch are not fresh

@@ -1,0 +1,133 @@
+"""End-to-end Trainer throughput on the real chip: host input pipeline
+(decode -> augment -> crop -> resize -> guidance -> batch) overlapped with
+the compiled train step, measured together through ``Trainer.train_epoch``.
+
+``bench.py`` measures the step alone (data pre-placed); ``bench_input.py``
+measures the host pipeline alone.  This script measures what a user actually
+gets: the two running concurrently through the prefetch/overlap machinery.
+Prints one JSON line per variant.
+
+TPU-only, like scripts/perf_sweep.py: the variants are full-size
+DANet-R101 512px configs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
+
+from distributedpytorch_tpu.backend_health import (  # noqa: E402
+    ensure_backend_or_cpu_fallback,
+    pin_requested_platform,
+)
+
+ensure_backend_or_cpu_fallback()
+
+import jax  # noqa: E402
+
+pin_requested_platform()
+
+from distributedpytorch_tpu.backend_health import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+CPU_SMOKE = "--cpu-smoke" in sys.argv
+if CPU_SMOKE:
+    sys.argv.remove("--cpu-smoke")
+elif not any(d.platform == "tpu" for d in jax.devices()):
+    print(json.dumps({"error": "no TPU available (e2e bench is TPU-only; "
+                      "--cpu-smoke runs a downsized flow check)"}))
+    sys.exit(1)
+
+from distributedpytorch_tpu.data.fake import make_fake_voc  # noqa: E402
+from distributedpytorch_tpu.train import Config, Trainer, apply_overrides  # noqa: E402
+
+# VOC-like image sizes (VOC2012 images are ~500x375) so decode/crop/resize
+# cost what it costs on the real dataset.
+N_IMAGES = 8 if CPU_SMOKE else 120
+IMG_SIZE = (96, 128) if CPU_SMOKE else (375, 500)
+BATCH = 2 if CPU_SMOKE else 8
+EPOCHS_TIMED = 1 if CPU_SMOKE else 2  # after a warmup epoch (compile + caches)
+
+
+def run(fixture_root: str, overrides: dict) -> dict:
+    work = tempfile.mkdtemp(prefix="bench_e2e_")
+    cfg = apply_overrides(Config(), {
+        "data.root": fixture_root,
+        "data.train_batch": BATCH,
+        "model.dtype": "float32" if CPU_SMOKE else "bfloat16",
+        **({"model.backbone": "resnet18",
+            "data.crop_size": [64, 64]} if CPU_SMOKE else {}),
+        "optim.lr": 1e-4,
+        "work_dir": work,
+        "epochs": 1,
+        "log_writers": [],
+        **overrides,
+    })
+    try:
+        trainer = Trainer(cfg)
+        n_batches = len(trainer.train_loader)
+        trainer.train_epoch(0)  # warmup: compile + any decode cache fill
+        t0 = time.perf_counter()
+        for ep in range(1, 1 + EPOCHS_TIMED):
+            trainer.train_epoch(ep)
+        # train_epoch defers syncs; one param read closes the timed region.
+        jax.block_until_ready(jax.tree.leaves(trainer.state.params)[0])
+        dt = time.perf_counter() - t0
+        echo = cfg.data.echo
+        steps = EPOCHS_TIMED * n_batches * echo
+        # Fresh-image rate (echoed repeats are NOT fresh data — same rule as
+        # the trainer's train/imgs_per_sec); the step rate is what the
+        # optimizer sees and is the number data echoing improves.
+        fresh = EPOCHS_TIMED * n_batches * BATCH
+        rec = {"imgs_per_sec_per_chip": round(
+                   fresh / dt / jax.device_count(), 2),
+               "steps": steps}
+        if echo > 1:
+            rec["step_imgs_per_sec_per_chip"] = round(
+                fresh * echo / dt / jax.device_count(), 2)
+        return rec
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    fixture = tempfile.mkdtemp(prefix="bench_e2e_voc_")
+    make_fake_voc(fixture, n_images=N_IMAGES, size=IMG_SIZE, max_objects=2,
+                  n_val=2)
+    variants = [
+        # reference-shape host pipeline: guidance synthesized on host
+        dict(),
+        # guidance fused into the compiled step (data.device_guidance)
+        {"data.device_guidance": True},
+        # + decode-once cache sized to the whole fixture
+        {"data.device_guidance": True, "data.decode_cache": N_IMAGES},
+        # + data echoing: each loaded batch steps twice
+        {"data.device_guidance": True, "data.decode_cache": N_IMAGES,
+         "data.echo": 2},
+        # everything movable moved on-device: flip + rotate/scale + guidance
+        # all inside the compiled step; host does decode -> crop -> resize
+        {"data.device_guidance": True, "data.decode_cache": N_IMAGES,
+         "data.device_augment": True, "data.device_augment_geom": True},
+    ]
+    sel = sys.argv[1:]
+    try:
+        for i, ov in enumerate(variants):
+            if sel and str(i) not in sel:
+                continue
+            rec = {"variant": i, **{k: v for k, v in ov.items()}}
+            try:
+                rec.update(run(fixture, ov))
+            except Exception as e:
+                rec["error"] = str(e)[:200]
+            print(json.dumps(rec), flush=True)
+    finally:
+        shutil.rmtree(fixture, ignore_errors=True)

@@ -275,9 +275,14 @@ class TestCombinedDataset:
         sem = VOCSemanticSegmentation(fake_voc_root, split="train")
         with pytest.raises(ValueError, match="schemas"):
             CombinedDataset([inst, sem])
-        both = CombinedDataset([inst, sem], allow_mixed_schemas=True)
+        # same images, different views: dedupe must be opted out to keep both
+        both = CombinedDataset([inst, sem], allow_mixed_schemas=True,
+                               dedupe=False)
         assert len(both) == len(inst) + len(sem)
         assert str(both).startswith("Combined(")
+        # default dedupe keeps only the first view of each shared image
+        first_only = CombinedDataset([inst, sem], allow_mixed_schemas=True)
+        assert len(first_only) == len(inst)
 
 
 class TestEnsureVoc:

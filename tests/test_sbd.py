@@ -143,6 +143,30 @@ class TestReferenceMergeFlow:
         assert batch["concat"].shape == (2, 64, 64, 4)
         assert np.isfinite(batch["concat"]).all()
 
+    def test_voc_train_sbd_overlap_dedupes_first_wins(self, tmp_path_factory,
+                                                      fake_voc_root):
+        """Real VOC train overlaps SBD on ~1300 images; each must enter the
+        merge ONCE, with its samples from the first dataset that lists it
+        (the CombineDBs rule) — not once per constituent."""
+        voc_train = VOCInstanceSegmentation(fake_voc_root, split="train",
+                                            preprocess=True)
+        dup = [voc_train.im_ids[0]]
+        root = make_fake_sbd(str(tmp_path_factory.mktemp("sbd_dup")),
+                             n_images=3, size=(100, 140), n_val=0, seed=6,
+                             overlap_ids=dup)
+        sbd = SBDInstanceSegmentation(root, split="train")
+        sbd_dup_samples = sum(sbd.sample_image_id(i) in dup
+                              for i in range(len(sbd)))
+        assert sbd_dup_samples > 0, "fixture overlap missing"
+
+        combined = CombinedDataset([voc_train, sbd])
+        # the SBD copies of the duplicated image are dropped, nothing else
+        assert len(combined) == len(voc_train) + len(sbd) - sbd_dup_samples
+        # and the surviving samples for that image come from VOC (dataset 0)
+        for i in range(len(combined)):
+            if combined.sample_image_id(i) in dup:
+                assert combined.index[i][0] == 0
+
 
 class TestTrainerSBDMerge:
     def test_trainer_sbd_root_merges_and_trains(self, tmp_path):
