@@ -45,6 +45,7 @@ def build_train_transform(
     guidance: str = "nellipse_gaussians",
     flip: bool = True,
     geom: bool = True,
+    fused_crop_resize: bool = False,
 ) -> T.Compose:
     """The training augmentation stack (reference train_pascal.py:123-134).
 
@@ -53,16 +54,33 @@ def build_train_transform(
     ``geom=False`` likewise drops the host ScaleNRotate when the device
     stage owns rotation/scale (ops.augment.random_scale_rotate — note the
     device form rotates the fixed-size crop rather than the full image).
+    ``fused_crop_resize`` collapses the crop + resize pair into one native
+    kernel pass (transforms.FusedCropResize) — same output contract, no
+    materialized intermediate crop.
     """
+    if fused_crop_resize:
+        crop_stage: list[T.Transform] = [
+            T.FusedCropResize(crop_elems=("image", "gt"), mask_elem="gt",
+                              relax=relax, zero_pad=zero_pad,
+                              size=crop_size),
+            # the fused kernel resizes in float32, so cubic can overshoot
+            # the [0,255] contract that uint8 saturation enforced — clamp
+            T.ClampRange(("crop_image",)),
+        ]
+    else:
+        crop_stage = [
+            T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
+                                 relax=relax, zero_pad=zero_pad),
+            T.FixedResize(resolutions={"crop_image": crop_size,
+                                       "crop_gt": crop_size}),
+            # without ScaleNRotate's uint8 cast upstream, cubic resize can
+            # overshoot the [0,255] contract — clamp explicitly
+            *([T.ClampRange(("crop_image",))] if not geom else []),
+        ]
     chain: list[T.Transform] = [
         *([T.RandomHorizontalFlip()] if flip else []),
         *([T.ScaleNRotate(rots=rots, scales=scales)] if geom else []),
-        T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
-                             relax=relax, zero_pad=zero_pad),
-        T.FixedResize(resolutions={"crop_image": crop_size, "crop_gt": crop_size}),
-        # without ScaleNRotate's uint8 cast upstream, cubic resize can
-        # overshoot the [0,255] contract — clamp explicitly
-        *([T.ClampRange(("crop_image",))] if not geom else []),
+        *crop_stage,
     ]
     chain += _guidance_stage(guidance, alpha, is_val=False)
     chain.append(T.ToArray())

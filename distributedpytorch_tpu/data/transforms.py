@@ -293,6 +293,77 @@ class CropFromMaskStatic(Transform):
                 f"zero_pad={self.zero_pad})")
 
 
+class FusedCropResize(Transform):
+    """``CropFromMaskStatic`` + ``FixedResize`` in one pass.
+
+    A pipeline-level fusion, not a reference transform: each listed element
+    is resized straight from its (relaxed, zero-padded) bbox window to
+    ``size`` by the native ``crop_resize`` kernel, never materializing the
+    intermediate crop — the two-stage pair's biggest allocation on the hot
+    path.  Output contract matches the pair: ``crop_<elem>`` keys at
+    ``size``, the recorded ``bbox``, FixedResize's pruning rule (keys not
+    produced/kept are deleted; ``meta``/``bbox``/``crop_relax`` exempt),
+    and the same per-element interpolation rule (nearest for binary /
+    255-valued windows, cubic otherwise).
+
+    Falls back to the two-stage path when the native library is absent.
+    """
+
+    def __init__(self, crop_elems=("image", "gt"), mask_elem="gt",
+                 relax=0, zero_pad=False, size=(512, 512)):
+        self.crop_elems = crop_elems
+        self.mask_elem = mask_elem
+        self.relax = relax
+        self.zero_pad = zero_pad
+        self.size = tuple(size)
+
+    def _window_flag(self, arr: np.ndarray, bbox) -> int:
+        """``helpers.resize_interp_flag`` evaluated on the in-image part of
+        the window (the zero padding only adds 0s, which never change
+        binary-ness)."""
+        win = arr[max(bbox[1], 0): bbox[3] + 1, max(bbox[0], 0): bbox[2] + 1]
+        return helpers.resize_interp_flag(win)
+
+    def __call__(self, sample, rng=None):
+        from .. import native_ops
+
+        if not (native_ops.enabled() and native_ops.has_crop_resize()):
+            two_stage = Compose([
+                CropFromMaskStatic(crop_elems=self.crop_elems,
+                                   mask_elem=self.mask_elem,
+                                   relax=self.relax, zero_pad=self.zero_pad),
+                FixedResize(resolutions={
+                    "crop_" + e: self.size for e in self.crop_elems}),
+            ])
+            return two_stage(sample, rng)
+
+        mask = sample[self.mask_elem]
+        bbox = helpers.get_bbox(mask, pad=self.relax, zero_pad=self.zero_pad)
+        for elem in self.crop_elems:
+            arr = sample[elem]
+            if bbox is None:  # empty mask -> zeros at the output size
+                shape = self.size + arr.shape[2:]
+                sample["crop_" + elem] = np.zeros(shape, np.float32)
+                continue
+            sample["crop_" + elem] = native_ops.crop_resize(
+                arr, bbox, self.size, self._window_flag(arr, bbox))
+        if bbox is None:
+            bbox = (0, 0, mask.shape[1] - 1, mask.shape[0] - 1)
+        sample["bbox"] = np.asarray(bbox, dtype=np.int64)
+        # FixedResize's pruning rule: everything not produced goes.
+        produced = {"crop_" + e for e in self.crop_elems}
+        for key in list(sample.keys()):
+            if key in produced or "meta" in key or "bbox" in key \
+                    or "crop_relax" in key:
+                continue
+            del sample[key]
+        return sample
+
+    def __repr__(self):
+        return (f"FusedCropResize(elems={self.crop_elems}, relax={self.relax},"
+                f" zero_pad={self.zero_pad}, size={self.size})")
+
+
 class CropFromMask(Transform):
     """Zoom-normalizing crop: pick the relax border so the object occupies a
     target fraction of the final ``d``×``d`` crop.

@@ -52,6 +52,14 @@ def _bind(lib):
     lib.hflip_f32.argtypes = [f32p, i, i, i, f32p]
     lib.gaussian_hm_f32.argtypes = [f32p, i, i, i, f, f32p]
     lib.nellipse_f32.argtypes = [f32p, i, i, i, f, f32p]
+    try:
+        lib.crop_resize_f32.argtypes = [f32p, i, i, i, i, i, i, i,
+                                        f32p, i, i, i]
+        lib.crop_resize_f32.restype = None
+    except AttributeError:
+        # stale .so from before the fused kernel existed; callers check
+        # hasattr and fall back to the two-stage path
+        pass
     for fn in (lib.resize_f32, lib.warp_affine_f32, lib.hflip_f32,
                lib.gaussian_hm_f32, lib.nellipse_f32):
         fn.restype = None
@@ -144,6 +152,27 @@ def warp_affine(arr: np.ndarray, m: np.ndarray, size: tuple[int, int],
     lib.warp_affine_f32(_ptr(a), h, w, c, _ptr(out), dh, dw,
                         m64.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
                         mode, border)
+    return out if chan else out[..., 0]
+
+
+def has_crop_resize() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "crop_resize_f32")
+
+
+def crop_resize(arr: np.ndarray, bbox, size: tuple[int, int],
+                mode: int = BICUBIC) -> np.ndarray:
+    """Fused crop-to-bbox + resize: the inclusive window ``bbox``
+    (x0, y0, x1, y1; may extend beyond the image — the overhang reads 0,
+    the zero-pad crop convention) resized to ``size`` without materializing
+    the intermediate crop."""
+    lib = load()
+    a, h, w, c, chan = _prep(arr)
+    x0, y0, x1, y1 = (int(v) for v in bbox)
+    dh, dw = size
+    out = np.empty((dh, dw, c), np.float32)
+    lib.crop_resize_f32(_ptr(a), h, w, c, x0, y0, x1, y1,
+                        _ptr(out), dh, dw, mode)
     return out if chan else out[..., 0]
 
 

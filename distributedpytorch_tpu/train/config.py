@@ -60,6 +60,12 @@ class DataConfig:
                                         # on-device from crop_gt (the most
                                         # expensive host transform; instance
                                         # task, all five guidance families)
+    fused_crop_resize: bool = False     # crop+resize as ONE native-kernel
+                                        # pass (no materialized crop).
+                                        # Wins on the cv2-free native
+                                        # imaging backend (+26%); with cv2
+                                        # present its SIMD resize is still
+                                        # faster — leave off (BASELINE.md)
     decode_cache: int = 0               # decode-once LRU over this many
                                         # images (FFCV-style; instance mode
                                         # revisits an image once per object

@@ -168,7 +168,8 @@ class Trainer:
                           else cfg.data.guidance),
                 flip=not cfg.data.device_augment,
                 geom=not (cfg.data.device_augment
-                          and cfg.data.device_augment_geom))
+                          and cfg.data.device_augment_geom),
+                fused_crop_resize=cfg.data.fused_crop_resize)
             val_tf = build_eval_transform(
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                 zero_pad=cfg.data.zero_pad, alpha=cfg.data.guidance_alpha,

@@ -113,6 +113,18 @@ def crop_from_mask(
     return crop_from_bbox(img, bbox, zero_pad=zero_pad)
 
 
+def resize_interp_flag(arr: np.ndarray) -> int:
+    """The reference's value-based resize-interpolation rule: nearest for
+    {0,1}- or {0,255}-valued arrays (binary / void masks), cubic otherwise.
+    Single owner — ``fixed_resize`` and the fused crop+resize path both
+    dispatch through it, so the two can never disagree on a mask.
+    (``ScaleNRotate``'s warp rule is the reference's OTHER rule — the mixed
+    {0,1,255} set — and deliberately stays separate.)"""
+    if ((arr == 0) | (arr == 1)).all() or ((arr == 0) | (arr == 255)).all():
+        return imaging.NEAREST
+    return imaging.CUBIC
+
+
 def fixed_resize(
     sample: np.ndarray, resolution, flagval: int | None = None
 ) -> np.ndarray:
@@ -124,10 +136,7 @@ def fixed_resize(
     otherwise.
     """
     if flagval is None:
-        if ((sample == 0) | (sample == 1)).all() or ((sample == 0) | (sample == 255)).all():
-            flagval = imaging.NEAREST
-        else:
-            flagval = imaging.CUBIC
+        flagval = resize_interp_flag(sample)
 
     if isinstance(resolution, int):
         tmp = [resolution, resolution]

@@ -17,6 +17,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 extern "C" {
 
@@ -39,62 +40,115 @@ inline float cubic_w(float x) {
   return 0.0f;
 }
 
-}  // namespace
+// Precomputed 1-D interpolation taps for one output axis: for output
+// coordinate i, `idx[i*n .. i*n+n-1]` are source indices (already clamped)
+// and `w[...]` their weights.  Separable resize = a horizontal pass with the
+// x-taps then a vertical pass with the y-taps — O(taps) work per output with
+// tight branch-free inner loops, instead of re-deriving coordinates and
+// clamping per (pixel, tap).
+struct Taps1D {
+  std::vector<int> idx;
+  std::vector<float> w;
+  int n = 0;  // taps per output coordinate (1, 2, or 4)
+};
 
-// mode: 0 = nearest, 1 = bilinear, 2 = bicubic
-void resize_f32(const float* src, int sh, int sw, int c,
-                float* dst, int dh, int dw, int mode) {
-  const float sx = static_cast<float>(sw) / dw;
-  const float sy = static_cast<float>(sh) / dh;
-  for (int y = 0; y < dh; ++y) {
-    const float fy = (y + 0.5f) * sy - 0.5f;
-    for (int x = 0; x < dw; ++x) {
-      const float fx = (x + 0.5f) * sx - 0.5f;
-      float* out = dst + (static_cast<int64_t>(y) * dw + x) * c;
-      if (mode == 0) {
-        // cv2 INTER_NEAREST: floor(x * scale), no half-pixel shift.
-        const int xs = clampi(static_cast<int>(x * sx), 0, sw - 1);
-        const int ys = clampi(static_cast<int>(y * sy), 0, sh - 1);
-        const float* in = src + (static_cast<int64_t>(ys) * sw + xs) * c;
-        std::memcpy(out, in, sizeof(float) * c);
-      } else if (mode == 1) {
-        const int x0 = static_cast<int>(std::floor(fx));
-        const int y0 = static_cast<int>(std::floor(fy));
-        const float ax = fx - x0, ay = fy - y0;
-        const int x0c = clampi(x0, 0, sw - 1), x1c = clampi(x0 + 1, 0, sw - 1);
-        const int y0c = clampi(y0, 0, sh - 1), y1c = clampi(y0 + 1, 0, sh - 1);
-        for (int k = 0; k < c; ++k) {
-          const float v00 = src[(static_cast<int64_t>(y0c) * sw + x0c) * c + k];
-          const float v01 = src[(static_cast<int64_t>(y0c) * sw + x1c) * c + k];
-          const float v10 = src[(static_cast<int64_t>(y1c) * sw + x0c) * c + k];
-          const float v11 = src[(static_cast<int64_t>(y1c) * sw + x1c) * c + k];
-          out[k] = v00 * (1 - ax) * (1 - ay) + v01 * ax * (1 - ay) +
-                   v10 * (1 - ax) * ay + v11 * ax * ay;
-        }
-      } else {
-        const int x0 = static_cast<int>(std::floor(fx));
-        const int y0 = static_cast<int>(std::floor(fy));
-        float wx[4], wy[4];
-        for (int t = 0; t < 4; ++t) {
-          wx[t] = cubic_w(fx - (x0 - 1 + t));
-          wy[t] = cubic_w(fy - (y0 - 1 + t));
-        }
-        for (int k = 0; k < c; ++k) {
-          float acc = 0.0f;
-          for (int j = 0; j < 4; ++j) {
-            const int yy = clampi(y0 - 1 + j, 0, sh - 1);
-            float row = 0.0f;
-            for (int i = 0; i < 4; ++i) {
-              const int xx = clampi(x0 - 1 + i, 0, sw - 1);
-              row += wx[i] * src[(static_cast<int64_t>(yy) * sw + xx) * c + k];
-            }
-            acc += wy[j] * row;
-          }
-          out[k] = acc;
-        }
+// `lo`/`hi`: inclusive source-index clamp range (the window in window
+// coordinates for the fused crop path; [0, src_len-1] for plain resize).
+Taps1D build_taps(int dst_len, int src_len, int mode, int lo, int hi) {
+  Taps1D t;
+  const float scale = static_cast<float>(src_len) / dst_len;
+  t.n = (mode == 0) ? 1 : (mode == 1 ? 2 : 4);
+  t.idx.resize(static_cast<size_t>(dst_len) * t.n);
+  t.w.resize(static_cast<size_t>(dst_len) * t.n);
+  for (int i = 0; i < dst_len; ++i) {
+    if (mode == 0) {
+      // cv2 INTER_NEAREST: floor(i * scale), no half-pixel shift.
+      t.idx[i] = clampi(static_cast<int>(i * scale), lo, hi);
+      t.w[i] = 1.0f;
+      continue;
+    }
+    const float f = (i + 0.5f) * scale - 0.5f;
+    const int base = static_cast<int>(std::floor(f));
+    if (mode == 1) {
+      const float a = f - base;
+      t.idx[i * 2] = clampi(base, lo, hi);
+      t.idx[i * 2 + 1] = clampi(base + 1, lo, hi);
+      t.w[i * 2] = 1.0f - a;
+      t.w[i * 2 + 1] = a;
+    } else {
+      for (int k = 0; k < 4; ++k) {
+        t.idx[i * 4 + k] = clampi(base - 1 + k, lo, hi);
+        t.w[i * 4 + k] = cubic_w(f - (base - 1 + k));
       }
     }
   }
+  return t;
+}
+
+// Shared separable core: horizontal pass over the rows listed in
+// `row_src` (an entry of -1 is a zero row — the fused crop's out-of-image
+// padding), then vertical pass combining buffered rows.  `xt` indices are
+// already absolute source-x offsets (or -1 for zero columns).  Only rows
+// some vertical tap actually references are filtered and buffered — under
+// heavy downscale (or nearest, 1 tap/row) most source rows are never read,
+// so the buffer and the horizontal work stay O(referenced rows), not
+// O(window rows).
+void separable_resize(const float* src, int sw, int c,
+                      const std::vector<int>& row_src,
+                      const Taps1D& xt, Taps1D yt,
+                      float* dst, int dh, int dw) {
+  const int rows = static_cast<int>(row_src.size());
+  // Compact the buffer to referenced rows; remap yt.idx into buffer slots.
+  std::vector<int> slot(rows, -1);
+  int used = 0;
+  for (auto& r : yt.idx) {
+    if (slot[r] < 0) slot[r] = used++;
+    r = slot[r];
+  }
+  const size_t row_elems = static_cast<size_t>(dw) * c;
+  std::vector<float> buf(static_cast<size_t>(used) * row_elems, 0.0f);
+  for (int r = 0; r < rows; ++r) {
+    if (slot[r] < 0) continue;  // no vertical tap reads this row
+    const int sy = row_src[r];
+    if (sy < 0) continue;  // zero padding row: buffer already zeroed
+    const float* in = src + static_cast<int64_t>(sy) * sw * c;
+    float* out = buf.data() + static_cast<size_t>(slot[r]) * row_elems;
+    for (int x = 0; x < dw; ++x) {
+      for (int t = 0; t < xt.n; ++t) {
+        const int xi = xt.idx[x * xt.n + t];
+        if (xi < 0) continue;  // zero padding column
+        const float wgt = xt.w[x * xt.n + t];
+        const float* px = in + static_cast<int64_t>(xi) * c;
+        float* o = out + static_cast<int64_t>(x) * c;
+        for (int k = 0; k < c; ++k) o[k] += wgt * px[k];
+      }
+    }
+  }
+  for (int y = 0; y < dh; ++y) {
+    float* out = dst + static_cast<int64_t>(y) * dw * c;
+    std::memset(out, 0, sizeof(float) * row_elems);
+    for (int t = 0; t < yt.n; ++t) {
+      const int r = yt.idx[y * yt.n + t];
+      const float wgt = yt.w[y * yt.n + t];
+      const float* in = buf.data() + static_cast<size_t>(r) * row_elems;
+      for (size_t e = 0; e < row_elems; ++e) out[e] += wgt * in[e];
+    }
+  }
+}
+
+}  // namespace
+
+// mode: 0 = nearest, 1 = bilinear, 2 = bicubic.  Separable two-pass with
+// precomputed taps; the tap weights/indices and accumulation order match the
+// direct per-pixel formulation bit-for-bit (same clamp rule, same
+// sum-over-x-then-over-y grouping).
+void resize_f32(const float* src, int sh, int sw, int c,
+                float* dst, int dh, int dw, int mode) {
+  const Taps1D xt = build_taps(dw, sw, mode, 0, sw - 1);
+  const Taps1D yt = build_taps(dh, sh, mode, 0, sh - 1);
+  std::vector<int> rows(sh);
+  for (int r = 0; r < sh; ++r) rows[r] = r;
+  separable_resize(src, sw, c, rows, xt, yt, dst, dh, dw);
 }
 
 // Inverse-map affine warp: for each dst pixel, sample src at M^-1 * (x, y).
@@ -151,6 +205,40 @@ void warp_affine_f32(const float* src, int sh, int sw, int c,
       }
     }
   }
+}
+
+// Fused zero-pad crop + resize: resize the inclusive window
+// [x0..x1] x [y0..y1] of src (which may extend beyond the image; the
+// out-of-image part reads 0) straight to dst, without materializing the
+// crop.  Sampling semantics are identical to crop_from_bbox followed by
+// resize_f32: interpolation taps clamp to the WINDOW (edge replicate at the
+// crop borders, what resizing the materialized crop does), and a tap whose
+// window pixel lies outside the source image reads the zero padding.
+// mode: 0 = nearest, 1 = bilinear, 2 = bicubic.
+void crop_resize_f32(const float* src, int sh, int sw, int c,
+                     int x0, int y0, int x1, int y1,
+                     float* dst, int dh, int dw, int mode) {
+  const int cw = x1 - x0 + 1;
+  const int ch = y1 - y0 + 1;
+  if (cw <= 0 || ch <= 0) {
+    std::memset(dst, 0, sizeof(float) * static_cast<int64_t>(dh) * dw * c);
+    return;
+  }
+  // Taps in window coordinates (clamped to the window: edge replicate at
+  // the crop borders), then mapped to absolute source coordinates; window
+  // pixels outside the image become -1 = read the zero padding.
+  Taps1D xt = build_taps(dw, cw, mode, 0, cw - 1);
+  for (auto& xi : xt.idx) {
+    const int abs_x = x0 + xi;
+    xi = (abs_x < 0 || abs_x >= sw) ? -1 : abs_x;
+  }
+  const Taps1D yt = build_taps(dh, ch, mode, 0, ch - 1);
+  std::vector<int> rows(ch);
+  for (int r = 0; r < ch; ++r) {
+    const int abs_y = y0 + r;
+    rows[r] = (abs_y < 0 || abs_y >= sh) ? -1 : abs_y;
+  }
+  separable_resize(src, sw, c, rows, xt, yt, dst, dh, dw);
 }
 
 void hflip_f32(const float* src, int h, int w, int c, float* dst) {

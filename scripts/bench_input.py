@@ -54,6 +54,10 @@ def main() -> int:
         # move into the compiled step, the host stops at the 512² crops
         tf_devg = build_train_transform(crop_size=(512, 512),
                                         guidance="none")
+        # + data.fused_crop_resize: crop+resize as one native-kernel pass
+        tf_devg_fused = build_train_transform(crop_size=(512, 512),
+                                              guidance="none",
+                                              fused_crop_resize=True)
 
         def ds(cache: int, t):
             return VOCInstanceSegmentation(root, split="train", transform=t,
@@ -66,6 +70,10 @@ def main() -> int:
             ("workers0", dict(cache=0, workers=0)),
             ("workers2+device_guidance", dict(cache=0, workers=2, t=tf_devg)),
             ("workers0+device_guidance", dict(cache=0, workers=0, t=tf_devg)),
+            ("workers0+device_guidance+fused_crop_resize",
+             dict(cache=0, workers=0, t=tf_devg_fused)),
+            ("workers0+device_guidance+fused+decode_cache",
+             dict(cache=64, workers=0, t=tf_devg_fused)),
         ]
         for name, v in variants:
             ips = measure(ds(v["cache"], v.get("t", tf)), batch=8,

@@ -152,3 +152,105 @@ class TestImagingBackendSwap:
         assert np.percentile(d, 99) <= 2.0, np.percentile(d, 99)
         # binary gt must agree almost everywhere
         assert (a["crop_gt"] != b["crop_gt"]).mean() < 0.02
+
+
+class TestFusedCropResize:
+    """The fused crop+resize kernel and its pipeline transform."""
+
+    def _img(self, seed=0, h=90, w=120, c=3):
+        r = np.random.default_rng(seed)
+        return r.uniform(0, 255, (h, w, c) if c else (h, w)
+                         ).astype(np.float32)
+
+    @pytest.mark.skipif(not native_ops.available(), reason="lib not built")
+    def test_kernel_matches_two_stage_exactly(self):
+        from distributedpytorch_tpu.utils.helpers import crop_from_bbox
+        assert native_ops.has_crop_resize()
+        for bbox in [(-10, -5, 99, 79),   # overhangs top-left
+                     (10, 8, 200, 150),   # overhangs bottom-right
+                     (20, 15, 80, 60)]:   # fully inside
+            for c in (3, 0):
+                img = self._img(c=c)
+                crop = crop_from_bbox(img, bbox, zero_pad=True)
+                for mode in (native_ops.NEAREST, native_ops.BILINEAR,
+                             native_ops.BICUBIC):
+                    two = native_ops.resize(crop, (64, 48), mode)
+                    fused = native_ops.crop_resize(img, bbox, (64, 48), mode)
+                    np.testing.assert_allclose(fused, two, atol=1e-4,
+                                               err_msg=f"{bbox} {mode} c{c}")
+
+    @pytest.mark.skipif(not native_ops.available(), reason="lib not built")
+    def test_transform_matches_two_stage_pair(self):
+        """FusedCropResize == CropFromMaskStatic + FixedResize on the train
+        contract: same keys, same bbox, gt exact, image within float-vs-uint8
+        rounding."""
+        from distributedpytorch_tpu.data import transforms as T
+
+        r = np.random.default_rng(3)
+        img = r.uniform(0, 255, (90, 120, 3)).astype(np.float32)
+        gt = np.zeros((90, 120), np.float32)
+        gt[25:70, 30:100] = 1.0
+        sample = {"image": img, "gt": gt,
+                  "void_pixels": np.zeros_like(gt),
+                  "meta": {"image": "x"}}
+
+        pair = T.Compose([
+            T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
+                                 relax=30, zero_pad=True),
+            T.FixedResize(resolutions={"crop_image": (64, 64),
+                                       "crop_gt": (64, 64)}),
+        ])
+        fused = T.FusedCropResize(crop_elems=("image", "gt"), mask_elem="gt",
+                                  relax=30, zero_pad=True, size=(64, 64))
+        a = pair({k: (v.copy() if hasattr(v, "copy") else v)
+                  for k, v in sample.items()})
+        b = fused({k: (v.copy() if hasattr(v, "copy") else v)
+                   for k, v in sample.items()})
+        assert set(a) == set(b)
+        np.testing.assert_array_equal(a["bbox"], b["bbox"])
+        np.testing.assert_array_equal(a["crop_gt"], b["crop_gt"])
+        np.testing.assert_allclose(a["crop_image"], b["crop_image"],
+                                   atol=1e-3)
+
+    @pytest.mark.skipif(not native_ops.available(), reason="lib not built")
+    def test_empty_mask_zeros(self):
+        from distributedpytorch_tpu.data import transforms as T
+        sample = {"image": self._img(), "gt": np.zeros((90, 120), np.float32)}
+        out = T.FusedCropResize(crop_elems=("image", "gt"), mask_elem="gt",
+                                relax=30, zero_pad=True, size=(32, 32)
+                                )(sample)
+        assert out["crop_image"].shape == (32, 32, 3)
+        assert out["crop_image"].max() == 0
+        assert out["crop_gt"].max() == 0
+
+    def test_fallback_without_native(self, monkeypatch):
+        """With the library disabled the transform must route through the
+        two-stage pair and produce the identical contract."""
+        from distributedpytorch_tpu.data import transforms as T
+        monkeypatch.setenv("DPTPU_NATIVE", "0")
+        gt = np.zeros((50, 60), np.float32)
+        gt[10:40, 12:50] = 1.0
+        sample = {"image": self._img(h=50, w=60), "gt": gt}
+        out = T.FusedCropResize(crop_elems=("image", "gt"), mask_elem="gt",
+                                relax=10, zero_pad=True, size=(32, 32)
+                                )(sample)
+        assert out["crop_image"].shape == (32, 32, 3)
+        assert out["crop_gt"].shape == (32, 32)
+        assert "bbox" in out and "image" not in out
+
+    @pytest.mark.skipif(not native_ops.available(), reason="lib not built")
+    def test_end_to_end_train_pipeline(self, fake_voc_root):
+        """data.fused_crop_resize through the real dataset + loader: batches
+        match the standard pipeline's contract and ranges."""
+        from distributedpytorch_tpu.data import (
+            DataLoader, VOCInstanceSegmentation, build_train_transform)
+        tf = build_train_transform(crop_size=(64, 64), relax=10,
+                                   fused_crop_resize=True)
+        ds = VOCInstanceSegmentation(fake_voc_root, split="train",
+                                     transform=tf)
+        loader = DataLoader(ds, batch_size=2, shuffle=True, drop_last=True,
+                            num_workers=0, seed=0)
+        batch = next(iter(loader))
+        assert batch["concat"].shape == (2, 64, 64, 4)
+        assert batch["concat"].min() >= 0 and batch["concat"].max() <= 255
+        assert set(np.unique(batch["crop_gt"])) <= {0.0, 1.0}
