@@ -9,11 +9,13 @@ from .grain_pipeline import (GrainDataLoader, HAVE_GRAIN,
 from .pipeline import (
     DataLoader,
     build_eval_transform,
+    build_prepared_post_transform,
     build_semantic_eval_transform,
     build_semantic_train_transform,
     build_train_transform,
     collate,
 )
+from .prepared import PreparedInstanceDataset, cache_fingerprint
 from .voc import (
     CATEGORY_NAMES,
     VOCInstanceSegmentation,
@@ -30,6 +32,9 @@ __all__ = [
     "VOCSemanticSegmentation",
     "HAVE_GRAIN",
     "build_eval_transform",
+    "build_prepared_post_transform",
+    "PreparedInstanceDataset",
+    "cache_fingerprint",
     "build_semantic_eval_transform",
     "build_semantic_train_transform",
     "build_train_transform",

@@ -35,6 +35,38 @@ from . import transforms as T
 GUIDANCE_KEY = "nellipseWithGaussians"
 
 
+def build_crop_stage(
+    crop_size: tuple[int, int],
+    relax: int,
+    zero_pad: bool,
+    fused: bool = False,
+    clamp: bool = True,
+) -> list[T.Transform]:
+    """The deterministic crop front shared by the train pipeline and the
+    prepared-sample cache (data.prepared_cache) — ONE definition, so the
+    cached bytes can never silently diverge from the live pipeline's.
+
+    ``fused`` collapses crop + resize into one native-kernel pass
+    (transforms.FusedCropResize); ``clamp`` bounds cubic-resize overshoot
+    back into the [0,255] contract (needed whenever no uint8 cast sits
+    upstream — the fused kernel resizes in float32 always).
+    """
+    if fused:
+        return [
+            T.FusedCropResize(crop_elems=("image", "gt"), mask_elem="gt",
+                              relax=relax, zero_pad=zero_pad,
+                              size=crop_size),
+            *([T.ClampRange(("crop_image",))] if clamp else []),
+        ]
+    return [
+        T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
+                             relax=relax, zero_pad=zero_pad),
+        T.FixedResize(resolutions={"crop_image": crop_size,
+                                   "crop_gt": crop_size}),
+        *([T.ClampRange(("crop_image",))] if clamp else []),
+    ]
+
+
 def build_train_transform(
     crop_size: tuple[int, int] = (512, 512),
     relax: int = 50,
@@ -58,33 +90,42 @@ def build_train_transform(
     kernel pass (transforms.FusedCropResize) — same output contract, no
     materialized intermediate crop.
     """
-    if fused_crop_resize:
-        crop_stage: list[T.Transform] = [
-            T.FusedCropResize(crop_elems=("image", "gt"), mask_elem="gt",
-                              relax=relax, zero_pad=zero_pad,
-                              size=crop_size),
-            # the fused kernel resizes in float32, so cubic can overshoot
-            # the [0,255] contract that uint8 saturation enforced — clamp
-            T.ClampRange(("crop_image",)),
-        ]
-    else:
-        crop_stage = [
-            T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
-                                 relax=relax, zero_pad=zero_pad),
-            T.FixedResize(resolutions={"crop_image": crop_size,
-                                       "crop_gt": crop_size}),
-            # without ScaleNRotate's uint8 cast upstream, cubic resize can
-            # overshoot the [0,255] contract — clamp explicitly
-            *([T.ClampRange(("crop_image",))] if not geom else []),
-        ]
     chain: list[T.Transform] = [
         *([T.RandomHorizontalFlip()] if flip else []),
         *([T.ScaleNRotate(rots=rots, scales=scales)] if geom else []),
-        *crop_stage,
+        # with ScaleNRotate upstream its uint8 cast already bounds values,
+        # so the non-fused path only clamps when geom is off
+        *build_crop_stage(crop_size, relax, zero_pad,
+                          fused=fused_crop_resize,
+                          clamp=fused_crop_resize or not geom),
     ]
     chain += _guidance_stage(guidance, alpha, is_val=False)
     chain.append(T.ToArray())
     return T.Compose(chain)
+
+
+def build_prepared_post_transform(
+    rots: tuple[float, float] = (-20, 20),
+    scales: tuple[float, float] = (0.75, 1.25),
+    alpha: float = 0.6,
+    guidance: str = "nellipse_gaussians",
+    flip: bool = True,
+    geom: bool = True,
+) -> T.Compose:
+    """The per-epoch random stage downstream of the prepared-sample cache
+    (data.prepared_cache): the cache already holds the deterministic
+    decode→crop→resize output (``crop_image``/``crop_gt``), so only the
+    random transforms run here — flip, scale/rotate *on the crop* (the
+    device_augment_geom semantics: the warp sees the fixed-size crop, not
+    the pre-crop full image), guidance synthesis, concat.  ``flip``/``geom``
+    gate the host stages exactly like :func:`build_train_transform` when the
+    on-device augmentation owns them instead."""
+    return T.Compose([
+        *([T.RandomHorizontalFlip()] if flip else []),
+        *([T.ScaleNRotate(rots=rots, scales=scales)] if geom else []),
+        *_guidance_stage(guidance, alpha, is_val=False),
+        T.ToArray(),
+    ])
 
 
 def build_eval_transform(

@@ -1,0 +1,287 @@
+"""Prepared-sample disk cache: decode→crop→resize stored once, mmap-read after.
+
+The end-to-end bound on a weak host is the deterministic front of the train
+pipeline — JPEG/PNG decode, mask-bbox crop, fixed resize (BASELINE.md: ~19
+fresh imgs/s e2e vs a ~65 imgs/s chip).  That front is *identical every
+epoch*: given the sample and the crop config it has no randomness.  So run
+it once, store the result compactly on disk, and serve every later epoch
+from an ``np.memmap`` read — the FFCV recipe (PAPERS.md) applied to the
+reference's host pipeline (/root/reference/train_pascal.py:123-134,
+pascal.py:232-263).
+
+What is cached per sample (all fixed-shape):
+
+* ``crop_image`` — (H, W, 3) uint8 (the [0,255] contract of reference
+  train_pascal.py:188 makes uint8 lossless up to rounding);
+* ``crop_gt``   — H·W bits, ``np.packbits`` of the binary mask (32 KB for a
+  512² crop instead of 1 MB float32);
+* ``bbox``      — the (relaxed) crop box, for eval-style paste-back;
+* ``im_size``   — the source image's (H, W), reconstructing ``meta``.
+
+Randomness is *not* cached: flip / scale-rotate / guidance synthesis run
+per epoch downstream of the cache (``post_transform``), so augmentation
+stays fresh.  Consequence, stated plainly: the random geometric stage
+operates on the fixed-size *crop* rather than the pre-crop full image —
+the same semantics as the on-device augmentation path
+(``data.device_augment_geom``); the flip commutes with the crop exactly
+(zero-padded boxes are symmetric), the rotation does not (pixels that a
+full-image rotation would bring into the crop window are zeros here).
+
+Cache identity: a fingerprint over the dataset identity and every config
+knob that changes the cached bytes (crop size, relax, zero_pad, fused
+kernel, imaging backend).  Each fingerprint gets its own subdirectory, so
+changing the config *invalidates by construction* — a new config simply
+builds a new cache and never reads stale rows.
+
+Concurrency: rows are written at distinct offsets (one row per sample
+index) with a ``valid`` byte flipped after the row lands; racing fillers
+(loader threads, grain worker processes) recompute the same deterministic
+bytes, so last-writer-wins is idempotent.  The memmaps are reopened after
+pickling (grain workers) rather than shipped.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from .. import imaging
+from . import transforms as T
+
+#: bump when the cached layout/semantics change
+_FORMAT_VERSION = 1
+
+
+def cache_fingerprint(dataset, crop_size, relax: int, zero_pad: bool,
+                      fused_crop_resize: bool) -> str:
+    """Identity of the cached bytes: dataset + every knob that changes them.
+
+    ``str(dataset)`` covers splits/area-thres (VOC/SBD ``__str__`` encode
+    them); ``len`` catches a changed instance list under the same name; the
+    imaging backend matters because cv2 and the native kernels differ in
+    the last ulp of cubic taps.
+    """
+    ident = json.dumps({
+        "format": _FORMAT_VERSION,
+        "dataset": str(dataset),
+        "n": len(dataset),
+        "crop_size": list(crop_size),
+        "relax": int(relax),
+        "zero_pad": bool(zero_pad),
+        "fused_crop_resize": bool(fused_crop_resize),
+        "imaging_backend": imaging.backend(),
+    }, sort_keys=True)
+    return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+
+class PreparedInstanceDataset:
+    """Wrap an instance dataset with a prepared-sample disk cache.
+
+    ``dataset`` must be constructed with ``transform=None`` (this class owns
+    the whole transform story: the deterministic crop stage feeds the cache,
+    ``post_transform`` runs per epoch on the cached crop).  Any source with
+    the instance sample contract works — VOC, SBD, ``CombinedDataset``.
+
+    First access of an index computes decode→crop→resize, writes the row,
+    and marks it valid; every later access (any epoch, any process) is a
+    memmap read.  A full first epoch therefore fills the cache as a side
+    effect of training — no separate build pass needed (``prebuild()``
+    exists for warming explicitly).
+    """
+
+    def __init__(self, dataset, cache_dir: str,
+                 crop_size=(512, 512), relax: int = 50,
+                 zero_pad: bool = True, fused_crop_resize: bool = False,
+                 post_transform=None):
+        if getattr(dataset, "transform", None) is not None:
+            raise ValueError(
+                "PreparedInstanceDataset wraps the *untransformed* dataset "
+                "(construct it with transform=None); the crop stage it would "
+                "run is exactly what this cache replaces")
+        self.dataset = dataset
+        self.cache_root = cache_dir
+        self.crop_size = tuple(int(v) for v in crop_size)
+        self.relax = int(relax)
+        self.zero_pad = bool(zero_pad)
+        self.fused_crop_resize = bool(fused_crop_resize)
+        self.post_transform = post_transform
+
+        # THE shared crop front (pipeline.build_crop_stage): one definition
+        # keeps the cached bytes from diverging from the live pipeline.
+        from .pipeline import build_crop_stage
+        self._stage1 = T.Compose(build_crop_stage(
+            self.crop_size, relax, zero_pad, fused=fused_crop_resize,
+            clamp=True))
+
+        self.fingerprint = cache_fingerprint(
+            dataset, self.crop_size, relax, zero_pad, fused_crop_resize)
+        self.cache_dir = os.path.join(cache_dir, self.fingerprint)
+        self._open_or_create()
+
+    # -- cache files ---------------------------------------------------------
+
+    def _open_or_create(self) -> None:
+        n = len(self.dataset)
+        h, w = self.crop_size
+        self._npack = (h * w + 7) // 8
+        os.makedirs(self.cache_dir, exist_ok=True)
+        meta_path = os.path.join(self.cache_dir, "meta.json")
+        expect = {"format": _FORMAT_VERSION, "fingerprint": self.fingerprint,
+                  "n": n, "crop_size": [h, w]}
+        fresh = True
+        if os.path.isfile(meta_path):
+            try:
+                with open(meta_path) as f:
+                    fresh = json.load(f) != expect
+            except (ValueError, OSError):
+                fresh = True
+        if fresh:
+            # (Re)create: zero the valid map LAST so a half-written images
+            # file from a crashed builder is never trusted.
+            for name, shape, dtype in self._layout(n, h, w):
+                mm = np.memmap(os.path.join(self.cache_dir, name), mode="w+",
+                               dtype=dtype, shape=shape)
+                del mm  # creation (ftruncate to size) is all that's needed
+            with open(meta_path + ".tmp", "w") as f:
+                json.dump(expect, f)
+            os.replace(meta_path + ".tmp", meta_path)
+        self._maps = {
+            name: np.memmap(os.path.join(self.cache_dir, name), mode="r+",
+                            dtype=dtype, shape=shape)
+            for name, shape, dtype in self._layout(n, h, w)
+        }
+
+    def _layout(self, n, h, w):
+        return [
+            ("images.u8", (n, h, w, 3), np.uint8),
+            ("masks.u8", (n, self._npack), np.uint8),
+            ("bboxes.i64", (n, 4), np.int64),
+            ("sizes.i32", (n, 2), np.int32),
+            ("valid.u8", (n,), np.uint8),
+        ]
+
+    # Grain process workers pickle the dataset; memmaps reopen in the worker
+    # (the files are the shared state, not the handles).
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_maps")
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._open_or_create()
+
+    # -- dataset protocol ----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def sample_image_id(self, index: int) -> str:
+        return self.dataset.sample_image_id(index)
+
+    @property
+    def n_prepared(self) -> int:
+        """Rows already cached (diagnostic / test hook)."""
+        return int(np.count_nonzero(self._maps["valid.u8"]))
+
+    def _fill(self, index: int) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                                         tuple[int, int]]:
+        raw = self.dataset.__getitem__(index)
+        sample = self._stage1(dict(raw), None)
+        h, w = self.crop_size
+        img8 = np.rint(np.asarray(sample["crop_image"],
+                                  np.float32)).astype(np.uint8)
+        gt = np.asarray(sample["crop_gt"], np.float32)
+        if gt.ndim == 3:
+            gt = gt[..., 0]
+        bits = np.packbits(gt.reshape(-1) > 0.5)
+        bbox = np.asarray(sample["bbox"], np.int64)
+        im_size = raw["meta"]["im_size"] if "meta" in raw \
+            else raw["image"].shape[:2]
+        self._maps["images.u8"][index] = img8
+        self._maps["masks.u8"][index] = bits
+        self._maps["bboxes.i64"][index] = bbox
+        self._maps["sizes.i32"][index] = im_size
+        self._maps["valid.u8"][index] = 1
+        return img8, bits, bbox, tuple(int(v) for v in im_size)
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        index = int(index)
+        h, w = self.crop_size
+        if self._maps["valid.u8"][index]:
+            img8 = np.asarray(self._maps["images.u8"][index])
+            bits = np.asarray(self._maps["masks.u8"][index])
+            bbox = np.asarray(self._maps["bboxes.i64"][index]).copy()
+            im_size = tuple(int(v) for v in self._maps["sizes.i32"][index])
+            if not (img8.any() or bits.any()):
+                # Torn write from a crashed filler: the valid byte landed
+                # but the row is still zeros (writeback order is arbitrary).
+                # A real sample always has object pixels; refill.
+                img8, bits, bbox, im_size = self._fill(index)
+        else:
+            img8, bits, bbox, im_size = self._fill(index)
+        gt = np.unpackbits(bits, count=h * w).reshape(h, w) \
+            .astype(np.float32)
+        sample = {
+            "crop_image": img8.astype(np.float32),
+            "crop_gt": gt,
+            "meta": self._meta(index, im_size),
+        }
+        if self.post_transform is not None:
+            sample = self.post_transform(sample, rng)
+        # bbox joins AFTER the random stage: flip/rotate iterate every array
+        # key and would mangle a 4-vector of coordinates (in the uncached
+        # pipeline the crop — and hence bbox — comes after them).
+        sample["bbox"] = bbox
+        return sample
+
+    def _meta(self, index: int, im_size: tuple[int, int]) -> dict:
+        """Rebuild the sample's ``meta`` without touching the image bytes.
+
+        A ``CombinedDataset`` wrapper (the sbd_root merge) is unwrapped to
+        the constituent that owns the sample, so the meta schema stays
+        identical to the uncached pipeline's (image/object/category/
+        im_size) regardless of nesting."""
+        ds, local = self.dataset, index
+        while hasattr(ds, "datasets") and hasattr(ds, "index"):
+            di, local = ds.index[local]
+            ds = ds.datasets[di]
+        meta = {"image": ds.sample_image_id(local), "im_size": im_size}
+        obj_list = getattr(ds, "obj_list", None)
+        if obj_list is not None:
+            im_ii, obj_ii = obj_list[local]
+            meta["object"] = str(obj_ii)
+            meta["category"] = ds.obj_dict[ds.im_ids[im_ii]][obj_ii]
+        return meta
+
+    def prebuild(self, num_workers: int = 0) -> None:
+        """Eagerly fill every missing row (optional — training's first epoch
+        does the same lazily)."""
+        missing = np.flatnonzero(self._maps["valid.u8"] == 0)
+        if num_workers > 0:
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(max_workers=num_workers) as pool:
+                list(pool.map(self._fill, missing.tolist()))
+        else:
+            for i in missing.tolist():
+                self._fill(i)
+        self.flush()
+
+    def flush(self) -> None:
+        """msync the maps — durability for readers in other processes/runs.
+
+        Data maps flush BEFORE the valid map: a host crash mid-writeback
+        must never persist a valid byte whose row bytes didn't land (the
+        page cache orders nothing on its own)."""
+        for name, mm in self._maps.items():
+            if name != "valid.u8":
+                mm.flush()
+        self._maps["valid.u8"].flush()
+
+    def __str__(self) -> str:
+        return (f"Prepared({self.dataset},crop={self.crop_size},"
+                f"relax={self.relax},fp={self.fingerprint})")

@@ -66,6 +66,16 @@ class DataConfig:
                                         # imaging backend (+26%); with cv2
                                         # present its SIMD resize is still
                                         # faster — leave off (BASELINE.md)
+    prepared_cache: str = ""            # dir for the prepared-sample disk
+                                        # cache (FFCV-style): the train
+                                        # pipeline's deterministic front
+                                        # (decode→crop→resize) is computed
+                                        # once per sample and mmap-read ever
+                                        # after; flip/rotate/guidance stay
+                                        # per-epoch random, post-crop.
+                                        # Keyed by a config fingerprint —
+                                        # changing crop knobs rebuilds.
+                                        # ~0.75 MB/sample at 512².
     decode_cache: int = 0               # decode-once LRU over this many
                                         # images (FFCV-style; instance mode
                                         # revisits an image once per object

@@ -147,6 +147,10 @@ class Trainer:
             if err:
                 raise RuntimeError(f"VOC download failed on process 0 "
                                    f"({err})")
+        if cfg.data.prepared_cache and cfg.task != "instance":
+            raise ValueError("data.prepared_cache caches the instance "
+                             "pipeline's crop stage; the semantic pipeline "
+                             "has no deterministic crop front to cache")
         if cfg.data.device_guidance:
             from ..ops.guidance_device import FAMILIES as _DEV_FAM
             if cfg.task != "instance":
@@ -158,7 +162,10 @@ class Trainer:
                     f"data.device_guidance supports {_DEV_FAM}, not "
                     f"{cfg.data.guidance!r}")
         if cfg.task == "instance":
-            train_tf = build_train_transform(
+            prepared = bool(cfg.data.prepared_cache)
+            # Prepared cache owns the deterministic crop stage itself; the
+            # wrapped dataset must stay untransformed.
+            train_tf = None if prepared else build_train_transform(
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                 zero_pad=cfg.data.zero_pad, rots=cfg.data.rots,
                 scales=cfg.data.scales, alpha=cfg.data.guidance_alpha,
@@ -195,6 +202,24 @@ class Trainer:
                     decode_cache=cfg.data.decode_cache)
                 self.train_set = CombinedDataset(
                     [self.train_set, sbd], excluded=[self.val_set])
+            if prepared:
+                from ..data import (
+                    PreparedInstanceDataset,
+                    build_prepared_post_transform,
+                )
+                self.train_set = PreparedInstanceDataset(
+                    self.train_set, cfg.data.prepared_cache,
+                    crop_size=cfg.data.crop_size, relax=cfg.data.relax,
+                    zero_pad=cfg.data.zero_pad,
+                    fused_crop_resize=cfg.data.fused_crop_resize,
+                    post_transform=build_prepared_post_transform(
+                        rots=cfg.data.rots, scales=cfg.data.scales,
+                        alpha=cfg.data.guidance_alpha,
+                        guidance=("none" if cfg.data.device_guidance
+                                  else cfg.data.guidance),
+                        flip=not cfg.data.device_augment,
+                        geom=not (cfg.data.device_augment
+                                  and cfg.data.device_augment_geom)))
         elif cfg.task == "semantic":
             self.train_set = VOCSemanticSegmentation(
                 root, split=cfg.data.train_split,

@@ -1,0 +1,210 @@
+"""Prepared-sample disk cache (data.prepared_cache): fill/read parity,
+fingerprint invalidation, fresh per-epoch randomness, loader and Trainer
+integration."""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.data import (
+    DataLoader,
+    PreparedInstanceDataset,
+    VOCInstanceSegmentation,
+    build_prepared_post_transform,
+    build_train_transform,
+    cache_fingerprint,
+)
+from distributedpytorch_tpu.data import transforms as T
+from distributedpytorch_tpu.data.pipeline import sample_rng
+
+
+def make_base(root, **kw):
+    return VOCInstanceSegmentation(root, split="train", transform=None,
+                                   preprocess=True, area_thres=0, **kw)
+
+
+@pytest.fixture()
+def base(fake_voc_root):
+    return make_base(fake_voc_root)
+
+
+class TestCacheCore:
+    def test_fill_then_read_identical(self, base, tmp_path):
+        ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10)
+        assert ds.n_prepared == 0
+        first = ds[0]           # fill
+        assert ds.n_prepared == 1
+        again = ds[0]           # memmap read
+        np.testing.assert_array_equal(first["crop_image"],
+                                      again["crop_image"])
+        np.testing.assert_array_equal(first["crop_gt"], again["crop_gt"])
+        np.testing.assert_array_equal(first["bbox"], again["bbox"])
+        assert first["meta"] == again["meta"]
+
+    def test_matches_uncached_stage1_within_rounding(self, base, tmp_path):
+        ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10)
+        ref_tf = T.Compose([
+            T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
+                                 relax=10, zero_pad=True),
+            T.FixedResize(resolutions={"crop_image": (64, 64),
+                                       "crop_gt": (64, 64)}),
+            T.ClampRange(("crop_image",)),
+        ])
+        for i in (0, 1, len(ds) - 1):
+            want = ref_tf(base.__getitem__(i), None)
+            got = ds[i]
+            # image quantized to uint8 in the cache: within rounding
+            assert np.abs(got["crop_image"] -
+                          want["crop_image"]).max() <= 0.5
+            np.testing.assert_array_equal(got["crop_gt"],
+                                          np.asarray(want["crop_gt"],
+                                                     np.float32))
+            np.testing.assert_array_equal(got["bbox"], want["bbox"])
+            assert got["meta"]["image"] == want["meta"]["image"]
+            assert got["meta"]["im_size"] == tuple(want["meta"]["im_size"])
+            assert got["meta"]["category"] == want["meta"]["category"]
+
+    def test_cache_persists_across_instances(self, base, tmp_path):
+        d = str(tmp_path / "prep")
+        ds = PreparedInstanceDataset(base, d, crop_size=(64, 64), relax=10)
+        ds.prebuild()
+        assert ds.n_prepared == len(ds)
+        ds2 = PreparedInstanceDataset(base, d, crop_size=(64, 64), relax=10)
+        assert ds2.n_prepared == len(ds2)  # reopened, nothing recomputed
+
+    def test_fingerprint_invalidation(self, base, tmp_path):
+        d = str(tmp_path / "prep")
+        ds = PreparedInstanceDataset(base, d, crop_size=(64, 64), relax=10)
+        ds.prebuild()
+        # any crop-config change keys a different cache
+        changed = PreparedInstanceDataset(base, d, crop_size=(64, 64),
+                                          relax=20)
+        assert changed.fingerprint != ds.fingerprint
+        assert changed.cache_dir != ds.cache_dir
+        assert changed.n_prepared == 0
+        assert cache_fingerprint(base, (64, 64), 10, True, False) == \
+            ds.fingerprint
+
+    def test_wrapping_transformed_dataset_rejected(self, fake_voc_root,
+                                                   tmp_path):
+        with_tf = VOCInstanceSegmentation(
+            fake_voc_root, split="train", transform=build_train_transform(),
+            preprocess=True, area_thres=0)
+        with pytest.raises(ValueError, match="transform=None"):
+            PreparedInstanceDataset(with_tf, str(tmp_path / "p"))
+
+    def test_combined_dataset_meta_delegates(self, base, fake_voc_root,
+                                             tmp_path):
+        # sbd_root + prepared_cache: meta schema must match the uncached
+        # pipeline's (image/object/category/im_size) through the wrapper
+        from distributedpytorch_tpu.data import (
+            CombinedDataset,
+            SBDInstanceSegmentation,
+            make_fake_sbd,
+        )
+        sbd_root = make_fake_sbd(str(tmp_path / "sbd"), n_images=3,
+                                 size=(100, 140), seed=1)
+        sbd = SBDInstanceSegmentation(sbd_root, split=["train"],
+                                      transform=None, preprocess=True,
+                                      area_thres=0)
+        combined = CombinedDataset([base, sbd])
+        ds = PreparedInstanceDataset(combined, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10)
+        for i in (0, len(ds) - 1):  # one VOC-side, one SBD-side sample
+            meta = ds[i]["meta"]
+            assert set(meta) == {"image", "object", "category", "im_size"}
+            assert meta["image"] == combined.sample_image_id(i)
+
+    def test_pickle_roundtrip_reopens_maps(self, base, tmp_path):
+        import pickle
+        ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10)
+        ds[0]
+        ds.flush()
+        ds2 = pickle.loads(pickle.dumps(ds))
+        assert ds2.n_prepared == ds.n_prepared
+        np.testing.assert_array_equal(ds[0]["crop_gt"], ds2[0]["crop_gt"])
+
+
+class TestRandomStage:
+    def post(self):
+        return build_prepared_post_transform(alpha=0.6)
+
+    def test_deterministic_given_rng(self, base, tmp_path):
+        ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10,
+                                     post_transform=self.post())
+        a = ds.__getitem__(0, rng=sample_rng(0, 0, 0))
+        b = ds.__getitem__(0, rng=sample_rng(0, 0, 0))
+        np.testing.assert_array_equal(a["concat"], b["concat"])
+
+    def test_fresh_randomness_per_epoch(self, base, tmp_path):
+        ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10,
+                                     post_transform=self.post())
+        outs = [ds.__getitem__(0, rng=sample_rng(0, ep, 0))["concat"]
+                for ep in range(6)]
+        # flip/rotate/guidance jitter: not all epochs identical
+        assert any(not np.array_equal(outs[0], o) for o in outs[1:])
+
+    def test_contract_keys_and_ranges(self, base, tmp_path):
+        ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10,
+                                     post_transform=self.post())
+        s = ds.__getitem__(0, rng=sample_rng(0, 0, 0))
+        assert s["concat"].shape == (64, 64, 4)
+        assert s["concat"].dtype == np.float32
+        assert 0.0 <= s["concat"].min() and s["concat"].max() <= 255.0
+        gt = s["crop_gt"]
+        assert set(np.unique(gt)) <= {0.0, 1.0}
+        assert s["bbox"].shape == (4,)
+
+
+class TestLoaderIntegration:
+    def test_epoch2_serves_entirely_from_cache(self, base, tmp_path):
+        ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10,
+                                     post_transform=build_prepared_post_transform())
+        loader = DataLoader(ds, batch_size=4, shuffle=True, drop_last=False,
+                            seed=0, num_workers=2)
+        loader.set_epoch(0)
+        n0 = sum(b["concat"].shape[0] for b in loader)
+        assert n0 == len(ds)
+        assert ds.n_prepared == len(ds)  # one shuffled epoch fills it
+        loader.set_epoch(1)
+        batches = list(loader)
+        assert sum(b["concat"].shape[0] for b in batches) == len(ds)
+        assert all(b["concat"].shape[1:] == (64, 64, 4) for b in batches)
+
+
+class TestTrainerIntegration:
+    def test_fit_with_prepared_cache(self, tmp_path):
+        from tests.test_train import make_tiny_cfg
+        from distributedpytorch_tpu.train import Trainer
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, epochs=2,
+            data=dataclasses.replace(cfg.data,
+                                     prepared_cache=str(tmp_path / "prep")))
+        tr = Trainer(cfg)
+        history = tr.fit()
+        assert all(np.isfinite(l) for l in history["train_loss"])
+        assert 0.0 <= history["val"][-1]["jaccard"] <= 1.0
+        assert tr.train_set.n_prepared == len(tr.train_set)
+        tr.close()
+
+    def test_semantic_task_rejects_prepared_cache(self, tmp_path):
+        from tests.test_train import make_tiny_cfg
+        from distributedpytorch_tpu.train import Trainer
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, task="semantic",
+            model=dataclasses.replace(cfg.model, nclass=21),
+            data=dataclasses.replace(cfg.data,
+                                     prepared_cache=str(tmp_path / "prep")))
+        with pytest.raises(ValueError, match="prepared_cache"):
+            Trainer(cfg)
