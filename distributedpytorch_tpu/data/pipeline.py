@@ -111,6 +111,7 @@ def build_prepared_post_transform(
     guidance: str = "nellipse_gaussians",
     flip: bool = True,
     geom: bool = True,
+    uint8_wire: bool = False,
 ) -> T.Compose:
     """The per-epoch random stage downstream of the prepared-sample cache
     (data.prepared_cache): the cache already holds the deterministic
@@ -119,12 +120,20 @@ def build_prepared_post_transform(
     device_augment_geom semantics: the warp sees the fixed-size crop, not
     the pre-crop full image), guidance synthesis, concat.  ``flip``/``geom``
     gate the host stages exactly like :func:`build_train_transform` when the
-    on-device augmentation owns them instead."""
+    on-device augmentation owns them instead.
+
+    ``uint8_wire`` (data.uint8_transfer) keeps uint8 arrays uint8 through
+    ``ToArray`` — with the uint8 cache upstream, ``concat``/``crop_gt``
+    ship to the device at a quarter of the float32 bytes.  The terminal
+    ``Keep`` prunes everything the step doesn't consume so ``collate``
+    stops memcpy'ing dead intermediates.
+    """
     return T.Compose([
         *([T.RandomHorizontalFlip()] if flip else []),
         *([T.ScaleNRotate(rots=rots, scales=scales)] if geom else []),
         *_guidance_stage(guidance, alpha, is_val=False),
-        T.ToArray(),
+        T.ToArray(uint8_passthrough=uint8_wire),
+        T.Keep(("concat", "crop_gt")),
     ])
 
 
@@ -147,6 +156,10 @@ def build_eval_transform(
         T.CropFromMaskStatic(crop_elems=("image", "gt"), mask_elem="gt",
                              relax=relax, zero_pad=zero_pad),
         T.FixedResize(resolutions=resolutions),
+        # the val stack has no uint8 cast upstream of the cubic resize, so
+        # the [0,255] input contract (reference train_pascal.py:239-241
+        # asserts it in the val loop too) needs an explicit clamp
+        T.ClampRange(("crop_image",)),
     ]
     chain += _guidance_stage(guidance, alpha, is_val=True)
     chain.append(T.ToArray())
@@ -209,6 +222,10 @@ def build_semantic_train_transform(
           if geom else []),
         T.FixedResize(resolutions={"image": crop_size, "gt": crop_size},
                       flagvals={"image": None, "gt": 0}),
+        # cubic resize overshoots at contrast edges; the [0,255] input
+        # contract (and its debug assert) needs the explicit clamp here
+        # just like the instance chains
+        T.ClampRange(("image",)),
         T.Rename({"image": "concat", "gt": "crop_gt"}),
         T.ToArray(),
     ])
@@ -222,6 +239,7 @@ def build_semantic_eval_transform(
     return T.Compose([
         T.FixedResize(resolutions={"image": crop_size, "gt": crop_size},
                       flagvals={"image": None, "gt": 0}),
+        T.ClampRange(("image",)),  # cubic-overshoot clamp, as in train
         T.Rename({"image": "concat", "gt": "crop_gt"}),
         T.ToArray(),
     ])

@@ -95,7 +95,7 @@ class PreparedInstanceDataset:
     def __init__(self, dataset, cache_dir: str,
                  crop_size=(512, 512), relax: int = 50,
                  zero_pad: bool = True, fused_crop_resize: bool = False,
-                 post_transform=None):
+                 post_transform=None, uint8_arrays: bool = False):
         if getattr(dataset, "transform", None) is not None:
             raise ValueError(
                 "PreparedInstanceDataset wraps the *untransformed* dataset "
@@ -108,6 +108,11 @@ class PreparedInstanceDataset:
         self.zero_pad = bool(zero_pad)
         self.fused_crop_resize = bool(fused_crop_resize)
         self.post_transform = post_transform
+        #: serve uint8 crop arrays as-is (the data.uint8_transfer wire
+        #: format — skips two full-array float casts per sample; all host
+        #: transforms downstream are uint8-safe: flip, the uint8-casting
+        #: warp, guidance-from-binary-mask)
+        self.uint8_arrays = bool(uint8_arrays)
 
         # THE shared crop front (pipeline.build_crop_stage): one definition
         # keeps the cached bytes from diverging from the live pipeline.
@@ -224,13 +229,14 @@ class PreparedInstanceDataset:
                 img8, bits, bbox, im_size = self._fill(index)
         else:
             img8, bits, bbox, im_size = self._fill(index)
-        gt = np.unpackbits(bits, count=h * w).reshape(h, w) \
-            .astype(np.float32)
-        sample = {
-            "crop_image": img8.astype(np.float32),
-            "crop_gt": gt,
-            "meta": self._meta(index, im_size),
-        }
+        gt = np.unpackbits(bits, count=h * w).reshape(h, w)
+        if self.uint8_arrays:
+            sample = {"crop_image": np.ascontiguousarray(img8),
+                      "crop_gt": gt}
+        else:
+            sample = {"crop_image": img8.astype(np.float32),
+                      "crop_gt": gt.astype(np.float32)}
+        sample["meta"] = self._meta(index, im_size)
         if self.post_transform is not None:
             sample = self.post_transform(sample, rng)
         # bbox joins AFTER the random stage: flip/rotate iterate every array

@@ -324,18 +324,25 @@ class FusedCropResize(Transform):
         win = arr[max(bbox[1], 0): bbox[3] + 1, max(bbox[0], 0): bbox[2] + 1]
         return helpers.resize_interp_flag(win)
 
+    def _two_stage(self, sample, rng):
+        return Compose([
+            CropFromMaskStatic(crop_elems=self.crop_elems,
+                               mask_elem=self.mask_elem,
+                               relax=self.relax, zero_pad=self.zero_pad),
+            FixedResize(resolutions={
+                "crop_" + e: self.size for e in self.crop_elems}),
+        ])(sample, rng)
+
     def __call__(self, sample, rng=None):
         from .. import native_ops
 
         if not (native_ops.enabled() and native_ops.has_crop_resize()):
-            two_stage = Compose([
-                CropFromMaskStatic(crop_elems=self.crop_elems,
-                                   mask_elem=self.mask_elem,
-                                   relax=self.relax, zero_pad=self.zero_pad),
-                FixedResize(resolutions={
-                    "crop_" + e: self.size for e in self.crop_elems}),
-            ])
-            return two_stage(sample, rng)
+            return self._two_stage(sample, rng)
+        if np.asarray(sample[self.mask_elem]).ndim != 2:
+            # Multi-channel mask: the pair's contract is per-channel crop
+            # LISTS (custom_transforms.py:350-370) which the fused kernel
+            # does not reproduce — route through the exact two-stage path.
+            return self._two_stage(sample, rng)
 
         mask = sample[self.mask_elem]
         bbox = helpers.get_bbox(mask, pad=self.relax, zero_pad=self.zero_pad)
@@ -350,11 +357,13 @@ class FusedCropResize(Transform):
         if bbox is None:
             bbox = (0, 0, mask.shape[1] - 1, mask.shape[0] - 1)
         sample["bbox"] = np.asarray(bbox, dtype=np.int64)
-        # FixedResize's pruning rule: everything not produced goes.
+        # FixedResize's pruning rule: everything not produced goes (with
+        # FixedResize's own exemptions: meta/bbox/crop_relax AND the
+        # extreme_points_coord payload it rescales rather than deletes).
         produced = {"crop_" + e for e in self.crop_elems}
         for key in list(sample.keys()):
             if key in produced or "meta" in key or "bbox" in key \
-                    or "crop_relax" in key:
+                    or "crop_relax" in key or key == "extreme_points_coord":
                 continue
             del sample[key]
         return sample
@@ -605,6 +614,26 @@ class Rename(Transform):
         return f"Rename({self.mapping})"
 
 
+class Keep(Transform):
+    """Delete every sample key except the listed ones (``meta`` always
+    survives) — the terminal pruning step for hot-path pipelines, so
+    ``collate`` never stacks arrays nothing downstream consumes (the
+    intermediate ``crop_image``/guidance maps are a ~4x memcpy tax per
+    batch once ``concat`` is assembled)."""
+
+    def __init__(self, keys: Sequence[str]):
+        self.keys = tuple(keys)
+
+    def __call__(self, sample, rng=None):
+        for key in list(sample.keys()):
+            if key not in self.keys and not _is_meta(key):
+                del sample[key]
+        return sample
+
+    def __repr__(self):
+        return f"Keep({self.keys})"
+
+
 class ClampRange(Transform):
     """Clamp named elements into ``[lo, hi]``.
 
@@ -640,7 +669,14 @@ class ToArray(Transform):
     but the layout stays HWC (NHWC batches are what XLA/TPU convolutions
     want) instead of transposing to CHW.  ``bbox`` converts without the
     channel rule; ``crop_relax``/meta pass through.
+
+    ``uint8_passthrough`` keeps arrays that arrive as uint8 in uint8 (the
+    wire format of ``data.uint8_transfer``: 4x fewer H2D bytes; the step
+    dequantizes on device) — everything else still casts to float32.
     """
+
+    def __init__(self, uint8_passthrough: bool = False):
+        self.uint8_passthrough = uint8_passthrough
 
     def __call__(self, sample, rng=None):
         for key, val in sample.items():
@@ -649,8 +685,14 @@ class ToArray(Transform):
             if "bbox" in key:
                 sample[key] = np.asarray(val)
                 continue
-            arr = np.asarray(val, dtype=np.float32)
+            arr = np.asarray(val)
+            if not (self.uint8_passthrough and arr.dtype == np.uint8):
+                # copy=False: already-float32 arrays pass through un-copied
+                arr = arr.astype(np.float32, copy=False)
             if arr.ndim == 2:
                 arr = arr[:, :, np.newaxis]
             sample[key] = arr
         return sample
+
+    def __repr__(self):
+        return f"ToArray(uint8_passthrough={self.uint8_passthrough})"

@@ -143,22 +143,40 @@ def prefetch_to_device(batches, mesh: Mesh, size: int = 2,
     ``keys`` filters each dict to the device-bound arrays (eval batches
     carry ragged host-side lists that cannot be placed).  ``size=0``
     degrades to synchronous per-step placement.
+
+    Placement runs on a dedicated thread: ``device_put`` of a large batch
+    is far from free on the calling thread (layout/copy work before the DMA
+    — ~146 ms for a 33 MB float batch through a tunneled chip), and done
+    inline it serializes against the step dispatch this prefetcher exists
+    to overlap.  One worker keeps placements ordered.
     """
     import collections
-
-    queue: collections.deque = collections.deque()
+    import concurrent.futures as cf
 
     def place(batch):
         if keys is not None:
             batch = {k: v for k, v in batch.items() if k in keys}
         return shard_batch(mesh, batch)
 
-    for batch in batches:
-        queue.append(place(batch))
-        if len(queue) > max(0, size):
-            yield queue.popleft()
-    while queue:
-        yield queue.popleft()
+    if size <= 0:  # synchronous degradation
+        for batch in batches:
+            yield place(batch)
+        return
+
+    futures: collections.deque = collections.deque()
+    with cf.ThreadPoolExecutor(max_workers=1) as pool:
+        try:
+            for batch in batches:
+                futures.append(pool.submit(place, batch))
+                if len(futures) > size:
+                    yield futures.popleft().result()
+            while futures:
+                yield futures.popleft().result()
+        finally:
+            # abandoned generator (early break/exception upstream): drop
+            # queued placements so shutdown doesn't run them pointlessly
+            while futures:
+                futures.popleft().cancel()
 
 
 def pad_to_multiple(batch: Mapping[str, np.ndarray], multiple: int

@@ -76,6 +76,12 @@ class DataConfig:
                                         # Keyed by a config fingerprint —
                                         # changing crop knobs rebuilds.
                                         # ~0.75 MB/sample at 512².
+    uint8_transfer: bool = False        # ship train batches to the device
+                                        # as uint8 (4x fewer H2D bytes and
+                                        # host memcpys; the compiled step
+                                        # dequantizes on device).  Requires
+                                        # prepared_cache (whose arrays are
+                                        # uint8-exact by construction).
     decode_cache: int = 0               # decode-once LRU over this many
                                         # images (FFCV-style; instance mode
                                         # revisits an image once per object
@@ -102,7 +108,12 @@ class ModelConfig:
     dtype: str = "float32"              # 'bfloat16' = BASELINE config 3
     loss_weights: tuple[float, ...] | None = None
     pam_block_size: int | None = None   # blocked position-attention
-    pam_impl: str = "einsum"            # einsum | flash (pallas) | ring
+    pam_impl: str = "einsum"            # auto | einsum | flash (pallas)
+                                        # | ring.  auto = einsum while the
+                                        # N^2 scores fit HBM (measured
+                                        # fastest through 32k tokens on
+                                        # v5e), flash at >=64k tokens where
+                                        # einsum cannot run at all
                                         # (ring = sequence-parallel PAM over
                                         # the mesh's model axis)
     remat: bool = False                 # rematerialize backbone blocks
@@ -125,6 +136,15 @@ class OptimConfig:
     poly_power: float = 0.9
     warmup_steps: int = 0
     accum_steps: int = 1                # the reference's nAveGrad knob
+    loss_scale: float = 1.0             # static loss scaling for bf16
+                                        # regimes: loss is scaled before the
+                                        # backward pass and gradients
+                                        # unscaled after, guarding tiny
+                                        # gradients against bf16/f32
+                                        # underflow at aggressive LRs.  The
+                                        # reported loss is unscaled.  1.0 =
+                                        # off (the flagship's bf16 runs are
+                                        # stable without it, BASELINE.md).
     grad_clip_norm: float | None = None
     freeze: tuple[str, ...] = ()        # param-path prefixes to freeze
     lr_mult: dict[str, float] | None = None  # per-prefix LR multipliers

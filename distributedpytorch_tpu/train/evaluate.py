@@ -67,18 +67,22 @@ def evaluate(
     zero_pad: bool = True,
     mesh=None,
     max_batches: int | None = None,
+    debug_asserts: bool = False,
 ) -> dict:
     """Run the full validation protocol; returns a metrics dict.
 
     ``loader`` yields batches with device keys (``concat``/``crop_gt``) plus
     host-side full-res ``gt``/``void_pixels`` (kept by the eval transform's
     ``None`` resolutions, reference train_pascal.py:138).
+
+    ``debug_asserts`` re-enables the reference's per-batch data-contract
+    checks in the val loop too (train_pascal.py:239-241 asserted in BOTH
+    loops).
     """
     thresholds = tuple(thresholds)
     jac_sum = np.zeros(len(thresholds))
     n_samples = 0
-    loss_sum = 0.0
-    n_batches = 0
+    losses: list = []  # device scalars; ONE bulk readback at epoch end
     first_batch_vis = None
     t0 = time.perf_counter()
 
@@ -86,6 +90,8 @@ def evaluate(
     for bi, batch in enumerate(loader):
         if max_batches is not None and bi >= max_batches:
             break
+        if debug_asserts:
+            batch_debug_asserts(batch)
         n = batch[INPUT_KEY].shape[0]
         device_keys = {k: v for k, v in batch.items()
                        if k in (INPUT_KEY, "crop_gt", "crop_void")}
@@ -93,8 +99,10 @@ def evaluate(
         if mesh is not None:
             padded = shard_batch(mesh, padded)
         outputs, loss = eval_step(state, padded)
-        loss_sum += float(loss)
-        n_batches += 1
+        # deferred: float(loss) here would add a host<->device round trip
+        # per val batch (~70ms each through a tunneled chip) on top of the
+        # outputs fetch — the same stall train_epoch's bulk readback fixed
+        losses.append(loss)
         # primary head only; ragged paste-back per sample on host
         probs = _sigmoid(_local_rows(outputs[0])[:n])
         if first_batch_vis is None:
@@ -127,6 +135,8 @@ def evaluate(
                 jac_sum[ti] += np_jaccard(full > th, gt > 0.5, void)
             n_samples += 1
 
+    loss_sum = float(np.sum(jax.device_get(losses))) if losses else 0.0
+    n_batches = len(losses)
     # Multi-host: every process evaluated only its loader shard; reduce the
     # raw sums across processes so all hosts hold identical global metrics —
     # the best-checkpoint gate must not diverge (the collective best-save
@@ -168,6 +178,21 @@ def batch_debug_asserts(batch: Mapping[str, np.ndarray]) -> None:
     assert np.all(np.isin(uniq, (0.0, 1.0))), f"gt not binary: {uniq[:5]}"
 
 
+def semantic_batch_debug_asserts(batch: Mapping[str, np.ndarray],
+                                 nclass: int,
+                                 ignore_index: int = 255) -> None:
+    """Semantic-task counterpart of :func:`batch_debug_asserts`: image
+    channels within [0,255] and non-degenerate, gt restricted to valid
+    class ids plus the in-band void value."""
+    x = np.asarray(batch[INPUT_KEY])
+    assert x.min() >= 0.0 and x.max() <= 255.0, "input outside [0,255]"
+    assert len(np.unique(x[..., :3])) > 2, "degenerate RGB channels"
+    uniq = np.unique(np.asarray(batch["crop_gt"]))
+    valid = np.concatenate([np.arange(nclass), [ignore_index]])
+    assert np.all(np.isin(uniq, valid)), \
+        f"gt ids outside 0..{nclass - 1} u {{{ignore_index}}}: {uniq[:8]}"
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _batch_confusion(outputs, labels, nclass: int, ignore_index: int):
     """argmax + confusion counts, compiled once per (nclass, ignore) pair
@@ -192,6 +217,7 @@ def evaluate_semantic(
     max_batches: int | None = None,
     tta_scales: tuple[float, ...] = (),
     tta_flip: bool = False,
+    debug_asserts: bool = False,
 ) -> dict:
     """Multi-class semantic validation: confusion-matrix mIoU.
 
@@ -223,7 +249,8 @@ def evaluate_semantic(
     tta = bool(tta_flip or any(s != 1.0 for s in tta_scales))
     scale_list = list(tta_scales) if tta_scales else [1.0]
     conf = np.zeros((nclass, nclass), np.int64)
-    loss_sum, n_batches = 0.0, 0
+    confs: list = []   # device (C,C) counts; bulk-read at epoch end
+    losses: list = []  # device scalars; same deferred-sync policy
     t0 = time.perf_counter()
 
     def forward_probs(inp: np.ndarray, gt: np.ndarray):
@@ -240,6 +267,8 @@ def evaluate_semantic(
     for bi, batch in enumerate(loader):
         if max_batches is not None and bi >= max_batches:
             break
+        if debug_asserts:
+            semantic_batch_debug_asserts(batch, nclass, ignore_index)
         n = batch[INPUT_KEY].shape[0]
         if not tta:
             device_keys = {k: v for k, v in batch.items()
@@ -248,15 +277,14 @@ def evaluate_semantic(
             if mesh is not None:
                 padded = shard_batch(mesh, padded)
             outputs, loss = eval_step(state, padded)
-            loss_sum += float(loss)
-            n_batches += 1
+            losses.append(loss)
             # Padding repeats real samples; drop them from the counts by
             # scoring only the first n rows (host-local multi-host).
             out0 = _local_rows(outputs[0])[:n]
             labels = _local_rows(padded["crop_gt"])[:n]
-            conf += np.asarray(_batch_confusion(
+            confs.append(_batch_confusion(
                 jnp.asarray(out0), jnp.asarray(labels), nclass,
-                ignore_index), np.int64)
+                ignore_index))
             continue
 
         inp = np.asarray(batch[INPUT_KEY])
@@ -265,8 +293,7 @@ def evaluate_semantic(
         # the plain pass always runs — it is THE reported loss; it votes
         # only if 1.0 is a configured scale
         base_probs, loss = forward_probs(inp, gt)
-        loss_sum += float(loss)
-        n_batches += 1
+        losses.append(loss)
         probs = np.zeros_like(base_probs)
         votes = 0
         for s in scale_list:
@@ -296,10 +323,14 @@ def evaluate_semantic(
                         for pp in p_f])
                 probs += p_f
                 votes += 1
-        conf += np.asarray(_batch_confusion(
+        confs.append(_batch_confusion(
             jnp.asarray(probs / votes), jnp.asarray(gt), nclass,
-            ignore_index), np.int64)
+            ignore_index))
 
+    if confs:  # one bulk readback for every deferred device value
+        conf += np.sum(np.asarray(jax.device_get(confs), np.int64), axis=0)
+    loss_sum = float(np.sum(jax.device_get(losses))) if losses else 0.0
+    n_batches = len(losses)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         gathered = multihost_utils.process_allgather(

@@ -57,7 +57,12 @@ from .checkpoint import (
     latest_checkpoint_dir,
     next_run_dir,
 )
-from .evaluate import batch_debug_asserts, evaluate, evaluate_semantic
+from .evaluate import (
+    batch_debug_asserts,
+    evaluate,
+    evaluate_semantic,
+    semantic_batch_debug_asserts,
+)
 from .logging import (
     MetricWriter,
     MultiWriter,
@@ -151,6 +156,12 @@ class Trainer:
             raise ValueError("data.prepared_cache caches the instance "
                              "pipeline's crop stage; the semantic pipeline "
                              "has no deterministic crop front to cache")
+        if cfg.data.uint8_transfer and not cfg.data.prepared_cache:
+            raise ValueError(
+                "data.uint8_transfer needs data.prepared_cache: only the "
+                "prepared pipeline is uint8-exact end-to-end (the plain "
+                "pipeline's cubic resize leaves fractional float values "
+                "that quantization would silently alter)")
         if cfg.data.device_guidance:
             from ..ops.guidance_device import FAMILIES as _DEV_FAM
             if cfg.task != "instance":
@@ -212,6 +223,7 @@ class Trainer:
                     crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                     zero_pad=cfg.data.zero_pad,
                     fused_crop_resize=cfg.data.fused_crop_resize,
+                    uint8_arrays=cfg.data.uint8_transfer,
                     post_transform=build_prepared_post_transform(
                         rots=cfg.data.rots, scales=cfg.data.scales,
                         alpha=cfg.data.guidance_alpha,
@@ -219,7 +231,8 @@ class Trainer:
                                   else cfg.data.guidance),
                         flip=not cfg.data.device_augment,
                         geom=not (cfg.data.device_augment
-                                  and cfg.data.device_augment_geom)))
+                                  and cfg.data.device_augment_geom),
+                        uint8_wire=cfg.data.uint8_transfer))
         elif cfg.task == "semantic":
             self.train_set = VOCSemanticSegmentation(
                 root, split=cfg.data.train_split,
@@ -346,7 +359,8 @@ class Trainer:
             accum_steps=cfg.optim.accum_steps, mesh=self.mesh,
             loss_type=loss_type, state_shardings=st_sh, augment=augment,
             aux_loss_weight=(cfg.model.moe_aux_weight
-                             if cfg.model.moe_experts else 0.0))
+                             if cfg.model.moe_experts else 0.0),
+            loss_scale=cfg.optim.loss_scale)
         self.eval_step = make_eval_step(
             self.model, loss_weights=cfg.model.loss_weights, mesh=self.mesh,
             loss_type=loss_type, state_shardings=st_sh)
@@ -565,8 +579,11 @@ class Trainer:
 
         def host_batches():
             for batch in self.train_loader:
-                if cfg.debug_asserts and cfg.task == "instance":
-                    batch_debug_asserts(batch)
+                if cfg.debug_asserts:
+                    if cfg.task == "instance":
+                        batch_debug_asserts(batch)
+                    else:
+                        semantic_batch_debug_asserts(batch, cfg.model.nclass)
                 yield batch
 
         def echoed(it):
@@ -593,18 +610,50 @@ class Trainer:
                 if guard is not None and guard.should_stop(step):
                     interrupted = True
                     break
-                if self.is_main and step % cfg.log_every_steps == 0:
-                    self.writer.scalars(  # float(loss) syncs — log steps only
-                        {"train/loss": float(loss),
-                         "train/lr": float(self.schedule(step)),
-                         "train/epoch": epoch}, step)
+                if step % cfg.log_every_steps == 0:
+                    # The log-cadence sync runs on EVERY process, not just
+                    # main: the watchdog below must raise on all hosts
+                    # together (loss is replicated, so they all see the
+                    # same value) — a main-only raise would leave the other
+                    # processes blocked forever at their next collective.
+                    loss_now = float(loss)
+                    if cfg.debug_asserts and not np.isfinite(loss_now):
+                        # bf16 watchdog: surface divergence at the log
+                        # cadence instead of training garbage for the rest
+                        # of the epoch (see also the epoch-end sweep below)
+                        raise FloatingPointError(
+                            f"non-finite train loss {loss_now} at step "
+                            f"{step} (epoch {epoch}) — divergence; lower "
+                            "optim.lr, enable optim.grad_clip_norm, or set "
+                            "optim.loss_scale for bf16 underflow")
+                    if self.is_main:
+                        self.writer.scalars(
+                            {"train/loss": loss_now,
+                             "train/lr": float(self.schedule(step)),
+                             "train/epoch": epoch}, step)
             else:
                 interrupted = False
         # One bulk readback, not one float() per step: each scalar fetch is a
         # full host<->device round trip (~70ms through a tunneled chip — per-
         # step syncs would dwarf the epoch itself).
-        mean_loss = float(np.mean(jax.device_get(losses))) if losses \
-            else float("nan")
+        loss_arr = np.asarray(jax.device_get(losses)) if losses else \
+            np.array([np.nan])
+        bad = np.flatnonzero(~np.isfinite(loss_arr))
+        if bad.size and losses:
+            # Epoch-end non-finite sweep (free: the losses are already on
+            # host).  Always logged; fatal under debug_asserts.
+            msg = (f"{bad.size}/{len(losses)} non-finite train losses this "
+                   f"epoch (first at epoch step {int(bad[0])}) — divergence "
+                   "or bf16 underflow; lower optim.lr, enable "
+                   "optim.grad_clip_norm, or set optim.loss_scale")
+            if cfg.debug_asserts:
+                raise FloatingPointError(msg)
+            if self.is_main:
+                print(f"warning: {msg}", flush=True)
+                self.writer.scalars(
+                    {"train/nonfinite_steps": int(bad.size)},
+                    int(self.state.step))
+        mean_loss = float(np.mean(loss_arr)) if losses else float("nan")
         dt = time.perf_counter() - t0
         # Distinct images ingested — echoed repeats of a batch are not fresh
         # data; reporting them would make any echo setting look like a win.
@@ -634,14 +683,24 @@ class Trainer:
                     self.eval_step, self.state, self.val_loader,
                     nclass=self.cfg.model.nclass, mesh=self.mesh,
                     tta_scales=self.cfg.eval_tta_scales,
-                    tta_flip=self.cfg.eval_tta_flip)
+                    tta_flip=self.cfg.eval_tta_flip,
+                    debug_asserts=self.cfg.debug_asserts)
             else:
                 metrics = evaluate(
                     self.eval_step, self.state, self.val_loader,
                     thresholds=self.cfg.eval_thresholds,
                     relax=self.cfg.data.relax,
-                    zero_pad=self.cfg.data.zero_pad, mesh=self.mesh)
+                    zero_pad=self.cfg.data.zero_pad, mesh=self.mesh,
+                    debug_asserts=self.cfg.debug_asserts)
         first = metrics.pop("_first_batch", None)
+        if self.cfg.debug_asserts and not np.isfinite(metrics["loss"]):
+            # Watchdog, val side: a 1-step epoch's train loss is computed
+            # BEFORE the diverging update, so the val loss can be the first
+            # place non-finite values surface.
+            raise FloatingPointError(
+                f"non-finite val loss {metrics['loss']} at epoch {epoch} — "
+                "divergence; lower optim.lr, enable optim.grad_clip_norm, "
+                "or set optim.loss_scale for bf16 underflow")
         if self.is_main:
             step = int(self.state.step)
             flat = {"val/loss": metrics["loss"],

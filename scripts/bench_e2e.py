@@ -53,6 +53,7 @@ from distributedpytorch_tpu.train import Config, Trainer, apply_overrides  # noq
 # VOC-like image sizes (VOC2012 images are ~500x375) so decode/crop/resize
 # cost what it costs on the real dataset.
 N_IMAGES = 8 if CPU_SMOKE else 120
+N_VAL = 2 if CPU_SMOKE else 16   # enough val samples for a stable val rate
 IMG_SIZE = (96, 128) if CPU_SMOKE else (375, 500)
 BATCH = 2 if CPU_SMOKE else 8
 EPOCHS_TIMED = 1 if CPU_SMOKE else 2  # after a warmup epoch (compile + caches)
@@ -60,6 +61,12 @@ EPOCHS_TIMED = 1 if CPU_SMOKE else 2  # after a warmup epoch (compile + caches)
 
 def run(fixture_root: str, overrides: dict) -> dict:
     work = tempfile.mkdtemp(prefix="bench_e2e_")
+    overrides = dict(overrides)
+    if overrides.get("data.prepared_cache") == "AUTO":
+        # shared across variants on purpose: same crop config -> same
+        # fingerprint -> later variants start warm (like a user's epoch 2+)
+        overrides["data.prepared_cache"] = os.path.join(
+            fixture_root, "prepared")
     cfg = apply_overrides(Config(), {
         "data.root": fixture_root,
         "data.train_batch": BATCH,
@@ -94,6 +101,14 @@ def run(fixture_root: str, overrides: dict) -> dict:
         if echo > 1:
             rec["step_imgs_per_sec_per_chip"] = round(
                 fresh * echo / dt / jax.device_count(), 2)
+        # Val-epoch rate (the full protocol: forward + host paste-back +
+        # threshold-swept Jaccard); first call compiles the eval step, the
+        # second is the steady-state number.
+        trainer.validate(log_panels=False)
+        vm = trainer.validate(log_panels=False)
+        rec["val_imgs_per_sec_per_chip"] = round(
+            vm["n_samples"] / vm["seconds"] / jax.device_count(), 2)
+        rec["val_seconds"] = round(vm["seconds"], 2)
         return rec
     finally:
         shutil.rmtree(work, ignore_errors=True)
@@ -102,7 +117,7 @@ def run(fixture_root: str, overrides: dict) -> dict:
 if __name__ == "__main__":
     fixture = tempfile.mkdtemp(prefix="bench_e2e_voc_")
     make_fake_voc(fixture, n_images=N_IMAGES, size=IMG_SIZE, max_objects=2,
-                  n_val=2)
+                  n_val=N_VAL)
     variants = [
         # reference-shape host pipeline: guidance synthesized on host
         dict(),
@@ -117,6 +132,20 @@ if __name__ == "__main__":
         # all inside the compiled step; host does decode -> crop -> resize
         {"data.device_guidance": True, "data.decode_cache": N_IMAGES,
          "data.device_augment": True, "data.device_augment_geom": True},
+        # prepared-sample disk cache: decode/crop/resize mmap-read after the
+        # fill epoch; host does flip + rotate/scale on the crop + guidance
+        {"data.prepared_cache": "AUTO"},
+        # + guidance on device: host is flip + rotate/scale + collate only
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True},
+        # + flip and rotate/scale on device too: host is mmap-read + collate
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.device_augment": True, "data.device_augment_geom": True},
+        # + uint8 wire format: 4x fewer H2D bytes and host memcpys
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True},
+        # the full package at global batch 16 (fewer dispatches per image)
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True, "data.train_batch": 16},
     ]
     sel = sys.argv[1:]
     try:

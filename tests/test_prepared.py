@@ -164,6 +164,63 @@ class TestRandomStage:
         assert s["bbox"].shape == (4,)
 
 
+class TestUint8Wire:
+    def test_uint8_batches_and_step_parity(self, base, tmp_path):
+        """uint8 wire format: same bytes, quarter the width — and the
+        compiled step dequantizes to the exact float values."""
+        import jax
+        import jax.numpy as jnp
+        kw = dict(crop_size=(64, 64), relax=10)
+        post8 = build_prepared_post_transform(guidance="none", flip=False,
+                                              geom=False, uint8_wire=True)
+        postf = build_prepared_post_transform(guidance="none", flip=False,
+                                              geom=False, uint8_wire=False)
+        ds8 = PreparedInstanceDataset(base, str(tmp_path / "p8"),
+                                      post_transform=post8,
+                                      uint8_arrays=True, **kw)
+        dsf = PreparedInstanceDataset(base, str(tmp_path / "pf"),
+                                      post_transform=postf, **kw)
+        s8 = ds8.__getitem__(0, rng=sample_rng(0, 0, 0))
+        sf = dsf.__getitem__(0, rng=sample_rng(0, 0, 0))
+        assert s8["concat"].dtype == np.uint8
+        assert s8["crop_gt"].dtype == np.uint8
+        assert sf["concat"].dtype == np.float32
+        np.testing.assert_array_equal(s8["concat"].astype(np.float32),
+                                      sf["concat"])
+        # post-transform Keep pruned dead intermediates
+        assert set(s8) == {"concat", "crop_gt", "meta", "bbox"}
+        # device-side dequantize: identical compute inputs
+        from distributedpytorch_tpu.parallel.step import _to_compute_dtype
+        out = _to_compute_dtype({"concat": jnp.asarray(s8["concat"]),
+                                 "crop_gt": jnp.asarray(s8["crop_gt"])})
+        assert out["concat"].dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(out["concat"]),
+                                      sf["concat"])
+
+    def test_trainer_uint8_transfer(self, tmp_path):
+        from tests.test_train import make_tiny_cfg
+        from distributedpytorch_tpu.train import Trainer
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, epochs=1,
+            data=dataclasses.replace(
+                cfg.data, prepared_cache=str(tmp_path / "prep"),
+                uint8_transfer=True, device_guidance=True))
+        tr = Trainer(cfg)
+        history = tr.fit()
+        assert all(np.isfinite(l) for l in history["train_loss"])
+        tr.close()
+
+    def test_uint8_transfer_requires_prepared_cache(self, tmp_path):
+        from tests.test_train import make_tiny_cfg
+        from distributedpytorch_tpu.train import Trainer
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, uint8_transfer=True))
+        with pytest.raises(ValueError, match="uint8_transfer"):
+            Trainer(cfg)
+
+
 class TestLoaderIntegration:
     def test_epoch2_serves_entirely_from_cache(self, base, tmp_path):
         ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
