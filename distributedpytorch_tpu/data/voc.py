@@ -46,6 +46,13 @@ CATEGORY_NAMES = [
     "pottedplant", "sheep", "sofa", "train", "tvmonitor",
 ]
 
+# Probed ONCE at import: os.umask() can only be read by setting it, which
+# mutates process-global state — doing that per-write raced loader/build
+# worker threads (a thread could briefly run with umask 0, or a cache file
+# could publish with the wrong mode).  Import happens before any workers.
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
 
 def ensure_voc(root: str, download: bool = False) -> str:
     """Ensure an extracted VOC2012 tree under ``root``; returns its path.
@@ -117,10 +124,9 @@ def write_obj_cache(path: str, obj_dict: dict) -> None:
         dir=os.path.dirname(path) or ".")
     try:
         # mkstemp creates 0600; publish with umask-honoring permissions so
-        # other users of a shared dataset root can read the cache.
-        umask = os.umask(0)
-        os.umask(umask)
-        os.fchmod(fd, 0o666 & ~umask)
+        # other users of a shared dataset root can read the cache (umask
+        # cached at import — see _UMASK above).
+        os.fchmod(fd, 0o666 & ~_UMASK)
         with os.fdopen(fd, "w") as f:
             json.dump(obj_dict, f, indent=1)
         os.replace(tmp, path)

@@ -39,6 +39,15 @@ from ..ops.attention import (
 from .resnet import ResNet, make_norm
 
 
+#: pam_impl='auto' switch point (scripts/pam_crossover.py on the v5e, table
+#: in BASELINE.md): XLA's fused einsum is FASTER at every measurable token
+#: count — 4k through 32k (e.g. 32k: 147 ms vs flash's 185 ms fwd+bwd) — so
+#: the switch is memory-feasibility, not speed: at 64k tokens the N^2 f32
+#: score matrix alone is ~17 GB > v5e HBM, and flash's O(N*block) VMEM
+#: schedule is the only form that can run at all.
+AUTO_FLASH_MIN_TOKENS = 65536
+
+
 def _resize_bilinear(x: jax.Array, size: tuple[int, int]) -> jax.Array:
     """Bilinear NHWC resize to (H, W) — static-shape, differentiable."""
     b, _, _, c = x.shape
@@ -52,7 +61,7 @@ class PositionAttentionModule(nn.Module):
     norm: Any
     dtype: jnp.dtype = jnp.float32
     block_size: int | None = None  # None -> full attention
-    impl: str = "einsum"           # einsum | flash | ring
+    impl: str = "einsum"           # auto | einsum | flash | ring
     sp_mesh: Any = None            # ring: mesh to shard the token axis over
     sp_axis: str = "model"         # ring: mesh axis carrying the tokens
 
@@ -63,11 +72,19 @@ class PositionAttentionModule(nn.Module):
         q = conv(self.channels // 8, (1, 1), name="query")(x).reshape(b, h * w, -1)
         k = conv(self.channels // 8, (1, 1), name="key")(x).reshape(b, h * w, -1)
         v = conv(self.channels, (1, 1), name="value")(x).reshape(b, h * w, -1)
-        if self.impl == "flash":
+        impl = self.impl
+        if impl == "auto":
+            # einsum while the N^2 scores fit HBM (it measured faster at
+            # every count up to 32k on the v5e), flash beyond (where einsum
+            # cannot run at all) — see AUTO_FLASH_MIN_TOKENS.  Token count
+            # is static at trace time: a compile-time choice, one program
+            # per shape.
+            impl = "einsum" if h * w < AUTO_FLASH_MIN_TOKENS else "flash"
+        if impl == "flash":
             from ..ops.pallas_attention import flash_position_attention
             blk = self.block_size or 256
             out = flash_position_attention(q, k, v, blk, blk)
-        elif self.impl == "ring":
+        elif impl == "ring":
             # Sequence parallelism live in the model: the spatial-token axis
             # is sharded over ``sp_axis`` and attention runs as a ppermute
             # ring (parallel/ring.py) — each device holds N/axis tokens and
@@ -94,7 +111,7 @@ class PositionAttentionModule(nn.Module):
             ring = make_ring_attention_inline(
                 self.sp_mesh, self.sp_axis, batch_axis=batch_ax)
             out = ring(q, k, v)
-        elif self.impl == "einsum":
+        elif impl == "einsum":
             if self.block_size is None:
                 out = position_attention(q, k, v)
             else:
@@ -102,7 +119,7 @@ class PositionAttentionModule(nn.Module):
         else:
             raise ValueError(
                 f"unknown attention impl: {self.impl!r} "
-                "(einsum | flash | ring)")
+                "(auto | einsum | flash | ring)")
         out = out.reshape(b, h, w, self.channels)
         # Residual gate starts at 0: the module is an identity at init and
         # learns how much attention context to blend in.

@@ -139,9 +139,13 @@ void separable_resize(const float* src, int sw, int c,
 }  // namespace
 
 // mode: 0 = nearest, 1 = bilinear, 2 = bicubic.  Separable two-pass with
-// precomputed taps; the tap weights/indices and accumulation order match the
-// direct per-pixel formulation bit-for-bit (same clamp rule, same
-// sum-over-x-then-over-y grouping).
+// precomputed taps.  Tap weights/indices and clamp rule match the direct
+// per-pixel formulation; accumulation order matches it bit-for-bit for
+// nearest and bicubic (those already grouped sum-over-x then sum-over-y).
+// Bilinear previously summed the four weight products in one expression
+// (v00*(1-ax)*(1-ay) + ...); the two-pass lerp is a different FP
+// association and can differ in the last ulp — the tolerance-based tests
+// are the stated contract there.
 void resize_f32(const float* src, int sh, int sw, int c,
                 float* dst, int dh, int dw, int mode) {
   const Taps1D xt = build_taps(dw, sw, mode, 0, sw - 1);

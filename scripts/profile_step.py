@@ -1,0 +1,143 @@
+"""Op-level device profile of the flagship train step (VERDICT item 2's
+missing per-op evidence): run N steps under ``jax.profiler.trace``, convert
+the XPlane capture to the XProf "hlo_stats" table, and print the top ops by
+self time as JSON — plus write the raw trace for TensorBoard/xprof.
+
+Usage:  python scripts/profile_step.py [--batch N] [--out DIR]
+Writes <out>/plugins/profile/... (raw trace) and prints one JSON line with
+the top-15 self-time ops and their category shares.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
+# tensorboard_plugin_profile's generated protos predate protobuf 4's C++
+# fast path; pure-python parsing works and only runs at conversion time.
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+from distributedpytorch_tpu.backend_health import (  # noqa: E402
+    ensure_backend_or_cpu_fallback,
+    pin_requested_platform,
+)
+
+ensure_backend_or_cpu_fallback()
+
+import jax  # noqa: E402
+
+pin_requested_platform()
+
+from distributedpytorch_tpu.backend_health import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+BATCH = 8
+STEPS = 10
+if "--batch" in sys.argv:
+    BATCH = int(sys.argv[sys.argv.index("--batch") + 1])
+OUT = "profile_step_out"
+if "--out" in sys.argv:
+    OUT = sys.argv[sys.argv.index("--out") + 1]
+ON_TPU = any(d.platform == "tpu" for d in jax.devices())
+SIZE = 512 if ON_TPU else 64
+BACKBONE = "resnet101" if ON_TPU else "resnet18"
+
+
+def hlo_stats_table(trace_dir: str):
+    """XPlane capture -> hlo_stats rows via the xprof conversion library."""
+    from tensorflow.python.profiler.internal import (
+        _pywrap_profiler_plugin as pp,
+    )
+
+    paths = sorted(glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*", "*.xplane.pb")))
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    data, _ = pp.xspace_to_tools_data([paths[-1]], "hlo_stats")
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", "replace")
+    return json.loads(data)
+
+
+def top_ops(table, n: int = 15):
+    """gviz-style {cols, rows} -> top-n rows by self time."""
+    cols = [c.get("label") or c.get("id") for c in table["cols"]]
+
+    def col(name_part):
+        for i, c in enumerate(cols):
+            if c and name_part.lower() in str(c).lower():
+                return i
+        return None
+
+    i_name = col("hlo op name") or col("op name") or 0
+    i_cat = col("category")
+    i_self = col("self time")  # typically us
+    i_frac = col("%")
+    rows = []
+    for r in table["rows"]:
+        c = [x.get("v") if isinstance(x, dict) else x for x in r["c"]]
+        rows.append({
+            "op": c[i_name],
+            "category": c[i_cat] if i_cat is not None else "",
+            "self_time_us": c[i_self] if i_self is not None else None,
+            "pct": c[i_frac] if i_frac is not None else None,
+        })
+    rows = [r for r in rows if isinstance(r["self_time_us"], (int, float))]
+    rows.sort(key=lambda r: -r["self_time_us"])
+    return rows[:n]
+
+
+def main() -> None:
+    from distributedpytorch_tpu.models import build_model
+    from distributedpytorch_tpu.parallel import (
+        create_train_state,
+        make_mesh,
+        make_train_step,
+        shard_batch,
+    )
+
+    mesh = make_mesh()
+    model = build_model("danet", nclass=1, backbone=BACKBONE,
+                        output_stride=8,
+                        dtype="bfloat16" if ON_TPU else "float32")
+    tx = optax.sgd(1e-3, momentum=0.9)
+    r = np.random.RandomState(0)
+    host_batch = {
+        "concat": r.uniform(0, 255, (BATCH, SIZE, SIZE, 4)
+                            ).astype(np.float32),
+        "crop_gt": (r.uniform(size=(BATCH, SIZE, SIZE)) > 0.7
+                    ).astype(np.float32),
+    }
+    with mesh:
+        state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                                   (1, SIZE, SIZE, 4), mesh=mesh)
+        step = make_train_step(model, tx, mesh=mesh)
+        batch = shard_batch(mesh, host_batch)
+        state, loss = step(state, batch)  # compile outside the trace
+        jax.block_until_ready(loss)
+        with jax.profiler.trace(OUT):
+            for _ in range(STEPS):
+                state, loss = step(state, batch)
+            jax.block_until_ready(loss)
+
+    rec = {"metric": f"danet_{BACKBONE}_{SIZE}px_b{BATCH}_profile",
+           "trace_dir": OUT, "steps": STEPS,
+           "platform": jax.devices()[0].platform}
+    try:
+        rec["top_ops_by_self_time"] = top_ops(hlo_stats_table(OUT))
+    except Exception as e:
+        rec["hlo_stats_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""bf16 guardrails: static loss scaling exactness + the non-finite-loss
+watchdog (a deliberately-hot run must be DETECTED, not silently trained
+through)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributedpytorch_tpu.models import build_model
+from distributedpytorch_tpu.parallel import create_train_state, make_train_step
+
+
+def tiny_setup(loss_scale: float):
+    model = build_model("danet", nclass=1, backbone="resnet18",
+                        output_stride=8)
+    tx = optax.sgd(1e-2, momentum=0.9)
+    state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                               (1, 32, 32, 4))
+    step = make_train_step(model, tx, loss_scale=loss_scale, donate=False)
+    r = np.random.RandomState(0)
+    batch = {
+        "concat": r.uniform(0, 255, (2, 32, 32, 4)).astype(np.float32),
+        "crop_gt": (r.uniform(size=(2, 32, 32)) > 0.6).astype(np.float32),
+    }
+    return state, step, batch
+
+
+class TestLossScale:
+    def test_scaled_matches_unscaled_in_f32(self):
+        """Scale-then-unscale is numerically a near-no-op in f32: same
+        reported loss, same updated params (within rounding)."""
+        s1, step1, batch = tiny_setup(1.0)
+        s2, step2, _ = tiny_setup(1024.0)
+        s1, l1 = step1(s1, batch)
+        s2, l2 = step2(s2, batch)
+        assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+        for a, b in zip(jax.tree.leaves(s1.params),
+                        jax.tree.leaves(s2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_reported_loss_is_unscaled(self):
+        s, step, batch = tiny_setup(4096.0)
+        _, loss = step(s, batch)
+        # balanced BCE on near-random logits sits around ~1, not ~4096
+        assert 0.01 < float(loss) < 50.0
+
+
+class TestNanWatchdog:
+    def make_hot_cfg(self, tmp_path, debug_asserts: bool):
+        from tests.test_train import make_tiny_cfg
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        return dataclasses.replace(
+            cfg, epochs=2, debug_asserts=debug_asserts,
+            # deliberately hot: SGD at lr=1e12 explodes on the first update;
+            # the tiny fixture's epoch is a single step whose loss is
+            # computed BEFORE that update, so detection needs either the
+            # val-side check (epoch 0) or the next epoch's train loss.
+            optim=dataclasses.replace(cfg.optim, lr=1e12,
+                                      schedule="constant"),
+            log_every_steps=1)
+
+    def test_hot_run_detected_under_debug_asserts(self, tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+        tr = Trainer(self.make_hot_cfg(tmp_path, debug_asserts=True))
+        with pytest.raises((FloatingPointError, AssertionError)):
+            # FloatingPointError from the watchdog; AssertionError possible
+            # if a data assert sees the blowup first — either way, detected.
+            tr.fit()
+        tr.close()
+
+    def test_hot_run_warns_and_survives_without_debug(self, tmp_path,
+                                                      capsys):
+        from distributedpytorch_tpu.train import Trainer
+        tr = Trainer(self.make_hot_cfg(tmp_path, debug_asserts=False))
+        history = tr.fit()
+        out = capsys.readouterr().out
+        assert "non-finite" in out
+        # the epoch AFTER the exploding update trains on garbage params
+        assert any(not np.isfinite(l) for l in history["train_loss"])
+        tr.close()

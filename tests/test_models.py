@@ -66,6 +66,31 @@ class TestDANet:
             np.testing.assert_allclose(np.asarray(oa), np.asarray(ob),
                                        rtol=1e-4, atol=1e-4)
 
+    def test_pam_impl_auto_picks_by_token_count(self, monkeypatch):
+        """auto = einsum below the measured crossover, flash at/above it;
+        both resolve at trace time and agree numerically (flash is exact
+        online softmax, interpreted on CPU)."""
+        from distributedpytorch_tpu.models import danet as danet_mod
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 16, 16, 4)),
+                        jnp.float32)
+        m_auto = DANet(nclass=1, backbone_depth=18, output_stride=8,
+                       pam_impl="auto")
+        m_ein = DANet(nclass=1, backbone_depth=18, output_stride=8)
+        variables = m_ein.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+            x, train=False)
+        # 16x16 at os=8 -> 4 tokens, far below the threshold: einsum path
+        a = m_auto.apply(variables, x, train=False)
+        b = m_ein.apply(variables, x, train=False)
+        for oa, ob in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(oa), np.asarray(ob))
+        # Drop the threshold below the token count: auto must take flash
+        monkeypatch.setattr(danet_mod, "AUTO_FLASH_MIN_TOKENS", 2)
+        c = m_auto.apply(variables, x, train=False)
+        for oa, oc in zip(a, c):
+            np.testing.assert_allclose(np.asarray(oa), np.asarray(oc),
+                                       rtol=1e-4, atol=1e-4)
+
     def test_train_mode_mutates_batch_stats(self):
         m = DANet(nclass=1, backbone_depth=18)
         x = jnp.ones((1, 32, 32, 4))

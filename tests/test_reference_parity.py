@@ -47,6 +47,37 @@ pytestmark = pytest.mark.skipif(
 # filled with this framework's implementations (the §2.4 contract table)
 # ---------------------------------------------------------------------------
 
+@pytest.fixture(scope="module", autouse=True)
+def _scoped_global_patches():
+    """Contain this module's process-global mutations.
+
+    The stubs shadow real package names (``dataloaders``, ``mypath``) in
+    ``sys.modules`` and re-add numpy<2 aliases (``np.int``/``np.bool``) the
+    reference's era assumed; left installed they could shadow genuine
+    packages or mask numpy-2.x misuse in unrelated test modules.  Everything
+    is restored on module teardown; reference-code execution itself stays
+    confined to this opt-in module (skipped when the mount is absent).
+    """
+    stub_names = ("dataloaders", "dataloaders.helpers",
+                  "dataloaders.nellipse",
+                  "dataloaders.skewed_axes_weight_map", "mypath",
+                  "_ref_custom_transforms", "_ref_pascal")
+    saved_modules = {n: sys.modules.get(n) for n in stub_names}
+    saved_np = {n: getattr(np, n, None) for n in ("int", "bool")}
+    yield
+    for n, mod in saved_modules.items():
+        if mod is None:
+            sys.modules.pop(n, None)
+        else:
+            sys.modules[n] = mod
+    for n, val in saved_np.items():
+        if val is None:
+            if hasattr(np, n):
+                delattr(np, n)
+        else:
+            setattr(np, n, val)
+
+
 def _install_stubs() -> None:
     if "dataloaders" in sys.modules:
         return
