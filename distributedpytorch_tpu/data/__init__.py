@@ -10,12 +10,17 @@ from .pipeline import (
     DataLoader,
     build_eval_transform,
     build_prepared_post_transform,
+    build_prepared_semantic_post_transform,
     build_semantic_eval_transform,
     build_semantic_train_transform,
     build_train_transform,
     collate,
 )
-from .prepared import PreparedInstanceDataset, cache_fingerprint
+from .prepared import (
+    PreparedInstanceDataset,
+    PreparedSemanticDataset,
+    cache_fingerprint,
+)
 from .voc import (
     CATEGORY_NAMES,
     VOCInstanceSegmentation,
@@ -33,7 +38,9 @@ __all__ = [
     "HAVE_GRAIN",
     "build_eval_transform",
     "build_prepared_post_transform",
+    "build_prepared_semantic_post_transform",
     "PreparedInstanceDataset",
+    "PreparedSemanticDataset",
     "cache_fingerprint",
     "build_semantic_eval_transform",
     "build_semantic_train_transform",

@@ -231,6 +231,27 @@ def build_semantic_train_transform(
     ])
 
 
+def build_prepared_semantic_post_transform(
+    rots: tuple[float, float] = (-10, 10),
+    scales: tuple[float, float] = (0.5, 2.0),
+    flip: bool = True,
+    geom: bool = True,
+    uint8_wire: bool = False,
+) -> T.Compose:
+    """Per-epoch random stage downstream of the semantic prepared cache:
+    flip + scale/rotate on the already-resized arrays (nearest-warped class
+    ids, 255-void border), renamed onto the step contract.  Mirrors
+    :func:`build_prepared_post_transform` for the semantic task."""
+    return T.Compose([
+        *([T.RandomHorizontalFlip()] if flip else []),
+        *([T.ScaleNRotate(rots=rots, scales=scales, semseg=True)]
+          if geom else []),
+        T.Rename({"image": "concat", "gt": "crop_gt"}),
+        T.ToArray(uint8_passthrough=uint8_wire),
+        T.Keep(("concat", "crop_gt")),
+    ])
+
+
 def build_semantic_eval_transform(
     crop_size: tuple[int, int] = (513, 513),
 ) -> T.Compose:

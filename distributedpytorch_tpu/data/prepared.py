@@ -77,7 +77,89 @@ def cache_fingerprint(dataset, crop_size, relax: int, zero_pad: bool,
     return hashlib.sha256(ident.encode()).hexdigest()[:16]
 
 
-class PreparedInstanceDataset:
+def _open_maps(cache_dir: str, expect_meta: dict, layout) -> dict:
+    """Open (or create/reset) the cache's memmaps under ``cache_dir``.
+
+    ``expect_meta`` mismatching the stored meta.json resets every file —
+    and the valid map is (re)created LAST so a half-written images file
+    from a crashed builder is never trusted."""
+    os.makedirs(cache_dir, exist_ok=True)
+    meta_path = os.path.join(cache_dir, "meta.json")
+    fresh = True
+    if os.path.isfile(meta_path):
+        try:
+            with open(meta_path) as f:
+                fresh = json.load(f) != expect_meta
+        except (ValueError, OSError):
+            fresh = True
+    if fresh:
+        for name, shape, dtype in layout:
+            mm = np.memmap(os.path.join(cache_dir, name), mode="w+",
+                           dtype=dtype, shape=shape)
+            del mm  # creation (ftruncate to size) is all that's needed
+        with open(meta_path + ".tmp", "w") as f:
+            json.dump(expect_meta, f)
+        os.replace(meta_path + ".tmp", meta_path)
+    return {
+        name: np.memmap(os.path.join(cache_dir, name), mode="r+",
+                        dtype=dtype, shape=shape)
+        for name, shape, dtype in layout
+    }
+
+
+class _PreparedCacheBase:
+    """Shared machinery of the prepared caches: pickling (grain process
+    workers reopen the memmaps rather than ship them), row counting, eager
+    prebuild, and the ordered crash-safe flush.  Subclasses define
+    ``_open_or_create``/``_fill``/``__getitem__`` over their own layout."""
+
+    # the files are the shared state, not the handles
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_maps")
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._open_or_create()
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def sample_image_id(self, index: int) -> str:
+        return self.dataset.sample_image_id(index)
+
+    @property
+    def n_prepared(self) -> int:
+        """Rows already cached (diagnostic / test hook)."""
+        return int(np.count_nonzero(self._maps["valid.u8"]))
+
+    def prebuild(self, num_workers: int = 0) -> None:
+        """Eagerly fill every missing row (optional — training's first epoch
+        does the same lazily)."""
+        missing = np.flatnonzero(self._maps["valid.u8"] == 0)
+        if num_workers > 0:
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(max_workers=num_workers) as pool:
+                list(pool.map(self._fill, missing.tolist()))
+        else:
+            for i in missing.tolist():
+                self._fill(i)
+        self.flush()
+
+    def flush(self) -> None:
+        """msync the maps — durability for readers in other processes/runs.
+
+        Data maps flush BEFORE the valid map: a host crash mid-writeback
+        must never persist a valid byte whose row bytes didn't land (the
+        page cache orders nothing on its own)."""
+        for name, mm in self._maps.items():
+            if name != "valid.u8":
+                mm.flush()
+        self._maps["valid.u8"].flush()
+
+
+class PreparedInstanceDataset(_PreparedCacheBase):
     """Wrap an instance dataset with a prepared-sample disk cache.
 
     ``dataset`` must be constructed with ``transform=None`` (this class owns
@@ -132,32 +214,11 @@ class PreparedInstanceDataset:
         n = len(self.dataset)
         h, w = self.crop_size
         self._npack = (h * w + 7) // 8
-        os.makedirs(self.cache_dir, exist_ok=True)
-        meta_path = os.path.join(self.cache_dir, "meta.json")
-        expect = {"format": _FORMAT_VERSION, "fingerprint": self.fingerprint,
-                  "n": n, "crop_size": [h, w]}
-        fresh = True
-        if os.path.isfile(meta_path):
-            try:
-                with open(meta_path) as f:
-                    fresh = json.load(f) != expect
-            except (ValueError, OSError):
-                fresh = True
-        if fresh:
-            # (Re)create: zero the valid map LAST so a half-written images
-            # file from a crashed builder is never trusted.
-            for name, shape, dtype in self._layout(n, h, w):
-                mm = np.memmap(os.path.join(self.cache_dir, name), mode="w+",
-                               dtype=dtype, shape=shape)
-                del mm  # creation (ftruncate to size) is all that's needed
-            with open(meta_path + ".tmp", "w") as f:
-                json.dump(expect, f)
-            os.replace(meta_path + ".tmp", meta_path)
-        self._maps = {
-            name: np.memmap(os.path.join(self.cache_dir, name), mode="r+",
-                            dtype=dtype, shape=shape)
-            for name, shape, dtype in self._layout(n, h, w)
-        }
+        self._maps = _open_maps(
+            self.cache_dir,
+            {"format": _FORMAT_VERSION, "fingerprint": self.fingerprint,
+             "n": n, "crop_size": [h, w]},
+            self._layout(n, h, w))
 
     def _layout(self, n, h, w):
         return [
@@ -168,29 +229,7 @@ class PreparedInstanceDataset:
             ("valid.u8", (n,), np.uint8),
         ]
 
-    # Grain process workers pickle the dataset; memmaps reopen in the worker
-    # (the files are the shared state, not the handles).
-    def __getstate__(self):
-        state = self.__dict__.copy()
-        state.pop("_maps")
-        return state
-
-    def __setstate__(self, state):
-        self.__dict__.update(state)
-        self._open_or_create()
-
-    # -- dataset protocol ----------------------------------------------------
-
-    def __len__(self) -> int:
-        return len(self.dataset)
-
-    def sample_image_id(self, index: int) -> str:
-        return self.dataset.sample_image_id(index)
-
-    @property
-    def n_prepared(self) -> int:
-        """Rows already cached (diagnostic / test hook)."""
-        return int(np.count_nonzero(self._maps["valid.u8"]))
+    # -- dataset protocol: pickling/len/ids/prebuild/flush in the base ------
 
     def _fill(self, index: int) -> tuple[np.ndarray, np.ndarray, np.ndarray,
                                          tuple[int, int]]:
@@ -264,30 +303,109 @@ class PreparedInstanceDataset:
             meta["category"] = ds.obj_dict[ds.im_ids[im_ii]][obj_ii]
         return meta
 
-    def prebuild(self, num_workers: int = 0) -> None:
-        """Eagerly fill every missing row (optional — training's first epoch
-        does the same lazily)."""
-        missing = np.flatnonzero(self._maps["valid.u8"] == 0)
-        if num_workers > 0:
-            import concurrent.futures as cf
-            with cf.ThreadPoolExecutor(max_workers=num_workers) as pool:
-                list(pool.map(self._fill, missing.tolist()))
-        else:
-            for i in missing.tolist():
-                self._fill(i)
-        self.flush()
-
-    def flush(self) -> None:
-        """msync the maps — durability for readers in other processes/runs.
-
-        Data maps flush BEFORE the valid map: a host crash mid-writeback
-        must never persist a valid byte whose row bytes didn't land (the
-        page cache orders nothing on its own)."""
-        for name, mm in self._maps.items():
-            if name != "valid.u8":
-                mm.flush()
-        self._maps["valid.u8"].flush()
-
     def __str__(self) -> str:
         return (f"Prepared({self.dataset},crop={self.crop_size},"
                 f"relax={self.relax},fp={self.fingerprint})")
+
+
+class PreparedSemanticDataset(_PreparedCacheBase):
+    """Prepared-sample cache for the semantic pipeline.
+
+    The semantic task's deterministic front is smaller than the instance
+    task's — decode → fixed resize (no mask-dependent crop) — but on a weak
+    host decode still dominates.  Cached per sample: the resized image as
+    uint8 and the class-id mask as uint8 (ids 0..20 plus in-band 255 void —
+    exact by construction).  Flip / scale-rotate run per epoch downstream
+    on the resized arrays, i.e. post-resize rather than the uncached
+    pipeline's pre-resize order (the same semantics shift the instance
+    cache documents; the warp's uint8 cast and nearest-gt rule are
+    unchanged).
+    """
+
+    def __init__(self, dataset, cache_dir: str, crop_size=(513, 513),
+                 post_transform=None, uint8_arrays: bool = False):
+        if getattr(dataset, "transform", None) is not None:
+            raise ValueError(
+                "PreparedSemanticDataset wraps the *untransformed* dataset "
+                "(construct it with transform=None)")
+        self.dataset = dataset
+        self.cache_root = cache_dir
+        self.crop_size = tuple(int(v) for v in crop_size)
+        self.post_transform = post_transform
+        self.uint8_arrays = bool(uint8_arrays)
+        self._stage1 = T.Compose([
+            T.FixedResize(resolutions={"image": self.crop_size,
+                                       "gt": self.crop_size},
+                          flagvals={"image": None, "gt": 0}),
+            T.ClampRange(("image",)),
+        ])
+        # relax/zero_pad/fused have no semantic analogue; pinned values
+        # keep the fingerprint function shared with the instance cache
+        self.fingerprint = cache_fingerprint(
+            dataset, self.crop_size, relax=0, zero_pad=False,
+            fused_crop_resize=False)
+        self.cache_dir = os.path.join(cache_dir, self.fingerprint)
+        self._open_or_create()
+
+    def _layout(self, n, h, w):
+        return [
+            ("images.u8", (n, h, w, 3), np.uint8),
+            ("gts.u8", (n, h, w), np.uint8),
+            ("sizes.i32", (n, 2), np.int32),
+            ("valid.u8", (n,), np.uint8),
+        ]
+
+    def _open_or_create(self) -> None:
+        h, w = self.crop_size
+        self._maps = _open_maps(
+            self.cache_dir,
+            {"format": _FORMAT_VERSION, "fingerprint": self.fingerprint,
+             "n": len(self.dataset), "crop_size": [h, w],
+             "kind": "semantic"},
+            self._layout(len(self.dataset), h, w))
+
+    def _fill(self, index: int):
+        raw = self.dataset.__getitem__(index)
+        sample = self._stage1(dict(raw), None)
+        img8 = np.rint(np.asarray(sample["image"],
+                                  np.float32)).astype(np.uint8)
+        gt8 = np.rint(np.asarray(sample["gt"], np.float32)).astype(np.uint8)
+        im_size = raw["meta"]["im_size"] if "meta" in raw \
+            else raw["image"].shape[:2]
+        self._maps["images.u8"][index] = img8
+        self._maps["gts.u8"][index] = gt8
+        self._maps["sizes.i32"][index] = im_size
+        self._maps["valid.u8"][index] = 1
+        return img8, gt8, tuple(int(v) for v in im_size)
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        index = int(index)
+        if self._maps["valid.u8"][index]:
+            img8 = np.asarray(self._maps["images.u8"][index])
+            gt8 = np.asarray(self._maps["gts.u8"][index])
+            im_size = tuple(int(v) for v in self._maps["sizes.i32"][index])
+            if not (img8.any() and gt8.any()):
+                # torn write from a crashed filler: pages persist in
+                # arbitrary order, so EITHER row can be zeros while valid=1
+                # — a real photo is never all-black and a VOC segmentation
+                # mask never all-background (objects + 255 void boundary);
+                # refill (idempotent) rather than serve silent wrong labels
+                img8, gt8, im_size = self._fill(index)
+        else:
+            img8, gt8, im_size = self._fill(index)
+        if self.uint8_arrays:
+            sample = {"image": np.ascontiguousarray(img8),
+                      "gt": np.ascontiguousarray(gt8)}
+        else:
+            sample = {"image": img8.astype(np.float32),
+                      "gt": gt8.astype(np.float32)}
+        sample["meta"] = {"image": self.dataset.sample_image_id(index),
+                          "im_size": im_size}
+        if self.post_transform is not None:
+            sample = self.post_transform(sample, rng)
+        return sample
+
+    def __str__(self) -> str:
+        return (f"PreparedSemantic({self.dataset},crop={self.crop_size},"
+                f"fp={self.fingerprint})")

@@ -574,7 +574,11 @@ class ConcatInputs(Transform):
                     f"!= {self.elems[0]} {base.shape[:2]}"
                 )
             parts.append(np.atleast_3d(sample[elem]))
-        sample["concat"] = np.concatenate(parts, axis=2)
+        # Single element (the device_guidance config: the map is appended on
+        # device): atleast_3d is a view — skip the pointless full-array copy
+        # np.concatenate would make on the hot path.
+        sample["concat"] = parts[0] if len(parts) == 1 \
+            else np.concatenate(parts, axis=2)
         return sample
 
     def __repr__(self):

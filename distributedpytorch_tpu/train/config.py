@@ -69,13 +69,14 @@ class DataConfig:
     prepared_cache: str = ""            # dir for the prepared-sample disk
                                         # cache (FFCV-style): the train
                                         # pipeline's deterministic front
-                                        # (decode→crop→resize) is computed
-                                        # once per sample and mmap-read ever
-                                        # after; flip/rotate/guidance stay
-                                        # per-epoch random, post-crop.
-                                        # Keyed by a config fingerprint —
-                                        # changing crop knobs rebuilds.
-                                        # ~0.75 MB/sample at 512².
+                                        # (instance: decode→crop→resize;
+                                        # semantic: decode→resize) is
+                                        # computed once per sample and
+                                        # mmap-read ever after; flip/rotate/
+                                        # guidance stay per-epoch random,
+                                        # post-crop.  Keyed by a config
+                                        # fingerprint — changing crop knobs
+                                        # rebuilds.  ~0.75 MB/sample @512².
     uint8_transfer: bool = False        # ship train batches to the device
                                         # as uint8 (4x fewer H2D bytes and
                                         # host memcpys; the compiled step

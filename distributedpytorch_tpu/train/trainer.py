@@ -152,10 +152,6 @@ class Trainer:
             if err:
                 raise RuntimeError(f"VOC download failed on process 0 "
                                    f"({err})")
-        if cfg.data.prepared_cache and cfg.task != "instance":
-            raise ValueError("data.prepared_cache caches the instance "
-                             "pipeline's crop stage; the semantic pipeline "
-                             "has no deterministic crop front to cache")
         if cfg.data.uint8_transfer and not cfg.data.prepared_cache:
             raise ValueError(
                 "data.uint8_transfer needs data.prepared_cache: only the "
@@ -234,15 +230,32 @@ class Trainer:
                                   and cfg.data.device_augment_geom),
                         uint8_wire=cfg.data.uint8_transfer))
         elif cfg.task == "semantic":
-            self.train_set = VOCSemanticSegmentation(
-                root, split=cfg.data.train_split,
-                transform=build_semantic_train_transform(
+            prepared = bool(cfg.data.prepared_cache)
+            sem_train_tf = None if prepared else \
+                build_semantic_train_transform(
                     crop_size=cfg.data.crop_size, rots=cfg.data.rots,
                     scales=cfg.data.scales,
                     flip=not cfg.data.device_augment,
                     geom=not (cfg.data.device_augment
-                              and cfg.data.device_augment_geom)),
+                              and cfg.data.device_augment_geom))
+            self.train_set = VOCSemanticSegmentation(
+                root, split=cfg.data.train_split, transform=sem_train_tf,
                 decode_cache=cfg.data.decode_cache)
+            if prepared:
+                from ..data.pipeline import (
+                    build_prepared_semantic_post_transform,
+                )
+                from ..data.prepared import PreparedSemanticDataset
+                self.train_set = PreparedSemanticDataset(
+                    self.train_set, cfg.data.prepared_cache,
+                    crop_size=cfg.data.crop_size,
+                    uint8_arrays=cfg.data.uint8_transfer,
+                    post_transform=build_prepared_semantic_post_transform(
+                        rots=cfg.data.rots, scales=cfg.data.scales,
+                        flip=not cfg.data.device_augment,
+                        geom=not (cfg.data.device_augment
+                                  and cfg.data.device_augment_geom),
+                        uint8_wire=cfg.data.uint8_transfer))
             # No val cache: semantic val is one sample per image scanned
             # sequentially — an LRU smaller than the split gets zero hits
             # and would only double the RAM budget.  (Instance val keeps

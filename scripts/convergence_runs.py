@@ -88,8 +88,8 @@ def run(name: str, fixture: str, overrides: dict) -> dict:
     key = "jaccard"
     curve = [round(float(m[key]), 4) for m in hist["val"]]
     best = max(curve) if curve else float("nan")
-    # epochs-to-plateau: first epoch within 1% of the best
-    plateau = next((i for i, v in enumerate(curve) if v >= best - 0.01),
+    # epochs-to-plateau: first epoch within 1% (relative) of the best
+    plateau = next((i for i, v in enumerate(curve) if v >= best * 0.99),
                    None)
     return {"run": name, "epochs": len(curve), "val_curve": curve,
             "best": best, "epochs_to_within_1pct_of_best": plateau,
@@ -110,10 +110,7 @@ if __name__ == "__main__":
             "task": "semantic", "model.name": "deeplabv3",
             "model.nclass": 21, "model.output_stride": 16,
             "model.aux_head": True, "model.in_channels": 3,
-            "data.val_batch": 8,
-            # semantic pipeline has no prepared-cache front
-            "data.prepared_cache": "", "data.uint8_transfer": False,
-            "data.decode_cache": N_IMAGES,
+            "data.val_batch": 8,  # semantic val batches cleanly
             **({} if CPU_SMOKE else {"data.crop_size": [513, 513]}),
         },
     }

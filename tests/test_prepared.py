@@ -254,14 +254,51 @@ class TestTrainerIntegration:
         assert tr.train_set.n_prepared == len(tr.train_set)
         tr.close()
 
-    def test_semantic_task_rejects_prepared_cache(self, tmp_path):
+    def test_semantic_task_with_prepared_cache(self, tmp_path):
         from tests.test_train import make_tiny_cfg
+        from distributedpytorch_tpu.data import make_fake_voc
         from distributedpytorch_tpu.train import Trainer
+        # semantic = one sample per IMAGE: needs >= batch-size images
+        root = make_fake_voc(str(tmp_path / "voc"), n_images=12,
+                             size=(96, 128), n_val=3, seed=2)
         cfg = make_tiny_cfg(str(tmp_path / "runs"))
         cfg = dataclasses.replace(
-            cfg, task="semantic",
-            model=dataclasses.replace(cfg.model, nclass=21),
-            data=dataclasses.replace(cfg.data,
-                                     prepared_cache=str(tmp_path / "prep")))
-        with pytest.raises(ValueError, match="prepared_cache"):
-            Trainer(cfg)
+            cfg, task="semantic", epochs=2,
+            model=dataclasses.replace(cfg.model, nclass=21, in_channels=3),
+            data=dataclasses.replace(cfg.data, fake=False, root=root,
+                                     prepared_cache=str(tmp_path / "prep"),
+                                     uint8_transfer=True))
+        tr = Trainer(cfg)
+        history = tr.fit()
+        assert all(np.isfinite(l) for l in history["train_loss"])
+        assert 0.0 <= history["val"][-1]["miou"] <= 1.0
+        assert tr.train_set.n_prepared == len(tr.train_set)
+        tr.close()
+
+    def test_semantic_cache_exact_class_ids(self, fake_voc_root, tmp_path):
+        from distributedpytorch_tpu.data import (
+            PreparedSemanticDataset,
+            VOCSemanticSegmentation,
+        )
+        base = VOCSemanticSegmentation(fake_voc_root, split="train",
+                                       transform=None)
+        ds = PreparedSemanticDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(65, 65))
+        from distributedpytorch_tpu.data.transforms import (
+            ClampRange,
+            Compose,
+            FixedResize,
+        )
+        ref = Compose([
+            FixedResize(resolutions={"image": (65, 65), "gt": (65, 65)},
+                        flagvals={"image": None, "gt": 0}),
+            ClampRange(("image",)),
+        ])
+        for i in (0, len(ds) - 1):
+            want = ref(base.__getitem__(i), None)
+            got = ds[i]   # fill + read path
+            got2 = ds[i]  # pure read path
+            # nearest-resized class ids are integers: cached exactly
+            np.testing.assert_array_equal(got["gt"], want["gt"])
+            np.testing.assert_array_equal(got["gt"], got2["gt"])
+            assert np.abs(got["image"] - want["image"]).max() <= 0.5
