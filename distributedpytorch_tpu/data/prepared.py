@@ -184,7 +184,6 @@ class PreparedInstanceDataset(_PreparedCacheBase):
                 "(construct it with transform=None); the crop stage it would "
                 "run is exactly what this cache replaces")
         self.dataset = dataset
-        self.cache_root = cache_dir
         self.crop_size = tuple(int(v) for v in crop_size)
         self.relax = int(relax)
         self.zero_pad = bool(zero_pad)
@@ -261,10 +260,12 @@ class PreparedInstanceDataset(_PreparedCacheBase):
             bits = np.asarray(self._maps["masks.u8"][index])
             bbox = np.asarray(self._maps["bboxes.i64"][index]).copy()
             im_size = tuple(int(v) for v in self._maps["sizes.i32"][index])
-            if not (img8.any() or bits.any()):
+            if not (img8.any() and bits.any()):
                 # Torn write from a crashed filler: the valid byte landed
-                # but the row is still zeros (writeback order is arbitrary).
-                # A real sample always has object pixels; refill.
+                # but a row is still zeros — and pages persist in arbitrary
+                # order, so EITHER row can be the torn one.  A real sample
+                # always has object pixels (area filter) and a non-black
+                # crop; refill (idempotent).
                 img8, bits, bbox, im_size = self._fill(index)
         else:
             img8, bits, bbox, im_size = self._fill(index)
@@ -329,7 +330,6 @@ class PreparedSemanticDataset(_PreparedCacheBase):
                 "PreparedSemanticDataset wraps the *untransformed* dataset "
                 "(construct it with transform=None)")
         self.dataset = dataset
-        self.cache_root = cache_dir
         self.crop_size = tuple(int(v) for v in crop_size)
         self.post_transform = post_transform
         self.uint8_arrays = bool(uint8_arrays)

@@ -251,6 +251,7 @@ def evaluate_semantic(
     conf = np.zeros((nclass, nclass), np.int64)
     confs: list = []   # device (C,C) counts; bulk-read at epoch end
     losses: list = []  # device scalars; same deferred-sync policy
+    n_samples = 0
     t0 = time.perf_counter()
 
     def forward_probs(inp: np.ndarray, gt: np.ndarray):
@@ -270,6 +271,7 @@ def evaluate_semantic(
         if debug_asserts:
             semantic_batch_debug_asserts(batch, nclass, ignore_index)
         n = batch[INPUT_KEY].shape[0]
+        n_samples += n
         if not tta:
             device_keys = {k: v for k, v in batch.items()
                            if k in (INPUT_KEY, "crop_gt")}
@@ -336,15 +338,17 @@ def evaluate_semantic(
         gathered = multihost_utils.process_allgather(
             jnp.asarray(conf, jnp.int64))
         conf = np.asarray(gathered).sum(axis=0)
-        packed = np.array([loss_sum, n_batches])
+        packed = np.array([loss_sum, n_batches, n_samples])
         summed = np.asarray(
             multihost_utils.process_allgather(packed)).sum(axis=0)
         loss_sum, n_batches = float(summed[0]), int(summed[1])
+        n_samples = int(summed[2])
 
     out = miou_from_confusion(conf)
     out.update({
         "loss": loss_sum / max(n_batches, 1),
         "jaccard": out["miou"],        # uniform best-checkpoint gate key
+        "n_samples": n_samples,
         "seconds": time.perf_counter() - t0,
     })
     return out

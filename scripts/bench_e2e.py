@@ -62,7 +62,7 @@ EPOCHS_TIMED = 1 if CPU_SMOKE else 2  # after a warmup epoch (compile + caches)
 def run(fixture_root: str, overrides: dict) -> dict:
     work = tempfile.mkdtemp(prefix="bench_e2e_")
     overrides = dict(overrides)
-    if overrides.get("data.prepared_cache") == "AUTO":
+    if str(overrides.get("data.prepared_cache", "")).startswith("AUTO"):
         # shared across variants on purpose: same crop config -> same
         # fingerprint -> later variants start warm (like a user's epoch 2+)
         overrides["data.prepared_cache"] = os.path.join(
@@ -71,13 +71,15 @@ def run(fixture_root: str, overrides: dict) -> dict:
         "data.root": fixture_root,
         "data.train_batch": BATCH,
         "model.dtype": "float32" if CPU_SMOKE else "bfloat16",
-        **({"model.backbone": "resnet18",
-            "data.crop_size": [64, 64]} if CPU_SMOKE else {}),
         "optim.lr": 1e-4,
         "work_dir": work,
         "epochs": 1,
         "log_writers": [],
         **overrides,
+        # smoke downsizing wins over variant shapes (513^2 on CPU is not a
+        # flow check)
+        **({"model.backbone": "resnet18", "data.crop_size": [64, 64],
+            "model.dtype": "float32"} if CPU_SMOKE else {}),
     })
     try:
         trainer = Trainer(cfg)
@@ -146,6 +148,16 @@ if __name__ == "__main__":
         # the full package at global batch 16 (fewer dispatches per image)
         {"data.prepared_cache": "AUTO", "data.device_guidance": True,
          "data.uint8_transfer": True, "data.train_batch": 16},
+        # fast path + batched val: the reference protocol is bs=1 (dispatch-
+        # bound through the tunnel); val_batch=8 amortizes it
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True, "data.val_batch": 8},
+        # semantic task on its prepared+uint8 fast path (DeepLabV3-R101
+        # os=16 513^2 — BASELINE config 4's model at the e2e level)
+        {"task": "semantic", "model.name": "deeplabv3", "model.nclass": 21,
+         "model.in_channels": 3, "model.output_stride": 16,
+         "data.crop_size": [513, 513], "data.val_batch": 8,
+         "data.prepared_cache": "AUTO_SEM", "data.uint8_transfer": True},
     ]
     sel = sys.argv[1:]
     try:
