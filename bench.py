@@ -66,26 +66,49 @@ PEAK_FLOPS_BY_KIND = {
     "v2": 45e12,
 }
 
+# Peak HBM bandwidth per chip (B/s), same public tables, keyed identically
+# — the roofline's second axis must match the chip the FLOPs table matched.
+PEAK_HBM_BY_KIND = {
+    "v5 lite": 819e9,
+    "v5litepod": 819e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v6 lite": 1640e9,
+    "v6e": 1640e9,
+    "v4": 1228e9,
+    "v3": 900e9,
+    "v2": 700e9,
+}
 
-def peak_flops_per_chip() -> float | None:
+
+def _kind_lookup(table: dict) -> float | None:
     kind = jax.devices()[0].device_kind.lower()
-    for sub, peak in PEAK_FLOPS_BY_KIND.items():
+    for sub, val in table.items():
         if sub in kind:
-            return peak
+            return val
     return None
 
 
-def step_flops(step, state, batch) -> float | None:
-    """XLA's FLOP count for the exact compiled train step (whole global
-    batch).  One lower+compile — the executable is cache-shared with the
-    timed run."""
+def peak_flops_per_chip() -> float | None:
+    return _kind_lookup(PEAK_FLOPS_BY_KIND)
+
+
+def peak_hbm_bw_per_chip() -> float | None:
+    return _kind_lookup(PEAK_HBM_BY_KIND)
+
+
+def step_cost(step, state, batch) -> dict:
+    """XLA's cost model for the exact compiled train step (whole global
+    batch): FLOPs and HBM bytes accessed — the two roofline inputs.  One
+    lower+compile; the executable is cache-shared with the timed run."""
     try:
         cost = step.lower(state, batch).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):  # older jax returns [dict]
             cost = cost[0]
-        return float(cost["flops"])
+        return {"flops": float(cost["flops"]),
+                "bytes": float(cost.get("bytes accessed", 0.0)) or None}
     except Exception:
-        return None
+        return {"flops": None, "bytes": None}
 
 # Keep the benchmark finishable on CPU-only dev boxes while exercising the
 # real config on TPU.
@@ -126,7 +149,8 @@ def main() -> None:
                                    (1, SIZE, SIZE, 4), mesh=mesh)
         step = make_train_step(model, tx, mesh=mesh)
         batch = shard_batch(mesh, host_batch)
-        flops = step_flops(step, state, batch)
+        cost = step_cost(step, state, batch)
+        flops = cost["flops"]
 
         state_box = [state]
 
@@ -157,9 +181,23 @@ def main() -> None:
         achieved = flops * stats["items_per_sec"] \
             / (BATCH * n_chips) / n_chips  # FLOP/s per chip
         record["tflops_per_sec_per_chip"] = round(achieved / 1e12, 2)
+        if cost["bytes"]:
+            record["bytes_accessed_per_step"] = cost["bytes"]
         if peak:
             record["mfu_vs_peak"] = round(achieved / peak, 4)
             record["vs_baseline"] = record["mfu_vs_peak"]
+            # Roofline floor for one step: max(compute at peak MXU, HBM
+            # traffic at peak bandwidth) — what a perfectly-overlapped
+            # execution could not beat.  Both axes come from the same
+            # device-kind tables, so the diagnosis matches the chip.
+            bw = peak_hbm_bw_per_chip()
+            if cost["bytes"] and bw:
+                t_flops = flops / n_chips / peak
+                t_bytes = cost["bytes"] / n_chips / bw
+                record["roofline_ms_per_step"] = round(
+                    max(t_flops, t_bytes) * 1e3, 2)
+                record["roofline_bound"] = (
+                    "compute" if t_flops >= t_bytes else "memory")
     if "vs_baseline" not in record:
         # no XLA cost model / unknown chip: report a neutral ratio rather
         # than an invented one

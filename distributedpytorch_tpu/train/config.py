@@ -88,6 +88,16 @@ class DataConfig:
                                         # revisits an image once per object
                                         # per epoch).  ~0.7 MB/image host
                                         # RAM; 0 = off.
+    steps_per_dispatch: int = 1         # >1: scan this many optimizer
+                                        # steps inside ONE compiled call
+                                        # (each over its own batch) —
+                                        # per-step dispatch overhead drops
+                                        # K-fold, the lever when the host's
+                                        # dispatch path (not data prep) is
+                                        # the bound.  Epoch-tail batches
+                                        # run through the single-step
+                                        # program.  Mutually exclusive with
+                                        # echo>1.
     echo: int = 1                       # data echoing (Choi et al. 2019,
                                         # arXiv:1907.05550): step each loaded
                                         # batch this many times — recovers

@@ -110,6 +110,15 @@ class Trainer:
                 "multi-class")
         if cfg.data.echo < 1:
             raise ValueError(f"data.echo must be >= 1, got {cfg.data.echo}")
+        if cfg.data.steps_per_dispatch < 1:
+            raise ValueError(f"data.steps_per_dispatch must be >= 1, got "
+                             f"{cfg.data.steps_per_dispatch}")
+        if cfg.data.steps_per_dispatch > 1 and cfg.data.echo > 1:
+            raise ValueError(
+                "data.steps_per_dispatch and data.echo both repeat steps "
+                "per host batch in incompatible ways — pick one (echo "
+                "re-steps the SAME batch; steps_per_dispatch packs "
+                "DISTINCT batches into one dispatch)")
         if (cfg.eval_tta_scales or cfg.eval_tta_flip) \
                 and cfg.task != "semantic":
             raise ValueError(
@@ -367,13 +376,21 @@ class Trainer:
                 rots=cfg.data.rots, scales=cfg.data.scales,
                 semantic=cfg.task == "semantic",
                 guidance_fn=guidance_fn)
-        self.train_step = make_train_step(
-            self.model, self.tx, loss_weights=cfg.model.loss_weights,
+        step_kwargs = dict(
+            loss_weights=cfg.model.loss_weights,
             accum_steps=cfg.optim.accum_steps, mesh=self.mesh,
             loss_type=loss_type, state_shardings=st_sh, augment=augment,
             aux_loss_weight=(cfg.model.moe_aux_weight
                              if cfg.model.moe_experts else 0.0),
             loss_scale=cfg.optim.loss_scale)
+        self.train_step = make_train_step(self.model, self.tx, **step_kwargs)
+        #: the K-steps-in-one-dispatch program (data.steps_per_dispatch>1);
+        #: epoch-tail remainders run through self.train_step
+        self.multi_train_step = (
+            make_train_step(self.model, self.tx,
+                            steps_per_call=cfg.data.steps_per_dispatch,
+                            **step_kwargs)
+            if cfg.data.steps_per_dispatch > 1 else None)
         self.eval_step = make_eval_step(
             self.model, loss_weights=cfg.model.loss_weights, mesh=self.mesh,
             loss_type=loss_type, state_shardings=st_sh)
@@ -608,6 +625,33 @@ class Trainer:
                 for _ in range(cfg.data.echo):
                     yield b
 
+        def dispatches(placed):
+            """(n_steps, losses) per compiled call: K-step chunks through
+            the multi-step program (data.steps_per_dispatch), the epoch
+            tail (and the k=1 config) through the single-step one."""
+            if self.multi_train_step is None:
+                for b in placed:
+                    self.state, loss = self.train_step(self.state, b)
+                    yield 1, loss
+                return
+            import itertools
+            k = cfg.data.steps_per_dispatch
+            it = iter(placed)
+            while True:
+                chunk = list(itertools.islice(it, k))
+                if not chunk:
+                    return
+                if len(chunk) == k:
+                    self.state, lv = self.multi_train_step(
+                        self.state, *chunk)
+                    yield k, lv
+                else:
+                    for b in chunk:
+                        self.state, loss = self.train_step(self.state, b)
+                        yield 1, loss
+
+        steps_done = 0
+        interrupted = False
         with self.mesh:
             # Async H2D overlap: up to device_prefetch batches are already
             # placed (sharded) while the current step computes.
@@ -616,20 +660,33 @@ class Trainer:
                 keys=("concat", "crop_gt", "crop_void"))
             if cfg.data.echo > 1:
                 batches = echoed(batches)
-            for i, device_batch in enumerate(batches):
-                self.state, loss = self.train_step(self.state, device_batch)
-                losses.append(loss)  # device array; sync deferred
-                step = step0 + i + 1
-                if guard is not None and guard.should_stop(step):
+            # cadence comes from the guard itself (a caller-provided guard
+            # may carry its own check_every)
+            check = guard.check_every if guard is not None else 1
+            for n_steps, loss in dispatches(batches):
+                losses.append(loss)  # device scalar or (K,); sync deferred
+                steps_done += n_steps
+                step = step0 + steps_done
+                # Boundary-crossing test, not a bare modulo: with K-step
+                # dispatches the step sequence is K-strided and could skip
+                # every `step % check == 0` point for a whole epoch.  All
+                # processes see identical (step, n_steps), so the consensus
+                # cadence stays synchronized.
+                if guard is not None and \
+                        (step // check) != ((step - n_steps) // check) and \
+                        guard.should_stop():
                     interrupted = True
                     break
-                if step % cfg.log_every_steps == 0:
+                crossed = (step // cfg.log_every_steps) \
+                    != ((step - n_steps) // cfg.log_every_steps)
+                if crossed:
                     # The log-cadence sync runs on EVERY process, not just
                     # main: the watchdog below must raise on all hosts
                     # together (loss is replicated, so they all see the
                     # same value) — a main-only raise would leave the other
                     # processes blocked forever at their next collective.
-                    loss_now = float(loss)
+                    loss_now = float(np.atleast_1d(
+                        jax.device_get(loss))[-1])
                     if cfg.debug_asserts and not np.isfinite(loss_now):
                         # bf16 watchdog: surface divergence at the log
                         # cadence instead of training garbage for the rest
@@ -644,18 +701,18 @@ class Trainer:
                             {"train/loss": loss_now,
                              "train/lr": float(self.schedule(step)),
                              "train/epoch": epoch}, step)
-            else:
-                interrupted = False
         # One bulk readback, not one float() per step: each scalar fetch is a
         # full host<->device round trip (~70ms through a tunneled chip — per-
-        # step syncs would dwarf the epoch itself).
-        loss_arr = np.asarray(jax.device_get(losses)) if losses else \
-            np.array([np.nan])
+        # step syncs would dwarf the epoch itself).  Entries are scalars
+        # (one per step) or (K,) vectors (one per multi-step dispatch).
+        loss_arr = np.concatenate(
+            [np.atleast_1d(x) for x in jax.device_get(losses)]) if losses \
+            else np.array([np.nan])
         bad = np.flatnonzero(~np.isfinite(loss_arr))
         if bad.size and losses:
             # Epoch-end non-finite sweep (free: the losses are already on
             # host).  Always logged; fatal under debug_asserts.
-            msg = (f"{bad.size}/{len(losses)} non-finite train losses this "
+            msg = (f"{bad.size}/{loss_arr.size} non-finite train losses this "
                    f"epoch (first at epoch step {int(bad[0])}) — divergence "
                    "or bf16 underflow; lower optim.lr, enable "
                    "optim.grad_clip_norm, or set optim.loss_scale")
@@ -670,7 +727,7 @@ class Trainer:
         dt = time.perf_counter() - t0
         # Distinct images ingested — echoed repeats of a batch are not fresh
         # data; reporting them would make any echo setting look like a win.
-        n_imgs = len(losses) * cfg.data.train_batch / cfg.data.echo
+        n_imgs = steps_done * cfg.data.train_batch / cfg.data.echo
         # An interrupted epoch logs no completed-epoch summary: its partial
         # mean would skew per-epoch curves, and the replayed epoch will log
         # the real one.

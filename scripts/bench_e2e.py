@@ -152,6 +152,9 @@ if __name__ == "__main__":
         # bound through the tunnel); val_batch=8 amortizes it
         {"data.prepared_cache": "AUTO", "data.device_guidance": True,
          "data.uint8_transfer": True, "data.val_batch": 8},
+        # + multi-step dispatch: 3 optimizer steps per compiled call
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True, "data.steps_per_dispatch": 3},
         # semantic task on its prepared+uint8 fast path (DeepLabV3-R101
         # os=16 513^2 — BASELINE config 4's model at the e2e level)
         {"task": "semantic", "model.name": "deeplabv3", "model.nclass": 21,

@@ -248,6 +248,53 @@ class TestGoldenLossRegression:
         np.testing.assert_allclose(float(l2), 4.4252347946, rtol=1e-5)
 
 
+class TestMultiStepDispatch:
+    def _setup(self, steps_per_call=1):
+        import optax
+
+        from distributedpytorch_tpu.models import build_model
+        from distributedpytorch_tpu.parallel import (
+            create_train_state,
+            make_mesh,
+            make_train_step,
+            shard_batch,
+        )
+        mesh = make_mesh()
+        model = build_model("danet", nclass=1, backbone="resnet18",
+                            output_stride=8)
+        tx = optax.sgd(1e-2, momentum=0.9)
+        with mesh:
+            state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                                       (1, 32, 32, 4), mesh=mesh)
+        step = make_train_step(model, tx, mesh=mesh, donate=False,
+                               steps_per_call=steps_per_call)
+        r = np.random.RandomState(0)
+        batches = [shard_batch(mesh, {
+            "concat": r.uniform(0, 255, (8, 32, 32, 4)).astype(np.float32),
+            "crop_gt": (r.uniform(size=(8, 32, 32)) > 0.6
+                        ).astype(np.float32)}) for _ in range(3)]
+        return mesh, state, step, batches
+
+    def test_k_steps_in_one_call_match_sequential(self):
+        """THE semantics contract: K batches through the multi-step program
+        == the same K batches through K single-step calls."""
+        mesh, state1, single, batches = self._setup(1)
+        _, state3, multi, _ = self._setup(3)
+        with mesh:
+            seq_losses = []
+            for b in batches:
+                state1, loss = single(state1, b)
+                seq_losses.append(float(loss))
+            state3, losses = multi(state3, *batches)
+        np.testing.assert_allclose(np.asarray(losses), seq_losses,
+                                   rtol=1e-6)
+        assert int(state3.step) == int(state1.step) == 3
+        for a, b in zip(jax.tree.leaves(state1.params),
+                        jax.tree.leaves(state3.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
 class TestPrefetchToDevice:
     def test_yields_all_batches_sharded_in_order(self):
         from distributedpytorch_tpu.parallel import (
@@ -270,3 +317,27 @@ class TestPrefetchToDevice:
         mesh = make_mesh()
         batches = [{"concat": np.zeros((8, 4), np.float32)}] * 3
         assert len(list(prefetch_to_device(iter(batches), mesh, 0))) == 3
+
+    def test_abandoned_iterator_does_not_hang(self):
+        """Early break (exception in the train loop) must release the
+        placement worker promptly — a leaked blocked thread here would
+        deadlock interpreter shutdown."""
+        from distributedpytorch_tpu.parallel import (
+            make_mesh, prefetch_to_device)
+        mesh = make_mesh()
+        batches = ({"concat": np.zeros((8, 4), np.float32)}
+                   for _ in range(100))
+        it = prefetch_to_device(batches, mesh, size=2)
+        next(it)
+        it.close()  # generator abandoned mid-stream
+
+    def test_uint8_batches_stay_uint8(self):
+        """The wire format survives placement: uint8 in, uint8 on device
+        (the step dequantizes, not the transfer)."""
+        from distributedpytorch_tpu.parallel import (
+            make_mesh, prefetch_to_device)
+        import jax.numpy as jnp
+        mesh = make_mesh()
+        batches = [{"concat": np.full((8, 4), 7, np.uint8)}]
+        (out,) = list(prefetch_to_device(iter(batches), mesh, size=2))
+        assert out["concat"].dtype == jnp.uint8

@@ -254,6 +254,41 @@ class TestTrainerIntegration:
         assert tr.train_set.n_prepared == len(tr.train_set)
         tr.close()
 
+    def test_fit_with_steps_per_dispatch(self, tmp_path):
+        """Multi-step dispatch through the full Trainer: a 3-batch epoch at
+        steps_per_dispatch=2 takes the 2-chunk path AND the 1-batch tail;
+        step count and fresh-image accounting stay exact."""
+        from tests.test_train import make_tiny_cfg
+        from distributedpytorch_tpu.data import make_fake_voc
+        from distributedpytorch_tpu.train import Trainer
+        root = make_fake_voc(str(tmp_path / "voc"), n_images=20,
+                             size=(96, 128), n_val=3, seed=4)
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, epochs=2,
+            data=dataclasses.replace(
+                cfg.data, fake=False, root=root, train_batch=8,
+                steps_per_dispatch=2,
+                prepared_cache=str(tmp_path / "prep"),
+                uint8_transfer=True, device_guidance=True))
+        tr = Trainer(cfg)
+        n_batches = len(tr.train_loader)
+        assert n_batches >= 3  # chunk + tail both exercised
+        history = tr.fit()
+        assert all(np.isfinite(l) for l in history["train_loss"])
+        assert int(tr.state.step) == 2 * n_batches
+        tr.close()
+
+    def test_steps_per_dispatch_excludes_echo(self, tmp_path):
+        from tests.test_train import make_tiny_cfg
+        from distributedpytorch_tpu.train import Trainer
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, steps_per_dispatch=2,
+                                          echo=2))
+        with pytest.raises(ValueError, match="steps_per_dispatch"):
+            Trainer(cfg)
+
     def test_semantic_task_with_prepared_cache(self, tmp_path):
         from tests.test_train import make_tiny_cfg
         from distributedpytorch_tpu.data import make_fake_voc
