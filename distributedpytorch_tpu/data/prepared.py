@@ -55,12 +55,34 @@ from . import transforms as T
 _FORMAT_VERSION = 1
 
 
+def _content_stamp(dataset) -> list:
+    """Cheap content probe of the underlying files: (path, size, mtime_ns)
+    of a handful of the dataset's image files.  Catches a dataset
+    *regenerated in place* with the same name/split/count (same ``str`` and
+    ``len``) but different pixels — which the identity fields alone would
+    silently alias to stale cached rows."""
+    if hasattr(dataset, "datasets"):  # CombinedDataset: walk constituents
+        return [s for ds in dataset.datasets for s in _content_stamp(ds)]
+    paths = getattr(dataset, "images", None)
+    if not paths:
+        return []
+    stamp = []
+    for p in {paths[0], paths[len(paths) // 2], paths[-1]}:
+        try:
+            st = os.stat(p)
+            stamp.append([p, st.st_size, st.st_mtime_ns])
+        except OSError:
+            stamp.append([p, -1, -1])
+    return sorted(stamp)
+
+
 def cache_fingerprint(dataset, crop_size, relax: int, zero_pad: bool,
                       fused_crop_resize: bool) -> str:
     """Identity of the cached bytes: dataset + every knob that changes them.
 
     ``str(dataset)`` covers splits/area-thres (VOC/SBD ``__str__`` encode
     them); ``len`` catches a changed instance list under the same name; the
+    content stamp catches same-name same-count regenerated files; the
     imaging backend matters because cv2 and the native kernels differ in
     the last ulp of cubic taps.
     """
@@ -68,6 +90,7 @@ def cache_fingerprint(dataset, crop_size, relax: int, zero_pad: bool,
         "format": _FORMAT_VERSION,
         "dataset": str(dataset),
         "n": len(dataset),
+        "content": _content_stamp(dataset),
         "crop_size": list(crop_size),
         "relax": int(relax),
         "zero_pad": bool(zero_pad),
@@ -271,8 +294,10 @@ class PreparedInstanceDataset(_PreparedCacheBase):
             img8, bits, bbox, im_size = self._fill(index)
         gt = np.unpackbits(bits, count=h * w).reshape(h, w)
         if self.uint8_arrays:
-            sample = {"crop_image": np.ascontiguousarray(img8),
-                      "crop_gt": gt}
+            # .copy(), NOT a view: img8 may alias the writable (r+) memmap
+            # row — an in-place mutation downstream would silently corrupt
+            # the on-disk cache forever (gt is already fresh via unpackbits)
+            sample = {"crop_image": img8.copy(), "crop_gt": gt}
         else:
             sample = {"crop_image": img8.astype(np.float32),
                       "crop_gt": gt.astype(np.float32)}
@@ -395,8 +420,10 @@ class PreparedSemanticDataset(_PreparedCacheBase):
         else:
             img8, gt8, im_size = self._fill(index)
         if self.uint8_arrays:
-            sample = {"image": np.ascontiguousarray(img8),
-                      "gt": np.ascontiguousarray(gt8)}
+            # copies, not views of the writable memmap rows (see the
+            # instance cache): downstream in-place math must never be able
+            # to corrupt the on-disk cache
+            sample = {"image": img8.copy(), "gt": gt8.copy()}
         else:
             sample = {"image": img8.astype(np.float32),
                       "gt": gt8.astype(np.float32)}

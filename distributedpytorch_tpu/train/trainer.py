@@ -167,6 +167,16 @@ class Trainer:
                 "prepared pipeline is uint8-exact end-to-end (the plain "
                 "pipeline's cubic resize leaves fractional float values "
                 "that quantization would silently alter)")
+        if cfg.data.uint8_transfer and cfg.task == "instance" \
+                and not (cfg.data.device_guidance
+                         or cfg.data.guidance == "none"):
+            raise ValueError(
+                "data.uint8_transfer with HOST-side guidance is a no-op on "
+                "the dominant tensor: concatenating the float guidance map "
+                "promotes 'concat' back to float32, so the advertised 4x "
+                "wire saving never happens — set data.device_guidance=true "
+                "(the map is synthesized on device from the uint8 crop_gt) "
+                "or data.guidance=none")
         if cfg.data.device_guidance:
             from ..ops.guidance_device import FAMILIES as _DEV_FAM
             if cfg.task != "instance":

@@ -1,0 +1,100 @@
+"""The reference's per-batch data-contract asserts, both loops
+(train_pascal.py:188-190 train, :239-241 val) — instance and semantic
+forms, plus the wiring into evaluate()."""
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.train.evaluate import (
+    batch_debug_asserts,
+    evaluate,
+    semantic_batch_debug_asserts,
+)
+
+
+def good_instance_batch(n=2, hw=16):
+    r = np.random.default_rng(0)
+    return {
+        "concat": r.uniform(0, 255, (n, hw, hw, 4)).astype(np.float32),
+        "crop_gt": (r.uniform(size=(n, hw, hw, 1)) > 0.5
+                    ).astype(np.float32),
+    }
+
+
+class TestInstanceAsserts:
+    def test_good_batch_passes(self):
+        batch_debug_asserts(good_instance_batch())
+
+    def test_out_of_range_input_fails(self):
+        b = good_instance_batch()
+        b["concat"][0, 0, 0, 0] = -3.0
+        with pytest.raises(AssertionError, match=r"\[0,255\]"):
+            batch_debug_asserts(b)
+
+    def test_nonbinary_gt_fails(self):
+        b = good_instance_batch()
+        b["crop_gt"][0, 0, 0, 0] = 0.5
+        with pytest.raises(AssertionError, match="binary"):
+            batch_debug_asserts(b)
+
+    def test_degenerate_rgb_fails(self):
+        b = good_instance_batch()
+        b["concat"][..., :3] = 7.0
+        with pytest.raises(AssertionError, match="degenerate"):
+            batch_debug_asserts(b)
+
+    def test_uint8_wire_batch_passes(self):
+        b = good_instance_batch()
+        b = {k: v.astype(np.uint8) for k, v in b.items()}
+        batch_debug_asserts(b)
+
+
+class TestSemanticAsserts:
+    def good(self, n=2, hw=16, nclass=21):
+        r = np.random.default_rng(1)
+        gt = r.integers(0, nclass, (n, hw, hw)).astype(np.float32)
+        gt[0, 0, 0] = 255  # in-band void is legal
+        return {
+            "concat": r.uniform(0, 255, (n, hw, hw, 3)).astype(np.float32),
+            "crop_gt": gt,
+        }
+
+    def test_good_batch_passes(self):
+        semantic_batch_debug_asserts(self.good(), nclass=21)
+
+    def test_invalid_class_id_fails(self):
+        b = self.good()
+        b["crop_gt"][0, 1, 1] = 21.0  # one past the last class, not void
+        with pytest.raises(AssertionError, match="ids"):
+            semantic_batch_debug_asserts(b, nclass=21)
+
+    def test_out_of_range_input_fails(self):
+        b = self.good()
+        b["concat"][0, 0, 0, 0] = 300.0
+        with pytest.raises(AssertionError, match=r"\[0,255\]"):
+            semantic_batch_debug_asserts(b, nclass=21)
+
+
+class TestValLoopWiring:
+    def test_evaluate_checks_batches_when_enabled(self):
+        """A contract-violating val batch must fail inside evaluate() —
+        the reference asserted in BOTH loops."""
+        bad = good_instance_batch()
+        bad["concat"][0, 0, 0, 0] = 999.0
+        bad["gt"] = [np.zeros((20, 20), np.float32)] * 2
+        calls = []
+
+        def fake_eval_step(state, batch):
+            calls.append(1)
+            return ([np.zeros((2, 16, 16, 1), np.float32)] * 3,
+                    np.float32(0.0))
+
+        with pytest.raises(AssertionError):
+            evaluate(fake_eval_step, None, [bad], debug_asserts=True)
+        assert not calls  # failed before any forward
+
+        # same batch with checks off runs through
+        good = good_instance_batch()
+        good["gt"] = [np.zeros((20, 20), np.float32)] * 2
+        out = evaluate(fake_eval_step, None, [good], debug_asserts=False)
+        assert calls and "jaccard" in out

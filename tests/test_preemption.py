@@ -137,6 +137,41 @@ class TestTrainerPreemption:
         # full schedule — no batch trained twice, none skipped.
         assert int(tr2.state.step) == cfg.epochs * nb
 
+    def test_exact_resume_with_multi_step_dispatch(self, tmp_path):
+        """steps_per_dispatch>1: a stop lands on a dispatch boundary (K
+        steps each), the saved offset is in optimizer steps, and the
+        resumed run regroups the remaining batches — total steps across
+        both runs still exactly one schedule."""
+        cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path),
+                                    "epochs": 2,
+                                    "data.steps_per_dispatch": 2,
+                                    "checkpoint.preempt_check_every": 3})
+        tr = Trainer(cfg)
+        nb = len(tr.train_loader)
+        assert nb > 4
+        guard = PreemptionGuard(check_every=3)
+        with guard:
+            guard.trip()
+            hist = tr.fit(guard)
+        assert hist.get("preempted") is True
+        step = tr.ckpt.latest_step()
+        # K=2 strided steps with check_every=3: first crossing is step 4
+        assert step == 4
+        _, meta = tr.ckpt.restore(tr.state)
+        assert meta["interrupted_epoch"] == 0
+        assert meta["epoch_steps_done"] == 4
+        ckpt_dir = tr.ckpt.directory
+        tr.close()
+
+        cfg2 = dataclasses.replace(cfg, resume=ckpt_dir)
+        tr2 = Trainer(cfg2)
+        assert tr2.start_epoch == 0
+        assert tr2._resume_start_batch == 4
+        hist2 = tr2.fit()
+        tr2.close()
+        assert "preempted" not in hist2
+        assert int(tr2.state.step) == cfg.epochs * nb
+
     def test_exact_resume_off_replays_epoch(self, tmp_path):
         cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path),
                                     "epochs": 2,

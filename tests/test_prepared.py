@@ -211,6 +211,52 @@ class TestUint8Wire:
         assert all(np.isfinite(l) for l in history["train_loss"])
         tr.close()
 
+    def test_uint8_samples_are_copies_not_cache_views(self, base, tmp_path):
+        """In-place mutation of a served sample must never reach the
+        on-disk cache (the served arrays could otherwise alias the
+        writable memmap rows)."""
+        post = build_prepared_post_transform(guidance="none", flip=False,
+                                             geom=False, uint8_wire=True)
+        ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10,
+                                     post_transform=post,
+                                     uint8_arrays=True)
+        before = ds[0]["concat"].copy()
+        s = ds[0]
+        s["concat"][:] = 0          # hostile downstream in-place write
+        s["crop_gt"][:] = 0
+        np.testing.assert_array_equal(ds[0]["concat"], before)
+
+    def test_fingerprint_tracks_file_content(self, fake_voc_root, tmp_path):
+        """A dataset regenerated in place (same name, split, count —
+        different pixels) must key a different cache."""
+        import shutil
+        root2 = str(tmp_path / "voc_copy")
+        shutil.copytree(fake_voc_root, root2)
+        b1 = make_base(root2)
+        ds = PreparedInstanceDataset(b1, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10)
+        fp1 = ds.fingerprint
+        # rewrite one probed image file (different bytes, same path)
+        img_path = b1.images[0]
+        from PIL import Image
+        Image.fromarray(np.zeros((40, 50, 3), np.uint8)).save(img_path)
+        b2 = make_base(root2)
+        ds2 = PreparedInstanceDataset(b2, str(tmp_path / "prep"),
+                                      crop_size=(64, 64), relax=10)
+        assert ds2.fingerprint != fp1
+
+    def test_uint8_transfer_needs_device_or_no_guidance(self, tmp_path):
+        from tests.test_train import make_tiny_cfg
+        from distributedpytorch_tpu.train import Trainer
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(
+                cfg.data, prepared_cache=str(tmp_path / "prep"),
+                uint8_transfer=True))  # host guidance default: rejected
+        with pytest.raises(ValueError, match="HOST-side guidance"):
+            Trainer(cfg)
+
     def test_uint8_transfer_requires_prepared_cache(self, tmp_path):
         from tests.test_train import make_tiny_cfg
         from distributedpytorch_tpu.train import Trainer
