@@ -143,7 +143,7 @@ class OptimConfig:
     lr: float = 5e-8
     momentum: float = 0.9
     weight_decay: float = 5e-4
-    schedule: str = "constant"          # constant | poly
+    schedule: str = "constant"          # constant | poly | cosine
     poly_power: float = 0.9
     warmup_steps: int = 0
     accum_steps: int = 1                # the reference's nAveGrad knob

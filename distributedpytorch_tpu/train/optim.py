@@ -38,8 +38,16 @@ def make_schedule(cfg: OptimConfig, total_steps: int) -> optax.Schedule:
             init_value=cfg.lr, end_value=0.0, power=cfg.poly_power,
             transition_steps=max(total_steps - cfg.warmup_steps, 1),
         )
+    elif cfg.schedule == "cosine":
+        # half-cosine decay lr -> 0 over the post-warmup steps (the other
+        # standard segmentation schedule besides poly)
+        sched = optax.cosine_decay_schedule(
+            init_value=cfg.lr,
+            decay_steps=max(total_steps - cfg.warmup_steps, 1),
+        )
     else:
-        raise ValueError(f"unknown schedule: {cfg.schedule!r}")
+        raise ValueError(f"unknown schedule: {cfg.schedule!r} "
+                         "(constant | poly | cosine)")
     if cfg.warmup_steps > 0:
         warm = optax.linear_schedule(0.0, cfg.lr, cfg.warmup_steps)
         sched = optax.join_schedules([warm, sched], [cfg.warmup_steps])

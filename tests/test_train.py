@@ -79,6 +79,20 @@ class TestOptim:
         assert float(s(0)) == pytest.approx(0.0)
         assert float(s(10)) == pytest.approx(0.1)
 
+    def test_cosine_schedule(self):
+        s = make_schedule(OptimConfig(lr=0.1, schedule="cosine"), 100)
+        assert float(s(0)) == pytest.approx(0.1)
+        assert float(s(50)) == pytest.approx(0.05, rel=1e-3)  # half-cosine
+        assert float(s(100)) == pytest.approx(0.0, abs=1e-9)
+        warm = make_schedule(
+            OptimConfig(lr=0.1, schedule="cosine", warmup_steps=10), 100)
+        assert float(warm(0)) == pytest.approx(0.0)
+        assert float(warm(10)) == pytest.approx(0.1)
+
+    def test_unknown_schedule_raises(self):
+        with pytest.raises(ValueError, match="cosine"):
+            make_schedule(OptimConfig(schedule="nope"), 10)
+
     def test_sgd_weight_decay_matches_torch_semantics(self):
         # torch: grad <- grad + wd*p, then momentum buffer. One step from
         # zero momentum: update = -lr * (g + wd*p).
