@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from .danet import DANet, DANetHead
 from .deeplab import ASPP, DeepLabV3, FCN, FCNHead
+from .pspnet import PSPNet, PyramidPooling
 from .resnet import ResNet, resnet50, resnet101
 
 _BACKBONE_DEPTH = {"resnet18": 18, "resnet34": 34, "resnet50": 50,
@@ -53,7 +54,7 @@ def build_model(
                     f"{k} is DANet-only; model {name!r} does not support it")
     if name == "danet":
         if kw.pop("aux_head", False):
-            raise ValueError("aux_head is a DeepLabV3/FCN option; DANet's "
+            raise ValueError("aux_head is a DeepLabV3/FCN/PSPNet option; DANet's "
                              "three heads already provide multi-output "
                              "supervision")
         return DANet(
@@ -83,8 +84,18 @@ def build_model(
             bn_cross_replica_axis=bn_cross_replica_axis,
             **kw,
         )
+    if name == "pspnet":
+        return PSPNet(
+            nclass=nclass,
+            backbone_depth=depth,
+            output_stride=output_stride or 8,
+            dtype=dtype,
+            bn_cross_replica_axis=bn_cross_replica_axis,
+            **kw,
+        )
     raise ValueError(
-        f"unknown model: {name!r} (danet | deeplabv3 | deeplabv3plus | fcn)")
+        f"unknown model: {name!r} (danet | deeplabv3 | deeplabv3plus | fcn "
+        "| pspnet)")
 
 
 __all__ = [
@@ -94,6 +105,8 @@ __all__ = [
     "DeepLabV3",
     "FCN",
     "FCNHead",
+    "PSPNet",
+    "PyramidPooling",
     "ResNet",
     "build_model",
     "resnet50",

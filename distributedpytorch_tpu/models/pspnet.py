@@ -1,0 +1,109 @@
+"""PSPNet — pyramid scene parsing segmentation model (flax.linen, NHWC).
+
+Fourth model family of the zoo (alongside DANet — the reference's flagship,
+reference train_pascal.py:32,86 — DeepLabV3(+), and FCN): Zhao et al.
+CVPR'17's pyramid pooling module over the dilated-ResNet stage-4 features.
+Where ASPP samples *dilated convolution* context at multiple rates, PPM
+pools the whole feature map to a few fixed grid sizes (1, 2, 3, 6),
+projects each, and upsamples back — global context at four granularities
+for almost no FLOPs.
+
+TPU notes: the pyramid pooling is average-pooling to *static* tiny grids +
+bilinear resize back — all static-shape `jax.image.resize`/`mean` ops that
+XLA fuses; no adaptive-pool dynamic shapes.  Output contract matches the
+zoo: a tuple of input-resolution logit maps, primary first (+ optional FCN
+aux head on c3, the original paper's training recipe), so the shared
+multi-output loss and Trainer drive it unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .deeplab import FCNHead, _resize_bilinear
+from .resnet import ResNet, make_norm
+
+
+class PyramidPooling(nn.Module):
+    """PPM: pool to each bin grid, 1x1-project to C/len(bins), upsample,
+    concat with the input, 3x3-project."""
+
+    channels: int
+    bins: Sequence[int]
+    norm: Any
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h, w = x.shape[1:3]
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        branch_c = max(self.channels // len(self.bins), 1)
+
+        def project(y, ch, kernel, name):
+            y = conv(ch, kernel, padding="SAME", name=f"{name}_conv")(y)
+            y = self.norm(name=f"{name}_bn")(y)
+            return nn.relu(y)
+
+        outs = [x]
+        for bin_ in self.bins:
+            # Static-grid average pool: reshape-mean when the grid divides,
+            # else resize-based pooling (still static shapes).
+            if h % bin_ == 0 and w % bin_ == 0:
+                b, _, _, c = x.shape
+                pooled = x.reshape(b, bin_, h // bin_, bin_, w // bin_, c) \
+                    .mean(axis=(2, 4))
+            else:
+                pooled = jax.image.resize(
+                    x, (x.shape[0], bin_, bin_, x.shape[-1]),
+                    method="linear").astype(x.dtype)
+            pooled = project(pooled, branch_c, (1, 1), f"bin{bin_}")
+            outs.append(_resize_bilinear(pooled, (h, w)))
+
+        y = jnp.concatenate(outs, axis=-1)
+        y = project(y, self.channels, (3, 3), "fuse")
+        return nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+
+
+class PSPNet(nn.Module):
+    """Dilated ResNet + pyramid pooling; ``__call__(x, train)`` ->
+    (logits,) or (logits, aux_logits) at input resolution."""
+
+    nclass: int = 21
+    backbone_depth: int = 50
+    output_stride: int = 8      # the paper trains at os=8
+    ppm_channels: int = 512
+    bins: Sequence[int] = (1, 2, 3, 6)
+    aux_head: bool = False
+    dtype: jnp.dtype = jnp.float32
+    bn_cross_replica_axis: str | None = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        feats = ResNet(
+            depth=self.backbone_depth,
+            output_stride=self.output_stride,
+            dtype=self.dtype,
+            bn_cross_replica_axis=self.bn_cross_replica_axis,
+            remat=self.remat,
+            name="backbone",
+        )(x, train=train)
+        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
+        y = PyramidPooling(channels=self.ppm_channels, bins=self.bins,
+                           norm=norm, dtype=self.dtype,
+                           name="ppm")(feats["c4"], train=train)
+        y = nn.Conv(self.nclass, (1, 1), dtype=self.dtype,
+                    name="classifier")(y)
+        outs = [_resize_bilinear(y, size)]
+        if self.aux_head:
+            aux = FCNHead(nclass=self.nclass, norm=norm, dtype=self.dtype,
+                          name="aux")(feats["c3"], train=train)
+            outs.append(_resize_bilinear(aux, size))
+        return tuple(outs)

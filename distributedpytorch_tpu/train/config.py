@@ -111,7 +111,7 @@ class DataConfig:
 @dataclass
 class ModelConfig:
     name: str = "danet"                 # danet | deeplabv3 | deeplabv3plus
-                                        # | fcn
+                                        # | fcn | pspnet
     nclass: int = 1                     # binary/sigmoid head (DANet(1, ...))
     backbone: str = "resnet101"
     output_stride: int | None = None

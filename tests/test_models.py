@@ -169,6 +169,30 @@ class TestFCN:
 
 
 class TestFactory:
+    def test_build_pspnet(self):
+        from distributedpytorch_tpu.models import build_model
+        m = build_model("pspnet", nclass=21, backbone="resnet18",
+                        output_stride=8, aux_head=True)
+        x = jnp.zeros((2, 48, 48, 3))
+        _, out = init_and_apply(m, x)
+        assert len(out) == 2  # primary + aux
+        for o in out:
+            assert o.shape == (2, 48, 48, 21)
+
+    def test_pspnet_bins_both_pool_paths(self):
+        """48x48 at os=8 -> 6x6 features: bins 1,2,3,6 divide (reshape-mean
+        path); 64x64 -> 8x8: bins 3 and 6 don't divide (resize path).  Both
+        must produce finite maps."""
+        from distributedpytorch_tpu.models import PSPNet
+        for hw in (48, 64):
+            m = PSPNet(nclass=1, backbone_depth=18, output_stride=8)
+            x = jnp.asarray(
+                np.random.default_rng(0).normal(size=(1, hw, hw, 3)),
+                jnp.float32)
+            _, out = init_and_apply(m, x)
+            assert np.isfinite(np.asarray(out[0])).all()
+            assert out[0].shape == (1, hw, hw, 1)
+
     def test_build_fcn(self):
         m = build_model("fcn", nclass=21, backbone="resnet50")
         assert isinstance(m, FCN) and m.output_stride == 8
