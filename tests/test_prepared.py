@@ -119,6 +119,23 @@ class TestCacheCore:
             assert set(meta) == {"image", "object", "category", "im_size"}
             assert meta["image"] == combined.sample_image_id(i)
 
+    def test_torn_write_rows_refill_on_read(self, base, tmp_path):
+        """Crash-recovery contract: a valid=1 row whose data pages never
+        landed (all zeros — writeback order is arbitrary) must be refilled,
+        not served as silent empty samples."""
+        ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10)
+        good = ds[0]
+        # simulate the torn write: image row zeroed, mask row zeroed,
+        # valid byte still set
+        ds._maps["images.u8"][0] = 0
+        torn_img = ds[0]
+        np.testing.assert_array_equal(torn_img["crop_image"],
+                                      good["crop_image"])
+        ds._maps["masks.u8"][0] = 0
+        torn_mask = ds[0]
+        np.testing.assert_array_equal(torn_mask["crop_gt"], good["crop_gt"])
+
     def test_pickle_roundtrip_reopens_maps(self, base, tmp_path):
         import pickle
         ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
