@@ -3,7 +3,7 @@
 from . import guidance, transforms
 from .combine import CombinedDataset
 from .fake import make_fake_sbd, make_fake_voc
-from .sbd import SBDInstanceSegmentation
+from .sbd import SBDInstanceSegmentation, SBDSemanticSegmentation
 from .grain_pipeline import (GrainDataLoader, HAVE_GRAIN,
                              make_grain_loader)
 from .pipeline import (
@@ -48,6 +48,7 @@ __all__ = [
     "collate",
     "guidance",
     "SBDInstanceSegmentation",
+    "SBDSemanticSegmentation",
     "make_fake_sbd",
     "make_fake_voc",
     "GrainDataLoader",

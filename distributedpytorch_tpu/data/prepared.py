@@ -57,22 +57,26 @@ _FORMAT_VERSION = 1
 
 def _content_stamp(dataset) -> list:
     """Cheap content probe of the underlying files: (path, size, mtime_ns)
-    of a handful of the dataset's image files.  Catches a dataset
+    of a handful of the dataset's image AND label files.  Catches a dataset
     *regenerated in place* with the same name/split/count (same ``str`` and
-    ``len``) but different pixels — which the identity fields alone would
-    silently alias to stale cached rows."""
+    ``len``) but different pixels/labels — which the identity fields alone
+    would silently alias to stale cached rows."""
     if hasattr(dataset, "datasets"):  # CombinedDataset: walk constituents
         return [s for ds in dataset.datasets for s in _content_stamp(ds)]
-    paths = getattr(dataset, "images", None)
-    if not paths:
-        return []
     stamp = []
-    for p in {paths[0], paths[len(paths) // 2], paths[-1]}:
-        try:
-            st = os.stat(p)
-            stamp.append([p, st.st_size, st.st_mtime_ns])
-        except OSError:
-            stamp.append([p, -1, -1])
+    # every file-list attribute the dataset classes expose: images, the
+    # instance/semantic label files (masks/categories/labels)
+    for attr in ("images", "masks", "categories", "labels"):
+        paths = getattr(dataset, attr, None)
+        if not isinstance(paths, list) or not paths \
+                or not isinstance(paths[0], str):
+            continue
+        for p in {paths[0], paths[len(paths) // 2], paths[-1]}:
+            try:
+                st = os.stat(p)
+                stamp.append([p, st.st_size, st.st_mtime_ns])
+            except OSError:
+                stamp.append([p, -1, -1])
     return sorted(stamp)
 
 

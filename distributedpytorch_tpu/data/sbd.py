@@ -173,3 +173,83 @@ class SBDInstanceSegmentation:
 
     def __str__(self) -> str:
         return f"SBD(split={self.split},area_thres={self.area_thres})"
+
+
+class SBDSemanticSegmentation:
+    """Per-image semantic SBD: class-id masks from the ``GTcls`` structs.
+
+    The semantic counterpart of :class:`SBDInstanceSegmentation`, with the
+    :class:`.voc.VOCSemanticSegmentation` sample contract (``image``/``gt``
+    class ids 0..20 with in-band 255 void, ``meta``).  Its purpose is the
+    standard "train_aug" recipe for the DeepLab configs: SBD's ~10k
+    annotated training images merged into VOC semantic training via
+    ``CombinedDataset`` with the VOC-val overlap excluded — the semantic
+    twin of the reference's instance-side ``use_sbd`` merge
+    (train_pascal.py:150-154).
+    """
+
+    def __init__(self, root: str, split="train", transform=None,
+                 retname: bool = True, decode_cache: int = 0):
+        from .voc import CATEGORY_NAMES, _DecodeCache
+
+        self.root = root
+        self.transform = transform
+        self.retname = retname
+        self.nclass = len(CATEGORY_NAMES)
+        self._cache = _DecodeCache(decode_cache) if decode_cache > 0 else None
+        self.split = sorted([split] if isinstance(split, str)
+                            else list(split))
+        base = os.path.join(root, BASE_DIR)
+        self._image_dir = os.path.join(base, "img")
+        self._cls_dir = os.path.join(base, "cls")
+        self.im_ids: list[str] = []
+        #: image / label file paths (also the prepared cache's
+        #: content-stamp probe — regenerated jpgs OR .mat labels must
+        #: change the fingerprint)
+        self.images: list[str] = []
+        self.labels: list[str] = []
+        for splt in self.split:
+            with open(os.path.join(base, splt + ".txt")) as f:
+                # .strip() filter matching SBDInstanceSegmentation: a
+                # whitespace-only line must not become a phantom id
+                ids = [l for l in f.read().splitlines() if l.strip()]
+            for line in ids:
+                img = os.path.join(self._image_dir, line + ".jpg")
+                cls = os.path.join(self._cls_dir, line + ".mat")
+                for p in (img, cls):
+                    if not os.path.isfile(p):
+                        raise FileNotFoundError(p)
+                self.im_ids.append(line)
+                self.images.append(img)
+                self.labels.append(cls)
+
+    def __len__(self) -> int:
+        return len(self.im_ids)
+
+    def sample_image_id(self, index: int) -> str:
+        return self.im_ids[index]
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        im_id = self.im_ids[index]
+
+        def decode():
+            img8 = np.array(Image.open(self.images[index]).convert("RGB"),
+                            np.uint8)
+            gt = _load_mat_struct(
+                os.path.join(self._cls_dir, im_id + ".mat"), "GTcls")
+            return img8, np.asarray(gt.Segmentation)
+
+        img8, gt_raw = (self._cache.get(index, decode)
+                        if self._cache is not None else decode())
+        img = img8.astype(np.float32)  # astype copies; cache never mutated
+        sample = {"image": img, "gt": gt_raw.astype(np.float32)}
+        if self.retname:
+            sample["meta"] = {"image": im_id,
+                              "im_size": (img.shape[0], img.shape[1])}
+        if self.transform is not None:
+            sample = self.transform(sample, rng)
+        return sample
+
+    def __str__(self) -> str:
+        return f"SBDSemantic(split={self.split})"

@@ -27,11 +27,14 @@ from typing import Any
 @dataclass
 class DataConfig:
     root: str = ""                      # dataset root (was: the mypath module)
-    sbd_root: str = ""                  # set: merge SBD into instance
-                                        # training via CombinedDataset,
-                                        # excluding VOC-val overlap (the
-                                        # reference's use_sbd recipe,
-                                        # train_pascal.py:150-154)
+    sbd_root: str = ""                  # set: merge SBD into training via
+                                        # CombinedDataset, excluding the
+                                        # VOC-val overlap.  Instance task:
+                                        # the reference's use_sbd recipe
+                                        # (train_pascal.py:150-154).
+                                        # Semantic task: the standard
+                                        # "train_aug" recipe (~10k extra
+                                        # images for the DeepLab configs).
     fake: bool = False                  # synth fixture instead of real VOC
     download: bool = False              # fetch + MD5-verify VOC if absent
     train_split: str = "train"

@@ -125,9 +125,6 @@ class Trainer:
                 "eval_tta_scales/eval_tta_flip apply to the semantic task "
                 "only (the instance protocol is the reference's fixed "
                 "threshold sweep)")
-        if cfg.data.sbd_root and cfg.task != "instance":
-            raise ValueError("data.sbd_root merges SBD instances into the "
-                             "instance task only")
 
         # --- mesh
         self.mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
@@ -260,6 +257,24 @@ class Trainer:
             self.train_set = VOCSemanticSegmentation(
                 root, split=cfg.data.train_split, transform=sem_train_tf,
                 decode_cache=cfg.data.decode_cache)
+            # Val has no decode cache (one sample per image, scanned
+            # sequentially — an LRU smaller than the split gets zero hits).
+            # Built before the SBD merge so the merge can exclude its
+            # overlap (SBD train covers most of VOC val — the standard
+            # "train_aug" recipe needs the exclusion).
+            self.val_set = VOCSemanticSegmentation(
+                root, split=cfg.data.val_split,
+                transform=build_semantic_eval_transform(
+                    crop_size=cfg.data.crop_size))
+            if cfg.data.sbd_root:
+                from ..data import CombinedDataset
+                from ..data.sbd import SBDSemanticSegmentation
+                sbd = SBDSemanticSegmentation(
+                    cfg.data.sbd_root, split=["train", "val"],
+                    transform=sem_train_tf,
+                    decode_cache=cfg.data.decode_cache)
+                self.train_set = CombinedDataset(
+                    [self.train_set, sbd], excluded=[self.val_set])
             if prepared:
                 from ..data.pipeline import (
                     build_prepared_semantic_post_transform,
@@ -275,14 +290,6 @@ class Trainer:
                         geom=not (cfg.data.device_augment
                                   and cfg.data.device_augment_geom),
                         uint8_wire=cfg.data.uint8_transfer))
-            # No val cache: semantic val is one sample per image scanned
-            # sequentially — an LRU smaller than the split gets zero hits
-            # and would only double the RAM budget.  (Instance val keeps
-            # it: every image is decoded once per *object*.)
-            self.val_set = VOCSemanticSegmentation(
-                root, split=cfg.data.val_split,
-                transform=build_semantic_eval_transform(
-                    crop_size=cfg.data.crop_size))
         else:
             raise ValueError(
                 f"unknown task: {cfg.task!r} (instance | semantic)")

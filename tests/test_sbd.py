@@ -203,19 +203,55 @@ class TestTrainerSBDMerge:
         assert all(np.isfinite(l) for l in hist["train_loss"])
         tr.close()
 
-    def test_semantic_task_rejects_sbd_root(self, tmp_path):
+    def test_semantic_sbd_merge_trains_with_exclusion(self, tmp_path):
+        """The semantic 'train_aug' recipe: VOC semantic train + SBD
+        semantic (GTcls masks), VOC-val overlap excluded — through the
+        Trainer with the prepared cache + uint8 wire on top."""
         import dataclasses
 
+        from distributedpytorch_tpu.data import VOCSemanticSegmentation
         from distributedpytorch_tpu.train import (
             Config,
             Trainer,
             apply_overrides,
         )
 
+        voc_root = make_fake_voc(str(tmp_path / "voc"), n_images=10,
+                                 size=(96, 128), n_val=3, seed=0)
+        val_ids = VOCSemanticSegmentation(voc_root, split="val").im_ids
+        sbd_root = make_fake_sbd(str(tmp_path / "sbd"), n_images=6,
+                                 size=(96, 128), n_val=0, seed=7,
+                                 overlap_ids=[val_ids[0]])
         cfg = apply_overrides(Config(), [
-            "task=semantic", "data.fake=true", "model.nclass=21",
-            "model.in_channels=3", "data.sbd_root=/nope",
+            "task=semantic", "model.nclass=21", "model.in_channels=3",
+            "data.train_batch=8", "data.val_batch=2",
+            "data.crop_size=[48,48]", "data.num_workers=0",
+            f"data.prepared_cache={tmp_path / 'prep'}",
+            "data.uint8_transfer=true",
+            "model.backbone=resnet18", "model.output_stride=8",
+            "checkpoint.async_save=false", "epochs=1", "eval_every=1",
+            f"data.root={voc_root}", f"data.sbd_root={sbd_root}",
         ])
         cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
-        with pytest.raises(ValueError, match="instance task"):
-            Trainer(cfg)
+        tr = Trainer(cfg)
+        inner = tr.train_set.dataset  # prepared wrap -> CombinedDataset
+        assert isinstance(inner, CombinedDataset)
+        # merged set is bigger than VOC train alone, and leak-free
+        assert len(inner) > 10 - 3
+        for i in range(len(inner)):
+            assert inner.sample_image_id(i) not in val_ids
+        hist = tr.fit()
+        assert all(np.isfinite(l) for l in hist["train_loss"])
+        assert 0.0 <= hist["val"][-1]["miou"] <= 1.0
+        tr.close()
+
+    def test_sbd_semantic_sample_contract(self, sbd_root):
+        from distributedpytorch_tpu.data import SBDSemanticSegmentation
+        ds = SBDSemanticSegmentation(sbd_root, split="train")
+        assert len(ds) == 4  # one sample per image
+        s = ds[0]
+        assert set(s) == {"image", "gt", "meta"}
+        assert s["image"].ndim == 3 and s["image"].dtype == np.float32
+        uniq = set(np.unique(s["gt"]).astype(int).tolist())
+        assert uniq <= set(range(21)) | {255}
+        assert s["meta"]["image"] == ds.sample_image_id(0)
