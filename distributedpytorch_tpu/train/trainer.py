@@ -673,7 +673,12 @@ class Trainer:
             # Async H2D overlap: up to device_prefetch batches are already
             # placed (sharded) while the current step computes.
             batches = prefetch_to_device(
-                host_batches(), self.mesh, size=cfg.data.device_prefetch,
+                host_batches(), self.mesh,
+                # a multi-step dispatch consumes K placed batches at once;
+                # a window smaller than K would stall the chip on placement
+                # at every chunk boundary
+                size=max(cfg.data.device_prefetch,
+                         cfg.data.steps_per_dispatch),
                 keys=("concat", "crop_gt", "crop_void"))
             if cfg.data.echo > 1:
                 batches = echoed(batches)
