@@ -254,16 +254,30 @@ def build_prepared_semantic_post_transform(
 
 def build_semantic_eval_transform(
     crop_size: tuple[int, int] = (513, 513),
+    keep_fullres: bool = False,
 ) -> T.Compose:
     """Deterministic semantic eval: fixed resize only (gt nearest so class
-    ids and 255-void stay exact), renamed onto the step contract."""
-    return T.Compose([
-        T.FixedResize(resolutions={"image": crop_size, "gt": crop_size},
-                      flagvals={"image": None, "gt": 0}),
+    ids and 255-void stay exact), renamed onto the step contract.
+
+    ``keep_fullres`` preserves the ORIGINAL-resolution gt as ``gt_full``
+    (ragged, host-side) so the evaluator can score mIoU at each image's
+    native size — the standard DeepLab protocol — instead of at the
+    resized crop (the instance pipeline keeps full-res gt the same way,
+    reference train_pascal.py:138)."""
+    res: dict = {"image": crop_size, "gt": crop_size}
+    flags: dict = {"image": None, "gt": 0}
+    chain: list[T.Transform] = []
+    if keep_fullres:
+        chain.append(T.Duplicate({"gt": "gt_full"}))
+        res["gt_full"] = None   # passthrough, survives the pruning rule
+        flags["gt_full"] = 0
+    chain += [
+        T.FixedResize(resolutions=res, flagvals=flags),
         T.ClampRange(("image",)),  # cubic-overshoot clamp, as in train
         T.Rename({"image": "concat", "gt": "crop_gt"}),
         T.ToArray(),
-    ])
+    ]
+    return T.Compose(chain)
 
 
 # ---------------------------------------------------------------------------

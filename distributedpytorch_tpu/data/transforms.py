@@ -600,6 +600,23 @@ class ToImage(Transform):
         return sample
 
 
+class Duplicate(Transform):
+    """Copy sample keys (``{src: dst}``) — e.g. preserving a full-res
+    ``gt`` under a new name before a resize stage consumes the original."""
+
+    def __init__(self, mapping: Mapping[str, str]):
+        self.mapping = dict(mapping)
+
+    def __call__(self, sample, rng=None):
+        for src, dst in self.mapping.items():
+            if src in sample:
+                sample[dst] = sample[src]
+        return sample
+
+    def __repr__(self):
+        return f"Duplicate({self.mapping})"
+
+
 class Rename(Transform):
     """Rename sample keys (``{old: new}``) — adapter between pipelines with
     different key contracts (e.g. the semantic pipeline's per-image

@@ -206,6 +206,12 @@ class Config:
                                         # (1.0 = the base pass)
     eval_tta_flip: bool = False         # semantic TTA: also average the
                                         # horizontal flip
+    eval_full_res: bool = False         # semantic: score mIoU at each
+                                        # image's ORIGINAL resolution
+                                        # (probabilities bilinearly resized
+                                        # back per sample — the standard
+                                        # DeepLab protocol) instead of at
+                                        # the resized eval crop
     seed: int = 0
     work_dir: str = "runs"              # run_<N> dirs created under this
     resume: str | None = None           # checkpoint dir to resume from, or

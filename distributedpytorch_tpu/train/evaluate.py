@@ -242,6 +242,28 @@ def evaluate_semantic(
     from ..ops.metrics import miou_from_confusion
     from ..utils.helpers import fixed_resize
 
+    def np_confusion(pred: np.ndarray, label: np.ndarray) -> np.ndarray:
+        """Host-side (C, C) confusion, rows=true cols=pred — the ragged
+        full-res twin of ops.metrics.confusion_matrix."""
+        valid = label != ignore_index
+        idx = label[valid].astype(np.int64) * nclass \
+            + pred[valid].astype(np.int64)
+        return np.bincount(idx, minlength=nclass * nclass) \
+            .reshape(nclass, nclass)
+
+    def fullres_confusion(probs: np.ndarray, gts_full: list) -> np.ndarray:
+        """Per-sample: bilinear-resize class probabilities to the gt's
+        native size, argmax, score — the standard DeepLab protocol (metric
+        at ORIGINAL resolution, not the network's crop)."""
+        out = np.zeros((nclass, nclass), np.int64)
+        for j, gt in enumerate(gts_full):
+            gt = np.asarray(gt)
+            if gt.ndim == 3:
+                gt = gt[..., 0]
+            p = fixed_resize(probs[j], gt.shape[:2], flagval=imaging.LINEAR)
+            out += np_confusion(np.argmax(p, axis=-1), gt)
+        return out
+
     if len(set(tta_scales)) != len(tta_scales):
         raise ValueError(f"duplicate tta_scales {tta_scales} would "
                          "double-weight votes")
@@ -282,11 +304,20 @@ def evaluate_semantic(
             losses.append(loss)
             # Padding repeats real samples; drop them from the counts by
             # scoring only the first n rows (host-local multi-host).
-            out0 = _local_rows(outputs[0])[:n]
-            labels = _local_rows(padded["crop_gt"])[:n]
-            confs.append(_batch_confusion(
-                jnp.asarray(out0), jnp.asarray(labels), nclass,
-                ignore_index))
+            if "gt_full" in batch:  # native-resolution protocol
+                # softmax on DEVICE before readback (same D2H bytes, no
+                # host-side exp/sum over B*H*W*C stalling the loop)
+                probs_h = _local_rows(jax.nn.softmax(
+                    jnp.asarray(outputs[0]).astype(jnp.float32),
+                    axis=-1))[:n]
+                conf += fullres_confusion(np.asarray(probs_h),
+                                          _as_list(batch["gt_full"], n))
+            else:
+                out0 = _local_rows(outputs[0])[:n]
+                labels = _local_rows(padded["crop_gt"])[:n]
+                confs.append(_batch_confusion(
+                    jnp.asarray(out0), jnp.asarray(labels), nclass,
+                    ignore_index))
             continue
 
         inp = np.asarray(batch[INPUT_KEY])
@@ -325,9 +356,12 @@ def evaluate_semantic(
                         for pp in p_f])
                 probs += p_f
                 votes += 1
-        confs.append(_batch_confusion(
-            jnp.asarray(probs / votes), jnp.asarray(gt), nclass,
-            ignore_index))
+        avg = probs / votes
+        if "gt_full" in batch:  # TTA composes with the native-res protocol
+            conf += fullres_confusion(avg, _as_list(batch["gt_full"], n))
+        else:
+            confs.append(_batch_confusion(
+                jnp.asarray(avg), jnp.asarray(gt), nclass, ignore_index))
 
     if confs:  # one bulk readback for every deferred device value
         conf += np.sum(np.asarray(jax.device_get(confs), np.int64), axis=0)

@@ -125,6 +125,11 @@ class Trainer:
                 "eval_tta_scales/eval_tta_flip apply to the semantic task "
                 "only (the instance protocol is the reference's fixed "
                 "threshold sweep)")
+        if cfg.eval_full_res and cfg.task != "semantic":
+            raise ValueError(
+                "eval_full_res applies to the semantic task only (the "
+                "instance protocol already scores at full resolution via "
+                "crop2fullmask paste-back)")
 
         # --- mesh
         self.mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
@@ -265,7 +270,8 @@ class Trainer:
             self.val_set = VOCSemanticSegmentation(
                 root, split=cfg.data.val_split,
                 transform=build_semantic_eval_transform(
-                    crop_size=cfg.data.crop_size))
+                    crop_size=cfg.data.crop_size,
+                    keep_fullres=cfg.eval_full_res))
             if cfg.data.sbd_root:
                 from ..data import CombinedDataset
                 from ..data.sbd import SBDSemanticSegmentation

@@ -102,6 +102,87 @@ class TestSemanticTrainerEndToEnd:
         tr.close()
 
 
+class TestFullResEval:
+    def test_fullres_batch_keeps_ragged_gt(self, fake_voc_root):
+        from distributedpytorch_tpu.data import (
+            DataLoader,
+            VOCSemanticSegmentation,
+            build_semantic_eval_transform,
+        )
+        ds = VOCSemanticSegmentation(
+            fake_voc_root, split="val",
+            transform=build_semantic_eval_transform(crop_size=(64, 64),
+                                                    keep_fullres=True))
+        batch = next(iter(DataLoader(ds, batch_size=2, num_workers=0)))
+        assert batch["concat"].shape[1:3] == (64, 64)
+        first = batch["gt_full"][0]  # list (ragged) and stacked both index
+        # native resolution preserved, ids exact
+        assert np.asarray(first).shape[:2] == (120, 160)
+        uniq = set(np.unique(np.asarray(first)).astype(int).tolist())
+        assert uniq <= set(range(21)) | {255}
+
+    def test_fullres_matches_crop_when_sizes_equal(self, tmp_path):
+        """When the eval crop EQUALS the native size, native-res scoring
+        must agree with crop-res scoring (same pixels, same argmax)."""
+        import dataclasses
+
+        from distributedpytorch_tpu.data import make_fake_voc
+        root = make_fake_voc(str(tmp_path / "voc"), n_images=6,
+                             size=(64, 64), n_val=2, seed=3)
+        base = [
+            "task=semantic", f"data.root={root}", "data.train_batch=4",
+            "mesh.data=4", "mesh.model=2",  # batch must divide the data axis
+            "data.val_batch=2", "data.crop_size=[64,64]",
+            "model.name=deeplabv3", "model.nclass=21",
+            "model.backbone=resnet18", "model.in_channels=3",
+            "optim.lr=0.001", "checkpoint.async_save=false", "epochs=1",
+            "eval_every=0",  # fit-free: validate() directly
+        ]
+        cfg_a = dataclasses.replace(
+            apply_overrides(Config(), base + ["eval_full_res=true"]),
+            work_dir=str(tmp_path / "runs_a"))
+        cfg_b = dataclasses.replace(
+            apply_overrides(Config(), base),
+            work_dir=str(tmp_path / "runs_b"))
+        tr_a = Trainer(cfg_a)
+        m_a = tr_a.validate(log_panels=False)
+        tr_b = Trainer(cfg_b)
+        # identical init (same seed/model) -> identical logits
+        m_b = tr_b.validate(log_panels=False)
+        assert m_a["miou"] == pytest.approx(m_b["miou"], abs=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(m_a["per_class_iou"], np.float64),
+            np.asarray(m_b["per_class_iou"], np.float64),
+            rtol=1e-6, equal_nan=True)
+        tr_a.close()
+        tr_b.close()
+
+    def test_fullres_trainer_e2e(self, tmp_path):
+        import dataclasses
+        cfg = apply_overrides(Config(), [
+            "task=semantic", "data.fake=true", "data.train_batch=4",
+            "mesh.data=4", "mesh.model=2",
+            "data.val_batch=2", "data.crop_size=[64,64]",
+            "eval_full_res=true",
+            "model.name=deeplabv3", "model.nclass=21",
+            "model.backbone=resnet18", "model.in_channels=3",
+            "optim.lr=0.001", "checkpoint.async_save=false", "epochs=1",
+        ])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        tr = Trainer(cfg)
+        hist = tr.fit()
+        assert 0.0 <= hist["val"][-1]["miou"] <= 1.0
+        tr.close()
+
+    def test_instance_task_rejects_full_res(self, tmp_path):
+        import dataclasses
+        cfg = apply_overrides(Config(), ["data.fake=true",
+                                         "eval_full_res=true"])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        with pytest.raises(ValueError, match="semantic task only"):
+            Trainer(cfg)
+
+
 class TestFCNSemantic:
     def test_fit_fcn_semantic(self, tmp_path):
         cfg = apply_overrides(Config(), [
