@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..utils.helpers import make_gaussian, make_gt
+from ..utils.helpers import make_gt
 
 
 # ---------------------------------------------------------------------------

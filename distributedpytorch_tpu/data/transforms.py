@@ -25,7 +25,7 @@ exemption lists at reference custom_transforms.py:108,166,482).
 
 from __future__ import annotations
 
-from typing import Callable, Mapping, Sequence
+from typing import Mapping, Sequence
 
 import numpy as np
 
