@@ -87,22 +87,39 @@ def evaluate(
     t0 = time.perf_counter()
 
     n_dev = mesh.devices.size if mesh is not None else 1
-    for bi, batch in enumerate(loader):
-        if max_batches is not None and bi >= max_batches:
-            break
-        if debug_asserts:
-            batch_debug_asserts(batch)
+
+    def forwarded():
+        """One-batch look-ahead: dispatch batch i+1's forward BEFORE
+        materializing batch i's outputs, so the per-sample host paste-back
+        below overlaps the next forward's device compute (eval was
+        dispatch-bound at the reference's bs=1 protocol, ~180 ms/sample
+        through a tunneled chip).  ``eval_step`` is async — holding its
+        un-materialized outputs costs nothing."""
+        prev = None
+        for bi, batch in enumerate(loader):
+            if max_batches is not None and bi >= max_batches:
+                break
+            if debug_asserts:
+                batch_debug_asserts(batch)
+            device_keys = {k: v for k, v in batch.items()
+                           if k in (INPUT_KEY, "crop_gt", "crop_void")}
+            padded, _ = pad_to_multiple(device_keys, n_dev)
+            if mesh is not None:
+                padded = shard_batch(mesh, padded)
+            outputs, loss = eval_step(state, padded)
+            # deferred: float(loss) here would add a host<->device round
+            # trip per val batch (~70ms each through a tunneled chip) on
+            # top of the outputs fetch — the same stall train_epoch's bulk
+            # readback fixed
+            losses.append(loss)
+            if prev is not None:
+                yield prev
+            prev = (batch, outputs)
+        if prev is not None:
+            yield prev
+
+    for batch, outputs in forwarded():
         n = batch[INPUT_KEY].shape[0]
-        device_keys = {k: v for k, v in batch.items()
-                       if k in (INPUT_KEY, "crop_gt", "crop_void")}
-        padded, _ = pad_to_multiple(device_keys, n_dev)
-        if mesh is not None:
-            padded = shard_batch(mesh, padded)
-        outputs, loss = eval_step(state, padded)
-        # deferred: float(loss) here would add a host<->device round trip
-        # per val batch (~70ms each through a tunneled chip) on top of the
-        # outputs fetch — the same stall train_epoch's bulk readback fixed
-        losses.append(loss)
         # primary head only; ragged paste-back per sample on host
         probs = _sigmoid(_local_rows(outputs[0])[:n])
         if first_batch_vis is None:
