@@ -284,6 +284,35 @@ class TestUint8Wire:
             Trainer(cfg)
 
 
+class TestGrainProcessWorkers:
+    def test_grain_workers_fill_and_serve_cache(self, base, tmp_path):
+        """REAL grain process workers over the prepared cache: the dataset
+        pickles into each worker (memmaps reopen, not ship), workers fill
+        rows cross-process via the shared files, and a second epoch serves
+        from a full cache."""
+        from distributedpytorch_tpu.data import HAVE_GRAIN
+        if not HAVE_GRAIN:
+            pytest.skip("grain not installed")
+        from distributedpytorch_tpu.data import GrainDataLoader
+        ds = PreparedInstanceDataset(
+            base, str(tmp_path / "prep"), crop_size=(64, 64), relax=10,
+            post_transform=build_prepared_post_transform(
+                guidance="none", uint8_wire=True),
+            uint8_arrays=True)
+        loader = GrainDataLoader(ds, batch_size=4, shuffle=True,
+                                 drop_last=False, seed=0, num_workers=2)
+        loader.set_epoch(0)
+        n = sum(b["concat"].shape[0] for b in loader)
+        assert n == len(ds)
+        # worker processes wrote through the SHARED memmap files: the
+        # parent's own view must see every row valid
+        assert ds.n_prepared == len(ds)
+        loader.set_epoch(1)
+        batches = list(loader)
+        assert sum(b["concat"].shape[0] for b in batches) == len(ds)
+        assert all(b["concat"].dtype == np.uint8 for b in batches)
+
+
 class TestLoaderIntegration:
     def test_epoch2_serves_entirely_from_cache(self, base, tmp_path):
         ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
