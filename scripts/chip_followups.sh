@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-2 chip follow-ups that were queued when the axon tunnel wedged
+# (>7 h on 2026-07-31).  Run on an IDLE host with a healthy tunnel; each
+# step is independent — rerun any that fail.  Results go into BASELINE.md
+# (sections reference these scripts by name).
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. op-level profile: top-op table for b8 and the b16 regression
+python scripts/profile_step.py --batch 8  --out /tmp/prof_b8  | tee /tmp/prof_b8.json
+python scripts/profile_step.py --batch 16 --out /tmp/prof_b16 | tee /tmp/prof_b16.json
+
+# 2. convergence evidence (VERDICT r1 item 3): guided vs guidance-ablated,
+#    then semantic DeepLabV3-R101 os=16 — ~15 min each
+python scripts/convergence_runs.py a b --epochs 30 | tee /tmp/conv_ab.json
+python scripts/convergence_runs.py c  --epochs 30 | tee /tmp/conv_c.json
+
+# 3. e2e bench rows not yet measured clean: batched val (10), semantic
+#    fast path (11), multi-step dispatch (12)
+python scripts/bench_e2e.py 10 11 12 | tee /tmp/bench_e2e_new.json
+
+# 4. the official step bench with the round-2 MFU/roofline fields
+python bench.py | tee /tmp/bench_mfu.json
