@@ -34,29 +34,56 @@ def pin_requested_platform() -> None:
         jax.config.update("jax_platforms", p)
 
 
-def accelerator_healthy(timeout_s: int = 240) -> tuple[bool, str]:
-    """Probe the default jax backend in a throwaway subprocess.
+def _probe(tail_code: str, timeout_s: int):
+    """Run a backend probe in a throwaway subprocess with a hard timeout.
 
-    The child pins any explicitly-requested platform exactly as the parent
-    will (:func:`pin_requested_platform`), so the probe validates the
-    backend the caller will actually run on.  Returns ``(healthy, reason)``.
+    The child sys.paths the repo and pins any explicitly-requested platform
+    exactly as the parent will (:func:`pin_requested_platform`), then
+    ``import jax`` followed by ``tail_code``.  One owner for the probe
+    prologue — every health question in this module (and the pollers built
+    on it) must ask it the same way.  Returns the ``CompletedProcess``, or
+    ``None`` on timeout.
     """
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     try:
-        probe = subprocess.run(
+        return subprocess.run(
             [sys.executable, "-c",
              f"import sys; sys.path.insert(0, {root!r});"
              "from distributedpytorch_tpu.backend_health import "
              "pin_requested_platform;"
              "pin_requested_platform();"
-             "import jax; assert len(jax.devices()) >= 1"],
+             "import jax;" + tail_code],
             timeout=timeout_s, capture_output=True, text=True)
-        if probe.returncode == 0:
-            return True, ""
-        lines = (probe.stderr or "").strip().splitlines()
-        return False, lines[-1] if lines else "probe failed"
     except subprocess.TimeoutExpired:
+        return None
+
+
+def accelerator_healthy(timeout_s: int = 240) -> tuple[bool, str]:
+    """Probe the default jax backend in a throwaway subprocess.
+
+    The probe validates the backend the caller will actually run on.
+    Returns ``(healthy, reason)``.
+    """
+    probe = _probe("assert len(jax.devices()) >= 1", timeout_s)
+    if probe is None:
         return False, f"backend init exceeded {timeout_s}s"
+    if probe.returncode == 0:
+        return True, ""
+    lines = (probe.stderr or "").strip().splitlines()
+    return False, lines[-1] if lines else "probe failed"
+
+
+def tpu_reachable(timeout_s: int = 240) -> bool:
+    """True when the default backend resolves to a real TPU right now.
+
+    Same bounding as :func:`accelerator_healthy`, but the question is
+    stricter: pollers queueing chip work (scripts/chip_queue.py, scripts/
+    sweep_when_healthy.py) must not fire on a CPU fallback — a CPU number
+    is worse than waiting.
+    """
+    probe = _probe("sys.exit(0 if any(d.platform == 'tpu' "
+                   "for d in jax.devices()) else 1)", timeout_s)
+    return probe is not None and probe.returncode == 0
 
 
 def ensure_backend_or_cpu_fallback() -> bool:

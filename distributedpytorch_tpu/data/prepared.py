@@ -42,6 +42,7 @@ pickling (grain workers) rather than shipped.
 
 from __future__ import annotations
 
+import fcntl
 import hashlib
 import json
 import os
@@ -104,29 +105,52 @@ def cache_fingerprint(dataset, crop_size, relax: int, zero_pad: bool,
     return hashlib.sha256(ident.encode()).hexdigest()[:16]
 
 
+def _needs_init(meta_path: str, expect_meta: dict) -> bool:
+    """True when the cache layout must be (re)created: meta.json missing,
+    unreadable, or describing a different layout than ``expect_meta``."""
+    if not os.path.isfile(meta_path):
+        return True
+    try:
+        with open(meta_path) as f:
+            return json.load(f) != expect_meta
+    except (ValueError, OSError):
+        return True
+
+
 def _open_maps(cache_dir: str, expect_meta: dict, layout) -> dict:
     """Open (or create/reset) the cache's memmaps under ``cache_dir``.
 
     ``expect_meta`` mismatching the stored meta.json resets every file —
     and the valid map is (re)created LAST so a half-written images file
-    from a crashed builder is never trusted."""
+    from a crashed builder is never trusted.
+
+    Creation is serialized across processes with an exclusive ``flock``:
+    two racing openers (grain workers, concurrent runs) that both observe a
+    missing/stale meta.json would otherwise both recreate the files with
+    ``mode='w+'``, each truncating rows the other had already written —
+    including a window where one process's valid byte survives a zeroed
+    data file.  The second opener re-checks freshness *under the lock* and
+    finds the first's meta.json already landed.  ``flock`` (not O_EXCL) so
+    a crashed creator's lock is released by the kernel, never left stale.
+    """
     os.makedirs(cache_dir, exist_ok=True)
     meta_path = os.path.join(cache_dir, "meta.json")
-    fresh = True
-    if os.path.isfile(meta_path):
+    if _needs_init(meta_path, expect_meta):
+        lock_fd = os.open(os.path.join(cache_dir, ".init.lock"),
+                          os.O_CREAT | os.O_RDWR, 0o644)
         try:
-            with open(meta_path) as f:
-                fresh = json.load(f) != expect_meta
-        except (ValueError, OSError):
-            fresh = True
-    if fresh:
-        for name, shape, dtype in layout:
-            mm = np.memmap(os.path.join(cache_dir, name), mode="w+",
-                           dtype=dtype, shape=shape)
-            del mm  # creation (ftruncate to size) is all that's needed
-        with open(meta_path + ".tmp", "w") as f:
-            json.dump(expect_meta, f)
-        os.replace(meta_path + ".tmp", meta_path)
+            fcntl.flock(lock_fd, fcntl.LOCK_EX)
+            if _needs_init(meta_path, expect_meta):  # lost the race?
+                for name, shape, dtype in layout:
+                    mm = np.memmap(os.path.join(cache_dir, name), mode="w+",
+                                   dtype=dtype, shape=shape)
+                    del mm  # creation (ftruncate to size) is all needed
+                with open(meta_path + ".tmp", "w") as f:
+                    json.dump(expect_meta, f)
+                os.replace(meta_path + ".tmp", meta_path)
+        finally:
+            fcntl.flock(lock_fd, fcntl.LOCK_UN)
+            os.close(lock_fd)
     return {
         name: np.memmap(os.path.join(cache_dir, name), mode="r+",
                         dtype=dtype, shape=shape)
@@ -287,12 +311,20 @@ class PreparedInstanceDataset(_PreparedCacheBase):
             bits = np.asarray(self._maps["masks.u8"][index])
             bbox = np.asarray(self._maps["bboxes.i64"][index]).copy()
             im_size = tuple(int(v) for v in self._maps["sizes.i32"][index])
-            if not (img8.any() and bits.any()):
+            if not (img8.any() and bits.any()
+                    and bbox.any()
+                    and bbox[2] >= bbox[0] and bbox[3] >= bbox[1]
+                    and im_size[0] > 0 and im_size[1] > 0):
                 # Torn write from a crashed filler: the valid byte landed
-                # but a row is still zeros — and pages persist in arbitrary
-                # order, so EITHER row can be the torn one.  A real sample
-                # always has object pixels (area filter) and a non-black
-                # crop; refill (idempotent).
+                # but a row is still zeros — and each array lives in its own
+                # file whose dirty pages persist independently, so ANY row
+                # (image, mask, bbox, size) can be the torn one.  A real
+                # sample always has object pixels (area filter), a non-black
+                # crop, a non-degenerate bbox, and a positive source size;
+                # refill (idempotent).  bbox coords are INCLUSIVE
+                # (helpers.get_bbox): a thin object at relax=0 legitimately
+                # has x_max == x_min, so extent is checked with >= and the
+                # all-zeros torn row is caught by .any().
                 img8, bits, bbox, im_size = self._fill(index)
         else:
             img8, bits, bbox, im_size = self._fill(index)
@@ -414,12 +446,14 @@ class PreparedSemanticDataset(_PreparedCacheBase):
             img8 = np.asarray(self._maps["images.u8"][index])
             gt8 = np.asarray(self._maps["gts.u8"][index])
             im_size = tuple(int(v) for v in self._maps["sizes.i32"][index])
-            if not (img8.any() and gt8.any()):
+            if not (img8.any() and gt8.any()
+                    and im_size[0] > 0 and im_size[1] > 0):
                 # torn write from a crashed filler: pages persist in
-                # arbitrary order, so EITHER row can be zeros while valid=1
-                # — a real photo is never all-black and a VOC segmentation
-                # mask never all-background (objects + 255 void boundary);
-                # refill (idempotent) rather than serve silent wrong labels
+                # arbitrary order per file, so ANY row (image, gt, size) can
+                # be zeros while valid=1 — a real photo is never all-black,
+                # a VOC segmentation mask never all-background (objects +
+                # 255 void boundary), and a source size is positive; refill
+                # (idempotent) rather than serve silent wrong labels
                 img8, gt8, im_size = self._fill(index)
         else:
             img8, gt8, im_size = self._fill(index)

@@ -713,22 +713,43 @@ class Trainer:
                     # together (loss is replicated, so they all see the
                     # same value) — a main-only raise would leave the other
                     # processes blocked forever at their next collective.
-                    loss_now = float(np.atleast_1d(
-                        jax.device_get(loss))[-1])
-                    if cfg.debug_asserts and not np.isfinite(loss_now):
+                    loss_vec = np.atleast_1d(jax.device_get(loss))
+                    if cfg.debug_asserts and \
+                            not np.all(np.isfinite(loss_vec)):
                         # bf16 watchdog: surface divergence at the log
                         # cadence instead of training garbage for the rest
-                        # of the epoch (see also the epoch-end sweep below)
+                        # of the epoch (see also the epoch-end sweep below).
+                        # The whole (K,) dispatch vector is checked, not
+                        # just one element — a mid-dispatch blowup must not
+                        # slip past the cadence check.
+                        off = int(np.flatnonzero(
+                            ~np.isfinite(loss_vec))[0])
                         raise FloatingPointError(
-                            f"non-finite train loss {loss_now} at step "
-                            f"{step} (epoch {epoch}) — divergence; lower "
-                            "optim.lr, enable optim.grad_clip_norm, or set "
+                            f"non-finite train loss {loss_vec[off]} at "
+                            f"step {step - n_steps + 1 + off} (epoch "
+                            f"{epoch}) — divergence; lower optim.lr, "
+                            "enable optim.grad_clip_norm, or set "
                             "optim.loss_scale for bf16 underflow")
                     if self.is_main:
-                        self.writer.scalars(
-                            {"train/loss": loss_now,
-                             "train/lr": float(self.schedule(step)),
-                             "train/epoch": epoch}, step)
+                        # Attribute each logged loss to the step that
+                        # crossed a cadence boundary, indexing that step's
+                        # own element of the (K,) dispatch vector —
+                        # loss_vec[-1] at `step` would skew the train/loss
+                        # curve by up to K-1 steps.  A single dispatch can
+                        # cross SEVERAL boundaries (K > log_every_steps):
+                        # every multiple of the cadence inside
+                        # (step - n_steps, step] gets its own point.  For
+                        # K=1 this is exactly one (loss_vec[0], step).
+                        L = cfg.log_every_steps
+                        bstep = ((step - n_steps) // L + 1) * L
+                        while bstep <= step:
+                            loss_now = float(
+                                loss_vec[bstep - (step - n_steps) - 1])
+                            self.writer.scalars(
+                                {"train/loss": loss_now,
+                                 "train/lr": float(self.schedule(bstep)),
+                                 "train/epoch": epoch}, bstep)
+                            bstep += L
         # One bulk readback, not one float() per step: each scalar fetch is a
         # full host<->device round trip (~70ms through a tunneled chip — per-
         # step syncs would dwarf the epoch itself).  Entries are scalars

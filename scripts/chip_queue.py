@@ -1,0 +1,146 @@
+"""Health-gated chip work queue for the axon-tunneled TPU.
+
+The tunnel wedges for hours at a time (BASELINE.md "tunnel hygiene"); chip
+experiments therefore queue here instead of blocking a session.  Drop
+numbered ``*.sh`` files into ``--queue-dir``; the runner polls backend
+health with a hard-timeout subprocess probe (a wedged backend init cannot
+take the poller down), and when the tunnel answers it executes queued files
+in sorted order, one at a time, on an otherwise-idle host.  Completed files
+are renamed ``<name>.done`` (or ``.fail``); per-step output is appended to
+``<name>.log`` next to the queue file.  New files may be enqueued while the
+runner is alive — it keeps draining until ``--max-hours`` elapses.
+
+A ``RUNNING`` flag file is held in the queue dir while a step executes so a
+concurrent session can avoid launching host-heavy work that would
+cross-contaminate the measurement (numbers collapse ~2-3x when pytest runs
+alongside a bench — BASELINE.md).
+
+Generalizes the round-2 one-shot ``sweep_when_healthy.py`` pattern.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from distributedpytorch_tpu.backend_health import tpu_reachable  # noqa: E402
+
+
+def _natural_key(name: str):
+    """Numeric-aware sort: 2_x.sh before 10_x.sh (plain sorted() would run
+    10 first and break producer→consumer step ordering)."""
+    return [int(p) if p.isdigit() else p
+            for p in re.split(r"(\d+)", name)]
+
+
+def pending(queue_dir: str, settle_seconds: float = 5.0) -> list[str]:
+    """Queued step files in natural-numeric order.
+
+    Files modified within the last ``settle_seconds`` are held back: a file
+    still being written (cat >, scp) would otherwise execute as a truncated
+    prefix — bash runs a half-written script cleanly up to the cut and the
+    runner would mark it .done.  Writers that rename into place are picked
+    up immediately on the next poll anyway.
+    """
+    now = time.time()
+    names = []
+    for f in os.listdir(queue_dir):
+        if not f.endswith(".sh"):
+            continue
+        try:
+            if now - os.path.getmtime(os.path.join(queue_dir, f)) \
+                    < settle_seconds:
+                continue
+        except OSError:
+            continue
+        names.append(f)
+    return sorted(names, key=_natural_key)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--queue-dir", required=True)
+    ap.add_argument("--poll-seconds", type=int, default=300)
+    ap.add_argument("--probe-timeout", type=int, default=240)
+    ap.add_argument("--step-timeout", type=int, default=7200)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    args = ap.parse_args()
+
+    os.makedirs(args.queue_dir, exist_ok=True)
+    running_flag = os.path.join(args.queue_dir, "RUNNING")
+    deadline = time.time() + args.max_hours * 3600
+
+    # SIGTERM must unwind like an exception, not die in place: the default
+    # handler would skip the finally blocks below, stranding the RUNNING
+    # flag and the detached step process group — a restarted runner would
+    # then launch the same step alongside the orphan.
+    def _term(signum, frame):
+        raise KeyboardInterrupt(f"signal {signum}")
+
+    signal.signal(signal.SIGTERM, _term)
+
+    while time.time() < deadline:
+        steps = pending(args.queue_dir)
+        if not steps:
+            time.sleep(args.poll_seconds)
+            continue
+        if not tpu_reachable(args.probe_timeout):
+            print("[chip_queue] tunnel unhealthy; %d step(s) waiting"
+                  % len(steps), flush=True)
+            time.sleep(args.poll_seconds)
+            continue
+        step = os.path.join(args.queue_dir, steps[0])
+        log = step + ".log"
+        print("[chip_queue] running %s" % step, flush=True)
+        open(running_flag, "w").close()
+        try:
+            with open(log, "a") as lf:
+                # Own process group (start_new_session): a step timeout must
+                # kill the step's WHOLE tree, not just the bash wrapper — an
+                # orphaned benchmark child would keep loading the chip/host
+                # while the next step runs, the exact cross-contamination
+                # the RUNNING flag exists to prevent.
+                proc = subprocess.Popen(["bash", step], stdout=lf,
+                                        stderr=subprocess.STDOUT, cwd=REPO,
+                                        start_new_session=True)
+                try:
+                    ok = proc.wait(timeout=args.step_timeout) == 0
+                except subprocess.TimeoutExpired:
+                    lf.write("\n[chip_queue] step timeout; killing group\n")
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+                    proc.wait()
+                    ok = False
+                except BaseException:
+                    # runner interrupted (SIGTERM/Ctrl-C) mid-step: take
+                    # the detached step group down with us — an orphan
+                    # would contaminate whatever runs next on this host.
+                    # The step file stays *.sh so a restart re-runs it.
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+                    raise
+        finally:
+            if os.path.exists(running_flag):
+                os.remove(running_flag)
+        os.rename(step, step + (".done" if ok else ".fail"))
+        print("[chip_queue] %s -> %s" % (step, "done" if ok else "FAIL"),
+              flush=True)
+    print("[chip_queue] window elapsed; %d step(s) left"
+          % len(pending(args.queue_dir)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,15 +18,9 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-PROBE = """
-import sys
-sys.path.insert(0, %r)
-from distributedpytorch_tpu.backend_health import ensure_backend_or_cpu_fallback
-ensure_backend_or_cpu_fallback()
-import jax
-print("TPU" if any(d.platform == "tpu" for d in jax.devices()) else "CPU")
-""" % REPO
+from distributedpytorch_tpu.backend_health import tpu_reachable  # noqa: E402
 
 # Reuse perf_sweep.run() — one benchmark definition (per-chip normalized,
 # device-count-scaled batch); importing perf_sweep also runs its bounded
@@ -61,14 +55,7 @@ def main() -> int:
 
     deadline = time.time() + args.max_hours * 3600
     while time.time() < deadline:
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c", PROBE], capture_output=True,
-                text=True, timeout=180)
-            healthy = probe.stdout.strip().endswith("TPU")
-        except subprocess.TimeoutExpired:
-            healthy = False
-        if healthy:
+        if tpu_reachable(timeout_s=180):
             break
         time.sleep(args.poll_seconds)
     else:

@@ -136,6 +136,56 @@ class TestCacheCore:
         torn_mask = ds[0]
         np.testing.assert_array_equal(torn_mask["crop_gt"], good["crop_gt"])
 
+    def test_torn_small_field_rows_refill_on_read(self, base, tmp_path):
+        """bboxes.i64 and sizes.i32 live in their own files whose dirty
+        pages persist independently of images/masks — a zeroed small-field
+        row under valid=1 must also trigger the refill, or eval-style
+        paste-back consumers would get a (0,0,0,0) box."""
+        ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                     crop_size=(64, 64), relax=10)
+        good = ds[0]
+        ds._maps["bboxes.i64"][0] = 0
+        np.testing.assert_array_equal(ds[0]["bbox"], good["bbox"])
+        ds._maps["sizes.i32"][0] = 0
+        assert ds[0]["meta"]["im_size"] == good["meta"]["im_size"]
+
+    def test_fresh_creation_serializes_on_init_lock(self, base, tmp_path):
+        """Two racing openers of the same fresh cache must not both create
+        the memmaps with mode='w+' (each truncation zeroes rows the other
+        already wrote).  Creation takes an exclusive flock on .init.lock:
+        with the lock held elsewhere, a constructor blocks until release."""
+        import multiprocessing as mp
+        d = str(tmp_path / "prep")
+        fp = cache_fingerprint(base, (64, 64), 10, True, False)
+        cache_dir = os.path.join(d, fp)
+        os.makedirs(cache_dir)
+        import fcntl
+        fd = os.open(os.path.join(cache_dir, ".init.lock"),
+                     os.O_CREAT | os.O_RDWR, 0o644)
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        ctx = mp.get_context("fork")
+        done = ctx.Event()
+
+        def construct():
+            PreparedInstanceDataset(make_base(str(base.root)), d,
+                                    crop_size=(64, 64), relax=10)
+            done.set()
+
+        p = ctx.Process(target=construct)
+        p.start()
+        try:
+            assert not done.wait(1.5)   # blocked on the held lock
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+        assert done.wait(30)            # released -> creation completes
+        p.join(30)
+        assert p.exitcode == 0
+        # the created cache is sound for this process too
+        ds = PreparedInstanceDataset(base, d, crop_size=(64, 64), relax=10)
+        ds[0]
+        assert ds.n_prepared >= 1
+
     def test_pickle_roundtrip_reopens_maps(self, base, tmp_path):
         import pickle
         ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
@@ -370,6 +420,55 @@ class TestTrainerIntegration:
         assert all(np.isfinite(l) for l in history["train_loss"])
         assert int(tr.state.step) == 2 * n_batches
         tr.close()
+
+    @staticmethod
+    def _logged_loss_steps(tmp_path, k: int, log_every: int):
+        """Run one tiny epoch at steps_per_dispatch=k and return
+        (n_train_steps, the train/loss JSONL events)."""
+        import json
+        from tests.test_train import make_tiny_cfg
+        from distributedpytorch_tpu.data import make_fake_voc
+        from distributedpytorch_tpu.train import Trainer
+        root = make_fake_voc(str(tmp_path / "voc"), n_images=40,
+                             size=(96, 128), n_val=3, seed=4)
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, epochs=1, log_every_steps=log_every,
+            data=dataclasses.replace(
+                cfg.data, fake=False, root=root, train_batch=8,
+                steps_per_dispatch=k,
+                prepared_cache=str(tmp_path / "prep"),
+                uint8_transfer=True, device_guidance=True))
+        tr = Trainer(cfg)
+        n_steps = len(tr.train_loader)
+        tr.fit()
+        run_dir = tr.run_dir
+        tr.close()
+        with open(os.path.join(run_dir, "metrics.jsonl")) as f:
+            logged = [json.loads(l) for l in f if "train/loss" in l]
+        assert logged, "no train/loss events logged"
+        assert all(np.isfinite(r["train/loss"]) for r in logged)
+        return n_steps, logged
+
+    def test_steps_per_dispatch_logs_at_boundary_steps(self, tmp_path):
+        """The train/loss curve must be attributed to the step that crossed
+        the log cadence, indexing that step's element of the (K,) dispatch
+        loss vector — not the dispatch's LAST loss at the dispatch-end step
+        (which skews the curve by up to K-1 steps)."""
+        n_steps, logged = self._logged_loss_steps(tmp_path, k=2, log_every=3)
+        assert n_steps >= 6  # several K=2 dispatches cross a boundary
+        # with K=2, L=3: dispatch (2,4] logs at 3, (4,6] at 6, ... — every
+        # logged step is a cadence boundary, one per crossed boundary
+        assert [r["step"] for r in logged] == \
+            [3 * i for i in range(1, n_steps // 3 + 1)]
+
+    def test_dispatch_crossing_multiple_boundaries_logs_each(self, tmp_path):
+        """K > log_every_steps: one dispatch crosses several cadence
+        boundaries and every one must get its own train/loss point, not
+        just the first."""
+        n_steps, logged = self._logged_loss_steps(tmp_path, k=4, log_every=1)
+        # L=1: every step is a boundary — one point per step, in order
+        assert [r["step"] for r in logged] == list(range(1, n_steps + 1))
 
     def test_steps_per_dispatch_excludes_echo(self, tmp_path):
         from tests.test_train import make_tiny_cfg
