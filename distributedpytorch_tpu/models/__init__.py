@@ -38,6 +38,8 @@ def build_model(
     """
     if isinstance(dtype, str):
         dtype = jnp.dtype(dtype)
+    if isinstance(kw.get("pam_score_dtype"), str):
+        kw["pam_score_dtype"] = jnp.dtype(kw["pam_score_dtype"])
     depth = _BACKBONE_DEPTH[backbone]
     if name != "danet":
         # PAM/MoE options are DANet-only.  One config schema drives every
@@ -46,6 +48,7 @@ def build_model(
         # something to train past.
         danet_only = {"pam_block_size": None, "pam_impl": "einsum",
                       "pam_sp_mesh": None, "pam_sp_axis": "model",
+                      "pam_score_dtype": None,
                       "moe_experts": 0, "moe_hidden": None, "moe_k": 1,
                       "moe_capacity_factor": 1.25}
         for k, default in danet_only.items():

@@ -64,6 +64,11 @@ class PositionAttentionModule(nn.Module):
     impl: str = "einsum"           # auto | einsum | flash | ring
     sp_mesh: Any = None            # ring: mesh to shard the token axis over
     sp_axis: str = "model"         # ring: mesh axis carrying the tokens
+    score_dtype: Any = None        # einsum: dtype the N x N scores are
+                                   # materialized in (bf16 halves the HBM
+                                   # round trip; softmax math stays f32).
+                                   # flash/ring/blocked never materialize
+                                   # the N x N matrix — no-op there.
 
     @nn.compact
     def __call__(self, x):
@@ -113,7 +118,8 @@ class PositionAttentionModule(nn.Module):
             out = ring(q, k, v)
         elif impl == "einsum":
             if self.block_size is None:
-                out = position_attention(q, k, v)
+                out = position_attention(q, k, v,
+                                         score_dtype=self.score_dtype)
             else:
                 out = blocked_position_attention(q, k, v, self.block_size)
         else:
@@ -153,6 +159,7 @@ class DANetHead(nn.Module):
     pam_impl: str = "einsum"
     pam_sp_mesh: Any = None
     pam_sp_axis: str = "model"
+    pam_score_dtype: Any = None
     dropout_rate: float = 0.1
     moe_experts: int = 0        # >0: MoE FFN on the fused features
     moe_hidden: int | None = None
@@ -179,6 +186,7 @@ class DANetHead(nn.Module):
             channels=inter, norm=self.norm, dtype=self.dtype,
             block_size=self.pam_block_size, impl=self.pam_impl,
             sp_mesh=self.pam_sp_mesh, sp_axis=self.pam_sp_axis,
+            score_dtype=self.pam_score_dtype,
             name="pam")(pa)
         pa = conv_bn_relu(pa, "pam_out")
 
@@ -230,6 +238,7 @@ class DANet(nn.Module):
     pam_impl: str = "einsum"  # einsum | flash | ring (sequence-parallel)
     pam_sp_mesh: Any = None   # ring: mesh whose axis shards the tokens
     pam_sp_axis: str = "model"
+    pam_score_dtype: Any = None  # einsum: N x N score materialization dtype
     remat: bool = False
     moe_experts: int = 0      # >0: MoE FFN in the head (see DANetHead)
     moe_hidden: int | None = None
@@ -256,6 +265,7 @@ class DANet(nn.Module):
             pam_impl=self.pam_impl,
             pam_sp_mesh=self.pam_sp_mesh,
             pam_sp_axis=self.pam_sp_axis,
+            pam_score_dtype=self.pam_score_dtype,
             moe_experts=self.moe_experts,
             moe_hidden=self.moe_hidden,
             moe_k=self.moe_k,

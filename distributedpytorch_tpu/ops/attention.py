@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 
 
-def position_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+def position_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                       score_dtype: jnp.dtype | None = None) -> jax.Array:
     """Full position (spatial self-) attention.
 
     ``q``/``k``: (B, N, Ck), ``v``: (B, N, Cv) -> (B, N, Cv).
@@ -35,9 +36,24 @@ def position_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     dot-product scores over all token pairs, softmax over keys, no scaling
     term — DANet uses unscaled energies with a learned residual gate (the
     gate lives in the calling flax module).
+
+    ``score_dtype`` controls the dtype the N x N score matrix is
+    *materialized* in between the einsum and the softmax — the single
+    largest HBM tenant of the whole step at big crops (4096 tokens: 64 MB
+    in f32, written once and re-read by the softmax's reduce+exp passes).
+    ``bfloat16`` halves that traffic.  Numerics stay conservative either
+    way: the einsum always *accumulates* in f32 (rounded only on store)
+    and the softmax arithmetic (max, exp, sum, div) always runs in f32 —
+    XLA fuses the up/downcasts into the neighboring kernels, so the only
+    cost is one bf16 rounding of the raw scores and none of the reductions
+    lose precision.  The attention-weight matrix itself already
+    materializes in ``v.dtype`` (bf16 under mixed precision) regardless.
+    ``None`` keeps the f32 materialization.
     """
     scores = jnp.einsum("bnc,bmc->bnm", q, k, preferred_element_type=jnp.float32)
-    attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    if score_dtype is not None:
+        scores = scores.astype(score_dtype)
+    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(v.dtype)
     return jnp.einsum("bnm,bmc->bnc", attn, v)
 
 

@@ -130,6 +130,15 @@ class ModelConfig:
                                         # einsum cannot run at all
                                         # (ring = sequence-parallel PAM over
                                         # the mesh's model axis)
+    pam_score_dtype: str | None = None  # einsum PAM only: dtype the N x N
+                                        # score matrix materializes in.
+                                        # 'bfloat16' halves the dominant
+                                        # non-MXU HBM round trip of the
+                                        # flagship step (BASELINE.md
+                                        # roofline); softmax arithmetic and
+                                        # einsum accumulation stay f32.
+                                        # None = f32 (exact reference-like
+                                        # scores)
     remat: bool = False                 # rematerialize backbone blocks
     moe_experts: int = 0                # >0: MoE FFN in the DANet head
     moe_hidden: int | None = None       # expert MLP width (default: channels)

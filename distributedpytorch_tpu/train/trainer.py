@@ -361,6 +361,7 @@ class Trainer:
             backbone=cfg.model.backbone, output_stride=cfg.model.output_stride,
             dtype=cfg.model.dtype, pam_block_size=cfg.model.pam_block_size,
             pam_impl=cfg.model.pam_impl,
+            pam_score_dtype=cfg.model.pam_score_dtype,
             # ring PAM shards the spatial tokens over this mesh's model axis
             pam_sp_mesh=(self.mesh if cfg.model.pam_impl == "ring" else None),
             remat=cfg.model.remat,

@@ -34,6 +34,29 @@ sys.path.insert(0, REPO)
 from distributedpytorch_tpu.backend_health import tpu_reachable  # noqa: E402
 
 
+def host_busy() -> str | None:
+    """Name a host-loading process (pytest, another bench/sweep) if one is
+    running — measurements taken alongside one collapse 2-3x on this
+    1-core host (BASELINE.md), so the queue waits for an idle host."""
+    try:
+        out = subprocess.run(["ps", "-eo", "args"], capture_output=True,
+                             text=True, timeout=10).stdout
+    except Exception:
+        return None
+    # Anchor on the interpreter invocation itself — a bare substring scan
+    # would match unrelated processes whose argv merely *mentions* these
+    # names (observed: a session wrapper whose prompt text contains them).
+    pat = re.compile(
+        r"^\S*pytest\b"
+        r"|^\S*python[\d.]*(\s+-\S+)*\s+"
+        r"\S*(pytest|bench\.py|bench_e2e|bench_input|pam_crossover"
+        r"|perf_sweep|profile_step|convergence_runs)")
+    for line in out.splitlines():
+        if pat.match(line.strip()):
+            return line.strip()[:120]
+    return None
+
+
 def _natural_key(name: str):
     """Numeric-aware sort: 2_x.sh before 10_x.sh (plain sorted() would run
     10 first and break producer→consumer step ordering)."""
@@ -90,6 +113,11 @@ def main() -> int:
     while time.time() < deadline:
         steps = pending(args.queue_dir)
         if not steps:
+            time.sleep(args.poll_seconds)
+            continue
+        busy = host_busy()
+        if busy is not None:
+            print("[chip_queue] host busy (%s); waiting" % busy, flush=True)
             time.sleep(args.poll_seconds)
             continue
         if not tpu_reachable(args.probe_timeout):

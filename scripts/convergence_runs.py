@@ -8,10 +8,13 @@ Three real-chip runs on a 200-image fake-VOC at real image sizes:
   b. guidance ablation: identical but ``data.guidance=none`` (3-channel
      input) — if this matches (a), the guided result proves nothing;
   c. semantic: DeepLabV3-R101 os=16 513², 21-class mIoU on the same images'
-     class masks.
+     class masks;
+  d. bf16 PAM scores: identical to (a) but ``model.pam_score_dtype=
+     bfloat16`` — the roofline lever's accuracy side (its speed side is
+     perf_sweep variants 11-12); compare curve (d) against curve (a).
 
 Prints one JSON line per run with the per-epoch val metric curve.
-Usage: python scripts/convergence_runs.py [a b c] [--epochs N]
+Usage: python scripts/convergence_runs.py [a b c d] [--epochs N]
 """
 
 from __future__ import annotations
@@ -98,7 +101,8 @@ def run(name: str, fixture: str, overrides: dict) -> dict:
 
 
 if __name__ == "__main__":
-    sel = [a for a in sys.argv[1:] if a in ("a", "b", "c")] or ["a", "b", "c"]
+    sel = [a for a in sys.argv[1:] if a in ("a", "b", "c", "d")] \
+        or ["a", "b", "c", "d"]
     fixture = tempfile.mkdtemp(prefix="conv_voc_")
     make_fake_voc(fixture, n_images=N_IMAGES, size=IMG_SIZE, max_objects=2,
                   n_val=N_VAL, seed=7)
@@ -113,6 +117,8 @@ if __name__ == "__main__":
             "data.val_batch": 8,  # semantic val batches cleanly
             **({} if CPU_SMOKE else {"data.crop_size": [513, 513]}),
         },
+        "d_bf16_scores": {"data.device_guidance": True,
+                          "model.pam_score_dtype": "bfloat16"},
     }
     for name, ov in runs.items():
         if name[0] not in sel:

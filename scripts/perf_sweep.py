@@ -54,12 +54,14 @@ SIZE = 512
 
 
 def run(batch: int, pam_impl: str, block: int | None, remat: bool,
-        os_: int = 8, device_guidance: bool = False) -> float:
+        os_: int = 8, device_guidance: bool = False,
+        score_dtype: str | None = None) -> float:
     mesh = make_mesh()
     n = mesh.devices.size
     model = build_model("danet", nclass=1, backbone="resnet101",
                         output_stride=os_, dtype="bfloat16",
-                        pam_impl=pam_impl, pam_block_size=block, remat=remat)
+                        pam_impl=pam_impl, pam_block_size=block, remat=remat,
+                        pam_score_dtype=score_dtype)
     tx = optax.sgd(1e-3, momentum=0.9)
     r = np.random.RandomState(0)
     in_ch = 3 if device_guidance else 4
@@ -116,6 +118,13 @@ if __name__ == "__main__":
         # rate; the host-side win is measured by scripts/bench_input.py)
         dict(batch=8, pam_impl="einsum", block=None, remat=False,
              device_guidance=True),
+        # the roofline lever (BASELINE.md): bf16 score materialization
+        # halves the PAM's N^2 HBM round trip, softmax math stays f32 —
+        # variants 11/12 A/B this against rows 0/1
+        dict(batch=8, pam_impl="einsum", block=None, remat=False,
+             score_dtype="bfloat16"),
+        dict(batch=16, pam_impl="einsum", block=None, remat=False,
+             score_dtype="bfloat16"),
     ]
     sel = sys.argv[1:]
     for i, v in enumerate(variants):
@@ -126,6 +135,7 @@ if __name__ == "__main__":
         rec = {k: val for k, val in v.items() if k != "os_"}
         rec["os"] = v.get("os_", 8)
         rec["device_guidance"] = v.get("device_guidance", False)
+        rec["score_dtype"] = v.get("score_dtype")
         try:
             ips = run(**v)
             print(json.dumps({**rec, "imgs_per_sec_per_chip": round(ips, 2)}),

@@ -91,6 +91,53 @@ class TestDANet:
             np.testing.assert_allclose(np.asarray(oa), np.asarray(oc),
                                        rtol=1e-4, atol=1e-4)
 
+    def test_bf16_score_dtype_close_to_f32(self):
+        """pam_score_dtype=bfloat16 changes only the N x N score
+        materialization (softmax math stays f32): close to the f32 path
+        but not identical to it, gradients finite.
+
+        Checked at the op level AND through the model with the PAM's
+        residual gate forced nonzero — at init gamma is zero, which would
+        annihilate the attention output and make any model-level
+        comparison pass vacuously."""
+        from distributedpytorch_tpu.ops.attention import position_attention
+        r = np.random.default_rng(3)
+        q, k = (jnp.asarray(r.normal(size=(2, 64, 8)), jnp.float32)
+                for _ in range(2))
+        v = jnp.asarray(r.normal(size=(2, 64, 16)), jnp.float32)
+        exact = np.asarray(position_attention(q, k, v))
+        half = np.asarray(position_attention(q, k, v,
+                                             score_dtype=jnp.bfloat16))
+        assert not np.array_equal(exact, half), \
+            "bf16 path bitwise-identical to f32 — the cast isn't happening"
+        np.testing.assert_allclose(exact, half, rtol=0, atol=3e-2)
+
+        x = jnp.asarray(r.normal(size=(1, 32, 32, 4)), jnp.float32)
+        m_f32 = DANet(nclass=1, backbone_depth=18, output_stride=8)
+        m_bf16 = DANet(nclass=1, backbone_depth=18, output_stride=8,
+                       pam_score_dtype=jnp.bfloat16)
+        variables = m_f32.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+            x, train=False)
+        variables = jax.tree_util.tree_map_with_path(
+            lambda p, l: (jnp.ones_like(l)
+                          if any(getattr(e, "key", None) == "gamma"
+                                 for e in p) else l), variables)
+        a = m_f32.apply(variables, x, train=False)
+        b = m_bf16.apply(variables, x, train=False)
+        for oa, ob in zip(a, b):
+            np.testing.assert_allclose(np.asarray(oa), np.asarray(ob),
+                                       rtol=0, atol=5e-2)
+
+        def loss(params):
+            outs = m_bf16.apply({**variables, "params": params}, x,
+                                train=False)
+            return sum(jnp.mean(o ** 2) for o in outs)
+
+        g = jax.grad(loss)(variables["params"])
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree_util.tree_leaves(g))
+
     def test_train_mode_mutates_batch_stats(self):
         m = DANet(nclass=1, backbone_depth=18)
         x = jnp.ones((1, 32, 32, 4))
@@ -200,6 +247,16 @@ class TestFactory:
     def test_build_danet(self):
         m = build_model("danet", nclass=1, backbone="resnet101")
         assert isinstance(m, DANet) and m.output_stride == 8
+
+    def test_build_danet_score_dtype_string(self):
+        m = build_model("danet", nclass=1, backbone="resnet18",
+                        pam_score_dtype="bfloat16")
+        assert m.pam_score_dtype == jnp.bfloat16
+
+    def test_score_dtype_is_danet_only(self):
+        with pytest.raises(ValueError, match="pam_score_dtype"):
+            build_model("deeplabv3", nclass=21, backbone="resnet50",
+                        pam_score_dtype="bfloat16")
 
     def test_build_deeplab_bf16(self):
         m = build_model("deeplabv3", nclass=21, backbone="resnet50",
