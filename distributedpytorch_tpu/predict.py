@@ -157,6 +157,7 @@ def model_from_config(cfg):
         pam_block_size=cfg.model.pam_block_size,
         pam_impl="einsum" if cfg.model.pam_impl == "ring"
         else cfg.model.pam_impl,
+        pam_score_dtype=getattr(cfg.model, "pam_score_dtype", None),
         remat=cfg.model.remat,
         moe_experts=cfg.model.moe_experts,
         moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,

@@ -1,0 +1,202 @@
+"""Per-stage budget of the end-to-end fast path (VERDICT item 6).
+
+``bench_e2e.py`` measures the overlapped pipeline as a user gets it; this
+script measures each stage of the SAME config in isolation, so the gap
+between the e2e number and its theoretical ceiling can be attributed:
+
+  host  — the Trainer's own train loader (prepared cache prebuilt in a
+          warmup epoch): mmap read + per-epoch random stage + collate.
+          CPU-safe, no accelerator touched (the model is swapped for a
+          tiny one — it never runs).
+  place — ``shard_batch`` on one real host batch, looped: the placement
+          thread's per-batch capacity (layout/copy + H2D DMA).  TPU.
+  step  — the compiled train step on one pre-placed batch, looped:
+          ``bench.py``'s chip rate re-measured inside this exact config.
+          TPU.
+
+Under perfect overlap e2e == min(host, place, step); the printed
+``ideal_overlap_imgs_per_sec`` vs the measured bench_e2e row is the
+overlap slack worth engineering at, and the slowest stage is the lever.
+
+Usage:
+  python scripts/bench_breakdown.py host            # CPU-safe stage
+  python scripts/bench_breakdown.py place step      # chip stages
+  python scripts/bench_breakdown.py host place step [k=v overrides...]
+Default config = bench_e2e variant 8 (prepared + device guidance + uint8
+wire), the measured-48.7 row.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
+
+from distributedpytorch_tpu.backend_health import (  # noqa: E402
+    ensure_backend_or_cpu_fallback,
+    pin_requested_platform,
+)
+
+STAGES = [a for a in sys.argv[1:] if a in ("host", "place", "step")]
+OVERRIDES = [a for a in sys.argv[1:] if "=" in a]
+CPU_SMOKE = "--cpu-smoke" in sys.argv
+if not STAGES:
+    STAGES = ["host", "place", "step"]
+
+NEEDS_TPU = bool({"place", "step"} & set(STAGES)) and not CPU_SMOKE
+if not NEEDS_TPU:
+    # Host-only run must never block on a wedged tunnel.  FORCE the
+    # override — the site-installed accelerator plugin sets JAX_PLATFORMS
+    # at interpreter startup, so setdefault would keep the tunneled
+    # platform and the Trainer's first jax.process_index() would hang on
+    # backend init.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+else:
+    ensure_backend_or_cpu_fallback()
+
+import jax  # noqa: E402
+
+pin_requested_platform()
+
+from distributedpytorch_tpu.backend_health import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+if NEEDS_TPU and not any(d.platform == "tpu" for d in jax.devices()):
+    print(json.dumps({"error": "place/step stages are TPU-only; "
+                      "run `bench_breakdown.py host` for the CPU stage"}))
+    sys.exit(1)
+
+import numpy as np  # noqa: E402
+
+from distributedpytorch_tpu.data.fake import make_fake_voc  # noqa: E402
+from distributedpytorch_tpu.parallel import shard_batch  # noqa: E402
+from distributedpytorch_tpu.train import Config, Trainer, apply_overrides  # noqa: E402
+from distributedpytorch_tpu.utils.profiling import throughput  # noqa: E402
+
+N_IMAGES = 8 if CPU_SMOKE else 120
+IMG_SIZE = (96, 128) if CPU_SMOKE else (375, 500)
+BATCH = 8  # divides the smoke run's 8-device CPU mesh too
+DEVICE_KEYS = ("concat", "crop_gt", "crop_void")
+
+
+def make_trainer(fixture: str, work: str, tiny_model: bool) -> Trainer:
+    cfg = apply_overrides(Config(), [
+        f"data.root={fixture}",
+        f"data.train_batch={BATCH}",
+        "data.area_thres=0",
+        # bench_e2e variant 8 — the measured-48.7 fast path
+        f"data.prepared_cache={os.path.join(fixture, 'prepared')}",
+        "data.device_guidance=true",
+        "data.uint8_transfer=true",
+        "model.dtype=" + ("float32" if tiny_model else "bfloat16"),
+        "optim.lr=1e-4",
+        "epochs=1", "log_writers=[]",
+        *OVERRIDES,
+        *(["model.backbone=resnet18", "model.output_stride=8",
+           "data.crop_size=[64,64]", "model.dtype=float32"]
+          if (tiny_model and CPU_SMOKE) else
+          ["model.backbone=resnet18", "model.output_stride=8"]
+          if tiny_model else []),
+    ])
+    import dataclasses
+    return Trainer(dataclasses.replace(cfg, work_dir=work))
+
+
+def one_host_batch(tr: Trainer) -> dict:
+    tr.train_loader.set_epoch(0)
+    batch = next(iter(tr.train_loader))
+    return {k: v for k, v in batch.items() if k in DEVICE_KEYS}
+
+
+def stage_host(fixture: str, work: str) -> dict:
+    tr = make_trainer(fixture, work, tiny_model=True)
+    loader = tr.train_loader
+    n_batches = len(loader)
+    loader.set_epoch(0)            # warmup epoch fills the prepared cache
+    for _ in loader:
+        pass
+    t0 = time.perf_counter()
+    epochs = 2
+    for ep in range(1, 1 + epochs):
+        loader.set_epoch(ep)
+        for _ in loader:
+            pass
+    dt = time.perf_counter() - t0
+    tr.close()
+    bs = tr.cfg.data.train_batch
+    return {"host_imgs_per_sec": round(epochs * n_batches * bs / dt, 2),
+            "host_ms_per_batch": round(dt / (epochs * n_batches) * 1e3, 1)}
+
+
+def stage_place(tr: Trainer, batch: dict) -> dict:
+    mesh = tr.mesh
+    nbytes = sum(np.asarray(v).nbytes for v in batch.values())
+    with mesh:
+        shard_batch(mesh, batch)   # warm layouts
+        reps = 5 if CPU_SMOKE else 30
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            placed = shard_batch(mesh, batch)
+            jax.block_until_ready(placed)
+        dt = time.perf_counter() - t0
+    bs = next(iter(batch.values())).shape[0]
+    return {"place_imgs_per_sec": round(reps * bs / dt, 2),
+            "place_ms_per_batch": round(dt / reps * 1e3, 1),
+            "batch_mb": round(nbytes / 2**20, 1)}
+
+
+def stage_step(tr: Trainer, batch: dict) -> dict:
+    mesh = tr.mesh
+    with mesh:
+        placed = shard_batch(mesh, batch)
+        box = [tr.state]
+
+        def one():
+            box[0], loss = tr.train_step(box[0], placed)
+            return loss
+
+        bs = next(iter(batch.values())).shape[0]
+        stats = throughput(one, steps=5 if CPU_SMOKE else 20,
+                           warmup=2, items_per_step=bs)
+    return {"step_imgs_per_sec": round(stats["items_per_sec"], 2),
+            "step_ms_per_batch": round(bs / stats["items_per_sec"] * 1e3, 1)}
+
+
+def main() -> int:
+    fixture = tempfile.mkdtemp(prefix="bench_breakdown_voc_")
+    work = tempfile.mkdtemp(prefix="bench_breakdown_")
+    try:
+        make_fake_voc(fixture, n_images=N_IMAGES, size=IMG_SIZE,
+                      max_objects=2, n_val=2, seed=0)
+        rec: dict = {"variant": "e2e-fast-path(prepared+devguid+uint8)",
+                     "overrides": OVERRIDES, "batch": BATCH}
+        if "host" in STAGES:
+            rec.update(stage_host(fixture, work))
+        if {"place", "step"} & set(STAGES):
+            tr = make_trainer(fixture, work, tiny_model=CPU_SMOKE)
+            batch = one_host_batch(tr)
+            if "place" in STAGES:
+                rec.update(stage_place(tr, batch))
+            if "step" in STAGES:
+                rec.update(stage_step(tr, batch))
+            tr.close()
+        rates = [v for k, v in rec.items() if k.endswith("imgs_per_sec")]
+        if len(rates) > 1:
+            rec["ideal_overlap_imgs_per_sec"] = round(min(rates), 2)
+        print(json.dumps(rec), flush=True)
+        return 0
+    finally:
+        shutil.rmtree(fixture, ignore_errors=True)
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,16 +43,25 @@ def host_busy() -> str | None:
                              text=True, timeout=10).stdout
     except Exception:
         return None
-    # Anchor on the interpreter invocation itself — a bare substring scan
-    # would match unrelated processes whose argv merely *mentions* these
-    # names (observed: a session wrapper whose prompt text contains them).
-    pat = re.compile(
-        r"^\S*pytest\b"
-        r"|^\S*python[\d.]*(\s+-\S+)*\s+"
-        r"\S*(pytest|bench\.py|bench_e2e|bench_input|pam_crossover"
-        r"|perf_sweep|profile_step|convergence_runs)")
+    # Anchor on the interpreter token, then scan the remaining argv tokens —
+    # a bare whole-line substring scan would match unrelated processes whose
+    # argv merely *mentions* these names (observed: a session wrapper whose
+    # prompt text contains them), while a rigid positional regex misses
+    # interpreter flags with separate arguments ("python -X faulthandler
+    # scripts/...") and "python -c ... import perf_sweep ..." workers.
+    markers = ("pytest", "bench.py", "bench_e2e", "bench_input",
+               "pam_crossover", "perf_sweep", "profile_step",
+               "convergence_runs", "bench_breakdown")
     for line in out.splitlines():
-        if pat.match(line.strip()):
+        toks = line.split()
+        if not toks:
+            continue
+        interp = os.path.basename(toks[0])
+        if interp.startswith("pytest"):
+            return line.strip()[:120]
+        if not re.fullmatch(r"python[\d.]*", interp):
+            continue
+        if any(m in t for m in markers for t in toks[1:]):
             return line.strip()[:120]
     return None
 

@@ -184,6 +184,24 @@ class TestPredictor:
         assert c.shape == a.shape
 
 
+class TestModelFromConfig:
+    def test_forwards_every_danet_model_knob(self):
+        """Inference must rebuild the model the Trainer trained —
+        including pam_score_dtype (a silent train/predict numeric
+        divergence otherwise)."""
+        import jax.numpy as jnp
+
+        from distributedpytorch_tpu.predict import model_from_config
+        from distributedpytorch_tpu.train import Config
+        cfg = Config()
+        cfg.model.backbone = "resnet18"
+        cfg.model.pam_score_dtype = "bfloat16"
+        cfg.model.pam_block_size = 7
+        m = model_from_config(cfg)
+        assert m.pam_score_dtype == jnp.bfloat16
+        assert m.pam_block_size == 7
+
+
 class TestFromTorch:
     def test_roundtrip_matches_native_predictor(self, tmp_path):
         """A torch .pth exported from this framework's own params serves
