@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from .danet import DANet, DANetHead
 from .deeplab import ASPP, DeepLabV3, FCN, FCNHead
+from .encnet import EncNet, EncNetHead, Encoding
 from .pspnet import PSPNet, PyramidPooling
 from .resnet import ResNet, resnet50, resnet101
 
@@ -55,6 +56,10 @@ def build_model(
             if k in kw and kw.pop(k) != default:
                 raise ValueError(
                     f"{k} is DANet-only; model {name!r} does not support it")
+    if name != "encnet" and kw.pop("encnet_codes", 32) != 32:
+        raise ValueError(
+            f"encnet_codes is EncNet-only; model {name!r} does not "
+            "support it")
     if name == "danet":
         if kw.pop("aux_head", False):
             raise ValueError("aux_head is a DeepLabV3/FCN/PSPNet option; DANet's "
@@ -96,9 +101,19 @@ def build_model(
             bn_cross_replica_axis=bn_cross_replica_axis,
             **kw,
         )
+    if name == "encnet":
+        kw["n_codes"] = kw.pop("encnet_codes", 32)
+        return EncNet(
+            nclass=nclass,
+            backbone_depth=depth,
+            output_stride=output_stride or 8,
+            dtype=dtype,
+            bn_cross_replica_axis=bn_cross_replica_axis,
+            **kw,
+        )
     raise ValueError(
         f"unknown model: {name!r} (danet | deeplabv3 | deeplabv3plus | fcn "
-        "| pspnet)")
+        "| pspnet | encnet)")
 
 
 __all__ = [
@@ -106,6 +121,9 @@ __all__ = [
     "DANet",
     "DANetHead",
     "DeepLabV3",
+    "EncNet",
+    "EncNetHead",
+    "Encoding",
     "FCN",
     "FCNHead",
     "PSPNet",

@@ -1,0 +1,167 @@
+"""EncNet — context encoding segmentation network (flax.linen, NHWC).
+
+Fifth model family of the zoo.  The reference pulls its models from the
+PyTorch-Encoding package (reference train_pascal.py:32 imports
+``encoding.models``); EncNet (Zhang et al., CVPR'18 "Context Encoding for
+Semantic Segmentation") is that package's namesake model: a learned
+codebook over the stage-4 features produces a global scene descriptor
+that (a) channel-gates the features (SE-style) and (b) predicts which
+classes are present anywhere in the image (the SE-loss auxiliary,
+``ops.losses.se_presence_loss``).
+
+TPU-first notes:
+* the soft-assignment is pure batched einsum via the expansion
+  ``||x - c||^2 = |x|^2 + |c|^2 - 2 x.c`` — (B,N,K) scores go straight
+  onto the MXU, no per-codeword loops and no dynamic shapes;
+* the aggregation ``e_k = sum_i a_ik (x_i - c_k)`` splits into two
+  einsums (``a^T x`` and ``colsum(a) * c``) so the (B,N,K,D) residual
+  tensor is never materialized;
+* output contract matches the zoo: a tuple of input-resolution logit
+  maps primary-first, plus (last) the (B, nclass) SE-presence logits —
+  the shared multi-output loss dispatches on ndim, and eval consumes
+  ``outputs[0]`` unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .deeplab import FCNHead, _resize_bilinear
+from .resnet import ResNet, make_norm
+
+
+class Encoding(nn.Module):
+    """Learned residual codebook: (B, N, D) -> (B, D) scene descriptor.
+
+    ``n_codes`` codewords ``c_k`` with per-codeword smoothing ``s_k``:
+    assignment ``a_ik = softmax_k(-s_k ||x_i - c_k||^2)``, aggregate
+    ``e_k = sum_i a_ik (x_i - c_k)``, then BN+ReLU and mean over k.
+    """
+
+    n_codes: int
+    norm: Any
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, n, d = x.shape
+        std = 1.0 / (self.n_codes * d) ** 0.5
+        codewords = self.param(
+            "codewords", nn.initializers.uniform(scale=2 * std),
+            (self.n_codes, d), jnp.float32)
+        codewords = codewords - std  # uniform(-std, std), paper's init
+        smoothing = self.param(
+            "smoothing", nn.initializers.uniform(scale=1.0),
+            (self.n_codes,), jnp.float32)  # uniform(0, 1) ~ paper's |init|
+        xf = x.astype(jnp.float32)
+        # squared distances by expansion: nothing (B,N,K,D)-sized exists
+        x2 = jnp.sum(xf * xf, axis=-1, keepdims=True)          # (B,N,1)
+        c2 = jnp.sum(codewords * codewords, axis=-1)           # (K,)
+        xc = jnp.einsum("bnd,kd->bnk", xf, codewords)          # (B,N,K)
+        dist2 = x2 + c2[None, None, :] - 2.0 * xc
+        assign = jax.nn.softmax(-smoothing[None, None, :] * dist2, axis=-1)
+        # e_k = sum_i a_ik x_i  -  (sum_i a_ik) c_k
+        agg_x = jnp.einsum("bnk,bnd->bkd", assign, xf)
+        agg_c = assign.sum(axis=1)[..., None] * codewords[None]
+        encoded = agg_x - agg_c                                 # (B,K,D)
+        # BN over the CODEWORD axis (features=K, stats over B and D) — the
+        # published EncNet normalization geometry (BatchNorm1d over the
+        # n_codes aggregates), not feature-axis BN.
+        encoded = self.norm(name="enc_bn", axis=1)(
+            encoded.astype(self.dtype))
+        return nn.relu(encoded).mean(axis=1)                    # (B,D)
+
+
+class EncModule(nn.Module):
+    """Context encoding + SE-style channel gate + presence head."""
+
+    channels: int
+    nclass: int
+    n_codes: int
+    norm: Any
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        enc = Encoding(n_codes=self.n_codes, norm=self.norm,
+                       dtype=self.dtype, name="encoding")(
+            x.reshape(b, h * w, c))
+        gate = nn.sigmoid(nn.Dense(self.channels, dtype=self.dtype,
+                                   name="fc_gate")(enc))
+        gated = x * gate[:, None, None, :]
+        se_logits = nn.Dense(self.nclass, dtype=self.dtype,
+                             name="fc_se")(enc).astype(jnp.float32)
+        return gated, se_logits
+
+
+class EncNetHead(nn.Module):
+    """conv-in -> EncModule gate -> dropout -> classifier (+ SE logits)."""
+
+    nclass: int
+    norm: Any
+    n_codes: int = 32
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        inter = max(x.shape[-1] // 4, 1)  # 2048 -> 512
+        y = nn.Conv(inter, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="in_conv")(x)
+        y = self.norm(name="in_bn")(y)
+        y = nn.relu(y)
+        y, se_logits = EncModule(channels=inter, nclass=self.nclass,
+                                 n_codes=self.n_codes, norm=self.norm,
+                                 dtype=self.dtype, name="enc")(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        logits = nn.Conv(self.nclass, (1, 1), dtype=self.dtype,
+                         name="cls")(y)
+        return logits, se_logits
+
+
+class EncNet(nn.Module):
+    """Backbone + context-encoding head.
+
+    ``__call__(x, train)`` returns ``(logits, [aux_logits,] se_logits)``:
+    input-resolution maps first (the zoo's tuple contract, reference
+    train_pascal.py:258-260), the (B, nclass) presence vector last — the
+    multi-output loss applies softmax CE to the maps and the EncNet
+    SE-presence BCE to the vector (``parallel/step.py:_compute_loss``).
+    """
+
+    nclass: int = 21
+    backbone_depth: int = 101
+    output_stride: int = 8
+    n_codes: int = 32
+    aux_head: bool = False
+    dtype: jnp.dtype = jnp.float32
+    bn_cross_replica_axis: str | None = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        feats = ResNet(
+            depth=self.backbone_depth,
+            output_stride=self.output_stride,
+            dtype=self.dtype,
+            bn_cross_replica_axis=self.bn_cross_replica_axis,
+            remat=self.remat,
+            name="backbone",
+        )(x, train=train)
+        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
+        logits, se_logits = EncNetHead(
+            nclass=self.nclass, norm=norm, n_codes=self.n_codes,
+            dtype=self.dtype, name="head")(feats["c4"], train=train)
+        outs = [_resize_bilinear(logits, size)]
+        if self.aux_head:
+            aux = FCNHead(nclass=self.nclass, norm=norm, dtype=self.dtype,
+                          name="aux_head")(feats["c3"], train=train)
+            outs.append(_resize_bilinear(aux, size))
+        return (*outs, se_logits)

@@ -18,6 +18,7 @@ from .pallas_attention import flash_position_attention
 from .losses import (
     sigmoid_balanced_bce,
     multi_output_loss,
+    se_presence_loss,
     softmax_xent_ignore,
 )
 from .metrics import (
@@ -37,6 +38,7 @@ __all__ = [
     "flash_position_attention",
     "sigmoid_balanced_bce",
     "multi_output_loss",
+    "se_presence_loss",
     "softmax_xent_ignore",
     "jaccard",
     "batched_jaccard",

@@ -75,6 +75,35 @@ def multi_output_loss(
     return total
 
 
+def se_presence_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore_index: int = 255,
+) -> jax.Array:
+    """Semantic-encoding (SE) loss: per-image class-presence BCE.
+
+    The EncNet training objective's auxiliary term (Zhang et al. CVPR'18,
+    the PyTorch-Encoding package the reference pulls its models from —
+    reference train_pascal.py:32): the context-encoding branch predicts
+    which classes appear anywhere in the image, forcing the encoded global
+    descriptor to carry scene-level semantics.  ``logits``: (B, C);
+    ``labels``: int (B, H, W) with ``ignore_index`` void pixels excluded
+    from the presence derivation.  Returns the mean BCE over (B, C).
+    """
+    c = logits.shape[-1]
+    flat = labels.reshape(labels.shape[0], -1)
+    valid = flat != ignore_index
+    # presence[b, k] = any valid pixel of class k; the (B, N, C) compare
+    # feeds straight into the any-reduce — XLA fuses it, nothing N*C-sized
+    # is materialized.
+    present = jnp.any(
+        (flat[..., None] == jnp.arange(c)) & valid[..., None], axis=1
+    ).astype(jnp.float32)
+    x = logits.astype(jnp.float32)
+    per = jnp.maximum(x, 0.0) - x * present + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return per.mean()
+
+
 def softmax_xent_ignore(
     logits: jax.Array,
     labels: jax.Array,

@@ -39,7 +39,7 @@ import optax
 from flax import struct
 from flax.core import unfreeze
 
-from ..ops import multi_output_loss, softmax_xent_ignore
+from ..ops import multi_output_loss, se_presence_loss, softmax_xent_ignore
 from . import mesh as mesh_lib
 
 Batch = Mapping[str, jax.Array]
@@ -153,6 +153,13 @@ def _compute_loss(outputs, batch: Batch, weights, loss_type: str):
     inputs = batch[INPUT_KEY]
     target = batch[TARGET_KEY]
     void = batch.get("crop_void")
+    if weights is not None and len(weights) != len(outputs):
+        # zip would silently truncate — e.g. EncNet's (map, aux, se) tuple
+        # under loss_weights=[1.0,0.4] would drop the SE-presence loss and
+        # never train the context-encoding branch
+        raise ValueError(
+            f"model.loss_weights has {len(weights)} entries but the model "
+            f"emits {len(outputs)} outputs — give every output a weight")
     if loss_type == "multi_sigmoid":
         if target.ndim == inputs.ndim - 1:  # (B,H,W) vs (B,H,W,C) logits
             target = target[..., None]
@@ -165,10 +172,18 @@ def _compute_loss(outputs, batch: Batch, weights, loss_type: str):
             labels = labels[..., 0]
         labels = labels.astype(jnp.int32)
         if weights is None:
-            weights = (1.0,) + (0.4,) * (len(outputs) - 1)
+            # map aux heads 0.4 (DeepLab recipe); a 2D SE output gets the
+            # EncNet paper's 0.2
+            weights = (1.0,) + tuple(
+                0.2 if o.ndim == 2 else 0.4 for o in outputs[1:])
         total = jnp.float32(0.0)
         for out, w in zip(outputs, weights):
-            total = total + w * softmax_xent_ignore(out, labels)
+            if out.ndim == 2:
+                # (B, C) vector head: EncNet's semantic-encoding branch —
+                # class-presence BCE, not a per-pixel CE
+                total = total + w * se_presence_loss(out, labels)
+            else:
+                total = total + w * softmax_xent_ignore(out, labels)
         return total
     raise ValueError(f"unknown loss_type: {loss_type!r}")
 

@@ -114,7 +114,7 @@ class DataConfig:
 @dataclass
 class ModelConfig:
     name: str = "danet"                 # danet | deeplabv3 | deeplabv3plus
-                                        # | fcn | pspnet
+                                        # | fcn | pspnet | encnet
     nclass: int = 1                     # binary/sigmoid head (DANet(1, ...))
     backbone: str = "resnet101"
     output_stride: int | None = None
@@ -145,9 +145,12 @@ class ModelConfig:
     moe_k: int = 1                      # top-k routing (1 = Switch)
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01        # load-balancing aux-loss weight
-    aux_head: bool = False              # DeepLabV3/FCN: auxiliary FCN head
-                                        # on c3 (second output; weight it
-                                        # via loss_weights, e.g. [1.0,0.4])
+    aux_head: bool = False              # DeepLabV3/FCN/PSPNet/EncNet:
+                                        # auxiliary FCN head on c3 (second
+                                        # output; weight it via
+                                        # loss_weights, e.g. [1.0,0.4])
+    encnet_codes: int = 32              # EncNet: context-encoding codebook
+                                        # size (the SE branch's codewords)
 
 
 @dataclass

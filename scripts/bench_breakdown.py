@@ -99,12 +99,14 @@ def make_trainer(fixture: str, work: str, tiny_model: bool) -> Trainer:
         "model.dtype=" + ("float32" if tiny_model else "bfloat16"),
         "optim.lr=1e-4",
         "epochs=1", "log_writers=[]",
-        *OVERRIDES,
         *(["model.backbone=resnet18", "model.output_stride=8",
            "data.crop_size=[64,64]", "model.dtype=float32"]
           if (tiny_model and CPU_SMOKE) else
           ["model.backbone=resnet18", "model.output_stride=8"]
           if tiny_model else []),
+        # user overrides LAST (apply_overrides is last-write-wins): the
+        # printed `overrides` record must be the config that actually ran
+        *OVERRIDES,
     ])
     import dataclasses
     return Trainer(dataclasses.replace(cfg, work_dir=work))

@@ -61,7 +61,26 @@ def host_busy() -> str | None:
             return line.strip()[:120]
         if not re.fullmatch(r"python[\d.]*", interp):
             continue
-        if any(m in t for m in markers for t in toks[1:]):
+        # Scan only the token that names WHAT python is running — a marker
+        # anywhere in argv would wedge the queue behind an unrelated
+        # daemon whose file argument merely mentions a bench name, while a
+        # rigid positional scan misses interpreter flags with separate
+        # arguments.  Three invocation shapes:
+        #   python -m <module> ...   -> the module token
+        #   python -c <code>         -> the code (imports benches by name)
+        #   python [flags] script.py -> first token that looks like a path
+        args = toks[1:]
+        if "-m" in args:
+            i = args.index("-m")
+            probe = [args[i + 1]] if i + 1 < len(args) else []
+        elif "-c" in args:
+            probe = args
+        else:
+            nonflags = [t for t in args if not t.startswith("-")]
+            probe = [next((t for t in nonflags
+                           if t.endswith(".py") or "/" in t),
+                          nonflags[0] if nonflags else "")]
+        if any(m in t for m in markers for t in probe):
             return line.strip()[:120]
     return None
 

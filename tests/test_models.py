@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributedpytorch_tpu.models import (DANet, DeepLabV3, FCN,
+from distributedpytorch_tpu.models import (DANet, DeepLabV3, EncNet, FCN,
                                            ResNet, build_model)
 
 
@@ -159,6 +159,73 @@ class TestDANet:
         assert leaf.dtype == jnp.float32
 
 
+class TestEncNet:
+    def test_output_contract(self):
+        """(logits map at input res, se presence vector) — maps first,
+        vector last, the ndim-dispatched loss contract."""
+        m = EncNet(nclass=21, backbone_depth=18, output_stride=8, n_codes=8)
+        x = jnp.zeros((2, 64, 64, 3))
+        _, out = init_and_apply(m, x)
+        assert isinstance(out, tuple) and len(out) == 2
+        assert out[0].shape == (2, 64, 64, 21)
+        assert out[1].shape == (2, 21)
+
+    def test_aux_head_inserts_second_map(self):
+        m = EncNet(nclass=21, backbone_depth=18, output_stride=8,
+                   n_codes=8, aux_head=True)
+        x = jnp.zeros((1, 64, 64, 3))
+        _, out = init_and_apply(m, x)
+        assert len(out) == 3
+        assert out[0].shape == out[1].shape == (1, 64, 64, 21)
+        assert out[2].shape == (1, 21)
+
+    def test_encoding_matches_naive_loop(self):
+        """The einsum-expansion soft-assignment must equal the direct
+        residual computation (the (B,N,K,D) form it avoids)."""
+        from distributedpytorch_tpu.models.encnet import Encoding
+        from distributedpytorch_tpu.models.resnet import make_norm
+        r = np.random.default_rng(0)
+        x = jnp.asarray(r.normal(size=(2, 12, 6)), jnp.float32)
+        enc = Encoding(n_codes=4, norm=make_norm(False))
+        variables = enc.init(jax.random.key(0), x)
+        got = enc.apply(variables, x)
+
+        cw = np.asarray(variables["params"]["codewords"]) - \
+            1.0 / (4 * 6) ** 0.5
+        sm = np.asarray(variables["params"]["smoothing"])
+        xn = np.asarray(x)
+        resid = xn[:, :, None, :] - cw[None, None, :, :]   # (B,N,K,D)
+        d2 = (resid ** 2).sum(-1)                          # (B,N,K)
+        a = np.exp(-sm * d2)
+        a = a / a.sum(-1, keepdims=True)
+        agg = (a[..., None] * resid).sum(axis=1)           # (B,K,D)
+        # BN over the codeword axis (features=K): params/stats are (K,),
+        # broadcast against (B,K,D) on axis 1.  Running stats are (0,1) at
+        # init -> identity up to eps scale.
+        bn = variables["batch_stats"]["enc_bn"]
+        scale = np.asarray(variables["params"]["enc_bn"]["scale"])
+        bias = np.asarray(variables["params"]["enc_bn"]["bias"])
+        assert scale.shape == (4,)  # K, not D
+        mean = np.asarray(bn["mean"])[None, :, None]
+        var = np.asarray(bn["var"])[None, :, None]
+        normed = (agg - mean) / np.sqrt(var + 1e-5) \
+            * scale[None, :, None] + bias[None, :, None]
+        want = np.maximum(normed, 0.0).mean(axis=1)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_train_mode_mutates_batch_stats(self):
+        m = EncNet(nclass=5, backbone_depth=18, n_codes=4)
+        x = jnp.ones((1, 32, 32, 3))
+        variables = m.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+            x, train=False)
+        _, mutated = m.apply(variables, x, train=True,
+                             mutable=["batch_stats"],
+                             rngs={"dropout": jax.random.key(2)})
+        assert "batch_stats" in mutated
+
+
 class TestDeepLabV3:
     def test_primary_output(self):
         m = DeepLabV3(nclass=21, backbone_depth=18, output_stride=16)
@@ -267,6 +334,18 @@ class TestFactory:
     def test_build_deeplabv3plus(self):
         m = build_model("deeplabv3plus", nclass=21, backbone="resnet50")
         assert isinstance(m, DeepLabV3) and m.decoder
+
+    def test_build_encnet(self):
+        from distributedpytorch_tpu.models import EncNet
+        m = build_model("encnet", nclass=21, backbone="resnet50",
+                        encnet_codes=16, aux_head=True)
+        assert isinstance(m, EncNet)
+        assert m.n_codes == 16 and m.aux_head
+
+    def test_encnet_codes_is_encnet_only(self):
+        with pytest.raises(ValueError, match="encnet_codes"):
+            build_model("deeplabv3", nclass=21, backbone="resnet50",
+                        encnet_codes=16)
 
     def test_unknown_raises(self):
         with pytest.raises(ValueError):

@@ -123,6 +123,26 @@ class TestLosses:
                                        weights=(1.0, 0.5))
         np.testing.assert_allclose(float(halved), 1.5 * float(one), rtol=1e-6)
 
+    def test_se_presence_loss(self, rng):
+        """EncNet's SE loss: BCE against the per-image class-presence
+        vector, void pixels excluded from the presence derivation."""
+        labels = np.zeros((2, 4, 4), np.int32)
+        labels[0, 0, 0] = 3          # image 0: classes {0, 3}
+        labels[1, :] = 255           # image 1: all void except...
+        labels[1, 2, 2] = 1          # ...one pixel of class 1
+        present = np.zeros((2, 5), np.float32)
+        present[0, [0, 3]] = 1.0
+        present[1, 1] = 1.0          # 255 never counts as presence
+        logits = jnp.asarray(rng.normal(size=(2, 5)), jnp.float32)
+        got = float(ops.se_presence_loss(logits, jnp.asarray(labels)))
+        x = np.asarray(logits)
+        p = 1 / (1 + np.exp(-x))
+        want = -(present * np.log(p) + (1 - present) * np.log(1 - p)).mean()
+        assert got == pytest.approx(want, rel=1e-5)
+        # perfectly confident correct logits drive the loss toward zero
+        sure = jnp.asarray(np.where(present > 0, 20.0, -20.0), jnp.float32)
+        assert float(ops.se_presence_loss(sure, jnp.asarray(labels))) < 1e-6
+
     def test_softmax_xent_ignore(self, rng):
         logits = jnp.asarray(rng.normal(size=(2, 4, 4, 5)), jnp.float32)
         labels = jnp.asarray(rng.integers(0, 5, (2, 4, 4)), jnp.int32)

@@ -82,6 +82,20 @@ class TestMesh:
 
 
 class TestTrainStep:
+    def test_loss_weights_length_mismatch_raises(self):
+        """zip truncation must not silently drop an output's loss term
+        (e.g. EncNet's SE branch under loss_weights=[1.0,0.4])."""
+        from distributedpytorch_tpu.parallel.step import _compute_loss
+        outs = (jnp.zeros((1, 4, 4, 5)), jnp.zeros((1, 4, 4, 5)),
+                jnp.zeros((1, 5)))
+        batch = {"concat": jnp.zeros((1, 4, 4, 3)),
+                 "crop_gt": jnp.zeros((1, 4, 4))}
+        with pytest.raises(ValueError, match="loss_weights"):
+            _compute_loss(outs, batch, (1.0, 0.4), "multi_softmax")
+        # full-length weights pass, SE vector included
+        loss = _compute_loss(outs, batch, (1.0, 0.4, 0.2), "multi_softmax")
+        assert np.isfinite(float(loss))
+
     def test_loss_decreases_and_state_advances(self, mesh, state_and_model):
         state, model, tx = state_and_model
         step = make_train_step(model, tx, mesh=mesh, donate=False)

@@ -102,6 +102,32 @@ class TestSemanticTrainerEndToEnd:
         tr.close()
 
 
+class TestEncNetSemantic:
+    def test_fit_encnet_semantic(self, tmp_path):
+        """EncNet through the full Trainer: the 2D SE-presence output rides
+        the multi_softmax loss (ndim dispatch) in train AND eval, and the
+        evaluator consumes outputs[0] untouched."""
+        cfg = apply_overrides(Config(), [
+            "task=semantic", "data.fake=true", "data.train_batch=4",
+            "data.val_batch=2", "data.crop_size=[64,64]",
+            "mesh.data=4", "mesh.model=2",
+            "model.name=encnet", "model.nclass=21",
+            "model.backbone=resnet18", "model.in_channels=3",
+            "model.aux_head=true", "model.encnet_codes=8",
+            "optim.lr=0.001", "optim.schedule=poly",
+            "checkpoint.async_save=false", "epochs=1", "eval_every=1",
+            "log_every_steps=1",
+        ])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        tr = Trainer(cfg)
+        hist = tr.fit()
+        assert np.isfinite(hist["train_loss"][0])
+        m = hist["val"][-1]
+        assert 0.0 <= m["miou"] <= 1.0
+        assert len(m["per_class_iou"]) == 21
+        tr.close()
+
+
 class TestFullResEval:
     def test_fullres_batch_keeps_ragged_gt(self, fake_voc_root):
         from distributedpytorch_tpu.data import (
