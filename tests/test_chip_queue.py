@@ -1,0 +1,84 @@
+"""The health-gated chip work queue's host-side logic (scripts/chip_queue).
+
+The runner itself needs a TPU tunnel; these tests cover the pure-host
+pieces that keep measurements trustworthy: the idle-host gate (launching a
+bench beside pytest collapses numbers 2-3x on a 1-core box — BASELINE.md),
+the natural-numeric step ordering, and the partial-write settle window.
+"""
+
+import os
+import sys
+import time
+import types
+import unittest.mock as mock
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+import chip_queue  # noqa: E402
+
+
+def _busy_with(ps_line: str):
+    with mock.patch.object(chip_queue.subprocess, "run") as m:
+        m.return_value = types.SimpleNamespace(stdout=ps_line + "\n")
+        return chip_queue.host_busy()
+
+
+class TestHostBusyGate:
+    def test_flags_bench_invocations(self):
+        for line in [
+            "python -m pytest tests/ -x -q",
+            "pytest tests/",
+            "python bench.py",
+            "python -u -X faulthandler scripts/convergence_runs.py d",
+            "python -c from perf_sweep import run; run(8)",
+            "/usr/bin/python3.11 scripts/bench_breakdown.py host",
+            "python scripts/bench_e2e.py 10 11 12",
+        ]:
+            assert _busy_with(line) is not None, line
+
+    def test_ignores_non_bench_processes(self):
+        for line in [
+            # a wrapper whose argv TEXT mentions bench names (observed: the
+            # session driver's prompt string) must not wedge the queue
+            "/bin/sh -c bash -c 'claude -p ... bench.py perf_sweep pytest'",
+            # a python daemon merely *reading* a bench's output file
+            "python log_viewer.py --follow /tmp/bench_e2e.json",
+            "python -m distributedpytorch_tpu epochs=1",
+            "ps -eo args",
+            "tee /tmp/r3/bench_mfu.json",
+            "",
+        ]:
+            assert _busy_with(line) is None, line
+
+    def test_ps_failure_fails_open(self):
+        with mock.patch.object(chip_queue.subprocess, "run",
+                               side_effect=OSError("no ps")):
+            assert chip_queue.host_busy() is None
+
+
+class TestQueueOrdering:
+    def test_natural_numeric_sort(self):
+        names = ["10_profile.sh", "2_bench.sh", "1_warmup.sh"]
+        assert sorted(names, key=chip_queue._natural_key) == \
+            ["1_warmup.sh", "2_bench.sh", "10_profile.sh"]
+
+    def test_pending_orders_and_filters(self, tmp_path):
+        for name in ("10_b.sh", "2_a.sh", "note.txt", "done.sh.done"):
+            (tmp_path / name).write_text("true\n")
+        old = time.time() - 60
+        for name in ("10_b.sh", "2_a.sh"):
+            os.utime(tmp_path / name, (old, old))
+        assert chip_queue.pending(str(tmp_path)) == ["2_a.sh", "10_b.sh"]
+
+    def test_pending_holds_back_files_still_being_written(self, tmp_path):
+        settled = tmp_path / "1_done.sh"
+        settled.write_text("true\n")
+        old = time.time() - 60
+        os.utime(settled, (old, old))
+        fresh = tmp_path / "2_fresh.sh"
+        fresh.write_text("partial")  # mtime = now: possibly mid-write
+        assert chip_queue.pending(str(tmp_path)) == ["1_done.sh"]
+        os.utime(fresh, (old, old))  # settles -> picked up
+        assert chip_queue.pending(str(tmp_path)) == ["1_done.sh",
+                                                     "2_fresh.sh"]
