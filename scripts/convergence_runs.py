@@ -1,7 +1,8 @@
 """Convergence evidence runs (VERDICT r1 item 3): prove the model learns
 from pixels, not just from the guidance channel.
 
-Three real-chip runs on a 200-image fake-VOC at real image sizes:
+Real-chip runs a-d share a 200-image fake-VOC at real image sizes
+(opt-in run e builds its own 1,000-image fixture):
 
   a. flagship guided: DANet-R101 512² b8 bf16, n-ellipse+gaussian guidance
      (the round-1 recipe, now on the prepared+uint8 fast path);
@@ -11,10 +12,16 @@ Three real-chip runs on a 200-image fake-VOC at real image sizes:
      class masks;
   d. bf16 PAM scores: identical to (a) but ``model.pam_score_dtype=
      bfloat16`` — the roofline lever's accuracy side (its speed side is
-     perf_sweep variants 11-12); compare curve (d) against curve (a).
+     perf_sweep variants 11-12); compare curve (d) against curve (a);
+  e. large-fixture semantic plateau: DeepLabV3-R101 on a 1,000-image
+     fake-VOC to a non-trivial mIoU plateau — the learning-from-pixels
+     evidence VERDICT r2 item 2 prescribes if ablation (b) tracks (a)
+     (guidance-copying); report epochs-to-plateau.  NOT in the default
+     selection (run only when the a/b outcome calls for it):
+     ``python scripts/convergence_runs.py e --epochs 60``.
 
 Prints one JSON line per run with the per-epoch val metric curve.
-Usage: python scripts/convergence_runs.py [a b c d] [--epochs N]
+Usage: python scripts/convergence_runs.py [a b c d e] [--epochs N]
 """
 
 from __future__ import annotations
@@ -101,11 +108,19 @@ def run(name: str, fixture: str, overrides: dict) -> dict:
 
 
 if __name__ == "__main__":
-    sel = [a for a in sys.argv[1:] if a in ("a", "b", "c", "d")] \
-        or ["a", "b", "c", "d"]
-    fixture = tempfile.mkdtemp(prefix="conv_voc_")
-    make_fake_voc(fixture, n_images=N_IMAGES, size=IMG_SIZE, max_objects=2,
-                  n_val=N_VAL, seed=7)
+    sel = [a for a in sys.argv[1:] if a in ("a", "b", "c", "d", "e")] \
+        or ["a", "b", "c", "d"]  # e is opt-in: 5x the fixture, ~4x the wall
+    fixture = None
+    if set(sel) - {"e"}:
+        fixture = tempfile.mkdtemp(prefix="conv_voc_")
+        make_fake_voc(fixture, n_images=N_IMAGES, size=IMG_SIZE,
+                      max_objects=2, n_val=N_VAL, seed=7)
+    fixture_big = None
+    if "e" in sel:
+        fixture_big = tempfile.mkdtemp(prefix="conv_voc_big_")
+        make_fake_voc(fixture_big, n_images=40 if CPU_SMOKE else 1000,
+                      size=IMG_SIZE, max_objects=2,
+                      n_val=8 if CPU_SMOKE else 50, seed=11)
     runs = {
         "a_guided": {"data.device_guidance": True},
         "b_guidance_none": {"data.guidance": "none",
@@ -120,11 +135,15 @@ if __name__ == "__main__":
         "d_bf16_scores": {"data.device_guidance": True,
                           "model.pam_score_dtype": "bfloat16"},
     }
+    # e extends c's semantic evidence to the big fixture: SAME model
+    # config by construction, so the plateau comparison stays valid if c
+    # is ever retuned
+    runs["e_semantic_plateau_1k"] = dict(runs["c_semantic_deeplab"])
     for name, ov in runs.items():
         if name[0] not in sel:
             continue
         try:
-            rec = run(name, fixture, ov)
+            rec = run(name, fixture_big if name[0] == "e" else fixture, ov)
         except Exception as e:
             rec = {"run": name,
                    "error": f"{type(e).__name__}: {str(e)[:300]}"}
