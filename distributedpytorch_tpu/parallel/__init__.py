@@ -42,6 +42,7 @@ from .ring import (
     ring_attention_local,
 )
 from .tp import state_shardings, tp_param_specs
+from .zero import zero_opt_specs
 from .ulysses import make_ulysses_attention, ulysses_attention_local
 from .step import (
     INPUT_KEY,
@@ -91,4 +92,5 @@ __all__ = [
     "shard_batch",
     "state_shardings",
     "tp_param_specs",
+    "zero_opt_specs",
 ]

@@ -84,6 +84,7 @@ def create_train_state(
     input_shape: tuple[int, ...],
     mesh=None,
     shard_params: bool = False,
+    shard_opt_state: bool = False,
 ) -> TrainState:
     """Initialize params/batch-stats with a dummy batch and wrap with the
     optimizer state.  ``input_shape`` is (N, H, W, C) — NHWC, the TPU-native
@@ -98,8 +99,15 @@ def create_train_state(
     ``shard_params=True`` turns on tensor parallelism: kernel output
     channels are partitioned over the ``model`` axis (see
     :mod:`parallel.tp`); momentum inherits the layout through propagation.
-    Default is fully replicated — the reference-parity data-parallel state.
+
+    ``shard_opt_state=True`` is the ZeRO-1 layout: optimizer-state leaves
+    partitioned over the ``data`` axis (:mod:`parallel.zero`), composing
+    with the TP layout when both are on.  Default is fully replicated —
+    the reference-parity data-parallel state.
     """
+    if shard_opt_state and mesh is None:
+        raise ValueError("shard_opt_state requires a mesh (the data axis "
+                         "it shards over)")
     init_rng, state_rng = jax.random.split(rng)
 
     def make_state():
@@ -108,13 +116,24 @@ def create_train_state(
         params = unfreeze(variables["params"])
         batch_stats = unfreeze(variables.get("batch_stats", {}))
         opt_state = tx.init(params)
+        opt_base = None
         if mesh is not None and shard_params:
             from .tp import constrain, tp_param_specs
             params = constrain(params, mesh, tp_param_specs(params, mesh))
             # Momentum traces share the kernels' shapes, so the same
             # shape-based rule shards optimizer memory identically.
-            opt_state = constrain(opt_state, mesh,
-                                  tp_param_specs(opt_state, mesh))
+            opt_base = tp_param_specs(opt_state, mesh)
+        if mesh is not None and shard_opt_state:
+            from .tp import constrain
+            from .zero import zero_opt_specs
+            # ZeRO-1 on top of whatever TP pinned: `data` goes on each
+            # leaf's largest still-free divisible dimension.
+            opt_state = constrain(
+                opt_state, mesh,
+                zero_opt_specs(opt_state, mesh, base_specs=opt_base))
+        elif opt_base is not None:
+            from .tp import constrain
+            opt_state = constrain(opt_state, mesh, opt_base)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -125,21 +144,27 @@ def create_train_state(
 
     if mesh is None:
         return make_state()
-    if not shard_params:
+    if not (shard_params or shard_opt_state):
         return jax.jit(make_state,
                        out_shardings=mesh_lib.replicated_sharding(mesh))()
-    # TP: let XLA propagate the constrained param layout into the optimizer
-    # state; pin the small unconstrained leaves (step/rng/batch_stats) to
-    # replicated afterwards via an identity reshard where needed.
+    # Sharded layouts: let XLA propagate the constrained layouts; pin the
+    # small unconstrained leaves (step/rng/batch_stats) to replicated
+    # afterwards via an identity reshard where needed.
     with mesh:
         state = jax.jit(make_state)()
     repl = mesh_lib.replicated_sharding(mesh)
-    return state.replace(
+    fixed = state.replace(
         step=jax.device_put(state.step, repl),
         rng=jax.device_put(state.rng, repl),
         batch_stats=jax.tree.map(
             lambda x: jax.device_put(x, repl), state.batch_stats),
     )
+    if not shard_params:
+        # ZeRO-only: params must stay replicated (XLA may have propagated
+        # the opt-state layout backward into the init graph)
+        fixed = fixed.replace(params=jax.tree.map(
+            lambda x: jax.device_put(x, repl), fixed.params))
+    return fixed
 
 
 def _compute_loss(outputs, batch: Batch, weights, loss_type: str):

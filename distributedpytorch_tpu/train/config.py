@@ -181,6 +181,10 @@ class MeshConfig:
     data: int | None = None             # None = all devices
     model: int = 1                      # tensor-parallel axis size
     shard_params: bool = False          # TP: shard kernels over `model`
+    shard_opt_state: bool = False       # ZeRO-1: shard optimizer state
+                                        # over `data` (1/N optimizer
+                                        # memory per device for one
+                                        # param-sized all-gather per step)
 
 
 @dataclass

@@ -381,11 +381,14 @@ class Trainer:
             self.state = create_train_state(
                 jax.random.PRNGKey(cfg.seed), self.model, self.tx,
                 (1, h, w, cfg.model.in_channels), mesh=self.mesh,
-                shard_params=cfg.mesh.shard_params)
+                shard_params=cfg.mesh.shard_params,
+                shard_opt_state=cfg.mesh.shard_opt_state)
         loss_type = ("multi_softmax" if cfg.task == "semantic"
                      else "multi_sigmoid")
-        # TP layouts flow from the created state into the compiled steps.
-        st_sh = state_shardings(self.state) if cfg.mesh.shard_params else None
+        # TP / ZeRO-1 layouts flow from the created state into the
+        # compiled steps.
+        st_sh = state_shardings(self.state) \
+            if (cfg.mesh.shard_params or cfg.mesh.shard_opt_state) else None
         augment = None
         if cfg.data.device_augment or cfg.data.device_guidance:
             from ..ops.augment import make_device_augment
