@@ -116,15 +116,14 @@ def create_train_state(
         params = unfreeze(variables["params"])
         batch_stats = unfreeze(variables.get("batch_stats", {}))
         opt_state = tx.init(params)
+        from .tp import constrain, tp_param_specs
         opt_base = None
         if mesh is not None and shard_params:
-            from .tp import constrain, tp_param_specs
             params = constrain(params, mesh, tp_param_specs(params, mesh))
             # Momentum traces share the kernels' shapes, so the same
             # shape-based rule shards optimizer memory identically.
             opt_base = tp_param_specs(opt_state, mesh)
         if mesh is not None and shard_opt_state:
-            from .tp import constrain
             from .zero import zero_opt_specs
             # ZeRO-1 on top of whatever TP pinned: `data` goes on each
             # leaf's largest still-free divisible dimension.
@@ -132,7 +131,6 @@ def create_train_state(
                 opt_state, mesh,
                 zero_opt_specs(opt_state, mesh, base_specs=opt_base))
         elif opt_base is not None:
-            from .tp import constrain
             opt_state = constrain(opt_state, mesh, opt_base)
         return TrainState(
             step=jnp.zeros((), jnp.int32),

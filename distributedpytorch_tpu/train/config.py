@@ -155,9 +155,17 @@ class ModelConfig:
 
 @dataclass
 class OptimConfig:
+    name: str = "sgd"                   # sgd (reference parity,
+                                        # train_pascal.py:118) | adamw
+                                        # (decoupled weight decay; its two
+                                        # moment buffers are where
+                                        # mesh.shard_opt_state pays most)
     lr: float = 5e-8
     momentum: float = 0.9
     weight_decay: float = 5e-4
+    adam_b1: float = 0.9                # adamw only
+    adam_b2: float = 0.999
+    adam_eps: float = 1e-8
     schedule: str = "constant"          # constant | poly | cosine
     poly_power: float = 0.9
     warmup_steps: int = 0

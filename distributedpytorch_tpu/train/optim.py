@@ -18,6 +18,10 @@ expressed as an ``optax.multi_transform`` over path-prefix labels.
 Weight decay note: torch SGD's ``weight_decay`` is L2-added-to-grad *before*
 momentum; ``optax.sgd`` has no wd, so we compose ``add_decayed_weights``
 ahead of the momentum trace to match torch semantics exactly.
+
+``optim.name=adamw`` swaps the update rule for decoupled-decay AdamW
+(optax.adamw) under the same schedules and param-group machinery; its two
+moment buffers are where ``mesh.shard_opt_state`` (ZeRO-1) pays most.
 """
 
 from __future__ import annotations
@@ -114,11 +118,22 @@ def make_optimizer(cfg: OptimConfig, total_steps: int
     the trainer can log the current LR."""
     sched = make_schedule(cfg, total_steps)
 
-    def sgd_update(mult: float = 1.0) -> optax.GradientTransformation:
+    def base_update(mult: float = 1.0) -> optax.GradientTransformation:
         parts = []
-        if cfg.weight_decay:
-            parts.append(optax.add_decayed_weights(cfg.weight_decay))
-        parts.append(optax.sgd(sched, momentum=cfg.momentum or None))
+        if cfg.name == "sgd":
+            # torch SGD semantics: wd is L2-added-to-grad BEFORE momentum
+            if cfg.weight_decay:
+                parts.append(optax.add_decayed_weights(cfg.weight_decay))
+            parts.append(optax.sgd(sched, momentum=cfg.momentum or None))
+        elif cfg.name == "adamw":
+            # adamw's decay is DECOUPLED (applied to params, scaled by the
+            # schedule) — optax.adamw owns that semantics
+            parts.append(optax.adamw(
+                sched, b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps,
+                weight_decay=cfg.weight_decay))
+        else:
+            raise ValueError(
+                f"unknown optimizer: {cfg.name!r} (sgd | adamw)")
         if mult != 1.0:  # torch param-group lr: scales the whole step
             parts.append(optax.scale(mult))
         return optax.chain(*parts)
@@ -126,12 +141,12 @@ def make_optimizer(cfg: OptimConfig, total_steps: int
     labeler = None
     if cfg.freeze or cfg.lr_mult:
         labeler = make_param_labeler(tuple(cfg.freeze), cfg.lr_mult)
-        group_txs = {"base": sgd_update(), "frozen": optax.set_to_zero()}
+        group_txs = {"base": base_update(), "frozen": optax.set_to_zero()}
         for prefix, mult in (cfg.lr_mult or {}).items():
-            group_txs[f"mult:{prefix}"] = sgd_update(float(mult))
+            group_txs[f"mult:{prefix}"] = base_update(float(mult))
         tx = optax.multi_transform(group_txs, labeler)
     else:
-        tx = sgd_update()
+        tx = base_update()
     if cfg.grad_clip_norm:
         pre = []
         if cfg.freeze:

@@ -93,6 +93,38 @@ class TestOptim:
         with pytest.raises(ValueError, match="cosine"):
             make_schedule(OptimConfig(schedule="nope"), 10)
 
+    def test_adamw_first_step_matches_closed_form(self):
+        # Adam step 1 from zero moments: m=(1-b1)g, v=(1-b2)g^2, with bias
+        # correction the update is -lr*(g/(|g|+eps)) - lr*wd*p (decoupled).
+        cfg = OptimConfig(name="adamw", lr=0.1, weight_decay=0.01,
+                          schedule="constant")
+        tx, _ = make_optimizer(cfg, 10)
+        p = {"w": np.float32(2.0)}
+        g = {"w": np.float32(0.5)}
+        st = tx.init(p)
+        upd, _ = tx.update(g, st, p)
+        expected = -0.1 * (0.5 / (0.5 + 1e-8)) - 0.1 * 0.01 * 2.0
+        np.testing.assert_allclose(float(upd["w"]), expected, rtol=1e-5)
+
+    def test_adamw_composes_with_param_groups(self):
+        cfg = OptimConfig(name="adamw", lr=0.1, weight_decay=0.0,
+                          schedule="constant", freeze=("frozen_tree",),
+                          lr_mult={"head": 10.0})
+        tx, _ = make_optimizer(cfg, 10)
+        p = {"frozen_tree": {"w": np.float32(1.0)},
+             "head": {"w": np.float32(1.0)},
+             "base": {"w": np.float32(1.0)}}
+        g = {k: {"w": np.float32(0.5)} for k in p}
+        upd, _ = tx.update(g, tx.init(p), p)
+        assert float(upd["frozen_tree"]["w"]) == 0.0
+        np.testing.assert_allclose(
+            float(upd["head"]["w"]), 10.0 * float(upd["base"]["w"]),
+            rtol=1e-5)
+
+    def test_unknown_optimizer_raises(self):
+        with pytest.raises(ValueError, match="adamw"):
+            make_optimizer(OptimConfig(name="lion"), 10)
+
     def test_sgd_weight_decay_matches_torch_semantics(self):
         # torch: grad <- grad + wd*p, then momentum buffer. One step from
         # zero momentum: update = -lr * (g + wd*p).
