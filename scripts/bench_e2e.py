@@ -52,10 +52,10 @@ from distributedpytorch_tpu.train import Config, Trainer, apply_overrides  # noq
 
 # VOC-like image sizes (VOC2012 images are ~500x375) so decode/crop/resize
 # cost what it costs on the real dataset.
-N_IMAGES = 8 if CPU_SMOKE else 120
+N_IMAGES = 20 if CPU_SMOKE else 120  # >= variant 9's train_batch=16
 N_VAL = 2 if CPU_SMOKE else 16   # enough val samples for a stable val rate
 IMG_SIZE = (96, 128) if CPU_SMOKE else (375, 500)
-BATCH = 2 if CPU_SMOKE else 8
+BATCH = 8  # also divides the smoke run's 8-device CPU mesh
 EPOCHS_TIMED = 1 if CPU_SMOKE else 2  # after a warmup epoch (compile + caches)
 
 
@@ -95,8 +95,11 @@ def run(fixture_root: str, overrides: dict) -> dict:
         steps = EPOCHS_TIMED * n_batches * echo
         # Fresh-image rate (echoed repeats are NOT fresh data — same rule as
         # the trainer's train/imgs_per_sec); the step rate is what the
-        # optimizer sees and is the number data echoing improves.
-        fresh = EPOCHS_TIMED * n_batches * BATCH
+        # optimizer sees and is the number data echoing improves.  Count
+        # with the variant's EFFECTIVE batch, not the module default — a
+        # train_batch override (variant 9) would otherwise under-report by
+        # exactly the ratio (round 2's b16 row was halved this way).
+        fresh = EPOCHS_TIMED * n_batches * cfg.data.train_batch
         rec = {"imgs_per_sec_per_chip": round(
                    fresh / dt / jax.device_count(), 2),
                "steps": steps}

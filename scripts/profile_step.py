@@ -47,6 +47,9 @@ if "--batch" in sys.argv:
 OUT = "profile_step_out"
 if "--out" in sys.argv:
     OUT = sys.argv[sys.argv.index("--out") + 1]
+SCORE_DTYPE = None  # model.pam_score_dtype: profile the bf16-scores step
+if "--score-dtype" in sys.argv:
+    SCORE_DTYPE = sys.argv[sys.argv.index("--score-dtype") + 1]
 ON_TPU = any(d.platform == "tpu" for d in jax.devices())
 SIZE = 512 if ON_TPU else 64
 BACKBONE = "resnet101" if ON_TPU else "resnet18"
@@ -108,7 +111,8 @@ def main() -> None:
     mesh = make_mesh()
     model = build_model("danet", nclass=1, backbone=BACKBONE,
                         output_stride=8,
-                        dtype="bfloat16" if ON_TPU else "float32")
+                        dtype="bfloat16" if ON_TPU else "float32",
+                        pam_score_dtype=SCORE_DTYPE)
     tx = optax.sgd(1e-3, momentum=0.9)
     r = np.random.RandomState(0)
     host_batch = {
@@ -131,6 +135,7 @@ def main() -> None:
 
     rec = {"metric": f"danet_{BACKBONE}_{SIZE}px_b{BATCH}_profile",
            "trace_dir": OUT, "steps": STEPS,
+           "score_dtype": SCORE_DTYPE,
            "platform": jax.devices()[0].platform}
     try:
         rec["top_ops_by_self_time"] = top_ops(hlo_stats_table(OUT))
