@@ -119,6 +119,12 @@ BACKBONE = "resnet101" if ON_TPU else "resnet18"
 DTYPE = "bfloat16" if ON_TPU else "float32"
 STEPS = 20 if ON_TPU else 3
 WARMUP = 3 if ON_TPU else 1
+#: A/B hook for the roofline lever without editing the bench: set
+#: DPTPU_BENCH_SCORE_DTYPE=bfloat16 to materialize the PAM's N^2 scores
+#: half-width (model.pam_score_dtype; softmax math stays f32).  Default
+#: keeps the reference-like f32 scores until the accuracy side
+#: (convergence run d) justifies flipping it.
+SCORE_DTYPE = os.environ.get("DPTPU_BENCH_SCORE_DTYPE") or None
 
 
 def main() -> None:
@@ -133,7 +139,8 @@ def main() -> None:
     mesh = make_mesh()
     n_chips = mesh.devices.size
     model = build_model("danet", nclass=1, backbone=BACKBONE,
-                        output_stride=8, dtype=DTYPE)
+                        output_stride=8, dtype=DTYPE,
+                        pam_score_dtype=SCORE_DTYPE)
     tx = optax.sgd(1e-3, momentum=0.9)
     r = np.random.RandomState(0)
     host_batch = {
@@ -175,6 +182,8 @@ def main() -> None:
         # extra context for the record: a CPU-fallback run is not a TPU number
         "platform": jax.devices()[0].platform,
     }
+    if SCORE_DTYPE:
+        record["pam_score_dtype"] = SCORE_DTYPE
     peak = peak_flops_per_chip()
     if flops is not None:
         record["flops_per_step"] = flops
