@@ -12,7 +12,13 @@ between the e2e number and its theoretical ceiling can be attributed:
           thread's per-batch capacity (layout/copy + H2D DMA).  TPU.
   step  — the compiled train step on one pre-placed batch, looped:
           ``bench.py``'s chip rate re-measured inside this exact config.
-          TPU.
+          With ``data.steps_per_dispatch=K`` this measures the K-step
+          program (items_per_step = K*batch), so the K-step executable's
+          chip-side efficiency can be compared against K singles.  TPU.
+  dispatch — host-blocking time of *issuing* one step call (sync, then
+          time the async enqueue alone).  This is the per-step host cost
+          that ``data.steps_per_dispatch`` amortizes; measuring it tells
+          whether K-step dispatch can pay on this host at all.  TPU.
 
 Under perfect overlap e2e == min(host, place, step); the printed
 ``ideal_overlap_imgs_per_sec`` vs the measured bench_e2e row is the
@@ -21,7 +27,7 @@ overlap slack worth engineering at, and the slowest stage is the lever.
 Usage:
   python scripts/bench_breakdown.py host            # CPU-safe stage
   python scripts/bench_breakdown.py place step      # chip stages
-  python scripts/bench_breakdown.py host place step [k=v overrides...]
+  python scripts/bench_breakdown.py host place step dispatch [k=v ...]
 Default config = bench_e2e variant 8 (prepared + device guidance + uint8
 wire), the measured-48.7 row.
 """
@@ -44,13 +50,15 @@ from distributedpytorch_tpu.backend_health import (  # noqa: E402
     pin_requested_platform,
 )
 
-STAGES = [a for a in sys.argv[1:] if a in ("host", "place", "step")]
+STAGES = [a for a in sys.argv[1:]
+          if a in ("host", "place", "step", "dispatch")]
 OVERRIDES = [a for a in sys.argv[1:] if "=" in a]
 CPU_SMOKE = "--cpu-smoke" in sys.argv
 if not STAGES:
     STAGES = ["host", "place", "step"]
 
-NEEDS_TPU = bool({"place", "step"} & set(STAGES)) and not CPU_SMOKE
+NEEDS_TPU = bool({"place", "step", "dispatch"} & set(STAGES)) \
+    and not CPU_SMOKE
 if not NEEDS_TPU:
     # Host-only run must never block on a wedged tunnel.  FORCE the
     # override — the site-installed accelerator plugin sets JAX_PLATFORMS
@@ -157,19 +165,78 @@ def stage_place(tr: Trainer, batch: dict) -> dict:
 
 def stage_step(tr: Trainer, batch: dict) -> dict:
     mesh = tr.mesh
+    k = tr.cfg.data.steps_per_dispatch
     with mesh:
         placed = shard_batch(mesh, batch)
         box = [tr.state]
 
-        def one():
-            box[0], loss = tr.train_step(box[0], placed)
-            return loss
+        if tr.multi_train_step is not None:
+            # K-step program: one compiled call consumes K batches (the
+            # same placed batch K times is fine — batches are read-only;
+            # only the state arg is donated).
+            def one():
+                box[0], lv = tr.multi_train_step(box[0],
+                                                 *([placed] * k))
+                return lv
+        else:
+            def one():
+                box[0], loss = tr.train_step(box[0], placed)
+                return loss
 
         bs = next(iter(batch.values())).shape[0]
         stats = throughput(one, steps=5 if CPU_SMOKE else 20,
-                           warmup=2, items_per_step=bs)
+                           warmup=2, items_per_step=bs * k)
+        # the step donates its state arg: the trainer's original buffers
+        # are gone after the first call — hand the live state back so a
+        # later stage (dispatch) doesn't touch deleted arrays.
+        tr.state = box[0]
+    # per-BATCH ms (÷k) so the field stays comparable with host_/place_
+    # ms_per_batch across K; the per-call time is the K-step program's
+    # whole dispatch.
+    ms_per_call = bs * k / stats["items_per_sec"] * 1e3
     return {"step_imgs_per_sec": round(stats["items_per_sec"], 2),
-            "step_ms_per_batch": round(bs / stats["items_per_sec"] * 1e3, 1)}
+            "step_ms_per_batch": round(ms_per_call / k, 1),
+            "step_ms_per_call": round(ms_per_call, 1),
+            "steps_per_dispatch": k}
+
+
+def stage_dispatch(tr: Trainer, batch: dict) -> dict:
+    """Host-blocking cost of issuing one (possibly K-step) train-step call.
+
+    Sync the device first, then time the call itself: JAX dispatch is
+    async, so the timed interval is trace-cache lookup + arg handling +
+    runtime enqueue — pure host work, none of the chip's compute.  This is
+    the term ``data.steps_per_dispatch`` divides by K; if it is already
+    small next to the step's chip time, K-step dispatch has nothing to
+    amortize (and its burstier K-batch consumption can make e2e WORSE on
+    a 1-core host)."""
+    mesh = tr.mesh
+    k = tr.cfg.data.steps_per_dispatch
+    step = tr.multi_train_step if tr.multi_train_step is not None \
+        else tr.train_step
+    with mesh:
+        args = [shard_batch(mesh, batch)] * k
+        box = [tr.state]
+        box[0], out = step(box[0], *args)   # compile
+        jax.device_get(out)
+        reps = 3 if CPU_SMOKE else 15
+        issue = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            box[0], out = step(box[0], *args)
+            issue += time.perf_counter() - t0
+            # drain via device_get, NOT block_until_ready: on the tunneled
+            # platform block_until_ready has been observed returning before
+            # the computation exists anywhere (utils/profiling.throughput's
+            # docstring), which would turn the timed calls into unsynced
+            # back-to-back enqueues and inflate the number toward full step
+            # time once the in-flight limit is hit.  device_get of the loss
+            # output really waits, so each timed call starts on an idle
+            # queue and measures pure enqueue cost.
+            jax.device_get(out)
+        tr.state = box[0]   # state was donated; keep the live one
+    return {"dispatch_ms_per_call": round(issue / reps * 1e3, 2),
+            "dispatch_calls_timed": reps}
 
 
 def main() -> int:
@@ -180,20 +247,29 @@ def main() -> int:
                       max_objects=2, n_val=2, seed=0)
         rec: dict = {"variant": "e2e-fast-path(prepared+devguid+uint8)",
                      "overrides": OVERRIDES, "batch": BATCH}
+        def add(stage_rec: dict) -> None:
+            # incremental: a late-stage crash must not lose earlier
+            # measurements (each partial is a valid JSON line; the last
+            # line printed is the most complete record)
+            rec.update(stage_rec)
+            print(json.dumps(rec), flush=True)
+
         if "host" in STAGES:
-            rec.update(stage_host(fixture, work))
-        if {"place", "step"} & set(STAGES):
+            add(stage_host(fixture, work))
+        if {"place", "step", "dispatch"} & set(STAGES):
             tr = make_trainer(fixture, work, tiny_model=CPU_SMOKE)
             batch = one_host_batch(tr)
             if "place" in STAGES:
-                rec.update(stage_place(tr, batch))
+                add(stage_place(tr, batch))
             if "step" in STAGES:
-                rec.update(stage_step(tr, batch))
+                add(stage_step(tr, batch))
+            if "dispatch" in STAGES:
+                add(stage_dispatch(tr, batch))
             tr.close()
         rates = [v for k, v in rec.items() if k.endswith("imgs_per_sec")]
         if len(rates) > 1:
             rec["ideal_overlap_imgs_per_sec"] = round(min(rates), 2)
-        print(json.dumps(rec), flush=True)
+            print(json.dumps(rec), flush=True)
         return 0
     finally:
         shutil.rmtree(fixture, ignore_errors=True)
