@@ -112,6 +112,7 @@ def build_prepared_post_transform(
     flip: bool = True,
     geom: bool = True,
     uint8_wire: bool = False,
+    packbits: bool = False,
 ) -> T.Compose:
     """The per-epoch random stage downstream of the prepared-sample cache
     (data.prepared_cache): the cache already holds the deterministic
@@ -126,7 +127,9 @@ def build_prepared_post_transform(
     ``ToArray`` — with the uint8 cache upstream, ``concat``/``crop_gt``
     ship to the device at a quarter of the float32 bytes.  The terminal
     ``Keep`` prunes everything the step doesn't consume so ``collate``
-    stops memcpy'ing dead intermediates.
+    stops memcpy'ing dead intermediates.  ``packbits``
+    (data.packbits_masks) additionally ships ``crop_gt`` at 1 bit/pixel
+    (see :class:`~..data.transforms.PackBits`); the compiled step unpacks.
     """
     return T.Compose([
         *([T.RandomHorizontalFlip()] if flip else []),
@@ -134,6 +137,7 @@ def build_prepared_post_transform(
         *_guidance_stage(guidance, alpha, is_val=False),
         T.ToArray(uint8_passthrough=uint8_wire),
         T.Keep(("concat", "crop_gt")),
+        *([T.PackBits(("crop_gt",))] if packbits else []),
     ])
 
 

@@ -717,3 +717,45 @@ class ToArray(Transform):
 
     def __repr__(self):
         return f"ToArray(uint8_passthrough={self.uint8_passthrough})"
+
+
+class PackBits(Transform):
+    """Pack binary uint8 masks to 1 bit/pixel for the wire
+    (``data.packbits_masks``).
+
+    Runs after :class:`ToArray` on the uint8 fast path: a ``(H, W, 1)``
+    uint8 {0,1} mask becomes a flat ``(ceil(H*W/8),)`` uint8 array
+    (``np.packbits``, big-endian bit order — the device side's unpack in
+    ``parallel.step`` mirrors it with MSB-first shifts).  An 8x wire/memcpy
+    cut on the mask tensor, on top of uint8_transfer's 4x: worth it when
+    H2D placement — not host or chip — bounds e2e (measured reality on a
+    sagging tunnel, BASELINE.md round-3 breakdown).  Collate stacks the
+    packed rows to ``(B, P)``; the compiled step unpacks with fused
+    elementwise bit ops.
+    """
+
+    def __init__(self, elems=("crop_gt",)):
+        self.elems = elems
+
+    def __call__(self, sample, rng=None):
+        for key in self.elems:
+            arr = sample.get(key)
+            if arr is None:
+                continue
+            arr = np.asarray(arr)
+            if arr.dtype != np.uint8:
+                raise TypeError(
+                    f"PackBits({key!r}): expected a uint8 {{0,1}} mask "
+                    f"(the data.uint8_transfer wire), got {arr.dtype}")
+            if arr.max(initial=0) > 1:
+                # np.packbits would silently coerce any nonzero byte to
+                # bit 1, losing the "gt strictly binary" contract that the
+                # plain wire's debug assert enforces — fail loudly instead
+                raise ValueError(
+                    f"PackBits({key!r}): mask has values > 1 "
+                    f"(max {arr.max()}); only binary masks pack losslessly")
+            sample[key] = np.packbits(arr.ravel())
+        return sample
+
+    def __repr__(self):
+        return f"PackBits({self.elems})"

@@ -55,6 +55,25 @@ def _to_compute_dtype(batch: Batch) -> dict:
     return {k: (v.astype(jnp.float32) if v.dtype == jnp.uint8 else v)
             for k, v in batch.items()}
 
+
+def _unpack_mask_bits(batch: Batch) -> dict:
+    """Inverse of the host's ``np.packbits`` wire (data.packbits_masks).
+
+    ``crop_gt`` arrives as ``(B, ceil(H*W/8))`` uint8; H and W come
+    statically from the ``concat`` tensor's shape, so everything here is
+    shape-static under jit.  MSB-first shifts mirror np.packbits'
+    big-endian bit order.  The whole unpack is broadcast/bitwise/reshape —
+    XLA fuses it into the mask's first consumer; the win is the 8x smaller
+    H2D transfer that already happened."""
+    packed = batch[TARGET_KEY]
+    h, w = batch[INPUT_KEY].shape[1:3]
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (packed[:, :, None] >> shifts) & jnp.uint8(1)
+    flat = bits.reshape(packed.shape[0], -1)[:, :h * w]
+    out = dict(batch)
+    out[TARGET_KEY] = flat.reshape(packed.shape[0], h, w, 1)
+    return out
+
 #: batch keys consumed by the step — the reference's stringly-typed contract
 #: (``sample['concat']`` / ``sample['crop_gt']``, train_pascal.py:187) made
 #: explicit in one place.
@@ -254,6 +273,7 @@ def make_train_step(
     aux_loss_weight: float = 0.0,
     loss_scale: float = 1.0,
     steps_per_call: int = 1,
+    packbits_masks: bool = False,
 ) -> Callable[..., tuple[TrainState, jax.Array]]:
     """Build the jitted ``(state, batch) -> (state, loss)`` train step.
 
@@ -295,6 +315,10 @@ def make_train_step(
         return loss, new_stats, grads
 
     def step_fn(state: TrainState, batch: Batch):
+        if packbits_masks:
+            # before the dtype pass: the packed row must stay integer for
+            # the bit shifts (data.packbits_masks wire)
+            batch = _unpack_mask_bits(batch)
         batch = _to_compute_dtype(batch)
         rng, new_rng = jax.random.split(state.rng)
         if augment is not None:

@@ -86,6 +86,15 @@ class DataConfig:
                                         # dequantizes on device).  Requires
                                         # prepared_cache (whose arrays are
                                         # uint8-exact by construction).
+    packbits_masks: bool = False        # ship the binary train mask at
+                                        # 1 bit/pixel (np.packbits on the
+                                        # wire, fused bit-ops unpack inside
+                                        # the step) — ~22% fewer wire bytes
+                                        # on top of uint8_transfer; pays
+                                        # when H2D placement bounds e2e
+                                        # (BASELINE.md round-3 breakdown).
+                                        # Instance task + uint8_transfer
+                                        # only.
     decode_cache: int = 0               # decode-once LRU over this many
                                         # images (FFCV-style; instance mode
                                         # revisits an image once per object

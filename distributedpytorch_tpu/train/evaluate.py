@@ -182,15 +182,28 @@ def evaluate(
     }
 
 
-def batch_debug_asserts(batch: Mapping[str, np.ndarray]) -> None:
+def batch_debug_asserts(batch: Mapping[str, np.ndarray],
+                        packed_masks: bool = False) -> None:
     """The reference's per-batch data-contract asserts
     (train_pascal.py:188-190), as an opt-in debug check rather than an
     always-on hot-loop cost: guidance/image channels within [0,255] and
-    non-degenerate, gt strictly binary."""
+    non-degenerate, gt strictly binary.
+
+    With ``packed_masks`` (data.packbits_masks) the mask rides the wire at
+    1 bit/pixel — binary by construction — so the gt check becomes
+    structural: the packed row must be uint8 of exactly ceil(H*W/8) bytes
+    for the batch's spatial shape."""
     x = np.asarray(batch[INPUT_KEY])
     assert x.min() >= 0.0 and x.max() <= 255.0, "input outside [0,255]"
     assert len(np.unique(x[..., :3])) > 2, "degenerate RGB channels"
     gt = np.asarray(batch["crop_gt"])
+    if packed_masks:
+        h, w = x.shape[1:3]
+        expect = (h * w + 7) // 8
+        assert gt.dtype == np.uint8 and gt.shape == (x.shape[0], expect), \
+            f"packed gt shape/dtype off: {gt.shape} {gt.dtype}, " \
+            f"expected ({x.shape[0]}, {expect}) uint8"
+        return
     uniq = np.unique(gt)
     assert np.all(np.isin(uniq, (0.0, 1.0))), f"gt not binary: {uniq[:5]}"
 

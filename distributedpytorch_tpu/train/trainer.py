@@ -163,6 +163,13 @@ class Trainer:
             if err:
                 raise RuntimeError(f"VOC download failed on process 0 "
                                    f"({err})")
+        if cfg.data.packbits_masks and not (
+                cfg.data.uint8_transfer and cfg.task == "instance"):
+            raise ValueError(
+                "data.packbits_masks packs the BINARY instance mask for "
+                "the uint8 wire — it requires task=instance (semantic gt "
+                "is class ids, not bits) and data.uint8_transfer (the "
+                "packed row rides the uint8 fast path)")
         if cfg.data.uint8_transfer and not cfg.data.prepared_cache:
             raise ValueError(
                 "data.uint8_transfer needs data.prepared_cache: only the "
@@ -249,7 +256,8 @@ class Trainer:
                         flip=not cfg.data.device_augment,
                         geom=not (cfg.data.device_augment
                                   and cfg.data.device_augment_geom),
-                        uint8_wire=cfg.data.uint8_transfer))
+                        uint8_wire=cfg.data.uint8_transfer,
+                        packbits=cfg.data.packbits_masks))
         elif cfg.task == "semantic":
             prepared = bool(cfg.data.prepared_cache)
             sem_train_tf = None if prepared else \
@@ -410,7 +418,8 @@ class Trainer:
             loss_type=loss_type, state_shardings=st_sh, augment=augment,
             aux_loss_weight=(cfg.model.moe_aux_weight
                              if cfg.model.moe_experts else 0.0),
-            loss_scale=cfg.optim.loss_scale)
+            loss_scale=cfg.optim.loss_scale,
+            packbits_masks=cfg.data.packbits_masks)
         self.train_step = make_train_step(self.model, self.tx, **step_kwargs)
         #: the K-steps-in-one-dispatch program (data.steps_per_dispatch>1);
         #: epoch-tail remainders run through self.train_step
@@ -639,7 +648,8 @@ class Trainer:
             for batch in self.train_loader:
                 if cfg.debug_asserts:
                     if cfg.task == "instance":
-                        batch_debug_asserts(batch)
+                        batch_debug_asserts(
+                            batch, packed_masks=cfg.data.packbits_masks)
                     else:
                         semantic_batch_debug_asserts(batch, cfg.model.nclass)
                 yield batch

@@ -125,6 +125,21 @@ if __name__ == "__main__":
              score_dtype="bfloat16"),
         dict(batch=16, pam_impl="einsum", block=None, remat=False,
              score_dtype="bfloat16"),
+        # remat: per-block recompute (models/resnet.py nn.remat).  The r3
+        # op profiles say the step runs at ~84% of peak HBM bandwidth with
+        # 43% of MXU idle — remat trades exactly the abundant resource
+        # (FLOPs) for the scarce one (activation HBM round trips between
+        # forward and backward), so it can WIN on wall clock here, not
+        # just on memory.  Variants 13-16 A/B it at b8/b16, alone and
+        # stacked with bf16 scores; 17 probes whether b32 becomes
+        # compilable/competitive once remat shrinks live activations.
+        dict(batch=8, pam_impl="einsum", block=None, remat=True),
+        dict(batch=16, pam_impl="einsum", block=None, remat=True),
+        dict(batch=8, pam_impl="einsum", block=None, remat=True,
+             score_dtype="bfloat16"),
+        dict(batch=16, pam_impl="einsum", block=None, remat=True,
+             score_dtype="bfloat16"),
+        dict(batch=32, pam_impl="einsum", block=None, remat=True),
     ]
     sel = sys.argv[1:]
     for i, v in enumerate(variants):

@@ -528,3 +528,94 @@ class TestTrainerIntegration:
             np.testing.assert_array_equal(got["gt"], want["gt"])
             np.testing.assert_array_equal(got["gt"], got2["gt"])
             assert np.abs(got["image"] - want["image"]).max() <= 0.5
+
+
+class TestPackBitsWire:
+    """data.packbits_masks: 1-bit/pixel mask wire over the uint8 fast path."""
+
+    def test_pack_unpack_roundtrip_exact(self, base, tmp_path):
+        import jax.numpy as jnp
+
+        from distributedpytorch_tpu.parallel.step import _unpack_mask_bits
+        kw = dict(crop_size=(64, 64), relax=10)
+        post_packed = build_prepared_post_transform(
+            guidance="none", flip=False, geom=False, uint8_wire=True,
+            packbits=True)
+        post_plain = build_prepared_post_transform(
+            guidance="none", flip=False, geom=False, uint8_wire=True)
+        dsp = PreparedInstanceDataset(base, str(tmp_path / "pp"),
+                                      post_transform=post_packed,
+                                      uint8_arrays=True, **kw)
+        dsu = PreparedInstanceDataset(base, str(tmp_path / "pu"),
+                                      post_transform=post_plain,
+                                      uint8_arrays=True, **kw)
+        sp = dsp.__getitem__(0, rng=sample_rng(0, 0, 0))
+        su = dsu.__getitem__(0, rng=sample_rng(0, 0, 0))
+        assert sp["crop_gt"].dtype == np.uint8
+        assert sp["crop_gt"].shape == ((64 * 64 + 7) // 8,)
+        batch = {"concat": jnp.asarray(sp["concat"][None]),
+                 "crop_gt": jnp.asarray(sp["crop_gt"][None])}
+        out = _unpack_mask_bits(batch)
+        np.testing.assert_array_equal(
+            np.asarray(out["crop_gt"])[0], su["crop_gt"])
+        # concat untouched
+        assert out["concat"] is batch["concat"]
+
+    def test_unpack_nonmultiple_of_8(self):
+        """H*W % 8 != 0: np.packbits zero-pads the tail; the device unpack
+        must slice it off, not fold it into the mask."""
+        import jax.numpy as jnp
+
+        from distributedpytorch_tpu.parallel.step import _unpack_mask_bits
+        r = np.random.RandomState(0)
+        mask = (r.uniform(size=(2, 5, 5, 1)) > 0.5).astype(np.uint8)
+        packed = np.stack([np.packbits(m.ravel()) for m in mask])
+        batch = {"concat": jnp.zeros((2, 5, 5, 4), jnp.uint8),
+                 "crop_gt": jnp.asarray(packed)}
+        out = _unpack_mask_bits(batch)
+        np.testing.assert_array_equal(np.asarray(out["crop_gt"]), mask)
+
+    def test_trainer_packbits_e2e(self, tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+        from tests.test_train import make_tiny_cfg
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, epochs=1, debug_asserts=True,
+            data=dataclasses.replace(
+                cfg.data, prepared_cache=str(tmp_path / "prep"),
+                uint8_transfer=True, device_guidance=True,
+                packbits_masks=True))
+        tr = Trainer(cfg)
+        history = tr.fit()
+        assert all(np.isfinite(l) for l in history["train_loss"])
+        tr.close()
+
+    def test_packbits_requires_uint8_instance(self, tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+        from tests.test_train import make_tiny_cfg
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        bad = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, packbits_masks=True))
+        with pytest.raises(ValueError, match="packbits_masks"):
+            Trainer(bad)
+
+    def test_packed_loss_matches_unpacked(self, tmp_path):
+        """Same seeds, packed vs plain wire: the training losses must be
+        bitwise-identical — packing is wire format, not semantics."""
+        from distributedpytorch_tpu.train import Trainer
+        from tests.test_train import make_tiny_cfg
+
+        def run(packed: bool, sub: str):
+            cfg = make_tiny_cfg(str(tmp_path / sub))
+            cfg = dataclasses.replace(
+                cfg, epochs=1,
+                data=dataclasses.replace(
+                    cfg.data, prepared_cache=str(tmp_path / f"prep_{sub}"),
+                    uint8_transfer=True, device_guidance=True,
+                    packbits_masks=packed))
+            tr = Trainer(cfg)
+            h = tr.fit()
+            tr.close()
+            return h["train_loss"]
+
+        np.testing.assert_array_equal(run(True, "a"), run(False, "b"))
