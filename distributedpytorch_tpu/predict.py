@@ -192,6 +192,15 @@ def load_run(run_dir: str, best: bool = True, cfg=None):
     template = jax.eval_shape(
         lambda: create_train_state(jax.random.PRNGKey(0), model, tx,
                                    (1, h, w, cfg.model.in_channels)))
+    # Pin every leaf to THIS process's device 0: Orbax needs concrete
+    # shardings on the abstract target whenever the checkpoint's own saved
+    # layout isn't reconstructible here (e.g. a run trained on an 8-device
+    # mesh, loaded in a 1-device export/predict process) — and a single
+    # device is exactly where inference wants the weights anyway.
+    one_dev = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+    template = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=one_dev),
+        template)
     mgr = CheckpointManager(os.path.join(run_dir, "checkpoints"),
                             async_save=False)
     try:
@@ -584,3 +593,71 @@ def predict_cli(run_dir: str, image_path: str, points_spec: str | None,
     return {"task": "instance", "pixels": int(mask.sum()),
             "threshold": threshold, "max_prob": float(prob.max()),
             "out": out_path}
+
+
+# ---------------------------------------------------------------------------
+# Serialized compiled inference (jax.export / StableHLO)
+# ---------------------------------------------------------------------------
+
+def export_serialized(predictor, path: str, batch: int | None = None,
+                      channels: int | None = None,
+                      platforms: Sequence[str] = ("cpu", "tpu")) -> dict:
+    """Serialize a predictor's compiled forward as a portable StableHLO
+    artifact (``jax.export``) — the deployment-artifact story the torch
+    ecosystem gets from TorchScript/ONNX export, done the XLA-native way.
+
+    The artifact freezes weights + graph at the predictor's resolution and
+    channel count and runs WITHOUT this package (any process with jax can
+    :func:`load_serialized` it), on every platform in ``platforms``
+    (multi-platform lowering: one file serves cpu and tpu).
+
+    ``batch=None`` exports with a SYMBOLIC batch dimension — one artifact
+    serves any batch size; pass a concrete int to pin it instead (smaller
+    artifact, and the fallback when a model's ops reject polymorphism).
+
+    Works for both :class:`Predictor` (output: sigmoid probability maps)
+    and :class:`SemanticPredictor` (output: int32 class-id maps); mesh-
+    sharded predictors are refused — GSPMD shardings are a property of
+    this process's mesh, not of a portable artifact.
+    """
+    from jax import export as jax_export
+
+    if getattr(predictor, "mesh", None) is not None:
+        raise ValueError(
+            "export_serialized: predictor was built with mesh=...; "
+            "sharded inference is process-local — build an unsharded "
+            "Predictor for export")
+    ch = channels
+    if ch is None:
+        # the click path feeds RGB + one guidance channel; the semantic
+        # path plain RGB (pipeline contract, prepare_input /
+        # build_semantic_eval_transform) — exotic stems pass channels=
+        ch = 4 if isinstance(predictor, Predictor) else 3
+    if batch is None:
+        (b,) = jax_export.symbolic_shape("b")
+    else:
+        b = int(batch)
+    spec = jax.ShapeDtypeStruct((b, *predictor.resolution, ch),
+                                jnp.float32)
+    exported = jax_export.export(
+        predictor._forward, platforms=list(platforms))(spec)
+    blob = exported.serialize()
+    with open(path, "wb") as f:
+        f.write(blob)
+    return {"path": path, "bytes": len(blob),
+            "input_shape": tuple(str(d) for d in spec.shape),
+            "platforms": tuple(platforms)}
+
+
+def load_serialized(path: str):
+    """Load an :func:`export_serialized` artifact into a callable.
+
+    Pure jax on the consumer side — none of this package's model or config
+    code runs; weights live inside the artifact.  The call is jitted, so
+    repeat invocations at one shape are dispatch-only.
+    """
+    from jax import export as jax_export
+
+    with open(path, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    return jax.jit(exported.call)

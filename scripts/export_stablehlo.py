@@ -1,0 +1,59 @@
+"""Export a training run's compiled inference as a portable StableHLO
+artifact (``jax.export``) — the deployment story: one file, weights +
+graph frozen, loadable by ANY jax process (none of this package's code on
+the consumer side), lowered for cpu AND tpu in the same artifact, batch
+dimension symbolic by default so one artifact serves every batch size.
+
+    python scripts/export_stablehlo.py work/run_0 danet.stablehlo
+    python scripts/export_stablehlo.py work/run_0 out.bin --batch 8 --latest
+
+Consumer side:
+
+    from distributedpytorch_tpu.predict import load_serialized  # or inline:
+    # fn = jax.jit(jax.export.deserialize(open(p,'rb').read()).call)
+    prob = fn(batch)                      # instance: sigmoid maps
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("run_dir")
+    ap.add_argument("out")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="pin the batch dim (default: symbolic 'b')")
+    ap.add_argument("--latest", action="store_true",
+                    help="export the latest checkpoint, not the best")
+    ap.add_argument("--platforms", default="cpu,tpu",
+                    help="comma-separated lowering targets")
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # tracing-only host job
+
+    from distributedpytorch_tpu.predict import (
+        Predictor,
+        SemanticPredictor,
+        export_serialized,
+        load_run_config,
+    )
+
+    cfg = load_run_config(args.run_dir)
+    cls = SemanticPredictor if cfg.task == "semantic" else Predictor
+    pred = cls.from_run(args.run_dir, best=not args.latest, cfg=cfg)
+    info = export_serialized(pred, args.out, batch=args.batch,
+                             platforms=tuple(args.platforms.split(",")))
+    print(json.dumps({"task": cfg.task, **info}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -552,3 +552,52 @@ class TestSlideInstanceGuard:
             predict_mod.predict_cli("unused", str(img_path),
                                     "1,1 2,2 3,3 4,4", str(tmp_path / "o.png"),
                                     slide=True)
+
+
+class TestSerializedExport:
+    """jax.export / StableHLO deployment artifacts (export_serialized)."""
+
+    def test_instance_roundtrip_symbolic_batch(self, tmp_path):
+        from distributedpytorch_tpu.predict import (
+            export_serialized,
+            load_serialized,
+        )
+        _, _, p = _tiny_predictor()
+        path = str(tmp_path / "danet.stablehlo")
+        info = export_serialized(p, path)   # symbolic batch, cpu+tpu
+        assert info["bytes"] > 0 and info["input_shape"][0] == "b"
+        fn = load_serialized(path)
+        r = np.random.RandomState(0)
+        for b in (1, 3):                    # one artifact, several batches
+            x = r.uniform(0, 255, (b, 64, 64, 4)).astype(np.float32)
+            got = np.asarray(fn(x))
+            want = np.asarray(p._forward(x))
+            assert got.shape == want.shape
+            np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_semantic_roundtrip_fixed_batch(self, tmp_path):
+        from distributedpytorch_tpu.predict import (
+            export_serialized,
+            load_serialized,
+        )
+        p = TestSlidingWindow._predictor(TestSlidingWindow())
+        path = str(tmp_path / "deeplab.stablehlo")
+        info = export_serialized(p, path, batch=2)
+        assert info["input_shape"][0] == "2"
+        fn = load_serialized(path)
+        x = np.random.RandomState(1).uniform(
+            0, 255, (2, *p.resolution, 3)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(fn(x)),
+                                      np.asarray(p._forward(x)))
+
+    def test_mesh_predictor_refused(self, tmp_path):
+        import jax
+
+        from distributedpytorch_tpu.parallel import make_mesh
+        from distributedpytorch_tpu.predict import export_serialized
+        model, state, _ = _tiny_predictor()
+        mesh = make_mesh()
+        p = Predictor(model, state.params, state.batch_stats,
+                      resolution=(64, 64), relax=10, mesh=mesh)
+        with pytest.raises(ValueError, match="mesh"):
+            export_serialized(p, str(tmp_path / "x.bin"))
