@@ -251,6 +251,15 @@ class Config:
                                         # back per sample — the standard
                                         # DeepLab protocol) instead of at
                                         # the resized eval crop
+    eval_bf16_probs: bool = True        # semantic full-res/TTA: read the
+                                        # softmax volumes back in bfloat16
+                                        # — halves the dominant D2H cost
+                                        # (~22 MB/image f32 at 513², the
+                                        # measured bound of the full-res
+                                        # protocol on a slow wire); argmax-
+                                        # after-resize is tie-epsilon
+                                        # sensitive only (tested).  false
+                                        # restores exact f32 readback.
     seed: int = 0
     work_dir: str = "runs"              # run_<N> dirs created under this
     resume: str | None = None           # checkpoint dir to resume from, or

@@ -248,6 +248,7 @@ def evaluate_semantic(
     tta_scales: tuple[float, ...] = (),
     tta_flip: bool = False,
     debug_asserts: bool = False,
+    bf16_probs: bool = True,
 ) -> dict:
     """Multi-class semantic validation: confusion-matrix mIoU.
 
@@ -265,6 +266,14 @@ def evaluate_semantic(
     omitting 1.0 does not vote the base pass); ``loss`` always reports the
     plain single-scale pass.  Empty/false = the plain protocol, on the
     unchanged fast path (device-side argmax, no NxC transfer).
+
+    ``bf16_probs`` (config.eval_bf16_probs): the full-res and TTA protocols
+    read whole softmax volumes back to the host — 22 MB/image in f32 at
+    513²/21 classes, the measured bound of the full-res loop on a slow
+    wire (BASELINE.md round-3, e2e row 12).  bf16 on the wire halves that;
+    probabilities are widened back to f32 on host before any resize/
+    averaging arithmetic, so the only effect is one bf16 rounding of each
+    probability — argmax-after-resize tie noise (tested against f32).
     """
     import jax.numpy as jnp
 
@@ -305,6 +314,15 @@ def evaluate_semantic(
     losses: list = []  # device scalars; same deferred-sync policy
     n_samples = 0
     t0 = time.perf_counter()
+    wire_dt = jnp.bfloat16 if bf16_probs else jnp.float32
+
+    def read_probs(dev_probs) -> np.ndarray:
+        """DEVICE softmax volume -> host f32, shipping ``wire_dt`` bytes.
+        The cast must run ON DEVICE, before ``_local_rows`` does the
+        device_get — casting the already-fetched numpy array would pay the
+        bf16 rounding for zero wire savings."""
+        host = _local_rows(dev_probs.astype(wire_dt))
+        return host.astype(np.float32)
 
     def forward_probs(inp: np.ndarray, gt: np.ndarray):
         """One padded+sharded eval pass -> (softmax probs for the n real
@@ -315,7 +333,7 @@ def evaluate_semantic(
         outputs, loss = eval_step(state, padded)
         probs = jax.nn.softmax(
             jnp.asarray(outputs[0]).astype(jnp.float32), axis=-1)
-        return _local_rows(probs)[: inp.shape[0]], loss
+        return read_probs(probs)[: inp.shape[0]], loss
 
     for bi, batch in enumerate(loader):
         if max_batches is not None and bi >= max_batches:
@@ -335,12 +353,12 @@ def evaluate_semantic(
             # Padding repeats real samples; drop them from the counts by
             # scoring only the first n rows (host-local multi-host).
             if "gt_full" in batch:  # native-resolution protocol
-                # softmax on DEVICE before readback (same D2H bytes, no
-                # host-side exp/sum over B*H*W*C stalling the loop)
-                probs_h = _local_rows(jax.nn.softmax(
+                # softmax on DEVICE before readback (no host-side exp/sum
+                # over B*H*W*C stalling the loop; wire_dt bytes cross)
+                probs_h = read_probs(jax.nn.softmax(
                     jnp.asarray(outputs[0]).astype(jnp.float32),
                     axis=-1))[:n]
-                conf += fullres_confusion(np.asarray(probs_h),
+                conf += fullres_confusion(probs_h,
                                           _as_list(batch["gt_full"], n))
             else:
                 out0 = _local_rows(outputs[0])[:n]

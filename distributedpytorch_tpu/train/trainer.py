@@ -818,7 +818,8 @@ class Trainer:
                     nclass=self.cfg.model.nclass, mesh=self.mesh,
                     tta_scales=self.cfg.eval_tta_scales,
                     tta_flip=self.cfg.eval_tta_flip,
-                    debug_asserts=self.cfg.debug_asserts)
+                    debug_asserts=self.cfg.debug_asserts,
+                    bf16_probs=self.cfg.eval_bf16_probs)
             else:
                 metrics = evaluate(
                     self.eval_step, self.state, self.val_loader,

@@ -164,8 +164,13 @@ class TestFullResEval:
             "optim.lr=0.001", "checkpoint.async_save=false", "epochs=1",
             "eval_every=0",  # fit-free: validate() directly
         ]
+        # eval_bf16_probs=false: this pins a pixel-exact protocol identity
+        # (same pixels -> same argmax); the default bf16 wire's tie-epsilon
+        # rounding is covered by TestBf16ProbsWire's tolerance test
         cfg_a = dataclasses.replace(
-            apply_overrides(Config(), base + ["eval_full_res=true"]),
+            apply_overrides(Config(),
+                            base + ["eval_full_res=true",
+                                    "eval_bf16_probs=false"]),
             work_dir=str(tmp_path / "runs_a"))
         cfg_b = dataclasses.replace(
             apply_overrides(Config(), base),
@@ -283,9 +288,13 @@ class TestSemanticTTA:
         tr = self._trained(tmp_path)
         base = evaluate_semantic(tr.eval_step, tr.state, tr.val_loader,
                                  nclass=21, mesh=tr.mesh)
+        # bf16_probs=False: this test pins the VOTE semantics (one 1.0
+        # vote == the fast path); the bf16 wire's tie-epsilon rounding is
+        # covered by its own tolerance test below
         triv = evaluate_semantic(tr.eval_step, tr.state, tr.val_loader,
                                  nclass=21, mesh=tr.mesh,
-                                 tta_scales=(1.0,), tta_flip=False)
+                                 tta_scales=(1.0,), tta_flip=False,
+                                 bf16_probs=False)
         np.testing.assert_array_equal(base["per_class_iou"],
                                       triv["per_class_iou"])
         assert base["miou"] == triv["miou"]
@@ -414,3 +423,81 @@ class TestAuxHead:
         with pytest.raises(ValueError, match="aux_head"):
             build_model("danet", nclass=1, backbone="resnet18",
                         aux_head=True)
+
+
+class TestBf16ProbsWire:
+    """eval_bf16_probs: bf16 D2H of the softmax volumes (full-res/TTA)."""
+
+    def _trained(self, tmp_path, extra=()):
+        cfg = apply_overrides(Config(), [
+            "task=semantic", "data.fake=true", "data.train_batch=4",
+            "data.val_batch=2", "data.crop_size=[64,64]",
+            "mesh.data=4", "mesh.model=2",
+            "model.name=deeplabv3", "model.nclass=21",
+            "model.backbone=resnet18", "model.in_channels=3",
+            "checkpoint.async_save=false", "epochs=1", "eval_every=0",
+            *extra,
+        ])
+        cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+        return Trainer(cfg)
+
+    def test_bf16_tracks_f32_fullres_and_tta(self, tmp_path):
+        from distributedpytorch_tpu.train.evaluate import evaluate_semantic
+
+        tr = self._trained(tmp_path, ["eval_full_res=true"])
+        kw = dict(nclass=21, mesh=tr.mesh, tta_scales=(0.5, 1.0),
+                  tta_flip=True)
+        m16 = evaluate_semantic(tr.eval_step, tr.state, tr.val_loader,
+                                bf16_probs=True, **kw)
+        mf = evaluate_semantic(tr.eval_step, tr.state, tr.val_loader,
+                               bf16_probs=False, **kw)
+        # one bf16 rounding of each probability -> at most tie-epsilon
+        # pixel flips; the aggregate metric must track closely
+        assert m16["miou"] == pytest.approx(mf["miou"], abs=5e-3)
+        assert m16["loss"] == pytest.approx(mf["loss"], rel=1e-6)
+        tr.close()
+
+    def test_config_knob_reaches_eval(self, tmp_path, monkeypatch):
+        # the trainer must FORWARD the knob (a passing validate() alone
+        # can't prove it: both wire dtypes produce a valid miou)
+        import sys
+
+        import distributedpytorch_tpu.train.trainer as trainer_mod
+        # NOT `from ..train import evaluate`: the package re-exports the
+        # evaluate FUNCTION under that name, shadowing the module
+        eval_mod = sys.modules["distributedpytorch_tpu.train.evaluate"]
+        seen = {}
+        real = eval_mod.evaluate_semantic
+
+        def spy(*a, **kw):
+            seen["bf16_probs"] = kw.get("bf16_probs")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(trainer_mod, "evaluate_semantic", spy)
+        tr = self._trained(tmp_path, ["eval_full_res=true",
+                                      "eval_bf16_probs=false"])
+        m = tr.validate(log_panels=False)
+        assert seen["bf16_probs"] is False
+        assert 0.0 <= m["miou"] <= 1.0
+        tr.close()
+
+    def test_bf16_wire_actually_ships_bf16(self, tmp_path, monkeypatch):
+        """The cast must happen ON DEVICE, upstream of the device_get —
+        otherwise the knob pays bf16 rounding for zero wire savings."""
+        import sys
+
+        import jax.numpy as jnp
+        eval_mod = sys.modules["distributedpytorch_tpu.train.evaluate"]
+        dtypes = []
+        real = eval_mod._local_rows
+
+        def spy(arr):
+            if getattr(arr, "ndim", 0) == 4:   # the (B,H,W,C) prob volumes
+                dtypes.append(arr.dtype)
+            return real(arr)
+
+        monkeypatch.setattr(eval_mod, "_local_rows", spy)
+        tr = self._trained(tmp_path, ["eval_full_res=true"])
+        tr.validate(log_panels=False)
+        tr.close()
+        assert dtypes and all(dt == jnp.bfloat16 for dt in dtypes), dtypes
