@@ -125,6 +125,10 @@ WARMUP = 3 if ON_TPU else 1
 #: keeps the reference-like f32 scores until the accuracy side
 #: (convergence run d) justifies flipping it.
 SCORE_DTYPE = os.environ.get("DPTPU_BENCH_SCORE_DTYPE") or None
+#: DPTPU_BENCH_MODEL=deeplabv3 benches BASELINE config 4 (DeepLabV3-R101
+#: os=16, 513², 21-class softmax CE, 3-channel input) with the same
+#: MFU/roofline fields as the flagship.  Default: the flagship DANet.
+BENCH_MODEL = os.environ.get("DPTPU_BENCH_MODEL", "danet")
 
 
 def main() -> None:
@@ -138,23 +142,38 @@ def main() -> None:
 
     mesh = make_mesh()
     n_chips = mesh.devices.size
-    model = build_model("danet", nclass=1, backbone=BACKBONE,
-                        output_stride=8, dtype=DTYPE,
-                        pam_score_dtype=SCORE_DTYPE)
+    semantic = BENCH_MODEL != "danet"
+    size = (SIZE + 1) if semantic and ON_TPU else SIZE  # 513² protocol
+    in_ch, nclass = (3, 21) if semantic else (4, 1)
+    if semantic:
+        # aux_head=True: BASELINE config 4 was measured multi-output
+        # (primary + 0.4-weighted aux CE) — benching without it would be
+        # a different model than the committed 122.6 imgs/s row
+        model = build_model(BENCH_MODEL, nclass=nclass, backbone=BACKBONE,
+                            output_stride=16, dtype=DTYPE, aux_head=True)
+    else:
+        model = build_model("danet", nclass=nclass, backbone=BACKBONE,
+                            output_stride=8, dtype=DTYPE,
+                            pam_score_dtype=SCORE_DTYPE)
     tx = optax.sgd(1e-3, momentum=0.9)
     r = np.random.RandomState(0)
     host_batch = {
-        "concat": r.uniform(0, 255, (BATCH * n_chips, SIZE, SIZE, 4)
+        "concat": r.uniform(0, 255, (BATCH * n_chips, size, size, in_ch)
                             ).astype(np.float32),
-        "crop_gt": (r.uniform(size=(BATCH * n_chips, SIZE, SIZE)) > 0.7
-                    ).astype(np.float32),
+        "crop_gt": (
+            r.randint(0, nclass, (BATCH * n_chips, size, size)
+                      ).astype(np.float32) if semantic else
+            (r.uniform(size=(BATCH * n_chips, size, size)) > 0.7
+             ).astype(np.float32)),
     }
     from distributedpytorch_tpu.utils.profiling import throughput
 
     with mesh:
         state = create_train_state(jax.random.PRNGKey(0), model, tx,
-                                   (1, SIZE, SIZE, 4), mesh=mesh)
-        step = make_train_step(model, tx, mesh=mesh)
+                                   (1, size, size, in_ch), mesh=mesh)
+        step = make_train_step(
+            model, tx, mesh=mesh,
+            loss_type="multi_softmax" if semantic else "multi_sigmoid")
         batch = shard_batch(mesh, host_batch)
         cost = step_cost(step, state, batch)
         flops = cost["flops"]
@@ -176,7 +195,8 @@ def main() -> None:
 
     per_chip = stats["items_per_sec"] / n_chips
     record = {
-        "metric": f"danet_{BACKBONE}_{SIZE}px_b{BATCH}_train_step_throughput",
+        "metric": (f"{BENCH_MODEL}_{BACKBONE}_{size}px_b{BATCH}"
+                   "_train_step_throughput"),
         "value": round(per_chip, 3),
         "unit": "imgs/sec/chip",
         # extra context for the record: a CPU-fallback run is not a TPU number

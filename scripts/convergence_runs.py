@@ -107,10 +107,21 @@ def run(name: str, fixture: str, overrides: dict) -> dict:
     # epochs-to-plateau: first epoch within 1% (relative) of the best
     plateau = next((i for i, v in enumerate(curve) if v >= best * 0.99),
                    None)
-    return {"run": name, "epochs": len(curve), "val_curve": curve,
-            "best": best, "epochs_to_within_1pct_of_best": plateau,
-            "final_train_loss": round(float(hist["train_loss"][-1]), 4)
-            if hist["train_loss"] else None}
+    # epochs = what actually trained; the curve has one point per EVAL
+    # (eval_every may be > 1 — runs e/f), so the plateau index is in
+    # eval-point units and eval_every is recorded for conversion
+    rec = {"run": name, "epochs": cfg.epochs,
+           "eval_every": cfg.eval_every, "evals": len(curve),
+           "val_curve": curve, "best": best,
+           "evals_to_within_1pct_of_best": plateau,
+           "final_train_loss": round(float(hist["train_loss"][-1]), 4)
+           if hist["train_loss"] else None}
+    # semantic runs: pixel accuracy is the floor-free secondary signal —
+    # all-background scores ~the bg pixel fraction; learning lifts it
+    if any("pixel_acc" in m for m in hist["val"]):
+        rec["pixel_acc_curve"] = [round(float(m["pixel_acc"]), 4)
+                                  for m in hist["val"] if "pixel_acc" in m]
+    return rec
 
 
 if __name__ == "__main__":
