@@ -202,7 +202,9 @@ def main() -> None:
         # extra context for the record: a CPU-fallback run is not a TPU number
         "platform": jax.devices()[0].platform,
     }
-    if SCORE_DTYPE:
+    if SCORE_DTYPE and not semantic:
+        # stamped only when it reached the model: the semantic build has
+        # no PAM and silently ignores DPTPU_BENCH_SCORE_DTYPE
         record["pam_score_dtype"] = SCORE_DTYPE
     peak = peak_flops_per_chip()
     if flops is not None:

@@ -23,6 +23,15 @@ from PIL import Image
 from .voc import BASE_DIR
 
 
+def _class_color(cat: int) -> np.ndarray:
+    """Deterministic, well-separated RGB base color for category ``cat``
+    (1..20): hues spaced around the wheel at fixed saturation/value."""
+    import colorsys
+
+    r, g, b = colorsys.hsv_to_rgb((cat - 1) / 20.0, 0.75, 0.9)
+    return np.array([r * 255, g * 255, b * 255], np.float32)
+
+
 def make_fake_voc(
     root: str,
     n_images: int = 6,
@@ -31,11 +40,23 @@ def make_fake_voc(
     n_val: int = 2,
     seed: int = 0,
     void_ring: bool = True,
+    visible_objects: bool = True,
 ) -> str:
     """Create a fake VOC tree under ``root``; returns ``root``.
 
     Image ids are ``fake_000000`` …; the first ``n_images - n_val`` go to the
     ``train`` split, the rest to ``val``.
+
+    ``visible_objects`` paints each object's region with a deterministic
+    class-correlated color (plus texture noise) so the task is LEARNABLE
+    from pixels: segmentation/classification of the regions has real
+    evidence in the image.  The original fixture drew masks over pure
+    blurred noise — objects were invisible, so any pixels-only model's
+    optimum was degenerate: semantic runs c/e/f measured all-background
+    exactly, and the unguided instance run b flatlined at a shape-prior
+    optimum (round-3 convergence artifacts); pass
+    ``visible_objects=False`` to reproduce that adversarial regime
+    deliberately.
     """
     rng = np.random.default_rng(seed)
     voc = os.path.join(root, BASE_DIR)
@@ -52,8 +73,6 @@ def make_fake_voc(
     ids = [f"fake_{i:06d}" for i in range(n_images)]
     for im_id in ids:
         img = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
-        # Smooth it a bit so cubic warps behave like photos, not noise.
-        img = cv2.GaussianBlur(img, (7, 7), 0)
         inst = np.zeros((h, w), dtype=np.uint8)
         cls = np.zeros((h, w), dtype=np.uint8)
         n_obj = int(rng.integers(1, max_objects + 1))
@@ -69,6 +88,13 @@ def make_fake_voc(
                             float(rng.uniform(0, 180)), 0, 360, 1, -1)
             else:
                 cv2.rectangle(shape_mask, (cx - ax, cy - ay), (cx + ax, cy + ay), 1, -1)
+            if visible_objects:
+                # class-correlated appearance: base color + texture noise,
+                # so the region AND its category are inferable from pixels
+                sel = shape_mask == 1
+                tex = (_class_color(cat)
+                       + rng.normal(0.0, 14.0, (int(sel.sum()), 3)))
+                img[sel] = np.clip(tex, 0, 255).astype(np.uint8)
             inst[shape_mask == 1] = obj
             cls[shape_mask == 1] = cat
             if void_ring:
@@ -76,6 +102,9 @@ def make_fake_voc(
                 inst[ring == 1] = 255
                 cls[ring == 1] = 255
 
+        # Smooth so cubic warps behave like photos, not noise (after
+        # drawing: object edges blur a little, like real photographs).
+        img = cv2.GaussianBlur(img, (7, 7), 0)
         Image.fromarray(img).save(os.path.join(dirs["img"], im_id + ".jpg"))
         Image.fromarray(inst).save(os.path.join(dirs["inst"], im_id + ".png"))
         Image.fromarray(cls).save(os.path.join(dirs["cls"], im_id + ".png"))
