@@ -190,9 +190,17 @@ def main() -> int:
         finally:
             if os.path.exists(running_flag):
                 os.remove(running_flag)
-        os.rename(step, step + (".done" if ok else ".fail"))
-        print("[chip_queue] %s -> %s" % (step, "done" if ok else "FAIL"),
-              flush=True)
+        try:
+            os.rename(step, step + (".done" if ok else ".fail"))
+        except FileNotFoundError:
+            # the step file vanished mid-run (an operator renamed/removed
+            # it) — a missing source must not take the whole runner down;
+            # whatever replaced it will be picked up by the next poll
+            print("[chip_queue] %s vanished during run; continuing" % step,
+                  flush=True)
+        else:
+            print("[chip_queue] %s -> %s" % (step, "done" if ok else "FAIL"),
+                  flush=True)
     print("[chip_queue] window elapsed; %d step(s) left"
           % len(pending(args.queue_dir)), flush=True)
     return 0
