@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from .ccnet import CCNet, CrissCrossAttention, RCCAHead
 from .danet import DANet, DANetHead
 from .deeplab import ASPP, DeepLabV3, FCN, FCNHead
 from .encnet import EncNet, EncNetHead, Encoding
@@ -60,6 +61,10 @@ def build_model(
         raise ValueError(
             f"encnet_codes is EncNet-only; model {name!r} does not "
             "support it")
+    if name != "ccnet" and kw.pop("ccnet_recurrence", 2) != 2:
+        raise ValueError(
+            f"ccnet_recurrence is CCNet-only; model {name!r} does not "
+            "support it")
     if name == "danet":
         if kw.pop("aux_head", False):
             raise ValueError("aux_head is a DeepLabV3/FCN/PSPNet option; DANet's "
@@ -101,6 +106,21 @@ def build_model(
             bn_cross_replica_axis=bn_cross_replica_axis,
             **kw,
         )
+    if name == "ccnet":
+        kw["recurrence"] = kw.pop("ccnet_recurrence", 2)
+        if kw["recurrence"] < 1:
+            raise ValueError(
+                f"ccnet_recurrence must be >= 1 (got {kw['recurrence']}): "
+                "R=0 would skip the criss-cross module entirely, creating "
+                "no attention params — a CCNet in name only")
+        return CCNet(
+            nclass=nclass,
+            backbone_depth=depth,
+            output_stride=output_stride or 8,
+            dtype=dtype,
+            bn_cross_replica_axis=bn_cross_replica_axis,
+            **kw,
+        )
     if name == "encnet":
         kw["n_codes"] = kw.pop("encnet_codes", 32)
         return EncNet(
@@ -113,17 +133,20 @@ def build_model(
         )
     raise ValueError(
         f"unknown model: {name!r} (danet | deeplabv3 | deeplabv3plus | fcn "
-        "| pspnet | encnet)")
+        "| pspnet | encnet | ccnet)")
 
 
 __all__ = [
     "ASPP",
+    "CCNet",
+    "CrissCrossAttention",
     "DANet",
     "DANetHead",
     "DeepLabV3",
     "EncNet",
     "EncNetHead",
     "Encoding",
+    "RCCAHead",
     "FCN",
     "FCNHead",
     "PSPNet",

@@ -1,0 +1,150 @@
+"""CCNet — criss-cross attention segmentation model (flax.linen, NHWC).
+
+Sixth model family of the zoo, and the third member of the reference's own
+attention lineage (the reference imports DANet from the PyTorch-Encoding
+family, train_pascal.py:32; CCNet — Huang et al. ICCV'19 — is that
+lineage's memory-light successor).  Where DANet's position attention
+scores every token against every token (N² = (HW)² energies — the
+measured 64 MB HBM tenant of the flagship step, BASELINE.md roofline),
+criss-cross attention scores each position only against its own row and
+column: O(N·(H+W)) energies, with a recurrence of R=2 giving every pixel
+a full-image receptive field through (at most) one intermediate
+criss-cross hop.
+
+TPU notes: the row/column attentions are two batched einsums with a
+softmax over the concatenated (H + W) axis — static shapes, MXU-shaped
+contractions, no gathers; XLA fuses the mask/softmax/cast chain.  At the
+flagship geometry (64×64 tokens) the energy tensor is 16× smaller than
+DANet's N² scores (B·H·W·(H+W) vs B·(HW)²), which is the architectural
+answer to the same HBM-bandwidth bound that ``model.pam_score_dtype``
+attacks numerically.  The recurrence shares one parameter set (the same
+submodule applied R times — the paper's weight-shared RCCA).
+
+Output contract matches the zoo: tuple of input-resolution logit maps,
+primary first (+ optional FCN aux head on c3), so the shared multi-output
+loss, Trainer, and evaluators drive it unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .deeplab import FCNHead, _resize_bilinear
+from .resnet import ResNet, make_norm
+
+
+class CrissCrossAttention(nn.Module):
+    """One criss-cross attention step: each position attends over its row
+    and column; residual-gated like the DANet heads (gamma init 0).
+
+    The column branch's self-energy is masked to -inf so the position
+    itself is counted exactly once (it stays visible through the row
+    branch) — the official implementation's INF trick, done with a static
+    boolean eye instead of an additive INF tensor.
+    """
+
+    reduction: int = 8
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        qk_c = max(c // self.reduction, 1)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        q = conv(qk_c, (1, 1), name="query")(x)
+        k = conv(qk_c, (1, 1), name="key")(x)
+        v = conv(c, (1, 1), name="value")(x)
+
+        # energies: column (same w, over all i') and row (same h, over all
+        # j') — two MXU contractions, no N x N matrix ever exists
+        e_col = jnp.einsum("bijc,bkjc->bijk", q, k)        # (B,H,W,H)
+        e_row = jnp.einsum("bijc,bikc->bijk", q, k)        # (B,H,W,W)
+        # mask the column self (k == i): counted once via the row branch
+        self_mask = jnp.eye(h, dtype=bool)[:, None, :]     # (H,1,H)
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min / 2, e_col.dtype)
+        e_col = jnp.where(self_mask, neg, e_col)
+
+        # softmax over the concatenated (H + W) criss-cross neighborhood,
+        # in f32 (bf16 energies would collapse near-ties; cast back after)
+        att = nn.softmax(
+            jnp.concatenate([e_col, e_row], axis=-1).astype(jnp.float32),
+            axis=-1).astype(self.dtype)
+        a_col, a_row = att[..., :h], att[..., h:]
+
+        out = (jnp.einsum("bijk,bkjc->bijc", a_col, v)
+               + jnp.einsum("bijk,bikc->bijc", a_row, v))
+        gamma = self.param("gamma", nn.initializers.zeros, ())
+        return x + gamma.astype(self.dtype) * out
+
+
+class RCCAHead(nn.Module):
+    """The paper's RCCA module: 3x3 reduce -> R weight-shared criss-cross
+    steps -> 3x3 project -> concat with the input -> bottleneck+dropout."""
+
+    channels: int
+    recurrence: int
+    norm: Any
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+
+        def cbr(y, ch, kernel, name):
+            y = conv(ch, kernel, padding="SAME", name=f"{name}_conv")(y)
+            y = self.norm(name=f"{name}_bn")(y)
+            return nn.relu(y)
+
+        y = cbr(x, self.channels, (3, 3), "reduce")
+        cca = CrissCrossAttention(dtype=self.dtype, name="cca")
+        for _ in range(self.recurrence):   # same module -> shared params
+            y = cca(y)
+        y = cbr(y, self.channels, (3, 3), "project")
+        y = jnp.concatenate([x, y], axis=-1)
+        y = cbr(y, self.channels, (3, 3), "bottleneck")
+        return nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+
+
+class CCNet(nn.Module):
+    """Dilated ResNet + recurrent criss-cross attention head;
+    ``__call__(x, train)`` -> (logits,) or (logits, aux_logits) at input
+    resolution."""
+
+    nclass: int = 21
+    backbone_depth: int = 101
+    output_stride: int = 8
+    head_channels: int = 512
+    recurrence: int = 2          # R=2: full-image receptive field
+    aux_head: bool = False
+    dtype: jnp.dtype = jnp.float32
+    bn_cross_replica_axis: str | None = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        feats = ResNet(
+            depth=self.backbone_depth,
+            output_stride=self.output_stride,
+            dtype=self.dtype,
+            bn_cross_replica_axis=self.bn_cross_replica_axis,
+            remat=self.remat,
+            name="backbone",
+        )(x, train=train)
+        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
+        y = RCCAHead(channels=self.head_channels,
+                     recurrence=self.recurrence, norm=norm,
+                     dtype=self.dtype, name="rcca")(feats["c4"], train=train)
+        y = nn.Conv(self.nclass, (1, 1), dtype=self.dtype,
+                    name="classifier")(y)
+        outs = [_resize_bilinear(y, size)]
+        if self.aux_head:
+            aux = FCNHead(nclass=self.nclass, norm=norm, dtype=self.dtype,
+                          name="aux")(feats["c3"], train=train)
+            outs.append(_resize_bilinear(aux, size))
+        return tuple(outs)

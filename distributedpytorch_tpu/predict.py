@@ -163,7 +163,8 @@ def model_from_config(cfg):
         moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
         moe_capacity_factor=cfg.model.moe_capacity_factor,
         aux_head=cfg.model.aux_head,
-        encnet_codes=getattr(cfg.model, "encnet_codes", 32))
+        encnet_codes=getattr(cfg.model, "encnet_codes", 32),
+        ccnet_recurrence=getattr(cfg.model, "ccnet_recurrence", 2))
 
 
 def load_run(run_dir: str, best: bool = True, cfg=None):

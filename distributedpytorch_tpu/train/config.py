@@ -166,6 +166,9 @@ class ModelConfig:
                                         # loss_weights, e.g. [1.0,0.4])
     encnet_codes: int = 32              # EncNet: context-encoding codebook
                                         # size (the SE branch's codewords)
+    ccnet_recurrence: int = 2           # CCNet: weight-shared criss-cross
+                                        # steps (R=2 = full-image receptive
+                                        # field through one hop)
 
 
 @dataclass

@@ -377,7 +377,8 @@ class Trainer:
             moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
             moe_capacity_factor=cfg.model.moe_capacity_factor,
             aux_head=cfg.model.aux_head,
-            encnet_codes=cfg.model.encnet_codes)
+            encnet_codes=cfg.model.encnet_codes,
+            ccnet_recurrence=cfg.model.ccnet_recurrence)
         steps_per_epoch = len(self.train_loader)  # > 0: guarded above
         # Each loaded batch is stepped data.echo times, so schedules (poly
         # decay, warmup fractions) must span echo x the loader length or
