@@ -19,6 +19,11 @@ between the e2e number and its theoretical ceiling can be attributed:
           time the async enqueue alone).  This is the per-step host cost
           that ``data.steps_per_dispatch`` amortizes; measuring it tells
           whether K-step dispatch can pay on this host at all.  TPU.
+  valhost — the Trainer's VAL loader iterated alone (decode + eval
+          transform + collate; no device).  Val has no prepared cache by
+          design, so this stage names how much of a slow measured val
+          rate (e.g. the 1 img/s semantic row, BASELINE.md) is host-side
+          before any caching work is considered.  CPU-safe.
 
 Under perfect overlap e2e == min(host, place, step); the printed
 ``ideal_overlap_imgs_per_sec`` vs the measured bench_e2e row is the
@@ -51,7 +56,7 @@ from distributedpytorch_tpu.backend_health import (  # noqa: E402
 )
 
 STAGES = [a for a in sys.argv[1:]
-          if a in ("host", "place", "step", "dispatch")]
+          if a in ("host", "place", "step", "dispatch", "valhost")]
 OVERRIDES = [a for a in sys.argv[1:] if "=" in a]
 CPU_SMOKE = "--cpu-smoke" in sys.argv
 if not STAGES:
@@ -144,6 +149,26 @@ def stage_host(fixture: str, work: str) -> dict:
     bs = tr.cfg.data.train_batch
     return {"host_imgs_per_sec": round(epochs * n_batches * bs / dt, 2),
             "host_ms_per_batch": round(dt / (epochs * n_batches) * 1e3, 1)}
+
+
+def stage_valhost(fixture: str, work: str) -> dict:
+    """Val loader alone: decode -> eval transform (incl. ragged full-res
+    gt passthrough when configured) -> collate, two passes."""
+    tr = make_trainer(fixture, work, tiny_model=True)
+    loader = tr.val_loader
+    n = 0
+    for b in loader:       # warm OS page cache like a 2nd-epoch val
+        n += b[next(iter(b))].shape[0] if hasattr(
+            b[next(iter(b))], "shape") else len(b[next(iter(b))])
+    t0 = time.perf_counter()
+    n = 0
+    for b in loader:
+        first = b[next(iter(b))]
+        n += first.shape[0] if hasattr(first, "shape") else len(first)
+    dt = time.perf_counter() - t0
+    tr.close()
+    return {"valhost_imgs_per_sec": round(n / dt, 2),
+            "valhost_ms_per_img": round(dt / max(n, 1) * 1e3, 1)}
 
 
 def stage_place(tr: Trainer, batch: dict) -> dict:
@@ -256,6 +281,8 @@ def main() -> int:
 
         if "host" in STAGES:
             add(stage_host(fixture, work))
+        if "valhost" in STAGES:
+            add(stage_valhost(fixture, work))
         if {"place", "step", "dispatch"} & set(STAGES):
             tr = make_trainer(fixture, work, tiny_model=CPU_SMOKE)
             batch = one_host_batch(tr)
