@@ -86,8 +86,10 @@ def tpu_reachable(timeout_s: int = 240) -> bool:
     return probe is not None and probe.returncode == 0
 
 
-def ensure_backend_or_cpu_fallback() -> bool:
-    """Probe (with retries) and fall back to CPU if the backend stays down.
+def ensure_backend_or_cpu_fallback(
+        recovery_minutes: float | None = None) -> bool:
+    """Probe (with a bounded recovery poll) and fall back to CPU if the
+    backend stays down.
 
     Returns True when the default backend is usable (or the probe was
     skipped), False when the fallback to CPU was taken.  Skipped entirely
@@ -95,27 +97,49 @@ def ensure_backend_or_cpu_fallback() -> bool:
     effect) or ``DPTPU_BENCH_PROBE=0`` (healthy hosts pay a second backend
     init for the probe child; opt out when the accelerator is known good).
 
-    A wedged tunnel has been observed to recover within minutes, and a CPU
-    number can cost a whole benchmark round — so the probe retries
-    (``DPTPU_BENCH_PROBE_RETRIES``, default 3) with a pause in between
-    before giving up.
+    A wedged tunnel has been observed to recover within minutes-to-tens-of-
+    minutes, and a CPU number can cost a whole benchmark round — so instead
+    of a fixed retry count, the probe POLLS until ``recovery_minutes`` of
+    wall clock have elapsed (env ``DPTPU_BENCH_RECOVERY_MINUTES`` overrides;
+    default 2 — a couple of fast-fail probes for interactive scripts.
+    ``bench.py`` passes a much longer window because its output is the
+    round's official record).  Each individual probe stays hard-bounded in
+    a child process, so a wedged backend init cannot take the poller down.
     """
     if os.environ.get("DPTPU_BENCH_PROBE") == "0" or \
             os.environ.get("JAX_PLATFORMS") == "cpu":
         return True
-    try:
-        retries = int(os.environ.get("DPTPU_BENCH_PROBE_RETRIES", "3"))
-    except ValueError:
-        retries = 3
-    retries = max(1, retries)
-    for attempt in range(retries):
+    env_min = os.environ.get("DPTPU_BENCH_RECOVERY_MINUTES")
+    if env_min is not None:
+        try:
+            recovery_minutes = float(env_min)
+        except ValueError:
+            pass
+    elif os.environ.get("DPTPU_BENCH_PROBE_RETRIES") is not None:
+        # Honor the pre-poll knob's contract: N retries spaced ~60 s apart
+        # == an (N-1)-minute window (N=1 -> single probe, fast fallback).
+        try:
+            recovery_minutes = max(
+                0.0,
+                float(os.environ["DPTPU_BENCH_PROBE_RETRIES"]) - 1)
+        except ValueError:
+            pass
+    if recovery_minutes is None:
+        recovery_minutes = 2.0
+    deadline = time.time() + recovery_minutes * 60
+    attempt = 0
+    while True:
+        attempt += 1
         ok, why = accelerator_healthy()
         if ok:
             return True
-        print(f"backend probe: unhealthy ({why}), "
-              f"attempt {attempt + 1}/{retries}", file=sys.stderr)
-        if attempt + 1 < retries:
-            time.sleep(60)
+        remaining = deadline - time.time()
+        print(f"backend probe: unhealthy ({why}), attempt {attempt}, "
+              f"{max(0, remaining) / 60:.1f} min of recovery window left",
+              file=sys.stderr)
+        if remaining <= 0:
+            break
+        time.sleep(min(60.0, max(1.0, remaining)))
     print("backend probe: falling back to CPU", file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
     return False

@@ -1,0 +1,171 @@
+"""The official bench record's wedged-tunnel survival machinery.
+
+Three rounds of the driver's ``BENCH_r{N}.json`` slot recorded a CPU
+fallback because ``bench.py`` gave up on the tunnel after a few probes
+(VERDICT r3 item 1).  Two mechanisms fix that, both tested here host-side:
+
+1. ``ensure_backend_or_cpu_fallback`` now polls the (hard-bounded) health
+   probe until a wall-clock recovery window elapses instead of a fixed
+   retry count.
+2. ``bench.py`` persists every healthy on-chip capture of the default
+   config and REPLAYS it — clearly labeled, age-gated — when the round-end
+   run still lands in a wedged window.
+"""
+
+import json
+import os
+import sys
+import time
+import unittest.mock as mock
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from distributedpytorch_tpu import backend_health  # noqa: E402
+
+
+class TestRecoveryPoll:
+    def _run(self, monkeypatch, health_results, minutes, sleeps):
+        """Drive the poll with mocked health + clock; return (ok, probes)."""
+        monkeypatch.delenv("DPTPU_BENCH_PROBE", raising=False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.delenv("DPTPU_BENCH_RECOVERY_MINUTES", raising=False)
+        clock = [0.0]
+        calls = []
+
+        def fake_healthy(*a, **k):
+            calls.append(clock[0])
+            ok = health_results[min(len(calls) - 1, len(health_results) - 1)]
+            return (ok, "" if ok else "probe failed")
+
+        def fake_sleep(s):
+            sleeps.append(s)
+            clock[0] += s
+
+        with mock.patch.object(backend_health, "accelerator_healthy",
+                               fake_healthy), \
+                mock.patch.object(backend_health.time, "time",
+                                  lambda: clock[0]), \
+                mock.patch.object(backend_health.time, "sleep", fake_sleep):
+            ok = backend_health.ensure_backend_or_cpu_fallback(
+                recovery_minutes=minutes)
+        return ok, len(calls)
+
+    def test_polls_until_recovery_within_window(self, monkeypatch):
+        sleeps = []
+        ok, probes = self._run(
+            monkeypatch, [False, False, False, True], minutes=25,
+            sleeps=sleeps)
+        assert ok and probes == 4
+        assert all(s <= 60 for s in sleeps)
+        assert "JAX_PLATFORMS" not in os.environ
+
+    def test_window_bounds_total_wait_then_cpu_fallback(self, monkeypatch):
+        sleeps = []
+        ok, probes = self._run(monkeypatch, [False], minutes=5,
+                               sleeps=sleeps)
+        assert not ok
+        assert os.environ.get("JAX_PLATFORMS") == "cpu"
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        # ~5 min of 60 s naps, plus the final partial one
+        assert 5 <= probes <= 7
+        assert sum(sleeps) <= 5 * 60 + 60
+
+    def test_env_override_shrinks_window(self, monkeypatch):
+        monkeypatch.delenv("DPTPU_BENCH_PROBE", raising=False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setenv("DPTPU_BENCH_RECOVERY_MINUTES", "0")
+        sleeps = []
+        clock = [0.0]
+        with mock.patch.object(backend_health, "accelerator_healthy",
+                               lambda *a, **k: (False, "down")), \
+                mock.patch.object(backend_health.time, "time",
+                                  lambda: clock[0]), \
+                mock.patch.object(backend_health.time, "sleep",
+                                  sleeps.append):
+            ok = backend_health.ensure_backend_or_cpu_fallback(
+                recovery_minutes=25)
+        assert not ok and sleeps == []
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    def test_legacy_retries_knob_maps_to_window(self, monkeypatch):
+        # DPTPU_BENCH_PROBE_RETRIES=1 was the documented fast-fallback
+        # setting; it must still mean "one probe, no waiting"
+        monkeypatch.delenv("DPTPU_BENCH_PROBE", raising=False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.delenv("DPTPU_BENCH_RECOVERY_MINUTES", raising=False)
+        monkeypatch.setenv("DPTPU_BENCH_PROBE_RETRIES", "1")
+        sleeps = []
+        clock = [0.0]
+        with mock.patch.object(backend_health, "accelerator_healthy",
+                               lambda *a, **k: (False, "down")), \
+                mock.patch.object(backend_health.time, "time",
+                                  lambda: clock[0]), \
+                mock.patch.object(backend_health.time, "sleep",
+                                  sleeps.append):
+            ok = backend_health.ensure_backend_or_cpu_fallback(
+                recovery_minutes=25)
+        assert not ok and sleeps == []
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    def test_skipped_when_cpu_forced(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        with mock.patch.object(backend_health, "accelerator_healthy") as m:
+            assert backend_health.ensure_backend_or_cpu_fallback() is True
+        m.assert_not_called()
+
+
+class TestReplayCapture:
+    def _capture(self, tmp_path, monkeypatch, **over):
+        rec = {"metric": "danet_resnet101_512px_b8_train_step_throughput",
+               "value": 66.5, "unit": "imgs/sec/chip", "platform": "tpu",
+               "mfu_vs_peak": 0.573, "vs_baseline": 0.573,
+               "captured_unix": time.time()}
+        rec.update(over)
+        path = str(tmp_path / "bench_latest_tpu.json")
+        with open(path, "w") as f:
+            json.dump(rec, f)
+        monkeypatch.setattr(bench, "LATEST_TPU_CAPTURE", path)
+        return rec
+
+    def test_fresh_tpu_capture_replays_with_labels(self, tmp_path,
+                                                   monkeypatch):
+        self._capture(tmp_path, monkeypatch)
+        out = bench.try_replay_tpu_capture()
+        assert out is not None
+        assert out["replayed_from_session_capture"] is True
+        assert out["platform"] == "tpu"
+        assert out["capture_age_hours"] < 0.1
+        assert "replayed" in out["note"]
+
+    def test_stale_capture_not_replayed(self, tmp_path, monkeypatch):
+        self._capture(tmp_path, monkeypatch,
+                      captured_unix=time.time() - 48 * 3600)
+        assert bench.try_replay_tpu_capture() is None
+
+    def test_cpu_capture_never_replayed(self, tmp_path, monkeypatch):
+        self._capture(tmp_path, monkeypatch, platform="cpu")
+        assert bench.try_replay_tpu_capture() is None
+
+    def test_malformed_sidecar_degrades_not_crashes(self, tmp_path,
+                                                    monkeypatch):
+        path = tmp_path / "bench_latest_tpu.json"
+        for content in ["[1, 2, 3]", "not json at all",
+                        '{"platform": "tpu", "captured_unix": "soon"}']:
+            path.write_text(content)
+            monkeypatch.setattr(bench, "LATEST_TPU_CAPTURE", str(path))
+            assert bench.try_replay_tpu_capture() is None
+
+    def test_missing_file_is_none(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "LATEST_TPU_CAPTURE",
+                            str(tmp_path / "nope.json"))
+        assert bench.try_replay_tpu_capture() is None
+
+    def test_save_round_trips_and_stamps(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "LATEST_TPU_CAPTURE",
+                            str(tmp_path / "sub" / "latest.json"))
+        bench.save_latest_tpu_capture(
+            {"platform": "tpu", "value": 67.0, "unit": "imgs/sec/chip"})
+        out = bench.try_replay_tpu_capture()
+        assert out is not None and out["value"] == 67.0
+        assert "captured_iso" in out and "captured_unix" in out
