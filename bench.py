@@ -122,6 +122,10 @@ def step_cost(step, state, batch) -> dict:
 # real config on TPU.
 ON_TPU = any(d.platform == "tpu" for d in jax.devices())
 BATCH = 8 if ON_TPU else 2
+#: batch override for the b16 A/Bs (VERDICT r3 item 5) with the same
+#: cost-model/roofline fields as the official record
+if os.environ.get("DPTPU_BENCH_BATCH"):
+    BATCH = int(os.environ["DPTPU_BENCH_BATCH"])
 SIZE = 512 if ON_TPU else 64
 BACKBONE = "resnet101" if ON_TPU else "resnet18"
 DTYPE = "bfloat16" if ON_TPU else "float32"
@@ -133,6 +137,15 @@ WARMUP = 3 if ON_TPU else 1
 #: keeps the reference-like f32 scores until the accuracy side
 #: (convergence run d) justifies flipping it.
 SCORE_DTYPE = os.environ.get("DPTPU_BENCH_SCORE_DTYPE") or None
+#: DPTPU_BENCH_BN_STATS=compute drops flax's f32 promotion of BN batch
+#: statistics (model.bn_fp32_stats=false) — the measured-mechanism A/B for
+#: the convert_reduce_fusion chains (46% of b8 device time, the largest
+#: b16 regression term).
+BN_FP32_STATS = os.environ.get("DPTPU_BENCH_BN_STATS") != "compute"
+#: DPTPU_BENCH_REMAT=1 [+ DPTPU_BENCH_REMAT_POLICY=dots_saveable]: the
+#: explicit-remat-policy A/B against XLA's auto-remat at b16.
+REMAT = os.environ.get("DPTPU_BENCH_REMAT") == "1"
+REMAT_POLICY = os.environ.get("DPTPU_BENCH_REMAT_POLICY") or None
 #: DPTPU_BENCH_MODEL=deeplabv3 benches BASELINE config 4 (DeepLabV3-R101
 #: os=16, 513², 21-class softmax CE, 3-channel input) with the same
 #: MFU/roofline fields as the flagship.  Default: the flagship DANet.
@@ -152,7 +165,9 @@ REPLAY_MAX_AGE_HOURS = 24.0
 
 
 def _is_default_config() -> bool:
-    return BENCH_MODEL == "danet" and not SCORE_DTYPE
+    return (BENCH_MODEL == "danet" and not SCORE_DTYPE
+            and BN_FP32_STATS and not REMAT
+            and not os.environ.get("DPTPU_BENCH_BATCH"))
 
 
 def save_latest_tpu_capture(record: dict) -> None:
@@ -176,8 +191,35 @@ def save_latest_tpu_capture(record: dict) -> None:
     os.replace(tmp, LATEST_TPU_CAPTURE)
 
 
+def _bench_code_changed_since(rev: str | None) -> bool | None:
+    """Did any SOURCE the bench measures change between ``rev`` and the
+    WORKING TREE?
+
+    Scoped to bench.py + the package — snapshot/docs/artifact commits
+    between capture and replay must not invalidate a capture, while any
+    model/step/pipeline change must.  Diffing against the working tree
+    (no HEAD argument) rather than rev..HEAD also catches uncommitted
+    edits — the state this repo usually benches in.  ``None`` = could not
+    determine (no git, unknown rev)."""
+    if not rev:
+        return None
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo, "diff", "--name-only", rev, "--",
+             "bench.py", "distributedpytorch_tpu"],
+            capture_output=True, text=True, timeout=20)
+    except Exception:
+        return None
+    if out.returncode != 0:
+        return None
+    return bool(out.stdout.strip())
+
+
 def try_replay_tpu_capture() -> dict | None:
-    """The saved record if it exists, is a TPU number, and is fresh."""
+    """The saved record if it exists, is a TPU number, is fresh, and the
+    measured code has not changed since the capture."""
     import time as _time
     # One try block around parse AND validation: a malformed sidecar (hand
     # edit, schema drift) must degrade to the ordinary fallback, never crash
@@ -190,6 +232,11 @@ def try_replay_tpu_capture() -> dict | None:
         age_h = (_time.time() - float(rec.get("captured_unix", 0))) / 3600
         if age_h > REPLAY_MAX_AGE_HOURS:
             return None
+        changed = _bench_code_changed_since(rec.get("captured_git_rev"))
+        if changed:
+            # the capture measured different code: a stale number must
+            # never masquerade as the current commit's throughput
+            return None
     except Exception:
         return None
     rec["replayed_from_session_capture"] = True
@@ -197,6 +244,9 @@ def try_replay_tpu_capture() -> dict | None:
     rec["note"] = ("tunnel was wedged at record time after a 25-min "
                    "recovery poll; this is the most recent same-session "
                    "on-chip capture of the identical config, replayed")
+    if changed is None:
+        rec["note"] += (" (code-drift check unavailable; verify "
+                        "captured_git_rev matches)")
     return rec
 
 
@@ -219,16 +269,18 @@ def main() -> None:
     semantic = BENCH_MODEL != "danet"
     size = (SIZE + 1) if semantic and ON_TPU else SIZE  # 513² protocol
     in_ch, nclass = (3, 21) if semantic else (4, 1)
+    common = dict(nclass=nclass, backbone=BACKBONE, dtype=DTYPE,
+                  bn_fp32_stats=BN_FP32_STATS, remat=REMAT,
+                  remat_policy=REMAT_POLICY)
     if semantic:
         # aux_head=True: BASELINE config 4 was measured multi-output
         # (primary + 0.4-weighted aux CE) — benching without it would be
         # a different model than the committed 122.6 imgs/s row
-        model = build_model(BENCH_MODEL, nclass=nclass, backbone=BACKBONE,
-                            output_stride=16, dtype=DTYPE, aux_head=True)
+        model = build_model(BENCH_MODEL, output_stride=16, aux_head=True,
+                            **common)
     else:
-        model = build_model("danet", nclass=nclass, backbone=BACKBONE,
-                            output_stride=8, dtype=DTYPE,
-                            pam_score_dtype=SCORE_DTYPE)
+        model = build_model("danet", output_stride=8,
+                            pam_score_dtype=SCORE_DTYPE, **common)
     tx = optax.sgd(1e-3, momentum=0.9)
     r = np.random.RandomState(0)
     host_batch = {
@@ -280,6 +332,11 @@ def main() -> None:
         # stamped only when it reached the model: the semantic build has
         # no PAM and silently ignores DPTPU_BENCH_SCORE_DTYPE
         record["pam_score_dtype"] = SCORE_DTYPE
+    if not BN_FP32_STATS:
+        record["bn_fp32_stats"] = False
+    if REMAT:
+        record["remat"] = True
+        record["remat_policy"] = REMAT_POLICY
     peak = peak_flops_per_chip()
     if flops is not None:
         record["flops_per_step"] = flops

@@ -141,6 +141,48 @@ def build_prepared_post_transform(
     ])
 
 
+def build_prepared_eval_post_transform(
+    alpha: float = 0.6,
+    guidance: str = "nellipse_gaussians",
+    uint8_wire: bool = False,
+) -> T.Compose:
+    """Per-access stage downstream of the prepared EVAL cache
+    (data.val_prepared): deterministic guidance (``is_val`` semantics,
+    reference train_pascal.py:135-145) + concat + array conversion.  No
+    random stages and no pruning — the cache itself appends the host-side
+    metric keys (full-res ``gt``/``void_pixels``, ``bbox``) afterwards.
+
+    With ``guidance='none'`` (the device-guidance fast path) ``concat`` is
+    the bare uint8 image channels and the jitted eval step synthesizes the
+    4th channel on device from ``crop_gt`` (ops.guidance_device,
+    ``is_val=True`` — bit-exact vs the host at pert=0).
+
+    The terminal ``Keep`` prunes the pre-concat intermediates (crop_image,
+    the guidance map) so ``collate`` stops memcpy'ing them per batch; the
+    cache appends its host-side metric keys AFTER this stage, so they are
+    never at risk here."""
+    return T.Compose([
+        *_guidance_stage(guidance, alpha, is_val=True),
+        T.ToArray(uint8_passthrough=uint8_wire),
+        T.Keep(("concat", "crop_gt", "meta")),
+    ])
+
+
+def build_prepared_semantic_eval_post_transform(
+    uint8_wire: bool = False,
+) -> T.Compose:
+    """Downstream of the prepared semantic cache at VAL: the cache already
+    holds the entire deterministic crop-res eval protocol (resize image
+    cubic + gt nearest + clamp, matching build_semantic_eval_transform up
+    to the cache's uint8 rounding of the image — class ids stay exact), so
+    only the contract rename remains."""
+    return T.Compose([
+        T.Rename({"image": "concat", "gt": "crop_gt"}),
+        T.ToArray(uint8_passthrough=uint8_wire),
+        T.Keep(("concat", "crop_gt", "meta")),
+    ])
+
+
 def build_eval_transform(
     crop_size: tuple[int, int] = (512, 512),
     relax: int = 50,

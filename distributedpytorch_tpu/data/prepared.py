@@ -228,7 +228,9 @@ class PreparedInstanceDataset(_PreparedCacheBase):
     def __init__(self, dataset, cache_dir: str,
                  crop_size=(512, 512), relax: int = 50,
                  zero_pad: bool = True, fused_crop_resize: bool = False,
-                 post_transform=None, uint8_arrays: bool = False):
+                 post_transform=None, uint8_arrays: bool = False,
+                 eval_protocol: bool = False,
+                 max_im_size=(512, 512)):
         if getattr(dataset, "transform", None) is not None:
             raise ValueError(
                 "PreparedInstanceDataset wraps the *untransformed* dataset "
@@ -245,6 +247,14 @@ class PreparedInstanceDataset(_PreparedCacheBase):
         #: transforms downstream are uint8-safe: flip, the uint8-casting
         #: warp, guidance-from-binary-mask)
         self.uint8_arrays = bool(uint8_arrays)
+        #: eval mode (data.val_prepared): additionally cache the FULL-RES
+        #: gt and void masks as packed bits (1 bit/pixel, padded rows of
+        #: ceil(max_h*max_w/8) bytes) so the threshold-swept paste-back
+        #: metric (reference train_pascal.py:280-291) never re-decodes the
+        #: source PNGs; __getitem__ then emits the evaluator's host-side
+        #: keys (``gt``/``void_pixels``/``bbox``) alongside the wire keys.
+        self.eval_protocol = bool(eval_protocol)
+        self.max_im_size = tuple(int(v) for v in max_im_size)
 
         # THE shared crop front (pipeline.build_crop_stage): one definition
         # keeps the cached bytes from diverging from the live pipeline.
@@ -255,7 +265,10 @@ class PreparedInstanceDataset(_PreparedCacheBase):
 
         self.fingerprint = cache_fingerprint(
             dataset, self.crop_size, relax, zero_pad, fused_crop_resize)
-        self.cache_dir = os.path.join(cache_dir, self.fingerprint)
+        # eval caches live beside the train cache, never aliased: same
+        # fingerprint inputs but an extra layout (full-res bit rows)
+        suffix = "-eval" if self.eval_protocol else ""
+        self.cache_dir = os.path.join(cache_dir, self.fingerprint + suffix)
         self._open_or_create()
 
     # -- cache files ---------------------------------------------------------
@@ -264,20 +277,29 @@ class PreparedInstanceDataset(_PreparedCacheBase):
         n = len(self.dataset)
         h, w = self.crop_size
         self._npack = (h * w + 7) // 8
-        self._maps = _open_maps(
-            self.cache_dir,
-            {"format": _FORMAT_VERSION, "fingerprint": self.fingerprint,
-             "n": n, "crop_size": [h, w]},
-            self._layout(n, h, w))
+        mh, mw = self.max_im_size
+        self._npack_full = (mh * mw + 7) // 8
+        meta = {"format": _FORMAT_VERSION, "fingerprint": self.fingerprint,
+                "n": n, "crop_size": [h, w]}
+        if self.eval_protocol:
+            meta["eval"] = True
+            meta["max_im_size"] = [mh, mw]
+        self._maps = _open_maps(self.cache_dir, meta, self._layout(n, h, w))
 
     def _layout(self, n, h, w):
-        return [
+        layout = [
             ("images.u8", (n, h, w, 3), np.uint8),
             ("masks.u8", (n, self._npack), np.uint8),
             ("bboxes.i64", (n, 4), np.int64),
             ("sizes.i32", (n, 2), np.int32),
             ("valid.u8", (n,), np.uint8),
         ]
+        if self.eval_protocol:
+            layout += [
+                ("fullgt.u8", (n, self._npack_full), np.uint8),
+                ("fullvoid.u8", (n, self._npack_full), np.uint8),
+            ]
+        return layout
 
     # -- dataset protocol: pickling/len/ids/prebuild/flush in the base ------
 
@@ -295,6 +317,21 @@ class PreparedInstanceDataset(_PreparedCacheBase):
         bbox = np.asarray(sample["bbox"], np.int64)
         im_size = raw["meta"]["im_size"] if "meta" in raw \
             else raw["image"].shape[:2]
+        if self.eval_protocol:
+            fh, fw = (int(v) for v in im_size)
+            if fh * fw > self.max_im_size[0] * self.max_im_size[1]:
+                raise ValueError(
+                    f"source image {fh}x{fw} exceeds the eval cache's "
+                    f"max_im_size {self.max_im_size}; raise max_im_size "
+                    "(row bytes scale with it)")
+            for key, src in (("fullgt.u8", raw["gt"]),
+                             ("fullvoid.u8", raw.get("void_pixels"))):
+                row = np.zeros(self._npack_full, np.uint8)
+                if src is not None:
+                    packed = np.packbits(
+                        np.asarray(src).reshape(-1) > 0.5)
+                    row[:packed.size] = packed
+                self._maps[key][index] = row
         self._maps["images.u8"][index] = img8
         self._maps["masks.u8"][index] = bits
         self._maps["bboxes.i64"][index] = bbox
@@ -314,7 +351,11 @@ class PreparedInstanceDataset(_PreparedCacheBase):
             if not (img8.any() and bits.any()
                     and bbox.any()
                     and bbox[2] >= bbox[0] and bbox[3] >= bbox[1]
-                    and im_size[0] > 0 and im_size[1] > 0):
+                    and im_size[0] > 0 and im_size[1] > 0
+                    # eval rows: full-res gt always has object pixels
+                    # (area filter); fullvoid may legitimately be empty
+                    and (not self.eval_protocol
+                         or self._maps["fullgt.u8"][index].any())):
                 # Torn write from a crashed filler: the valid byte landed
                 # but a row is still zeros — and each array lives in its own
                 # file whose dirty pages persist independently, so ANY row
@@ -344,6 +385,16 @@ class PreparedInstanceDataset(_PreparedCacheBase):
         # key and would mangle a 4-vector of coordinates (in the uncached
         # pipeline the crop — and hence bbox — comes after them).
         sample["bbox"] = bbox
+        if self.eval_protocol:
+            # host-side metric keys (never shipped): full-res masks from
+            # the packed rows.  uint8 0/1 — np_jaccard bools them and the
+            # paste-back only thresholds, so the cheap dtype is exact.
+            fh, fw = im_size
+            for key, src in (("gt", "fullgt.u8"),
+                             ("void_pixels", "fullvoid.u8")):
+                sample[key] = np.unpackbits(
+                    np.asarray(self._maps[src][index]),
+                    count=fh * fw).reshape(fh, fw)
         return sample
 
     def _meta(self, index: int, im_size: tuple[int, int]) -> dict:
@@ -366,7 +417,8 @@ class PreparedInstanceDataset(_PreparedCacheBase):
         return meta
 
     def __str__(self) -> str:
-        return (f"Prepared({self.dataset},crop={self.crop_size},"
+        kind = "PreparedEval" if self.eval_protocol else "Prepared"
+        return (f"{kind}({self.dataset},crop={self.crop_size},"
                 f"relax={self.relax},fp={self.fingerprint})")
 
 

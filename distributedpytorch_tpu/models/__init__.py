@@ -32,6 +32,7 @@ def build_model(
     output_stride: int | None = None,
     dtype: str | jnp.dtype = jnp.float32,
     bn_cross_replica_axis: str | None = None,
+    bn_fp32_stats: bool = True,
     **kw,
 ):
     """Construct a segmentation model by name.
@@ -76,6 +77,7 @@ def build_model(
             output_stride=output_stride or 8,
             dtype=dtype,
             bn_cross_replica_axis=bn_cross_replica_axis,
+            bn_fp32_stats=bn_fp32_stats,
             **kw,
         )
     if name in ("deeplabv3", "deeplabv3plus"):
@@ -86,6 +88,7 @@ def build_model(
             decoder=(name == "deeplabv3plus"),
             dtype=dtype,
             bn_cross_replica_axis=bn_cross_replica_axis,
+            bn_fp32_stats=bn_fp32_stats,
             **kw,
         )
     if name == "fcn":
@@ -95,6 +98,7 @@ def build_model(
             output_stride=output_stride or 8,
             dtype=dtype,
             bn_cross_replica_axis=bn_cross_replica_axis,
+            bn_fp32_stats=bn_fp32_stats,
             **kw,
         )
     if name == "pspnet":
@@ -104,6 +108,7 @@ def build_model(
             output_stride=output_stride or 8,
             dtype=dtype,
             bn_cross_replica_axis=bn_cross_replica_axis,
+            bn_fp32_stats=bn_fp32_stats,
             **kw,
         )
     if name == "ccnet":
@@ -119,6 +124,7 @@ def build_model(
             output_stride=output_stride or 8,
             dtype=dtype,
             bn_cross_replica_axis=bn_cross_replica_axis,
+            bn_fp32_stats=bn_fp32_stats,
             **kw,
         )
     if name == "encnet":
@@ -129,6 +135,7 @@ def build_model(
             output_stride=output_stride or 8,
             dtype=dtype,
             bn_cross_replica_axis=bn_cross_replica_axis,
+            bn_fp32_stats=bn_fp32_stats,
             **kw,
         )
     raise ValueError(

@@ -123,7 +123,9 @@ class CCNet(nn.Module):
     aux_head: bool = False
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
+    bn_fp32_stats: bool = True  # False: BN stats in compute dtype (see make_norm)
     remat: bool = False
+    remat_policy: str | None = None  # jax.checkpoint_policies name (see ResNet)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -133,10 +135,13 @@ class CCNet(nn.Module):
             output_stride=self.output_stride,
             dtype=self.dtype,
             bn_cross_replica_axis=self.bn_cross_replica_axis,
+            bn_fp32_stats=self.bn_fp32_stats,
             remat=self.remat,
+            remat_policy=self.remat_policy,
             name="backbone",
         )(x, train=train)
-        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
+        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis,
+                 fp32_stats=self.bn_fp32_stats)
         y = RCCAHead(channels=self.head_channels,
                      recurrence=self.recurrence, norm=norm,
                      dtype=self.dtype, name="rcca")(feats["c4"], train=train)

@@ -234,12 +234,14 @@ class DANet(nn.Module):
     output_stride: int = 8
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
+    bn_fp32_stats: bool = True  # False: BN stats in compute dtype (see make_norm)
     pam_block_size: int | None = None
     pam_impl: str = "einsum"  # einsum | flash | ring (sequence-parallel)
     pam_sp_mesh: Any = None   # ring: mesh whose axis shards the tokens
     pam_sp_axis: str = "model"
     pam_score_dtype: Any = None  # einsum: N x N score materialization dtype
     remat: bool = False
+    remat_policy: str | None = None  # jax.checkpoint_policies name (see ResNet)
     moe_experts: int = 0      # >0: MoE FFN in the head (see DANetHead)
     moe_hidden: int | None = None
     moe_k: int = 1
@@ -253,10 +255,13 @@ class DANet(nn.Module):
             output_stride=self.output_stride,
             dtype=self.dtype,
             bn_cross_replica_axis=self.bn_cross_replica_axis,
+            bn_fp32_stats=self.bn_fp32_stats,
             remat=self.remat,
+            remat_policy=self.remat_policy,
             name="backbone",
         )(x, train=train)
-        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
+        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis,
+                 fp32_stats=self.bn_fp32_stats)
         outs = DANetHead(
             nclass=self.nclass,
             norm=norm,

@@ -128,7 +128,9 @@ class FCN(nn.Module):
     aux_head: bool = False
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
+    bn_fp32_stats: bool = True  # False: BN stats in compute dtype (see make_norm)
     remat: bool = False
+    remat_policy: str | None = None  # jax.checkpoint_policies name (see ResNet)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -138,10 +140,13 @@ class FCN(nn.Module):
             output_stride=self.output_stride,
             dtype=self.dtype,
             bn_cross_replica_axis=self.bn_cross_replica_axis,
+            bn_fp32_stats=self.bn_fp32_stats,
             remat=self.remat,
+            remat_policy=self.remat_policy,
             name="backbone",
         )(x, train=train)
-        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
+        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis,
+                 fp32_stats=self.bn_fp32_stats)
         y = FCNHead(nclass=self.nclass, norm=norm, dtype=self.dtype,
                     name="head")(feats["c4"], train=train)
         outs = [_resize_bilinear(y, size)]
@@ -164,7 +169,9 @@ class DeepLabV3(nn.Module):
     decoder: bool = False     # True = DeepLabV3+ (low-level c1 skip fusion)
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
+    bn_fp32_stats: bool = True  # False: BN stats in compute dtype (see make_norm)
     remat: bool = False
+    remat_policy: str | None = None  # jax.checkpoint_policies name (see ResNet)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -177,10 +184,13 @@ class DeepLabV3(nn.Module):
             multi_grid=(1, 2, 4),
             dtype=self.dtype,
             bn_cross_replica_axis=self.bn_cross_replica_axis,
+            bn_fp32_stats=self.bn_fp32_stats,
             remat=self.remat,
+            remat_policy=self.remat_policy,
             name="backbone",
         )(x, train=train)
-        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
+        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis,
+                 fp32_stats=self.bn_fp32_stats)
         y = ASPP(channels=self.aspp_channels, rates=rates, norm=norm,
                  dtype=self.dtype, name="aspp")(feats["c4"], train=train)
         if self.decoder:

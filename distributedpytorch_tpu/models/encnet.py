@@ -142,7 +142,9 @@ class EncNet(nn.Module):
     aux_head: bool = False
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
+    bn_fp32_stats: bool = True  # False: BN stats in compute dtype (see make_norm)
     remat: bool = False
+    remat_policy: str | None = None  # jax.checkpoint_policies name (see ResNet)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -152,10 +154,13 @@ class EncNet(nn.Module):
             output_stride=self.output_stride,
             dtype=self.dtype,
             bn_cross_replica_axis=self.bn_cross_replica_axis,
+            bn_fp32_stats=self.bn_fp32_stats,
             remat=self.remat,
+            remat_policy=self.remat_policy,
             name="backbone",
         )(x, train=train)
-        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
+        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis,
+                 fp32_stats=self.bn_fp32_stats)
         logits, se_logits = EncNetHead(
             nclass=self.nclass, norm=norm, n_codes=self.n_codes,
             dtype=self.dtype, name="head")(feats["c4"], train=train)

@@ -46,9 +46,19 @@ def make_norm(
     dtype: jnp.dtype = jnp.float32,
     cross_replica_axis: str | None = None,
     momentum: float = 0.9,
+    fp32_stats: bool = True,
 ) -> ModuleDef:
     """BatchNorm factory: per-replica stats by default (the reference's
-    ``sync_bn=False``), cross-replica when an axis name is given."""
+    ``sync_bn=False``), cross-replica when an axis name is given.
+
+    ``fp32_stats=False`` computes batch statistics in the compute dtype
+    instead of flax's float32 promotion (``force_float32_reductions``).
+    The op profiles attribute 46% of the b8 flagship's device time — and
+    the b16 regression's largest term — to bf16→f32 convert+reduce chains
+    riding the conv fusions (BASELINE.md batch autopsy); this is the
+    measured-mechanism A/B.  Accuracy: bf16 mean/var over >=8·64² elements
+    loses ~2-3 decimal digits; gate on a convergence check before
+    defaulting."""
     return partial(
         nn.BatchNorm,
         use_running_average=not train,
@@ -56,6 +66,7 @@ def make_norm(
         epsilon=1e-5,
         dtype=dtype,
         axis_name=cross_replica_axis,
+        force_float32_reductions=fp32_stats,
     )
 
 
@@ -155,12 +166,23 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: jnp.dtype = jnp.float32
     bn_cross_replica_axis: str | None = None
+    bn_fp32_stats: bool = True  # False: BN stats in compute dtype (see make_norm)
     deep_stem: bool = False  # 3x 3x3 stem (encoding-style) vs single 7x7
     remat: bool = False  # rematerialize blocks: trade FLOPs for HBM
+    #: with remat: a jax.checkpoint_policies name ('dots_saveable',
+    #: 'dots_with_no_batch_dims_saveable', ...) instead of full recompute.
+    #: Rationale (BASELINE.md b16 autopsy): XLA AUTO-rematerializes under
+    #: HBM pressure at b16 with its own op choice; full per-block remat
+    #: measured -13.5% there because the recompute re-reads more HBM than
+    #: the stash it saves.  'dots_saveable' keeps conv/matmul outputs and
+    #: recomputes only the cheap elementwise/BN chains — the explicit
+    #: pre-emption VERDICT r3 item 5 asks to A/B.
+    remat_policy: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis)
+        norm = make_norm(train, self.dtype, self.bn_cross_replica_axis,
+                 fp32_stats=self.bn_fp32_stats)
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         block_cls = (
             BottleneckBlock if self.depth in BOTTLENECK_DEPTHS else BasicBlock
@@ -173,7 +195,12 @@ class ResNet(nn.Module):
             # jax.checkpoint per residual block: the backward pass recomputes
             # each block's activations instead of holding all ~100 of them in
             # HBM — the standard way to fit bigger batches/crops per chip.
-            block_cls = nn.remat(block_cls)
+            policy = None
+            if self.remat_policy:
+                import jax
+
+                policy = getattr(jax.checkpoint_policies, self.remat_policy)
+            block_cls = nn.remat(block_cls, policy=policy)
         counts = RESNET_DEPTHS[self.depth]
         strides, dilations = _stage_plan(self.output_stride)
 

@@ -75,6 +75,56 @@ def np_jaccard(pred: np.ndarray, gt: np.ndarray, void: np.ndarray | None = None)
     return 1.0 if union == 0 else inter / union
 
 
+def np_jaccard_thresholds(
+    prob: np.ndarray,
+    thresholds,
+    gt: np.ndarray,
+    void: np.ndarray | None = None,
+) -> np.ndarray:
+    """Threshold-swept IoU in ONE pass over the image.
+
+    The reference protocol scores ``prob > t`` for each t in {0.3, 0.5,
+    0.8} (train_pascal.py:281-291); the naive form walks the full-res
+    image once per threshold.  Digitizing ``prob`` against the sorted
+    thresholds instead gives every threshold's intersection/union from two
+    bin-counts via suffix sums — the host paste-back loop's scoring cost
+    stops scaling with ``len(thresholds)``.
+
+    Exact equality semantics match ``prob > t`` (strict): bin index k
+    counts thresholds strictly below the value, so a pixel AT a threshold
+    is not predicted positive for it.  Returns IoUs in the CALLER'S
+    threshold order.
+    """
+    prob = np.asarray(prob)
+    # thresholds must compare in PROB's dtype: ``prob > 0.3`` on float32
+    # casts the scalar to float32 (0.3f != 0.3), so a float64 threshold
+    # table here would flip at-threshold pixels relative to the naive form
+    t = np.asarray(thresholds, dtype=prob.dtype if
+                   np.issubdtype(prob.dtype, np.floating) else np.float64)
+    order = np.argsort(t, kind="stable")
+    ts = t[order]
+    k = ts.size
+    gt = gt.astype(bool).ravel()
+    valid = np.ones_like(gt) if void is None \
+        else ~np.asarray(void).astype(bool).ravel()
+    # searchsorted 'left': #(ts < x); pred for threshold j  <=>  bin > j
+    bins = np.searchsorted(ts, prob.ravel(), side="left")
+    gt_counts = np.bincount(bins[gt & valid], minlength=k + 1)
+    ngt_counts = np.bincount(bins[~gt & valid], minlength=k + 1)
+    # suffix sums over bins j+1..k = counts where pred_j is True
+    inter = np.cumsum(gt_counts[::-1])[::-1]        # inter[j+1..] summed
+    pred_only = np.cumsum(ngt_counts[::-1])[::-1]
+    n_gt_valid = int(gt_counts.sum())
+    out = np.empty(k)
+    for j in range(k):
+        i = int(inter[j + 1])
+        u = n_gt_valid + int(pred_only[j + 1])
+        out[j] = 1.0 if u == 0 else i / u
+    inv = np.empty(k, np.intp)
+    inv[order] = np.arange(k)
+    return out[inv]
+
+
 # ---------------------------------------------------------------------------
 # multi-class semantic metrics (the DeepLabV3 "val mIoU" of BASELINE.md)
 # ---------------------------------------------------------------------------

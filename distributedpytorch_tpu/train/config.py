@@ -95,6 +95,32 @@ class DataConfig:
                                         # (BASELINE.md round-3 breakdown).
                                         # Instance task + uint8_transfer
                                         # only.
+    val_prepared: bool = True           # when prepared_cache is set, serve
+                                        # the crop-res VAL protocol from a
+                                        # prepared cache too (eval is fully
+                                        # deterministic, so the WHOLE
+                                        # per-epoch decode→crop→resize(→
+                                        # guidance) front caches; instance
+                                        # mode also caches full-res gt/void
+                                        # as packed bits for the paste-back
+                                        # metric).  With uint8_transfer the
+                                        # val wire ships uint8 as well.
+                                        # SEMANTICS: the cached val image
+                                        # is uint8-rounded (same <=0.5/255
+                                        # perturbation the train cache
+                                        # makes; masks/bboxes bit-exact),
+                                        # so val metrics move ~1e-3 vs the
+                                        # plain path — set false for
+                                        # bit-exact protocol comparisons.
+                                        # The semantic full-res protocol
+                                        # (eval_full_res) keeps the plain
+                                        # ragged path.
+    val_max_im_size: tuple[int, int] = (512, 512)
+                                        # eval-cache budget for the packed
+                                        # full-res mask rows (instance
+                                        # val_prepared): raise for datasets
+                                        # with images larger than VOC's
+                                        # 500px sides
     decode_cache: int = 0               # decode-once LRU over this many
                                         # images (FFCV-style; instance mode
                                         # revisits an image once per object
@@ -128,6 +154,17 @@ class ModelConfig:
     backbone: str = "resnet101"
     output_stride: int | None = None
     in_channels: int = 4                # RGB + guidance heatmap
+    remat_policy: str = ""              # with model.remat: a jax.
+                                        # checkpoint_policies name (e.g.
+                                        # dots_saveable — keep conv/matmul
+                                        # outputs, recompute elementwise/BN
+                                        # chains) instead of full recompute
+    bn_fp32_stats: bool = True          # False: BN batch stats in the
+                                        # compute dtype (bf16) instead of
+                                        # flax's f32 promotion — the A/B
+                                        # for the convert+reduce chains the
+                                        # op profiles blame for the b16
+                                        # regression (BASELINE.md)
     dtype: str = "float32"              # 'bfloat16' = BASELINE config 3
     loss_weights: tuple[float, ...] | None = None
     pam_block_size: int | None = None   # blocked position-attention

@@ -196,6 +196,9 @@ class Trainer:
                 raise ValueError(
                     f"data.device_guidance supports {_DEV_FAM}, not "
                     f"{cfg.data.guidance!r}")
+        #: set by the instance branch when the prepared val wire ships
+        #: 3-channel batches and the eval step owns guidance synthesis
+        self._val_device_guidance = False
         if cfg.task == "instance":
             prepared = bool(cfg.data.prepared_cache)
             # Prepared cache owns the deterministic crop stage itself; the
@@ -212,7 +215,14 @@ class Trainer:
                 geom=not (cfg.data.device_augment
                           and cfg.data.device_augment_geom),
                 fused_crop_resize=cfg.data.fused_crop_resize)
-            val_tf = build_eval_transform(
+            #: val fast path (data.val_prepared): eval is deterministic end
+            #: to end, so the whole per-epoch val front caches — decode,
+            #: crop, resize, full-res metric masks; with device_guidance
+            #: the wire also drops to 3-channel uint8 and the jitted eval
+            #: step appends the guidance channel (is_val semantics).
+            val_prep = prepared and cfg.data.val_prepared
+            self._val_device_guidance = val_prep and cfg.data.device_guidance
+            val_tf = None if val_prep else build_eval_transform(
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                 zero_pad=cfg.data.zero_pad, alpha=cfg.data.guidance_alpha,
                 guidance=cfg.data.guidance)
@@ -225,6 +235,22 @@ class Trainer:
                 root, split=cfg.data.val_split, transform=val_tf,
                 preprocess=True, area_thres=cfg.data.area_thres,
                 decode_cache=cfg.data.decode_cache)
+            if val_prep:
+                from ..data import PreparedInstanceDataset
+                from ..data.pipeline import build_prepared_eval_post_transform
+                self.val_set = PreparedInstanceDataset(
+                    self.val_set, cfg.data.prepared_cache,
+                    crop_size=cfg.data.crop_size, relax=cfg.data.relax,
+                    zero_pad=cfg.data.zero_pad,
+                    fused_crop_resize=cfg.data.fused_crop_resize,
+                    uint8_arrays=cfg.data.uint8_transfer,
+                    eval_protocol=True,
+                    max_im_size=cfg.data.val_max_im_size,
+                    post_transform=build_prepared_eval_post_transform(
+                        alpha=cfg.data.guidance_alpha,
+                        guidance=("none" if cfg.data.device_guidance
+                                  else cfg.data.guidance),
+                        uint8_wire=cfg.data.uint8_transfer))
             if cfg.data.sbd_root:
                 # the reference's use_sbd recipe (train_pascal.py:150-154),
                 # live: merge SBD train+val, drop its VOC-val overlap
@@ -275,11 +301,35 @@ class Trainer:
             # Built before the SBD merge so the merge can exclude its
             # overlap (SBD train covers most of VOC val — the standard
             # "train_aug" recipe needs the exclusion).
+            #
+            # val fast path (data.val_prepared): the crop-res protocol's
+            # entire val front (decode → resize → clamp) is deterministic
+            # and identical to the prepared cache's stage1, so serve val
+            # from a prepared cache too — with uint8_transfer the 25 MB f32
+            # val batches (the measured 1 img/s semantic-val wire,
+            # BASELINE.md ‡) drop to uint8.  The full-res protocol keeps
+            # the plain ragged path (per-image sizes cannot be cached
+            # fixed-shape).
+            sem_val_prep = (prepared and cfg.data.val_prepared
+                            and not cfg.eval_full_res)
             self.val_set = VOCSemanticSegmentation(
                 root, split=cfg.data.val_split,
-                transform=build_semantic_eval_transform(
+                transform=None if sem_val_prep else
+                build_semantic_eval_transform(
                     crop_size=cfg.data.crop_size,
                     keep_fullres=cfg.eval_full_res))
+            if sem_val_prep:
+                from ..data.pipeline import (
+                    build_prepared_semantic_eval_post_transform,
+                )
+                from ..data.prepared import PreparedSemanticDataset
+                self.val_set = PreparedSemanticDataset(
+                    self.val_set, cfg.data.prepared_cache,
+                    crop_size=cfg.data.crop_size,
+                    uint8_arrays=cfg.data.uint8_transfer,
+                    post_transform=(
+                        build_prepared_semantic_eval_post_transform(
+                            uint8_wire=cfg.data.uint8_transfer)))
             if cfg.data.sbd_root:
                 from ..data import CombinedDataset
                 from ..data.sbd import SBDSemanticSegmentation
@@ -367,12 +417,14 @@ class Trainer:
         self.model = build_model(
             name=cfg.model.name, nclass=cfg.model.nclass,
             backbone=cfg.model.backbone, output_stride=cfg.model.output_stride,
-            dtype=cfg.model.dtype, pam_block_size=cfg.model.pam_block_size,
+            dtype=cfg.model.dtype, bn_fp32_stats=cfg.model.bn_fp32_stats,
+            pam_block_size=cfg.model.pam_block_size,
             pam_impl=cfg.model.pam_impl,
             pam_score_dtype=cfg.model.pam_score_dtype,
             # ring PAM shards the spatial tokens over this mesh's model axis
             pam_sp_mesh=(self.mesh if cfg.model.pam_impl == "ring" else None),
             remat=cfg.model.remat,
+            remat_policy=cfg.model.remat_policy or None,
             moe_experts=cfg.model.moe_experts,
             moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
             moe_capacity_factor=cfg.model.moe_capacity_factor,
@@ -429,9 +481,24 @@ class Trainer:
                             steps_per_call=cfg.data.steps_per_dispatch,
                             **step_kwargs)
             if cfg.data.steps_per_dispatch > 1 else None)
+        eval_preprocess = None
+        if self._val_device_guidance:
+            # prepared val ships bare image channels; append the guidance
+            # channel on device with the DETERMINISTIC val semantics
+            # (extreme_points_fixed — bit-exact vs the host at pert=0).
+            # The rng argument is never consumed at is_val.
+            from ..ops.guidance_device import make_device_guidance
+            gstage = make_device_guidance(
+                family=cfg.data.guidance, alpha=cfg.data.guidance_alpha,
+                is_val=True)
+            fixed_key = jax.random.PRNGKey(0)
+
+            def eval_preprocess(b, _g=gstage, _k=fixed_key):
+                return _g(b, _k)
         self.eval_step = make_eval_step(
             self.model, loss_weights=cfg.model.loss_weights, mesh=self.mesh,
-            loss_type=loss_type, state_shardings=st_sh)
+            loss_type=loss_type, state_shardings=st_sh,
+            preprocess=eval_preprocess)
 
         # --- checkpointing
         self.ckpt = CheckpointManager(

@@ -52,8 +52,12 @@ from distributedpytorch_tpu.train import Config, Trainer, apply_overrides  # noq
 
 # VOC-like image sizes (VOC2012 images are ~500x375) so decode/crop/resize
 # cost what it costs on the real dataset.
-N_IMAGES = 20 if CPU_SMOKE else 120  # >= variant 9's train_batch=16
-N_VAL = 2 if CPU_SMOKE else 16   # enough val samples for a stable val rate
+N_IMAGES = 20 if CPU_SMOKE else 144  # keeps 104 TRAIN images (the round-3
+                                 # workload) now that N_VAL is 40 —
+                                 # make_fake_voc carves val out of n_images
+N_VAL = 2 if CPU_SMOKE else 40   # enough val samples for a stable val rate
+                                 # (val >= 10 imgs/s needs > a few seconds
+                                 # of samples to time honestly)
 IMG_SIZE = (96, 128) if CPU_SMOKE else (375, 500)
 BATCH = 8  # also divides the smoke run's 8-device CPU mesh
 EPOCHS_TIMED = 1 if CPU_SMOKE else 2  # after a warmup epoch (compile + caches)
@@ -169,6 +173,25 @@ if __name__ == "__main__":
         # e2e (BASELINE.md round-3 breakdown)
         {"data.prepared_cache": "AUTO", "data.device_guidance": True,
          "data.uint8_transfer": True, "data.packbits_masks": True},
+        # 14: the stacked headline (VERDICT r3 item 6): fast path +
+        # packbits wire + bf16 PAM scores, in the same sequential run as
+        # its controls
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True, "data.packbits_masks": True,
+         "model.pam_score_dtype": "bfloat16"},
+        # 15: val-path A/B control — fast path with the OLD plain val
+        # (data.val_prepared=false); variants 8/10 minus this row isolate
+        # the prepared-val win within one run
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True, "data.val_batch": 8,
+         "data.val_prepared": False},
+        # 16: semantic val-path A/B control (the round-3 1.0 imgs/s row's
+        # config, now with val_prepared off vs variant 12's on)
+        {"task": "semantic", "model.name": "deeplabv3", "model.nclass": 21,
+         "model.in_channels": 3, "model.output_stride": 16,
+         "data.crop_size": [513, 513], "data.val_batch": 8,
+         "data.prepared_cache": "AUTO_SEM", "data.uint8_transfer": True,
+         "data.val_prepared": False},
     ]
     sel = sys.argv[1:]
     try:

@@ -74,8 +74,13 @@ if CPU_SMOKE:
 from distributedpytorch_tpu.data.fake import make_fake_voc  # noqa: E402
 from distributedpytorch_tpu.train import Config, Trainer, apply_overrides  # noqa: E402
 
-N_IMAGES = 16 if CPU_SMOKE else 200
-N_VAL = 3 if CPU_SMOKE else 20
+# val >= 200 (VERDICT r3 item 7): a 20-50-image val split oscillates
+# +-0.05-0.10 mIoU from single-class flips late-epoch; 200 images makes the
+# curves quotable at the precision BASELINE.md quotes them.  Train counts
+# stay what rounds 1-3 used (180 small / 1000 big) so curve comparisons
+# against the committed artifacts remain train-scale-identical.
+N_IMAGES = 16 if CPU_SMOKE else 380
+N_VAL = 3 if CPU_SMOKE else 200
 IMG_SIZE = (96, 128) if CPU_SMOKE else (375, 500)
 # smoke runs on the 8-device CPU mesh: batch must divide over the data axis
 SMALL = {"model.backbone": "resnet18", "data.crop_size": [64, 64],
@@ -135,9 +140,9 @@ if __name__ == "__main__":
     fixture_big = None
     if set("ef") & set(sel):
         fixture_big = tempfile.mkdtemp(prefix="conv_voc_big_")
-        make_fake_voc(fixture_big, n_images=40 if CPU_SMOKE else 1000,
+        make_fake_voc(fixture_big, n_images=40 if CPU_SMOKE else 1200,
                       size=IMG_SIZE, max_objects=2,
-                      n_val=8 if CPU_SMOKE else 50, seed=11)
+                      n_val=8 if CPU_SMOKE else 200, seed=11)
     runs = {
         "a_guided": {"data.device_guidance": True},
         "b_guidance_none": {"data.guidance": "none",

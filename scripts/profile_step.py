@@ -50,8 +50,15 @@ if "--out" in sys.argv:
 SCORE_DTYPE = None  # model.pam_score_dtype: profile the bf16-scores step
 if "--score-dtype" in sys.argv:
     SCORE_DTYPE = sys.argv[sys.argv.index("--score-dtype") + 1]
+#: --model deeplabv3 profiles BASELINE config 4 (DeepLabV3-R101 os=16 513²,
+#: 21-class multi-output CE, 3-channel input) — the same shape bench.py's
+#: DPTPU_BENCH_MODEL hook measures; VERDICT r3 item 2 wants its op table.
+MODEL = "danet"
+if "--model" in sys.argv:
+    MODEL = sys.argv[sys.argv.index("--model") + 1]
 ON_TPU = any(d.platform == "tpu" for d in jax.devices())
-SIZE = 512 if ON_TPU else 64
+SEMANTIC = MODEL != "danet"
+SIZE = (513 if SEMANTIC else 512) if ON_TPU else 64
 BACKBONE = "resnet101" if ON_TPU else "resnet18"
 
 
@@ -99,6 +106,20 @@ def top_ops(table, n: int = 15):
     return rows[:n]
 
 
+def category_totals(table):
+    """Self-time summed per op category over the WHOLE table — the view
+    that attributes a step's device time (the top-15 alone undercounts
+    long-tail categories like data formatting)."""
+    rows = top_ops(table, n=10**9)
+    tot: dict[str, float] = {}
+    for r in rows:
+        tot[r["category"] or "?"] = (
+            tot.get(r["category"] or "?", 0.0) + r["self_time_us"])
+    total = sum(tot.values()) or 1.0
+    return {k: {"self_time_us": round(v, 1), "pct": round(100 * v / total, 2)}
+            for k, v in sorted(tot.items(), key=lambda kv: -kv[1])}
+
+
 def main() -> None:
     from distributedpytorch_tpu.models import build_model
     from distributedpytorch_tpu.parallel import (
@@ -109,22 +130,31 @@ def main() -> None:
     )
 
     mesh = make_mesh()
-    model = build_model("danet", nclass=1, backbone=BACKBONE,
-                        output_stride=8,
-                        dtype="bfloat16" if ON_TPU else "float32",
-                        pam_score_dtype=SCORE_DTYPE)
+    dtype = "bfloat16" if ON_TPU else "float32"
+    in_ch, nclass = (3, 21) if SEMANTIC else (4, 1)
+    if SEMANTIC:
+        model = build_model(MODEL, nclass=nclass, backbone=BACKBONE,
+                            output_stride=16, dtype=dtype, aux_head=True)
+    else:
+        model = build_model("danet", nclass=nclass, backbone=BACKBONE,
+                            output_stride=8, dtype=dtype,
+                            pam_score_dtype=SCORE_DTYPE)
     tx = optax.sgd(1e-3, momentum=0.9)
     r = np.random.RandomState(0)
     host_batch = {
-        "concat": r.uniform(0, 255, (BATCH, SIZE, SIZE, 4)
+        "concat": r.uniform(0, 255, (BATCH, SIZE, SIZE, in_ch)
                             ).astype(np.float32),
-        "crop_gt": (r.uniform(size=(BATCH, SIZE, SIZE)) > 0.7
-                    ).astype(np.float32),
+        "crop_gt": (
+            r.randint(0, nclass, (BATCH, SIZE, SIZE)).astype(np.float32)
+            if SEMANTIC else
+            (r.uniform(size=(BATCH, SIZE, SIZE)) > 0.7).astype(np.float32)),
     }
     with mesh:
         state = create_train_state(jax.random.PRNGKey(0), model, tx,
-                                   (1, SIZE, SIZE, 4), mesh=mesh)
-        step = make_train_step(model, tx, mesh=mesh)
+                                   (1, SIZE, SIZE, in_ch), mesh=mesh)
+        step = make_train_step(
+            model, tx, mesh=mesh,
+            loss_type="multi_softmax" if SEMANTIC else "multi_sigmoid")
         batch = shard_batch(mesh, host_batch)
         state, loss = step(state, batch)  # compile outside the trace
         jax.block_until_ready(loss)
@@ -133,12 +163,14 @@ def main() -> None:
                 state, loss = step(state, batch)
             jax.block_until_ready(loss)
 
-    rec = {"metric": f"danet_{BACKBONE}_{SIZE}px_b{BATCH}_profile",
+    rec = {"metric": f"{MODEL}_{BACKBONE}_{SIZE}px_b{BATCH}_profile",
            "trace_dir": OUT, "steps": STEPS,
            "score_dtype": SCORE_DTYPE,
            "platform": jax.devices()[0].platform}
     try:
-        rec["top_ops_by_self_time"] = top_ops(hlo_stats_table(OUT))
+        table = hlo_stats_table(OUT)
+        rec["top_ops_by_self_time"] = top_ops(table)
+        rec["category_totals"] = category_totals(table)
     except Exception as e:
         rec["hlo_stats_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     print(json.dumps(rec))

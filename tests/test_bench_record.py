@@ -156,6 +156,41 @@ class TestReplayCapture:
             monkeypatch.setattr(bench, "LATEST_TPU_CAPTURE", str(path))
             assert bench.try_replay_tpu_capture() is None
 
+    def test_code_drift_blocks_replay(self, tmp_path, monkeypatch):
+        self._capture(tmp_path, monkeypatch, captured_git_rev="deadbee")
+        with mock.patch.object(bench, "_bench_code_changed_since",
+                               return_value=True):
+            assert bench.try_replay_tpu_capture() is None
+        with mock.patch.object(bench, "_bench_code_changed_since",
+                               return_value=False):
+            out = bench.try_replay_tpu_capture()
+            assert out is not None
+            assert "code-drift" not in out["note"]
+
+    def test_unknown_rev_replays_with_caveat(self, tmp_path, monkeypatch):
+        self._capture(tmp_path, monkeypatch)  # no captured_git_rev
+        out = bench.try_replay_tpu_capture()
+        assert out is not None
+        assert "code-drift check unavailable" in out["note"]
+
+    def test_current_head_counts_as_unchanged(self):
+        import subprocess
+        repo = os.path.dirname(bench.__file__)
+        head = subprocess.run(
+            ["git", "-C", repo, "rev-parse", "HEAD"],
+            capture_output=True, text=True).stdout.strip()
+        dirty = subprocess.run(
+            ["git", "-C", repo, "status", "--porcelain", "--",
+             "bench.py", "distributedpytorch_tpu"],
+            capture_output=True, text=True).stdout.strip()
+        if dirty:
+            # mid-development tree: the drift guard SHOULD flag it
+            assert bench._bench_code_changed_since(head) is True
+        else:
+            assert bench._bench_code_changed_since(head) is False
+        assert bench._bench_code_changed_since(None) is None
+        assert bench._bench_code_changed_since("not-a-rev") is None
+
     def test_missing_file_is_none(self, tmp_path, monkeypatch):
         monkeypatch.setattr(bench, "LATEST_TPU_CAPTURE",
                             str(tmp_path / "nope.json"))

@@ -191,3 +191,28 @@ class TestMetrics:
         dev = float(ops.jaccard(jnp.asarray(pred), jnp.asarray(gt),
                                 jnp.asarray(void)))
         assert host == pytest.approx(dev, rel=1e-6)
+
+    def test_np_jaccard_thresholds_matches_per_threshold_loop(self, rng):
+        """The one-pass digitize+bincount sweep must equal the naive
+        per-threshold np_jaccard loop, including AT-threshold pixels
+        (strict ``prob > t``), unsorted threshold order, void exclusion,
+        and the empty-union convention."""
+        from distributedpytorch_tpu.ops.metrics import (
+            np_jaccard,
+            np_jaccard_thresholds,
+        )
+        prob = rng.uniform(size=(13, 17)).astype(np.float32)
+        prob.flat[::7] = 0.5            # exact-equality pixels
+        prob.flat[1::11] = 0.3
+        gt = rng.integers(0, 2, (13, 17)).astype(np.float32)
+        void = rng.integers(0, 2, (13, 17)).astype(np.float32)
+        for v in (void, None):
+            for ths in ((0.3, 0.5, 0.8), (0.8, 0.3, 0.5), (0.5,)):
+                want = [np_jaccard(prob > t, gt > 0.5, v) for t in ths]
+                got = np_jaccard_thresholds(prob, ths, gt > 0.5, v)
+                np.testing.assert_allclose(got, want, atol=1e-12)
+        # empty union: nothing predicted, nothing true -> 1.0 everywhere
+        z = np.zeros((4, 4), np.float32)
+        np.testing.assert_array_equal(
+            np_jaccard_thresholds(z, (0.3, 0.5), z.astype(bool), None),
+            [1.0, 1.0])

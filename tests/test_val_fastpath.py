@@ -1,0 +1,226 @@
+"""Val fast path (data.val_prepared, VERDICT r3 item 3): prepared eval
+caches, the uint8 val wire, device-guidance eval preprocessing, and metric
+parity against the plain (uncached) validation protocol.
+
+The eval protocol is deterministic end to end (reference
+train_pascal.py:135-145, 233-308), so the entire per-epoch val front —
+decode, crop, resize, guidance, plus the full-res metric masks — is
+cacheable.  What these tests pin down:
+
+* the cached eval sample carries the evaluator's exact contract (wire keys
+  + host-side ``gt``/``void_pixels``/``bbox``), with the full-res masks
+  BIT-EXACT vs the plain pipeline (they feed the metric; rounding there
+  would change reported Jaccards);
+* the uint8 wire serves uint8 (the measured 25 MB f32 semantic val batch
+  was the 1 img/s bound, BASELINE.md ‡);
+* the end-to-end metric matches the plain path.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.data import (
+    DataLoader,
+    PreparedInstanceDataset,
+    VOCInstanceSegmentation,
+    build_eval_transform,
+)
+from distributedpytorch_tpu.data.pipeline import (
+    build_prepared_eval_post_transform,
+    build_prepared_semantic_eval_post_transform,
+    build_semantic_eval_transform,
+)
+from distributedpytorch_tpu.data.prepared import PreparedSemanticDataset
+from distributedpytorch_tpu.data.voc import VOCSemanticSegmentation
+
+
+def make_base(root):
+    return VOCInstanceSegmentation(root, split="val", transform=None,
+                                   preprocess=True, area_thres=0)
+
+
+@pytest.fixture()
+def base(fake_voc_root):
+    return make_base(fake_voc_root)
+
+
+@pytest.fixture()
+def plain(fake_voc_root):
+    return VOCInstanceSegmentation(
+        fake_voc_root, split="val", preprocess=True, area_thres=0,
+        transform=build_eval_transform(crop_size=(64, 64), relax=10))
+
+
+def make_eval_cache(base, tmp_path, uint8=False, guidance="nellipse_gaussians"):
+    return PreparedInstanceDataset(
+        base, str(tmp_path / "prep"), crop_size=(64, 64), relax=10,
+        uint8_arrays=uint8, eval_protocol=True, max_im_size=(256, 256),
+        post_transform=build_prepared_eval_post_transform(
+            guidance=guidance, uint8_wire=uint8))
+
+
+class TestInstanceEvalCache:
+    def test_contract_vs_plain_pipeline(self, base, plain, tmp_path):
+        ds = make_eval_cache(base, tmp_path)
+        assert len(ds) == len(plain)
+        for i in (0, 1, len(ds) - 1):
+            got = ds[i]
+            want = plain[i]
+            # full-res metric masks: BIT-exact (they feed the Jaccard)
+            np.testing.assert_array_equal(
+                np.asarray(got["gt"], bool),
+                np.asarray(want["gt"], bool).reshape(got["gt"].shape))
+            np.testing.assert_array_equal(
+                np.asarray(got["void_pixels"], bool),
+                np.asarray(want["void_pixels"],
+                           bool).reshape(got["void_pixels"].shape))
+            np.testing.assert_array_equal(got["bbox"], want["bbox"])
+            # crop_gt binary + exact; image within uint8 rounding
+            np.testing.assert_array_equal(
+                got["crop_gt"], np.asarray(want["crop_gt"], np.float32))
+            assert got["concat"].shape == want["concat"].shape
+            assert np.abs(got["concat"][..., :3]
+                          - want["concat"][..., :3]).max() <= 0.5
+            # guidance channel: same crop_gt in, same deterministic points
+            # out — differences can only come from the rounded image (none)
+            np.testing.assert_allclose(got["concat"][..., 3],
+                                       want["concat"][..., 3],
+                                       atol=1e-3)
+
+    def test_second_access_never_touches_source(self, base, tmp_path):
+        ds = make_eval_cache(base, tmp_path)
+        ds.prebuild()
+        first = ds[0]
+
+        def boom(i):
+            raise AssertionError("source dataset touched after prebuild")
+
+        ds.dataset.__getitem__ = boom
+        again = ds[0]
+        np.testing.assert_array_equal(first["concat"], again["concat"])
+        np.testing.assert_array_equal(first["gt"], again["gt"])
+
+    def test_uint8_wire_dtypes(self, base, tmp_path):
+        ds = make_eval_cache(base, tmp_path, uint8=True, guidance="none")
+        s = ds[0]
+        assert s["concat"].dtype == np.uint8 and s["concat"].shape[-1] == 3
+        assert s["crop_gt"].dtype == np.uint8
+        assert set(np.unique(s["crop_gt"])) <= {0, 1}
+
+    def test_eval_cache_dir_distinct_from_train(self, base, tmp_path):
+        train_ds = PreparedInstanceDataset(base, str(tmp_path / "prep"),
+                                           crop_size=(64, 64), relax=10)
+        eval_ds = make_eval_cache(base, tmp_path)
+        assert train_ds.cache_dir != eval_ds.cache_dir
+
+    def test_oversize_image_raises_with_guidance(self, base, tmp_path):
+        ds = PreparedInstanceDataset(
+            base, str(tmp_path / "prep"), crop_size=(64, 64), relax=10,
+            eval_protocol=True, max_im_size=(8, 8),
+            post_transform=build_prepared_eval_post_transform())
+        with pytest.raises(ValueError, match="max_im_size"):
+            ds[0]
+
+
+class TestSemanticEvalCache:
+    def test_contract_vs_plain_pipeline(self, fake_voc_root, tmp_path):
+        base = VOCSemanticSegmentation(fake_voc_root, split="val",
+                                       transform=None)
+        plain = VOCSemanticSegmentation(
+            fake_voc_root, split="val",
+            transform=build_semantic_eval_transform(crop_size=(65, 65)))
+        ds = PreparedSemanticDataset(
+            base, str(tmp_path / "prep"), crop_size=(65, 65),
+            post_transform=build_prepared_semantic_eval_post_transform())
+        assert len(ds) == len(plain)
+        for i in range(len(ds)):
+            got, want = ds[i], plain[i]
+            # class ids resized nearest: integer-exact
+            np.testing.assert_array_equal(
+                got["crop_gt"], np.asarray(want["crop_gt"], np.float32))
+            assert np.abs(got["concat"] - want["concat"]).max() <= 0.5
+
+    def test_uint8_wire_dtypes(self, fake_voc_root, tmp_path):
+        base = VOCSemanticSegmentation(fake_voc_root, split="val",
+                                       transform=None)
+        ds = PreparedSemanticDataset(
+            base, str(tmp_path / "prep"), crop_size=(65, 65),
+            uint8_arrays=True,
+            post_transform=build_prepared_semantic_eval_post_transform(
+                uint8_wire=True))
+        s = ds[0]
+        assert s["concat"].dtype == np.uint8
+        assert s["crop_gt"].dtype == np.uint8
+
+
+class TestTrainerIntegration:
+    def _cfg(self, root, tmp_path, **over):
+        from distributedpytorch_tpu.train import Config, apply_overrides
+        cfg = apply_overrides(Config(), [
+            f"data.root={root}", "data.train_batch=8", "data.val_batch=2",
+            "data.crop_size=[64,64]", "data.relax=10", "data.area_thres=0",
+            "model.backbone=resnet18", "model.output_stride=8",
+            "optim.lr=1e-4", "checkpoint.async_save=false", "epochs=1",
+            *[f"{k}={v}" for k, v in over.items()]])
+        return dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
+
+    def test_val_metric_parity_plain_vs_prepared(self, fake_voc_root,
+                                                 tmp_path):
+        """Same state, same protocol: the prepared+uint8+device-guidance
+        val path must reproduce the plain path's Jaccard to within the
+        uint8 image rounding (<0.5/255 input perturbation)."""
+        from distributedpytorch_tpu.train import Trainer
+
+        tr_plain = Trainer(self._cfg(fake_voc_root, tmp_path / "a"))
+        m_plain = tr_plain.validate(epoch=0)
+        tr_fast = Trainer(self._cfg(
+            fake_voc_root, tmp_path / "b",
+            **{"data.prepared_cache": str(tmp_path / "cache"),
+               "data.uint8_transfer": "true",
+               "data.device_guidance": "true"}))
+        # identical params: copy the plain trainer's state
+        tr_fast.state = tr_plain.state
+        m_fast = tr_fast.validate(epoch=0)
+        assert m_fast["n_samples"] == m_plain["n_samples"]
+        assert abs(m_fast["jaccard"] - m_plain["jaccard"]) < 2e-2
+        for th in ("0.3", "0.5", "0.8"):
+            assert abs(m_fast["jaccard_per_threshold"][th]
+                       - m_plain["jaccard_per_threshold"][th]) < 2e-2
+        tr_plain.close()
+        tr_fast.close()
+
+    def test_semantic_val_parity(self, tmp_path):
+        from distributedpytorch_tpu.data import make_fake_voc
+        from distributedpytorch_tpu.train import Trainer
+
+        fake_voc_root = make_fake_voc(str(tmp_path / "voc"), n_images=12,
+                                      size=(96, 128), n_val=3, seed=3)
+        sem = {"task": "semantic", "model.name": "deeplabv3",
+               "model.nclass": 21, "model.in_channels": 3,
+               "data.crop_size": "[65,65]"}
+        tr_plain = Trainer(self._cfg(fake_voc_root, tmp_path / "a", **sem))
+        m_plain = tr_plain.validate(epoch=0)
+        tr_fast = Trainer(self._cfg(
+            fake_voc_root, tmp_path / "b", **sem,
+            **{"data.prepared_cache": str(tmp_path / "cache"),
+               "data.uint8_transfer": "true"}))
+        tr_fast.state = tr_plain.state
+        m_fast = tr_fast.validate(epoch=0)
+        assert abs(m_fast["miou"] - m_plain["miou"]) < 2e-2
+        tr_plain.close()
+        tr_fast.close()
+
+    def test_val_prepared_off_keeps_plain_path(self, fake_voc_root,
+                                               tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+
+        tr = Trainer(self._cfg(
+            fake_voc_root, tmp_path,
+            **{"data.prepared_cache": str(tmp_path / "cache"),
+               "data.val_prepared": "false",
+               "data.uint8_transfer": "true",
+               "data.device_guidance": "true"}))
+        assert not isinstance(tr.val_set, PreparedInstanceDataset)
+        tr.close()
