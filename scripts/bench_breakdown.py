@@ -56,14 +56,15 @@ from distributedpytorch_tpu.backend_health import (  # noqa: E402
 )
 
 STAGES = [a for a in sys.argv[1:]
-          if a in ("host", "place", "step", "dispatch", "valhost")]
+          if a in ("host", "place", "step", "dispatch", "valhost",
+                   "valplace", "valstep", "valmetric")]
 OVERRIDES = [a for a in sys.argv[1:] if "=" in a]
 CPU_SMOKE = "--cpu-smoke" in sys.argv
 if not STAGES:
     STAGES = ["host", "place", "step"]
 
-NEEDS_TPU = bool({"place", "step", "dispatch"} & set(STAGES)) \
-    and not CPU_SMOKE
+NEEDS_TPU = bool({"place", "step", "dispatch", "valplace", "valstep",
+                  "valmetric"} & set(STAGES)) and not CPU_SMOKE
 if not NEEDS_TPU:
     # Host-only run must never block on a wedged tunnel.  FORCE the
     # override — the site-installed accelerator plugin sets JAX_PLATFORMS
@@ -225,6 +226,117 @@ def stage_step(tr: Trainer, batch: dict) -> dict:
             "steps_per_dispatch": k}
 
 
+def one_val_batch(tr: Trainer) -> tuple[dict, dict]:
+    """(full val batch, placed-shape device subset) — the evaluator's own
+    split and padding (evaluate.py pads to the mesh's device multiple
+    before sharding; without it a val_batch of 1 cannot shard)."""
+    from distributedpytorch_tpu.parallel import pad_to_multiple
+    batch = next(iter(tr.val_loader))
+    dev = {k: v for k, v in batch.items() if k in DEVICE_KEYS}
+    dev, _ = pad_to_multiple(dev, tr.mesh.devices.size)
+    return batch, dev
+
+
+def stage_valplace(tr: Trainer, dev: dict) -> dict:
+    mesh = tr.mesh
+    nbytes = sum(np.asarray(v).nbytes for v in dev.values())
+    with mesh:
+        shard_batch(mesh, dev)
+        reps = 5 if CPU_SMOKE else 30
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(shard_batch(mesh, dev))
+        dt = time.perf_counter() - t0
+    bs = next(iter(dev.values())).shape[0]
+    return {"valplace_imgs_per_sec": round(reps * bs / dt, 2),
+            "valplace_ms_per_batch": round(dt / reps * 1e3, 1),
+            "val_batch_mb": round(nbytes / 2**20, 2)}
+
+
+def stage_valstep(tr: Trainer, dev: dict) -> dict:
+    """The jitted eval forward alone (loss + logits), pre-placed batch."""
+    mesh = tr.mesh
+    with mesh:
+        placed = shard_batch(mesh, dev)
+
+        def one():
+            outputs, loss = tr.eval_step(tr.state, placed)
+            return loss, outputs[0]
+
+        bs = next(iter(dev.values())).shape[0]
+        stats = throughput(one, steps=5 if CPU_SMOKE else 20, warmup=2,
+                           items_per_step=bs)
+    return {"valstep_imgs_per_sec": round(stats["items_per_sec"], 2),
+            "valstep_ms_per_batch": round(
+                bs / stats["items_per_sec"] * 1e3, 1)}
+
+
+def stage_valmetric(tr: Trainer, batch: dict, dev: dict) -> dict:
+    """D2H readback of the primary logits + the host paste-back/threshold
+    sweep — the two val terms no forward overlap hides.  Instance protocol
+    only (the semantic path scores its confusion matrix on device).
+
+    Mirrors evaluate()'s own loop via its helpers (_sigmoid/_as_list,
+    bbox-or-get_bbox fallback) and the trainer's ACTUAL eval config — a
+    hardcoded workload here would attribute numbers to a config that
+    never ran."""
+    if tr.cfg.task != "instance":
+        return {"valmetric_skipped": "instance-only stage"}
+    import numpy as _np
+
+    from distributedpytorch_tpu.ops.metrics import np_jaccard_thresholds
+    from distributedpytorch_tpu.train.evaluate import _as_list, _sigmoid
+    from distributedpytorch_tpu.utils.helpers import (
+        crop2fullmask,
+        get_bbox,
+        tens2image,
+    )
+    thresholds = tuple(tr.cfg.eval_thresholds)
+    relax = tr.cfg.data.relax
+    zero_pad = tr.cfg.data.zero_pad
+    mesh = tr.mesh
+    with mesh:
+        placed = shard_batch(mesh, dev)
+        outputs, _ = tr.eval_step(tr.state, placed)
+        jax.device_get(outputs[0])          # compile + settle
+        # forward + D2H readback together (a tunneled device has no
+        # reliable sync point to isolate the read); subtract
+        # valstep_ms_per_batch to get the readback term alone
+        reps = 3 if CPU_SMOKE else 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            outputs, _ = tr.eval_step(tr.state, placed)
+            logits = _np.asarray(jax.device_get(outputs[0]))
+        dt_read = (time.perf_counter() - t0) / reps
+    probs = _sigmoid(logits.astype(_np.float32))
+    n = len(batch["gt"]) if isinstance(batch["gt"], list) \
+        else batch["gt"].shape[0]
+    gts = _as_list(batch["gt"], n)
+    voids = _as_list(batch.get("void_pixels", [None] * n), n)
+    bboxes = _as_list(batch["bbox"], n) if "bbox" in batch else [None] * n
+    t0 = time.perf_counter()
+    reps_m = 3 if CPU_SMOKE else 10
+    for _ in range(reps_m):
+        for j in range(n):
+            gt = tens2image(_np.asarray(gts[j]))
+            if gt.max() <= 0.5:
+                continue
+            if bboxes[j] is not None:
+                bbox = tuple(int(v) for v in _np.asarray(bboxes[j]))
+            else:
+                bbox = get_bbox(gt > 0.5, pad=relax, zero_pad=zero_pad)
+            pred = tens2image(probs[j])
+            full = crop2fullmask(pred, bbox, gt.shape[:2],
+                                 zero_pad=zero_pad, relax=relax)
+            void = None if voids[j] is None \
+                else tens2image(_np.asarray(voids[j]))
+            np_jaccard_thresholds(full, thresholds, gt > 0.5, void)
+    dt_metric = (time.perf_counter() - t0) / reps_m
+    return {"valfwdread_ms_per_batch": round(dt_read * 1e3, 1),
+            "valmetric_ms_per_batch": round(dt_metric * 1e3, 1),
+            "valmetric_imgs_per_sec": round(n / dt_metric, 2)}
+
+
 def stage_dispatch(tr: Trainer, batch: dict) -> dict:
     """Host-blocking cost of issuing one (possibly K-step) train-step call.
 
@@ -268,8 +380,12 @@ def main() -> int:
     fixture = tempfile.mkdtemp(prefix="bench_breakdown_voc_")
     work = tempfile.mkdtemp(prefix="bench_breakdown_")
     try:
-        make_fake_voc(fixture, n_images=N_IMAGES, size=IMG_SIZE,
-                      max_objects=2, n_val=2, seed=0)
+        # val stages need a real val split; keep n_val tiny otherwise so
+        # the train-stage workload stays identical to earlier rounds'
+        # committed breakdowns
+        n_val = 24 if any(s.startswith("val") for s in STAGES) else 2
+        make_fake_voc(fixture, n_images=N_IMAGES + (n_val - 2),
+                      size=IMG_SIZE, max_objects=2, n_val=n_val, seed=0)
         rec: dict = {"variant": "e2e-fast-path(prepared+devguid+uint8)",
                      "overrides": OVERRIDES, "batch": BATCH}
         def add(stage_rec: dict) -> None:
@@ -283,7 +399,8 @@ def main() -> int:
             add(stage_host(fixture, work))
         if "valhost" in STAGES:
             add(stage_valhost(fixture, work))
-        if {"place", "step", "dispatch"} & set(STAGES):
+        if {"place", "step", "dispatch", "valplace", "valstep",
+                "valmetric"} & set(STAGES):
             tr = make_trainer(fixture, work, tiny_model=CPU_SMOKE)
             batch = one_host_batch(tr)
             if "place" in STAGES:
@@ -292,8 +409,20 @@ def main() -> int:
                 add(stage_step(tr, batch))
             if "dispatch" in STAGES:
                 add(stage_dispatch(tr, batch))
+            if {"valplace", "valstep", "valmetric"} & set(STAGES):
+                vbatch, vdev = one_val_batch(tr)
+                if "valplace" in STAGES:
+                    add(stage_valplace(tr, vdev))
+                if "valstep" in STAGES:
+                    add(stage_valstep(tr, vdev))
+                if "valmetric" in STAGES:
+                    add(stage_valmetric(tr, vbatch, vdev))
             tr.close()
-        rates = [v for k, v in rec.items() if k.endswith("imgs_per_sec")]
+        # train-path stages only: the val stages are a separate pipeline
+        # and must not drag the train overlap ceiling down
+        rates = [v for k, v in rec.items()
+                 if k in ("host_imgs_per_sec", "place_imgs_per_sec",
+                          "step_imgs_per_sec")]
         if len(rates) > 1:
             rec["ideal_overlap_imgs_per_sec"] = round(min(rates), 2)
             print(json.dumps(rec), flush=True)
