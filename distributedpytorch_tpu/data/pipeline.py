@@ -145,6 +145,7 @@ def build_prepared_eval_post_transform(
     alpha: float = 0.6,
     guidance: str = "nellipse_gaussians",
     uint8_wire: bool = False,
+    packbits: bool = False,
 ) -> T.Compose:
     """Per-access stage downstream of the prepared EVAL cache
     (data.val_prepared): deterministic guidance (``is_val`` semantics,
@@ -165,6 +166,9 @@ def build_prepared_eval_post_transform(
         *_guidance_stage(guidance, alpha, is_val=True),
         T.ToArray(uint8_passthrough=uint8_wire),
         T.Keep(("concat", "crop_gt", "meta")),
+        # data.packbits_masks: the binary crop_gt is 25% of the 3-channel
+        # uint8 val batch; ship it at 1 bit/pixel (the eval step unpacks)
+        *([T.PackBits(("crop_gt",))] if packbits else []),
     ])
 
 

@@ -410,13 +410,19 @@ def make_train_step(
 def make_eval_step(model, loss_weights: tuple[float, ...] | None = None,
                    mesh=None, loss_type: str = "multi_sigmoid",
                    preprocess: Callable[[Batch], Batch] | None = None,
-                   state_shardings=None):
+                   state_shardings=None, packbits_masks: bool = False):
     """Jitted ``(state, batch) -> (outputs, loss)`` inference step
     (reference val loop body, train_pascal.py:245-254).  Outputs are the
     model's logit tuple; sigmoid/thresholding happen in the evaluator, which
-    needs probabilities host-side for the full-res paste-back anyway."""
+    needs probabilities host-side for the full-res paste-back anyway.
+
+    ``packbits_masks`` mirrors the train step's 1-bit ``crop_gt`` wire for
+    the prepared val path (data.val_prepared + data.packbits_masks): the
+    mask is 25% of the 3-channel uint8 val batch's bytes."""
 
     def step_fn(state: TrainState, batch: Batch):
+        if packbits_masks:
+            batch = _unpack_mask_bits(batch)
         batch = _to_compute_dtype(batch)
         if preprocess is not None:  # must mirror the train augment's
             batch = preprocess(batch)  # deterministic normalization

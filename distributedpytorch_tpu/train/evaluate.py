@@ -68,6 +68,7 @@ def evaluate(
     mesh=None,
     max_batches: int | None = None,
     debug_asserts: bool = False,
+    packed_masks: bool = False,
 ) -> dict:
     """Run the full validation protocol; returns a metrics dict.
 
@@ -100,7 +101,7 @@ def evaluate(
             if max_batches is not None and bi >= max_batches:
                 break
             if debug_asserts:
-                batch_debug_asserts(batch)
+                batch_debug_asserts(batch, packed_masks=packed_masks)
             device_keys = {k: v for k, v in batch.items()
                            if k in (INPUT_KEY, "crop_gt", "crop_void")}
             padded, _ = pad_to_multiple(device_keys, n_dev)
@@ -123,8 +124,17 @@ def evaluate(
         # primary head only; ragged paste-back per sample on host
         probs = _sigmoid(_local_rows(outputs[0])[:n])
         if first_batch_vis is None:
+            vis_batch = batch
+            if packed_masks:
+                # panels overlay crop_gt on the image; hand them the
+                # unpacked mask, not the 1-bit wire row
+                h, w = np.asarray(batch[INPUT_KEY]).shape[1:3]
+                gt_bits = np.asarray(batch["crop_gt"])
+                vis_batch = dict(batch)
+                vis_batch["crop_gt"] = np.unpackbits(
+                    gt_bits, axis=-1, count=h * w).reshape(n, h, w)
             first_batch_vis = {
-                "batch": batch,
+                "batch": vis_batch,
                 "outputs": [_local_rows(o)[:n] for o in outputs],
             }
         gts = _as_list(batch["gt"], n)

@@ -199,6 +199,9 @@ class Trainer:
         #: set by the instance branch when the prepared val wire ships
         #: 3-channel batches and the eval step owns guidance synthesis
         self._val_device_guidance = False
+        #: set by the instance branch when the prepared val wire ships the
+        #: packed 1-bit crop_gt (the eval step unpacks)
+        self._val_packbits = False
         if cfg.task == "instance":
             prepared = bool(cfg.data.prepared_cache)
             # Prepared cache owns the deterministic crop stage itself; the
@@ -222,6 +225,7 @@ class Trainer:
             #: step appends the guidance channel (is_val semantics).
             val_prep = prepared and cfg.data.val_prepared
             self._val_device_guidance = val_prep and cfg.data.device_guidance
+            self._val_packbits = val_prep and cfg.data.packbits_masks
             val_tf = None if val_prep else build_eval_transform(
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                 zero_pad=cfg.data.zero_pad, alpha=cfg.data.guidance_alpha,
@@ -250,7 +254,8 @@ class Trainer:
                         alpha=cfg.data.guidance_alpha,
                         guidance=("none" if cfg.data.device_guidance
                                   else cfg.data.guidance),
-                        uint8_wire=cfg.data.uint8_transfer))
+                        uint8_wire=cfg.data.uint8_transfer,
+                        packbits=cfg.data.packbits_masks))
             if cfg.data.sbd_root:
                 # the reference's use_sbd recipe (train_pascal.py:150-154),
                 # live: merge SBD train+val, drop its VOC-val overlap
@@ -498,7 +503,8 @@ class Trainer:
         self.eval_step = make_eval_step(
             self.model, loss_weights=cfg.model.loss_weights, mesh=self.mesh,
             loss_type=loss_type, state_shardings=st_sh,
-            preprocess=eval_preprocess)
+            preprocess=eval_preprocess,
+            packbits_masks=self._val_packbits)
 
         # --- checkpointing
         self.ckpt = CheckpointManager(
@@ -894,7 +900,8 @@ class Trainer:
                     thresholds=self.cfg.eval_thresholds,
                     relax=self.cfg.data.relax,
                     zero_pad=self.cfg.data.zero_pad, mesh=self.mesh,
-                    debug_asserts=self.cfg.debug_asserts)
+                    debug_asserts=self.cfg.debug_asserts,
+                    packed_masks=self._val_packbits)
         first = metrics.pop("_first_batch", None)
         if self.cfg.debug_asserts and not np.isfinite(metrics["loss"]):
             # Watchdog, val side: a 1-step epoch's train loss is computed

@@ -172,7 +172,12 @@ def stage_valhost(fixture: str, work: str) -> dict:
             "valhost_ms_per_img": round(dt / max(n, 1) * 1e3, 1)}
 
 
-def stage_place(tr: Trainer, batch: dict) -> dict:
+def stage_place(tr: Trainer, batch: dict, prefix: str = "",
+                n_real: int | None = None) -> dict:
+    """H2D placement rate of ``batch``; shared by the train and val
+    (``prefix='val'``) pipelines.  ``n_real`` counts only genuine samples
+    when the batch carries pad rows (the evaluator discards them, so a
+    padded-row rate would overstate val throughput by the pad factor)."""
     mesh = tr.mesh
     nbytes = sum(np.asarray(v).nbytes for v in batch.values())
     with mesh:
@@ -183,10 +188,12 @@ def stage_place(tr: Trainer, batch: dict) -> dict:
             placed = shard_batch(mesh, batch)
             jax.block_until_ready(placed)
         dt = time.perf_counter() - t0
-    bs = next(iter(batch.values())).shape[0]
-    return {"place_imgs_per_sec": round(reps * bs / dt, 2),
-            "place_ms_per_batch": round(dt / reps * 1e3, 1),
-            "batch_mb": round(nbytes / 2**20, 1)}
+    bs = n_real if n_real is not None \
+        else next(iter(batch.values())).shape[0]
+    return {f"{prefix}place_imgs_per_sec": round(reps * bs / dt, 2),
+            f"{prefix}place_ms_per_batch": round(dt / reps * 1e3, 1),
+            (f"{prefix}_batch_mb" if prefix else "batch_mb"):
+                round(nbytes / 2**20, 2)}
 
 
 def stage_step(tr: Trainer, batch: dict) -> dict:
@@ -226,34 +233,21 @@ def stage_step(tr: Trainer, batch: dict) -> dict:
             "steps_per_dispatch": k}
 
 
-def one_val_batch(tr: Trainer) -> tuple[dict, dict]:
-    """(full val batch, placed-shape device subset) — the evaluator's own
-    split and padding (evaluate.py pads to the mesh's device multiple
-    before sharding; without it a val_batch of 1 cannot shard)."""
+def one_val_batch(tr: Trainer) -> tuple[dict, dict, int]:
+    """(full val batch, placed-shape device subset, REAL sample count) —
+    the evaluator's own split and padding (evaluate.py pads to the mesh's
+    device multiple before sharding; without it a val_batch of 1 cannot
+    shard).  Rates must count only the real samples: the evaluator
+    discards the pad rows."""
     from distributedpytorch_tpu.parallel import pad_to_multiple
     batch = next(iter(tr.val_loader))
     dev = {k: v for k, v in batch.items() if k in DEVICE_KEYS}
+    n_real = next(iter(dev.values())).shape[0]
     dev, _ = pad_to_multiple(dev, tr.mesh.devices.size)
-    return batch, dev
+    return batch, dev, n_real
 
 
-def stage_valplace(tr: Trainer, dev: dict) -> dict:
-    mesh = tr.mesh
-    nbytes = sum(np.asarray(v).nbytes for v in dev.values())
-    with mesh:
-        shard_batch(mesh, dev)
-        reps = 5 if CPU_SMOKE else 30
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            jax.block_until_ready(shard_batch(mesh, dev))
-        dt = time.perf_counter() - t0
-    bs = next(iter(dev.values())).shape[0]
-    return {"valplace_imgs_per_sec": round(reps * bs / dt, 2),
-            "valplace_ms_per_batch": round(dt / reps * 1e3, 1),
-            "val_batch_mb": round(nbytes / 2**20, 2)}
-
-
-def stage_valstep(tr: Trainer, dev: dict) -> dict:
+def stage_valstep(tr: Trainer, dev: dict, n_real: int) -> dict:
     """The jitted eval forward alone (loss + logits), pre-placed batch."""
     mesh = tr.mesh
     with mesh:
@@ -263,12 +257,11 @@ def stage_valstep(tr: Trainer, dev: dict) -> dict:
             outputs, loss = tr.eval_step(tr.state, placed)
             return loss, outputs[0]
 
-        bs = next(iter(dev.values())).shape[0]
         stats = throughput(one, steps=5 if CPU_SMOKE else 20, warmup=2,
-                           items_per_step=bs)
+                           items_per_step=n_real)
     return {"valstep_imgs_per_sec": round(stats["items_per_sec"], 2),
             "valstep_ms_per_batch": round(
-                bs / stats["items_per_sec"] * 1e3, 1)}
+                n_real / stats["items_per_sec"] * 1e3, 1)}
 
 
 def stage_valmetric(tr: Trainer, batch: dict, dev: dict) -> dict:
@@ -410,11 +403,12 @@ def main() -> int:
             if "dispatch" in STAGES:
                 add(stage_dispatch(tr, batch))
             if {"valplace", "valstep", "valmetric"} & set(STAGES):
-                vbatch, vdev = one_val_batch(tr)
+                vbatch, vdev, n_real = one_val_batch(tr)
                 if "valplace" in STAGES:
-                    add(stage_valplace(tr, vdev))
+                    add(stage_place(tr, vdev, prefix="val",
+                                    n_real=n_real))
                 if "valstep" in STAGES:
-                    add(stage_valstep(tr, vdev))
+                    add(stage_valstep(tr, vdev, n_real))
                 if "valmetric" in STAGES:
                     add(stage_valmetric(tr, vbatch, vdev))
             tr.close()

@@ -191,6 +191,41 @@ class TestTrainerIntegration:
         tr_plain.close()
         tr_fast.close()
 
+    def test_val_parity_with_packed_mask_wire(self, fake_voc_root,
+                                              tmp_path):
+        """data.packbits_masks now rides the VAL wire too (1-bit crop_gt,
+        unpacked inside the eval step): metrics must match the plain
+        protocol like the unpacked fast path does."""
+        from distributedpytorch_tpu.train import Trainer
+
+        tr_plain = Trainer(self._cfg(fake_voc_root, tmp_path / "a"))
+        m_plain = tr_plain.validate(epoch=0)
+        tr_fast = Trainer(self._cfg(
+            fake_voc_root, tmp_path / "b",
+            **{"data.prepared_cache": str(tmp_path / "cache"),
+               "data.uint8_transfer": "true",
+               "data.device_guidance": "true",
+               "data.packbits_masks": "true",
+               "debug_asserts": "true"}))
+        sample = tr_fast.val_set[0]
+        h, w = tr_fast.cfg.data.crop_size
+        assert sample["crop_gt"].shape == ((h * w + 7) // 8,)
+        tr_fast.state = tr_plain.state
+        m_fast = tr_fast.validate(epoch=0)
+        assert abs(m_fast["jaccard"] - m_plain["jaccard"]) < 2e-2
+        # the panels contract: the vis record must carry the UNPACKED
+        # mask (the 1-bit wire row would crash make_val_panels silently)
+        from distributedpytorch_tpu.train.evaluate import evaluate
+        from distributedpytorch_tpu.train.logging import make_val_panels
+        m = evaluate(tr_fast.eval_step, tr_fast.state, tr_fast.val_loader,
+                     mesh=tr_fast.mesh, packed_masks=True)
+        fb = m["_first_batch"]
+        assert np.asarray(fb["batch"]["crop_gt"]).shape[1:] == (h, w)
+        fig = make_val_panels(fb)
+        assert fig is not None
+        tr_plain.close()
+        tr_fast.close()
+
     def test_semantic_val_parity(self, tmp_path):
         from distributedpytorch_tpu.data import make_fake_voc
         from distributedpytorch_tpu.train import Trainer
