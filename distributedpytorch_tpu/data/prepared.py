@@ -437,7 +437,8 @@ class PreparedSemanticDataset(_PreparedCacheBase):
     """
 
     def __init__(self, dataset, cache_dir: str, crop_size=(513, 513),
-                 post_transform=None, uint8_arrays: bool = False):
+                 post_transform=None, uint8_arrays: bool = False,
+                 keep_fullres: bool = False, max_im_size=(512, 512)):
         if getattr(dataset, "transform", None) is not None:
             raise ValueError(
                 "PreparedSemanticDataset wraps the *untransformed* dataset "
@@ -446,6 +447,13 @@ class PreparedSemanticDataset(_PreparedCacheBase):
         self.crop_size = tuple(int(v) for v in crop_size)
         self.post_transform = post_transform
         self.uint8_arrays = bool(uint8_arrays)
+        #: eval_full_res protocol (data.val_prepared): additionally cache
+        #: the NATIVE-resolution class-id mask (uint8 ids + in-band 255
+        #: void — exact) in padded rows, emitted as ``gt_full`` so the
+        #: evaluator scores mIoU at each image's original size without
+        #: re-decoding the label PNG every epoch
+        self.keep_fullres = bool(keep_fullres)
+        self.max_im_size = tuple(int(v) for v in max_im_size)
         self._stage1 = T.Compose([
             T.FixedResize(resolutions={"image": self.crop_size,
                                        "gt": self.crop_size},
@@ -457,24 +465,32 @@ class PreparedSemanticDataset(_PreparedCacheBase):
         self.fingerprint = cache_fingerprint(
             dataset, self.crop_size, relax=0, zero_pad=False,
             fused_crop_resize=False)
-        self.cache_dir = os.path.join(cache_dir, self.fingerprint)
+        suffix = "-fullres" if self.keep_fullres else ""
+        self.cache_dir = os.path.join(cache_dir, self.fingerprint + suffix)
         self._open_or_create()
 
     def _layout(self, n, h, w):
-        return [
+        layout = [
             ("images.u8", (n, h, w, 3), np.uint8),
             ("gts.u8", (n, h, w), np.uint8),
             ("sizes.i32", (n, 2), np.int32),
             ("valid.u8", (n,), np.uint8),
         ]
+        if self.keep_fullres:
+            mh, mw = self.max_im_size
+            layout.append(("gtfull.u8", (n, mh * mw), np.uint8))
+        return layout
 
     def _open_or_create(self) -> None:
         h, w = self.crop_size
+        meta = {"format": _FORMAT_VERSION, "fingerprint": self.fingerprint,
+                "n": len(self.dataset), "crop_size": [h, w],
+                "kind": "semantic"}
+        if self.keep_fullres:
+            meta["fullres"] = True
+            meta["max_im_size"] = list(self.max_im_size)
         self._maps = _open_maps(
-            self.cache_dir,
-            {"format": _FORMAT_VERSION, "fingerprint": self.fingerprint,
-             "n": len(self.dataset), "crop_size": [h, w],
-             "kind": "semantic"},
+            self.cache_dir, meta,
             self._layout(len(self.dataset), h, w))
 
     def _fill(self, index: int):
@@ -485,6 +501,19 @@ class PreparedSemanticDataset(_PreparedCacheBase):
         gt8 = np.rint(np.asarray(sample["gt"], np.float32)).astype(np.uint8)
         im_size = raw["meta"]["im_size"] if "meta" in raw \
             else raw["image"].shape[:2]
+        if self.keep_fullres:
+            fh, fw = (int(v) for v in im_size)
+            if fh * fw > self.max_im_size[0] * self.max_im_size[1]:
+                raise ValueError(
+                    f"source image {fh}x{fw} exceeds the fullres cache's "
+                    f"max_im_size {self.max_im_size}; raise "
+                    "data.val_max_im_size (row bytes scale with it)")
+            row = np.zeros(self.max_im_size[0] * self.max_im_size[1],
+                           np.uint8)
+            full = np.rint(np.asarray(raw["gt"], np.float32)
+                           ).astype(np.uint8).reshape(-1)
+            row[:full.size] = full
+            self._maps["gtfull.u8"][index] = row
         self._maps["images.u8"][index] = img8
         self._maps["gts.u8"][index] = gt8
         self._maps["sizes.i32"][index] = im_size
@@ -499,7 +528,11 @@ class PreparedSemanticDataset(_PreparedCacheBase):
             gt8 = np.asarray(self._maps["gts.u8"][index])
             im_size = tuple(int(v) for v in self._maps["sizes.i32"][index])
             if not (img8.any() and gt8.any()
-                    and im_size[0] > 0 and im_size[1] > 0):
+                    and im_size[0] > 0 and im_size[1] > 0
+                    # fullres rows: a VOC-style semantic mask is never
+                    # all-background (objects + 255 void boundary)
+                    and (not self.keep_fullres
+                         or self._maps["gtfull.u8"][index].any())):
                 # torn write from a crashed filler: pages persist in
                 # arbitrary order per file, so ANY row (image, gt, size) can
                 # be zeros while valid=1 — a real photo is never all-black,
@@ -521,8 +554,19 @@ class PreparedSemanticDataset(_PreparedCacheBase):
                           "im_size": im_size}
         if self.post_transform is not None:
             sample = self.post_transform(sample, rng)
+        if self.keep_fullres:
+            fh, fw = im_size
+            # ragged host-side metric key (never shipped); uint8 ids
+            # exact.  .copy(), not a view: the slice shares the writable
+            # r+ memmap buffer and a consumer's in-place edit (e.g. a void
+            # remap) would silently rewrite the cached labels on disk.
+            sample["gt_full"] = np.asarray(
+                self._maps["gtfull.u8"][index][:fh * fw]
+            ).reshape(fh, fw).copy()
         return sample
 
     def __str__(self) -> str:
-        return (f"PreparedSemantic({self.dataset},crop={self.crop_size},"
+        kind = "PreparedSemanticFullres" if self.keep_fullres \
+            else "PreparedSemantic"
+        return (f"{kind}({self.dataset},crop={self.crop_size},"
                 f"fp={self.fingerprint})")

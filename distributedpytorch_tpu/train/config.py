@@ -113,8 +113,9 @@ class DataConfig:
                                         # plain path — set false for
                                         # bit-exact protocol comparisons.
                                         # The semantic full-res protocol
-                                        # (eval_full_res) keeps the plain
-                                        # ragged path.
+                                        # (eval_full_res) composes: its
+                                        # native-res gt caches as padded
+                                        # uint8 id rows (gt_full).
     val_max_im_size: tuple[int, int] = (512, 512)
                                         # eval-cache budget for the packed
                                         # full-res mask rows (instance

@@ -307,16 +307,15 @@ class Trainer:
             # overlap (SBD train covers most of VOC val — the standard
             # "train_aug" recipe needs the exclusion).
             #
-            # val fast path (data.val_prepared): the crop-res protocol's
-            # entire val front (decode → resize → clamp) is deterministic
-            # and identical to the prepared cache's stage1, so serve val
-            # from a prepared cache too — with uint8_transfer the 25 MB f32
-            # val batches (the measured 1 img/s semantic-val wire,
-            # BASELINE.md ‡) drop to uint8.  The full-res protocol keeps
-            # the plain ragged path (per-image sizes cannot be cached
-            # fixed-shape).
-            sem_val_prep = (prepared and cfg.data.val_prepared
-                            and not cfg.eval_full_res)
+            # val fast path (data.val_prepared): the semantic val front
+            # (decode → resize → clamp) is deterministic and identical to
+            # the prepared cache's stage1, so serve val from a prepared
+            # cache too — with uint8_transfer the 25 MB f32 val batches
+            # (the measured 1 img/s semantic-val wire, BASELINE.md ‡)
+            # drop to uint8.  The full-res protocol composes: its
+            # native-resolution gt caches as padded uint8 id rows,
+            # emitted ragged as ``gt_full``.
+            sem_val_prep = prepared and cfg.data.val_prepared
             self.val_set = VOCSemanticSegmentation(
                 root, split=cfg.data.val_split,
                 transform=None if sem_val_prep else
@@ -332,6 +331,8 @@ class Trainer:
                     self.val_set, cfg.data.prepared_cache,
                     crop_size=cfg.data.crop_size,
                     uint8_arrays=cfg.data.uint8_transfer,
+                    keep_fullres=cfg.eval_full_res,
+                    max_im_size=cfg.data.val_max_im_size,
                     post_transform=(
                         build_prepared_semantic_eval_post_transform(
                             uint8_wire=cfg.data.uint8_transfer)))

@@ -154,6 +154,42 @@ class TestSemanticEvalCache:
         assert s["concat"].dtype == np.uint8
         assert s["crop_gt"].dtype == np.uint8
 
+    def test_fullres_gt_cached_exactly(self, fake_voc_root, tmp_path):
+        """eval_full_res protocol: the native-resolution class-id mask is
+        cached in padded uint8 rows and must come back BIT-exact (it is
+        the metric's ground truth) alongside the resized wire keys."""
+        base = VOCSemanticSegmentation(fake_voc_root, split="val",
+                                       transform=None)
+        plain = VOCSemanticSegmentation(
+            fake_voc_root, split="val",
+            transform=build_semantic_eval_transform(crop_size=(65, 65),
+                                                    keep_fullres=True))
+        ds = PreparedSemanticDataset(
+            base, str(tmp_path / "prep"), crop_size=(65, 65),
+            keep_fullres=True, max_im_size=(256, 256),
+            post_transform=build_prepared_semantic_eval_post_transform())
+        for i in range(len(ds)):
+            got, want = ds[i], plain[i]
+            np.testing.assert_array_equal(
+                got["gt_full"],
+                np.asarray(want["gt_full"],
+                           np.uint8).reshape(got["gt_full"].shape))
+        # distinct cache dir from the crop-res eval cache
+        crop_only = PreparedSemanticDataset(
+            base, str(tmp_path / "prep"), crop_size=(65, 65),
+            post_transform=build_prepared_semantic_eval_post_transform())
+        assert crop_only.cache_dir != ds.cache_dir
+
+    def test_fullres_oversize_raises(self, fake_voc_root, tmp_path):
+        base = VOCSemanticSegmentation(fake_voc_root, split="val",
+                                       transform=None)
+        ds = PreparedSemanticDataset(
+            base, str(tmp_path / "prep"), crop_size=(65, 65),
+            keep_fullres=True, max_im_size=(8, 8),
+            post_transform=build_prepared_semantic_eval_post_transform())
+        with pytest.raises(ValueError, match="val_max_im_size"):
+            ds[0]
+
 
 class TestTrainerIntegration:
     def _cfg(self, root, tmp_path, **over):
@@ -235,6 +271,28 @@ class TestTrainerIntegration:
         sem = {"task": "semantic", "model.name": "deeplabv3",
                "model.nclass": 21, "model.in_channels": 3,
                "data.crop_size": "[65,65]"}
+        tr_plain = Trainer(self._cfg(fake_voc_root, tmp_path / "a", **sem))
+        m_plain = tr_plain.validate(epoch=0)
+        tr_fast = Trainer(self._cfg(
+            fake_voc_root, tmp_path / "b", **sem,
+            **{"data.prepared_cache": str(tmp_path / "cache"),
+               "data.uint8_transfer": "true"}))
+        tr_fast.state = tr_plain.state
+        m_fast = tr_fast.validate(epoch=0)
+        assert abs(m_fast["miou"] - m_plain["miou"]) < 2e-2
+        tr_plain.close()
+        tr_fast.close()
+
+    def test_semantic_fullres_val_parity(self, tmp_path):
+        from distributedpytorch_tpu.data import make_fake_voc
+        from distributedpytorch_tpu.train import Trainer
+
+        fake_voc_root = make_fake_voc(str(tmp_path / "voc"), n_images=12,
+                                      size=(96, 128), n_val=3, seed=5)
+        sem = {"task": "semantic", "model.name": "deeplabv3",
+               "model.nclass": 21, "model.in_channels": 3,
+               "data.crop_size": "[65,65]", "eval_full_res": "true",
+               "data.val_max_im_size": "[256,256]"}
         tr_plain = Trainer(self._cfg(fake_voc_root, tmp_path / "a", **sem))
         m_plain = tr_plain.validate(epoch=0)
         tr_fast = Trainer(self._cfg(
