@@ -117,11 +117,14 @@ class DataConfig:
                                         # native-res gt caches as padded
                                         # uint8 id rows (gt_full).
     val_max_im_size: tuple[int, int] = (512, 512)
-                                        # eval-cache budget for the packed
-                                        # full-res mask rows (instance
-                                        # val_prepared): raise for datasets
-                                        # with images larger than VOC's
-                                        # 500px sides
+                                        # eval-cache budget for native-res
+                                        # mask rows (instance packed
+                                        # gt/void bits AND the semantic
+                                        # eval_full_res gt_full ids):
+                                        # raise for datasets with images
+                                        # larger than VOC's 500px sides
+                                        # (changing it rebuilds the val
+                                        # cache)
     decode_cache: int = 0               # decode-once LRU over this many
                                         # images (FFCV-style; instance mode
                                         # revisits an image once per object

@@ -192,6 +192,20 @@ if __name__ == "__main__":
          "data.crop_size": [513, 513], "data.val_batch": 8,
          "data.prepared_cache": "AUTO_SEM", "data.uint8_transfer": True,
          "data.val_prepared": False},
+        # 17: the FULL-RES semantic protocol (metric at native size) on
+        # the prepared val path — gt_full served from padded uint8 rows
+        {"task": "semantic", "model.name": "deeplabv3", "model.nclass": 21,
+         "model.in_channels": 3, "model.output_stride": 16,
+         "data.crop_size": [513, 513], "data.val_batch": 8,
+         "eval_full_res": True,
+         "data.prepared_cache": "AUTO_SEM", "data.uint8_transfer": True},
+        # 18: full-res control (plain ragged val path)
+        {"task": "semantic", "model.name": "deeplabv3", "model.nclass": 21,
+         "model.in_channels": 3, "model.output_stride": 16,
+         "data.crop_size": [513, 513], "data.val_batch": 8,
+         "eval_full_res": True,
+         "data.prepared_cache": "AUTO_SEM", "data.uint8_transfer": True,
+         "data.val_prepared": False},
     ]
     sel = sys.argv[1:]
     try:
