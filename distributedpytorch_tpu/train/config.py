@@ -345,7 +345,7 @@ def _from_dict(cls, d: dict):
             v = _from_dict(ftype, v)
         elif f.name in ("crop_size", "rots", "scales", "loss_weights",
                         "eval_thresholds", "eval_tta_scales",
-                        "freeze") and isinstance(v, list):
+                        "freeze", "val_max_im_size") and isinstance(v, list):
             v = tuple(v)
         kwargs[f.name] = v
     return cls(**kwargs)
