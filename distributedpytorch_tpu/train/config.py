@@ -283,6 +283,23 @@ class Config:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     epochs: int = 100
     eval_every: int = 1                 # nTestInterval (train_pascal.py:62)
+    val_overlap: bool = False           # run each validation on a thread
+                                        # CONCURRENTLY with the next train
+                                        # epoch (eval forwards interleave
+                                        # on device; paste-back runs beside
+                                        # the loader) — hides the val epoch
+                                        # behind training wall-clock.
+                                        # Best-save/logging land when the
+                                        # next train epoch finishes — so a
+                                        # HARD crash (no SIGTERM) during
+                                        # that epoch loses one more epoch
+                                        # than serial mode would (the
+                                        # deferred checkpoint never
+                                        # landed).  Costs one extra full
+                                        # state in HBM while in flight;
+                                        # single-process only (two threads
+                                        # issuing collectives could
+                                        # deadlock across hosts).
     eval_thresholds: tuple[float, ...] = (0.3, 0.5, 0.8)
     eval_tta_scales: tuple[float, ...] = ()  # semantic TTA: average softmax
                                         # probs over these input scales

@@ -196,6 +196,15 @@ class Trainer:
                 raise ValueError(
                     f"data.device_guidance supports {_DEV_FAM}, not "
                     f"{cfg.data.guidance!r}")
+        if cfg.val_overlap and jax.process_count() > 1:
+            raise ValueError(
+                "val_overlap is single-process only: the val thread and "
+                "the train loop would issue cross-host collectives in "
+                "unsynchronized order (a distributed deadlock), so "
+                "multi-host runs must validate serially")
+        #: in-flight overlapped validation (val_overlap): set by
+        #: _launch_overlapped_val, consumed by _join_overlapped_val
+        self._pending_val = None
         #: set by the instance branch when the prepared val wire ships
         #: 3-channel batches and the eval step owns guidance synthesis
         self._val_device_guidance = False
@@ -701,7 +710,8 @@ class Trainer:
     # ------------------------------------------------------------------ train
     def train_epoch(self, epoch: int,
                     guard: PreemptionGuard | None = None,
-                    start_batch: int = 0) -> float:
+                    start_batch: int = 0,
+                    abort_check=None) -> float:
         """One epoch; returns mean train loss (the reference printed the
         running loss once per epoch, train_pascal.py:207-212).
 
@@ -797,6 +807,11 @@ class Trainer:
                     break
                 crossed = (step // cfg.log_every_steps) \
                     != ((step - n_steps) // cfg.log_every_steps)
+                if crossed and abort_check is not None:
+                    # val_overlap: a failure on the val thread (e.g. the
+                    # non-finite watchdog) must abort training NOW, not a
+                    # full epoch later at the join
+                    abort_check()
                 if crossed:
                     # The log-cadence sync runs on EVERY process, not just
                     # main: the watchdog below must raise on all hosts
@@ -883,13 +898,16 @@ class Trainer:
         return mean_loss
 
     # ------------------------------------------------------------------- eval
-    def validate(self, epoch: int | None = None, log_panels: bool = True
-                 ) -> dict:
+    def _eval_metrics(self, state, epoch: int | None = None
+                      ) -> tuple[dict, dict | None]:
+        """The device/host evaluation half of :meth:`validate` — no writer
+        or checkpoint side effects, so it is safe to run on the val-overlap
+        thread against a snapshot ``state``."""
         self.val_loader.set_epoch(0)
         with self.mesh:
             if self.cfg.task == "semantic":
                 metrics = evaluate_semantic(
-                    self.eval_step, self.state, self.val_loader,
+                    self.eval_step, state, self.val_loader,
                     nclass=self.cfg.model.nclass, mesh=self.mesh,
                     tta_scales=self.cfg.eval_tta_scales,
                     tta_flip=self.cfg.eval_tta_flip,
@@ -897,7 +915,7 @@ class Trainer:
                     bf16_probs=self.cfg.eval_bf16_probs)
             else:
                 metrics = evaluate(
-                    self.eval_step, self.state, self.val_loader,
+                    self.eval_step, state, self.val_loader,
                     thresholds=self.cfg.eval_thresholds,
                     relax=self.cfg.data.relax,
                     zero_pad=self.cfg.data.zero_pad, mesh=self.mesh,
@@ -912,8 +930,21 @@ class Trainer:
                 f"non-finite val loss {metrics['loss']} at epoch {epoch} — "
                 "divergence; lower optim.lr, enable optim.grad_clip_norm, "
                 "or set optim.loss_scale for bf16 underflow")
+        return metrics, first
+
+    def validate(self, epoch: int | None = None, log_panels: bool = True,
+                 state=None) -> dict:
+        state = self.state if state is None else state
+        metrics, first = self._eval_metrics(state, epoch)
+        self._log_val(metrics, first, epoch, int(state.step),
+                      log_panels=log_panels)
+        return metrics
+
+    def _log_val(self, metrics: dict, first: dict | None,
+                 epoch: int | None, step: int,
+                 log_panels: bool = True) -> None:
+        """Writer half of validation — main thread only."""
         if self.is_main:
-            step = int(self.state.step)
             flat = {"val/loss": metrics["loss"],
                     "val/jaccard": metrics["jaccard"]}
             if "best_threshold" in metrics:
@@ -934,7 +965,76 @@ class Trainer:
                     plt.close(fig)
                 except Exception:
                     pass  # visualization must never kill training
-        return metrics
+
+    # ----------------------------------------------------- val overlap
+    def _launch_overlapped_val(self, epoch: int, step: int) -> None:
+        """Start validation of the CURRENT state on a thread (val_overlap):
+        the next train epoch proceeds while eval forwards interleave on the
+        device and the paste-back runs beside the loader.
+
+        The snapshot must be a device-side COPY, not a reference: the
+        train step donates its state argument, so the next epoch's first
+        step would delete the original buffers while the val thread (and
+        the deferred best-save) still read them.  One extra full state in
+        HBM until the join; the copy itself is a single pass of HBM
+        bandwidth (~ms).  All writer/checkpoint side effects happen at
+        :meth:`_join_overlapped_val` on the main thread."""
+        import threading
+
+        with self.mesh:
+            state = jax.tree.map(
+                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+                self.state)
+        box: dict = {}
+
+        def run() -> None:
+            try:
+                box["result"] = self._eval_metrics(state, epoch)
+            except BaseException as e:  # re-raised at join
+                box["error"] = e
+
+        t = threading.Thread(target=run, name=f"val-overlap-{epoch}",
+                             daemon=True)
+        t.start()
+        self._pending_val = (epoch, step, state, t, box)
+
+    def _poll_overlapped_val_error(self) -> None:
+        """Fail fast if the in-flight overlapped validation already died
+        (called at the train loop's log cadence): without this, a val-side
+        divergence watchdog would only surface at the join, a full train
+        epoch after the fact."""
+        pending = self._pending_val
+        if pending is not None and "error" in pending[4]:
+            self._join_overlapped_val(None)  # immediate join; raises
+
+    def _join_overlapped_val(self, history: dict | None) -> None:
+        """Wait for the in-flight overlapped validation (if any) and apply
+        its deferred epoch-end bookkeeping via :meth:`_finish_val`."""
+        pending = self._pending_val
+        if pending is None:
+            return
+        self._pending_val = None
+        epoch, step, state, thread, box = pending
+        thread.join()
+        if "error" in box:
+            raise box["error"]
+        metrics, first = box["result"]
+        self._finish_val(metrics, first, epoch, step, state, history)
+
+    def _finish_val(self, metrics: dict, first: dict | None, epoch: int,
+                    step: int, state, history: dict | None) -> None:
+        """THE epoch-end validation bookkeeping — one owner for both the
+        serial and overlapped schedules (logging, history, best-gated
+        checkpoint of ``state`` at ``step``)."""
+        self._log_val(metrics, first, epoch, step)
+        if history is not None:
+            history["val"].append(metrics)
+        is_best = self.ckpt.save(step, state, metric=metrics["jaccard"],
+                                 extra={"epoch": epoch})
+        if is_best and self.is_main:
+            self.writer.scalars(
+                {"val/new_best_jaccard": metrics["jaccard"],
+                 "val/epoch": epoch}, step)
 
     # -------------------------------------------------------------------- fit
     def fit(self, guard: PreemptionGuard | None = None) -> dict:
@@ -984,8 +1084,14 @@ class Trainer:
                 else:
                     ctx = contextlib.nullcontext()
                 with ctx:
-                    epoch_loss = self.train_epoch(epoch, guard=guard,
-                                                  start_batch=sb)
+                    epoch_loss = self.train_epoch(
+                        epoch, guard=guard, start_batch=sb,
+                        abort_check=(self._poll_overlapped_val_error
+                                     if cfg.val_overlap else None))
+                # the previous epoch's overlapped validation ran during
+                # this train epoch; land its bookkeeping (best save, logs)
+                # before this epoch's own epoch-end work
+                self._join_overlapped_val(history)
                 step = int(self.state.step)
                 if guard is not None and guard.should_stop():
                     # The partial epoch is not appended to history; the
@@ -1025,15 +1131,16 @@ class Trainer:
                 history["train_loss"].append(epoch_loss)
                 extra = {"epoch": epoch}
                 if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
-                    metrics = self.validate(epoch)
-                    history["val"].append(metrics)
-                    is_best = self.ckpt.save(step, self.state,
-                                             metric=metrics["jaccard"],
-                                             extra=extra)
-                    if is_best and self.is_main:
-                        self.writer.scalars(
-                            {"val/new_best_jaccard": metrics["jaccard"],
-                             "val/epoch": epoch}, step)
+                    if cfg.val_overlap:
+                        # validate concurrently with the NEXT train epoch
+                        # (joined after it); the last epoch's launch is
+                        # joined right after the loop
+                        self._launch_overlapped_val(epoch, step)
+                    else:
+                        metrics, first = self._eval_metrics(self.state,
+                                                            epoch)
+                        self._finish_val(metrics, first, epoch, step,
+                                         self.state, history)
                 elif cfg.checkpoint.snapshot_every and \
                         (epoch + 1) % cfg.checkpoint.snapshot_every == 0:
                     self.ckpt.save(step, self.state, extra=extra)
@@ -1046,6 +1153,9 @@ class Trainer:
             # handlers must stay installed, and escalation deferred, until
             # the last async save has committed.
             with guard.shield() if guard is not None else contextlib.nullcontext():
+                # the final epoch's overlapped validation has no train
+                # epoch to hide behind; land it before the last save wait
+                self._join_overlapped_val(history)
                 self.ckpt.wait()
             self.writer.flush()
         return history

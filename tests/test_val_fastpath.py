@@ -305,6 +305,31 @@ class TestTrainerIntegration:
         tr_plain.close()
         tr_fast.close()
 
+    def test_val_overlap_matches_serial_fit(self, fake_voc_root, tmp_path):
+        """val_overlap runs each validation concurrently with the next
+        train epoch.  The evaluated states are identical to the serial
+        schedule (training never waits on val), so the val curves must
+        match; best-checkpoint gating must also land."""
+        import glob
+
+        from distributedpytorch_tpu.train import Trainer
+
+        hists = {}
+        for mode, flag in (("serial", "false"), ("overlap", "true")):
+            tr = Trainer(self._cfg(fake_voc_root, tmp_path / mode,
+                                   **{"epochs": 3,
+                                      "val_overlap": flag}))
+            hists[mode] = tr.fit()
+            tr.close()
+            assert glob.glob(str(tmp_path / mode / "**" / "best*"),
+                             recursive=True), f"{mode}: no best checkpoint"
+        assert len(hists["overlap"]["val"]) == \
+            len(hists["serial"]["val"]) == 3
+        for a, b in zip(hists["serial"]["val"], hists["overlap"]["val"]):
+            assert abs(a["jaccard"] - b["jaccard"]) < 1e-5
+        assert hists["serial"]["train_loss"] == pytest.approx(
+            hists["overlap"]["train_loss"], abs=1e-6)
+
     def test_val_prepared_off_keeps_plain_path(self, fake_voc_root,
                                                tmp_path):
         from distributedpytorch_tpu.train import Trainer
