@@ -1007,9 +1007,12 @@ class Trainer:
         if pending is not None and "error" in pending[4]:
             self._join_overlapped_val(None)  # immediate join; raises
 
-    def _join_overlapped_val(self, history: dict | None) -> None:
+    def _join_overlapped_val(self, history: dict | None,
+                             finish: bool = True) -> None:
         """Wait for the in-flight overlapped validation (if any) and apply
-        its deferred epoch-end bookkeeping via :meth:`_finish_val`."""
+        its deferred epoch-end bookkeeping via :meth:`_finish_val`.
+        ``finish=False`` waits only (benchmarks timing the schedule must
+        not fold checkpoint/panel costs into the measurement)."""
         pending = self._pending_val
         if pending is None:
             return
@@ -1018,8 +1021,22 @@ class Trainer:
         thread.join()
         if "error" in box:
             raise box["error"]
-        metrics, first = box["result"]
-        self._finish_val(metrics, first, epoch, step, state, history)
+        if finish:
+            metrics, first = box["result"]
+            self._finish_val(metrics, first, epoch, step, state, history)
+
+    def _discard_overlapped_val(self) -> None:
+        """Abandon the in-flight overlapped validation: join the thread
+        (it reads a valid snapshot; letting it run unsupervised would race
+        a later validate() on the shared val loader and pin the extra HBM
+        state) and drop its result.  For unwind paths only — a primary
+        exception is already propagating, so the box's own error (if any)
+        is intentionally swallowed."""
+        pending = self._pending_val
+        if pending is None:
+            return
+        self._pending_val = None
+        pending[3].join()
 
     def _finish_val(self, metrics: dict, first: dict | None, epoch: int,
                     step: int, state, history: dict | None) -> None:
@@ -1070,6 +1087,12 @@ class Trainer:
             if guard is None and cfg.checkpoint.save_on_preempt:
                 guard = stack.enter_context(PreemptionGuard(
                     check_every=cfg.checkpoint.preempt_check_every))
+            # an exception unwinding past the loop (train-side watchdog,
+            # Ctrl-C without a guard) must not strand the val-overlap
+            # thread: it would race a later validate() on the shared val
+            # loader and pin the snapshot's HBM.  Normal completion joins
+            # with full bookkeeping below, making this a no-op.
+            stack.callback(self._discard_overlapped_val)
             for epoch in range(self.start_epoch, cfg.epochs):
                 t0 = time.perf_counter()
                 sb = self._resume_start_batch  # only the run's first epoch

@@ -66,6 +66,7 @@ EPOCHS_TIMED = 1 if CPU_SMOKE else 2  # after a warmup epoch (compile + caches)
 def run(fixture_root: str, overrides: dict) -> dict:
     work = tempfile.mkdtemp(prefix="bench_e2e_")
     overrides = dict(overrides)
+    schedule = overrides.pop("_schedule", None)  # not a Config field
     if str(overrides.get("data.prepared_cache", "")).startswith("AUTO"):
         # shared across variants on purpose: same crop config -> same
         # fingerprint -> later variants start warm (like a user's epoch 2+)
@@ -88,6 +89,8 @@ def run(fixture_root: str, overrides: dict) -> dict:
     try:
         trainer = Trainer(cfg)
         n_batches = len(trainer.train_loader)
+        if schedule:
+            return run_schedule(trainer, cfg, n_batches, schedule)
         trainer.train_epoch(0)  # warmup: compile + any decode cache fill
         t0 = time.perf_counter()
         for ep in range(1, 1 + EPOCHS_TIMED):
@@ -121,6 +124,45 @@ def run(fixture_root: str, overrides: dict) -> dict:
         return rec
     finally:
         shutil.rmtree(work, ignore_errors=True)
+
+
+def run_schedule(trainer: Trainer, cfg, n_batches: int,
+                 schedule: str) -> dict:
+    """Epoch wall-clock INCLUDING validation, for the serial vs
+    val_overlap A/B: the plain variants time train epochs and val epochs
+    separately, which cannot show what overlap hides.
+
+    Symmetry rules (the A/B is meaningless without them): both schedules
+    run EPOCHS_TIMED train epochs and EPOCHS_TIMED evaluations, neither
+    pays checkpoint/panel costs inside the timed region (``_eval_metrics``
+    / ``finish=False``), and every overlapped validation is joined AFTER a
+    timed train epoch it could hide behind — the steady-state pipeline
+    shape, achieved by launching the first val just before the clock
+    starts and not launching one after the last train epoch."""
+    trainer.train_epoch(0)
+    trainer._eval_metrics(trainer.state)      # warm eval program + caches
+    overlap = schedule == "overlap"
+    if overlap:
+        trainer._launch_overlapped_val(0, int(trainer.state.step))
+    t0 = time.perf_counter()
+    for ep in range(1, 1 + EPOCHS_TIMED):
+        trainer.train_epoch(
+            ep, abort_check=(trainer._poll_overlapped_val_error
+                             if overlap else None))
+        if overlap:
+            trainer._join_overlapped_val(None, finish=False)
+            if ep < EPOCHS_TIMED:
+                trainer._launch_overlapped_val(
+                    ep, int(trainer.state.step))
+        else:
+            trainer._eval_metrics(trainer.state)
+    jax.block_until_ready(jax.tree.leaves(trainer.state.params)[0])
+    dt = time.perf_counter() - t0
+    fresh = EPOCHS_TIMED * n_batches * cfg.data.train_batch
+    return {"schedule": schedule,
+            "epoch_incl_val_seconds": round(dt / EPOCHS_TIMED, 2),
+            "epoch_incl_val_imgs_per_sec_per_chip": round(
+                fresh / dt / jax.device_count(), 2)}
 
 
 if __name__ == "__main__":
@@ -206,6 +248,14 @@ if __name__ == "__main__":
          "eval_full_res": True,
          "data.prepared_cache": "AUTO_SEM", "data.uint8_transfer": True,
          "data.val_prepared": False},
+        # 19/20: epoch wall INCLUDING validation, serial vs val_overlap —
+        # the overlap hides the val epoch behind the next train epoch
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True, "data.val_batch": 8,
+         "_schedule": "serial"},
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True, "data.val_batch": 8,
+         "val_overlap": True, "_schedule": "overlap"},
     ]
     sel = sys.argv[1:]
     try:
