@@ -283,6 +283,31 @@ class TestTrainerIntegration:
         tr_plain.close()
         tr_fast.close()
 
+    def test_semantic_tta_composes_with_prepared_val(self, tmp_path):
+        """Multi-scale + flip TTA reads the val batch host-side and
+        re-forwards resized copies — it must compose with the uint8
+        prepared val wire and match the plain path's TTA mIoU."""
+        from distributedpytorch_tpu.data import make_fake_voc
+        from distributedpytorch_tpu.train import Trainer
+
+        fake_voc_root = make_fake_voc(str(tmp_path / "voc"), n_images=12,
+                                      size=(96, 128), n_val=3, seed=9)
+        sem = {"task": "semantic", "model.name": "deeplabv3",
+               "model.nclass": 21, "model.in_channels": 3,
+               "data.crop_size": "[65,65]",
+               "eval_tta_scales": "[0.75,1.0]", "eval_tta_flip": "true"}
+        tr_plain = Trainer(self._cfg(fake_voc_root, tmp_path / "a", **sem))
+        m_plain = tr_plain.validate(epoch=0)
+        tr_fast = Trainer(self._cfg(
+            fake_voc_root, tmp_path / "b", **sem,
+            **{"data.prepared_cache": str(tmp_path / "cache"),
+               "data.uint8_transfer": "true"}))
+        tr_fast.state = tr_plain.state
+        m_fast = tr_fast.validate(epoch=0)
+        assert abs(m_fast["miou"] - m_plain["miou"]) < 2e-2
+        tr_plain.close()
+        tr_fast.close()
+
     def test_semantic_fullres_val_parity(self, tmp_path):
         from distributedpytorch_tpu.data import make_fake_voc
         from distributedpytorch_tpu.train import Trainer
