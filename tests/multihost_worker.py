@@ -58,6 +58,18 @@ def main() -> None:
     if mode == "preempt":
         overrides += ["epochs=200", "eval_every=0",
                       "checkpoint.snapshot_every=0", "log_every_steps=10000"]
+    elif mode == "prepared":
+        # both processes share ONE prepared cache (train + eval) on the
+        # common filesystem — the flock'd init and idempotent row fills
+        # must survive two hosts racing, and the prepared VAL protocol
+        # (uint8 wire + device guidance + packed full-res metric masks)
+        # must reduce to identical global metrics on every host
+        overrides += [
+            "data.prepared_cache=" + os.path.join(
+                os.environ["WORK_DIR"], "..", "prep_cache"),
+            "data.uint8_transfer=true", "data.device_guidance=true",
+            "data.packbits_masks=true",  # 1-bit crop_gt wire, both loops
+            "data.val_max_im_size=[128,128]"]
     cfg = apply_overrides(Config(), overrides)
     cfg = dataclasses.replace(
         cfg, work_dir=os.environ["WORK_DIR"],

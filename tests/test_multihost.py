@@ -89,6 +89,22 @@ def test_two_process_training(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_prepared_fast_path(tmp_path):
+    """The full fast path (shared prepared cache for train AND val, uint8
+    wire, device guidance, prepared val metric masks) across 2 processes:
+    the flock'd cache init and idempotent fills must survive two hosts
+    racing on one filesystem, and the prepared-val protocol must reduce to
+    identical global metrics on every host."""
+    results = _run_two_workers(tmp_path, mode="prepared")
+    a, b = results[0], results[1]
+    assert a["run_dir"] == b["run_dir"]
+    assert a["jaccard"] == b["jaccard"]
+    assert 0.0 <= a["jaccard"] <= 1.0
+    assert a["n_samples"] == b["n_samples"] >= 3
+    assert a["ckpt_step"] == b["ckpt_step"] is not None
+
+
+@pytest.mark.slow
 def test_two_process_preemption_consensus(tmp_path):
     """A stop signal delivered to ONE process must stop BOTH at the same
     step via the consensus allgather, land one coordinated final
