@@ -30,7 +30,6 @@ params + grads, not two.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Mapping
 
 import jax
