@@ -1,0 +1,8 @@
+#!/bin/bash
+# VERDICT r3 item 2: op-level profile of the semantic flagship (config 4)
+# — only the DANet shape has profiles so far; explain the 63.6 GB/step.
+set -x
+cd /root/repo
+python scripts/profile_step.py --model deeplabv3 --batch 8 --out /tmp/prof_dl_b8 | tee artifacts/r4/prof_deeplab_b8.json
+# second half of VERDICT item 2: attribute the DANet+bf16-scores residual
+python scripts/profile_step.py --score-dtype bfloat16 --batch 8 --out /tmp/prof_danet_bf16s | tee artifacts/r4/prof_danet_bf16scores_b8.json

@@ -1,0 +1,13 @@
+#!/bin/bash
+# VERDICT r3 item 5: the b16 fixes the op profiles prescribe, A/B'd with
+# the official harness (cost-model + roofline fields in every record).
+set -x
+cd /root/repo
+export DPTPU_BENCH_RECOVERY_MINUTES=2
+DPTPU_BENCH_BATCH=16 python bench.py | tee artifacts/r4/bench_b16_base.json
+DPTPU_BENCH_BATCH=16 DPTPU_BENCH_BN_STATS=compute python bench.py | tee artifacts/r4/bench_b16_bnstats.json
+DPTPU_BENCH_BATCH=16 DPTPU_BENCH_REMAT=1 DPTPU_BENCH_REMAT_POLICY=dots_saveable python bench.py | tee artifacts/r4/bench_b16_rematdots.json
+DPTPU_BENCH_BATCH=16 DPTPU_BENCH_SCORE_DTYPE=bfloat16 python bench.py | tee artifacts/r4/bench_b16_bf16scores.json
+DPTPU_BENCH_BATCH=16 DPTPU_BENCH_SCORE_DTYPE=bfloat16 DPTPU_BENCH_BN_STATS=compute python bench.py | tee artifacts/r4/bench_b16_bnstats_bf16scores.json
+DPTPU_BENCH_BN_STATS=compute python bench.py | tee artifacts/r4/bench_b8_bnstats.json
+DPTPU_BENCH_BN_STATS=compute DPTPU_BENCH_SCORE_DTYPE=bfloat16 python bench.py | tee artifacts/r4/bench_b8_bnstats_bf16scores.json

@@ -1,0 +1,6 @@
+#!/bin/bash
+# VERDICT r3 item 2: attack the semantic flagship's above-roofline bytes
+set -x
+cd /root/repo
+export DPTPU_BENCH_RECOVERY_MINUTES=2
+DPTPU_BENCH_MODEL=deeplabv3 DPTPU_BENCH_BN_STATS=compute python bench.py | tee artifacts/r4/bench_deeplab_bnstats.json

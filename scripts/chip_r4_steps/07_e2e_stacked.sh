@@ -1,0 +1,7 @@
+#!/bin/bash
+# VERDICT r3 items 3+6: val fast path rows + the stacked e2e headline,
+# all in ONE sequential run (tunnel drift makes cross-run e2e deltas noise)
+set -x
+cd /root/repo
+export DPTPU_BENCH_RECOVERY_MINUTES=2
+python scripts/bench_e2e.py 8 10 12 14 15 16 17 18 19 20 | tee artifacts/r4/bench_e2e_r4.jsonl

@@ -1,0 +1,7 @@
+#!/bin/bash
+# VERDICT r3 item 4: flagship-shape semantic convergence on the VISIBLE
+# fixture (DeepLabV3-R101 513^2, 1000 train images, 60 epochs)
+set -x
+cd /root/repo
+export DPTPU_BENCH_RECOVERY_MINUTES=2
+python scripts/convergence_runs.py e --epochs 60 | tee artifacts/r4/conv_c_visible.jsonl

@@ -1,0 +1,9 @@
+#!/bin/bash
+# VERDICT r3 item 3: per-stage val budgets — instance fast path, semantic
+# crop-res fast path, and the full-res protocol's decode-heavy front
+set -x
+cd /root/repo
+export DPTPU_BENCH_RECOVERY_MINUTES=2
+python scripts/bench_breakdown.py valhost valplace valstep valmetric data.val_batch=8 | tee artifacts/r4/breakdown_val_instance.json
+python scripts/bench_breakdown.py valhost valplace valstep task=semantic model.name=deeplabv3 model.nclass=21 model.in_channels=3 model.output_stride=16 "data.crop_size=[513,513]" data.val_batch=8 data.device_guidance=false | tee artifacts/r4/breakdown_val_semantic.json
+python scripts/bench_breakdown.py valhost task=semantic model.name=deeplabv3 model.nclass=21 model.in_channels=3 model.output_stride=16 "data.crop_size=[513,513]" data.val_batch=8 data.device_guidance=false eval_full_res=true | tee artifacts/r4/breakdown_val_semantic_fullres.json
