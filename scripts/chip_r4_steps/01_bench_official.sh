@@ -1,6 +1,7 @@
 #!/bin/bash
 # Official bench, default config — highest-value artifact (writes the
 # replay sidecar so BENCH_r04.json survives a wedged round-end window).
+set -eo pipefail
 set -x
 cd /root/repo
 DPTPU_BENCH_RECOVERY_MINUTES=2 python bench.py | tee artifacts/r4/bench_mfu.json

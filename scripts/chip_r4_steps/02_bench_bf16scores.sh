@@ -1,4 +1,5 @@
 #!/bin/bash
+set -eo pipefail
 set -x
 cd /root/repo
 DPTPU_BENCH_RECOVERY_MINUTES=2 DPTPU_BENCH_SCORE_DTYPE=bfloat16 python bench.py | tee artifacts/r4/bench_mfu_bf16scores.json

@@ -1,6 +1,7 @@
 #!/bin/bash
 # VERDICT r3 item 2: op-level profile of the semantic flagship (config 4)
 # — only the DANet shape has profiles so far; explain the 63.6 GB/step.
+set -eo pipefail
 set -x
 cd /root/repo
 python scripts/profile_step.py --model deeplabv3 --batch 8 --out /tmp/prof_dl_b8 | tee artifacts/r4/prof_deeplab_b8.json

@@ -1,6 +1,7 @@
 #!/bin/bash
 # VERDICT r3 item 5: the b16 fixes the op profiles prescribe, A/B'd with
 # the official harness (cost-model + roofline fields in every record).
+set -eo pipefail
 set -x
 cd /root/repo
 export DPTPU_BENCH_RECOVERY_MINUTES=2

@@ -1,5 +1,6 @@
 #!/bin/bash
 # VERDICT r3 item 2: attack the semantic flagship's above-roofline bytes
+set -eo pipefail
 set -x
 cd /root/repo
 export DPTPU_BENCH_RECOVERY_MINUTES=2

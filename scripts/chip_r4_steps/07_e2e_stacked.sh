@@ -1,6 +1,7 @@
 #!/bin/bash
 # VERDICT r3 items 3+6: val fast path rows + the stacked e2e headline,
 # all in ONE sequential run (tunnel drift makes cross-run e2e deltas noise)
+set -eo pipefail
 set -x
 cd /root/repo
 export DPTPU_BENCH_RECOVERY_MINUTES=2

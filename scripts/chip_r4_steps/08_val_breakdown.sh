@@ -1,6 +1,7 @@
 #!/bin/bash
 # VERDICT r3 item 3: per-stage val budgets — instance fast path, semantic
 # crop-res fast path, and the full-res protocol's decode-heavy front
+set -eo pipefail
 set -x
 cd /root/repo
 export DPTPU_BENCH_RECOVERY_MINUTES=2

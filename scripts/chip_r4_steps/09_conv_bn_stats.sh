@@ -1,0 +1,7 @@
+#!/bin/bash
+# accuracy gate for model.bn_fp32_stats=false (stacked with bf16 scores)
+set -eo pipefail
+set -x
+cd /root/repo
+export DPTPU_BENCH_RECOVERY_MINUTES=2
+python scripts/convergence_runs.py g --epochs 30 | tee artifacts/r4/conv_g_bnstats.jsonl

@@ -1,7 +1,7 @@
 #!/bin/bash
-# VERDICT r3 item 7: re-quote b' and f' at the 200-image val split
+# VERDICT r3 item 7: re-quote b' at the 200-image val split
+set -eo pipefail
 set -x
 cd /root/repo
 export DPTPU_BENCH_RECOVERY_MINUTES=2
 python scripts/convergence_runs.py b --epochs 30 | tee artifacts/r4/conv_b_v200.jsonl
-python scripts/convergence_runs.py f --epochs 60 | tee artifacts/r4/conv_f_v200.jsonl

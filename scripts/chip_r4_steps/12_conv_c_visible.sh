@@ -1,6 +1,7 @@
 #!/bin/bash
 # VERDICT r3 item 4: flagship-shape semantic convergence on the VISIBLE
 # fixture (DeepLabV3-R101 513^2, 1000 train images, 60 epochs)
+set -eo pipefail
 set -x
 cd /root/repo
 export DPTPU_BENCH_RECOVERY_MINUTES=2

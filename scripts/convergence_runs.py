@@ -26,8 +26,13 @@ Real-chip runs a-d share a 200-image fake-VOC at real image sizes
      from-scratch outcome; the reference only ever fine-tuned a
      pretrained .pth).
 
+  g. bf16 BN batch stats: run (a)'s config with ``model.bn_fp32_stats=
+     false`` stacked on bf16 PAM scores — the accuracy gate for the
+     round-4 convert_reduce_fusion attack; compare against curves (a)
+     and (d).
+
 Prints one JSON line per run with the per-epoch val metric curve.
-Usage: python scripts/convergence_runs.py [a b c d e f] [--epochs N]
+Usage: python scripts/convergence_runs.py [a b c d e f g] [--epochs N]
 """
 
 from __future__ import annotations
@@ -130,7 +135,8 @@ def run(name: str, fixture: str, overrides: dict) -> dict:
 
 
 if __name__ == "__main__":
-    sel = [a for a in sys.argv[1:] if a in ("a", "b", "c", "d", "e", "f")] \
+    sel = [a for a in sys.argv[1:]
+           if a in ("a", "b", "c", "d", "e", "f", "g")] \
         or ["a", "b", "c", "d"]  # e is opt-in: 5x the fixture, ~4x the wall
     fixture = None
     if set(sel) - {"e", "f"}:
@@ -156,6 +162,15 @@ if __name__ == "__main__":
         },
         "d_bf16_scores": {"data.device_guidance": True,
                           "model.pam_score_dtype": "bfloat16"},
+        # g: the accuracy gate for model.bn_fp32_stats=false (VERDICT r3
+        # item 5): run a's config with BN batch stats in bf16, stacked
+        # with bf16 PAM scores — compare best/plateau vs runs a and d.
+        # bf16 fast-variance cancels hardest on the raw-[0,255] stem BN
+        # (test_models pins ~5-10% relative variance error); this run
+        # answers whether that moves the trained metric.
+        "g_bf16_bn_stats": {"data.device_guidance": True,
+                            "model.pam_score_dtype": "bfloat16",
+                            "model.bn_fp32_stats": False},
     }
     # e extends c's semantic evidence to the big fixture: SAME model
     # config by construction, so the plateau comparison stays valid if c
