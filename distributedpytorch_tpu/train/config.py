@@ -319,8 +319,12 @@ class Config:
                                         # measured bound of the full-res
                                         # protocol on a slow wire); argmax-
                                         # after-resize is tie-epsilon
-                                        # sensitive only (tested).  false
-                                        # restores exact f32 readback.
+                                        # sensitive only (tested).  Also
+                                        # halves the INSTANCE val logit
+                                        # readback (boundary-pixel rounding
+                                        # at the thresholds; tested).
+                                        # false restores exact f32
+                                        # readback everywhere.
     seed: int = 0
     work_dir: str = "runs"              # run_<N> dirs created under this
     resume: str | None = None           # checkpoint dir to resume from, or

@@ -24,6 +24,7 @@ import time
 from typing import Callable, Mapping, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..ops.metrics import np_jaccard_thresholds
@@ -69,6 +70,7 @@ def evaluate(
     max_batches: int | None = None,
     debug_asserts: bool = False,
     packed_masks: bool = False,
+    bf16_readback: bool = False,
 ) -> dict:
     """Run the full validation protocol; returns a metrics dict.
 
@@ -121,8 +123,16 @@ def evaluate(
 
     for batch, outputs in forwarded():
         n = batch[INPUT_KEY].shape[0]
-        # primary head only; ragged paste-back per sample on host
-        probs = _sigmoid(_local_rows(outputs[0])[:n])
+        # primary head only; ragged paste-back per sample on host.
+        # bf16_readback (eval_bf16_probs): cast the logit volume to bf16
+        # ON DEVICE before the D2H fetch — half the val readback bytes
+        # (same policy the semantic full-res path uses); threshold-level
+        # effects are boundary-pixel rounding only (tested).
+        raw = outputs[0]
+        if bf16_readback and isinstance(raw, jax.Array):
+            raw = raw.astype(jnp.bfloat16)
+        probs = _sigmoid(
+            _local_rows(raw)[:n].astype(np.float32, copy=False))
         if first_batch_vis is None:
             vis_batch = batch
             if packed_masks:

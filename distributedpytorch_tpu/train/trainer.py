@@ -920,7 +920,8 @@ class Trainer:
                     relax=self.cfg.data.relax,
                     zero_pad=self.cfg.data.zero_pad, mesh=self.mesh,
                     debug_asserts=self.cfg.debug_asserts,
-                    packed_masks=self._val_packbits)
+                    packed_masks=self._val_packbits,
+                    bf16_readback=self.cfg.eval_bf16_probs)
         first = metrics.pop("_first_batch", None)
         if self.cfg.debug_asserts and not np.isfinite(metrics["loss"]):
             # Watchdog, val side: a 1-step epoch's train loss is computed

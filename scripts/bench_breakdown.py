@@ -289,9 +289,18 @@ def stage_valmetric(tr: Trainer, batch: dict, dev: dict) -> dict:
     zero_pad = tr.cfg.data.zero_pad
     mesh = tr.mesh
     with mesh:
+        import jax.numpy as jnp
+
+        def fetch(out0):
+            # mirror the evaluator's wire: eval_bf16_probs (default on)
+            # casts the logit volume to bf16 ON DEVICE before the D2H
+            if tr.cfg.eval_bf16_probs:
+                out0 = out0.astype(jnp.bfloat16)
+            return _np.asarray(jax.device_get(out0), _np.float32)
+
         placed = shard_batch(mesh, dev)
         outputs, _ = tr.eval_step(tr.state, placed)
-        jax.device_get(outputs[0])          # compile + settle
+        fetch(outputs[0])                   # compile + settle
         # forward + D2H readback together (a tunneled device has no
         # reliable sync point to isolate the read); subtract
         # valstep_ms_per_batch to get the readback term alone
@@ -299,9 +308,9 @@ def stage_valmetric(tr: Trainer, batch: dict, dev: dict) -> dict:
         t0 = time.perf_counter()
         for _ in range(reps):
             outputs, _ = tr.eval_step(tr.state, placed)
-            logits = _np.asarray(jax.device_get(outputs[0]))
+            logits = fetch(outputs[0])
         dt_read = (time.perf_counter() - t0) / reps
-    probs = _sigmoid(logits.astype(_np.float32))
+    probs = _sigmoid(logits)  # fetch() already widened to f32
     n = len(batch["gt"]) if isinstance(batch["gt"], list) \
         else batch["gt"].shape[0]
     gts = _as_list(batch["gt"], n)

@@ -330,6 +330,19 @@ class TestTrainerIntegration:
         tr_plain.close()
         tr_fast.close()
 
+    def test_instance_bf16_readback_parity(self, fake_voc_root, tmp_path):
+        """eval_bf16_probs now also halves the instance val logit D2H:
+        bf16 logit rounding may flip boundary pixels at the thresholds but
+        must not move the Jaccard beyond noise."""
+        from distributedpytorch_tpu.train import Trainer
+
+        tr = Trainer(self._cfg(fake_voc_root, tmp_path / "a"))
+        m_bf16 = tr.validate(epoch=0)          # default: bf16 readback
+        tr.cfg = dataclasses.replace(tr.cfg, eval_bf16_probs=False)
+        m_f32 = tr.validate(epoch=0)
+        assert abs(m_bf16["jaccard"] - m_f32["jaccard"]) < 1e-2
+        tr.close()
+
     def test_val_overlap_matches_serial_fit(self, fake_voc_root, tmp_path):
         """val_overlap runs each validation concurrently with the next
         train epoch.  The evaluated states are identical to the serial
