@@ -113,15 +113,26 @@ def softmax_xent_ignore(
 
     ``logits``: (..., C); ``labels``: int (...) with ``ignore_index`` marking
     void pixels (the reference's 255-labeled boundary pixels,
-    pascal.py:240-242).  One fused log-softmax + gather; ignored pixels
-    contribute zero and are excluded from the mean — the multi-class loss for
-    the DeepLabV3 semantic-segmentation configs of BASELINE.md.
+    pascal.py:240-242).  Ignored pixels contribute zero and are excluded
+    from the mean — the multi-class loss for the DeepLabV3 semantic-
+    segmentation configs of BASELINE.md.
+
+    The label log-prob is selected with a compare-select-reduce over the
+    class axis rather than ``take_along_axis``: XLA lowers the gather to a
+    scalar per-element loop on TPU (measured 1.6 GiB/s, 28.9 ms per head at
+    8x513x513x21 — 60% of the whole DeepLabV3 step, r4 profile
+    ``prof_deeplab_b8.json``), while the select fuses into the surrounding
+    elementwise work.  ``where`` (not one_hot multiply) keeps non-selected
+    lanes exactly zero even for non-finite logits.
     """
     valid = (labels != ignore_index)
     safe_labels = jnp.where(valid, labels, 0)
-    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
-    gold = jnp.take_along_axis(
-        logits.astype(jnp.float32), safe_labels[..., None], axis=-1
-    )[..., 0]
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    klass = jax.lax.broadcasted_iota(
+        safe_labels.dtype, logits.shape, logits.ndim - 1)
+    gold = jnp.where(
+        klass == safe_labels[..., None], logits, jnp.float32(0.0)
+    ).sum(axis=-1)
     per_pix = (logz - gold) * valid
     return per_pix.sum() / jnp.maximum(valid.sum(), 1)

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Refresh the default-config bench + replay sidecar at queue tail so the
+# round-end record measures the session's FINAL code state.
+set -eo pipefail
+set -x
+cd /root/repo
+DPTPU_BENCH_RECOVERY_MINUTES=2 python bench.py | tee artifacts/r4/bench_mfu_final.json
