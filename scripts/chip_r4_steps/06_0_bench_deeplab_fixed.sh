@@ -1,0 +1,7 @@
+#!/bin/bash
+# Re-measure DeepLab config-4 after the CE-gather -> select-reduce fix
+# (step 03's number measured the gather-bound code).
+set -eo pipefail
+set -x
+cd /root/repo
+DPTPU_BENCH_RECOVERY_MINUTES=2 DPTPU_BENCH_MODEL=deeplabv3 python bench.py | tee artifacts/r4/bench_mfu_deeplab_fixedloss.json

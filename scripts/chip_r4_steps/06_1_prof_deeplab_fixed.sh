@@ -1,0 +1,7 @@
+#!/bin/bash
+# Post-fix op profile: confirm the gather fusions are gone and find the
+# next residual on the config-4 shape.
+set -eo pipefail
+set -x
+cd /root/repo
+python scripts/profile_step.py --model deeplabv3 --batch 8 --out /tmp/prof_dl_fixed | tee artifacts/r4/prof_deeplab_fixedloss_b8.json

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Post-gather-fix semantic family table: every context-head family at the
+# BASELINE config-4 shape (R101 os=16 513² b8 bf16, aux head), one run.
+set -eo pipefail
+set -x
+cd /root/repo
+export DPTPU_BENCH_RECOVERY_MINUTES=2
+for m in deeplabv3plus fcn pspnet ccnet encnet; do
+  DPTPU_BENCH_MODEL=$m python bench.py | tee artifacts/r4/bench_family_$m.json
+done

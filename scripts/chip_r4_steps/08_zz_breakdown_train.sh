@@ -1,0 +1,9 @@
+#!/bin/bash
+# Train-side stage budget of the stacked headline config (v14): under
+# perfect overlap e2e == min(host, place, step); names the binding stage
+# at the achieved 48.0 imgs/s.
+set -eo pipefail
+set -x
+cd /root/repo
+export DPTPU_BENCH_RECOVERY_MINUTES=2
+python scripts/bench_breakdown.py host place step dispatch data.packbits_masks=true model.pam_score_dtype=bfloat16 | tee artifacts/r4/breakdown_train_stacked.json
