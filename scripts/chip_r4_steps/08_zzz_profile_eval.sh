@@ -1,0 +1,7 @@
+#!/bin/bash
+# Why is the semantic eval step 202 ms/batch (~15x its expected forward
+# cost)?  Trace the jitted eval step and name the ops.
+set -eo pipefail
+set -x
+cd /root/repo
+python scripts/profile_eval_step.py --task semantic --out /tmp/prof_eval_sem | tee artifacts/r4/prof_eval_semantic.json
