@@ -28,6 +28,7 @@ from .metrics import (
     miou_from_confusion,
     threshold_sweep_jaccard,
 )
+from .warp import fullres_argmax, resize_bilinear_ragged
 
 __all__ = [
     "augment",
@@ -45,4 +46,6 @@ __all__ = [
     "confusion_matrix",
     "miou_from_confusion",
     "threshold_sweep_jaccard",
+    "fullres_argmax",
+    "resize_bilinear_ragged",
 ]

@@ -325,6 +325,19 @@ class Config:
                                         # at the thresholds; tested).
                                         # false restores exact f32
                                         # readback everywhere.
+    eval_device_fullres: bool = True    # semantic full-res (non-TTA): do
+                                        # the per-sample native-res resize
+                                        # + argmax ON DEVICE (separable
+                                        # weight-matmul warp, ops/warp.py)
+                                        # and ship only the uint8 class
+                                        # map — 21x fewer wire bytes and
+                                        # no per-image host resize (the
+                                        # 1.5 imgs/s r4 bound).  Applies
+                                        # when every image in the batch
+                                        # fits data.val_max_im_size and
+                                        # the run is single-process;
+                                        # false restores the host resize
+                                        # path (bit-exact legacy).
     seed: int = 0
     work_dir: str = "runs"              # run_<N> dirs created under this
     resume: str | None = None           # checkpoint dir to resume from, or

@@ -272,6 +272,7 @@ def evaluate_semantic(
     tta_flip: bool = False,
     debug_asserts: bool = False,
     bf16_probs: bool = True,
+    device_fullres: tuple[int, int] | None = None,
 ) -> dict:
     """Multi-class semantic validation: confusion-matrix mIoU.
 
@@ -297,6 +298,17 @@ def evaluate_semantic(
     probabilities are widened back to f32 on host before any resize/
     averaging arithmetic, so the only effect is one bf16 rounding of each
     probability — argmax-after-resize tie noise (tested against f32).
+
+    ``device_fullres`` (config.eval_device_fullres; the (max_h, max_w) =
+    ``data.val_max_im_size`` canvas when enabled): the non-TTA full-res
+    protocol resizes per-sample to native size and argmaxes ON DEVICE
+    (``ops.warp.fullres_argmax`` — a separable weight-matmul warp, no
+    gathers) and ships only the uint8 class map: ~21x fewer D2H bytes
+    than the bf16 probability volume and zero per-image host resizes
+    (the measured 1.5 imgs/s bound of the host path, BASELINE.md r4).
+    Falls back to the host path per batch when an image exceeds the
+    canvas, under TTA (the averaged probabilities already live on host),
+    or multi-host.
     """
     import jax.numpy as jnp
 
@@ -376,13 +388,34 @@ def evaluate_semantic(
             # Padding repeats real samples; drop them from the counts by
             # scoring only the first n rows (host-local multi-host).
             if "gt_full" in batch:  # native-resolution protocol
-                # softmax on DEVICE before readback (no host-side exp/sum
-                # over B*H*W*C stalling the loop; wire_dt bytes cross)
-                probs_h = read_probs(jax.nn.softmax(
-                    jnp.asarray(outputs[0]).astype(jnp.float32),
-                    axis=-1))[:n]
-                conf += fullres_confusion(probs_h,
-                                          _as_list(batch["gt_full"], n))
+                gts_full = [np.asarray(g) for g in
+                            _as_list(batch["gt_full"], n)]
+                hw = np.array([g.shape[:2] for g in gts_full], np.int32)
+                # softmax on DEVICE either way (no host-side exp/sum over
+                # B*H*W*C stalling the loop)
+                probs_dev = jax.nn.softmax(
+                    jnp.asarray(outputs[0]).astype(jnp.float32), axis=-1)
+                if (device_fullres is not None
+                        and jax.process_count() == 1
+                        and hw[:, 0].max() <= device_fullres[0]
+                        and hw[:, 1].max() <= device_fullres[1]):
+                    # resize-to-native + argmax on device; only the uint8
+                    # class map crosses the wire.  Padding rows get a 1x1
+                    # target — never scored.
+                    from ..ops.warp import fullres_argmax
+                    hw_pad = np.ones((probs_dev.shape[0], 2), np.int32)
+                    hw_pad[:n] = hw
+                    maps = np.asarray(jax.device_get(fullres_argmax(
+                        probs_dev, jnp.asarray(hw_pad),
+                        tuple(device_fullres))))
+                    for j, g in enumerate(gts_full):
+                        if g.ndim == 3:
+                            g = g[..., 0]
+                        conf += np_confusion(
+                            maps[j, :g.shape[0], :g.shape[1]], g)
+                else:
+                    conf += fullres_confusion(read_probs(probs_dev)[:n],
+                                              gts_full)
             elif jax.process_count() == 1:
                 # crop-res fast path, single process: argmax + bincount on
                 # DEVICE from the still-resident outputs — only the (C,C)

@@ -912,7 +912,10 @@ class Trainer:
                     tta_scales=self.cfg.eval_tta_scales,
                     tta_flip=self.cfg.eval_tta_flip,
                     debug_asserts=self.cfg.debug_asserts,
-                    bf16_probs=self.cfg.eval_bf16_probs)
+                    bf16_probs=self.cfg.eval_bf16_probs,
+                    device_fullres=(
+                        tuple(self.cfg.data.val_max_im_size)
+                        if self.cfg.eval_device_fullres else None))
             else:
                 metrics = evaluate(
                     self.eval_step, state, self.val_loader,

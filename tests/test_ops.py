@@ -174,6 +174,45 @@ class TestLosses:
         got = float(ops.softmax_xent_ignore(logits, labels))
         assert got == pytest.approx(want, rel=1e-5)
 
+    def test_ragged_resize_matches_host_backend(self, rng):
+        # ops/warp.py's weight-matmul warp must reproduce the host path's
+        # cv2.INTER_LINEAR per-sample resize (half-pixel centers, edge
+        # clamp) for both up- and down-scales.
+        from distributedpytorch_tpu import imaging
+        from distributedpytorch_tpu.ops.warp import resize_bilinear_ragged
+        from distributedpytorch_tpu.utils.helpers import fixed_resize
+
+        probs = rng.random((3, 33, 29, 5), dtype=np.float64).astype(np.float32)
+        sizes = np.array([[50, 40], [20, 64], [33, 29]], np.int32)
+        out = np.asarray(resize_bilinear_ragged(
+            jnp.asarray(probs), jnp.asarray(sizes), (64, 64)))
+        for j, (h, w) in enumerate(sizes):
+            want = fixed_resize(probs[j], (int(h), int(w)),
+                                flagval=imaging.LINEAR)
+            got = out[j, :h, :w]
+            assert np.max(np.abs(got - want)) < 1e-4, \
+                f"sample {j}: max abs diff {np.max(np.abs(got - want))}"
+            # out-of-range canvas stays exactly zero
+            assert not out[j, h:].any() and not out[j, :, w:].any()
+
+    def test_fullres_argmax_matches_host_protocol(self, rng):
+        from distributedpytorch_tpu import imaging
+        from distributedpytorch_tpu.ops.warp import fullres_argmax
+        from distributedpytorch_tpu.utils.helpers import fixed_resize
+
+        probs = rng.random((2, 17, 17, 21), dtype=np.float64).astype(np.float32)
+        sizes = np.array([[31, 24], [12, 40]], np.int32)
+        maps = np.asarray(fullres_argmax(
+            jnp.asarray(probs), jnp.asarray(sizes), (48, 48)))
+        assert maps.dtype == np.uint8
+        for j, (h, w) in enumerate(sizes):
+            want = np.argmax(fixed_resize(probs[j], (int(h), int(w)),
+                                          flagval=imaging.LINEAR), axis=-1)
+            agree = (maps[j, :h, :w] == want).mean()
+            # identical arithmetic up to f32 association; ties are the
+            # only legitimate divergence and random probs barely tie
+            assert agree > 0.999, f"sample {j}: agreement {agree}"
+
     def test_softmax_xent_nonfinite_other_lanes(self):
         # a -inf logit in a NON-selected lane must not poison the selected
         # log-prob through the select (0 * inf = nan with a one_hot multiply)

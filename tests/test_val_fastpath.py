@@ -330,6 +330,33 @@ class TestTrainerIntegration:
         tr_plain.close()
         tr_fast.close()
 
+    def test_semantic_fullres_device_vs_host_path(self, tmp_path):
+        """eval_device_fullres=true (device warp + uint8 class-map wire)
+        must reproduce the host resize path's full-res mIoU through the
+        real Trainer."""
+        from distributedpytorch_tpu.data import make_fake_voc
+        from distributedpytorch_tpu.train import Trainer
+
+        fake_voc_root = make_fake_voc(str(tmp_path / "voc"), n_images=12,
+                                      size=(96, 128), n_val=3, seed=13)
+        sem = {"task": "semantic", "model.name": "deeplabv3",
+               "model.nclass": 21, "model.in_channels": 3,
+               "data.crop_size": "[65,65]", "eval_full_res": "true",
+               "data.val_max_im_size": "[256,256]"}
+        tr_host = Trainer(self._cfg(fake_voc_root, tmp_path / "a", **sem,
+                                    eval_device_fullres="false"))
+        m_host = tr_host.validate(epoch=0)
+        tr_dev = Trainer(self._cfg(fake_voc_root, tmp_path / "b", **sem,
+                                   eval_device_fullres="true"))
+        tr_dev.state = tr_host.state
+        m_dev = tr_dev.validate(epoch=0)
+        # same protocol arithmetic on device; only f32-association /
+        # argmax-tie noise may move individual boundary pixels
+        assert abs(m_dev["miou"] - m_host["miou"]) < 1e-3
+        assert m_dev["n_samples"] == m_host["n_samples"]
+        tr_host.close()
+        tr_dev.close()
+
     def test_instance_bf16_readback_parity(self, fake_voc_root, tmp_path):
         """eval_bf16_probs now also halves the instance val logit D2H:
         bf16 logit rounding may flip boundary pixels at the thresholds but
