@@ -347,6 +347,8 @@ def evaluate_semantic(
     conf = np.zeros((nclass, nclass), np.int64)
     confs: list = []   # device (C,C) counts; bulk-read at epoch end
     losses: list = []  # device scalars; same deferred-sync policy
+    fullres_maps: list = []  # (device uint8 class maps, native gts);
+    #                          scored host-side after the bulk readback
     n_samples = 0
     t0 = time.perf_counter()
     wire_dt = jnp.bfloat16 if bf16_probs else jnp.float32
@@ -405,14 +407,12 @@ def evaluate_semantic(
                     from ..ops.warp import fullres_argmax
                     hw_pad = np.ones((probs_dev.shape[0], 2), np.int32)
                     hw_pad[:n] = hw
-                    maps = np.asarray(jax.device_get(fullres_argmax(
+                    # deferred: the uint8 maps stay on device until the
+                    # epoch-end bulk readback (same policy as losses/confs)
+                    # so the next batch's forward overlaps this one's warp
+                    fullres_maps.append((fullres_argmax(
                         probs_dev, jnp.asarray(hw_pad),
-                        tuple(device_fullres))))
-                    for j, g in enumerate(gts_full):
-                        if g.ndim == 3:
-                            g = g[..., 0]
-                        conf += np_confusion(
-                            maps[j, :g.shape[0], :g.shape[1]], g)
+                        tuple(device_fullres)), gts_full))
                 else:
                     conf += fullres_confusion(read_probs(probs_dev)[:n],
                                               gts_full)
@@ -482,6 +482,12 @@ def evaluate_semantic(
 
     if confs:  # one bulk readback for every deferred device value
         conf += np.sum(np.asarray(jax.device_get(confs), np.int64), axis=0)
+    for dev_maps, gts in fullres_maps:
+        maps = np.asarray(jax.device_get(dev_maps))
+        for j, g in enumerate(gts):
+            if g.ndim == 3:
+                g = g[..., 0]
+            conf += np_confusion(maps[j, :g.shape[0], :g.shape[1]], g)
     loss_sum = float(np.sum(jax.device_get(losses))) if losses else 0.0
     n_batches = len(losses)
     if jax.process_count() > 1:
