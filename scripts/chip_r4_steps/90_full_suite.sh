@@ -1,0 +1,7 @@
+#!/bin/bash
+# Full suite green-gate before the final bench refresh (runs on the idle
+# host the queue guarantees between chip steps).
+set -eo pipefail
+set -x
+cd /root/repo
+python -m pytest tests/ -q 2>&1 | tail -5 | tee artifacts/r4/suite_final.txt
