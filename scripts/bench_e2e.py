@@ -256,6 +256,20 @@ if __name__ == "__main__":
         {"data.prepared_cache": "AUTO", "data.device_guidance": True,
          "data.uint8_transfer": True, "data.val_batch": 8,
          "val_overlap": True, "_schedule": "overlap"},
+        # 21: stacked headline + K-step dispatch.  The tunnel serializes
+        # H2D/dispatch RPCs against the running step (no true overlap:
+        # measured wall/step == step + place + dispatch even with the
+        # placement thread ahead), so a K=3 program keeps the chip busy
+        # 3 steps per round trip and hides 2/3 of that serial overhead.
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True, "data.packbits_masks": True,
+         "model.pam_score_dtype": "bfloat16",
+         "data.steps_per_dispatch": 3},
+        # 22: same with K=6 (half an epoch per dispatch at the bench size)
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True, "data.packbits_masks": True,
+         "model.pam_score_dtype": "bfloat16",
+         "data.steps_per_dispatch": 6},
     ]
     sel = sys.argv[1:]
     try:
