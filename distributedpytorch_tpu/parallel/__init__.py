@@ -45,12 +45,16 @@ from .tp import state_shardings, tp_param_specs
 from .zero import zero_opt_specs
 from .ulysses import make_ulysses_attention, ulysses_attention_local
 from .step import (
+    DEVICE_KEYS,
     INPUT_KEY,
     TARGET_KEY,
+    WIRE_KEY,
     TrainState,
     create_train_state,
     make_eval_step,
     make_train_step,
+    pack_wire,
+    unpack_wire,
 )
 
 __all__ = [
@@ -83,6 +87,10 @@ __all__ = [
     "make_ring_attention_inline",
     "make_ulysses_attention",
     "make_train_step",
+    "DEVICE_KEYS",
+    "WIRE_KEY",
+    "pack_wire",
+    "unpack_wire",
     "ring_attention_local",
     "ulysses_attention_local",
     "pad_to_multiple",

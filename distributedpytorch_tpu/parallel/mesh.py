@@ -130,7 +130,8 @@ def shard_batch(mesh: Mesh, batch: Mapping[str, np.ndarray]) -> dict:
 
 
 def prefetch_to_device(batches, mesh: Mesh, size: int = 2,
-                       keys: tuple[str, ...] | None = None):
+                       keys: tuple[str, ...] | None = None,
+                       transform=None):
     """Iterate ``batches`` with up to ``size`` of them already placed on the
     mesh (batch-dim sharded) ahead of consumption.
 
@@ -149,11 +150,19 @@ def prefetch_to_device(batches, mesh: Mesh, size: int = 2,
     — ~146 ms for a 33 MB float batch through a tunneled chip), and done
     inline it serializes against the step dispatch this prefetcher exists
     to overlap.  One worker keeps placements ordered.
+
+    ``transform`` is an optional host-side ``batch -> batch`` stage run on
+    that same worker thread just before placement (after the ``keys``
+    filter would be pointless — it may introduce new keys, so it runs
+    first).  Used by data.coalesce_wire to keep the full-batch pack memcpy
+    off the dispatch thread.
     """
     import collections
     import concurrent.futures as cf
 
     def place(batch):
+        if transform is not None:
+            batch = transform(batch)
         if keys is not None:
             batch = {k: v for k, v in batch.items() if k in keys}
         return shard_batch(mesh, batch)

@@ -34,6 +34,7 @@ from typing import Any, Callable, Mapping
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from flax.core import unfreeze
@@ -78,6 +79,75 @@ def _unpack_mask_bits(batch: Batch) -> dict:
 #: explicit in one place.
 INPUT_KEY = "concat"
 TARGET_KEY = "crop_gt"
+
+#: key under which a coalesced batch ships (data.coalesce_wire)
+WIRE_KEY = "wire"
+
+#: the device-bound train keys, in wire order — everything else a loader
+#: yields (meta, host-side lists) stays on host
+DEVICE_KEYS = ("concat", "crop_gt", "crop_void")
+
+
+def pack_wire(batch: Mapping, keys: tuple[str, ...]) -> tuple[dict, tuple]:
+    """Coalesce ``keys`` of a host batch into one ``(B, bytes)`` uint8 buffer.
+
+    One buffer = ONE H2D transfer (one RPC on a tunneled/remoted device)
+    instead of one per key — the per-transfer link latency, which flaps
+    5→160 ms on minute timescales through a tunnel (BASELINE.md round-4),
+    is paid once per batch.  Leaves are flattened per-sample and
+    concatenated along axis 1, so the batch dim stays the leading (sharded)
+    axis.  Returns ``({WIRE_KEY: buf}, spec)`` where ``spec`` is the static
+    ``((key, per_sample_shape), ...)`` layout ``unpack_wire`` inverts; a
+    batch whose shapes match the spec of a previous call round-trips
+    exactly (uint8 is bit-preserved).
+
+    All leaves must already be uint8 — the data.uint8_transfer wire format
+    (validated at Trainer init; float leaves would need a bitcast whose
+    semantics this deliberately avoids).
+    """
+    parts, spec = [], []
+    for k in keys:
+        if k not in batch:
+            continue
+        v = np.asarray(batch[k])
+        if v.dtype != np.uint8:
+            raise ValueError(
+                f"data.coalesce_wire: leaf {k!r} is {v.dtype}, not uint8 — "
+                "the coalesced wire requires data.uint8_transfer's uint8 "
+                "batch format")
+        parts.append(v.reshape(v.shape[0], -1))
+        spec.append((k, tuple(v.shape[1:])))
+    if not parts:
+        raise ValueError(
+            f"pack_wire: none of {keys} present in the batch "
+            f"(batch keys: {sorted(batch)})")
+    buf = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+    return {WIRE_KEY: np.ascontiguousarray(buf)}, tuple(spec)
+
+
+def unpack_wire(batch: Batch, spec: tuple) -> dict:
+    """Inverse of :func:`pack_wire`, inside jit: static strided slices of
+    the ``(B, bytes)`` buffer back into the per-key uint8 leaves.  XLA
+    fuses each slice+reshape into the leaf's first consumer, so the
+    round-trip costs nothing on device — the win is the single H2D RPC
+    that already happened."""
+    buf = batch[WIRE_KEY]
+    out = {k: v for k, v in batch.items() if k != WIRE_KEY}
+    off = 0
+    for key, shape in spec:
+        n = 1
+        for d in shape:
+            n *= d
+        out[key] = buf[:, off:off + n].reshape((buf.shape[0],) + shape)
+        off += n
+    if off != buf.shape[1]:
+        # a spec from a different wire layout underrunning the buffer
+        # would otherwise slice misaligned leaves silently
+        raise ValueError(
+            f"unpack_wire: spec covers {off} bytes/sample but the buffer "
+            f"carries {buf.shape[1]} — spec and wire were built from "
+            "different batch layouts")
+    return out
 
 
 class TrainState(struct.PyTreeNode):
@@ -273,6 +343,7 @@ def make_train_step(
     loss_scale: float = 1.0,
     steps_per_call: int = 1,
     packbits_masks: bool = False,
+    wire_spec: tuple | None = None,
 ) -> Callable[..., tuple[TrainState, jax.Array]]:
     """Build the jitted ``(state, batch) -> (state, loss)`` train step.
 
@@ -299,6 +370,12 @@ def make_train_step(
     ``(state, b1, ..., bK) -> (state, (K,) losses)`` — K full optimizer
     steps scanned inside one executable (data.steps_per_dispatch), cutting
     per-step dispatch overhead K-fold on dispatch-bound hosts.
+
+    ``wire_spec`` (data.coalesce_wire): the step consumes a coalesced
+    ``{WIRE_KEY: (B, bytes) uint8}`` batch and restores the named leaves
+    with :func:`unpack_wire` before any other stage — composes with
+    ``packbits_masks`` (the packed row rides the buffer) and with the
+    multi-step program (the scan body unpacks each step's buffer).
     """
 
     def grads_of(params, batch_stats, batch, rng):
@@ -314,6 +391,10 @@ def make_train_step(
         return loss, new_stats, grads
 
     def step_fn(state: TrainState, batch: Batch):
+        if wire_spec is not None:
+            # first: the coalesced buffer (data.coalesce_wire) restores the
+            # named leaves every later stage keys on
+            batch = unpack_wire(batch, wire_spec)
         if packbits_masks:
             # before the dtype pass: the packed row must stay integer for
             # the bit shifts (data.packbits_masks wire)

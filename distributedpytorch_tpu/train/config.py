@@ -95,6 +95,21 @@ class DataConfig:
                                         # (BASELINE.md round-3 breakdown).
                                         # Instance task + uint8_transfer
                                         # only.
+    coalesce_wire: bool = False         # pack the train batch's device-
+                                        # bound uint8 leaves into ONE
+                                        # (B, bytes) buffer per batch: one
+                                        # H2D transfer instead of one per
+                                        # key, so per-RPC link latency is
+                                        # paid once (tunneled/remoted
+                                        # devices flap 5→160 ms per RPC on
+                                        # minute timescales — BASELINE.md
+                                        # round-4 wire study; on local PCIe
+                                        # this is neutral).  The compiled
+                                        # step slices the leaves back out
+                                        # (static offsets, fused by XLA).
+                                        # Requires uint8_transfer; composes
+                                        # with packbits_masks (the packed
+                                        # row rides the same buffer).
     val_prepared: bool = True           # when prepared_cache is set, serve
                                         # the crop-res VAL protocol from a
                                         # prepared cache too (eval is fully

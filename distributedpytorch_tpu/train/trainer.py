@@ -42,10 +42,13 @@ from ..data import (
 )
 from ..models import build_model
 from ..parallel import (
+    DEVICE_KEYS,
+    WIRE_KEY,
     create_train_state,
     make_eval_step,
     make_mesh,
     make_train_step,
+    pack_wire,
     prefetch_to_device,
     state_shardings,
 )
@@ -170,6 +173,12 @@ class Trainer:
                 "the uint8 wire — it requires task=instance (semantic gt "
                 "is class ids, not bits) and data.uint8_transfer (the "
                 "packed row rides the uint8 fast path)")
+        if cfg.data.coalesce_wire and not cfg.data.uint8_transfer:
+            raise ValueError(
+                "data.coalesce_wire concatenates the batch's uint8 leaves "
+                "into one wire buffer — it requires data.uint8_transfer "
+                "(float leaves would need a bitcast wire this deliberately "
+                "avoids); enable uint8_transfer + prepared_cache")
         if cfg.data.uint8_transfer and not cfg.data.prepared_cache:
             raise ValueError(
                 "data.uint8_transfer needs data.prepared_cache: only the "
@@ -488,14 +497,16 @@ class Trainer:
                              if cfg.model.moe_experts else 0.0),
             loss_scale=cfg.optim.loss_scale,
             packbits_masks=cfg.data.packbits_masks)
-        self.train_step = make_train_step(self.model, self.tx, **step_kwargs)
-        #: the K-steps-in-one-dispatch program (data.steps_per_dispatch>1);
-        #: epoch-tail remainders run through self.train_step
-        self.multi_train_step = (
-            make_train_step(self.model, self.tx,
-                            steps_per_call=cfg.data.steps_per_dispatch,
-                            **step_kwargs)
-            if cfg.data.steps_per_dispatch > 1 else None)
+        self._step_kwargs = step_kwargs
+        self.train_step, self.multi_train_step = self._build_steps()
+        #: data.coalesce_wire: the wire-consuming twins of the two programs
+        #: above, built lazily at the first train batch — the wire layout
+        #: (per-key byte extents) is data-shaped, and deriving it from the
+        #: real batch instead of re-deriving shape math from config keeps
+        #: one source of truth.  ``_step_kwargs`` is kept for that build.
+        self._wire_spec: tuple | None = None
+        self._wire_step = None
+        self._wire_multi_step = None
         eval_preprocess = None
         if self._val_device_guidance:
             # prepared val ships bare image channels; append the guidance
@@ -708,6 +719,40 @@ class Trainer:
                   f"(best={self.ckpt.best_metric:.4f})", flush=True)
 
     # ------------------------------------------------------------------ train
+    def _build_steps(self, wire_spec: tuple | None = None):
+        """The (single-step, K-step-or-None) compiled train programs from
+        the one stored ``_step_kwargs`` — the only constructor for both the
+        plain and the wire-consuming (data.coalesce_wire) twins, so the two
+        families cannot drift as kwargs grow.  The K-step program exists
+        iff data.steps_per_dispatch > 1; epoch-tail remainders always run
+        through the single-step one."""
+        k = self.cfg.data.steps_per_dispatch
+        single = make_train_step(self.model, self.tx, wire_spec=wire_spec,
+                                 **self._step_kwargs)
+        multi = (make_train_step(self.model, self.tx, steps_per_call=k,
+                                 wire_spec=wire_spec, **self._step_kwargs)
+                 if k > 1 else None)
+        return single, multi
+
+    def _pack_wire_transform(self, batch: dict) -> dict:
+        """data.coalesce_wire stage for the prefetcher's placement thread:
+        pack the batch into the one-buffer wire, and on the FIRST batch
+        derive the spec + build the wire-consuming step programs.  Runs on
+        the worker so the full-batch memcpy stays off the dispatch thread;
+        the attribute writes are published to the dispatch loop by the
+        placement future's ``result()`` (completion happens-before the
+        first wire batch is yielded)."""
+        batch, spec = pack_wire(batch, DEVICE_KEYS)
+        if self._wire_spec is None:
+            self._wire_spec = spec
+            self._wire_step, self._wire_multi_step = self._build_steps(spec)
+        elif spec != self._wire_spec:
+            raise RuntimeError(
+                f"data.coalesce_wire: batch layout changed mid-training "
+                f"({spec} vs {self._wire_spec}) — the train loader must "
+                "produce fixed-shape batches (drop_last + fixed crop)")
+        return batch
+
     def train_epoch(self, epoch: int,
                     guard: PreemptionGuard | None = None,
                     start_batch: int = 0,
@@ -751,11 +796,19 @@ class Trainer:
         def dispatches(placed):
             """(n_steps, losses) per compiled call: K-step chunks through
             the multi-step program (data.steps_per_dispatch), the epoch
-            tail (and the k=1 config) through the single-step one."""
-            if self.multi_train_step is None:
+            tail (and the k=1 config) through the single-step one.  The
+            wire-consuming twins substitute under data.coalesce_wire —
+            read per call, not hoisted: they are built lazily by
+            ``host_batches`` while the prefetcher pulls ahead."""
+            def one_step(b):
+                fn = self._wire_step if cfg.data.coalesce_wire \
+                    else self.train_step
+                self.state, loss = fn(self.state, b)
+                return loss
+
+            if cfg.data.steps_per_dispatch <= 1:
                 for b in placed:
-                    self.state, loss = self.train_step(self.state, b)
-                    yield 1, loss
+                    yield 1, one_step(b)
                 return
             import itertools
             k = cfg.data.steps_per_dispatch
@@ -765,13 +818,13 @@ class Trainer:
                 if not chunk:
                     return
                 if len(chunk) == k:
-                    self.state, lv = self.multi_train_step(
-                        self.state, *chunk)
+                    fn = self._wire_multi_step if cfg.data.coalesce_wire \
+                        else self.multi_train_step
+                    self.state, lv = fn(self.state, *chunk)
                     yield k, lv
                 else:
                     for b in chunk:
-                        self.state, loss = self.train_step(self.state, b)
-                        yield 1, loss
+                        yield 1, one_step(b)
 
         steps_done = 0
         interrupted = False
@@ -785,7 +838,10 @@ class Trainer:
                 # at every chunk boundary
                 size=max(cfg.data.device_prefetch,
                          cfg.data.steps_per_dispatch),
-                keys=("concat", "crop_gt", "crop_void"))
+                keys=(WIRE_KEY,) if cfg.data.coalesce_wire
+                else DEVICE_KEYS,
+                transform=(self._pack_wire_transform
+                           if cfg.data.coalesce_wire else None))
             if cfg.data.echo > 1:
                 batches = echoed(batches)
             # cadence comes from the guard itself (a caller-provided guard

@@ -619,3 +619,90 @@ class TestPackBitsWire:
             return h["train_loss"]
 
         np.testing.assert_array_equal(run(True, "a"), run(False, "b"))
+
+
+class TestCoalesceWire:
+    """data.coalesce_wire: the one-buffer-per-batch H2D wire format."""
+
+    def test_pack_unpack_roundtrip(self):
+        import jax.numpy as jnp
+
+        from distributedpytorch_tpu.parallel import (
+            WIRE_KEY, pack_wire, unpack_wire)
+        r = np.random.RandomState(3)
+        batch = {
+            "concat": r.randint(0, 256, (4, 6, 5, 3), dtype=np.uint8),
+            "crop_gt": r.randint(0, 256, (4, 11), dtype=np.uint8),
+            "crop_void": r.randint(0, 2, (4, 6, 5, 1), dtype=np.uint8),
+            "meta": ["host-only", "stays", "out", "!"],
+        }
+        wire, spec = pack_wire(batch, ("concat", "crop_gt", "crop_void",
+                                       "absent_key"))
+        assert set(wire) == {WIRE_KEY}
+        assert wire[WIRE_KEY].shape == (4, 6 * 5 * 3 + 11 + 6 * 5)
+        assert [k for k, _ in spec] == ["concat", "crop_gt", "crop_void"]
+        out = unpack_wire({WIRE_KEY: jnp.asarray(wire[WIRE_KEY])}, spec)
+        assert WIRE_KEY not in out
+        for k in ("concat", "crop_gt", "crop_void"):
+            np.testing.assert_array_equal(np.asarray(out[k]), batch[k])
+
+    def test_pack_rejects_float_leaves(self):
+        from distributedpytorch_tpu.parallel import pack_wire
+        with pytest.raises(ValueError, match="uint8"):
+            pack_wire({"concat": np.zeros((2, 3, 3, 4), np.float32)},
+                      ("concat",))
+
+    def test_coalesce_requires_uint8_transfer(self, tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+        from tests.test_train import make_tiny_cfg
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        bad = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, coalesce_wire=True))
+        with pytest.raises(ValueError, match="coalesce_wire"):
+            Trainer(bad)
+
+    @pytest.mark.parametrize("packbits", [False, True])
+    def test_coalesced_loss_matches_plain(self, tmp_path, packbits):
+        """Same seeds, coalesced vs per-key wire: training losses must be
+        bitwise-identical — coalescing is transfer shape, not semantics.
+        Parameterized over packbits_masks: the packed row must ride the
+        buffer unchanged."""
+        from distributedpytorch_tpu.train import Trainer
+        from tests.test_train import make_tiny_cfg
+
+        def run(coalesce: bool, sub: str):
+            cfg = make_tiny_cfg(str(tmp_path / sub))
+            cfg = dataclasses.replace(
+                cfg, epochs=1,
+                data=dataclasses.replace(
+                    cfg.data, prepared_cache=str(tmp_path / f"prep_{sub}"),
+                    uint8_transfer=True, device_guidance=True,
+                    packbits_masks=packbits, coalesce_wire=coalesce))
+            tr = Trainer(cfg)
+            h = tr.fit()
+            tr.close()
+            return h["train_loss"]
+
+        np.testing.assert_array_equal(run(True, f"c{packbits}"),
+                                      run(False, f"p{packbits}"))
+
+    def test_coalesced_multi_step_dispatch(self, tmp_path):
+        """coalesce_wire + steps_per_dispatch>1: the K-step scan unpacks
+        each step's buffer; losses match the K=1 coalesced run."""
+        from distributedpytorch_tpu.train import Trainer
+        from tests.test_train import make_tiny_cfg
+
+        def run(k: int, sub: str):
+            cfg = make_tiny_cfg(str(tmp_path / sub))
+            cfg = dataclasses.replace(
+                cfg, epochs=1,
+                data=dataclasses.replace(
+                    cfg.data, prepared_cache=str(tmp_path / f"prep_{sub}"),
+                    uint8_transfer=True, device_guidance=True,
+                    coalesce_wire=True, steps_per_dispatch=k))
+            tr = Trainer(cfg)
+            h = tr.fit()
+            tr.close()
+            return h["train_loss"]
+
+        np.testing.assert_array_equal(run(2, "k2"), run(1, "k1"))
