@@ -270,6 +270,13 @@ if __name__ == "__main__":
          "data.uint8_transfer": True, "data.packbits_masks": True,
          "model.pam_score_dtype": "bfloat16",
          "data.steps_per_dispatch": 6},
+        # 23: stacked headline + the coalesced one-buffer wire
+        # (data.coalesce_wire): one H2D RPC per batch instead of three —
+        # the lever when the tunnel's per-RPC latency (not bandwidth)
+        # bounds placement (BASELINE.md round-4 wire study)
+        {"data.prepared_cache": "AUTO", "data.device_guidance": True,
+         "data.uint8_transfer": True, "data.packbits_masks": True,
+         "model.pam_score_dtype": "bfloat16", "data.coalesce_wire": True},
     ]
     sel = sys.argv[1:]
     try:
