@@ -11,6 +11,7 @@ from .mesh import (
     batch_sharding,
     batch_spec,
     initialize_distributed,
+    make_hybrid_mesh,
     make_mesh,
     pad_to_multiple,
     prefetch_to_device,
@@ -82,6 +83,7 @@ __all__ = [
     "create_train_state",
     "initialize_distributed",
     "make_eval_step",
+    "make_hybrid_mesh",
     "make_mesh",
     "make_ring_attention",
     "make_ring_attention_inline",

@@ -87,6 +87,88 @@ def make_mesh(data: int | None = None, model: int = 1,
     return Mesh(devices.reshape(data, model), (DATA_AXIS, MODEL_AXIS))
 
 
+def make_hybrid_mesh(slices: int, data: int | None = None, model: int = 1,
+                     devices=None, process_is_granule: bool | None = None
+                     ) -> Mesh:
+    """A ``(data, model)`` mesh over a MULTI-SLICE topology (ICI + DCN).
+
+    Multi-slice TPU systems (and any multi-host cluster without a single
+    ICI domain) have two networks: fast ICI within a slice, slower DCN
+    between slices.  The scaling recipe is hierarchical data parallelism:
+    keep the ``model`` axis and the inner factor of the ``data`` axis
+    within a slice, and let only the OUTER factor of ``data`` span DCN —
+    GSPMD then lowers the gradient all-reduce to an intra-slice reduce
+    (ICI), a small cross-slice phase (DCN), and an intra-slice broadcast.
+
+    The returned mesh has the same ``(data, model)`` axis names as
+    ``make_mesh``, so every train step, sharding rule, and checkpoint
+    layout in this framework works unchanged — the hierarchy lives purely
+    in the device ORDER, which ``mesh_utils.create_hybrid_device_mesh``
+    arranges so that mesh coordinates varying fastest stay ICI-local.
+
+    ``slices`` is the DCN factor of the data axis; ``data`` the per-slice
+    factor (``None`` = everything left).  ``process_is_granule=None``
+    auto-detects: device ``slice_index`` attributes when the runtime
+    exposes them (real multi-slice TPU), else processes as granules (the
+    documented fallback, also what CPU multi-process tests exercise).
+
+    The reference has no counterpart (its parallelism never left one
+    host, reference train_pascal.py:92); this completes the DCN half of
+    the "NCCL/MPI backend" story TPU-natively (SURVEY.md §2.6, §5.8).
+    """
+    from jax.experimental import mesh_utils
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if slices < 1 or n % slices:
+        raise ValueError(f"{n} devices not divisible into {slices} slices")
+    per_slice = n // slices
+    if data is None:
+        if per_slice % model:
+            raise ValueError(
+                f"{per_slice} devices/slice not divisible by model={model}")
+        data = per_slice // model
+    if data * model != per_slice:
+        raise ValueError(
+            f"per-slice mesh {data}x{model} != {per_slice} devices/slice")
+    if slices == 1:
+        # one granule: no DCN dimension exists; the plain ICI mesh IS the
+        # hybrid mesh (and create_hybrid_device_mesh would reject granule
+        # detection on single-slice platforms that expose no slice_index)
+        return make_mesh(data=data, model=model, devices=devices)
+    if process_is_granule is None:
+        # Slice granules when the runtime exposes a real multi-slice
+        # structure matching the request; processes when devices carry no
+        # slice structure at all (or a single degenerate slice 0, as the
+        # multi-process CPU backend does).  A PRESENT-but-mismatched slice
+        # structure is a misconfiguration — falling back to hosts there
+        # would silently treat intra-slice ICI links as the DCN phase.
+        idx = {getattr(d, "slice_index", None) for d in devices}
+        on_tpu = any(getattr(d, "platform", None) == "tpu" for d in devices)
+        if None in idx or (len(idx) == 1 and not on_tpu):
+            # no slice structure at all, or the degenerate all-slice-0
+            # of non-TPU backends (multi-process CPU): hosts are the DCN
+            # granules
+            process_is_granule = True
+        elif len(idx) == slices:
+            process_is_granule = False
+        else:
+            # PRESENT slice structure contradicting the request — incl.
+            # a real single-slice TPU asked for slices>1, whose hosts
+            # are ICI-connected, not DCN
+            raise ValueError(
+                f"requested slices={slices} but the devices expose "
+                f"{len(idx)} distinct slice_index value(s); pass "
+                "process_is_granule=True explicitly to group by host "
+                "instead")
+    arr = mesh_utils.create_hybrid_device_mesh(
+        (data, model), (slices, 1), devices,
+        process_is_granule=process_is_granule)
+    # (slices*data, model): outer (DCN) factor varies slowest, so rows of
+    # the data axis within one slice stay contiguous -> ICI-local
+    return Mesh(arr.reshape(slices * data, model), (DATA_AXIS, MODEL_AXIS))
+
+
 def batch_spec() -> P:
     """Batch arrays: leading (batch) dim split over ``data``; spatial and
     channel dims replicated (a 512×512 conv input shards naturally on batch

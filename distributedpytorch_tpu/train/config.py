@@ -260,8 +260,18 @@ class OptimConfig:
 
 @dataclass
 class MeshConfig:
-    data: int | None = None             # None = all devices
+    data: int | None = None             # None = all devices (per slice
+                                        # when slices > 1)
     model: int = 1                      # tensor-parallel axis size
+    slices: int = 1                     # DCN factor of the data axis:
+                                        # >1 = hierarchical DP over a
+                                        # multi-slice topology
+                                        # (make_hybrid_mesh)
+    process_is_granule: bool | None = None
+                                        # DCN granule choice for slices>1:
+                                        # None = auto (device slice_index
+                                        # when it matches, else hosts);
+                                        # true forces host granules
     shard_params: bool = False          # TP: shard kernels over `model`
     shard_opt_state: bool = False       # ZeRO-1: shard optimizer state
                                         # over `data` (1/N optimizer

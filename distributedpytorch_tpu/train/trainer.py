@@ -46,6 +46,7 @@ from ..parallel import (
     WIRE_KEY,
     create_train_state,
     make_eval_step,
+    make_hybrid_mesh,
     make_mesh,
     make_train_step,
     pack_wire,
@@ -134,8 +135,15 @@ class Trainer:
                 "instance protocol already scores at full resolution via "
                 "crop2fullmask paste-back)")
 
-        # --- mesh
-        self.mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
+        # --- mesh  (slices != 1 routes through make_hybrid_mesh so its
+        # validation also catches slices<1 typos instead of silently
+        # training on a flat mesh)
+        if cfg.mesh.slices != 1:
+            self.mesh = make_hybrid_mesh(
+                cfg.mesh.slices, data=cfg.mesh.data, model=cfg.mesh.model,
+                process_is_granule=cfg.mesh.process_is_granule)
+        else:
+            self.mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
 
         # --- data
         root = cfg.data.root

@@ -58,6 +58,12 @@ def main() -> None:
     if mode == "preempt":
         overrides += ["epochs=200", "eval_every=0",
                       "checkpoint.snapshot_every=0", "log_every_steps=10000"]
+    elif mode == "hybrid":
+        # hierarchical DP over a 2-slice hybrid mesh (processes as DCN
+        # granules — the documented fallback on platforms without
+        # slice_index): same training, gradient all-reduce now spans an
+        # intra-granule phase and a cross-granule phase
+        overrides += ["mesh.slices=2"]
     elif mode == "prepared":
         # both processes share ONE prepared cache (train + eval) on the
         # common filesystem — the flock'd init and idempotent row fills

@@ -105,6 +105,21 @@ def test_two_process_prepared_fast_path(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_hybrid_mesh(tmp_path):
+    """mesh.slices=2 over 2 processes: ``make_hybrid_mesh`` arranges the
+    data axis so each process (DCN granule) holds a contiguous block, and
+    training still reduces to identical global metrics on every host —
+    the hierarchical-DP layout for multi-slice topologies, exercised via
+    the process-is-granule fallback."""
+    results = _run_two_workers(tmp_path, mode="hybrid")
+    a, b = results[0], results[1]
+    assert a["run_dir"] == b["run_dir"]
+    assert a["jaccard"] == b["jaccard"]
+    assert a["n_samples"] == b["n_samples"] >= 3
+    assert a["ckpt_step"] == b["ckpt_step"] is not None
+
+
+@pytest.mark.slow
 def test_two_process_preemption_consensus(tmp_path):
     """A stop signal delivered to ONE process must stop BOTH at the same
     step via the consensus allgather, land one coordinated final
