@@ -1,0 +1,43 @@
+"""jaxlint: AST-based static analysis for TPU-hazard patterns.
+
+The reference repo's header is a hand-maintained checklist of correctness
+hazards (train_pascal.py:1-8); this framework's equivalents — silent
+recompiles, host-device syncs inside the step loop, PRNG key reuse,
+forgotten donation — are only observable after an expensive TPU run.  This
+package catches them statically, in CI, before a chip is touched:
+
+    python -m distributedpytorch_tpu.analysis [paths...]
+    jaxlint [paths...]                       # console entry point
+
+Rules (see :mod:`rules` and docs/DESIGN.md "Static analysis"):
+
+===== ======================================================================
+code  catches
+===== ======================================================================
+JL001 host-device sync inside a jitted function (.item(), float(), np.*)
+JL002 recompile hazard: Python if/while on tracer-derived values in jit
+JL003 PRNG discipline: key reuse without split; PRNGKey(const) in a loop
+JL004 donation drift: jit of a state-updating step without donate_argnums
+JL005 sharding drift: PartitionSpec axis names not defined by parallel/mesh
+JL006 dtype leak: float64 flowing into device code (jnp.float64, x64 flag)
+JL007 leftover debug statements (jax.debug.print, breakpoint, print-in-jit)
+JL000 meta: unknown rule code inside a ``# jaxlint: disable=`` comment
+===== ======================================================================
+
+Suppression: ``# jaxlint: disable=JL001`` on the offending line, or
+``# jaxlint: disable-file=JL001`` anywhere in the file for a file-wide
+waiver.  Runtime complement: :class:`utils.compile_watchdog.CompileWatchdog`
+counts actual XLA compilations and fails tests that recompile steady-state
+steps.
+"""
+
+from .core import (
+    Finding,
+    RULES,
+    lint_paths,
+    lint_source,
+    main,
+)
+from . import rules as _rules  # noqa: F401  populates RULES at import
+
+__all__ = ["Finding", "RULES", "lint_paths", "lint_source", "main"]

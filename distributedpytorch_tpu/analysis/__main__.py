@@ -1,0 +1,8 @@
+"""``python -m distributedpytorch_tpu.analysis [paths...]`` — jaxlint CLI."""
+
+import sys
+
+from .core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

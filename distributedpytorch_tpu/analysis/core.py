@@ -1,0 +1,439 @@
+"""jaxlint driver: rule registry, jit-body detection, suppressions, CLI.
+
+Import-light on purpose (stdlib only — no jax/numpy): the linter must run
+in CI containers, pre-commit hooks, and editors without initializing a
+backend.  Rules live in :mod:`rules`; this module owns everything they
+share — the per-file analysis context (AST, parents, which functions are
+jit-traced, the allowed sharding axes) and the suppression grammar.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import sys
+import tokenize
+from typing import Callable, Iterable, Iterator
+
+#: codes the suppression parser accepts beyond registered rules
+META_CODE = "JL000"
+
+#: the canonical axes of parallel/mesh.py — ALWAYS accepted by JL005;
+#: ``*_AXIS`` constants found in the linted sources extend this whitelist
+DEFAULT_AXES = frozenset({"data", "model"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit: ``path:line:col: CODE message``."""
+
+    code: str
+    message: str
+    path: str
+    line: int
+    col: int
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} " \
+               f"{self.message}"
+
+
+#: registry: code -> rule function ``(ctx) -> Iterable[Finding]``
+RULES: dict[str, Callable] = {}
+
+
+def rule(code: str, name: str, summary: str):
+    """Register a rule function under ``code`` (JLxxx)."""
+
+    def deco(fn):
+        fn.code, fn.name, fn.summary = code, name, summary
+        if code in RULES:
+            raise ValueError(f"duplicate rule code {code}")
+        RULES[code] = fn
+        return fn
+
+    return deco
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``jax.random.split`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_with_parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """Does this expression evaluate to a jit transform?  Covers ``jax.jit``
+    and ``functools.partial(jax.jit, ...)``."""
+    if dotted_name(node) in _JIT_NAMES:
+        return True
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) in _PARTIAL_NAMES
+            and bool(node.args)
+            and dotted_name(node.args[0]) in _JIT_NAMES)
+
+
+def _enclosing_funcs(node: ast.AST, parents: dict[ast.AST, ast.AST]
+                     ) -> list[ast.AST]:
+    """Function defs lexically enclosing ``node``, innermost first."""
+    chain = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            chain.append(cur)
+        cur = parents.get(cur)
+    return chain
+
+
+def _resolve_def(name: str, call: ast.Call,
+                 defs_by_name: dict[str, list],
+                 parents: dict[ast.AST, ast.AST]) -> ast.FunctionDef | None:
+    """The def named ``name`` that is lexically visible at ``call`` —
+    with two same-named defs in different factories (this repo's
+    ``step_fn`` idiom), each jit call site binds its OWN scope's def."""
+    candidates = defs_by_name.get(name, [])
+    if len(candidates) == 1:
+        return candidates[0]
+    call_chain = _enclosing_funcs(call, parents)
+    best, best_depth = None, -1
+    for d in candidates:
+        chain = _enclosing_funcs(d, parents)
+        container = chain[0] if chain else None
+        if container is None:
+            depth = 0  # module level: visible everywhere
+        elif container in call_chain:
+            depth = len(call_chain) - call_chain.index(container)
+        else:
+            continue  # a sibling scope's def — not visible here
+        if depth >= best_depth:  # ties: later (re)definition wins
+            best, best_depth = d, depth
+    return best
+
+
+class JitIndex:
+    """Which functions in a module are jit-traced, and how.
+
+    Three detections, mirroring how this codebase actually jits:
+
+    * ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs;
+    * defs passed as the first argument of a ``jax.jit(...)`` call (the
+      ``return jax.jit(step_fn, ...)`` idiom of parallel/step.py),
+      resolved by lexical scope and recorded together with that call's
+      keywords so the donation rule can see ``donate_argnums``;
+    * every def nested inside a jit body (it is part of the traced
+      program).
+    """
+
+    def __init__(self, tree: ast.AST,
+                 parents: dict[ast.AST, ast.AST] | None = None):
+        if parents is None:
+            parents = walk_with_parents(tree)
+        #: root jit-traced defs (nested defs reachable by walking them)
+        self.roots: list[ast.FunctionDef] = []
+        #: jit-traced def -> list of (jit call node, its keywords)
+        self.call_sites: dict[ast.FunctionDef,
+                              list[tuple[ast.Call, list[ast.keyword]]]]
+        self.call_sites = {}
+        #: decorated defs -> the decorator node (for JL004 position)
+        self.decorated: dict[ast.FunctionDef, ast.AST] = {}
+
+        defs_by_name: dict[str, list] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        seen: set[ast.FunctionDef] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and dotted_name(node.func) in _JIT_NAMES \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                fn = _resolve_def(node.args[0].id, node, defs_by_name,
+                                  parents)
+                if fn is None:
+                    continue
+                self.call_sites.setdefault(fn, []).append(
+                    (node, node.keywords))
+                if fn not in seen:
+                    seen.add(fn)
+                    self.roots.append(fn)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for deco in node.decorator_list:
+                if _is_jit_callable(deco):
+                    self.decorated[node] = deco
+                    if node not in seen:
+                        seen.add(node)
+                        self.roots.append(node)
+        # drop roots nested inside other roots (walking the outer one
+        # already covers them; double-visits would duplicate findings)
+        spans = [(r.lineno, max(r.lineno, getattr(r, "end_lineno",
+                                                  r.lineno)), r)
+                 for r in self.roots]
+        self.roots = [
+            r for (lo, hi, r) in spans
+            if not any(o is not r and olo <= lo and hi <= ohi
+                       for (olo, ohi, o) in spans)
+        ]
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a rule sees for one file."""
+
+    path: str
+    src: str
+    tree: ast.AST
+    parents: dict[ast.AST, ast.AST]
+    jit: JitIndex
+    allowed_axes: frozenset[str]
+
+    def finding(self, code: str, node: ast.AST, message: str) -> Finding:
+        return Finding(code=code, message=message, path=self.path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0))
+
+
+# --------------------------------------------------------------- suppressions
+
+_DISABLE_RE = re.compile(
+    r"#\s*jaxlint:\s*(disable(?:-file)?)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+def parse_suppressions(src: str, path: str, known_codes: set[str]
+                       ) -> tuple[dict[int, set[str]], set[str],
+                                  list[Finding]]:
+    """Scan comments for the suppression grammar.
+
+    Returns ``(line_disables, file_disables, meta_findings)`` where
+    ``line_disables[lineno]`` is the set of codes waived on that line,
+    ``file_disables`` the file-wide set, and ``meta_findings`` the JL000
+    reports for unknown codes named in a disable comment (a typo'd code
+    silently suppressing nothing is itself a hazard).
+    """
+    line_disables: dict[int, set[str]] = {}
+    file_disables: set[str] = set()
+    meta: list[Finding] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        comments = [(t.start[0], t.start[1], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return line_disables, file_disables, meta
+    for lineno, col, text in comments:
+        m = _DISABLE_RE.search(text)
+        if m is None:
+            # only a comment that attempts the directive grammar — the
+            # tool name, a colon, and a waiver keyword — is malformed;
+            # prose merely mentioning the words is not
+            if re.search(r"jaxlint\s*:", text) and "disable" in text:
+                meta.append(Finding(
+                    META_CODE, f"unparseable jaxlint comment: {text!r}",
+                    path, lineno, col))
+            continue
+        kind, codes = m.group(1), m.group(2)
+        for code in (c.strip() for c in codes.split(",")):
+            if not code:
+                continue
+            if code not in known_codes:
+                meta.append(Finding(
+                    META_CODE,
+                    f"unknown rule code {code!r} in {kind}= comment "
+                    f"(known: {', '.join(sorted(known_codes))})",
+                    path, lineno, col))
+                continue
+            if kind == "disable-file":
+                file_disables.add(code)
+            else:
+                line_disables.setdefault(lineno, set()).add(code)
+    return line_disables, file_disables, meta
+
+
+# -------------------------------------------------------------------- driver
+
+def collect_axis_names(trees: Iterable[ast.AST]) -> frozenset[str]:
+    """Sharding axis names the linted sources define: every module-level
+    ``<NAME>_AXIS = "literal"`` constant (parallel/mesh.py's DATA_AXIS /
+    MODEL_AXIS, pipeline.py's PIPE_AXIS, moe.py's EXPERT_AXIS, ...)."""
+    axes: set[str] = set()
+    for tree in trees:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.endswith("_AXIS") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                axes.add(node.value.value)
+    return frozenset(axes)
+
+
+def _select_rules(select: Iterable[str] | None = None,
+                  ignore: Iterable[str] | None = None) -> dict:
+    from . import rules as _rules  # noqa: F401  (registers on import)
+    chosen = dict(RULES)
+    if select:
+        unknown = set(select) - set(chosen) - {META_CODE}
+        if unknown:
+            raise ValueError(f"unknown rule code(s): {sorted(unknown)}")
+        chosen = {c: chosen[c] for c in select if c in chosen}
+    for c in ignore or ():
+        chosen.pop(c, None)
+    return chosen
+
+
+def _meta_enabled(select: Iterable[str] | None,
+                  ignore: Iterable[str] | None) -> bool:
+    """JL000 obeys --select/--ignore like any rule."""
+    if select is not None and META_CODE not in select:
+        return False
+    return META_CODE not in (ignore or ())
+
+
+def lint_source(src: str, path: str = "<string>",
+                select: Iterable[str] | None = None,
+                ignore: Iterable[str] | None = None,
+                allowed_axes: Iterable[str] | None = None,
+                tree: ast.AST | None = None) -> list[Finding]:
+    """Lint one source string; returns findings sorted by position.
+
+    ``allowed_axes``: the sharding axis names JL005 accepts; defaults to
+    the canonical ``{"data", "model"}`` plus any ``*_AXIS`` constants
+    defined in ``src`` itself.
+    ``tree``: pre-parsed AST of ``src``, to spare a reparse.
+    """
+    chosen = _select_rules(select, ignore)
+    meta_on = _meta_enabled(select, ignore)
+    if tree is None:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            if not meta_on:
+                return []
+            return [Finding(META_CODE, f"syntax error: {e.msg}", path,
+                            e.lineno or 1, e.offset or 0)]
+    if allowed_axes is None:
+        axes = collect_axis_names([tree]) | DEFAULT_AXES
+    else:
+        axes = frozenset(allowed_axes)
+    parents = walk_with_parents(tree)
+    ctx = FileContext(path=path, src=src, tree=tree, parents=parents,
+                      jit=JitIndex(tree, parents), allowed_axes=axes)
+    findings: list[Finding] = []
+    for fn in chosen.values():
+        findings.extend(fn(ctx))
+    line_dis, file_dis, meta = parse_suppressions(
+        src, path, set(RULES) | {META_CODE})
+    findings = [
+        f for f in findings
+        if f.code not in file_dis
+        and f.code not in line_dis.get(f.line, ())
+    ]
+    if meta_on:
+        findings.extend(m for m in meta
+                        if m.code not in file_dis
+                        and m.code not in line_dis.get(m.line, ()))
+    return sorted(findings, key=lambda f: (f.line, f.col, f.code))
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, files in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if not d.startswith(".")
+                                     and d != "__pycache__")
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+        else:
+            raise FileNotFoundError(p)
+
+
+def lint_paths(paths: Iterable[str],
+               select: Iterable[str] | None = None,
+               ignore: Iterable[str] | None = None) -> list[Finding]:
+    """Lint files/trees.  The JL005 axis whitelist is collected across ALL
+    the linted sources first (the constants live in parallel/mesh.py but
+    are consumed in other files), then each file is linted against it."""
+    files = list(iter_python_files(paths))
+    sources: dict[str, str] = {}
+    trees: dict[str, ast.AST] = {}
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            sources[f] = fh.read()
+        try:
+            trees[f] = ast.parse(sources[f])
+        except SyntaxError:
+            pass  # lint_source reports it per file below
+    axes = collect_axis_names(trees.values()) | DEFAULT_AXES
+    findings: list[Finding] = []
+    for f in files:
+        findings.extend(lint_source(sources[f], path=f, select=select,
+                                    ignore=ignore, allowed_axes=axes,
+                                    tree=trees.get(f)))
+    return sorted(findings, key=lambda x: (x.path, x.line, x.col, x.code))
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: ``jaxlint [paths...]`` — exit 0 when clean, 1 with findings."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="AST-based TPU-hazard linter for jax code "
+                    "(see docs/DESIGN.md 'Static analysis').")
+    # default: the installed package itself, wherever jaxlint is run from
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    parser.add_argument("paths", nargs="*", default=[pkg_dir],
+                        help="files or directories (default: the package)")
+    parser.add_argument("--select", help="comma-separated codes to run")
+    parser.add_argument("--ignore", help="comma-separated codes to skip")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    args = parser.parse_args(argv)
+
+    from . import rules as _rules  # noqa: F401  (registers on import)
+    if args.list_rules:
+        for code in sorted(RULES):
+            fn = RULES[code]
+            print(f"{code}  {fn.name}: {fn.summary}")
+        return 0
+    split = lambda s: [c.strip() for c in s.split(",") if c.strip()]  # noqa: E731
+    try:
+        findings = lint_paths(
+            args.paths,
+            select=split(args.select) if args.select else None,
+            ignore=split(args.ignore) if args.ignore else None)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"jaxlint: error: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.format())
+    if findings:
+        print(f"jaxlint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0

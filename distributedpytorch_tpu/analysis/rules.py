@@ -1,0 +1,659 @@
+"""jaxlint rules: the TPU failure modes this codebase has paid for.
+
+Each rule is a function ``(ctx: FileContext) -> Iterable[Finding]``
+registered under a stable ``JLxxx`` code.  Rules are deliberately
+heuristic — they run on the AST with no type information — so each one is
+scoped to keep false positives near zero on idiomatic jax code: hazards
+that only matter inside a compiled program (host syncs, tracer branching,
+float64, print) are checked only inside *jit bodies* as detected by
+:class:`core.JitIndex`, while hazards that are wrong anywhere (key reuse,
+unknown sharding axes, jax.debug leftovers) are checked module-wide.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Iterator
+
+from .core import Finding, FileContext, dotted_name, rule
+
+# --------------------------------------------------------------- shared bits
+
+#: attribute reads on a tracer that are STATIC under jit — branching or
+#: host math on these never retraces
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _is_shape_derived(node: ast.AST) -> bool:
+    """Expression provably derived from static tracer metadata (or
+    constants) — ``x.shape[0]``, ``len(w)``, ``a.ndim - 1``..."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return True
+    if isinstance(node, ast.Subscript):
+        return _is_shape_derived(node.value)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "len":
+        return True
+    if isinstance(node, ast.BinOp):
+        return _is_shape_derived(node.left) and _is_shape_derived(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_shape_derived(node.operand)
+    return False
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _param_names(fn: ast.FunctionDef) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+def _assigned_names(target: ast.AST) -> Iterator[str]:
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            yield n.id
+
+
+# ---------------------------------------------------------------- JL001
+
+_HOST_SYNC_CALLS = {
+    "jax.device_get": "jax.device_get materializes device values on host",
+    "np.asarray": "np.asarray on a tracer forces a device->host transfer",
+    "np.array": "np.array on a tracer forces a device->host transfer",
+    "numpy.asarray": "numpy.asarray forces a device->host transfer",
+    "numpy.array": "numpy.array forces a device->host transfer",
+    "jax.block_until_ready": "blocking sync inside a traced function",
+}
+_SCALAR_BUILTINS = {"float", "int", "bool"}
+
+
+def _tainted_names(root: ast.FunctionDef) -> set[str]:
+    """Root params plus every name assigned from a param-derived
+    expression, propagated to a fixed point (statement order doesn't
+    matter; taint only grows)."""
+    tainted = set(_param_names(root))
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(root):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                continue
+            value = node.value
+            if value is None or not _taints(value, tainted):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for name in _assigned_names(t):
+                    if name not in tainted:
+                        tainted.add(name)
+                        changed = True
+    return tainted
+
+
+@rule("JL001", "host-sync-in-jit",
+      "host-device synchronization reachable from a jitted function")
+def host_sync_in_jit(ctx: FileContext) -> Iterable[Finding]:
+    for root in ctx.jit.roots:
+        tainted = _tainted_names(root)
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                yield ctx.finding(
+                    "JL001", node,
+                    ".item() inside a jitted function is a per-element "
+                    "device->host round trip; keep the value on device or "
+                    "read it back in bulk outside jit")
+            elif name in _HOST_SYNC_CALLS \
+                    and any(_taints(a, tainted) for a in node.args):
+                # taint-gated: np.array([0.485, ...]) on literals is a
+                # legitimate trace-time constant, not a device readback
+                yield ctx.finding(
+                    "JL001", node,
+                    f"{name}() inside a jitted function: "
+                    f"{_HOST_SYNC_CALLS[name]} — use jnp on the tracer "
+                    "instead")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready":
+                yield ctx.finding(
+                    "JL001", node,
+                    ".block_until_ready() inside a jitted function is a "
+                    "blocking host sync — move it outside the traced code")
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in _SCALAR_BUILTINS \
+                    and len(node.args) == 1 \
+                    and _taints(node.args[0], tainted):
+                yield ctx.finding(
+                    "JL001", node,
+                    f"{node.func.id}() on a traced value concretizes it "
+                    "(host sync or ConcretizationTypeError); compute with "
+                    "jnp scalars instead")
+
+
+# ---------------------------------------------------------------- JL002
+
+def _is_none_check(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` — pytree STRUCTURE, static under
+    jit (an optional leaf's presence never retraces)."""
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops)
+            and any(isinstance(c, ast.Constant) and c.value is None
+                    for c in (test.left, *test.comparators)))
+
+
+def _is_structure_check(test: ast.AST, tainted: set[str]) -> bool:
+    """True when every tainted name in ``test`` is consumed through a
+    PYTREE-STRUCTURE predicate — ``isinstance(x, ...)`` or a string-key
+    membership ``"k" in x`` — which are static at trace time (the guard
+    raises/branches while tracing, never per-value)."""
+    static_ids: set[int] = set()
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "isinstance":
+            static_ids.update(id(n) for n in ast.walk(sub))
+        if isinstance(sub, ast.Compare) \
+                and all(isinstance(op, (ast.In, ast.NotIn))
+                        for op in sub.ops) \
+                and isinstance(sub.left, ast.Constant) \
+                and isinstance(sub.left.value, str):
+            for c in sub.comparators:
+                static_ids.update(id(n) for n in ast.walk(c))
+    return all(id(n) in static_ids for n in ast.walk(test)
+               if isinstance(n, ast.Name) and n.id in tainted)
+
+
+@rule("JL002", "tracer-control-flow",
+      "Python if/while on tracer-derived values retraces per value")
+def tracer_control_flow(ctx: FileContext) -> Iterable[Finding]:
+    for root in ctx.jit.roots:
+        tainted = set(_param_names(root))
+        yield from _walk_taint(ctx, root.body, tainted)
+
+
+def _taints(node: ast.AST, tainted: set[str]) -> bool:
+    """Does evaluating ``node`` produce a tracer-derived value?  Static
+    metadata (.shape/.ndim/len) and None-checks break the chain."""
+    if _is_shape_derived(node):
+        return False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            # a tainted name under a static-attr read doesn't taint; walk
+            # can't see context, so re-test the smallest enclosing pieces
+            return not _only_static_uses(node, tainted)
+    return False
+
+
+def _only_static_uses(node: ast.AST, tainted: set[str]) -> bool:
+    """True when every tainted Name inside ``node`` is consumed through a
+    static attribute (``x.shape``...) or ``len(x)``."""
+    static_spans: list[tuple[int, int]] = []
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Attribute)
+                and sub.attr in _STATIC_ATTRS) or (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len"):
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name) and n.id in tainted:
+                    static_spans.append((n.lineno, n.col_offset))
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in tainted:
+            if (n.lineno, n.col_offset) not in static_spans:
+                return False
+    return True
+
+
+def _walk_taint(ctx: FileContext, body: list[ast.stmt],
+                tainted: set[str]) -> Iterator[Finding]:
+    """Forward taint pass: params are tracers; assignments propagate;
+    if/while tests on tainted values are flagged.  Taint only grows
+    (branches are not merged) — conservative and order-robust."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None and _taints(value, tainted):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in targets:
+                    tainted.update(_assigned_names(t))
+        elif isinstance(stmt, (ast.If, ast.While)):
+            if not _is_none_check(stmt.test) \
+                    and not _is_structure_check(stmt.test, tainted) \
+                    and _taints(stmt.test, tainted):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                yield ctx.finding(
+                    "JL002", stmt,
+                    f"Python `{kind}` on a tracer-derived value: the "
+                    "branch is decided at TRACE time, recompiling per "
+                    "concrete value (or raising under jit) — use "
+                    "jnp.where / lax.cond / lax.while_loop")
+            yield from _walk_taint(ctx, stmt.body, tainted)
+            yield from _walk_taint(ctx, stmt.orelse, tainted)
+            continue
+        elif isinstance(stmt, ast.For):
+            if _taints(stmt.iter, tainted):
+                yield ctx.finding(
+                    "JL002", stmt,
+                    "Python `for` over a tracer-derived iterable unrolls "
+                    "at trace time and retraces per length — use "
+                    "lax.scan / lax.fori_loop")
+            yield from _walk_taint(ctx, stmt.body, tainted)
+            yield from _walk_taint(ctx, stmt.orelse, tainted)
+            continue
+        # recurse into other compound statements, nested defs included
+        # (a def nested in a jit body is traced with the same closures;
+        # its params shadow, so drop them from the view it sees)
+        for child_body, shadow in _child_bodies(stmt):
+            yield from _walk_taint(ctx, child_body, tainted - shadow)
+
+
+def _child_bodies(stmt: ast.stmt
+                  ) -> Iterator[tuple[list[ast.stmt], set[str]]]:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        yield stmt.body, _param_names(stmt)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        yield stmt.body, set()
+    elif isinstance(stmt, ast.Try):
+        for b in (stmt.body, stmt.orelse, stmt.finalbody,
+                  *[h.body for h in stmt.handlers]):
+            yield b, set()
+
+
+# ---------------------------------------------------------------- JL003
+
+#: jax.random functions that MANAGE keys rather than consume them
+_KEY_MANAGERS = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                 "wrap_key_data", "clone"}
+
+#: parameter names treated as live keys without a visible binding
+_KEY_PARAM_RE = re.compile(r"(^|_)(rng|key|prng_key|prngkey)$")
+
+
+def _random_module_aliases(tree: ast.AST) -> frozenset[str]:
+    """Local names the random module is reachable under: ``random`` always
+    (``jax.random.split`` / ``from jax import random``), plus any alias from
+    ``import jax.random as jr`` or ``from jax import random as jrandom``."""
+    aliases = {"random"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            aliases.update(a.asname for a in node.names
+                           if a.name == "jax.random" and a.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+            aliases.update(a.asname for a in node.names
+                           if a.name == "random" and a.asname)
+    return frozenset(aliases)
+
+
+def _random_fn(call: ast.Call,
+               aliases: frozenset[str] = frozenset({"random"})
+               ) -> str | None:
+    """'split' for ``jax.random.split(...)``-shaped calls, else None."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[-2] in aliases:
+        return parts[-1]
+    return None
+
+
+@rule("JL003", "prng-discipline",
+      "PRNG key consumed twice without a split, or PRNGKey(const) in a loop")
+def prng_discipline(ctx: FileContext) -> Iterable[Finding]:
+    aliases = _random_module_aliases(ctx.tree)
+    # per-scope reuse analysis
+    scopes = [n for n in ast.walk(ctx.tree)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for scope in scopes:
+        yield from _check_key_reuse(ctx, scope, aliases)
+    # PRNGKey(constant) under a loop, anywhere in the module (each call
+    # reported once, however deeply the loops nest)
+    reported: set[ast.AST] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.For, ast.While)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and sub not in reported \
+                        and _random_fn(sub, aliases) in ("PRNGKey", "key") \
+                        and sub.args \
+                        and isinstance(sub.args[0], ast.Constant):
+                    reported.add(sub)
+                    yield ctx.finding(
+                        "JL003", sub,
+                        "PRNGKey(constant) inside a loop yields the SAME "
+                        "stream every iteration — split one key outside "
+                        "the loop (or fold_in the loop index)")
+
+
+def _stmt_exprs(stmt: ast.stmt) -> Iterator[ast.expr]:
+    """The statement's OWN expressions — child statement bodies excluded
+    (they are walked separately, so each expression is seen exactly once)."""
+    for _field, value in ast.iter_fields(stmt):
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.expr):
+                    yield v
+                elif isinstance(v, ast.withitem):
+                    yield v.context_expr
+                elif isinstance(v, ast.keyword):
+                    yield v.value
+
+
+def _innermost_call(node: ast.AST, parents: dict, stop: ast.AST
+                    ) -> ast.Call | None:
+    """Nearest enclosing Call of ``node``, not ascending past ``stop`` —
+    ``split(key)`` inside ``deg2rad(uniform(key))`` attributes the use to
+    ``uniform``, the call that actually receives the key."""
+    cur = parents.get(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, ast.Call):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _check_key_reuse(ctx: FileContext, scope: ast.FunctionDef,
+                     aliases: frozenset[str] = frozenset({"random"})
+                     ) -> Iterator[Finding]:
+    """Linear walk of one function: names bound from jax.random key ops
+    are 'live keys'; passing a live key to any call consumes it (split /
+    fold_in are the sanctioned re-uses); a second consumption without an
+    intervening rebind is the classic silent-correlation bug."""
+    consumed: dict[str, ast.AST] = {}   # key name -> first consuming node
+    # live keys: names bound from jax.random key ops, plus — in functions
+    # that visibly use jax.random — parameters that are unmistakably keys
+    # by name (the `def f(key): two draws from key` shape is THE classic
+    # reuse bug).  Functions with no jax.random call in sight get no
+    # name-based seeding: an `rng` there is likely a numpy Generator.
+    uses_jax_random = any(
+        isinstance(n, ast.Call) and _random_fn(n, aliases) is not None
+        for n in ast.walk(scope))
+    keys: set[str] = {p for p in _param_names(scope)
+                      if _KEY_PARAM_RE.search(p)} if uses_jax_random \
+        else set()
+
+    def handle_stmt(stmt: ast.stmt) -> Iterator[Finding]:
+        for expr in _stmt_exprs(stmt):
+            for name_node in ast.walk(expr):
+                if not (isinstance(name_node, ast.Name)
+                        and isinstance(name_node.ctx, ast.Load)
+                        and name_node.id in keys):
+                    continue
+                call = _innermost_call(name_node, ctx.parents, stmt)
+                if call is None:
+                    continue  # bare aliasing, not a draw
+                if name_node is call.func or (
+                        isinstance(call.func, ast.Attribute)
+                        and name_node in ast.walk(call.func)):
+                    continue  # key.something(...) — not an argument use
+                fn = _random_fn(call, aliases)
+                if fn in _KEY_MANAGERS:
+                    continue  # split/fold_in are the sanctioned uses
+                prior = consumed.get(name_node.id)
+                if prior is not None:
+                    yield ctx.finding(
+                        "JL003", name_node,
+                        f"key {name_node.id!r} already consumed at line "
+                        f"{prior.lineno} and used again without an "
+                        "intervening jax.random.split — reusing a key "
+                        "silently correlates the two draws")
+                else:
+                    consumed[name_node.id] = name_node
+        # (re)bindings AFTER uses within the statement: x, y = split(x)
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            # unwrap subscripts: `key = split(key)[0]` rebinds a fresh key
+            core = value
+            while isinstance(core, ast.Subscript):
+                core = core.value
+            is_key_value = isinstance(core, ast.Call) \
+                and _random_fn(core, aliases) in _KEY_MANAGERS
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                for name in _assigned_names(t):
+                    if is_key_value:
+                        keys.add(name)
+                    else:
+                        keys.discard(name)  # retired from tracking
+                    consumed.pop(name, None)
+
+    def _terminates(body: list[ast.stmt]) -> bool:
+        return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise,
+                                                    ast.Break, ast.Continue))
+
+    def walk_branch(body: list[ast.stmt]) -> tuple[list, dict]:
+        """Walk one ALTERNATE path without mutating the shared state:
+        returns (findings, state-after-on-fall-through), where a branch
+        that cannot fall through contributes nothing to the
+        continuation (the classic early-return shape)."""
+        snapshot = dict(consumed)
+        findings = list(walk_body(body))
+        after = snapshot if _terminates(body) else dict(consumed)
+        consumed.clear()
+        consumed.update(snapshot)
+        return findings, dict(after)
+
+    def walk_body(body: list[ast.stmt]) -> Iterator[Finding]:
+        for stmt in body:
+            # nested defs get their own _check_key_reuse invocation
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from handle_stmt(stmt)
+            if isinstance(stmt, ast.If):
+                # mutually exclusive paths: each walks from the pre-if
+                # state (one branch's draw must not read as the other's
+                # reuse); the continuation state is the UNION of the
+                # fall-through branch states — replacing, not updating,
+                # so a key rebound in both branches comes back clean
+                body_findings, after_body = walk_branch(stmt.body)
+                else_findings, after_else = walk_branch(stmt.orelse)
+                yield from body_findings
+                yield from else_findings
+                consumed.clear()
+                consumed.update(after_body)
+                consumed.update(after_else)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                # two linear passes ~= two unrolled iterations: a key
+                # consumed each iteration without an intervening rebind
+                # surfaces as a reuse on the second pass (duplicates are
+                # collapsed by the scope-level position filter)
+                yield from walk_body(stmt.body)
+                yield from walk_body(stmt.body)
+                yield from walk_body(stmt.orelse)
+            else:
+                # with/try bodies are the SAME path, not alternatives:
+                # walk them inline so their rebinds clear state for the
+                # continuation; only except handlers are alternates
+                for field in ("body", "orelse", "finalbody"):
+                    child = getattr(stmt, field, None)
+                    if child:
+                        yield from walk_body(child)
+                for h in getattr(stmt, "handlers", ()) or ():
+                    findings, after = walk_branch(h.body)
+                    yield from findings
+                    consumed.update(after)
+
+    emitted: set[tuple[int, int]] = set()
+    for f in walk_body(list(scope.body)):
+        if (f.line, f.col) not in emitted:
+            emitted.add((f.line, f.col))
+            yield f
+
+
+# ---------------------------------------------------------------- JL004
+
+def _updates_own_arg(fn: ast.FunctionDef) -> str | None:
+    """Name of a parameter the function returns an updated version of —
+    the ``state.replace(...)`` / ``optax.apply_updates(state, ...)``
+    step-function shape whose old buffers are dead after the call."""
+    params = _param_names(fn)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "replace" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in params:
+            return node.func.value.id
+        name = dotted_name(node.func)
+        if name is not None and name.split(".")[-1] == "apply_updates" \
+                and node.args and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in params:
+            return node.args[0].id
+    return None
+
+
+@rule("JL004", "donation-drift",
+      "jit of a state-updating step without donate_argnums")
+def donation_drift(ctx: FileContext) -> Iterable[Finding]:
+    for fn, sites in ctx.jit.call_sites.items():
+        arg = _updates_own_arg(fn)
+        if arg is None:
+            continue
+        for call, keywords in sites:
+            if not any(kw.arg in ("donate_argnums", "donate_argnames")
+                       for kw in keywords):
+                yield ctx.finding(
+                    "JL004", call,
+                    f"jit of {fn.name!r} returns an updated {arg!r} but "
+                    "donates nothing: the old buffers stay live across "
+                    "the call, doubling peak HBM — pass donate_argnums")
+    for fn_node, deco in ctx.jit.decorated.items():
+        arg = _updates_own_arg(fn_node)
+        if arg is None:
+            continue
+        kws = deco.keywords if isinstance(deco, ast.Call) else []
+        if not any(kw.arg in ("donate_argnums", "donate_argnames")
+                   for kw in kws):
+            yield ctx.finding(
+                "JL004", deco,
+                f"jitted {fn_node.name!r} returns an updated {arg!r} but "
+                "donates nothing: the old buffers stay live across the "
+                "call, doubling peak HBM — use "
+                "partial(jax.jit, donate_argnums=...)")
+
+
+# ---------------------------------------------------------------- JL005
+
+_PSPEC_NAMES = {"P", "PartitionSpec", "jax.sharding.PartitionSpec",
+                "sharding.PartitionSpec"}
+
+
+@rule("JL005", "sharding-axis-drift",
+      "PartitionSpec axis name not defined by the mesh modules")
+def sharding_axis_drift(ctx: FileContext) -> Iterable[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func) in _PSPEC_NAMES):
+            continue
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str) \
+                        and sub.value not in ctx.allowed_axes:
+                    yield ctx.finding(
+                        "JL005", sub,
+                        f"PartitionSpec axis {sub.value!r} is not a mesh "
+                        "axis defined by the *_AXIS constants "
+                        f"({', '.join(sorted(ctx.allowed_axes))}) — a "
+                        "typo'd axis silently replicates instead of "
+                        "sharding")
+
+
+# ---------------------------------------------------------------- JL006
+
+@rule("JL006", "float64-leak",
+      "float64 flowing into device code (TPUs have no f64 units)")
+def float64_leak(ctx: FileContext) -> Iterable[Finding]:
+    # jnp.float64 anywhere: without x64 it silently truncates to f32;
+    # with x64 it software-emulates at ~25x cost on TPU
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute) and node.attr == "float64" \
+                and dotted_name(node) in ("jnp.float64",
+                                          "jax.numpy.float64"):
+            yield ctx.finding(
+                "JL006", node,
+                "jnp.float64 is a silent f32 truncation without "
+                "jax_enable_x64 and a ~25x software-emulated cost with it "
+                "— use jnp.float32 (or explicit f32 accumulation)")
+        if isinstance(node, ast.Call) \
+                and dotted_name(node.func) == "jax.config.update" \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value == "jax_enable_x64":
+            yield ctx.finding(
+                "JL006", node,
+                "jax_enable_x64 flips EVERY default dtype to 64-bit — "
+                "device code pays software-emulated f64 on TPU; scope "
+                "precision per-array instead")
+    # inside jit bodies: numpy float64 constructions become device
+    # constants that either upcast the program or truncate silently
+    for root in ctx.jit.roots:
+        for node in ast.walk(root):
+            name = dotted_name(node) if isinstance(node, ast.Attribute) \
+                else None
+            if name in ("np.float64", "numpy.float64"):
+                yield ctx.finding(
+                    "JL006", node,
+                    "np.float64 inside a jitted function: the f64 "
+                    "constant upcasts downstream math (then truncates on "
+                    "TPU) — use np.float32/jnp.float32")
+            if isinstance(node, ast.Constant) and node.value == "float64":
+                yield ctx.finding(
+                    "JL006", node,
+                    "'float64' dtype inside a jitted function — TPUs "
+                    "have no f64; use 'float32'")
+
+
+# ---------------------------------------------------------------- JL007
+
+_DEBUG_CALLS = {
+    "jax.debug.print": "jax.debug.print forces a host callback every "
+                       "step — remove it or gate it behind a debug flag",
+    "jax.debug.breakpoint": "jax.debug.breakpoint halts every device "
+                            "program — remove before committing",
+    "pdb.set_trace": "pdb left in committed code",
+}
+
+
+@rule("JL007", "debug-leftover",
+      "leftover debug statements (jax.debug.print, breakpoint, print-in-jit)")
+def debug_leftover(ctx: FileContext) -> Iterable[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name in _DEBUG_CALLS:
+            yield ctx.finding("JL007", node, _DEBUG_CALLS[name])
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id == "breakpoint":
+            yield ctx.finding("JL007", node, "breakpoint() left in "
+                              "committed code")
+    for root in ctx.jit.roots:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "print":
+                yield ctx.finding(
+                    "JL007", node,
+                    "print() inside a jitted function runs at TRACE time "
+                    "only (once, with tracers) — it never sees runtime "
+                    "values; delete it or use logging outside jit")

@@ -87,7 +87,10 @@ def tpu_reachable(timeout_s: int = 240) -> bool:
 
 
 def ensure_backend_or_cpu_fallback(
-        recovery_minutes: float | None = None) -> bool:
+        recovery_minutes: float | None = None, *,
+        ignore_env: bool = False,
+        backoff_base: float = 5.0,
+        backoff_cap: float = 60.0) -> bool:
     """Probe (with a bounded recovery poll) and fall back to CPU if the
     backend stays down.
 
@@ -100,31 +103,47 @@ def ensure_backend_or_cpu_fallback(
     A wedged tunnel has been observed to recover within minutes-to-tens-of-
     minutes, and a CPU number can cost a whole benchmark round — so instead
     of a fixed retry count, the probe POLLS until ``recovery_minutes`` of
-    wall clock have elapsed (env ``DPTPU_BENCH_RECOVERY_MINUTES`` overrides;
-    default 2 — a couple of fast-fail probes for interactive scripts.
-    ``bench.py`` passes a much longer window because its output is the
-    round's official record).  Each individual probe stays hard-bounded in
-    a child process, so a wedged backend init cannot take the poller down.
+    wall clock have elapsed (env ``DPTPU_BENCH_RECOVERY_MINUTES`` overrides
+    unless ``ignore_env`` — the escape hatch for an explicit CLI flag like
+    bench.py's ``--wait-for-backend``; default 2 — a couple of fast-fail
+    probes for interactive scripts.  ``bench.py`` passes a much longer
+    window because its output is the round's official record).  Each
+    individual probe stays hard-bounded in a child process, so a wedged
+    backend init cannot take the poller down.
+
+    Retries back off exponentially from ``backoff_base`` seconds to
+    ``backoff_cap``: a tunnel that recovers in seconds is caught within
+    seconds (the fixed 60 s nap used to eat most of short windows), while
+    a long outage converges to the old one-probe-a-minute cadence.
     """
     if os.environ.get("DPTPU_BENCH_PROBE") == "0" or \
             os.environ.get("JAX_PLATFORMS") == "cpu":
         return True
     env_min = os.environ.get("DPTPU_BENCH_RECOVERY_MINUTES")
-    if env_min is not None:
+    if ignore_env:
+        pass  # explicit caller flag beats ambient env configuration
+    elif env_min is not None:
         try:
             recovery_minutes = float(env_min)
         except ValueError:
             pass
     elif os.environ.get("DPTPU_BENCH_PROBE_RETRIES") is not None:
-        # Honor the pre-poll knob's contract: N retries spaced ~60 s apart
-        # == an (N-1)-minute window (N=1 -> single probe, fast fallback).
+        # Honor the pre-poll knob's contract literally: N probes spaced
+        # ~60 s apart == an (N-1)-minute window (N=1 -> single probe,
+        # fast fallback).  The legacy fixed cadence, not the fast ramp —
+        # so both the probe count AND the recovery window stay what the
+        # knob documented.
         try:
-            recovery_minutes = max(
-                0.0,
-                float(os.environ["DPTPU_BENCH_PROBE_RETRIES"]) - 1)
+            n = float(os.environ["DPTPU_BENCH_PROBE_RETRIES"])
+            if n != n:            # NaN would poison the deadline math
+                raise ValueError(n)
+            recovery_minutes = max(0.0, n - 1)
+            backoff_base = backoff_cap
         except ValueError:
             pass
-    if recovery_minutes is None:
+    if recovery_minutes is None or recovery_minutes != recovery_minutes:
+        # None and NaN both mean the default (a NaN window would make the
+        # deadline comparison below always-false and the poll infinite)
         recovery_minutes = 2.0
     deadline = time.time() + recovery_minutes * 60
     attempt = 0
@@ -139,7 +158,10 @@ def ensure_backend_or_cpu_fallback(
               file=sys.stderr)
         if remaining <= 0:
             break
-        time.sleep(min(60.0, max(1.0, remaining)))
+        # exponent clamped so an unbounded poll can't overflow float math
+        backoff = min(backoff_cap,
+                      backoff_base * (2 ** min(attempt - 1, 30)))
+        time.sleep(min(backoff, max(1.0, remaining)))
     print("backend probe: falling back to CPU", file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
     return False

@@ -1,8 +1,10 @@
 """Utilities: array helpers, logging, debug checks, profiling."""
 
-from . import helpers, profiling, torch_interop
+from . import compile_watchdog, helpers, profiling, torch_interop
+from .compile_watchdog import CompileWatchdog, RecompileError
 from .profiling import (StepTimer, annotate, device_memory_stats,
                         throughput, trace)
 
-__all__ = ["StepTimer", "annotate", "device_memory_stats", "helpers",
+__all__ = ["CompileWatchdog", "RecompileError", "StepTimer", "annotate",
+           "compile_watchdog", "device_memory_stats", "helpers",
            "profiling", "throughput", "torch_interop", "trace"]

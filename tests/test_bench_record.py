@@ -25,11 +25,20 @@ from distributedpytorch_tpu import backend_health  # noqa: E402
 
 
 class TestRecoveryPoll:
-    def _run(self, monkeypatch, health_results, minutes, sleeps):
-        """Drive the poll with mocked health + clock; return (ok, probes)."""
+    def _run(self, monkeypatch, health_results, minutes, sleeps,
+             clear_env=True, clear_retries=True, **kwargs):
+        """Drive the poll with mocked health + an ADVANCING clock (a
+        regression that re-opens a long window fails the assert instead of
+        spinning forever); return (ok, probes).  ``kwargs`` pass through to
+        ensure_backend_or_cpu_fallback; ``clear_env=False`` /
+        ``clear_retries=False`` keep the ambient knob a test just set."""
         monkeypatch.delenv("DPTPU_BENCH_PROBE", raising=False)
         monkeypatch.delenv("JAX_PLATFORMS", raising=False)
-        monkeypatch.delenv("DPTPU_BENCH_RECOVERY_MINUTES", raising=False)
+        if clear_env:
+            monkeypatch.delenv("DPTPU_BENCH_RECOVERY_MINUTES",
+                               raising=False)
+        if clear_retries:
+            monkeypatch.delenv("DPTPU_BENCH_PROBE_RETRIES", raising=False)
         clock = [0.0]
         calls = []
 
@@ -48,7 +57,7 @@ class TestRecoveryPoll:
                                   lambda: clock[0]), \
                 mock.patch.object(backend_health.time, "sleep", fake_sleep):
             ok = backend_health.ensure_backend_or_cpu_fallback(
-                recovery_minutes=minutes)
+                recovery_minutes=minutes, **kwargs)
         return ok, len(calls)
 
     def test_polls_until_recovery_within_window(self, monkeypatch):
@@ -67,45 +76,78 @@ class TestRecoveryPoll:
         assert not ok
         assert os.environ.get("JAX_PLATFORMS") == "cpu"
         monkeypatch.delenv("JAX_PLATFORMS", raising=False)
-        # ~5 min of 60 s naps, plus the final partial one
-        assert 5 <= probes <= 7
+        # backoff ramp (5,10,20,40) then 60 s naps, plus the final partial
+        assert 7 <= probes <= 10
         assert sum(sleeps) <= 5 * 60 + 60
 
-    def test_env_override_shrinks_window(self, monkeypatch):
-        monkeypatch.delenv("DPTPU_BENCH_PROBE", raising=False)
+    def test_backoff_ramps_then_caps(self, monkeypatch):
+        # early probes come fast (a tunnel that recovers in seconds is
+        # caught in seconds), later ones settle at the 60 s cadence
+        sleeps = []
+        self._run(monkeypatch, [False], minutes=5, sleeps=sleeps)
         monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        assert sleeps[0] < 60
+        full = sleeps[:-1]  # the last nap is clipped to the window edge
+        assert all(a <= b for a, b in zip(full, full[1:]))
+        assert max(sleeps) <= 60
+        assert 60 in sleeps  # the cap is reached within a 5-min window
+
+    def test_explicit_window_can_ignore_env(self, monkeypatch):
+        # bench.py --wait-for-backend passes ignore_env=True: the CLI flag
+        # must beat an ambient DPTPU_BENCH_RECOVERY_MINUTES
+        monkeypatch.setenv("DPTPU_BENCH_RECOVERY_MINUTES", "30")
+        sleeps = []
+        ok, probes = self._run(monkeypatch, [False], minutes=0,
+                               sleeps=sleeps, clear_env=False,
+                               ignore_env=True)
+        assert not ok and probes == 1 and sleeps == []
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    def test_env_override_shrinks_window(self, monkeypatch):
         monkeypatch.setenv("DPTPU_BENCH_RECOVERY_MINUTES", "0")
         sleeps = []
-        clock = [0.0]
-        with mock.patch.object(backend_health, "accelerator_healthy",
-                               lambda *a, **k: (False, "down")), \
-                mock.patch.object(backend_health.time, "time",
-                                  lambda: clock[0]), \
-                mock.patch.object(backend_health.time, "sleep",
-                                  sleeps.append):
-            ok = backend_health.ensure_backend_or_cpu_fallback(
-                recovery_minutes=25)
-        assert not ok and sleeps == []
+        ok, probes = self._run(monkeypatch, [False], minutes=25,
+                               sleeps=sleeps, clear_env=False)
+        assert not ok and probes == 1 and sleeps == []
         monkeypatch.delenv("JAX_PLATFORMS", raising=False)
 
     def test_legacy_retries_knob_maps_to_window(self, monkeypatch):
         # DPTPU_BENCH_PROBE_RETRIES=1 was the documented fast-fallback
         # setting; it must still mean "one probe, no waiting"
-        monkeypatch.delenv("DPTPU_BENCH_PROBE", raising=False)
-        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
-        monkeypatch.delenv("DPTPU_BENCH_RECOVERY_MINUTES", raising=False)
         monkeypatch.setenv("DPTPU_BENCH_PROBE_RETRIES", "1")
         sleeps = []
-        clock = [0.0]
-        with mock.patch.object(backend_health, "accelerator_healthy",
-                               lambda *a, **k: (False, "down")), \
-                mock.patch.object(backend_health.time, "time",
-                                  lambda: clock[0]), \
-                mock.patch.object(backend_health.time, "sleep",
-                                  sleeps.append):
-            ok = backend_health.ensure_backend_or_cpu_fallback(
-                recovery_minutes=25)
-        assert not ok and sleeps == []
+        ok, probes = self._run(monkeypatch, [False], minutes=25,
+                               sleeps=sleeps, clear_retries=False)
+        assert not ok and probes == 1 and sleeps == []
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    def test_legacy_retries_inf_is_unbounded_poll(self, monkeypatch):
+        monkeypatch.setenv("DPTPU_BENCH_PROBE_RETRIES", "inf")
+        sleeps = []
+        ok, probes = self._run(monkeypatch, [False, False, True],
+                               minutes=25, sleeps=sleeps, clear_retries=False)
+        assert ok and probes == 3
+
+    def test_legacy_retries_knob_keeps_minute_cadence(self, monkeypatch):
+        # N retries means N probes ~60 s apart — the legacy fixed cadence,
+        # not the fast ramp (a fast-failing probe must not burn the whole
+        # recovery window in seconds)
+        monkeypatch.setenv("DPTPU_BENCH_PROBE_RETRIES", "3")
+        sleeps = []
+        ok, probes = self._run(monkeypatch, [False], minutes=25,
+                               sleeps=sleeps, clear_retries=False)
+        assert not ok and probes == 3 and sleeps == [60.0, 60.0]
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    def test_nan_window_falls_back_to_default_not_infinite_poll(
+            self, monkeypatch):
+        # --wait-for-backend nan / DPTPU_BENCH_RECOVERY_MINUTES=nan must
+        # not poison the deadline math into an unbounded 1 s-cadence spin
+        sleeps = []
+        ok, probes = self._run(monkeypatch, [False],
+                               minutes=float("nan"), sleeps=sleeps)
+        assert not ok and probes >= 2  # polled the default window, ended
+        assert sum(sleeps) <= 2 * 60 + 60
         monkeypatch.delenv("JAX_PLATFORMS", raising=False)
 
     def test_skipped_when_cpu_forced(self, monkeypatch):

@@ -1,0 +1,108 @@
+"""CompileWatchdog: compile counting, the recompile budget, and exception
+hygiene — the runtime half of the analysis (jaxlint) subsystem."""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributedpytorch_tpu.utils import CompileWatchdog, RecompileError
+
+
+def fresh_jit(tag: str):
+    """A jitted function with a unique, matchable __name__ — fresh jit
+    cache per call, so counts are deterministic across test ordering."""
+    def fn(x):
+        return x * 2 + 1
+    fn.__name__ = tag
+    return jax.jit(fn)
+
+
+class TestCounting:
+    def test_steady_state_compiles_once(self):
+        step = fresh_jit("wd_steady_fn")
+        with CompileWatchdog(match="wd_steady_fn") as wd:
+            for _ in range(3):
+                step(jnp.ones((4,)))
+        assert wd.counts["wd_steady_fn"] == 1
+        assert wd.total == 1
+
+    def test_shape_drift_counts_every_recompile(self):
+        step = fresh_jit("wd_drift_fn")
+        with CompileWatchdog(match="wd_drift_fn") as wd:
+            step(jnp.ones((2,)))
+            step(jnp.ones((3,)))
+            step(jnp.ones((2,)))  # cache hit — not a compile
+        assert wd.counts["wd_drift_fn"] == 2
+
+    def test_match_filters_unrelated_compiles(self):
+        step = fresh_jit("wd_match_fn")
+        other = fresh_jit("wd_other_fn")
+        with CompileWatchdog(match="wd_match_fn") as wd:
+            step(jnp.ones((4,)))
+            other(jnp.ones((4,)))
+        assert wd.total == 1
+        assert "wd_other_fn" not in wd.counts
+
+    def test_counting_stops_outside_the_block(self):
+        step = fresh_jit("wd_scope_fn")
+        with CompileWatchdog(match="wd_scope_fn") as wd:
+            step(jnp.ones((4,)))
+        step(jnp.ones((5,)))  # recompile AFTER exit: not counted
+        assert wd.counts["wd_scope_fn"] == 1
+
+
+class TestBudget:
+    def test_budget_ok_no_raise(self):
+        step = fresh_jit("wd_budget_ok_fn")
+        with CompileWatchdog(match="wd_budget_ok_fn", max_compiles=1):
+            for _ in range(3):
+                step(jnp.ones((4,)))
+
+    def test_recompile_trips_budget(self):
+        step = fresh_jit("wd_budget_trip_fn")
+        with pytest.raises(RecompileError, match="wd_budget_trip_fn x2"):
+            with CompileWatchdog(match="wd_budget_trip_fn",
+                                 max_compiles=1):
+                step(jnp.ones((2,)))
+                step(jnp.ones((3,)))
+
+    def test_primary_exception_not_masked(self):
+        step = fresh_jit("wd_mask_fn")
+        with pytest.raises(ValueError, match="primary"):
+            with CompileWatchdog(match="wd_mask_fn", max_compiles=0):
+                step(jnp.ones((2,)))  # would trip the budget ...
+                raise ValueError("primary")  # ... but this wins
+
+
+class TestHygiene:
+    def test_handler_removed_and_propagation_restored(self):
+        import logging
+        jax_logger = logging.getLogger("jax")
+        before_handlers = list(jax_logger.handlers)
+        before_prop = jax_logger.propagate
+        with CompileWatchdog():
+            pass
+        assert jax_logger.handlers == before_handlers
+        assert jax_logger.propagate == before_prop
+
+    def test_no_compile_log_spam_on_stderr(self, capfd):
+        step = fresh_jit("wd_quiet_fn")
+        with CompileWatchdog(match="wd_quiet_fn"):
+            step(jnp.ones((4,)))
+        err = capfd.readouterr().err
+        assert "Compiling wd_quiet_fn" not in err
+
+    def test_nested_fresh_counts(self):
+        step = fresh_jit("wd_nested_fn")
+        with CompileWatchdog(match="wd_nested_fn") as outer:
+            step(jnp.ones((2,)))
+            with CompileWatchdog(match="wd_nested_fn") as inner:
+                step(jnp.ones((3,)))
+        assert outer.counts["wd_nested_fn"] == 2
+        assert inner.counts["wd_nested_fn"] == 1
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

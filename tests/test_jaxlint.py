@@ -1,0 +1,670 @@
+"""jaxlint: every rule with a firing AND a non-firing fixture, the
+suppression grammar (on-line, file-level, unknown-code reporting), and the
+CLI contract.  Pure AST work — no jax import, no devices."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedpytorch_tpu.analysis import (  # noqa: E402
+    RULES,
+    lint_source,
+    main,
+)
+
+
+def lint(src, **kw):
+    return lint_source(textwrap.dedent(src), **kw)
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+class TestRegistry:
+    def test_at_least_six_rules_registered(self):
+        assert len(RULES) >= 6
+        assert all(c.startswith("JL") for c in RULES)
+
+
+class TestHostSyncJL001:
+    def test_fires_on_item_float_and_np_in_jit(self):
+        found = lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(state, batch):
+                v = float(batch)
+                a = np.asarray(batch)
+                b = batch.item()
+                c = jax.device_get(state)
+                return v, a, b, c
+        """)
+        assert codes(found).count("JL001") == 4
+
+    def test_fires_in_function_passed_to_jit_call(self):
+        found = lint("""
+            import jax
+
+            def make_step():
+                def step_fn(state, batch):
+                    return batch.item()
+                return jax.jit(step_fn)
+        """)
+        assert "JL001" in codes(found)
+
+    def test_silent_on_numpy_constant_in_jit(self):
+        # np.array over literals is a trace-time constant, not a readback
+        found = lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def normalize(x):
+                mean = np.array([0.485, 0.456, 0.406])
+                return x - mean
+        """)
+        assert "JL001" not in codes(found)
+
+    def test_silent_on_scalar_builtin_over_static_value(self):
+        # float() of a closure config value is host Python, not a sync
+        found = lint("""
+            import jax
+
+            CFG_LR = "1e-3"
+
+            @jax.jit
+            def step(batch):
+                scale = float(CFG_LR)
+                return batch * scale
+        """)
+        assert "JL001" not in codes(found)
+
+    def test_fires_on_block_until_ready_method(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def step(batch):
+                y = batch * 2
+                y.block_until_ready()
+                return y
+        """)
+        assert "JL001" in codes(found)
+
+    def test_silent_on_host_code_and_shape_math(self):
+        found = lint("""
+            import jax
+            import numpy as np
+
+            def host_loop(loader):
+                return [np.asarray(b).item() for b in loader]
+
+            @jax.jit
+            def step(batch):
+                n = float(batch.shape[0])
+                m = int(batch.ndim - 1)
+                return batch * n * m
+        """)
+        assert "JL001" not in codes(found)
+
+
+class TestTracerControlFlowJL002:
+    def test_fires_on_if_and_while_over_tracer(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                y = x * 2
+                if y > 0:
+                    y = y + 1
+                while x.sum() > 0:
+                    x = x - 1
+                return x, y
+        """)
+        assert codes(found).count("JL002") == 2
+
+    def test_silent_on_static_branches(self):
+        found = lint("""
+            import jax
+
+            def make(flag, aug=None):
+                @jax.jit
+                def step(x, w=None):
+                    if flag:                      # closure config
+                        x = x * 2
+                    if w is None:                 # pytree structure
+                        x = x + 1
+                    if aug is not None:
+                        x = aug(x)
+                    if x.ndim == 3:               # static metadata
+                        x = x[None]
+                    if isinstance(x, dict) and "k" in x:  # structure
+                        x = x["k"]
+                    for i in range(x.shape[0]):   # static trip count
+                        x = x + i
+                    return x
+                return step
+        """)
+        assert "JL002" not in codes(found)
+
+
+class TestPrngJL003:
+    def test_fires_on_key_reuse(self):
+        found = lint("""
+            import jax
+
+            def sample(rng):
+                k1, k2 = jax.random.split(rng)
+                a = jax.random.normal(k1, (2,))
+                b = jax.random.uniform(k1, (2,))
+                return a, b, k2
+        """)
+        assert "JL003" in codes(found)
+
+    def test_fires_on_named_key_param_double_draw(self):
+        found = lint("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.normal(key, (2,))
+                return a, b
+        """)
+        assert "JL003" in codes(found)
+
+    def test_fires_on_prngkey_constant_in_loop(self):
+        found = lint("""
+            import jax
+
+            def stream(n):
+                out = []
+                for i in range(n):
+                    out.append(jax.random.normal(
+                        jax.random.PRNGKey(0), (2,)))
+                return out
+        """)
+        assert "JL003" in codes(found)
+
+    def test_fires_under_random_module_alias(self):
+        # `import jax.random as jr` must not blind the reuse analysis
+        found = lint("""
+            import jax.random as jr
+
+            def sample(key, shape):
+                a = jr.uniform(key, shape)
+                b = jr.bernoulli(key, 0.5, shape)
+                return a, b
+        """)
+        assert "JL003" in codes(found)
+
+    def test_prngkey_in_nested_loops_reported_once(self):
+        found = lint("""
+            import jax
+
+            def worst():
+                for i in range(3):
+                    for j in range(3):
+                        k = jax.random.PRNGKey(0)
+        """)
+        assert codes(found).count("JL003") == 1
+
+    def test_silent_on_split_discipline(self):
+        found = lint("""
+            import jax
+
+            def sample(rng):
+                rng, k1 = jax.random.split(rng)
+                a = jax.random.normal(k1, (2,))
+                rng, k2 = jax.random.split(rng)
+                b = jax.random.uniform(k2, (2,))
+                sub = jax.random.fold_in(rng, 7)
+                c = jax.random.normal(sub, (2,))
+                return a, b, c
+        """)
+        assert "JL003" not in codes(found)
+
+    def test_silent_on_early_return_branches(self):
+        found = lint("""
+            import jax
+
+            def dispatch(rng, fast):
+                k = jax.random.fold_in(rng, 0)
+                if fast:
+                    return jax.random.normal(k, (2,))
+                return jax.random.uniform(k, (2,))
+        """)
+        assert "JL003" not in codes(found)
+
+    def test_fires_on_key_consumed_every_loop_iteration(self):
+        # one draw per iteration from the SAME key correlates them all
+        found = lint("""
+            import jax
+
+            def sample(key, n):
+                out = []
+                for i in range(n):
+                    out.append(jax.random.uniform(key, (2,)))
+                return out
+        """)
+        assert codes(found).count("JL003") == 1
+
+    def test_fires_after_subscripted_split_rebind(self):
+        # `key = split(key)[0]` is a fresh key — and the two draws from
+        # it afterwards are the textbook reuse
+        found = lint("""
+            import jax
+
+            def sample(key):
+                key = jax.random.split(key)[0]
+                a = jax.random.uniform(key, (2,))
+                b = jax.random.normal(key, (2,))
+                return a, b
+        """)
+        assert codes(found).count("JL003") == 1
+
+    def test_silent_on_rebind_inside_with_block(self):
+        # a with-body is the same control-flow path: its split rebind
+        # must clear prior consumption for the continuation
+        found = lint("""
+            import jax
+
+            def sample(key, mesh):
+                a = jax.random.uniform(key, (2,))
+                with mesh:
+                    key, sub = jax.random.split(key)
+                b = jax.random.normal(key, (2,))
+                return a, b
+        """)
+        assert "JL003" not in codes(found)
+
+    def test_silent_on_exclusive_branch_draws(self):
+        # if/else draw from the same key but only one branch executes
+        found = lint("""
+            import jax
+
+            def sample(key, gaussian):
+                if gaussian:
+                    a = jax.random.normal(key, (2,))
+                else:
+                    a = jax.random.uniform(key, (2,))
+                return a
+        """)
+        assert "JL003" not in codes(found)
+
+    def test_reuse_after_early_return_branch_still_fires(self):
+        # the early-return branch is an alternate path; the fall-through
+        # path still reuses `key` and must be flagged
+        found = lint("""
+            import jax
+
+            def sample(key, flag):
+                a = jax.random.uniform(key, (2,))
+                if flag:
+                    return a
+                b = jax.random.normal(key, (2,))
+                return b
+        """)
+        assert "JL003" in codes(found)
+
+    def test_silent_on_numpy_rng_host_helpers(self):
+        # an `rng` param in a function that never touches jax.random is a
+        # numpy Generator, not a key (data/transforms.py shape)
+        found = lint("""
+            def transform(sample, rng):
+                a = stage_one(sample, rng)
+                return stage_two(a, rng)
+        """)
+        assert "JL003" not in codes(found)
+
+
+class TestDonationJL004:
+    def test_fires_on_state_updating_jit_without_donation(self):
+        found = lint("""
+            import jax
+
+            def make_step(tx):
+                def step_fn(state, batch):
+                    return state.replace(step=state.step + 1)
+                return jax.jit(step_fn)
+        """)
+        assert "JL004" in codes(found)
+
+    def test_fires_on_decorated_step_without_donation(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def step(state):
+                return state.replace(step=state.step + 1)
+        """)
+        assert "JL004" in codes(found)
+
+    def test_same_named_defs_resolve_to_their_own_scope(self):
+        # two factories each define step_fn (this repo's idiom): the
+        # train factory's jit is checked against ITS def, not the eval
+        # factory's shadowing one
+        found = lint("""
+            import jax
+
+            def make_train_step():
+                def step_fn(state, batch):
+                    return state.replace(step=state.step + 1)
+                return jax.jit(step_fn)
+
+            def make_eval_step():
+                def step_fn(state, batch):
+                    return state.params
+                return jax.jit(step_fn)
+        """)
+        assert codes(found).count("JL004") == 1
+
+    def test_fires_on_apply_updates_step_without_donation(self):
+        found = lint("""
+            import jax
+            import optax
+
+            def make_step():
+                def step_fn(params, grads):
+                    return optax.apply_updates(params, grads)
+                return jax.jit(step_fn)
+        """)
+        assert "JL004" in codes(found)
+
+    def test_silent_when_donated_or_pure(self):
+        found = lint("""
+            import functools
+            import jax
+
+            def make_step():
+                def step_fn(state, batch):
+                    return state.replace(step=state.step + 1)
+                return jax.jit(step_fn, donate_argnums=(0,))
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step2(state):
+                return state.replace(step=state.step + 1)
+
+            def make_eval():
+                def eval_fn(state, batch):
+                    return state.params, batch
+                return jax.jit(eval_fn)
+        """)
+        assert "JL004" not in codes(found)
+
+
+class TestShardingJL005:
+    def test_fires_on_unknown_axis_literal(self):
+        found = lint("""
+            from jax.sharding import PartitionSpec as P
+            spec = P("batch", None)
+        """)
+        assert "JL005" in codes(found)
+
+    def test_silent_on_canonical_axes_and_constants(self):
+        found = lint("""
+            from jax.sharding import PartitionSpec as P
+            DATA_AXIS = "data"
+            spec = P("data", "model")
+            spec2 = P(DATA_AXIS, None)
+            spec3 = P()
+        """)
+        assert "JL005" not in codes(found)
+
+    def test_file_local_axis_constant_extends_whitelist(self):
+        found = lint("""
+            from jax.sharding import PartitionSpec as P
+            RING_AXIS = "ring"
+            spec = P("ring", None)
+        """)
+        assert "JL005" not in codes(found)
+
+    def test_explicit_allowed_axes_param(self):
+        src = """
+            from jax.sharding import PartitionSpec as P
+            spec = P("stage")
+        """
+        assert "JL005" in codes(lint(src))
+        assert "JL005" not in codes(lint(src, allowed_axes={"stage"}))
+
+
+class TestFloat64JL006:
+    def test_fires_on_jnp_float64_and_x64_flag(self):
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            jax.config.update("jax_enable_x64", True)
+            ACC = jnp.float64
+        """)
+        assert codes(found).count("JL006") == 2
+
+    def test_fires_on_np_float64_inside_jit(self):
+        found = lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                return x.astype(np.float64)
+        """)
+        assert "JL006" in codes(found)
+
+    def test_silent_on_host_side_float64(self):
+        # host-side coordinate math in f64 is deliberate (predict.py,
+        # data/guidance.py); only device code is the hazard
+        found = lint("""
+            import numpy as np
+
+            def bbox_math(points):
+                return np.asarray(points, np.float64).sum()
+        """)
+        assert "JL006" not in codes(found)
+
+
+class TestDebugJL007:
+    def test_fires_on_jax_debug_and_print_in_jit(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                print("tracing", x)
+                jax.debug.print("x={}", x)
+                return x
+        """)
+        assert codes(found).count("JL007") == 2
+
+    def test_fires_on_breakpoint_anywhere(self):
+        found = lint("""
+            def host():
+                breakpoint()
+        """)
+        assert "JL007" in codes(found)
+
+    def test_silent_on_host_print(self):
+        found = lint("""
+            def report(loss):
+                print(f"loss={loss}", flush=True)
+        """)
+        assert "JL007" not in codes(found)
+
+
+class TestSuppressions:
+    def test_online_disable_suppresses_that_line_only(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def step(batch):
+                a = batch.item()  # jaxlint: disable=JL001
+                b = batch.item()
+                return a, b
+        """)
+        assert codes(found) == ["JL001"]
+
+    def test_file_level_disable_suppresses_everywhere(self):
+        found = lint("""
+            # jaxlint: disable-file=JL001
+            import jax
+
+            @jax.jit
+            def step(batch):
+                return batch.item(), batch.item()
+        """)
+        assert "JL001" not in codes(found)
+
+    def test_multiple_codes_in_one_comment(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def step(batch):
+                return float(batch.item())  # jaxlint: disable=JL001,JL002
+        """)
+        assert found == []
+
+    def test_trailing_rationale_after_code_still_suppresses(self):
+        # the code list ends at the first non-comma-joined word — a prose
+        # rationale must neither break the waiver nor read as a code
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def step(batch):
+                a = batch.item()  # jaxlint: disable=JL001 host readback intended
+                return a
+        """)
+        assert found == []
+
+    def test_unknown_code_is_itself_reported(self):
+        found = lint("""
+            x = 1  # jaxlint: disable=JL999
+        """)
+        assert codes(found) == ["JL000"]
+        assert "JL999" in found[0].message
+
+    def test_prose_mentioning_jaxlint_and_disable_is_not_flagged(self):
+        found = lint("""
+            # jaxlint findings here must not be disabled lightly
+            x = 1
+        """)
+        assert found == []
+
+    def test_unparseable_jaxlint_comment_reported(self):
+        found = lint("""
+            x = 1  # jaxlint: disable JL001
+        """)
+        assert codes(found) == ["JL000"]
+
+    def test_disable_does_not_leak_to_other_codes(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def step(batch):
+                return batch.item()  # jaxlint: disable=JL007
+        """)
+        assert codes(found) == ["JL001"]
+
+
+class TestSyntaxError:
+    def test_reported_as_meta_finding_not_crash(self):
+        found = lint("def broken(:\n")
+        assert codes(found) == ["JL000"]
+        assert "syntax error" in found[0].message
+
+
+class TestCli:
+    def _write(self, tmp_path, name, src):
+        p = tmp_path / name
+        p.write_text(textwrap.dedent(src))
+        return str(p)
+
+    def test_dirty_file_exits_1_with_findings(self, tmp_path, capsys):
+        path = self._write(tmp_path, "dirty.py", """
+            import jax
+
+            @jax.jit
+            def step(batch):
+                return batch.item()
+        """)
+        rc = main([path])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "JL001" in out and "dirty.py" in out
+
+    def test_clean_file_exits_0(self, tmp_path, capsys):
+        path = self._write(tmp_path, "clean.py", """
+            import jax
+
+            @jax.jit
+            def step(batch):
+                return batch * 2
+        """)
+        assert main([path]) == 0
+        assert capsys.readouterr().out == ""
+
+    def test_select_and_ignore(self, tmp_path):
+        path = self._write(tmp_path, "dirty.py", """
+            import jax
+
+            @jax.jit
+            def step(batch):
+                print("dbg")
+                return batch.item()
+        """)
+        assert main([path, "--select", "JL007"]) == 1
+        assert main([path, "--ignore", "JL001,JL007"]) == 0
+
+    def test_meta_code_obeys_select_and_ignore(self, tmp_path):
+        path = self._write(tmp_path, "typo.py", """
+            x = 1  # jaxlint: disable=JL999
+        """)
+        assert main([path]) == 1                       # JL000 by default
+        assert main([path, "--ignore", "JL000"]) == 0  # waivable
+        assert main([path, "--select", "JL001"]) == 0  # not selected
+        assert main([path, "--select", "JL000"]) == 1  # selectable alone
+
+    def test_unknown_select_exits_2(self, tmp_path):
+        path = self._write(tmp_path, "clean.py", "x = 1\n")
+        assert main([path, "--select", "JL999"]) == 2
+
+    def test_missing_path_exits_2(self):
+        assert main(["/nonexistent/nowhere.py"]) == 2
+
+    def test_list_rules(self, capsys):
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for code in ("JL001", "JL005", "JL007"):
+            assert code in out
+
+    def test_directory_walk_collects_cross_file_axes(self, tmp_path):
+        # RING_AXIS defined in one file whitelists P("ring") in another —
+        # the parallel/mesh.py -> consumers relationship
+        self._write(tmp_path, "axes.py", 'RING_AXIS = "ring"\n')
+        self._write(tmp_path, "use.py", """
+            from jax.sharding import PartitionSpec as P
+            spec = P("ring")
+        """)
+        assert main([str(tmp_path)]) == 0
+
+
+class TestFindingFormat:
+    def test_path_line_col_code_message(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def step(batch):
+                return batch.item()
+        """, path="pkg/mod.py")
+        line = found[0].format()
+        assert line.startswith("pkg/mod.py:")
+        assert ": JL001 " in line

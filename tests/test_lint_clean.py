@@ -1,0 +1,64 @@
+"""Tier-1 gate: the package must lint clean under its own jaxlint.
+
+This is the self-application half of the analysis subsystem: every TPU
+hazard rule runs over ``distributedpytorch_tpu/`` itself, so a regression
+that reintroduces a host sync in a jit body, a PRNG reuse, or a typo'd
+sharding axis fails CI before any chip time is spent.  Suppressions
+(`# jaxlint: disable=...`) are part of the contract — a waiver documents
+the false positive in place and this test keeps everything else clean.
+"""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import distributedpytorch_tpu  # noqa: E402
+from distributedpytorch_tpu.analysis import lint_paths  # noqa: E402
+
+PKG_DIR = os.path.dirname(os.path.abspath(distributedpytorch_tpu.__file__))
+REPO_DIR = os.path.dirname(PKG_DIR)
+
+
+def test_package_lints_clean():
+    findings = lint_paths([PKG_DIR])
+    assert not findings, "jaxlint findings in the package:\n" + "\n".join(
+        f.format() for f in findings)
+
+
+def test_bench_lints_clean():
+    # the official bench record is device code too
+    findings = lint_paths([os.path.join(REPO_DIR, "bench.py")])
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_module_cli_exits_zero_on_package():
+    # the exact acceptance command:
+    #   python -m distributedpytorch_tpu.analysis distributedpytorch_tpu/
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedpytorch_tpu.analysis", PKG_DIR],
+        capture_output=True, text=True, cwd=REPO_DIR,
+        env=dict(os.environ, PYTHONPATH=REPO_DIR), timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_no_unsuppressed_debug_prints_in_hot_paths():
+    # grep-level confirmation (independent of the AST scoping): no
+    # jax.debug.print / breakpoint survives anywhere in the package
+    hits = []
+    for dirpath, dirnames, files in os.walk(PKG_DIR):
+        # the linter's own rule table names the hazard strings it hunts
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "analysis")]
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            with open(path, encoding="utf-8") as fh:
+                for i, line in enumerate(fh, 1):
+                    if "jax.debug.print" in line or "pdb.set_trace" in line:
+                        if "jaxlint: disable" not in line \
+                                and not line.lstrip().startswith("#"):
+                            hits.append(f"{path}:{i}: {line.strip()}")
+    assert not hits, "\n".join(hits)
