@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -54,6 +55,11 @@ _parser.add_argument(
     "--wait-for-backend", type=float, default=None, metavar="SECONDS",
     help="poll a wedged accelerator backend for up to SECONDS (with "
          "exponential backoff) before falling back to CPU; default 1500")
+_parser.add_argument(
+    "--serve", action="store_true",
+    help="bench the serve/ inference service instead of the train step: "
+         "synthetic client load against the micro-batcher, reporting "
+         "requests/sec + p50/p99 latency in the standard record schema")
 # this module is also imported (by tests and capture replay): only read
 # argv when bench.py IS the program, so a host process keeps its own
 # -h/--help and flags
@@ -269,7 +275,113 @@ def try_replay_tpu_capture() -> dict | None:
     return rec
 
 
+#: --serve load shape: enough concurrent closed-loop clients to keep the
+#: top bucket fillable, enough requests for a stable p99
+SERVE_CLIENTS = 8
+SERVE_REQUESTS = 128 if ON_TPU else 64
+SERVE_MAX_BATCH = 8
+
+
+def serve_bench() -> None:
+    """Synthetic client load against serve.InferenceService.
+
+    Fresh-init weights (throughput does not depend on the checkpoint),
+    the same model/resolution ladder as the train bench, every bucket
+    warmed before the clock starts (compiles are a cold-start cost the
+    steady-state number must not include).  SERVE_CLIENTS threads each
+    submit their share of SERVE_REQUESTS as a burst and wait — the
+    64-request acceptance scenario, measured.
+    """
+    import threading
+
+    from distributedpytorch_tpu.models import build_model
+    from distributedpytorch_tpu.parallel import create_train_state
+    from distributedpytorch_tpu.predict import Predictor
+    from distributedpytorch_tpu.serve import InferenceService
+
+    model = build_model("danet", nclass=1, backbone=BACKBONE,
+                        output_stride=8, dtype=DTYPE)
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(1e-3), (1, SIZE, SIZE, 4))
+    predictor = Predictor(model, state.params, state.batch_stats,
+                          resolution=(SIZE, SIZE), relax=50)
+    r = np.random.RandomState(0)
+    image = r.randint(0, 256, (SIZE, SIZE, 3)).astype(np.uint8)
+    quarter, mid = SIZE // 4, SIZE // 2
+    jobs = [np.array([[quarter, mid], [SIZE - quarter, mid],
+                      [mid, quarter], [mid, SIZE - quarter]], np.float64)
+            + float(i % 16) for i in range(SERVE_REQUESTS)]
+
+    svc = InferenceService(predictor, max_batch=SERVE_MAX_BATCH,
+                           queue_depth=2 * SERVE_REQUESTS,
+                           max_wait_s=0.002)
+    svc.warmup()   # compiles excluded from the clock, tripwire stays exact
+    with svc:
+        errors: list[Exception] = []
+
+        def client(chunk) -> None:
+            # submit failures (shed, unhealthy trip) must land in
+            # `errors` too — an escaping exception would kill the thread
+            # and leave its chunk uncounted but reported as served
+            futures = []
+            for pts in chunk:
+                try:
+                    futures.append(svc.submit(image, pts))
+                except Exception as e:  # noqa: BLE001 — recorded, reported
+                    errors.append(e)
+            for f in futures:
+                try:
+                    f.result(timeout=600)
+                except Exception as e:  # noqa: BLE001 — recorded, reported
+                    errors.append(e)
+
+        threads = [
+            threading.Thread(target=client,
+                             args=(jobs[k::SERVE_CLIENTS],))
+            for k in range(SERVE_CLIENTS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        stats = svc.metrics.snapshot()
+
+    completed = SERVE_REQUESTS - len(errors)
+    record = {
+        "metric": (f"danet_{BACKBONE}_{SIZE}px_serve_b{SERVE_MAX_BATCH}"
+                   "_throughput"),
+        # successes only: an errored request is not served throughput
+        "value": round(completed / dt, 3),
+        "unit": "requests/sec",
+        # no published serving baseline exists; neutral ratio, same rule
+        # as the train bench's unknown-hardware branch
+        "vs_baseline": 1.0,
+        "platform": jax.devices()[0].platform,
+        "requests": SERVE_REQUESTS,
+        "clients": SERVE_CLIENTS,
+        "errors": len(errors),
+        "batches": stats["batches"],
+        "batch_buckets": stats["batch_buckets"],
+        "shed_queue_full": stats["shed_queue_full"],
+        "shed_deadline": stats["shed_deadline"],
+        "retrace_failures": stats["retrace_failures"],
+    }
+    if "latency_ms" in stats:
+        record["p50_ms"] = stats["latency_ms"]["p50"]
+        record["p99_ms"] = stats["latency_ms"]["p99"]
+    if "pad_fraction" in stats:
+        record["pad_fraction"] = stats["pad_fraction"]
+    if not ON_TPU:
+        record["note"] = ("CPU fallback (downsized config), not a TPU "
+                          "number")
+    print(json.dumps(record))
+
+
 def main() -> None:
+    if _CLI_ARGS.serve:
+        serve_bench()
+        return
     if FELL_BACK_TO_CPU and not ON_TPU and _is_default_config():
         replay = try_replay_tpu_capture()
         if replay is not None:

@@ -23,6 +23,13 @@ from .train import Config, Trainer, apply_overrides, from_json
 
 
 def main(argv: list[str] | None = None) -> int:
+    # Serve mode delegates wholesale: the inference service has its own
+    # argument surface (serve/__main__.py), and mixing it into the training
+    # parser would tangle two unrelated CLIs.  `--serve` must lead.
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv[:1] == ["--serve"]:
+        from .serve.__main__ import main as serve_main
+        return serve_main(argv[1:])
     # An env-requested platform (JAX_PLATFORMS=cpu for smoke runs) can be
     # overridden by a site-installed accelerator plugin during interpreter
     # startup; re-pin it before any backend init, or the run hangs trying to
@@ -30,7 +37,10 @@ def main(argv: list[str] | None = None) -> int:
     pin_requested_platform()
     parser = argparse.ArgumentParser(
         prog="distributedpytorch_tpu",
-        description="TPU-native interactive-segmentation training")
+        description="TPU-native interactive-segmentation training",
+        epilog="Serving: `python -m distributedpytorch_tpu --serve ...` "
+               "(equivalently `python -m distributedpytorch_tpu.serve`) "
+               "starts the batched inference service; see its --help.")
     parser.add_argument("--config", help="JSON config file")
     parser.add_argument("--fake-data", action="store_true",
                         help="synthetic VOC fixture (smoke runs, no dataset)")

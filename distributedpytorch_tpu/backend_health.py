@@ -86,6 +86,46 @@ def tpu_reachable(timeout_s: int = 240) -> bool:
     return probe is not None and probe.returncode == 0
 
 
+def device_op_alive(timeout_s: float = 5.0) -> tuple[bool, str]:
+    """In-process liveness: one trivial device computation, hard-bounded.
+
+    The serving complement of :func:`accelerator_healthy`: that probe pays
+    a full backend init in a throwaway child (right for a cold start,
+    ~seconds), while a liveness endpoint polled every few seconds needs
+    the question "can THIS process still run device work right now"
+    answered in milliseconds.  The op runs on a daemon thread with a join
+    timeout, so a wedged runtime yields ``(False, reason)`` instead of
+    hanging the probe (the stuck daemon thread is abandoned — acceptable
+    for a process whose orchestrator is about to restart it anyway).
+
+    Returns ``(alive, reason)``; reason is empty when alive.
+    """
+    import threading
+
+    out: dict = {}
+
+    def run() -> None:
+        try:
+            import jax
+
+            # tiny but real: touches dispatch, device math, and D2H
+            out["value"] = float(jax.device_get(
+                jax.numpy.ones(()) + jax.numpy.ones(())))
+        except Exception as e:  # noqa: BLE001 — any failure means dead
+            out["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return False, f"device op exceeded {timeout_s}s"
+    if "error" in out:
+        return False, out["error"]
+    if out.get("value") != 2.0:
+        return False, f"device op returned {out.get('value')!r}, not 2.0"
+    return True, ""
+
+
 def ensure_backend_or_cpu_fallback(
         recovery_minutes: float | None = None, *,
         ignore_env: bool = False,

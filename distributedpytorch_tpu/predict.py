@@ -233,6 +233,7 @@ def _click_kwargs_from_cfg(cfg, kwargs: dict) -> dict:
     kwargs.setdefault("zero_pad", cfg.data.zero_pad)
     kwargs.setdefault("alpha", cfg.data.guidance_alpha)
     kwargs.setdefault("guidance", cfg.data.guidance)
+    kwargs.setdefault("in_channels", cfg.model.in_channels)
     return kwargs
 
 
@@ -254,9 +255,14 @@ class Predictor:
                  guidance: str = "nellipse_gaussians",
                  mean: Sequence[float] | None = None,
                  std: Sequence[float] | None = None,
-                 mesh=None):
+                 mesh=None, in_channels: int = 4):
         self.model = model
         self.resolution = tuple(resolution)
+        #: network input channel count (RGB + guidance = 4 for the
+        #: reference stem; exotic stems differ) — flax infers it lazily
+        #: from the first call, so shape-building consumers (the serve
+        #: warmup) read it here instead of guessing
+        self.in_channels = in_channels
         self.relax = relax
         self.zero_pad = zero_pad
         self.alpha = alpha
@@ -379,6 +385,54 @@ class Predictor:
         return cls(model, params, stats,
                    **_click_kwargs_from_cfg(cfg, kwargs))
 
+    def prepare(self, image: np.ndarray,
+                points: Any) -> tuple[np.ndarray, tuple[int, int, int, int]]:
+        """:func:`prepare_input` with this predictor's settings: image +
+        clicks -> (network input at ``self.resolution``, paste-back bbox).
+        Pure host-side numpy — safe to run concurrently from many client
+        threads (the serve front door does exactly that)."""
+        return prepare_input(image, points, relax=self.relax,
+                             zero_pad=self.zero_pad,
+                             resolution=self.resolution,
+                             alpha=self.alpha, guidance=self.guidance)
+
+    def forward_prepared(self, concat: np.ndarray) -> np.ndarray:
+        """(B, H, W, C) prepared crops -> (B, H, W) float32 probability
+        maps — the raw batched compiled forward.
+
+        The single code path under :meth:`predict_batch` AND the serve
+        micro-batcher (serve/service.py): one compile per distinct leading
+        batch dimension, every later call at that B is dispatch-only.  A
+        single (H, W, C) crop is accepted and treated as B=1.  Per-lane
+        results are independent of the other lanes' CONTENT (eval-mode
+        BN, per-sample attention) — at a fixed batch shape a lane is
+        bitwise reproducible whatever rides alongside it, which is what
+        lets the serve batcher pad with dead lanes at no numerical cost.
+        With a ``mesh``, the batch additionally pads/shards over the data
+        axis (the jit's in_shardings owns device placement).
+        """
+        concat = np.asarray(concat, np.float32)
+        if concat.ndim == 3:
+            concat = concat[None]
+        if self.mesh is not None:
+            # Pad to the data-axis extent only (a model axis does not shard
+            # the batch); the jit's in_shardings owns the device placement.
+            from .parallel.mesh import DATA_AXIS, pad_to_multiple
+            padded, n = pad_to_multiple({"concat": concat},
+                                        self.mesh.shape[DATA_AXIS])
+            return np.asarray(self._forward(padded["concat"]))[:n, ..., 0]
+        return np.asarray(self._forward(concat))[..., 0]
+
+    def paste_back(self, prob: np.ndarray, bbox: tuple[int, int, int, int],
+                   shape_hw: tuple[int, int]) -> np.ndarray:
+        """One crop-space probability map -> full-image coordinates with
+        the relax border shaved (the val metric's mask_relax paste-back,
+        reference train_pascal.py:290)."""
+        return np.clip(crop2fullmask(prob, bbox, shape_hw,
+                                     zero_pad=self.zero_pad,
+                                     relax=self.relax),
+                       0.0, 1.0)
+
     def predict(self, image: np.ndarray, points: Any) -> np.ndarray:
         """(H, W, 3) image + (4, 2) xy clicks -> (H, W) float32 probability
         mask in full-image coordinates (relax border shaved, as in the val
@@ -393,33 +447,18 @@ class Predictor:
         masks (same contract as :meth:`predict`).  All N crops go through
         one batched forward — the all-objects-of-an-image labeling case at
         1/N the dispatch overhead.  One compile per distinct N; reuse the
-        same N (padding with repeats if needed) to stay dispatch-only.
-        With a ``mesh``, the crop batch shards over the data axis (padded
-        to its extent) — multi-chip inference with no other changes.
+        same N (padding with repeats if needed) to stay dispatch-only, or
+        use ``serve.InferenceService`` which pads to power-of-two buckets
+        for you.  With a ``mesh``, the crop batch shards over the data
+        axis (padded to its extent) — multi-chip inference with no other
+        changes.
         """
         if len(points_list) == 0:  # not `not points_list`: ndarray-safe
             return []
-        prepared = [prepare_input(image, pts, relax=self.relax,
-                                  zero_pad=self.zero_pad,
-                                  resolution=self.resolution,
-                                  alpha=self.alpha, guidance=self.guidance)
-                    for pts in points_list]
-        concat = np.stack([c for c, _ in prepared])
-        if self.mesh is not None:
-            # Pad to the data-axis extent only (a model axis does not shard
-            # the batch); the jit's in_shardings owns the device placement.
-            from .parallel.mesh import DATA_AXIS, pad_to_multiple
-            padded, n = pad_to_multiple({"concat": concat},
-                                        self.mesh.shape[DATA_AXIS])
-            probs = np.asarray(self._forward(padded["concat"]))[:n, ..., 0]
-        else:
-            probs = np.asarray(self._forward(concat))[..., 0]
-        return [
-            np.clip(crop2fullmask(probs[i], bbox, image.shape[:2],
-                                  zero_pad=self.zero_pad, relax=self.relax),
-                    0.0, 1.0)
-            for i, (_, bbox) in enumerate(prepared)
-        ]
+        prepared = [self.prepare(image, pts) for pts in points_list]
+        probs = self.forward_prepared(np.stack([c for c, _ in prepared]))
+        return [self.paste_back(probs[i], bbox, image.shape[:2])
+                for i, (_, bbox) in enumerate(prepared)]
 
 
 class SemanticPredictor:

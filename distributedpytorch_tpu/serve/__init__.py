@@ -1,0 +1,50 @@
+"""serve: TPU-native batched inference service for click-guided segmentation.
+
+``predict.Predictor`` answers one caller; this package answers many
+concurrent ones from the same compiled forward — the ROADMAP's
+"heavy traffic" leg of the inference story.  Architecture (details in
+docs/DESIGN.md "Serving"):
+
+    clients -> bounded queue -> max-wait/max-batch drain -> power-of-two
+    bucket padding -> ONE compiled program per bucket -> unpad ->
+    per-request paste-back -> futures
+
+* :mod:`batching` — the pure bucket/pad/unpad shape math
+* :mod:`service` — :class:`InferenceService`: queue, worker, deadlines,
+  load shedding, CompileWatchdog retrace tripwire, metrics
+* :mod:`metrics` — counters + p50/p99 request latency (ops surface)
+* :mod:`client` — :class:`ServeClient` over in-process or HTTP targets
+* :mod:`__main__` — ``python -m distributedpytorch_tpu.serve`` HTTP shell
+
+>>> from distributedpytorch_tpu.serve import InferenceService
+>>> with InferenceService(predictor, max_batch=8) as svc:
+...     mask = svc.predict(image, points)       # == Predictor.predict's
+"""
+
+from .batching import bucket_for, bucket_sizes, pad_to_bucket, unpad
+from .client import HealthCache, ServeClient, decode_array, encode_array
+from .metrics import ServeMetrics
+from .service import (
+    DeadlineExceededError,
+    InferenceService,
+    QueueFullError,
+    ServiceUnhealthyError,
+    warmup_buckets,
+)
+
+__all__ = [
+    "DeadlineExceededError",
+    "HealthCache",
+    "InferenceService",
+    "QueueFullError",
+    "ServeClient",
+    "ServeMetrics",
+    "ServiceUnhealthyError",
+    "bucket_for",
+    "bucket_sizes",
+    "decode_array",
+    "encode_array",
+    "pad_to_bucket",
+    "unpad",
+    "warmup_buckets",
+]

@@ -1,0 +1,244 @@
+"""HTTP front end: ``python -m distributedpytorch_tpu.serve --run-dir RUN``.
+
+A thin, dependency-free (stdlib ``http.server``) shell around
+:class:`service.InferenceService`: each HTTP request thread submits into
+the shared bounded queue and blocks on its future, so concurrent clients
+feed the micro-batcher exactly like in-process threads do.  The endpoints:
+
+    POST /v1/predict   {"image": <wire array>, "points": [[x,y]*4],
+                        "deadline_ms": optional}
+                    -> {"mask": <wire array>, "latency_ms": ...}
+                       429 shed (queue full) | 504 deadline | 400 bad input
+    GET  /healthz   -> 200/503 liveness: service state + an in-process
+                       device-op probe (backend_health.device_op_alive,
+                       TTL-cached so probes stay cheap)
+    GET  /stats     -> metrics snapshot (counters, p50/p99, buckets)
+
+Wire arrays are ``{"shape", "dtype", "b64"}`` (client.py) — no pickle.
+Graceful stop: SIGTERM/SIGINT land the in-flight batch, fail the queued
+remainder loudly, and exit 0 (the same manners as the trainer's
+preemption path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .client import HealthCache, decode_array, encode_array
+from .service import (
+    DeadlineExceededError,
+    InferenceService,
+    QueueFullError,
+    ServiceUnhealthyError,
+    warmup_buckets,  # noqa: F401  re-export; pre-consolidation import site
+)
+
+#: back-compat alias (the cache moved to client.py so the in-process
+#: ServeClient path shares it)
+_HealthCache = HealthCache
+
+
+class _Server(ThreadingHTTPServer):
+    """ThreadingHTTPServer with NON-daemon handler threads: a graceful
+    stop must let handlers woken by ``service.stop()`` (their futures just
+    resolved to 503s) finish WRITING those replies — daemon threads would
+    be killed at interpreter exit mid-write and the queued clients would
+    see a connection reset instead of the promised loud failure.
+    ``server_close`` (ThreadingMixIn, block_on_close) joins them."""
+    daemon_threads = False
+
+
+def make_handler(service: InferenceService, health_cache: _HealthCache,
+                 request_timeout_s: float = 120.0) -> type:
+    """Build the request-handler class closed over the shared service.
+
+    ``request_timeout_s`` bounds how long a handler thread waits on its
+    future when the request carries no deadline: with a wedged backend the
+    worker never resolves anything, and an unbounded ``result()`` would
+    accumulate blocked HTTP threads forever while /healthz correctly
+    reports the backend dead."""
+
+    class Handler(BaseHTTPRequestHandler):
+        # per-request threads come from ThreadingHTTPServer
+        protocol_version = "HTTP/1.1"
+        # idle keep-alive bound: handler threads are NON-daemon (_Server),
+        # so a connection-reusing client parked between requests would
+        # otherwise block server_close()'s join forever at shutdown —
+        # the socket read times out, close_connection ends the thread
+        timeout = 10.0
+
+        def log_message(self, fmt, *args):  # quiet: metrics are the log
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if code == 429:
+                self.send_header("Retry-After", "1")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802 — http.server's contract
+            if self.path == "/healthz":
+                alive, why = health_cache.probe()
+                health = service.health()
+                health["backend_alive"] = alive
+                if not alive:
+                    health["ok"] = False
+                    health["unhealthy_reason"] = (
+                        health.get("unhealthy_reason") or why)
+                self._reply(200 if health["ok"] else 503, health)
+            elif self.path == "/stats":
+                self._reply(200, service.metrics.snapshot())
+            else:
+                self._reply(404, {"error": f"no such path {self.path!r}"})
+
+        def do_POST(self) -> None:  # noqa: N802
+            # body read isolated from the predict phase: a client stalling
+            # mid-body raises the socket timeout (builtin TimeoutError on
+            # 3.11+, where concurrent.futures.TimeoutError is the SAME
+            # class — it must not masquerade as a 503 'backend wedged'),
+            # and the desynced keep-alive stream can only be dropped
+            try:
+                raw = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+            except (TimeoutError, OSError):
+                self.close_connection = True
+                return
+            if self.path != "/v1/predict":
+                # body already drained: on a keep-alive (HTTP/1.1)
+                # connection unread bytes would be parsed as the client's
+                # NEXT request line
+                self._reply(404, {"error": f"no such path {self.path!r}"})
+                return
+            try:
+                body = json.loads(raw.decode("utf-8"))
+                image = decode_array(body["image"])
+                points = np.asarray(body["points"], np.float64)
+                deadline_ms = body.get("deadline_ms")
+                deadline_s = None if deadline_ms is None \
+                    else float(deadline_ms) / 1e3
+                t0 = time.perf_counter()
+                fut = service.submit(image, points, deadline_s=deadline_s)
+                # a request with a deadline can't legitimately outwait it
+                # (+grace for the drain-side check to answer first), and
+                # nobody outwaits the server-side cap — a huge client
+                # deadline must not park this thread on a wedged backend
+                mask = fut.result(timeout=request_timeout_s
+                                  if deadline_s is None
+                                  else min(deadline_s + 5.0,
+                                           request_timeout_s))
+                self._reply(200, {
+                    "mask": encode_array(mask),
+                    "latency_ms": round(
+                        (time.perf_counter() - t0) * 1e3, 3)})
+            except QueueFullError as e:
+                self._reply(429, {"error": str(e)})
+            except DeadlineExceededError as e:
+                self._reply(504, {"error": str(e)})
+            except FuturesTimeoutError:
+                self._reply(503, {"error": (
+                    "no result within the server-side wait bound — the "
+                    "backend may be wedged; check /healthz")})
+            except ServiceUnhealthyError as e:
+                self._reply(503, {"error": str(e)})
+            except (KeyError, TypeError, ValueError) as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+
+    return Handler
+
+
+def build_predictor(args):
+    """Predictor from a run dir or a torch checkpoint — the same two
+    sources the --predict CLI serves, minus the per-call restore cost."""
+    from ..predict import Predictor
+
+    if args.run_dir:
+        return Predictor.from_run(args.run_dir)
+    return Predictor.from_torch(args.torch)
+
+
+def main(argv: list[str] | None = None) -> int:
+    from ..backend_health import pin_requested_platform
+
+    pin_requested_platform()
+    parser = argparse.ArgumentParser(
+        prog="distributedpytorch_tpu.serve",
+        description="TPU-native batched inference service for click-guided "
+                    "segmentation")
+    src = parser.add_mutually_exclusive_group(required=True)
+    src.add_argument("--run-dir",
+                     help="training run dir (config.json + checkpoints/)")
+    src.add_argument("--torch", metavar="PTH",
+                     help="torch state_dict checkpoint (reference "
+                          "architecture) instead of a run dir")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8801)
+    parser.add_argument("--max-batch", type=int, default=8,
+                        help="top micro-batch bucket (power of two); "
+                             "buckets are 1/2/4/.../max-batch")
+    parser.add_argument("--queue-depth", type=int, default=64,
+                        help="bounded request queue; a full queue sheds "
+                             "(HTTP 429) instead of growing latency")
+    parser.add_argument("--max-wait-ms", type=float, default=5.0,
+                        help="batcher hold time waiting to fill a bucket")
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        help="default per-request deadline (none = wait)")
+    parser.add_argument("--warmup", action="store_true",
+                        help="compile every bucket before accepting "
+                             "traffic (first clicks pay no compile)")
+    args = parser.parse_args(argv)
+
+    predictor = build_predictor(args)
+    service = InferenceService(
+        predictor, max_batch=args.max_batch, queue_depth=args.queue_depth,
+        max_wait_s=args.max_wait_ms / 1e3,
+        default_deadline_s=None if args.deadline_ms is None
+        else args.deadline_ms / 1e3)
+    if args.warmup:
+        # service.warmup (not bare warmup_buckets): it also registers the
+        # warmed shapes with the retrace tripwire, keeping its budget exact
+        service.warmup()
+    service.start()
+    httpd = _Server((args.host, args.port),
+                    make_handler(service, _HealthCache()))
+
+    def on_signal(signum, frame):
+        # shutdown() must come from another thread than serve_forever's
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    print(json.dumps({"serving": f"http://{args.host}:{args.port}",
+                      "buckets": list(service.buckets),
+                      "queue_depth": args.queue_depth,
+                      "resolution": list(predictor.resolution)}),
+          flush=True)
+    try:
+        httpd.serve_forever()
+    finally:
+        # ORDER MATTERS: stopping the service resolves every in-flight and
+        # queued future (503s for the queued remainder), which is what the
+        # blocked handler threads are waiting on; only then can
+        # server_close() join them (non-daemon handlers, see _Server) so
+        # each client actually receives its reply before the process exits.
+        service.stop()
+        httpd.server_close()
+        print(json.dumps({"stopped": True,
+                          "stats": service.metrics.snapshot()}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Power-of-two micro-batch bucketing for the inference service.
+
+XLA compiles one program per input shape.  A naive batcher that forwards
+whatever N requests happen to be pending compiles a fresh program for every
+distinct N — under bursty traffic that is a compile storm at exactly the
+moment latency matters most.  Rounding every pending batch UP to a fixed
+power-of-two bucket (1/2/4/8/... lanes, fixed 512x512 spatial shape) bounds
+the program count at ``log2(max_batch) + 1`` forever: each bucket compiles
+exactly once, every later batch rides the jit cache, and the padding waste
+is < 2x in the worst case (amortized far less — a full bucket has none).
+
+The padded lanes are dead weight by construction: eval-mode BN and
+per-sample attention make each output lane a function of its own input lane
+only, so zero-filled padding cannot perturb the real lanes (pinned by
+tests/test_serve.py::test_padding_lanes_do_not_leak) and the batcher just
+slices them off.  These are pure host-side numpy functions — the service
+(service.py) owns the queueing policy, this module owns the shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def bucket_sizes(max_batch: int) -> tuple[int, ...]:
+    """The ascending power-of-two bucket ladder up to ``max_batch``.
+
+    ``max_batch`` must itself be a power of two — a ragged top bucket would
+    either waste its headroom (never filled) or round up past the declared
+    maximum (violating the operator's HBM budget).
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if max_batch & (max_batch - 1):
+        raise ValueError(
+            f"max_batch must be a power of two, got {max_batch} "
+            "(the bucket ladder doubles; a ragged top bucket would "
+            "over- or under-shoot it)")
+    sizes = []
+    b = 1
+    while b <= max_batch:
+        sizes.append(b)
+        b *= 2
+    return tuple(sizes)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket holding ``n`` requests.
+
+    ``buckets`` is the ascending ladder from :func:`bucket_sizes`; asking
+    for more than the top bucket is a caller bug (the service never drains
+    more than ``max_batch`` requests per batch).
+    """
+    if n < 1:
+        raise ValueError(f"need at least one request, got {n}")
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(
+        f"{n} requests exceed the top bucket {buckets[-1]} — the batcher "
+        "must split the drain, not grow the program")
+
+
+def pad_to_bucket(stack: np.ndarray, bucket: int) -> np.ndarray:
+    """(n, H, W, C) request stack -> (bucket, H, W, C), zero-filled lanes.
+
+    Zero lanes (not repeats of a real request) so a masking bug downstream
+    surfaces as an obviously-wrong all-background mask instead of silently
+    serving one user's result to another.
+    """
+    n = stack.shape[0]
+    if n > bucket:
+        raise ValueError(f"{n} requests do not fit bucket {bucket}")
+    if n == bucket:
+        return stack
+    padded = np.zeros((bucket, *stack.shape[1:]), stack.dtype)
+    padded[:n] = stack
+    return padded
+
+
+def unpad(results: np.ndarray, n: int) -> np.ndarray:
+    """Mask the padded lanes back out: keep only the ``n`` real results."""
+    return results[:n]

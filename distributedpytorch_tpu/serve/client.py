@@ -1,0 +1,192 @@
+"""ServeClient: one client API over both transports.
+
+The service runs in two configurations — in-process (a library embedding
+:class:`service.InferenceService` directly) and out-of-process behind the
+``python -m distributedpytorch_tpu.serve`` HTTP front end.  ServeClient
+makes the two interchangeable: pass an ``InferenceService`` or a
+``http://host:port`` URL, call :meth:`predict` either way, get the same
+(H, W) float32 mask and the same exception taxonomy (QueueFullError when
+shed, DeadlineExceededError when expired, ValueError for bad clicks).
+
+The HTTP wire is dependency-free JSON: arrays travel as
+``{"shape": [...], "dtype": "...", "b64": <base64 of raw C-order bytes>}``
+— no pickle (never unpickle network input), no image re-encode on the hot
+path, stdlib-only on both ends.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any
+
+import numpy as np
+
+from .service import (
+    DeadlineExceededError,
+    InferenceService,
+    QueueFullError,
+    ServiceUnhealthyError,
+)
+
+#: dtypes the wire accepts — closed set, so a hostile payload cannot name
+#: an object dtype and smuggle pickled code through np.frombuffer
+_WIRE_DTYPES = ("uint8", "float32", "float64", "int32", "int64", "bool")
+
+
+def encode_array(arr: np.ndarray) -> dict:
+    """numpy array -> JSON-safe {shape, dtype, b64(raw C-order bytes)}."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.name not in _WIRE_DTYPES:
+        raise ValueError(f"dtype {arr.dtype.name} not wire-encodable "
+                         f"({'|'.join(_WIRE_DTYPES)})")
+    return {"shape": list(arr.shape), "dtype": arr.dtype.name,
+            "b64": base64.b64encode(arr.tobytes()).decode("ascii")}
+
+
+def decode_array(obj: dict) -> np.ndarray:
+    """Inverse of :func:`encode_array`, validating dtype and byte count."""
+    dtype = str(obj["dtype"])
+    if dtype not in _WIRE_DTYPES:
+        raise ValueError(f"refusing wire dtype {dtype!r}")
+    shape = tuple(int(d) for d in obj["shape"])
+    raw = base64.b64decode(obj["b64"])
+    expected = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    if len(raw) != expected:
+        raise ValueError(
+            f"wire array byte count {len(raw)} != shape/dtype "
+            f"implied {expected}")
+    return np.frombuffer(raw, dtype=dtype).reshape(shape)
+
+
+class HealthCache:
+    """TTL cache around the device-op liveness probe: a probe every few
+    seconds must not queue a device op behind every batch (nor, on a
+    wedged backend, burn the probe's full timeout and leak an abandoned
+    daemon thread per poll).  Shared by the HTTP front's /healthz and the
+    in-process ServeClient.health path."""
+
+    def __init__(self, ttl_s: float = 10.0):
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._at = -float("inf")
+        self._result: tuple[bool, str] = (False, "never probed")
+
+    def probe(self) -> tuple[bool, str]:
+        from ..backend_health import device_op_alive
+
+        with self._lock:
+            if time.monotonic() - self._at >= self.ttl_s:
+                self._result = device_op_alive(timeout_s=5.0)
+                self._at = time.monotonic()
+            return self._result
+
+
+#: HTTP status -> the in-process exception it round-trips to
+_STATUS_ERRORS = {
+    429: QueueFullError,
+    504: DeadlineExceededError,
+    503: ServiceUnhealthyError,
+    400: ValueError,
+}
+
+
+class ServeClient:
+    """Uniform client over an in-process service or a remote HTTP one.
+
+    >>> client = ServeClient(service)                  # in-process
+    >>> client = ServeClient("http://127.0.0.1:8801")  # remote
+    >>> mask = client.predict(image, points)           # (H, W) float32
+    """
+
+    def __init__(self, target: InferenceService | str,
+                 timeout_s: float = 60.0):
+        if isinstance(target, str):
+            self._url = target.rstrip("/")
+            self._service = None
+        else:
+            self._url = None
+            self._service = target
+        self.timeout_s = timeout_s
+        self._health_cache = HealthCache()
+
+    def predict(self, image: np.ndarray, points: Any,
+                deadline_s: float | None = None) -> np.ndarray:
+        """Segment one object; blocks until the mask (or the shed/deadline
+        error) comes back.  ``deadline_s`` rides to the server's batcher."""
+        if self._service is not None:
+            return self._service.predict(image, points,
+                                         deadline_s=deadline_s,
+                                         timeout=self.timeout_s)
+        body: dict = {
+            "image": encode_array(np.asarray(image)),
+            "points": np.asarray(points, np.float64).tolist(),
+        }
+        if deadline_s is not None:
+            body["deadline_ms"] = deadline_s * 1e3
+        reply = self._post("/v1/predict", body)
+        return decode_array(reply["mask"])
+
+    def health(self) -> dict:
+        if self._service is not None:
+            # transport parity: the HTTP /healthz merges a (TTL-cached)
+            # device-op liveness probe into the service state — do the
+            # same here, or a wedged backend would report ok=True only
+            # on the in-process path
+            health = self._service.health()
+            alive, why = self._health_cache.probe()
+            health["backend_alive"] = alive
+            if not alive:
+                health["ok"] = False
+                health["unhealthy_reason"] = (
+                    health.get("unhealthy_reason") or why)
+            return health
+        return self._get("/healthz")
+
+    def stats(self) -> dict:
+        if self._service is not None:
+            return self._service.metrics.snapshot()
+        return self._get("/stats")
+
+    # ------------------------------------------------------------ transport
+
+    def _request(self, req: urllib.request.Request) -> dict:
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = json.loads(e.read().decode("utf-8")).get("error", "")
+            except Exception:
+                pass
+            exc = _STATUS_ERRORS.get(e.code)
+            if exc is not None:
+                raise exc(detail or f"HTTP {e.code}") from None
+            raise RuntimeError(
+                f"serve endpoint returned HTTP {e.code}: {detail}") from e
+
+    def _post(self, path: str, body: dict) -> dict:
+        data = json.dumps(body).encode("utf-8")
+        return self._request(urllib.request.Request(
+            self._url + path, data=data, method="POST",
+            headers={"Content-Type": "application/json"}))
+
+    def _get(self, path: str) -> dict:
+        # /healthz answers 503 with a JSON body when unhealthy — that body
+        # IS the answer for a probe, not an error to raise, so read it
+        # directly instead of funneling through the exception mapping
+        try:
+            with urllib.request.urlopen(self._url + path,
+                                        timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read().decode("utf-8"))
+            except Exception:
+                return {"ok": False,
+                        "unhealthy_reason": f"HTTP {e.code} with no body"}

@@ -1,0 +1,97 @@
+"""Ops surface of the inference service: counters + latency percentiles.
+
+A batcher that silently sheds or silently retraces is indistinguishable
+from a healthy one at the API — the metrics are the only place the
+difference shows.  Everything here is cheap host-side accounting sampled
+on the request path (no device work), snapshot-read by the ``/stats`` and
+``/healthz`` endpoints and by ``bench.py --serve``.
+
+Latency is end-to-end request latency (submit -> mask handed back), the
+number a client actually experiences: queue wait + batching wait + forward
++ paste-back.  Percentiles use the nearest-rank rule shared with the train
+side (:func:`utils.profiling.percentile` — StepTimer-style accounting)
+over a bounded reservoir of the most recent samples, so a long-lived
+service reports its CURRENT tail, not a mush of every request since boot.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from ..utils.profiling import percentile
+
+
+class ServeMetrics:
+    """Thread-safe counters + a bounded latency reservoir.
+
+    Counters (monotonic since service start):
+
+    * ``requests``        — accepted into the queue
+    * ``completed``       — answered with a mask
+    * ``failed``          — answered with an error (bad input, model error)
+    * ``shed_queue_full`` — rejected at the front door (bounded queue full;
+      backpressure instead of unbounded latency)
+    * ``shed_deadline``   — dropped at drain time (deadline already blown;
+      forwarding them would waste a lane on an answer nobody is waiting for)
+    * ``batches``         — compiled-forward dispatches
+    * ``retrace_failures``— steady-state recompiles the CompileWatchdog
+      caught (any non-zero value means the bucket invariant broke)
+    """
+
+    def __init__(self, reservoir: int = 2048):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.completed = 0
+        self.failed = 0
+        self.shed_queue_full = 0
+        self.shed_deadline = 0
+        self.batches = 0
+        self.retrace_failures = 0
+        #: per-bucket dispatch counts {bucket_size: batches}
+        self.batch_buckets: collections.Counter = collections.Counter()
+        #: per-bucket real-lane totals (padding waste = bucket*batches - this)
+        self.batch_lanes: collections.Counter = collections.Counter()
+        self._latencies = collections.deque(maxlen=reservoir)
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def observe_batch(self, bucket: int, lanes: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_buckets[bucket] += 1
+            self.batch_lanes[bucket] += lanes
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+
+    def snapshot(self) -> dict:
+        """One coherent dict for /stats, /healthz, and the serve bench."""
+        with self._lock:
+            lat = list(self._latencies)
+            out = {
+                "requests": self.requests,
+                "completed": self.completed,
+                "failed": self.failed,
+                "shed_queue_full": self.shed_queue_full,
+                "shed_deadline": self.shed_deadline,
+                "batches": self.batches,
+                "retrace_failures": self.retrace_failures,
+                "batch_buckets": dict(self.batch_buckets),
+                "batch_lanes": dict(self.batch_lanes),
+            }
+        if lat:
+            out["latency_ms"] = {
+                "p50": round(percentile(lat, 50.0) * 1e3, 3),
+                "p99": round(percentile(lat, 99.0) * 1e3, 3),
+                "max": round(max(lat) * 1e3, 3),
+                "samples": len(lat),
+            }
+        dispatched = sum(b * c for b, c in out["batch_buckets"].items())
+        if dispatched:
+            out["pad_fraction"] = round(
+                1.0 - sum(out["batch_lanes"].values()) / dispatched, 4)
+        return out

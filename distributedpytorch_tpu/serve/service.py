@@ -1,0 +1,402 @@
+"""The inference service: bounded queue -> micro-batcher -> bucketed forward.
+
+``predict.Predictor`` completes the click-to-mask story for ONE caller; this
+module amortizes its compiled forward over many concurrent callers — the
+keep-the-accelerator-busy principle of the data pipeline (prefetch, echo)
+applied to the inference side.  The shape:
+
+    client threads --submit()--> bounded queue --drain--> micro-batcher
+                                                              |
+         futures <--paste-back <-- unpad <-- bucketed jitted forward
+
+Design points, each load-bearing:
+
+* **Bounded queue, shed at the door.**  An unbounded queue converts
+  overload into unbounded latency for everyone; a full queue instead
+  rejects the NEW request immediately (:class:`QueueFullError`), which is
+  both honest backpressure and the cheapest possible rejection (no device
+  work spent).
+* **Max-wait/max-batch drain.**  The worker dispatches when ``max_batch``
+  requests are pending or ``max_wait_s`` has elapsed since the first one —
+  batching gain under load, bounded added latency when idle (a lone
+  request waits at most ``max_wait_s``).
+* **Power-of-two buckets.**  Every drained batch pads up to the next
+  bucket (batching.py), so the service compiles at most one program per
+  bucket, ever.  Per-lane independence of the forward (eval-mode BN,
+  per-sample attention) makes the padded lanes inert: a request's mask is
+  bitwise identical to the same crop run through the shared forward at
+  that bucket by hand, and to single-request ``Predictor.predict`` on
+  backends whose per-lane results are batch-shape-invariant (different
+  shapes compile different programs; XLA may fuse them differently at the
+  float32-ulp level — the property tests/test_serve.py pins per backend).
+* **Deadlines, checked at drain time.**  A request whose deadline passed
+  while queued is dropped (:class:`DeadlineExceededError`) instead of
+  occupying a lane to compute an answer nobody is waiting for.
+* **Retraces fail loudly.**  A :class:`utils.compile_watchdog
+  .CompileWatchdog` runs for the service's lifetime; a compile beyond
+  one-per-bucket increments ``retrace_failures``, flips the service
+  unhealthy, and (default) refuses further traffic — steady-state
+  recompiles cost seconds per occurrence and must never hide.
+
+Host-side preprocessing (clicks -> guidance -> crop) runs on the CALLING
+thread in :meth:`InferenceService.submit`, so it parallelizes across
+clients instead of serializing in the worker; the worker owns only the
+device dispatch and the paste-back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any
+
+import numpy as np
+
+from ..utils.compile_watchdog import CompileWatchdog
+from . import batching
+from .metrics import ServeMetrics
+
+
+class QueueFullError(RuntimeError):
+    """Load shed: the bounded request queue is full — retry later."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline passed before its batch was dispatched."""
+
+
+class ServiceUnhealthyError(RuntimeError):
+    """The service refused the request (stopped, or tripped unhealthy)."""
+
+
+def warmup_buckets(predictor, buckets) -> list[tuple[int, int, int, int]]:
+    """Compile every bucket's program on a bare predictor; returns the
+    input shapes it built (resolution and channel count come from the
+    predictor).  Service users should call :meth:`InferenceService.warmup`
+    instead, which also registers these shapes with the retrace tripwire.
+    """
+    h, w = predictor.resolution
+    ch = getattr(predictor, "in_channels", 4)
+    shapes = [(b, h, w, ch) for b in buckets]
+    for s in shapes:
+        predictor.forward_prepared(np.zeros(s, np.float32))
+    return shapes
+
+
+@dataclasses.dataclass
+class _Request:
+    """One queued click-segmentation request, already host-preprocessed."""
+    concat: np.ndarray                    # (H, W, C) prepared network input
+    bbox: tuple[int, int, int, int]       # paste-back crop box
+    shape_hw: tuple[int, int]             # full-image size for paste-back
+    future: Future                        # resolves to the (H, W) mask
+    submitted: float                      # perf_counter at submit
+    deadline: float | None                # absolute perf_counter, or None
+
+
+class InferenceService:
+    """Multi-client batched inference over one :class:`predict.Predictor`.
+
+    >>> with InferenceService(predictor, max_batch=8) as svc:
+    ...     fut = svc.submit(image, points)          # non-blocking
+    ...     mask = fut.result(timeout=5.0)           # (H, W) float32
+    ...     mask2 = svc.predict(image2, points2)     # blocking convenience
+
+    ``max_batch`` (power of two) tops the bucket ladder; ``queue_depth``
+    bounds admission; ``max_wait_s`` bounds how long the batcher holds a
+    lone request hoping for company; ``default_deadline_s`` applies to
+    requests submitted without an explicit deadline (None = no deadline).
+    ``strict_retrace=False`` keeps serving after a watchdog trip (counted
+    and exposed, but not fatal).
+    """
+
+    #: substring of the predictor's jitted forward in compile logs
+    _FORWARD_NAME = "forward"
+
+    def __init__(self, predictor, max_batch: int = 8,
+                 queue_depth: int = 64, max_wait_s: float = 0.005,
+                 default_deadline_s: float | None = None,
+                 strict_retrace: bool = True,
+                 metrics: ServeMetrics | None = None):
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        if max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        self.predictor = predictor
+        self.buckets = batching.bucket_sizes(max_batch)
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self.default_deadline_s = default_deadline_s
+        self.strict_retrace = strict_retrace
+        self.metrics = metrics or ServeMetrics()
+        self._queue: queue.Queue[_Request] = queue.Queue(maxsize=queue_depth)
+        # mute_jax_logs=False: this watchdog stays open for the service's
+        # LIFETIME — the default propagation pause would silence every jax
+        # warning/error process-wide for as long as we serve
+        self._watchdog = CompileWatchdog(match=self._FORWARD_NAME,
+                                         mute_jax_logs=False)
+        self._shapes_dispatched: set[tuple[int, ...]] = set()
+        self._warm_shapes: set[tuple[int, ...]] = set()
+        self._unhealthy: str | None = None
+        self._stop = threading.Event()
+        #: "new" (accepting, queued until start) -> "running" -> "stopped"
+        self._state = "new"
+        self._worker: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "InferenceService":
+        """Start the batcher worker.  Requests submitted BEFORE start sit
+        in the queue and drain as the first batch — which is also how a
+        deterministic multi-request batch is composed in tests."""
+        if self._state != "new":
+            raise RuntimeError(f"cannot start a {self._state} service")
+        self._state = "running"
+        self._worker = threading.Thread(target=self._run, name="serve-batcher",
+                                        daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the worker and fail any still-queued requests."""
+        if self._state == "stopped":
+            return
+        self._state = "stopped"
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(
+                        ServiceUnhealthyError("service stopped"))
+                    self.metrics.count("failed")
+            except RuntimeError:
+                # a racing submit() already failed its own future (the
+                # post-put guard); never let one resolved future abort
+                # the drain and strand the rest
+                pass
+
+    def __enter__(self) -> "InferenceService":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ front door
+
+    def submit(self, image: np.ndarray, points: Any,
+               deadline_s: float | None = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the mask.
+
+        Host-side preprocessing runs here, on the caller's thread.  Raises
+        :class:`QueueFullError` immediately when the bounded queue is full
+        (shed, don't wait) and :class:`ServiceUnhealthyError` when the
+        service is stopped or tripped unhealthy.  Bad inputs (malformed
+        points, clicks outside the image) raise ``ValueError`` here,
+        before anything is queued.
+        """
+        if self._state == "stopped":
+            raise ServiceUnhealthyError("service stopped")
+        if self._unhealthy and self.strict_retrace:
+            raise ServiceUnhealthyError(self._unhealthy)
+        if self._queue.full():
+            # fast-path shed BEFORE the (expensive) host preprocessing:
+            # under overload a rejection must not cost nearly as much host
+            # CPU as serving would.  Best-effort (racy by nature); the
+            # put_nowait below is the authoritative check.
+            self.metrics.count("shed_queue_full")
+            raise QueueFullError(
+                f"request queue full ({self._queue.maxsize} deep) — "
+                "overloaded; retry with backoff")
+        concat, bbox = self.predictor.prepare(image, points)
+        now = time.perf_counter()
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        req = _Request(concat=concat, bbox=bbox,
+                       shape_hw=tuple(np.asarray(image).shape[:2]),
+                       future=Future(), submitted=now,
+                       deadline=None if deadline_s is None
+                       else now + deadline_s)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self.metrics.count("shed_queue_full")
+            raise QueueFullError(
+                f"request queue full ({self._queue.maxsize} deep) — "
+                "overloaded; retry with backoff") from None
+        self.metrics.count("requests")
+        if self._state == "stopped" and not req.future.done():
+            # raced a concurrent stop() past its queue drain: fail the
+            # future now rather than strand the caller until their timeout
+            try:
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(
+                        ServiceUnhealthyError("service stopped"))
+            except RuntimeError:
+                pass  # stop()'s drain got it first — already resolved
+        return req.future
+
+    def predict(self, image: np.ndarray, points: Any,
+                deadline_s: float | None = None,
+                timeout: float | None = None) -> np.ndarray:
+        """Blocking convenience: :meth:`submit` + ``Future.result``."""
+        return self.submit(image, points, deadline_s).result(timeout)
+
+    def warmup(self) -> None:
+        """Compile every bucket's program before taking traffic: a cold
+        service otherwise charges its first unlucky clients the XLA
+        compile — exactly the latency cliff the bucket ladder prevents.
+
+        The warmed shapes are registered with the retrace tripwire: these
+        compiles happen on the CALLING thread (invisible to the worker's
+        thread-local watchdog), so without registration the budget would
+        silently allow that many real steady-state retraces before
+        tripping."""
+        for shape in warmup_buckets(self.predictor, self.buckets):
+            self._warm_shapes.add(self._compiled_shape(shape))
+
+    # ------------------------------------------------------------ ops surface
+
+    def health(self) -> dict:
+        """Liveness + the counters a probe needs to decide 'still good'."""
+        return {
+            "ok": self._state == "running" and self._unhealthy is None,
+            "running": self._state == "running",
+            "state": self._state,
+            "unhealthy_reason": self._unhealthy,
+            "queue_depth": self._queue.qsize(),
+            "queue_capacity": self._queue.maxsize,
+            "buckets": list(self.buckets),
+            "stats": self.metrics.snapshot(),
+        }
+
+    @property
+    def compile_counts(self) -> dict:
+        """Forward-compile counts seen by the lifetime watchdog."""
+        return dict(self._watchdog.counts)
+
+    @property
+    def buckets_compiled(self) -> set[int]:
+        """Bucket sizes dispatched (== compiled, absent retraces)."""
+        return {s[0] for s in self._shapes_dispatched}
+
+    # ------------------------------------------------------------ worker
+
+    def _run(self) -> None:
+        # The watchdog must live on THIS thread: jax.log_compiles() is a
+        # thread-local config context, and every forward dispatch (hence
+        # every compile) happens here.  A watchdog entered on the caller's
+        # thread would count nothing and silently disarm the retrace check.
+        with self._watchdog:
+            while not self._stop.is_set():
+                batch = self._gather()
+                if batch:
+                    self._process(batch)
+
+    def _gather(self) -> list[_Request]:
+        """Drain on the max-wait/max-batch policy: dispatch when
+        ``max_batch`` requests are pending OR ``max_wait_s`` has elapsed
+        since the first one was picked up.  The window bounds WAITING for
+        company only — requests already sitting in the queue are always
+        drained (even at ``max_wait_s=0``), or a pre-loaded backlog would
+        trickle out one lane at a time."""
+        try:
+            first = self._queue.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        wait_until = time.perf_counter() + self.max_wait_s
+        while len(batch) < self.max_batch:
+            remaining = wait_until - time.perf_counter()
+            try:
+                if remaining > 0:
+                    batch.append(self._queue.get(timeout=remaining))
+                else:
+                    batch.append(self._queue.get_nowait())
+            except queue.Empty:
+                if remaining <= 0:
+                    break
+        return batch
+
+    def _process(self, batch: list[_Request]) -> None:
+        now = time.perf_counter()
+        live: list[_Request] = []
+        for req in batch:
+            if not req.future.set_running_or_notify_cancel():
+                continue                       # client gave up; skip the lane
+            if req.deadline is not None and now > req.deadline:
+                self.metrics.count("shed_deadline")
+                req.future.set_exception(DeadlineExceededError(
+                    "deadline passed while queued — the service is "
+                    "saturated; shed instead of serving a stale answer"))
+                continue
+            live.append(req)
+        if not live:
+            return
+        try:
+            bucket = batching.bucket_for(len(live), self.buckets)
+            padded = batching.pad_to_bucket(
+                np.stack([r.concat for r in live]), bucket)
+            probs = batching.unpad(self.predictor.forward_prepared(padded),
+                                   len(live))
+            # register AFTER a successful forward: a dispatch that dies
+            # mid-compile must not leave a phantom shape that either
+            # false-trips the tripwire on retry or pads its budget
+            self._shapes_dispatched.add(self._compiled_shape(padded.shape))
+            self._check_retrace()
+            for i, req in enumerate(live):
+                req.future.set_result(self.predictor.paste_back(
+                    probs[i], req.bbox, req.shape_hw))
+            self.metrics.observe_batch(bucket, len(live))
+            self.metrics.count("completed", len(live))
+            done = time.perf_counter()
+            for req in live:
+                self.metrics.observe_latency(done - req.submitted)
+        except Exception as e:                       # fail the batch, serve on
+            failed = 0
+            for req in live:
+                if not req.future.done():            # not the already-resolved
+                    req.future.set_exception(e)
+                    failed += 1
+            self.metrics.count("failed", failed)
+
+    def _compiled_shape(self, shape: tuple[int, ...]) -> tuple[int, ...]:
+        """The shape the forward actually COMPILES for a bucket dispatch.
+
+        A mesh predictor additionally pads the batch up to the data-axis
+        extent inside ``forward_prepared`` (mesh.pad_to_multiple), which
+        can collapse several buckets onto one program — keying the retrace
+        check on the pre-mesh shape would over-count expected programs and
+        desensitize the tripwire by exactly that margin."""
+        mesh = getattr(self.predictor, "mesh", None)
+        if mesh is None:
+            return shape
+        from ..parallel.mesh import DATA_AXIS
+        m = mesh.shape[DATA_AXIS]
+        return (-(-shape[0] // m) * m, *shape[1:])
+
+    def _check_retrace(self) -> None:
+        """One compile per bucket, ever: more forward compiles than
+        distinct dispatched shapes means a steady-state retrace (shape
+        drift, donation mismatch, tracer-dependent Python) — the failure
+        jaxlint hunts statically, caught here at runtime.  Shapes warmed
+        via :meth:`warmup` are excluded from the budget (their compiles
+        happened off-worker, so dispatching them must cost ZERO watched
+        compiles — the tripwire fires on the very first retrace)."""
+        compiles = sum(self._watchdog.counts.values())
+        budget = len(self._shapes_dispatched - self._warm_shapes)
+        if compiles > budget:
+            self.metrics.count("retrace_failures")
+            self._unhealthy = (
+                f"steady-state retrace: {compiles} forward compiles for "
+                f"{budget} cold batch shapes "
+                f"(counts: {dict(self._watchdog.counts)}) — run jaxlint")

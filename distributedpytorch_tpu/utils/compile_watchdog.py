@@ -64,12 +64,21 @@ class CompileWatchdog:
     metrics) compiling nearby.  ``max_compiles``: per-function budget
     enforced at block exit (a primary exception propagating out of the
     block takes precedence — the watchdog never masks it).
+
+    ``mute_jax_logs=False`` keeps the ``jax`` logger propagating while the
+    watchdog is active.  The default pause is right for a short test
+    region (log_compiles' WARNING spam would flood the console), but a
+    LONG-LIVED watchdog — the serve batcher holds one open for the
+    service's lifetime — would otherwise silence every jax warning/error
+    process-wide for as long as it runs.
     """
 
     def __init__(self, match: str | None = None,
-                 max_compiles: int | None = None):
+                 max_compiles: int | None = None,
+                 mute_jax_logs: bool = True):
         self.match = match
         self.max_compiles = max_compiles
+        self.mute_jax_logs = mute_jax_logs
         self.counts: Counter[str] = Counter()
         self._handler: _CountingHandler | None = None
         self._log_ctx = None
@@ -91,7 +100,8 @@ class CompileWatchdog:
         jax_logger = logging.getLogger("jax")
         jax_logger.addHandler(self._handler)
         self._prev_propagate = jax_logger.propagate
-        jax_logger.propagate = False
+        if self.mute_jax_logs:
+            jax_logger.propagate = False
         self._log_ctx = jax.log_compiles()
         self._log_ctx.__enter__()
         return self

@@ -20,6 +20,7 @@ train_pascal.py:12,181,307-308) — no profiler, no NVTX, no per-step numbers
 from __future__ import annotations
 
 import contextlib
+import math
 import statistics
 import time
 
@@ -75,6 +76,25 @@ def throughput(step_fn, steps: int, warmup: int = 2,
     return res
 
 
+def percentile(values, q: float) -> float:
+    """Nearest-rank percentile of ``values`` (q in [0, 100]).
+
+    The latency-reporting convention: p99 is an actually-observed sample,
+    never an interpolation between two samples (an interpolated tail value
+    can be a latency no request ever experienced).  Shared by
+    :class:`StepTimer` and the serve metrics (serve/metrics.py).
+    """
+    if not values:
+        raise ValueError("percentile of no samples")
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"q must be in [0, 100], got {q}")
+    ordered = sorted(values)
+    if q == 0.0:
+        return ordered[0]
+    rank = math.ceil(q / 100.0 * len(ordered))
+    return ordered[min(len(ordered), rank) - 1]
+
+
 class StepTimer:
     """Accumulates per-step wall times, async-dispatch-aware.
 
@@ -112,6 +132,7 @@ class StepTimer:
             "steps": len(self.times),
             "mean_s": statistics.fmean(self.times),
             "p50_s": statistics.median(self.times),
+            "p99_s": percentile(self.times, 99.0),
             "min_s": min(self.times),
             "max_s": max(self.times),
         }

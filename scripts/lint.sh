@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# jaxlint over everything device-adjacent: the package (serve/ included —
+# the batcher feeds a jitted forward and is exactly the code whose silent
+# retraces the rules exist to catch) plus bench.py, the official record.
+# Mirror of the tier-1 gate (tests/test_lint_clean.py); run it before
+# pushing anything that touches device code:
+#
+#     scripts/lint.sh                # whole surface
+#     scripts/lint.sh --select JL002 # one rule
+#
+# Extra args pass through to the linter CLI (--select/--ignore/paths).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m distributedpytorch_tpu.analysis \
+    distributedpytorch_tpu bench.py "$@"

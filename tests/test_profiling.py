@@ -29,6 +29,35 @@ class TestStepTimer:
     def test_empty_summary(self):
         assert StepTimer().summary() == {"steps": 0}
 
+    def test_summary_percentiles(self):
+        t = StepTimer(warmup=0)
+        for _ in range(6):
+            t.tick()
+        s = t.summary()
+        assert s["p50_s"] <= s["p99_s"] <= s["max_s"]
+
+
+class TestPercentile:
+    """Nearest-rank percentile — shared by StepTimer and serve/metrics."""
+
+    def test_nearest_rank_is_an_observed_sample(self):
+        from distributedpytorch_tpu.utils.profiling import percentile
+        values = [5.0, 1.0, 3.0, 2.0, 4.0]
+        assert percentile(values, 0.0) == 1.0
+        assert percentile(values, 50.0) == 3.0
+        assert percentile(values, 99.0) == 5.0
+        assert percentile(values, 100.0) == 5.0
+        # every answer is a member, never an interpolation
+        for q in (0.0, 10.0, 37.5, 50.0, 90.0, 99.0, 100.0):
+            assert percentile(values, q) in values
+
+    def test_errors(self):
+        from distributedpytorch_tpu.utils.profiling import percentile
+        with pytest.raises(ValueError, match="no samples"):
+            percentile([], 50.0)
+        with pytest.raises(ValueError, match="0, 100"):
+            percentile([1.0], 101.0)
+
 
 class TestTrace:
     def test_annotate_context(self):

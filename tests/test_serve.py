@@ -1,0 +1,521 @@
+"""serve/: bounded queue -> micro-batcher -> bucketed forward -> futures.
+
+The acceptance surface of the serving subsystem: bucket rounding, padding
+correctness (padded lanes masked out AND inert), deadline shedding,
+queue-full load shedding, one-compile-per-bucket (CompileWatchdog-
+verified), and the headline parity property — a concurrent burst answered
+with masks bitwise identical to single-request ``Predictor.predict``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.serve import (
+    DeadlineExceededError,
+    InferenceService,
+    QueueFullError,
+    ServeClient,
+    ServiceUnhealthyError,
+    bucket_for,
+    bucket_sizes,
+    decode_array,
+    encode_array,
+    pad_to_bucket,
+    unpad,
+)
+from distributedpytorch_tpu.utils.compile_watchdog import CompileWatchdog
+
+
+def _image(h=90, w=120, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (h, w, 3)).astype(np.uint8)
+
+
+def _points(dx=0.0, dy=0.0):
+    return np.array([[30.0, 45.0], [95.0, 40.0],
+                     [60.0, 20.0], [55.0, 75.0]]) + np.array([dx, dy])
+
+
+def _make_predictor(res=64):
+    import jax
+    import optax
+
+    from distributedpytorch_tpu.models import build_model
+    from distributedpytorch_tpu.parallel import create_train_state
+    from distributedpytorch_tpu.predict import Predictor
+
+    model = build_model("danet", nclass=1, backbone="resnet18",
+                        output_stride=8)
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(1e-3), (1, res, res, 4))
+    return Predictor(model, state.params, state.batch_stats,
+                     resolution=(res, res), relax=10)
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    return _make_predictor()
+
+
+class TestBuckets:
+    def test_ladder(self):
+        assert bucket_sizes(8) == (1, 2, 4, 8)
+        assert bucket_sizes(1) == (1,)
+
+    def test_rejects_non_power_of_two(self):
+        for bad in (0, -1, 3, 6, 12):
+            with pytest.raises(ValueError):
+                bucket_sizes(bad)
+
+    def test_rounding(self):
+        buckets = bucket_sizes(8)
+        assert [bucket_for(n, buckets) for n in (1, 2, 3, 4, 5, 8)] \
+            == [1, 2, 4, 4, 8, 8]
+
+    def test_rounding_errors(self):
+        with pytest.raises(ValueError, match="at least one"):
+            bucket_for(0, bucket_sizes(8))
+        with pytest.raises(ValueError, match="top bucket"):
+            bucket_for(9, bucket_sizes(8))
+
+
+class TestPadding:
+    def test_pads_with_zero_lanes(self):
+        stack = np.ones((3, 4, 4, 2), np.float32)
+        padded = pad_to_bucket(stack, 4)
+        assert padded.shape == (4, 4, 4, 2)
+        np.testing.assert_array_equal(padded[:3], stack)
+        assert (padded[3] == 0).all()
+
+    def test_exact_fit_is_identity(self):
+        stack = np.ones((4, 2, 2, 1), np.float32)
+        assert pad_to_bucket(stack, 4) is stack
+
+    def test_overfull_raises(self):
+        with pytest.raises(ValueError, match="do not fit"):
+            pad_to_bucket(np.ones((5, 2, 2, 1), np.float32), 4)
+
+    def test_unpad_masks_padded_lanes_out(self):
+        results = np.arange(4, dtype=np.float32)[:, None]
+        np.testing.assert_array_equal(unpad(results, 2),
+                                      results[:2])
+
+    def test_padding_lanes_do_not_leak(self, predictor):
+        """The per-lane-independence property the whole batcher rests on:
+        at a FIXED batch shape, a lane's forward output is bitwise
+        identical whether its neighbors are padding zeros or other
+        requests (eval-mode BN, per-sample attention: no cross-lane math).
+        Across DIFFERENT batch shapes XLA may fuse/partition differently
+        (ulp-level float32 drift, backend-dependent), so that comparison
+        is tolerance-based — same as test_predict's batch-vs-single pin."""
+        concat, _ = predictor.prepare(_image(), _points())
+        padded = predictor.forward_prepared(pad_to_bucket(concat[None], 4))
+        crowd = np.stack([concat, concat * 0.5, concat * 0.25, concat])
+        np.testing.assert_array_equal(unpad(padded, 1)[0],
+                                      predictor.forward_prepared(crowd)[0])
+        alone = predictor.forward_prepared(concat[None])
+        np.testing.assert_allclose(alone[0], unpad(padded, 1)[0], atol=1e-5)
+
+
+class TestServiceLifecycle:
+    def test_start_stop_and_health(self, predictor):
+        svc = InferenceService(predictor, max_batch=2)
+        with svc:
+            h = svc.health()
+            assert h["ok"] and h["running"]
+            assert h["buckets"] == [1, 2]
+        assert not svc.health()["ok"]
+        with pytest.raises(ServiceUnhealthyError):
+            svc.submit(_image(), _points())
+        with pytest.raises(RuntimeError, match="stopped"):
+            svc.start()
+
+    def test_submit_before_start_drains_as_first_batch(self, predictor):
+        svc = InferenceService(predictor, max_batch=4, max_wait_s=0.0)
+        futs = [svc.submit(_image(), _points(dx=i)) for i in range(3)]
+        with svc:
+            masks = [f.result(timeout=60) for f in futs]
+        assert len(masks) == 3
+        # 3 queued requests drained as one bucket-4 batch
+        assert svc.metrics.batch_buckets == {4: 1}
+        assert svc.metrics.batch_lanes == {4: 3}
+
+    def test_stop_fails_queued_requests_loudly(self, predictor):
+        svc = InferenceService(predictor, max_batch=2)
+        fut = svc.submit(_image(), _points())   # queued, never started
+        svc.stop()
+        with pytest.raises(ServiceUnhealthyError, match="stopped"):
+            fut.result(timeout=5)
+
+    def test_bad_input_raises_at_submit(self, predictor):
+        with InferenceService(predictor, max_batch=2) as svc:
+            with pytest.raises(ValueError, match="outside"):
+                svc.submit(_image(), np.array([[0, 0], [1, 1], [2, 2],
+                                               [500, 500]], np.float64))
+
+
+class TestParity:
+    def test_single_request_matches_predictor_bitwise(self, predictor):
+        """A lone request drains into bucket 1 — the very same compiled
+        program single-request ``Predictor.predict`` uses — so the serve
+        answer is bitwise identical on every backend."""
+        with InferenceService(predictor, max_batch=4) as svc:
+            got = svc.predict(_image(), _points(), timeout=60)
+        np.testing.assert_array_equal(got,
+                                      predictor.predict(_image(), _points()))
+
+    def test_fixed_composition_bitwise_vs_shared_path(self, predictor):
+        """The service machinery (queue, pad, unpad, paste-back) adds ZERO
+        numerical perturbation: a deterministic 3-request batch (queued
+        before start, drained as one bucket-4 dispatch) is bitwise
+        identical to running the same three prepared crops through the
+        shared forward at the same bucket by hand."""
+        img = _image()
+        pts = [_points(dx=i) for i in range(3)]
+        svc = InferenceService(predictor, max_batch=4, max_wait_s=0.0)
+        futs = [svc.submit(img, p) for p in pts]
+        with svc:
+            got = [f.result(timeout=120) for f in futs]
+        assert svc.metrics.batch_buckets == {4: 1}
+        prepared = [predictor.prepare(img, p) for p in pts]
+        probs = unpad(predictor.forward_prepared(
+            pad_to_bucket(np.stack([c for c, _ in prepared]), 4)), 3)
+        for i, (_, bbox) in enumerate(prepared):
+            want = predictor.paste_back(probs[i], bbox, img.shape[:2])
+            np.testing.assert_array_equal(got[i], want)
+
+    def test_burst_64_bitwise_identical_and_compile_bounded(self, predictor):
+        """THE acceptance property: a synthetic 64-request burst from 8
+        concurrent clients is answered (a) completely, (b) with masks
+        identical to single-request ``Predictor.predict`` — bitwise when
+        the backend's per-lane results are batch-shape-invariant (probed
+        below; true on single-device CPU and TPU lane semantics), float32-
+        ulp-tolerance otherwise (this suite's 8-virtual-device CPU mesh
+        partitions work per shape; same property test_predict pins for
+        predict_batch) — and (c) with at most one compile per power-of-two
+        bucket, verified by the service's lifetime CompileWatchdog (it
+        lives on the worker thread: jax.log_compiles is thread-local, so
+        only the worker's own watchdog can see the forward compiles)."""
+        img = _image()
+        # backend probe: does a lane's result survive a batch-shape change
+        # bit-for-bit?  decides how strict the parity assert below can be.
+        probe, _ = predictor.prepare(img, _points())
+        shape_invariant = np.array_equal(
+            predictor.forward_prepared(probe[None])[0],
+            unpad(predictor.forward_prepared(pad_to_bucket(probe[None], 8)),
+                  1)[0])
+        jobs = [(i, _points(dx=float(i % 7), dy=float(i % 5)))
+                for i in range(64)]
+        results: dict[int, np.ndarray] = {}
+        errors: list[Exception] = []
+        with InferenceService(predictor, max_batch=8, queue_depth=64,
+                              max_wait_s=0.002) as svc:
+
+            def client(chunk):
+                for i, pts in chunk:
+                    try:
+                        results[i] = svc.predict(img, pts, timeout=120)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(jobs[k::8],))
+                       for k in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            buckets_used = svc.buckets_compiled
+            stats = svc.metrics.snapshot()
+        assert not errors
+        assert len(results) == 64
+        # compiles bounded: at most one program per bucket of the ladder
+        # (<=, not ==: the module-scoped predictor may have pre-compiled
+        # some bucket shapes in earlier tests — cache hits here)
+        assert sum(svc.compile_counts.values()) <= len(buckets_used)
+        assert len(buckets_used) <= len(bucket_sizes(8))
+        assert stats["retrace_failures"] == 0
+        assert stats["completed"] == 64
+        assert "latency_ms" in stats and stats["latency_ms"]["p99"] > 0
+        for i, pts in jobs:
+            want = predictor.predict(img, pts)
+            if shape_invariant:
+                np.testing.assert_array_equal(results[i], want)
+            else:
+                np.testing.assert_allclose(results[i], want, atol=1e-5)
+
+
+class TestShedding:
+    def test_queue_full_sheds_instead_of_queueing(self, predictor):
+        """Backpressure: with the worker wedged mid-batch and the bounded
+        queue full, a new submit is rejected NOW (QueueFullError), not
+        parked into unbounded latency."""
+        gate = threading.Event()
+        entered = threading.Event()
+        orig = predictor.forward_prepared
+
+        def gated(x):
+            entered.set()
+            assert gate.wait(timeout=60)
+            return orig(x)
+
+        svc = InferenceService(predictor, max_batch=1, queue_depth=1,
+                               max_wait_s=0.0)
+        try:
+            predictor.forward_prepared = gated
+            svc.start()
+            img, pts = _image(), _points()
+            in_flight = svc.submit(img, pts)        # worker picks this up
+            assert entered.wait(timeout=30)
+            queued = svc.submit(img, pts)           # fills the queue
+            with pytest.raises(QueueFullError):
+                svc.submit(img, pts)                # shed at the door
+            assert svc.metrics.shed_queue_full == 1
+            gate.set()
+            want = predictor.predict(img, pts)
+            np.testing.assert_array_equal(in_flight.result(timeout=60), want)
+            np.testing.assert_array_equal(queued.result(timeout=60), want)
+        finally:
+            gate.set()
+            predictor.forward_prepared = orig
+            svc.stop()
+
+    def test_deadline_expired_while_queued_is_shed(self, predictor):
+        """A request whose deadline passes while it waits behind a slow
+        batch is dropped at drain time — no device lane is spent on an
+        answer nobody is waiting for."""
+        gate = threading.Event()
+        entered = threading.Event()
+        orig = predictor.forward_prepared
+
+        def gated(x):
+            entered.set()
+            assert gate.wait(timeout=60)
+            return orig(x)
+
+        svc = InferenceService(predictor, max_batch=1, queue_depth=4,
+                               max_wait_s=0.0)
+        try:
+            predictor.forward_prepared = gated
+            svc.start()
+            img, pts = _image(), _points()
+            first = svc.submit(img, pts)
+            assert entered.wait(timeout=30)
+            doomed = svc.submit(img, pts, deadline_s=0.01)
+            time.sleep(0.05)                        # deadline passes queued
+            gate.set()
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(timeout=60)
+            assert first.result(timeout=60).shape == img.shape[:2]
+            assert svc.metrics.shed_deadline == 1
+        finally:
+            gate.set()
+            predictor.forward_prepared = orig
+            svc.stop()
+
+    def test_no_deadline_waits_out_the_backlog(self, predictor):
+        with InferenceService(predictor, max_batch=2, queue_depth=8,
+                              max_wait_s=0.0) as svc:
+            futs = [svc.submit(_image(), _points(dx=i)) for i in range(4)]
+            for f in futs:
+                assert f.result(timeout=120).shape == (90, 120)
+        assert svc.metrics.shed_deadline == 0
+
+
+class TestWatchdogWiring:
+    def test_one_compile_per_bucket_across_multi_batch_run(self):
+        """The shared forward path compiles exactly once per bucket: two
+        full passes over the ladder, second pass all cache hits.  Fresh
+        predictor so no bucket is pre-compiled by earlier tests — the
+        count must be EXACTLY one per bucket."""
+        fresh = _make_predictor()
+        h, w = fresh.resolution
+        buckets = bucket_sizes(8)
+        r = np.random.RandomState(7)
+        with CompileWatchdog(match="forward") as wd:
+            for _ in range(2):                     # multi-batch run
+                for b in buckets:
+                    x = r.uniform(0, 255, (b, h, w, 4)).astype(np.float32)
+                    out = fresh.forward_prepared(x)
+                    assert out.shape == (b, h, w)
+        assert sum(wd.counts.values()) == len(buckets)
+
+    def test_retrace_trips_unhealthy_and_refuses_traffic(self, predictor):
+        """A steady-state retrace (simulated: a varying non-bucket shape
+        reaching the forward) must flip the service unhealthy and — in
+        strict mode — refuse further traffic loudly."""
+        svc = InferenceService(predictor, max_batch=1, queue_depth=8,
+                               max_wait_s=0.0, strict_retrace=True)
+        orig = predictor.forward_prepared
+        h, w = predictor.resolution
+        shapes = iter([(3, h, w, 4), (5, h, w, 4), (7, h, w, 4)])
+
+        def drifting(x):
+            # shape drift: every batch hits the jit cache cold
+            return orig(np.zeros(next(shapes), np.float32))[:x.shape[0]]
+
+        try:
+            predictor.forward_prepared = drifting
+            svc.start()
+            svc.predict(_image(), _points(), timeout=60)
+            svc.predict(_image(), _points(), timeout=60)
+            deadline = time.monotonic() + 30
+            while svc.health()["ok"] and time.monotonic() < deadline:
+                time.sleep(0.01)
+            health = svc.health()
+            assert not health["ok"]
+            assert "retrace" in health["unhealthy_reason"]
+            assert svc.metrics.retrace_failures >= 1
+            with pytest.raises(ServiceUnhealthyError, match="retrace"):
+                svc.submit(_image(), _points())
+        finally:
+            predictor.forward_prepared = orig
+            svc.stop()
+
+
+class TestWire:
+    def test_array_roundtrip(self):
+        for arr in (np.arange(12, dtype=np.float32).reshape(3, 4),
+                    np.random.RandomState(0).randint(
+                        0, 255, (5, 7, 3)).astype(np.uint8)):
+            got = decode_array(encode_array(arr))
+            np.testing.assert_array_equal(got, arr)
+            assert got.dtype == arr.dtype
+
+    def test_rejects_unlisted_dtype(self):
+        with pytest.raises(ValueError, match="wire"):
+            encode_array(np.array([object()]))
+        with pytest.raises(ValueError, match="refusing"):
+            decode_array({"shape": [1], "dtype": "object", "b64": ""})
+
+    def test_rejects_byte_count_mismatch(self):
+        enc = encode_array(np.zeros(4, np.float32))
+        enc["shape"] = [8]
+        with pytest.raises(ValueError, match="byte count"):
+            decode_array(enc)
+
+
+class TestHttpEndToEnd:
+    """ServeClient over a live ThreadingHTTPServer — the full wire loop."""
+
+    @pytest.fixture()
+    def server(self, predictor):
+        from http.server import ThreadingHTTPServer
+
+        from distributedpytorch_tpu.serve.__main__ import (
+            _HealthCache,
+            make_handler,
+        )
+
+        svc = InferenceService(predictor, max_batch=4, queue_depth=16,
+                               max_wait_s=0.002)
+        svc.start()
+        httpd = ThreadingHTTPServer(
+            ("127.0.0.1", 0), make_handler(svc, _HealthCache()))
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            yield svc, f"http://127.0.0.1:{httpd.server_address[1]}"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            svc.stop()
+
+    def test_predict_health_stats(self, server, predictor):
+        svc, url = server
+        client = ServeClient(url)
+        img, pts = _image(), _points()
+        mask = client.predict(img, pts)
+        np.testing.assert_array_equal(mask, predictor.predict(img, pts))
+        health = client.health()
+        assert health["ok"] and health["backend_alive"]
+        stats = client.stats()
+        assert stats["completed"] >= 1 and stats["batches"] >= 1
+
+    def test_bad_requests_are_4xx_not_5xx(self, server):
+        import json
+        import urllib.error
+        import urllib.request
+
+        _, url = server
+        for body in (b"not json",
+                     json.dumps({"points": [[1, 1]] * 4}).encode(),
+                     json.dumps({"image": encode_array(_image()),
+                                 "points": [[1, 1]]}).encode()):
+            req = urllib.request.Request(
+                url + "/v1/predict", data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=30)
+            assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url + "/nope", timeout=30)
+        assert e.value.code == 404
+
+    def test_client_maps_statuses_to_exceptions(self, server):
+        svc, url = server
+        client = ServeClient(url)
+        svc.stop()                       # -> 503 on the next predict
+        with pytest.raises(ServiceUnhealthyError):
+            client.predict(_image(), _points())
+        health = client.health()         # 503 body IS the probe answer
+        assert health["ok"] is False
+
+
+class TestInProcessClient:
+    def test_same_api_as_http(self, predictor):
+        with InferenceService(predictor, max_batch=2) as svc:
+            client = ServeClient(svc)
+            img, pts = _image(), _points()
+            np.testing.assert_array_equal(client.predict(img, pts),
+                                          predictor.predict(img, pts))
+            assert client.health()["ok"]
+            assert client.stats()["completed"] >= 1
+
+
+class TestWarmup:
+    def test_warmup_compiles_every_bucket_once(self, predictor):
+        from distributedpytorch_tpu.serve.__main__ import warmup_buckets
+
+        buckets = bucket_sizes(4)
+        with CompileWatchdog(match="forward") as wd:
+            warmup_buckets(predictor, buckets)
+        # every ladder shape compiled at most once (cache hits when an
+        # earlier test already compiled a bucket shape on this predictor)
+        assert sum(wd.counts.values()) <= len(buckets)
+        # traffic after warmup is dispatch-only: the service's own
+        # worker-thread watchdog must see ZERO fresh compiles
+        with InferenceService(predictor, max_batch=4,
+                              max_wait_s=0.0) as svc:
+            svc.predict(_image(), _points(), timeout=60)
+            assert sum(svc.compile_counts.values()) == 0
+
+    def test_service_warmup_keeps_tripwire_exact(self, predictor):
+        """service.warmup() compiles off-worker AND registers the shapes,
+        so dispatching a warmed bucket leaves the retrace budget at zero
+        (without registration, warmup would grant that many free real
+        retraces before the tripwire could fire)."""
+        svc = InferenceService(predictor, max_batch=4, max_wait_s=0.0)
+        svc.warmup()
+        assert {b for b, *_ in svc._warm_shapes} == set(svc.buckets)
+        with svc:
+            svc.predict(_image(), _points(), timeout=60)
+            assert sum(svc.compile_counts.values()) == 0
+            assert svc.health()["ok"]
+            assert svc.metrics.retrace_failures == 0
+
+    def test_cli_help_exits_zero(self):
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, "-m", "distributedpytorch_tpu.serve", "--help"],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo),
+            cwd=repo)
+        assert r.returncode == 0
+        assert "--max-batch" in r.stdout
