@@ -84,34 +84,17 @@ enable_compile_cache()
 import numpy as np  # noqa: E402
 import optax  # noqa: E402
 
-# Published per-chip peak dense-matmul throughput, bf16/f32 as used here.
-# Sources: Google Cloud TPU system-architecture tables (public).  Matched by
-# substring of jax's device_kind; None -> MFU omitted (unknown hardware).
-PEAK_FLOPS_BY_KIND = {
-    "v5 lite": 197e12,   # v5e: 197 TFLOP/s bf16
-    "v5litepod": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6 lite": 918e12,   # v6e (Trillium)
-    "v6e": 918e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 45e12,
-}
-
-# Peak HBM bandwidth per chip (B/s), same public tables, keyed identically
-# — the roofline's second axis must match the chip the FLOPs table matched.
-PEAK_HBM_BY_KIND = {
-    "v5 lite": 819e9,
-    "v5litepod": 819e9,
-    "v5e": 819e9,
-    "v5p": 2765e9,
-    "v6 lite": 1640e9,
-    "v6e": 1640e9,
-    "v4": 1228e9,
-    "v3": 900e9,
-    "v2": 700e9,
-}
+# Peak dense-matmul throughput and HBM bandwidth per chip, keyed by
+# device_kind substring.  The tables moved to telemetry/goodput.py (the
+# trainer's MFU estimator shares them); these module attributes remain the
+# bench-side names.
+from distributedpytorch_tpu.telemetry.goodput import (  # noqa: E402
+    PEAK_FLOPS_BY_KIND,
+    PEAK_HBM_BY_KIND,
+    mfu_estimate,
+    xla_step_cost,
+)
+from distributedpytorch_tpu.telemetry import get_accountant  # noqa: E402
 
 
 def _kind_lookup(table: dict) -> float | None:
@@ -133,15 +116,10 @@ def peak_hbm_bw_per_chip() -> float | None:
 def step_cost(step, state, batch) -> dict:
     """XLA's cost model for the exact compiled train step (whole global
     batch): FLOPs and HBM bytes accessed — the two roofline inputs.  One
-    lower+compile; the executable is cache-shared with the timed run."""
-    try:
-        cost = step.lower(state, batch).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-            cost = cost[0]
-        return {"flops": float(cost["flops"]),
-                "bytes": float(cost.get("bytes accessed", 0.0)) or None}
-    except Exception:
-        return {"flops": None, "bytes": None}
+    lower+compile; the executable is cache-shared with the timed run.
+    (Thin wrapper over the shared telemetry helper, kept for the
+    bench-side name.)"""
+    return xla_step_cost(step, state, batch)
 
 # Keep the benchmark finishable on CPU-only dev boxes while exercising the
 # real config on TPU.
@@ -315,7 +293,10 @@ def serve_bench() -> None:
     svc = InferenceService(predictor, max_batch=SERVE_MAX_BATCH,
                            queue_depth=2 * SERVE_REQUESTS,
                            max_wait_s=0.002)
-    svc.warmup()   # compiles excluded from the clock, tripwire stays exact
+    acct = get_accountant()
+    acct.reset()
+    with acct.account("compile"):
+        svc.warmup()   # compiles off the clock, tripwire stays exact
     with svc:
         errors: list[Exception] = []
 
@@ -340,12 +321,14 @@ def serve_bench() -> None:
                              args=(jobs[k::SERVE_CLIENTS],))
             for k in range(SERVE_CLIENTS)]
         t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        with acct.account("step"):  # the measured burst is the payload
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
         dt = time.perf_counter() - t0
         stats = svc.metrics.snapshot()
+    goodput_rep = acct.report()
 
     completed = SERVE_REQUESTS - len(errors)
     record = {
@@ -372,6 +355,17 @@ def serve_bench() -> None:
         record["p99_ms"] = stats["latency_ms"]["p99"]
     if "pad_fraction" in stats:
         record["pad_fraction"] = stats["pad_fraction"]
+    # standard telemetry fields, same schema as the train record: serving
+    # has no per-request FLOPs count, so mfu is explicitly null rather
+    # than absent (consumers can rely on the key)
+    record["goodput"] = round(goodput_rep["goodput"], 4)
+    record["goodput_breakdown"] = {
+        k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
+    record["mfu"] = None
+    from distributedpytorch_tpu.utils.profiling import device_memory_stats
+
+    record["peak_bytes_in_use"] = \
+        device_memory_stats()["peak_bytes_in_use"]
     if not ON_TPU:
         record["note"] = ("CPU fallback (downsized config), not a TPU "
                           "number")
@@ -444,11 +438,21 @@ def main() -> None:
             # (loss alone completes before the update does).
             return loss, jax.tree.leaves(state_box[0].params)[0]
 
+        # Goodput accounting over the bench itself: the first call pays
+        # trace+XLA ('compile'); the steady-state loop is 'step'.  The
+        # bench's goodput fraction answers "how much of this record's
+        # wall-clock was measurement vs compile".
+        acct = get_accountant()
+        acct.reset()
+        with acct.account("compile"):
+            jax.device_get(one_step())
         # throughput() pipelines all dispatches and materializes once at the
         # end — per-step host syncs through a tunneled device mismeasure
         # badly, and block_until_ready can be a no-op there (see profiling).
-        stats = throughput(one_step, steps=STEPS, warmup=WARMUP,
-                           items_per_step=BATCH * n_chips)
+        with acct.account("step"):
+            stats = throughput(one_step, steps=STEPS, warmup=WARMUP,
+                               items_per_step=BATCH * n_chips)
+        goodput_rep = acct.report()
 
     per_chip = stats["items_per_sec"] / n_chips
     record = {
@@ -495,6 +499,19 @@ def main() -> None:
         # no XLA cost model / unknown chip: report a neutral ratio rather
         # than an invented one
         record["vs_baseline"] = 1.0
+    # Standard telemetry fields (always present, None when unknowable):
+    # goodput = productive fraction of this record's wall-clock; mfu =
+    # model-FLOPs utilization (falls back to the conservative unknown-
+    # hardware peak, labeled); peak_bytes_in_use = HBM high-water mark.
+    record["goodput"] = round(goodput_rep["goodput"], 4)
+    record["goodput_breakdown"] = {
+        k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
+    if flops and flops > 0:  # a zero/negative cost-model sentinel: no MFU
+        est = mfu_estimate(flops / n_chips, stats["mean_s"])
+        record["mfu"] = round(est["mfu"], 4)
+        record["mfu_peak_source"] = est["peak_source"]
+    else:
+        record["mfu"] = None
     if not ON_TPU:
         # The axon tunnel wedges for hours at a time; when the round-end run
         # lands in such a window this records the downsized CPU config, not
@@ -505,6 +522,7 @@ def main() -> None:
     from distributedpytorch_tpu.utils.profiling import device_memory_stats
 
     peak = device_memory_stats()["peak_bytes_in_use"]
+    record["peak_bytes_in_use"] = peak  # 0 on backends without stats (CPU)
     if peak:
         record["peak_hbm_gb"] = round(peak / 2**30, 2)
     if ON_TPU and _is_default_config():

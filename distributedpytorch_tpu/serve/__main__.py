@@ -13,6 +13,14 @@ feed the micro-batcher exactly like in-process threads do.  The endpoints:
                        device-op probe (backend_health.device_op_alive,
                        TTL-cached so probes stay cheap)
     GET  /stats     -> metrics snapshot (counters, p50/p99, buckets)
+    GET  /metrics   -> Prometheus text exposition of the process-wide
+                       telemetry registry (serve counters, span
+                       percentiles, goodput gauges when co-hosted)
+    POST /debug/trace?steps=N
+                    -> arm a bounded on-demand jax.profiler capture of
+                       the next N batches (202 + target dir; 409 when a
+                       capture is already armed/active).  SIGUSR2 arms
+                       the same default capture.
 
 Wire arrays are ``{"shape", "dtype", "b64"}`` (client.py) — no pickle.
 Graceful stop: SIGTERM/SIGINT land the in-flight batch, fail the queued
@@ -24,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import threading
@@ -33,6 +42,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..telemetry import get_registry, prometheus
+from ..telemetry.trace import query_steps
 from .client import HealthCache, decode_array, encode_array
 from .service import (
     DeadlineExceededError,
@@ -80,9 +91,13 @@ def make_handler(service: InferenceService, health_cache: _HealthCache,
             pass
 
         def _reply(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload).encode("utf-8")
+            self._reply_text(code, json.dumps(payload), "application/json")
+
+        def _reply_text(self, code: int, text: str,
+                        content_type: str) -> None:
+            body = text.encode("utf-8")
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             if code == 429:
                 self.send_header("Retry-After", "1")
@@ -90,7 +105,12 @@ def make_handler(service: InferenceService, health_cache: _HealthCache,
             self.wfile.write(body)
 
         def do_GET(self) -> None:  # noqa: N802 — http.server's contract
-            if self.path == "/healthz":
+            if self.path == "/metrics":
+                # the one telemetry surface: serve counters AND any train
+                # goodput/span metrics living in this process's registry
+                self._reply_text(200, prometheus.render_text(get_registry()),
+                                 prometheus.CONTENT_TYPE)
+            elif self.path == "/healthz":
                 alive, why = health_cache.probe()
                 health = service.health()
                 health["backend_alive"] = alive
@@ -116,7 +136,24 @@ def make_handler(service: InferenceService, health_cache: _HealthCache,
             except (TimeoutError, OSError):
                 self.close_connection = True
                 return
-            if self.path != "/v1/predict":
+            base, _, query = self.path.partition("?")
+            if base == "/debug/trace":
+                trig = service.trace
+                if trig is None:
+                    self._reply(503, {"error": "trace capture not armed "
+                                               "for this service"})
+                    return
+                target = trig.request(query_steps(query))
+                if target is None:
+                    self._reply(409, {"error": "a trace capture is "
+                                               "already armed or active"})
+                else:
+                    self._reply(202, {"trace_dir": target,
+                                      "note": "starts at the next batch; "
+                                              "bounded by steps and a "
+                                              "wall-clock backstop"})
+                return
+            if base != "/v1/predict":
                 # body already drained: on a keep-alive (HTTP/1.1)
                 # connection unread bytes would be parsed as the client's
                 # NEXT request line
@@ -198,14 +235,23 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--warmup", action="store_true",
                         help="compile every bucket before accepting "
                              "traffic (first clicks pay no compile)")
+    parser.add_argument("--trace-dir", default=None,
+                        help="where POST /debug/trace and SIGUSR2 write "
+                             "bounded XPlane captures (default: "
+                             "<run-dir>/serve_trace, or ./serve_trace)")
     args = parser.parse_args(argv)
 
+    from ..telemetry import TraceCapture
+
     predictor = build_predictor(args)
+    trace = TraceCapture(args.trace_dir or os.path.join(
+        args.run_dir or ".", "serve_trace"))
     service = InferenceService(
         predictor, max_batch=args.max_batch, queue_depth=args.queue_depth,
         max_wait_s=args.max_wait_ms / 1e3,
         default_deadline_s=None if args.deadline_ms is None
-        else args.deadline_ms / 1e3)
+        else args.deadline_ms / 1e3,
+        trace=trace)
     if args.warmup:
         # service.warmup (not bare warmup_buckets): it also registers the
         # warmed shapes with the retrace tripwire, keeping its budget exact
@@ -220,6 +266,8 @@ def main(argv: list[str] | None = None) -> int:
 
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
+    # SIGUSR2 arms the same bounded capture POST /debug/trace does
+    uninstall_trace_signal = trace.install_signal()
     print(json.dumps({"serving": f"http://{args.host}:{args.port}",
                       "buckets": list(service.buckets),
                       "queue_depth": args.queue_depth,
@@ -235,6 +283,7 @@ def main(argv: list[str] | None = None) -> int:
         # each client actually receives its reply before the process exits.
         service.stop()
         httpd.server_close()
+        uninstall_trace_signal()
         print(json.dumps({"stopped": True,
                           "stats": service.metrics.snapshot()}), flush=True)
     return 0

@@ -55,6 +55,7 @@ from typing import Any
 
 import numpy as np
 
+from ..telemetry.trace import TraceCapture
 from ..utils.compile_watchdog import CompileWatchdog
 from . import batching
 from .metrics import ServeMetrics
@@ -120,7 +121,8 @@ class InferenceService:
                  queue_depth: int = 64, max_wait_s: float = 0.005,
                  default_deadline_s: float | None = None,
                  strict_retrace: bool = True,
-                 metrics: ServeMetrics | None = None):
+                 metrics: ServeMetrics | None = None,
+                 trace: TraceCapture | None = None):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         if max_wait_s < 0:
@@ -138,6 +140,9 @@ class InferenceService:
         # warning/error process-wide for as long as we serve
         self._watchdog = CompileWatchdog(match=self._FORWARD_NAME,
                                          mute_jax_logs=False)
+        #: on-demand bounded device-trace trigger (telemetry.trace),
+        #: armed by POST /debug/trace or SIGUSR2, driven by the worker
+        self.trace = trace
         self._shapes_dispatched: set[tuple[int, ...]] = set()
         self._warm_shapes: set[tuple[int, ...]] = set()
         self._unhealthy: str | None = None
@@ -299,8 +304,16 @@ class InferenceService:
         with self._watchdog:
             while not self._stop.is_set():
                 batch = self._gather()
+                if self.trace is not None:
+                    # drive the on-demand capture from the worker (the
+                    # only thread dispatching device work): 1 step per
+                    # batch, 0 on idle polls so the wall-clock backstop
+                    # still closes a capture when traffic stops
+                    self.trace.tick(1 if batch else 0)
                 if batch:
                     self._process(batch)
+            if self.trace is not None:
+                self.trace.close()
 
     def _gather(self) -> list[_Request]:
         """Drain on the max-wait/max-batch policy: dispatch when

@@ -1,0 +1,38 @@
+"""Unified telemetry: registry, spans, goodput/MFU, traces, Prometheus.
+
+One process-wide surface for "what is this process doing":
+
+* :mod:`registry`   — thread-safe counters/gauges/histograms
+  (:func:`get_registry` is the process singleton);
+* :mod:`spans`      — nested host spans mirrored into XPlane device
+  traces via ``jax.profiler.TraceAnnotation``;
+* :mod:`goodput`    — wall-clock attribution ({step, compile,
+  checkpoint, eval, input_wait, idle}) + MFU estimation with the
+  device-kind peak-FLOPs table;
+* :mod:`prometheus` — text exposition for ``GET /metrics``;
+* :mod:`trace`      — on-demand bounded ``jax.profiler`` capture
+  (SIGUSR2 / ``POST /debug/trace``) without restarting the process.
+
+Every future perf PR reports into this layer; the train loop, the
+checkpoint manager, the evaluator and the serve front are already wired.
+"""
+
+from . import goodput, prometheus, registry, spans, trace
+from .goodput import (
+    BUCKETS,
+    GoodputAccountant,
+    get_accountant,
+    mfu_estimate,
+    peak_flops_for,
+)
+from .prometheus import render_text
+from .registry import MetricsRegistry, get_registry, is_enabled, set_enabled
+from .spans import current_span, span
+from .trace import TraceCapture
+
+__all__ = [
+    "BUCKETS", "GoodputAccountant", "MetricsRegistry", "TraceCapture",
+    "current_span", "get_accountant", "get_registry", "goodput",
+    "is_enabled", "mfu_estimate", "peak_flops_for", "prometheus",
+    "registry", "render_text", "set_enabled", "span", "spans", "trace",
+]

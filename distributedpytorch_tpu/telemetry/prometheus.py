@@ -1,0 +1,76 @@
+"""Prometheus text-exposition rendering of a :class:`MetricsRegistry`.
+
+Text format 0.0.4 — the lingua franca every scraper parses; no client
+library dependency (the container bakes none in, and the format is three
+line shapes).  Counters and gauges render directly; histograms render as
+Prometheus *summaries* (``name{quantile="0.5"}``, ``name_sum``,
+``name_count``): the reservoir keeps observed samples, so nearest-rank
+quantiles are exact over the window, whereas fixed histogram buckets
+would have to be chosen per metric.
+
+Served by ``GET /metrics`` on the serve front (serve/__main__.py) — the
+single surface where serve counters, train goodput gauges, and span
+percentiles all land.
+"""
+
+from __future__ import annotations
+
+import math
+
+from .registry import Family, MetricsRegistry, get_registry
+
+#: served with this Content-Type (version is part of the contract)
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_QUANTILES = (0.5, 0.9, 0.99)
+
+
+def _fmt(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels(pairs, extra: tuple = ()) -> str:
+    items = [*pairs, *extra]
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(str(v))}"' for k, v in items) + "}"
+
+
+def _render_family(fam: Family, lines: list[str]) -> None:
+    if fam.help:
+        lines.append(f"# HELP {fam.name} {_escape(fam.help)}")
+    kind = "summary" if fam.kind == "histogram" else fam.kind
+    lines.append(f"# TYPE {fam.name} {kind}")
+    for child in fam.children():
+        if fam.kind == "histogram":
+            snap = child.collect(_QUANTILES)  # one lock + one sort
+            for q, v in snap["quantiles"].items():
+                lines.append(
+                    f"{fam.name}"
+                    f"{_labels(child.labels, (('quantile', q),))} "
+                    f"{_fmt(v)}")
+            lines.append(f"{fam.name}_sum{_labels(child.labels)} "
+                         f"{_fmt(snap['sum'])}")
+            lines.append(f"{fam.name}_count{_labels(child.labels)} "
+                         f"{_fmt(snap['count'])}")
+        else:
+            lines.append(f"{fam.name}{_labels(child.labels)} "
+                         f"{_fmt(child.value)}")
+
+
+def render_text(registry: MetricsRegistry | None = None) -> str:
+    """The whole registry as Prometheus text exposition (ends with \\n)."""
+    lines: list[str] = []
+    for fam in (registry or get_registry()).collect():
+        _render_family(fam, lines)
+    return "\n".join(lines) + "\n" if lines else "\n"

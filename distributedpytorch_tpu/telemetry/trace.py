@@ -1,0 +1,189 @@
+"""On-demand, bounded ``jax.profiler`` trace capture — no restart needed.
+
+The existing profiling story required deciding BEFORE launch
+(``profile_epoch`` config, ``utils.profiling.trace`` around a region);
+the interesting step regression always shows up mid-run.
+:class:`TraceCapture` arms a capture from the outside of a live process —
+``SIGUSR2`` on the trainer, ``POST /debug/trace?steps=N`` on the serve
+front — and the owning loop drives it with one cheap :meth:`tick` per
+step/batch: the next tick after a request starts the trace, N ticks later
+it stops, and the XPlane files land under the run dir
+(``trace_on_demand/trace_NNN``) for tensorboard/xprof.
+
+Safety properties, each deliberate:
+
+* **Bounded.**  Steps are clamped to ``max_steps`` and a wall-clock
+  ``max_seconds`` backstop closes a trace even if the step flow stalls
+  (a serve instance that goes idle mid-capture must not profile
+  forever — unbounded traces fill disks).
+* **Signal-safe arming.**  :meth:`request` only assigns plain attributes
+  (no locks): it is safe to call from a signal handler interrupting
+  arbitrary code.  All real work happens in :meth:`tick` on the owning
+  loop's thread.
+* **One at a time.**  ``jax.profiler`` supports a single active trace
+  per process; a request while one is active or armed is refused
+  (returns None) rather than queued.
+* **Never fatal.**  Profiler failures are counted
+  (``trace_capture_failures_total``) and printed, never raised into the
+  train loop.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+from .registry import MetricsRegistry, get_registry
+
+
+class TraceCapture:
+    """Armed-from-outside bounded device trace; driven by ``tick``.
+
+    ``tick(n)`` means "n more steps are about to run": the owning loop
+    calls it immediately before each dispatch (the trainer passes its
+    steps-per-dispatch; the serve worker passes 1 per batch and 0 on
+    idle polls so the time backstop still runs).
+    """
+
+    def __init__(self, log_dir: str, default_steps: int = 20,
+                 max_steps: int = 200, max_seconds: float = 120.0,
+                 registry: MetricsRegistry | None = None):
+        self.log_dir = log_dir
+        self.default_steps = default_steps
+        self.max_steps = max_steps
+        self.max_seconds = max_seconds
+        self._registry = registry
+        # armed-request slot: written by request() (possibly from a signal
+        # handler), consumed by tick() on the owning thread.  The arm
+        # itself is guarded by a NON-BLOCKING try-lock: concurrent HTTP
+        # threads cannot both claim the slot, and a signal handler that
+        # finds the lock held simply refuses (acquire(False) never blocks,
+        # so it can never deadlock against interrupted code).
+        self._arm_lock = threading.Lock()
+        self._want = 0
+        # active-capture state: owned exclusively by the tick()er's thread
+        self._active = False
+        self._remaining = 0
+        self._started = 0.0
+        self._dir = ""
+        self._captures = 0
+
+    # ------------------------------------------------------------- arming
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def request(self, steps: int | None = None) -> str | None:
+        """Arm a capture of ``steps`` (clamped to [1, max_steps]); the
+        next STEP tick starts it.  Returns the directory the trace will
+        land in, or None when one is already armed/active (refused, not
+        queued).  Safe to call from signal handlers and HTTP threads."""
+        if not self._arm_lock.acquire(blocking=False):
+            return None  # concurrent arm in flight — refuse, never block
+        try:
+            if self._active or self._want:
+                return None
+            n = self.default_steps if steps is None else int(steps)
+            target = os.path.join(self.log_dir,
+                                  f"trace_{self._captures:03d}")
+            # write the target BEFORE arming: tick() may fire between the
+            # two assignments and must already see where to write
+            self._pending_dir = target
+            self._want = max(1, min(self.max_steps, n))
+            return target
+        finally:
+            self._arm_lock.release()
+
+    def install_signal(self, signum: int | None = None):
+        """Install a SIGUSR2 (default) handler that arms a default
+        capture; returns an uninstall callable.  Off the main thread
+        (where ``signal.signal`` raises) this degrades to a no-op —
+        ``request()`` still works programmatically."""
+        if signum is None:
+            signum = getattr(signal, "SIGUSR2", None)
+            if signum is None:  # platform without SIGUSR2
+                return lambda: None
+        try:
+            prev = signal.signal(signum, lambda s, f: self.request())
+        except ValueError:
+            return lambda: None
+        return lambda: signal.signal(signum, prev)
+
+    # ------------------------------------------------------------- driving
+    def tick(self, n: int = 1) -> None:
+        """Advance by ``n`` imminent steps (0 = just service the time
+        backstop).  Called from exactly one thread — the step loop."""
+        if self._active:
+            if self._remaining <= 0 or \
+                    time.perf_counter() - self._started > self.max_seconds:
+                self._stop()
+            else:
+                self._remaining -= n
+        elif self._want and n > 0:
+            # start only on a REAL step tick: an idle tick(0) opening the
+            # trace would burn the wall-clock backstop on idle time and
+            # could close a serve capture having traced zero batches
+            steps = self._want
+            self._want = 0
+            self._start(steps)
+            self._remaining = steps - n
+        # else: idle — one attribute read, the per-step cost when unarmed
+
+    def close(self) -> None:
+        """Stop any in-flight capture (call at fit end / service stop)."""
+        if self._active:
+            self._stop()
+
+    # ------------------------------------------------------------ internals
+    def _reg(self) -> MetricsRegistry:
+        return self._registry or get_registry()
+
+    def _start(self, steps: int) -> None:
+        import jax
+
+        self._dir = getattr(self, "_pending_dir", None) or os.path.join(
+            self.log_dir, f"trace_{self._captures:03d}")
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            jax.profiler.start_trace(self._dir)
+        except Exception as e:  # another trace active, or profiler error
+            self._reg().counter("trace_capture_failures_total",
+                                "on-demand trace captures that failed").inc()
+            print(f"telemetry: trace capture failed to start: {e}",
+                  flush=True)
+            return
+        self._active = True
+        self._started = time.perf_counter()
+        print(f"telemetry: capturing {steps}-step trace -> {self._dir}",
+              flush=True)
+
+    def _stop(self) -> None:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self._reg().counter("trace_capture_failures_total",
+                                "on-demand trace captures that failed").inc()
+            print(f"telemetry: trace capture failed to stop: {e}",
+                  flush=True)
+        else:
+            self._reg().counter("trace_captures_total",
+                                "on-demand trace captures completed").inc()
+            print(f"telemetry: trace written -> {self._dir}", flush=True)
+        self._active = False
+        self._captures += 1
+
+
+#: serve-side convenience: arm via HTTP thread, driven by the worker loop
+def query_steps(query: str, default: int | None = None) -> int | None:
+    """Parse ``steps=N`` out of a raw query string (bad values -> default)."""
+    from urllib.parse import parse_qs
+
+    try:
+        vals = parse_qs(query).get("steps")
+        return int(vals[0]) if vals else default
+    except (ValueError, TypeError):
+        return default

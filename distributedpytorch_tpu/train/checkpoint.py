@@ -27,6 +27,7 @@ import jax
 import orbax.checkpoint as ocp
 
 from ..parallel import TrainState
+from ..telemetry import get_accountant, span
 
 
 def next_run_index(work_dir: str) -> int:
@@ -104,9 +105,12 @@ class CheckpointManager:
         if extra:
             meta.update(extra)
         payload["meta"] = ocp.args.JsonSave(meta)
-        self._mgr.save(step, args=ocp.args.Composite(**payload))
-        if is_best:
-            self._best.save(step, args=ocp.args.Composite(**payload))
+        # goodput: async saves charge only the enqueue here; the Orbax
+        # write itself lands in wait()'s checkpoint bucket
+        with get_accountant().account("checkpoint"), span("checkpoint/save"):
+            self._mgr.save(step, args=ocp.args.Composite(**payload))
+            if is_best:
+                self._best.save(step, args=ocp.args.Composite(**payload))
         return is_best
 
     def restore(self, state: TrainState, step: int | None = None,
@@ -119,13 +123,15 @@ class CheckpointManager:
             step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        restored = mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(state),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
+        with get_accountant().account("checkpoint"), \
+                span("checkpoint/restore"):
+            restored = mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(state),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
         return restored["state"], restored["meta"]
 
     def latest_step(self) -> int | None:
@@ -133,8 +139,9 @@ class CheckpointManager:
 
     def wait(self) -> None:
         """Block until async saves land (call before process exit)."""
-        self._mgr.wait_until_finished()
-        self._best.wait_until_finished()
+        with get_accountant().account("checkpoint"), span("checkpoint/wait"):
+            self._mgr.wait_until_finished()
+            self._best.wait_until_finished()
 
     def close(self) -> None:
         self.wait()

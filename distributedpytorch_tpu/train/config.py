@@ -377,6 +377,11 @@ class Config:
     comet_project: str = ""             # reference used 'Attention' (:41)
     comet_workspace: str = ""
     profile_epoch: int | None = None    # XPlane-trace this epoch (0-based)
+    telemetry: bool = True              # goodput/MFU accounting + the
+                                        # SIGUSR2 on-demand trace trigger
+                                        # (telemetry/); false = every
+                                        # account() is a no-op (the <=2%
+                                        # overhead contract's baseline)
 
 
 def _to_jsonable(obj: Any) -> Any:

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..ops.metrics import np_jaccard_thresholds
 from ..parallel import INPUT_KEY, pad_to_multiple, shard_batch
+from ..telemetry import span
 from ..utils.helpers import crop2fullmask, get_bbox, tens2image
 
 
@@ -109,7 +110,8 @@ def evaluate(
             padded, _ = pad_to_multiple(device_keys, n_dev)
             if mesh is not None:
                 padded = shard_batch(mesh, padded)
-            outputs, loss = eval_step(state, padded)
+            with span("eval/dispatch"):  # async: launch cost, not compute
+                outputs, loss = eval_step(state, padded)
             # deferred: float(loss) here would add a host<->device round
             # trip per val batch (~70ms each through a tunneled chip) on
             # top of the outputs fetch — the same stall train_epoch's bulk
@@ -150,30 +152,35 @@ def evaluate(
         gts = _as_list(batch["gt"], n)
         voids = _as_list(batch.get("void_pixels", [None] * n), n)
         bboxes = _as_list(batch["bbox"], n) if "bbox" in batch else [None] * n
-        for j in range(n):
-            gt = tens2image(np.asarray(gts[j]))
-            void = None if voids[j] is None else tens2image(np.asarray(voids[j]))
-            if gt.max() <= 0.5:  # empty gt: score pred-empty as IoU 1, else 0
-                for ti, th in enumerate(thresholds):
-                    jac_sum[ti] += float(not (probs[j] > th).any())
+        # the ragged host half of the protocol, named in traces so a
+        # paste-back-bound eval shows up as itself, not as device idle
+        with span("eval/pasteback"):
+            for j in range(n):
+                gt = tens2image(np.asarray(gts[j]))
+                void = None if voids[j] is None \
+                    else tens2image(np.asarray(voids[j]))
+                if gt.max() <= 0.5:  # empty gt: pred-empty is IoU 1, else 0
+                    for ti, th in enumerate(thresholds):
+                        jac_sum[ti] += float(not (probs[j] > th).any())
+                    n_samples += 1
+                    continue
+                # Prefer the bbox the crop transform recorded for this
+                # sample — guaranteed to be the exact box the crop was taken
+                # from; only recompute (with this function's relax/zero_pad)
+                # when absent.
+                if bboxes[j] is not None:
+                    bbox = tuple(int(v) for v in np.asarray(bboxes[j]))
+                else:
+                    bbox = get_bbox(gt > 0.5, pad=relax, zero_pad=zero_pad)
+                pred = tens2image(probs[j])
+                full = crop2fullmask(pred, bbox, gt.shape[:2],
+                                     zero_pad=zero_pad, relax=relax)
+                # all thresholds in one pass (digitize + bincount) — the
+                # scoring half of the host paste-back no longer scales with
+                # the threshold count
+                jac_sum += np_jaccard_thresholds(full, thresholds,
+                                                 gt > 0.5, void)
                 n_samples += 1
-                continue
-            # Prefer the bbox the crop transform recorded for this sample —
-            # guaranteed to be the exact box the crop was taken from; only
-            # recompute (with this function's relax/zero_pad) when absent.
-            if bboxes[j] is not None:
-                bbox = tuple(int(v) for v in np.asarray(bboxes[j]))
-            else:
-                bbox = get_bbox(gt > 0.5, pad=relax, zero_pad=zero_pad)
-            pred = tens2image(probs[j])
-            full = crop2fullmask(pred, bbox, gt.shape[:2],
-                                 zero_pad=zero_pad, relax=relax)
-            # all thresholds in one pass (digitize + bincount) — the
-            # scoring half of the host paste-back no longer scales with
-            # the threshold count
-            jac_sum += np_jaccard_thresholds(full, thresholds,
-                                             gt > 0.5, void)
-            n_samples += 1
 
     loss_sum = float(np.sum(jax.device_get(losses))) if losses else 0.0
     n_batches = len(losses)
@@ -480,15 +487,17 @@ def evaluate_semantic(
             confs.append(_batch_confusion(
                 jnp.asarray(avg), jnp.asarray(gt), nclass, ignore_index))
 
-    if confs:  # one bulk readback for every deferred device value
-        conf += np.sum(np.asarray(jax.device_get(confs), np.int64), axis=0)
-    for dev_maps, gts in fullres_maps:
-        maps = np.asarray(jax.device_get(dev_maps))
-        for j, g in enumerate(gts):
-            if g.ndim == 3:
-                g = g[..., 0]
-            conf += np_confusion(maps[j, :g.shape[0], :g.shape[1]], g)
-    loss_sum = float(np.sum(jax.device_get(losses))) if losses else 0.0
+    with span("eval/readback"):  # the epoch-end bulk D2H sync, named
+        if confs:  # one bulk readback for every deferred device value
+            conf += np.sum(np.asarray(jax.device_get(confs), np.int64),
+                           axis=0)
+        for dev_maps, gts in fullres_maps:
+            maps = np.asarray(jax.device_get(dev_maps))
+            for j, g in enumerate(gts):
+                if g.ndim == 3:
+                    g = g[..., 0]
+                conf += np_confusion(maps[j, :g.shape[0], :g.shape[1]], g)
+        loss_sum = float(np.sum(jax.device_get(losses))) if losses else 0.0
     n_batches = len(losses)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils

@@ -19,6 +19,7 @@ anti-pattern this module exists to avoid.  Select writers with the
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 from typing import Any, Mapping
@@ -64,18 +65,46 @@ class ConsoleWriter(MetricWriter):
 
 class JsonlWriter(MetricWriter):
     """One JSONL stream of scalar events + PNG figures on disk — greppable,
-    diffable, no deps; the run directory becomes the experiment record."""
+    diffable, no deps; the run directory becomes the experiment record.
+
+    Non-finite values serialize as ``null``: ``json.dumps`` would emit
+    bare ``NaN``/``Infinity`` (a Python extension no strict JSON parser
+    accepts), and a diverging run is EXACTLY when the log must stay
+    machine-readable.  The stream is line-buffered so a crashed run keeps
+    its tail — the last lines before the crash are the diagnosis.
+    """
 
     def __init__(self, directory: str):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
-        self._f = open(os.path.join(directory, "metrics.jsonl"), "a")
+        self._f = open(os.path.join(directory, "metrics.jsonl"), "a",
+                       buffering=1)
+
+    @classmethod
+    def _jsonable(cls, v):
+        if isinstance(v, bool):
+            return v
+        if isinstance(v, (int, float, np.integer, np.floating)):
+            f = float(v)
+            return f if math.isfinite(f) else None
+        if isinstance(v, dict):  # containers sanitize recursively, so a
+            return {k: cls._jsonable(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):  # nested NaN can't crash dumps
+            return [cls._jsonable(x) for x in v]
+        return v
 
     def scalars(self, metrics, step):
         rec = {"step": int(step), "time": time.time()}
-        rec.update({k: (float(v) if isinstance(v, (int, float)) else v)
-                    for k, v in metrics.items()})
-        self._f.write(json.dumps(rec) + "\n")
+        rec.update({k: self._jsonable(v) for k, v in metrics.items()})
+        try:
+            line = json.dumps(rec, allow_nan=False)
+        except (TypeError, ValueError):
+            # a writer must never kill the run it records: stringify the
+            # offending values and keep the stream valid JSONL
+            line = json.dumps({k: v if isinstance(
+                v, (bool, int, float, str, type(None))) else repr(v)
+                for k, v in rec.items()}, allow_nan=False)
+        self._f.write(line + "\n")
 
     def figure(self, name, fig, step):
         path = os.path.join(self.directory, f"{name}_step{step}.png")
@@ -145,6 +174,10 @@ class CometWriter(MetricWriter):
                  workspace: str | None = None,
                  experiment_name: str | None = None):
         self._exp = None
+        #: consecutive _guarded failures so far (reset on any success);
+        #: initialized here, not lazily via getattr — the counter is part
+        #: of the writer's state contract, not an accident of first error
+        self._fails = 0
         try:
             from comet_ml import Experiment
             if not os.environ.get("COMET_API_KEY"):
@@ -173,7 +206,7 @@ class CometWriter(MetricWriter):
             call()
             self._fails = 0
         except Exception as e:
-            self._fails = getattr(self, "_fails", 0) + 1
+            self._fails += 1
             if self._fails >= self._MAX_FAILS:
                 print(f"CometWriter error (disabled after "
                       f"{self._fails} consecutive failures): {e}",

@@ -49,6 +49,10 @@ class PreemptionGuard:
         self._flag = threading.Event()
         self._shield_depth = 0
         self.check_every = max(1, int(check_every))
+        #: termination signals delivered to this process (handler-side
+        #: count; mirrored into the telemetry registry by should_stop)
+        self.signals_received = 0
+        self._signals_reported = 0
 
     # ------------------------------------------------------------ handlers
     def __enter__(self) -> "PreemptionGuard":
@@ -70,6 +74,10 @@ class PreemptionGuard:
         return False
 
     def _handle(self, signum, frame) -> None:
+        # plain attribute increment only: a handler interrupting arbitrary
+        # bytecode must never touch a lock (the registry's counters do);
+        # should_stop() mirrors this into telemetry from a normal context
+        self.signals_received += 1
         if self._flag.is_set():
             if self._shield_depth > 0:
                 # Inside a shield() block (the final checkpoint flush):
@@ -124,13 +132,40 @@ class PreemptionGuard:
         """
         if step is not None and step % self.check_every != 0:
             return False
+        self._publish_telemetry()
         import jax
 
         if jax.process_count() == 1:
             return self.triggered
         import numpy as np
         from jax.experimental import multihost_utils
+        from ..telemetry import span
 
-        flags = multihost_utils.process_allgather(
-            np.asarray(self.triggered, np.int32))
+        # the consensus allgather is a host sync on the step-loop cadence:
+        # named in the device trace so its cost is attributable, not folded
+        # into whatever op happens to be adjacent
+        with span("preempt/consensus"):
+            flags = multihost_utils.process_allgather(
+                np.asarray(self.triggered, np.int32))
         return bool(np.any(flags))
+
+    def _publish_telemetry(self) -> None:
+        """Mirror handler-side signal counts into the registry (normal
+        thread context — the handler itself must stay lock-free)."""
+        from ..telemetry import get_registry
+        from ..telemetry.registry import is_enabled
+
+        if not is_enabled():
+            return
+
+        seen = self.signals_received
+        if seen > self._signals_reported:
+            get_registry().counter(
+                "preemption_signals_total",
+                "termination signals delivered to this process"
+            ).inc(seen - self._signals_reported)
+            self._signals_reported = seen
+        get_registry().gauge(
+            "preemption_stop_pending",
+            "1 while a graceful stop is requested but not yet taken"
+        ).set(float(self.triggered))

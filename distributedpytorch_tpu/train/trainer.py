@@ -53,6 +53,8 @@ from ..parallel import (
     prefetch_to_device,
     state_shardings,
 )
+from ..telemetry import TraceCapture, get_accountant, mfu_estimate
+from ..telemetry import set_enabled as telemetry_set_enabled
 from ..utils.helpers import generate_param_report
 from ..utils.profiling import device_memory_stats
 from . import config as config_lib
@@ -515,6 +517,18 @@ class Trainer:
         self._wire_spec: tuple | None = None
         self._wire_step = None
         self._wire_multi_step = None
+        # --- telemetry: goodput program-identity + MFU inputs + on-demand
+        # trace.  _programs_seen keys the compile-vs-step goodput split
+        # (the FIRST dispatch of each compiled program pays trace+XLA and
+        # is attributed to 'compile'); the trace trigger arms from SIGUSR2
+        # during fit() and writes bounded XPlane captures under the run dir.
+        self._programs_seen: set[str] = set()
+        self._prod_steps = 0
+        self._flops_per_step: float | None = None
+        self._flops_source: str | None = None
+        self._trace = TraceCapture(
+            os.path.join(self.run_dir, "trace_on_demand")) \
+            if (cfg.telemetry and self.is_main) else None
         eval_preprocess = None
         if self._val_device_guidance:
             # prepared val ships bare image channels; append the guidance
@@ -761,6 +775,55 @@ class Trainer:
                 "produce fixed-shape batches (drop_last + fixed crop)")
         return batch
 
+    def _note_step_cost(self, fn, args, steps_per_call: int) -> None:
+        """One-shot model-FLOPs/step estimate for MFU — XLA's own
+        ``cost_analysis`` of the exact compiled program (the executable is
+        cache-shared with the running step, so this re-traces but never
+        re-compiles), falling back to a parameter-proportional floor
+        (fwd+bwd ~ 3 param passes x 2 FLOPs/MAC x batch) on backends whose
+        cost model is unavailable.  The source is recorded so a fallback
+        estimate can never masquerade as a measured count."""
+        if self._flops_per_step is not None or not self.cfg.telemetry:
+            return
+        from ..telemetry.goodput import xla_step_cost
+        flops = xla_step_cost(fn, *args)["flops"]
+        if flops and flops > 0:  # guard negative cost-model sentinels
+            flops /= max(1, steps_per_call)
+            self._flops_source = "xla_cost_analysis"
+        else:
+            flops = 6.0 * self.n_params * self.cfg.data.train_batch
+            self._flops_source = "param_estimate"
+        self._flops_per_step = flops
+
+    def _report_goodput(self, history: dict | None = None) -> None:
+        """Fit-end goodput breakdown + MFU estimate: into the writer stack
+        (=> metrics.jsonl / console / comet), the registry gauges (=> the
+        serve front's /metrics when co-hosted) and ``history``."""
+        if not self.cfg.telemetry:
+            return
+        rep = get_accountant().report()
+        if history is not None:
+            history["goodput"] = rep
+        scalars = {f"goodput/{b}_s": round(v, 4)
+                   for b, v in rep["buckets"].items()}
+        scalars["goodput/total_s"] = round(rep["total_s"], 4)
+        scalars["goodput/productive_frac"] = round(rep["goodput"], 4)
+        if self._flops_per_step and self._prod_steps:
+            step_time = rep["buckets"]["step"] / self._prod_steps
+            if step_time > 0:
+                est = mfu_estimate(
+                    self._flops_per_step / self.mesh.devices.size,
+                    step_time, device_kind=None)
+                est["flops_source"] = self._flops_source
+                if history is not None:
+                    history["mfu"] = est
+                scalars["mfu"] = round(est["mfu"], 6)
+                scalars["mfu/flops_per_step"] = self._flops_per_step
+                scalars["mfu/peak_flops_per_device"] = \
+                    est["peak_flops_per_device"]
+        if self.is_main:
+            self.writer.scalars(scalars, int(self.state.step))
+
     def train_epoch(self, epoch: int,
                     guard: PreemptionGuard | None = None,
                     start_batch: int = 0,
@@ -777,6 +840,7 @@ class Trainer:
         self.train_loader.set_epoch(epoch, start_batch=start_batch)
         losses = []
         t0 = time.perf_counter()
+        acct = get_accountant()
         # Track the step as a python int (start + i): reading
         # ``self.state.step`` every iteration would block on the device and
         # serialize host data-prep against device compute.
@@ -801,6 +865,21 @@ class Trainer:
                 for _ in range(cfg.data.echo):
                     yield b
 
+        def waited(it):
+            # input-wait measured at the batch-fetch boundary: host time
+            # blocked on the prefetcher IS the data-pipeline stall signal
+            # (the silently-dominant cost FFCV / arxiv 2005.02130 document)
+            # — a first-class goodput bucket instead of invisible idle.
+            # Pure perf_counter bookkeeping: no host sync enters the loop.
+            it = iter(it)
+            while True:
+                with acct.account("input_wait"):
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        return
+                yield b
+
         def dispatches(placed):
             """(n_steps, losses) per compiled call: K-step chunks through
             the multi-step program (data.steps_per_dispatch), the epoch
@@ -808,11 +887,32 @@ class Trainer:
             wire-consuming twins substitute under data.coalesce_wire —
             read per call, not hoisted: they are built lazily by
             ``host_batches`` while the prefetcher pulls ahead."""
+            def dispatch(fn, key, n, args):
+                """One compiled call, goodput-attributed: the first
+                dispatch of each program pays trace+XLA and books under
+                'compile'; repeats are productive 'step' time.  The trace
+                trigger ticks BEFORE the call so an armed capture starts
+                on (not after) the step it was requested for."""
+                if self._trace is not None:
+                    self._trace.tick(n)
+                first = key not in self._programs_seen
+                with acct.account("compile" if first else "step"):
+                    self.state, out = fn(self.state, *args)
+                if first:
+                    self._programs_seen.add(key)
+                    # the cost-analysis re-trace books as compile too —
+                    # it is trace time, and idle must stay unexplained
+                    # time only
+                    with acct.account("compile"):
+                        self._note_step_cost(fn, (self.state, *args), n)
+                else:
+                    self._prod_steps += n
+                return out
+
             def one_step(b):
-                fn = self._wire_step if cfg.data.coalesce_wire \
-                    else self.train_step
-                self.state, loss = fn(self.state, b)
-                return loss
+                if cfg.data.coalesce_wire:
+                    return dispatch(self._wire_step, "wire1", 1, (b,))
+                return dispatch(self.train_step, "plain1", 1, (b,))
 
             if cfg.data.steps_per_dispatch <= 1:
                 for b in placed:
@@ -826,9 +926,12 @@ class Trainer:
                 if not chunk:
                     return
                 if len(chunk) == k:
-                    fn = self._wire_multi_step if cfg.data.coalesce_wire \
-                        else self.multi_train_step
-                    self.state, lv = fn(self.state, *chunk)
+                    if cfg.data.coalesce_wire:
+                        lv = dispatch(self._wire_multi_step, "wireK", k,
+                                      chunk)
+                    else:
+                        lv = dispatch(self.multi_train_step, "plainK", k,
+                                      chunk)
                     yield k, lv
                 else:
                     for b in chunk:
@@ -852,6 +955,7 @@ class Trainer:
                            if cfg.data.coalesce_wire else None))
             if cfg.data.echo > 1:
                 batches = echoed(batches)
+            batches = waited(batches)
             # cadence comes from the guard itself (a caller-provided guard
             # may carry its own check_every)
             check = guard.check_every if guard is not None else 1
@@ -923,9 +1027,14 @@ class Trainer:
         # full host<->device round trip (~70ms through a tunneled chip — per-
         # step syncs would dwarf the epoch itself).  Entries are scalars
         # (one per step) or (K,) vectors (one per multi-step dispatch).
-        loss_arr = np.concatenate(
-            [np.atleast_1d(x) for x in jax.device_get(losses)]) if losses \
-            else np.array([np.nan])
+        # Goodput: this wait IS the deferred device compute of the epoch's
+        # steps landing — productive time, not idle.
+        if losses:
+            with acct.account("step"):
+                fetched = jax.device_get(losses)
+            loss_arr = np.concatenate([np.atleast_1d(x) for x in fetched])
+        else:
+            loss_arr = np.array([np.nan])
         bad = np.flatnonzero(~np.isfinite(loss_arr))
         if bad.size and losses:
             # Epoch-end non-finite sweep (free: the losses are already on
@@ -967,6 +1076,13 @@ class Trainer:
         """The device/host evaluation half of :meth:`validate` — no writer
         or checkpoint side effects, so it is safe to run on the val-overlap
         thread against a snapshot ``state``."""
+        # goodput: validation wall-clock books under 'eval' (per-thread
+        # stacks keep the val-overlap thread's books separate)
+        with get_accountant().account("eval"):
+            return self._eval_metrics_inner(state, epoch)
+
+    def _eval_metrics_inner(self, state, epoch: int | None = None
+                            ) -> tuple[dict, dict | None]:
         self.val_loader.set_epoch(0)
         with self.mesh:
             if self.cfg.task == "semantic":
@@ -1151,7 +1267,18 @@ class Trainer:
             print(f"warning: profile_epoch={cfg.profile_epoch} outside the "
                   f"epoch range [{self.start_epoch}, {cfg.epochs}) — no "
                   "trace will be written", flush=True)
+        # goodput books cover exactly this fit; the on-demand trace trigger
+        # (SIGUSR2 -> bounded XPlane capture under run_dir/trace_on_demand)
+        # is armed for its duration.  set_enabled gates EVERY optional
+        # instrumentation path (spans, preemption publishing) process-wide,
+        # so telemetry=false is the true zero-instrumentation baseline.
+        telemetry_set_enabled(cfg.telemetry)
+        get_accountant().reset(enabled=cfg.telemetry)
+        self._prod_steps = 0
         with contextlib.ExitStack() as stack:
+            if self._trace is not None:
+                stack.callback(self._trace.close)
+                stack.callback(self._trace.install_signal())
             if guard is None and cfg.checkpoint.save_on_preempt:
                 guard = stack.enter_context(PreemptionGuard(
                     check_every=cfg.checkpoint.preempt_check_every))
@@ -1248,9 +1375,13 @@ class Trainer:
                 # epoch to hide behind; land it before the last save wait
                 self._join_overlapped_val(history)
                 self.ckpt.wait()
+            # after the last save has landed, so its wait is in the books
+            self._report_goodput(history)
             self.writer.flush()
         return history
 
     def close(self) -> None:
+        if self._trace is not None:
+            self._trace.close()
         self.ckpt.close()
         self.writer.close()

@@ -103,10 +103,19 @@ class StepTimer:
     ...     state, loss = step(state, batch)
     ...     timer.tick(loss)          # blocks on loss, records dt
     >>> timer.summary()               # {'mean_s': ..., 'p50_s': ..., ...}
+
+    ``sync="device_get"`` opts into materializing the outputs instead of
+    ``block_until_ready`` — the remote-tunneled-backend mode where
+    ``block_until_ready`` can be a no-op (see :func:`throughput`'s
+    rationale); the default stays the cheaper local-device block.
     """
 
-    def __init__(self, warmup: int = 2):
+    def __init__(self, warmup: int = 2, sync: str = "block"):
+        if sync not in ("block", "device_get"):
+            raise ValueError(f"sync must be 'block' or 'device_get', "
+                             f"got {sync!r}")
         self.warmup = warmup
+        self.sync = sync
         self._seen = 0
         self._last: float | None = None
         self.times: list[float] = []
@@ -114,7 +123,10 @@ class StepTimer:
     def tick(self, *outputs) -> float | None:
         """Record one step boundary; pass any step outputs to block on."""
         if outputs:
-            jax.block_until_ready(outputs)
+            if self.sync == "device_get":
+                jax.device_get(outputs)
+            else:
+                jax.block_until_ready(outputs)
         now = time.perf_counter()
         dt = None
         if self._last is not None:

@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
 # jaxlint over everything device-adjacent: the package (serve/ included —
 # the batcher feeds a jitted forward and is exactly the code whose silent
-# retraces the rules exist to catch) plus bench.py, the official record.
+# retraces the rules exist to catch; telemetry/ included — instrumentation
+# sits at step-loop boundaries and must never smuggle a host sync into
+# them) plus bench.py, the official record.
 # Mirror of the tier-1 gate (tests/test_lint_clean.py); run it before
 # pushing anything that touches device code:
 #

@@ -118,6 +118,34 @@ class TestJsonlWriter:
                  open(os.path.join(str(tmp_path), "metrics.jsonl"))]
         assert any(l.get("loss") == 2.0 for l in lines)
 
+    def test_nonfinite_serializes_as_null(self, tmp_path):
+        # regression: json.dumps writes bare NaN/Infinity (a Python
+        # extension no strict parser accepts) — a diverging run is exactly
+        # when the log must stay machine-readable
+        import numpy as np
+        w = JsonlWriter(str(tmp_path))
+        w.scalars({"loss": float("nan"), "lr": float("inf"),
+                   "g": float("-inf"), "ok": 1.5,
+                   "np_nan": np.float32("nan")}, step=7)
+        w.close()
+        def no_constants(s):
+            raise AssertionError(f"bare {s} in metrics.jsonl")
+        [rec] = [json.loads(l, parse_constant=no_constants) for l in
+                 open(os.path.join(str(tmp_path), "metrics.jsonl"))]
+        assert rec["loss"] is None and rec["lr"] is None
+        assert rec["g"] is None and rec["ok"] == 1.5
+        assert rec["np_nan"] is None
+
+    def test_line_buffered_tail_survives_without_close(self, tmp_path):
+        # a crashed run never reaches flush()/close(); the tail is the
+        # diagnosis and must already be on disk
+        w = JsonlWriter(str(tmp_path))
+        w.scalars({"loss": 3.0}, step=1)
+        with open(os.path.join(str(tmp_path), "metrics.jsonl")) as f:
+            lines = f.readlines()
+        assert lines and json.loads(lines[-1])["loss"] == 3.0
+        w.close()
+
 
 class TestTrainerWiring:
     def test_log_writers_knob_builds_comet(self, tmp_path, fake_comet):
@@ -152,6 +180,35 @@ class TestTrainerWiring:
 
 
 class TestCometTransientErrors:
+    def test_fails_counter_initialized_in_init(self, fake_comet):
+        # _fails is part of the writer's state contract, not a lazy
+        # getattr accident of the first error
+        assert CometWriter()._fails == 0
+
+    def test_nonconsecutive_failures_never_disable(self, fake_comet):
+        # one success resets the consecutive-failure count: 2x(MAX-1)
+        # failures with a success between must keep the writer alive
+        w = CometWriter()
+        exp = FakeExperiment.instances[0]
+        boxed = {"dead": True}
+
+        def flaky(d, step=None):
+            if boxed["dead"]:
+                raise ConnectionError("down")
+
+        exp.log_metrics = flaky
+        for i in range(CometWriter._MAX_FAILS - 1):
+            w.scalars({"a": float(i)}, i)
+        assert w._fails == CometWriter._MAX_FAILS - 1
+        boxed["dead"] = False
+        w.scalars({"a": 0.0}, 99)          # success resets the count
+        assert w._fails == 0
+        boxed["dead"] = True
+        for i in range(CometWriter._MAX_FAILS - 1):
+            w.scalars({"a": float(i)}, i)
+        assert w._exp is not None, \
+            "non-consecutive failures must not disable the writer"
+
     def test_transient_error_retries_then_recovers(self, fake_comet, capsys):
         w = CometWriter()
         exp = FakeExperiment.instances[0]

@@ -463,6 +463,69 @@ class TestHttpEndToEnd:
         health = client.health()         # 503 body IS the probe answer
         assert health["ok"] is False
 
+    def test_metrics_endpoint_prometheus_exposition(self, server):
+        import urllib.request
+
+        svc, url = server
+        ServeClient(url).predict(_image(), _points())
+        # a train-side registry gauge shares the same surface
+        from distributedpytorch_tpu.telemetry import get_registry
+        get_registry().gauge("goodput_ratio").set(0.5)
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            text = r.read().decode("utf-8")
+        assert "# TYPE serve_requests_total counter" in text
+        assert "serve_completed_total" in text
+        assert "goodput_ratio 0.5" in text
+        # every sample line parses: NAME{labels}? VALUE
+        import re
+        line_re = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+            r"(NaN|[+-]Inf|-?[0-9.e+-]+)$")
+        for line in text.strip().splitlines():
+            if not line.startswith("#"):
+                assert line_re.match(line), f"unparseable: {line!r}"
+
+    def test_debug_trace_endpoint_captures_bounded_trace(
+            self, predictor, tmp_path):
+        import json
+        import os
+        import urllib.request
+        from http.server import ThreadingHTTPServer
+
+        from distributedpytorch_tpu.serve.__main__ import (
+            _HealthCache,
+            make_handler,
+        )
+        from distributedpytorch_tpu.telemetry import TraceCapture
+
+        svc = InferenceService(
+            predictor, max_batch=4, queue_depth=16, max_wait_s=0.002,
+            trace=TraceCapture(str(tmp_path), default_steps=1))
+        svc.start()
+        httpd = ThreadingHTTPServer(
+            ("127.0.0.1", 0), make_handler(svc, _HealthCache()))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            req = urllib.request.Request(url + "/debug/trace?steps=1",
+                                         data=b"", method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 202
+                target = json.loads(r.read())["trace_dir"]
+            client = ServeClient(url)
+            client.predict(_image(), _points())  # the traced batch
+            deadline = time.time() + 10
+            while time.time() < deadline and svc.trace.active:
+                time.sleep(0.05)  # idle worker polls tick(0) -> stop
+            assert os.path.isdir(target) and os.listdir(target), \
+                "no XPlane files written by the on-demand capture"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            svc.stop()
+
 
 class TestInProcessClient:
     def test_same_api_as_http(self, predictor):
