@@ -97,6 +97,43 @@ from distributedpytorch_tpu.telemetry.goodput import (  # noqa: E402
 from distributedpytorch_tpu.telemetry import get_accountant  # noqa: E402
 
 
+def ir_audit_fields(fn, args, program: str) -> dict:
+    """The record's IR-audit fields (jaxaudit, analysis/ir.py): the
+    compiled program's collective inventory and its compile-contract
+    status ('pass' | 'drift' | 'no_contract' | 'skipped' | 'error').
+    Both keys are ALWAYS present so record consumers can rely on the
+    schema; DPTPU_BENCH_AUDIT=0 skips the audit, and any audit failure
+    degrades to 'error' rather than killing the record run.  The trace
+    is cache-shared with the MFU estimator's lowering (telemetry
+    .lowering), so the inventory costs no extra lower on the hot path.
+
+    Bench programs are named by their bench config (model/backbone/
+    size/batch vary by env knobs and platform) so they can NEVER collide
+    with the canonical contract set — a 512px TPU forward pinned under
+    the canonical 64px name would poison `jaxaudit check` everywhere.
+    A fresh setup therefore starts at 'no_contract':
+    DPTPU_BENCH_AUDIT_UPDATE=1 pins the current program as that
+    config's contract, after which every later record reports
+    pass/drift against it."""
+    fields = {"collectives": None, "ir_contract": "skipped"}
+    if os.environ.get("DPTPU_BENCH_AUDIT", "1") == "0":
+        return fields
+    try:
+        from distributedpytorch_tpu.analysis import contracts as _contracts
+        from distributedpytorch_tpu.analysis import ir as _ir
+
+        rep = _ir.audit(fn, _ir.struct_of(tuple(args)), name=program)
+        fields["collectives"] = rep["collectives"]
+        if os.environ.get("DPTPU_BENCH_AUDIT_UPDATE") == "1":
+            _contracts.save_contract(
+                _contracts.contract_from_report(rep),
+                _contracts.default_contracts_dir())
+        fields["ir_contract"] = _contracts.check_report_status(rep)
+    except Exception:
+        fields["ir_contract"] = "error"
+    return fields
+
+
 def _kind_lookup(table: dict) -> float | None:
     kind = jax.devices()[0].device_kind.lower()
     for sub, val in table.items():
@@ -362,6 +399,15 @@ def serve_bench() -> None:
     record["goodput_breakdown"] = {
         k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
     record["mfu"] = None
+    # IR-audit fields: the top bucket's forward (the program serving the
+    # measured burst), same schema as the train record.  Config-named —
+    # never the canonical serve_forward_b<N> names, whose contracts pin
+    # the 64px audit config, not this bench's resolution.
+    record.update(ir_audit_fields(
+        predictor.forward_jitted,
+        (jax.ShapeDtypeStruct((SERVE_MAX_BATCH, SIZE, SIZE, 4),
+                              np.float32),),
+        f"bench_serve_{BACKBONE}_{SIZE}px_b{SERVE_MAX_BATCH}"))
     from distributedpytorch_tpu.utils.profiling import device_memory_stats
 
     record["peak_bytes_in_use"] = \
@@ -453,6 +499,13 @@ def main() -> None:
             stats = throughput(one_step, steps=STEPS, warmup=WARMUP,
                                items_per_step=BATCH * n_chips)
         goodput_rep = acct.report()
+        # after the measurement (never before: the audit's trace must not
+        # share the timed window); struct args — the real state was
+        # donated to the steps above.  The name carries the bench config
+        # so each A/B variant pins its own contract.
+        audit_fields = ir_audit_fields(
+            step, (state, batch),
+            f"bench_{BENCH_MODEL}_{BACKBONE}_{size}px_b{BATCH}")
 
     per_chip = stats["items_per_sec"] / n_chips
     record = {
@@ -506,6 +559,9 @@ def main() -> None:
     record["goodput"] = round(goodput_rep["goodput"], 4)
     record["goodput_breakdown"] = {
         k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
+    # IR-audit fields (jaxaudit): collective inventory of the exact
+    # compiled step + compile-contract status; keys always present
+    record.update(audit_fields)
     if flops and flops > 0:  # a zero/negative cost-model sentinel: no MFU
         est = mfu_estimate(flops / n_chips, stats["mean_s"])
         record["mfu"] = round(est["mfu"], 4)

@@ -1,4 +1,4 @@
-"""jaxlint: AST-based static analysis for TPU-hazard patterns.
+"""Static analysis: jaxlint (AST) + jaxaudit (IR) for TPU-hazard patterns.
 
 The reference repo's header is a hand-maintained checklist of correctness
 hazards (train_pascal.py:1-8); this framework's equivalents — silent
@@ -21,6 +21,7 @@ JL004 donation drift: jit of a state-updating step without donate_argnums
 JL005 sharding drift: PartitionSpec axis names not defined by parallel/mesh
 JL006 dtype leak: float64 flowing into device code (jnp.float64, x64 flag)
 JL007 leftover debug statements (jax.debug.print, breakpoint, print-in-jit)
+JL008 jnp.array/asarray without explicit dtype in jit (silent f32 upcast)
 JL000 meta: unknown rule code inside a ``# jaxlint: disable=`` comment
 ===== ======================================================================
 
@@ -29,6 +30,21 @@ Suppression: ``# jaxlint: disable=JL001`` on the offending line, or
 waiver.  Runtime complement: :class:`utils.compile_watchdog.CompileWatchdog`
 counts actual XLA compilations and fails tests that recompile steady-state
 steps.
+
+The hazards the AST structurally cannot see — they exist only in the
+traced jaxpr and the compiled HLO — are jaxaudit's job (:mod:`ir` +
+:mod:`contracts`, docs/DESIGN.md "IR auditing & compile contracts"):
+
+    python -m distributedpytorch_tpu.analysis --ir check
+    jaxaudit check                           # console entry point
+
+jaxaudit traces the REAL train/eval/serve programs, inventories their
+collectives per mesh axis, checks dtype flow (JA002), dead/duplicate
+outputs (JA003/JA004), baked constants (JA005) and donation aliasing
+(JA006), and diffs everything against platform-keyed compile contracts
+checked in under ``tests/contracts/``.  ``ir``/``contracts`` import jax;
+they are deliberately NOT imported here so the linter half stays usable
+in editors and pre-commit hooks with no backend.
 """
 
 from .core import (

@@ -1,8 +1,26 @@
-"""``python -m distributedpytorch_tpu.analysis [paths...]`` — jaxlint CLI."""
+"""``python -m distributedpytorch_tpu.analysis [paths...]`` — jaxlint CLI.
+
+``python -m distributedpytorch_tpu.analysis --ir <command> [...]`` routes
+to jaxaudit, the IR-level program auditor (``jaxaudit check`` /
+``update`` / ``audit`` / ``list`` — see :mod:`contracts`).  The split
+keeps the default linter path import-light (no jax): only ``--ir``
+touches a backend.
+"""
 
 import sys
 
-from .core import main
+
+def _main() -> int:
+    argv = sys.argv[1:]
+    if "--ir" in argv:
+        argv = [a for a in argv if a != "--ir"]
+        from .contracts import main as ir_main
+
+        return ir_main(argv)
+    from .core import main
+
+    return main(argv)
+
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_main())

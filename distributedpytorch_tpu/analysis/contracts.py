@@ -1,0 +1,413 @@
+"""Compile contracts: checked-in pins of what each hot program compiles to.
+
+A contract is a small platform-keyed JSON file under ``tests/contracts/``
+recording what :func:`ir.audit` observed for one program — collective
+counts per mesh axis (jaxpr) and per HLO op (compiled), output
+shapes/dtypes, donation declaration + aliasing effectiveness, baked
+constant totals, an XLA FLOPs estimate, and the per-class finding
+counts.  ``jaxaudit check`` re-traces the live program and fails on
+drift; ``jaxaudit update`` regenerates the pins after a REVIEWED change.
+
+Why platform-keyed (``<program>.<platform><ndevices>.json``): the same
+Python builds a different program per backend and topology — GSPMD
+inserts different collectives for 8 devices than for 1, donation aliases
+on some backends and not others, FLOPs counts differ with fused ops.  A
+single un-keyed contract would be wrong everywhere but the machine that
+wrote it.  The checked-in set pins the canonical tier-1 topology (the
+8-device virtual CPU mesh of tests/conftest.py); ``jaxaudit`` pins the
+same topology when run standalone, so the gate is deterministic on any
+dev box.  TPU contracts are generated the same way on a chip
+(``JAX_PLATFORMS=tpu jaxaudit update``).
+
+Drift semantics, per field:
+
+* collectives / outputs / donation / finding counts — exact: one stray
+  psum or a lost ``donate_argnums`` IS the regression this gate exists
+  to catch;
+* constant bytes — bound: growth past 5% fails (const bloat), shrinkage
+  passes (an improvement should not fail CI; update the pin when you
+  land it);
+* FLOPs — banded at ±10%: the estimate wobbles with XLA fusion choices,
+  but a silently doubled step does not hide in a 10% band.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+#: the canonical audited set: the trainer's two steps and two serve
+#: buckets (the bucket ladder's ends).  Any ``serve_forward_b<N>`` name
+#: is buildable on demand (``--programs serve_forward_b4``).
+PROGRAM_NAMES = ("train_step", "eval_step",
+                 "serve_forward_b1", "serve_forward_b8")
+
+_PROGRAM_HELP = {
+    "train_step": "jitted mesh train step (fwd+loss+bwd+SGD, donated)",
+    "eval_step": "jitted mesh eval step (fwd+loss)",
+    "serve_forward_b1": "serve bucket forward, batch 1",
+    "serve_forward_b8": "serve bucket forward, batch 8",
+}
+
+#: relative FLOPs band and constant-bytes growth bound (see module doc)
+FLOPS_RTOL = 0.10
+CONST_BYTES_GROWTH = 0.05
+
+#: canonical audited config: small enough that trace+compile fits the
+#: tier-1 budget, mesh-sharded so the collective structure is real
+_AUDIT_HW = (64, 64)
+_AUDIT_CHANNELS = 4
+
+
+def platform_key(platform: str | None = None,
+                 n_devices: int | None = None) -> str:
+    """``cpu8`` / ``tpu4`` — the contract filename key."""
+    if platform is None or n_devices is None:
+        import jax
+
+        devs = jax.devices()
+        platform = platform or devs[0].platform
+        n_devices = n_devices or len(devs)
+    return f"{platform}{n_devices}"
+
+
+def default_contracts_dir() -> str:
+    """``<repo>/tests/contracts`` for a source checkout (the layout this
+    repo ships); installed deployments pass ``--contracts-dir``."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), "tests", "contracts")
+
+
+def contract_path(contracts_dir: str, program: str, key: str) -> str:
+    return os.path.join(contracts_dir, f"{program}.{key}.json")
+
+
+# ----------------------------------------------------------------- contracts
+
+def contract_from_report(report: dict) -> dict:
+    """The pinned subset of an :func:`ir.audit` report."""
+    return {
+        "program": report["program"],
+        "platform_key": platform_key(report["platform"],
+                                     report["n_devices"]),
+        "collectives": report["collectives"],
+        "outputs": list(report["outputs"]),
+        "donation": {
+            "declared_args": report["donation"]["declared_args"],
+            "effective": report["donation"]["effective"],
+        },
+        "constants": {
+            "count": report["constants"]["count"],
+            "total_bytes": report["constants"]["total_bytes"],
+        },
+        "flops": report["flops"],
+        "finding_counts": dict(report["finding_counts"]),
+    }
+
+
+def diff_contract(contract: dict, report: dict) -> list[str]:
+    """Human-readable drift lines; empty list == the live program still
+    matches its pins."""
+    drift: list[str] = []
+
+    for level in ("jaxpr", "hlo"):
+        want = (contract.get("collectives") or {}).get(level)
+        have = (report.get("collectives") or {}).get(level)
+        if want is None:
+            continue
+        if have is None:
+            drift.append(f"collectives[{level}]: live inventory "
+                         f"unavailable (contract pins {want})")
+        elif want != have:
+            drift.append(f"collectives[{level}]: contract {want} "
+                         f"!= live {have}")
+
+    want_out, have_out = contract["outputs"], report["outputs"]
+    if want_out != have_out:
+        if len(want_out) != len(have_out):
+            drift.append(f"outputs: contract has {len(want_out)}, "
+                         f"live has {len(have_out)}")
+        else:
+            i = next(i for i, (a, b) in enumerate(zip(want_out, have_out))
+                     if a != b)
+            drift.append(f"outputs: #{i} contract {want_out[i]} != "
+                         f"live {have_out[i]}")
+
+    dw, dh = contract["donation"], report["donation"]
+    if dw["declared_args"] != dh["declared_args"]:
+        drift.append(f"donation: contract declares "
+                     f"{dw['declared_args']} donated arg(s), live "
+                     f"declares {dh['declared_args']}")
+    if dw.get("effective") != dh.get("effective"):
+        drift.append(f"donation: aliasing effective={dh.get('effective')} "
+                     f"(contract pins {dw.get('effective')})")
+
+    cw, ch = contract["constants"], report["constants"]
+    if cw["count"] != ch["count"]:
+        drift.append(f"constants: contract pins {cw['count']}, live has "
+                     f"{ch['count']}")
+    limit = cw["total_bytes"] * (1 + CONST_BYTES_GROWTH) + 1024
+    if ch["total_bytes"] > limit:
+        drift.append(f"constants: {ch['total_bytes']} bytes baked into "
+                     f"the trace, past the pinned "
+                     f"{cw['total_bytes']} (+{CONST_BYTES_GROWTH:.0%})")
+
+    fw, fh = contract.get("flops"), report.get("flops")
+    if fw:
+        if not fh:
+            drift.append(f"flops: live estimate unavailable (contract "
+                         f"pins {fw:.3g})")
+        elif abs(fh - fw) / fw > FLOPS_RTOL:
+            drift.append(f"flops: live {fh:.4g} outside ±{FLOPS_RTOL:.0%} "
+                         f"of pinned {fw:.4g}")
+
+    for cls, want_n in contract["finding_counts"].items():
+        have_n = report["finding_counts"].get(cls, 0)
+        if have_n != want_n:
+            drift.append(f"findings[{cls}]: {have_n} (contract pins "
+                         f"{want_n})")
+    return drift
+
+
+def save_contract(contract: dict, contracts_dir: str) -> str:
+    os.makedirs(contracts_dir, exist_ok=True)
+    path = contract_path(contracts_dir, contract["program"],
+                         contract["platform_key"])
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(contract, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_contract(contracts_dir: str, program: str,
+                  key: str) -> dict | None:
+    path = contract_path(contracts_dir, program, key)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def check_report(report: dict, contracts_dir: str | None = None
+                 ) -> list[str]:
+    """Drift of one live audit report against its checked-in contract;
+    a missing contract is itself a (single-line) failure."""
+    contracts_dir = contracts_dir or default_contracts_dir()
+    key = platform_key(report["platform"], report["n_devices"])
+    contract = load_contract(contracts_dir, report["program"], key)
+    if contract is None:
+        return [f"no contract for {report['program']} on {key} "
+                f"(run `jaxaudit update` and review the diff)"]
+    return diff_contract(contract, report)
+
+
+def check_report_status(report: dict, contracts_dir: str | None = None
+                        ) -> str:
+    """``'pass' | 'drift' | 'no_contract'`` — the one-word form bench.py
+    stamps into its records."""
+    contracts_dir = contracts_dir or default_contracts_dir()
+    key = platform_key(report["platform"], report["n_devices"])
+    contract = load_contract(contracts_dir, report["program"], key)
+    if contract is None:
+        return "no_contract"
+    return "drift" if diff_contract(contract, report) else "pass"
+
+
+# ------------------------------------------------------- canonical programs
+
+def build_default_programs(names: tuple | list | None = None) -> dict:
+    """``{name: (fn, example_args)}`` for the canonical audited set — the
+    REAL mesh train/eval steps and serve bucket forwards at the tier-1
+    config (DANet-ResNet18, 64², one lane per device).
+
+    Train/eval state is shape-only (``jax.eval_shape`` of the real
+    ``create_train_state``): tracing needs avals, not weights.  The serve
+    forwards need concrete params (the jitted forward closes over them —
+    the closure IS what the constants check audits), so one real init
+    runs for those.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..models import build_model
+    from ..parallel import (
+        create_train_state,
+        make_eval_step,
+        make_mesh,
+        make_train_step,
+    )
+    from ..predict import Predictor
+
+    names = tuple(names) if names else PROGRAM_NAMES
+    unknown = [n for n in names
+               if n not in ("train_step", "eval_step")
+               and not (n.startswith("serve_forward_b")
+                        and n[len("serve_forward_b"):].isdigit())]
+    if unknown:
+        raise ValueError(f"unknown program(s): {unknown} "
+                         f"(known: {list(PROGRAM_NAMES)} and "
+                         "serve_forward_b<N>)")
+
+    h, w = _AUDIT_HW
+    ch = _AUDIT_CHANNELS
+    sds = jax.ShapeDtypeStruct
+    model = build_model("danet", nclass=1, backbone="resnet18",
+                        output_stride=8, dtype="float32")
+    tx = optax.sgd(1e-3, momentum=0.9)
+
+    programs: dict = {}
+    if {"train_step", "eval_step"} & set(names):
+        mesh = make_mesh()
+        b = mesh.devices.size  # one lane per device
+        batch = {"concat": sds((b, h, w, ch), jnp.float32),
+                 "crop_gt": sds((b, h, w), jnp.float32)}
+        with mesh:
+            state_struct = jax.eval_shape(
+                lambda: create_train_state(
+                    jax.random.PRNGKey(0), model, tx, (1, h, w, ch),
+                    mesh=mesh))
+            if "train_step" in names:
+                step = make_train_step(model, tx, mesh=mesh,
+                                       loss_type="multi_sigmoid")
+                programs["train_step"] = (step, (state_struct, batch))
+            if "eval_step" in names:
+                ev = make_eval_step(model, mesh=mesh,
+                                    loss_type="multi_sigmoid")
+                programs["eval_step"] = (ev, (state_struct, batch))
+
+    serve = [n for n in names if n.startswith("serve_forward_b")]
+    if serve:
+        state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                                   (1, h, w, ch))
+        pred = Predictor(model, state.params, state.batch_stats,
+                         resolution=(h, w), relax=50)
+        for n in serve:
+            bucket = int(n[len("serve_forward_b"):])
+            programs[n] = (pred.forward_jitted,
+                           (sds((bucket, h, w, ch), jnp.float32),))
+    # preserve the caller's order
+    return {n: programs[n] for n in names if n in programs}
+
+
+# ------------------------------------------------------------------- the CLI
+
+def _pin_cpu_topology() -> None:
+    """Standalone ``jaxaudit`` pins the canonical 8-device CPU topology
+    (exactly tests/conftest.py's) BEFORE jax initializes, so the checked
+    gate sees the same programs everywhere.  A no-op when jax is already
+    imported (in-process callers own their topology) or when the caller
+    pinned another platform (``JAX_PLATFORMS=tpu jaxaudit update``)."""
+    if "jax" in sys.modules:
+        return
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat and plat != "cpu":
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def run_cli(argv: list[str] | None = None, programs: dict | None = None
+            ) -> int:
+    """``jaxaudit {audit|check|update|list} [...]``.
+
+    ``programs`` injects a prebuilt ``{name: (fn, args)}`` registry —
+    tests audit throwaway jits through the same code path the gate runs.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="jaxaudit",
+        description="IR-level program auditor + compile contracts "
+                    "(see docs/DESIGN.md 'IR auditing & compile "
+                    "contracts').")
+    parser.add_argument("command",
+                        choices=("audit", "check", "update", "list"),
+                        help="audit: print reports; check: diff against "
+                             "contracts (exit 1 on drift); update: "
+                             "regenerate contracts; list: program names")
+    parser.add_argument("--programs",
+                        help="comma-separated subset (default: all)")
+    parser.add_argument("--contracts-dir", default=None,
+                        help="contract directory (default: the repo's "
+                             "tests/contracts)")
+    args = parser.parse_args(argv)
+
+    if args.command == "list":
+        for name in PROGRAM_NAMES:
+            print(f"{name:18s} {_PROGRAM_HELP.get(name, '')}")
+        return 0
+
+    names = tuple(s.strip() for s in args.programs.split(",")
+                  if s.strip()) if args.programs else None
+    contracts_dir = args.contracts_dir or default_contracts_dir()
+
+    from . import ir  # jax import lives behind the CLI, not the package
+
+    if programs is None:
+        _pin_cpu_topology()
+        try:
+            from ..backend_health import enable_compile_cache
+
+            enable_compile_cache()
+        except Exception:
+            pass
+        try:
+            programs = build_default_programs(names)
+        except ValueError as e:
+            print(f"jaxaudit: error: {e}", file=sys.stderr)
+            return 2
+    elif names:
+        unknown = set(names) - set(programs)
+        if unknown:
+            print(f"jaxaudit: error: unknown program(s) "
+                  f"{sorted(unknown)}", file=sys.stderr)
+            return 2
+        programs = {n: programs[n] for n in names}
+
+    reports = ir.audit_many(programs)
+
+    if args.command == "audit":
+        print(json.dumps(reports, indent=1, sort_keys=True))
+        findings = sum(len(r["findings"]) for r in reports.values())
+        if findings:
+            print(f"jaxaudit: {findings} finding(s) across "
+                  f"{len(reports)} program(s)", file=sys.stderr)
+        return 0
+
+    if args.command == "update":
+        for report in reports.values():
+            path = save_contract(contract_from_report(report),
+                                 contracts_dir)
+            print(f"wrote {path}")
+        return 0
+
+    # check
+    failed = 0
+    for name, report in reports.items():
+        drift = check_report(report, contracts_dir)
+        if drift:
+            failed += 1
+            for line in drift:
+                print(f"{name}: {line}")
+        else:
+            print(f"{name}: ok "
+                  f"({platform_key(report['platform'], report['n_devices'])})")
+    if failed:
+        print(f"jaxaudit: {failed}/{len(reports)} program(s) drifted "
+              "from their compile contracts", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Console entry point (``jaxaudit`` in pyproject)."""
+    return run_cli(sys.argv[1:] if argv is None else argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,459 @@
+"""jaxaudit: IR-level auditing of the hot compiled programs.
+
+jaxlint (:mod:`rules`) reads Python source; the hazards that actually
+cost step time only exist in the traced jaxpr and the compiled HLO —
+a silent f32 upcast in the bf16 path, a dead output the trainer keeps
+alive, donation that fails to alias, collective bloat on the mesh axes.
+This module traces the REAL jitted callables (the trainer's train/eval
+steps, the serve buckets' forwards) through the process-wide
+:mod:`telemetry.lowering` cache and walks the program itself:
+
+* **collective inventory** — psum/all_gather/psum_scatter/ppermute/
+  all_to_all equation counts per mesh axis from the jaxpr (explicit
+  shard_map collectives), plus all-reduce/all-gather/reduce-scatter/
+  collective-permute/all-to-all op counts from the compiled HLO (the
+  collectives GSPMD inserts — the structure arxiv's distributed-CNN
+  scaling work shows dominates efficiency);
+* **dtype flow** (JA002) — f32 equations fed by a bf16→f32 upcast whose
+  consumer is not in the allowlisted accumulation set;
+* **dead / duplicate outputs** (JA003/JA004) — outputs with no input
+  dependence (baked constants the caller re-fetches every step) and the
+  same value returned twice;
+* **large baked constants** (JA005) — closure arrays captured into the
+  trace (a captured dataset or index table rides every dispatch);
+* **donation effectiveness** (JA006) — declared donations
+  (``args_info``) vs the bytes the compiled program actually aliased
+  (``memory_analysis().alias_size_in_bytes``): ``donate_argnums`` that
+  fails to alias silently doubles peak HBM.
+
+The report is JSON-able; :mod:`contracts` pins it platform-keyed under
+``tests/contracts/`` and fails CI on drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Iterator
+
+#: jaxpr-level collective primitives (psum2 is shard_map's psum)
+_COLLECTIVE_PRIMS = {
+    "psum": "psum",
+    "psum2": "psum",
+    "all_gather": "all_gather",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute",
+    "psum_scatter": "psum_scatter",
+    "pmax": "pmax",
+    "pmin": "pmin",
+}
+
+#: HLO ops counted in the compiled module (sync + async -start forms)
+_HLO_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+#: f32 primitives allowed to consume a bf16→f32 upcast: reductions and
+#: matmul/conv accumulation (widening the accumulator is the POINT of
+#: mixed precision), re-converts and gradient plumbing, plus pure
+#: layout/movement ops (reshape/transpose/slice/...) that do no f32
+#: arithmetic — they carry the value, they don't compute on it.
+#: Everything else computing in f32 on upcast bf16 data is paying 2x
+#: bytes for math the bf16 units could do.
+DEFAULT_F32_ACCUM_ALLOW = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "dot_general", "conv_general_dilated",
+    "convert_element_type", "reduce_precision", "stop_gradient",
+    # layout/movement, no arithmetic
+    "reshape", "transpose", "broadcast_in_dim", "squeeze",
+    "expand_dims", "slice", "dynamic_slice", "dynamic_update_slice",
+    "concatenate", "rev", "gather", "pad", "copy",
+})
+
+#: constants above this many bytes raise JA005 (1 MiB — an f32 image or
+#: a class-weight table is fine; a captured dataset is not)
+DEFAULT_LARGE_CONST_BYTES = 1 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditFinding:
+    """One IR-level hazard: ``CODE[class] message``."""
+
+    code: str       # JAxxx
+    cls: str        # stable class key (contract-pinned count)
+    message: str
+
+    def format(self) -> str:
+        return f"{self.code}[{self.cls}] {self.message}"
+
+
+#: the closed set of finding classes a contract pins counts for
+FINDING_CLASSES = ("dtype_upcast", "dead_output", "duplicate_output",
+                   "large_const", "donation")
+
+
+# ------------------------------------------------------------- jaxpr walking
+
+def _jaxprs_in(value) -> Iterator:
+    """Jaxprs nested inside one eqn param value (Jaxpr, ClosedJaxpr, or
+    lists/tuples of either)."""
+    if hasattr(value, "eqns"):
+        yield value
+    elif hasattr(value, "jaxpr"):
+        yield from _jaxprs_in(value.jaxpr)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _jaxprs_in(v)
+
+
+def iter_jaxprs(jaxpr) -> Iterator:
+    """``jaxpr`` and every jaxpr nested in its equations' params
+    (scan/cond/pjit/shard_map bodies, custom_vjp branches, ...)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            for sub in _jaxprs_in(v):
+                yield from iter_jaxprs(sub)
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    for j in iter_jaxprs(jaxpr):
+        yield from j.eqns
+
+
+def _is_literal(atom) -> bool:
+    return hasattr(atom, "val")  # core.Literal; Vars carry no .val
+
+
+# --------------------------------------------------------------- inventories
+
+def collective_inventory(closed_jaxpr) -> dict:
+    """``{primitive: {axis: count}}`` over every (nested) equation.
+    shard_map's ``psum2`` reports as ``psum``; an axis jax left implicit
+    reports as ``"?"``."""
+    inv: dict[str, dict[str, int]] = {}
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        name = _COLLECTIVE_PRIMS.get(eqn.primitive.name)
+        if name is None:
+            continue
+        axes = eqn.params.get("axes")
+        if axes is None:
+            axes = eqn.params.get("axis_name")
+        if axes is None:
+            axes = ("?",)
+        if not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        per_axis = inv.setdefault(name, {})
+        for ax in axes:
+            per_axis[str(ax)] = per_axis.get(str(ax), 0) + 1
+    return inv
+
+
+def hlo_collective_counts(compiled) -> dict | None:
+    """Collective op counts in the compiled module's HLO text — the
+    all-reduces GSPMD inserted for sharded programs, invisible at the
+    jaxpr level.  None when the text is unavailable."""
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    if not text:
+        return None
+    counts = {}
+    for op in _HLO_COLLECTIVES:
+        n = len(re.findall(rf" {op}(?:-start)?\(", text))
+        if n:
+            counts[op] = n
+    return counts
+
+
+# ------------------------------------------------------------ dtype findings
+
+def _has_subjaxpr(eqn) -> bool:
+    return any(True for v in eqn.params.values() for _ in _jaxprs_in(v))
+
+
+def dtype_upcast_findings(closed_jaxpr,
+                          allow: frozenset = DEFAULT_F32_ACCUM_ALLOW
+                          ) -> list[AuditFinding]:
+    """bf16→f32 ``convert_element_type`` equations whose result feeds a
+    primitive outside the accumulation allowlist.  Walked per nesting
+    level: each nested jaxpr runs its own pass over its own converts.
+    Call-like consumers (pjit/scan/cond/custom_jvp_call/... — anything
+    carrying a subjaxpr) are transparent, not findings: the value merely
+    crosses a call boundary there, and what happens to it inside is not
+    an upcast hazard by itself (flagging 'consumed by scan' would make
+    every bf16 contract pin noise)."""
+    findings = []
+    for jaxpr in iter_jaxprs(closed_jaxpr.jaxpr):
+        # non-transparent consumers of each var at THIS level
+        consumers: dict[int, list[str]] = {}
+        for eqn in jaxpr.eqns:
+            if _has_subjaxpr(eqn):
+                continue
+            for atom in eqn.invars:
+                if not _is_literal(atom):
+                    consumers.setdefault(id(atom), []).append(
+                        eqn.primitive.name)
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            src = eqn.invars[0]
+            if _is_literal(src):
+                continue
+            src_dtype = str(getattr(src.aval, "dtype", ""))
+            out = eqn.outvars[0]
+            out_dtype = str(getattr(out.aval, "dtype", ""))
+            if src_dtype != "bfloat16" or out_dtype != "float32":
+                continue
+            bad = sorted({p for p in consumers.get(id(out), ())
+                          if p not in allow})
+            if bad:
+                shape = tuple(getattr(src.aval, "shape", ()))
+                findings.append(AuditFinding(
+                    "JA002", "dtype_upcast",
+                    f"bf16{list(shape)} upcast to f32 consumed by "
+                    f"non-accumulation op(s) {', '.join(bad)} — f32 math "
+                    "on the bf16 path pays 2x bytes; keep it bf16 or "
+                    "allowlist a real accumulation"))
+    return findings
+
+
+# ----------------------------------------------------------- output findings
+
+def output_findings(closed_jaxpr) -> list[AuditFinding]:
+    """Dead outputs (no dependence on any input — a constant the caller
+    re-fetches every dispatch) and duplicate outputs (the same var
+    returned twice — the trainer is keeping two names for one buffer)."""
+    jaxpr = closed_jaxpr.jaxpr
+    depends: dict[int, bool] = {id(v): True for v in jaxpr.invars}
+    for eqn in jaxpr.eqns:
+        dep = any(depends.get(id(a), False) for a in eqn.invars
+                  if not _is_literal(a))
+        for ov in eqn.outvars:
+            depends[id(ov)] = dep
+    findings = []
+    seen: dict[int, int] = {}
+    for i, ov in enumerate(jaxpr.outvars):
+        aval = getattr(ov, "aval", None)
+        desc = _format_aval(aval) if aval is not None else "<literal>"
+        if _is_literal(ov) or not depends.get(id(ov), False):
+            findings.append(AuditFinding(
+                "JA003", "dead_output",
+                f"output #{i} ({desc}) does not depend on any input — a "
+                "baked constant shipped back every dispatch; drop it or "
+                "compute it once on host"))
+        elif id(ov) in seen:
+            findings.append(AuditFinding(
+                "JA004", "duplicate_output",
+                f"output #{i} ({desc}) duplicates output #{seen[id(ov)]} "
+                "— the same buffer returned twice costs an extra copy "
+                "out of the program"))
+        else:
+            seen[id(ov)] = i
+    return findings
+
+
+# ------------------------------------------------------------ const findings
+
+def constant_report(closed_jaxpr,
+                    large_const_bytes: int = DEFAULT_LARGE_CONST_BYTES
+                    ) -> tuple[dict, list[AuditFinding]]:
+    import numpy as np
+
+    total = 0
+    largest = (0, "")
+    n = 0
+    for c in closed_jaxpr.consts:
+        shape = getattr(c, "shape", ())
+        dtype = getattr(c, "dtype", None)
+        if dtype is not None:
+            nbytes = int(np.prod(shape, dtype=np.int64)) \
+                * np.dtype(dtype).itemsize
+        else:
+            nbytes = getattr(c, "nbytes", 0)
+        total += int(nbytes)
+        n += 1
+        if nbytes > largest[0]:
+            largest = (int(nbytes),
+                       f"{np.dtype(dtype).name if dtype is not None else '?'}"
+                       f"{list(shape)}")
+    report = {"count": n, "total_bytes": total,
+              "largest_bytes": largest[0], "largest": largest[1] or None}
+    findings = []
+    if total > large_const_bytes:
+        findings.append(AuditFinding(
+            "JA005", "large_const",
+            f"{n} constant(s) totaling {total / 2**20:.1f} MiB baked into "
+            f"the trace (largest: {largest[1]}, "
+            f"{largest[0] / 2**20:.1f} MiB) — closure-captured arrays ride "
+            "every dispatch; pass them as arguments (or accept and pin "
+            "this in the program's contract)"))
+    return report, findings
+
+
+# --------------------------------------------------------- donation findings
+
+def _aliased_outputs(compiled) -> int | None:
+    """Input->output alias pairs in the compiled module's header
+    (``input_output_alias={ {0}: (0, {}, may-alias), ... }``).  This is
+    the aliasing XLA actually committed to — and unlike
+    ``memory_analysis().alias_size_in_bytes`` it survives persistent-
+    compile-cache deserialization, which reports zeroed memory stats."""
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    if not text:
+        return None
+    for line in text.splitlines():
+        if "input_output_alias=" in line:
+            return line.count("-alias)")
+        if line.startswith("HloModule"):
+            # entry module header without the attribute: nothing aliased
+            return 0
+    return 0
+
+
+def donation_report(traced, compiled) -> tuple[dict, list[AuditFinding]]:
+    """Declared donations (trace-level ``args_info``) vs the aliasing the
+    compiled program actually committed to (the HLO module's
+    ``input_output_alias`` attribute, with ``memory_analysis`` aliased
+    bytes as a secondary, cache-permitting signal).  ``None`` fields mean
+    the program was not compiled or the backend hides the module."""
+    import jax
+    import numpy as np
+
+    declared_args = 0
+    declared_bytes = 0
+    if traced is not None:
+        for leaf in jax.tree.leaves(traced.args_info):
+            if getattr(leaf, "donated", False):
+                declared_args += 1
+                shape = getattr(leaf, "shape", ())
+                dtype = getattr(leaf, "dtype", None)
+                if dtype is not None:
+                    declared_bytes += int(
+                        np.prod(shape, dtype=np.int64)
+                    ) * np.dtype(dtype).itemsize
+    aliased = None
+    alias_bytes = None
+    if compiled is not None:
+        aliased = _aliased_outputs(compiled)
+        try:
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                alias_bytes = int(mem.alias_size_in_bytes)
+        except Exception:
+            alias_bytes = None
+    effective = None  # nothing declared, or the module is unreadable
+    if declared_args and aliased is not None:
+        effective = aliased > 0
+    report = {
+        "declared_args": declared_args,
+        "declared_bytes": int(declared_bytes),
+        "aliased_outputs": aliased,
+        "alias_bytes": alias_bytes,
+        "effective": effective,
+    }
+    findings = []
+    if declared_args and aliased == 0:
+        findings.append(AuditFinding(
+            "JA006", "donation",
+            f"{declared_args} argument(s) ({declared_bytes / 2**20:.1f} "
+            "MiB) declared donated but the compiled program aliased "
+            "nothing — donation failed (dtype/layout mismatch between "
+            "the donated input and any output?); peak HBM holds both "
+            "copies"))
+    return report, findings
+
+
+# -------------------------------------------------------------------- driver
+
+def _format_aval(aval) -> str:
+    import numpy as np
+
+    dtype = getattr(aval, "dtype", None)
+    shape = getattr(aval, "shape", None)
+    if dtype is None or shape is None:
+        return str(aval)
+    return f"{np.dtype(dtype).name}{list(shape)}"
+
+
+def audit(fn, args: tuple = (), *, name: str = "program",
+          compile: bool = True,
+          f32_allow: frozenset = DEFAULT_F32_ACCUM_ALLOW,
+          large_const_bytes: int = DEFAULT_LARGE_CONST_BYTES) -> dict:
+    """Audit one jitted callable at ``args`` (concrete arrays or
+    ShapeDtypeStructs — tracing never executes the program).
+
+    ``compile=False`` stops at the jaxpr: collective/dtype/output/const
+    checks only, no HLO inventory, no donation-aliasing or FLOPs fields
+    (trace-only costs well under a second even for the full train step).
+
+    Returns the JSON-able report :mod:`contracts` pins.
+    """
+    import jax
+
+    from ..telemetry.lowering import lower_cached
+
+    prog = lower_cached(fn, *args)
+    traced = prog.traced
+    if traced is None:
+        raise RuntimeError(
+            "this jax version has no AOT fn.trace(); jaxaudit needs the "
+            "ClosedJaxpr of the exact jitted callable")
+    closed = traced.jaxpr
+
+    findings: list[AuditFinding] = []
+    findings += dtype_upcast_findings(closed, allow=f32_allow)
+    findings += output_findings(closed)
+    consts, const_findings = constant_report(
+        closed, large_const_bytes=large_const_bytes)
+    findings += const_findings
+
+    compiled = prog.compiled if compile else None
+    donation, donation_findings = donation_report(traced, compiled)
+    findings += donation_findings
+
+    report = {
+        "program": name,
+        "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "collectives": {
+            "jaxpr": collective_inventory(closed),
+            "hlo": hlo_collective_counts(compiled) if compile else None,
+        },
+        "outputs": [_format_aval(getattr(v, "aval", None))
+                    for v in closed.jaxpr.outvars],
+        "donation": donation,
+        "constants": consts,
+        "flops": None,
+        "bytes_accessed": None,
+        "findings": [dataclasses.asdict(f) for f in findings],
+        "finding_counts": {
+            cls: sum(1 for f in findings if f.cls == cls)
+            for cls in FINDING_CLASSES
+        },
+    }
+    if compile:
+        cost = prog.cost()
+        report["flops"] = cost["flops"]
+        report["bytes_accessed"] = cost["bytes"]
+    return report
+
+
+def audit_many(programs: dict, **kwargs) -> dict:
+    """``{name: (fn, args)} -> {name: report}`` (see :func:`audit`)."""
+    return {nm: audit(fn, args, name=nm, **kwargs)
+            for nm, (fn, args) in programs.items()}
+
+
+def struct_of(tree) -> Any:
+    """ShapeDtypeStruct templates of a pytree of arrays — the safe way to
+    hand a donated state to :func:`audit` (tracing never executes, but a
+    struct can never be consumed either)."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        if hasattr(x, "shape") and hasattr(x, "dtype") else x, tree)

@@ -657,3 +657,57 @@ def debug_leftover(ctx: FileContext) -> Iterable[Finding]:
                     "print() inside a jitted function runs at TRACE time "
                     "only (once, with tracers) — it never sees runtime "
                     "values; delete it or use logging outside jit")
+
+
+# ---------------------------------------------------------------- JL008
+
+_IMPLICIT_ARRAY_CTORS = {"jnp.array", "jnp.asarray",
+                         "jax.numpy.array", "jax.numpy.asarray"}
+
+
+def _is_literalish(node: ast.AST) -> bool:
+    """A value that BUILDS a new constant — list/tuple displays, numeric
+    literals, and arithmetic over them.  ``jnp.asarray(x)`` of an
+    existing array preserves x's dtype (no new f32 constant), so names
+    and calls are out of scope for JL008."""
+    if isinstance(node, ast.Constant):
+        return not isinstance(node.value, str)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_literalish(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literalish(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_literalish(node.left) and _is_literalish(node.right)
+    return False
+
+
+@rule("JL008", "implicit-dtype-array",
+      "jnp.array/asarray of literals without an explicit dtype inside "
+      "jit (silent f32 upcast)")
+def implicit_dtype_array(ctx: FileContext) -> Iterable[Finding]:
+    """The AST-level mirror of jaxaudit's IR dtype-flow check (JA002):
+    inside a traced program, ``jnp.array([...])`` defaults the NEW
+    constant to float32, and the first op mixing it with a bf16 tensor
+    silently promotes that op — and everything downstream — to f32.
+    An explicit ``dtype=`` (second positional argument counts: that IS
+    the dtype parameter) states the precision on the constant itself,
+    where the bf16 path can see it.  Scoped to literal-built values:
+    ``jnp.asarray(x)`` of an existing array preserves its dtype and is
+    not flagged."""
+    for root in ctx.jit.roots:
+        for node in ast.walk(root):
+            if not (isinstance(node, ast.Call)
+                    and dotted_name(node.func) in _IMPLICIT_ARRAY_CTORS):
+                continue
+            if not (node.args and _is_literalish(node.args[0])):
+                continue
+            has_dtype = len(node.args) >= 2 or any(
+                kw.arg == "dtype" for kw in node.keywords)
+            if not has_dtype:
+                name = dotted_name(node.func)
+                yield ctx.finding(
+                    "JL008", node,
+                    f"{name}() of literals without dtype= inside a "
+                    "jitted function creates a float32 (or weakly-typed) "
+                    "constant that silently upcasts bf16 math downstream "
+                    "— pass dtype= explicitly (e.g. x.dtype)")

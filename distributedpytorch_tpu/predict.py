@@ -295,6 +295,13 @@ class Predictor:
                 forward, in_shardings=batch_sharding(mesh),
                 out_shardings=replicated_sharding(mesh))
 
+    @property
+    def forward_jitted(self):
+        """The exact jitted forward this predictor dispatches — the
+        callable the serve audit hooks and jaxaudit contracts trace
+        (``analysis.ir``); one compiled program per batch shape."""
+        return self._forward
+
     @classmethod
     def from_run(cls, run_dir: str, best: bool = True, cfg=None,
                  **kwargs) -> "Predictor":

@@ -284,6 +284,30 @@ class InferenceService:
             "stats": self.metrics.snapshot(),
         }
 
+    def audit_programs(self, buckets=None) -> dict:
+        """``{serve_forward_b<N>: (fn, args)}`` for the EXACT jitted
+        forward at each bucket's compiled shape (mesh padding included,
+        :meth:`_compiled_shape`) — the hook jaxaudit (analysis.ir)
+        traces and the checked-in serve contracts pin.  Args are
+        ShapeDtypeStructs; tracing never dispatches."""
+        import jax
+        import jax.numpy as jnp
+
+        h, w = self.predictor.resolution
+        ch = getattr(self.predictor, "in_channels", 4)
+        fn = self.predictor.forward_jitted
+        return {
+            f"serve_forward_b{b}": (fn, (jax.ShapeDtypeStruct(
+                self._compiled_shape((b, h, w, ch)), jnp.float32),))
+            for b in (buckets if buckets is not None else self.buckets)
+        }
+
+    def audit(self, buckets=None, **kwargs) -> dict:
+        """jaxaudit reports for the bucket forwards (see analysis.ir)."""
+        from ..analysis import ir as ir_lib
+
+        return ir_lib.audit_many(self.audit_programs(buckets), **kwargs)
+
     @property
     def compile_counts(self) -> dict:
         """Forward-compile counts seen by the lifetime watchdog."""

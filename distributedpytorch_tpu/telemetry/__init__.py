@@ -11,13 +11,16 @@ One process-wide surface for "what is this process doing":
   device-kind peak-FLOPs table;
 * :mod:`prometheus` — text exposition for ``GET /metrics``;
 * :mod:`trace`      — on-demand bounded ``jax.profiler`` capture
-  (SIGUSR2 / ``POST /debug/trace``) without restarting the process.
+  (SIGUSR2 / ``POST /debug/trace``) without restarting the process;
+* :mod:`lowering`   — process-wide trace/lower/compile cache shared by
+  the MFU estimator and the IR auditor (``analysis.ir``), so each hot
+  program is lowered exactly once.
 
 Every future perf PR reports into this layer; the train loop, the
 checkpoint manager, the evaluator and the serve front are already wired.
 """
 
-from . import goodput, prometheus, registry, spans, trace
+from . import goodput, lowering, prometheus, registry, spans, trace
 from .goodput import (
     BUCKETS,
     GoodputAccountant,
@@ -25,14 +28,16 @@ from .goodput import (
     mfu_estimate,
     peak_flops_for,
 )
+from .lowering import LoweredProgram, lower_cached
 from .prometheus import render_text
 from .registry import MetricsRegistry, get_registry, is_enabled, set_enabled
 from .spans import current_span, span
 from .trace import TraceCapture
 
 __all__ = [
-    "BUCKETS", "GoodputAccountant", "MetricsRegistry", "TraceCapture",
-    "current_span", "get_accountant", "get_registry", "goodput",
-    "is_enabled", "mfu_estimate", "peak_flops_for", "prometheus",
-    "registry", "render_text", "set_enabled", "span", "spans", "trace",
+    "BUCKETS", "GoodputAccountant", "LoweredProgram", "MetricsRegistry",
+    "TraceCapture", "current_span", "get_accountant", "get_registry",
+    "goodput", "is_enabled", "lower_cached", "lowering", "mfu_estimate",
+    "peak_flops_for", "prometheus", "registry", "render_text",
+    "set_enabled", "span", "spans", "trace",
 ]

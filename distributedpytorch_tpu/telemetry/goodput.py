@@ -118,15 +118,14 @@ def mfu_estimate(flops_per_step: float, step_time_s: float,
 def xla_step_cost(fn, *args) -> dict:
     """XLA's cost model for a jitted callable at ``args`` (concrete arrays
     or ShapeDtypeStructs): ``{"flops", "bytes"}``, None when unavailable.
-    The lower+compile is cache-shared with the already-running program —
-    a re-trace, never a re-compile.  Shared by bench.py's roofline and the
-    trainer's MFU estimator."""
+    Delegates to the process-wide :mod:`telemetry.lowering` cache, so the
+    MFU estimator, bench.py's roofline and the IR auditor (analysis.ir)
+    all lower each program exactly once.  Shared by bench.py's roofline
+    and the trainer's MFU estimator."""
+    from .lowering import lower_cached
+
     try:
-        cost = fn.lower(*args).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-            cost = cost[0]
-        return {"flops": float(cost["flops"]),
-                "bytes": float(cost.get("bytes accessed", 0.0)) or None}
+        return dict(lower_cached(fn, *args).cost())
     except Exception:
         return {"flops": None, "bytes": None}
 

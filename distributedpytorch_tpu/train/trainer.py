@@ -824,6 +824,95 @@ class Trainer:
         if self.is_main:
             self.writer.scalars(scalars, int(self.state.step))
 
+    # ------------------------------------------------------------ IR audit
+    def audit_programs(self, train_batch=None, val_batch=None) -> dict:
+        """``{name: (fn, example_args)}`` for the EXACT jitted programs
+        this trainer dispatches — the hook jaxaudit (analysis.ir) traces.
+        Args are ShapeDtypeStruct templates: tracing never executes, and
+        a struct can never be consumed by the step's donation.
+
+        ``train_batch`` / ``val_batch``: one host batch from the real
+        loaders, for configs whose wire format (uint8_transfer,
+        packbits_masks, coalesce_wire, device_guidance) a config-derived
+        synthesis cannot reproduce; the plain f32 wire synthesizes
+        itself.  Under data.coalesce_wire the WIRE-consuming twins are
+        audited (they are what the loop dispatches): the caller's real
+        batch is packed through the prefetcher's own transform, which
+        derives/validates the wire spec and builds the twins if no batch
+        has yet.  The K-step program (data.steps_per_dispatch) is
+        included when configured."""
+        from ..analysis.ir import struct_of
+
+        cfg = self.cfg
+        h, w = cfg.data.crop_size
+        sds = jax.ShapeDtypeStruct
+        if train_batch is None:
+            if cfg.data.uint8_transfer or cfg.data.packbits_masks \
+                    or cfg.data.coalesce_wire:
+                raise ValueError(
+                    "this config ships a non-f32 wire "
+                    "(uint8_transfer/packbits/coalesce) — pass one real "
+                    "host batch from the train loader as train_batch")
+            train_batch = {
+                "concat": sds((cfg.data.train_batch, h, w,
+                               cfg.model.in_channels), jnp.float32),
+                "crop_gt": sds((cfg.data.train_batch, h, w),
+                               jnp.float32),
+            }
+        if val_batch is None and not (self._val_device_guidance
+                                      or self._val_packbits):
+            # the shape the eval loop actually dispatches: the per-host
+            # val share, padded to the device multiple exactly as
+            # evaluate() does (pad_to_multiple + shard_batch) — NOT the
+            # train batch, which eval never sees
+            n_proc = jax.process_count()
+            n_dev = self.mesh.devices.size
+            vb_host = max(1, -(-cfg.data.val_batch // n_proc))
+            vb = -(-vb_host // n_dev) * n_dev * n_proc
+            val_batch = {
+                "concat": sds((vb, h, w, cfg.model.in_channels),
+                              jnp.float32),
+                "crop_gt": sds((vb, h, w), jnp.float32),
+            }
+        state_s = struct_of(self.state)
+        if cfg.data.coalesce_wire:
+            # the dispatched programs are the wire-consuming twins —
+            # packing the caller's real host batch through the same
+            # transform the prefetcher uses derives (or validates) the
+            # wire spec and builds the twins if the first batch hasn't
+            batch_s = struct_of(self._pack_wire_transform(
+                dict(train_batch)))
+            train_fn, multi_fn = self._wire_step, self._wire_multi_step
+        else:
+            train_fn, multi_fn = self.train_step, self.multi_train_step
+            batch_s = struct_of(dict(train_batch))
+        programs = {"train_step": (train_fn, (state_s, batch_s))}
+        if multi_fn is not None:
+            k = cfg.data.steps_per_dispatch
+            programs["multi_train_step"] = (
+                multi_fn, (state_s,) + (batch_s,) * k)
+        if val_batch is not None:
+            programs["eval_step"] = (self.eval_step,
+                                     (state_s, struct_of(dict(val_batch))))
+        return programs
+
+    def audit(self, check: bool = False, contracts_dir: str | None = None,
+              **batches) -> dict:
+        """Run jaxaudit over :meth:`audit_programs`; returns
+        ``{name: report}``.  With ``check``, each report additionally
+        carries ``contract_drift`` (the drift lines against the
+        checked-in contracts — empty means clean)."""
+        from ..analysis import contracts as contracts_lib
+        from ..analysis import ir as ir_lib
+
+        with self.mesh:
+            reports = ir_lib.audit_many(self.audit_programs(**batches))
+        if check:
+            for rep in reports.values():
+                rep["contract_drift"] = contracts_lib.check_report(
+                    rep, contracts_dir)
+        return reports
+
     def train_epoch(self, epoch: int,
                     guard: PreemptionGuard | None = None,
                     start_batch: int = 0,

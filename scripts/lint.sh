@@ -1,17 +1,32 @@
 #!/usr/bin/env bash
-# jaxlint over everything device-adjacent: the package (serve/ included —
-# the batcher feeds a jitted forward and is exactly the code whose silent
-# retraces the rules exist to catch; telemetry/ included — instrumentation
-# sits at step-loop boundaries and must never smuggle a host sync into
-# them) plus bench.py, the official record.
-# Mirror of the tier-1 gate (tests/test_lint_clean.py); run it before
-# pushing anything that touches device code:
+# The static-analysis gate, both layers in one command:
 #
-#     scripts/lint.sh                # whole surface
-#     scripts/lint.sh --select JL002 # one rule
+#   1. jaxlint — AST-level TPU hazards over everything device-adjacent:
+#      the package (serve/ included — the batcher feeds a jitted forward
+#      and is exactly the code whose silent retraces the rules exist to
+#      catch; telemetry/ included — instrumentation sits at step-loop
+#      boundaries and must never smuggle a host sync into them) plus
+#      bench.py, the official record.
+#   2. jaxaudit check — IR-level compile contracts: the canonical
+#      train/eval/serve programs are re-traced on the pinned 8-device
+#      CPU topology and diffed against tests/contracts/ (collective
+#      counts, output shapes, donation aliasing, baked constants,
+#      FLOPs bounds).  After a REVIEWED program change, regenerate with
+#      `python -m distributedpytorch_tpu.analysis --ir update`.
 #
-# Extra args pass through to the linter CLI (--select/--ignore/paths).
+# Mirror of the tier-1 gates (tests/test_lint_clean.py +
+# tests/test_jaxaudit.py); run it before pushing anything that touches
+# device code:
+#
+#     scripts/lint.sh                # both layers
+#     scripts/lint.sh --select JL002 # one lint rule (skips the IR gate)
+#
+# Extra args pass through to the LINTER CLI (--select/--ignore/paths)
+# and skip the jaxaudit half (a scoped lint run shouldn't pay a trace).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec python -m distributedpytorch_tpu.analysis \
+python -m distributedpytorch_tpu.analysis \
     distributedpytorch_tpu bench.py "$@"
+if [ "$#" -eq 0 ]; then
+    python -m distributedpytorch_tpu.analysis --ir check
+fi

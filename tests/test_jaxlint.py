@@ -496,6 +496,85 @@ class TestDebugJL007:
         assert "JL007" not in codes(found)
 
 
+class TestImplicitDtypeJL008:
+    def test_fires_on_array_and_asarray_without_dtype_in_jit(self):
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                m = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+                v = jnp.asarray([0.5, 0.5])
+                return m @ x + v
+        """)
+        assert codes(found).count("JL008") == 2
+
+    def test_silent_with_dtype_keyword_or_positional(self):
+        # the second positional argument IS the dtype parameter
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                m = jnp.array([[1.0, 0.0]], dtype=x.dtype)
+                v = jnp.asarray([0.5, 0.5], jnp.float32)
+                return m @ x + v
+        """)
+        assert "JL008" not in codes(found)
+
+    def test_silent_outside_jit(self):
+        # host-side construction defaults are numpy's business, not the
+        # compiled program's
+        found = lint("""
+            import jax.numpy as jnp
+
+            def host_table():
+                return jnp.array([1.0, 2.0])
+        """)
+        assert "JL008" not in codes(found)
+
+    def test_silent_on_asarray_of_existing_array(self):
+        # jnp.asarray(x) of an array-valued expression preserves x's
+        # dtype — no new f32 constant, nothing to flag
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x, pair):
+                y = jnp.asarray(x)
+                z = jnp.array(pair[0])
+                return y + z
+        """)
+        assert "JL008" not in codes(found)
+
+    def test_fires_in_function_passed_to_jit_call(self):
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def make_step():
+                def step_fn(x):
+                    return x + jnp.asarray([1.0])
+                return jax.jit(step_fn)
+        """)
+        assert "JL008" in codes(found)
+
+    def test_disable_comment_waives_it(self):
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                m = jnp.array([[1.0]])  # jaxlint: disable=JL008
+                return m + x
+        """)
+        assert "JL008" not in codes(found)
+
+
 class TestSuppressions:
     def test_online_disable_suppresses_that_line_only(self):
         found = lint("""
