@@ -94,6 +94,7 @@ from distributedpytorch_tpu.telemetry.goodput import (  # noqa: E402
     mfu_estimate,
     xla_step_cost,
 )
+from distributedpytorch_tpu.chaos import sites as chaos_sites  # noqa: E402
 from distributedpytorch_tpu.telemetry import get_accountant  # noqa: E402
 
 
@@ -399,6 +400,10 @@ def serve_bench() -> None:
     record["goodput_breakdown"] = {
         k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
     record["mfu"] = None
+    # chaos field: the armed fault-injection scenario's name, null when
+    # none is armed — key ALWAYS present (schema stability), so record
+    # consumers can tell a clean number from a chaos-conditioned one
+    record["chaos"] = chaos_sites.active_scenario()
     # IR-audit fields: the top bucket's forward (the program serving the
     # measured burst), same schema as the train record.  Config-named —
     # never the canonical serve_forward_b<N> names, whose contracts pin
@@ -419,6 +424,12 @@ def serve_bench() -> None:
 
 
 def main() -> None:
+    # chaos: a DPTPU_CHAOS_PLAN env plan arms for the bench too, so the
+    # record's `chaos` field names the scenario that conditioned the
+    # number.  Inside main(), not at module scope — importers (tests,
+    # capture replay) must never arm a fault plan as an import side
+    # effect (the same rule as the __main__-gated argv read above).
+    chaos_sites.maybe_arm_from_env()
     if _CLI_ARGS.serve:
         serve_bench()
         return
@@ -559,6 +570,9 @@ def main() -> None:
     record["goodput"] = round(goodput_rep["goodput"], 4)
     record["goodput_breakdown"] = {
         k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
+    # chaos field: armed fault-plan name or null; key always present
+    # (the PR 4 schema-stability convention)
+    record["chaos"] = chaos_sites.active_scenario()
     # IR-audit fields (jaxaudit): collective inventory of the exact
     # compiled step + compile-contract status; keys always present
     record.update(audit_fields)

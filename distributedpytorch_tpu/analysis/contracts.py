@@ -299,16 +299,9 @@ def _pin_cpu_topology() -> None:
     gate sees the same programs everywhere.  A no-op when jax is already
     imported (in-process callers own their topology) or when the caller
     pinned another platform (``JAX_PLATFORMS=tpu jaxaudit update``)."""
-    if "jax" in sys.modules:
-        return
-    plat = os.environ.get("JAX_PLATFORMS", "")
-    if plat and plat != "cpu":
-        return
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
+    from ..backend_health import pin_cpu8_topology
+
+    pin_cpu8_topology()
 
 
 def run_cli(argv: list[str] | None = None, programs: dict | None = None

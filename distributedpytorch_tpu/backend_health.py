@@ -19,6 +19,29 @@ import sys
 import time
 
 
+def pin_cpu8_topology(env: dict | None = None) -> dict:
+    """Pin the canonical 8-device CPU topology (tests/conftest.py's) into
+    ``env`` (default ``os.environ``) BEFORE jax initializes — the one
+    owner of the rule standalone CLIs (jaxaudit, dptpu-chaos) and chaos
+    child processes share.  A no-op when jax is already imported (the
+    process owns its topology) or when the caller pinned another
+    platform (``JAX_PLATFORMS=tpu jaxaudit update``).  Returns ``env``.
+    """
+    if env is None:
+        if "jax" in sys.modules:
+            return os.environ
+        env = os.environ
+    plat = env.get("JAX_PLATFORMS", "")
+    if plat and plat != "cpu":
+        return env
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    return env
+
+
 def pin_requested_platform() -> None:
     """Re-pin an env-requested platform via jax.config, AFTER importing jax.
 
@@ -100,29 +123,32 @@ def device_op_alive(timeout_s: float = 5.0) -> tuple[bool, str]:
 
     Returns ``(alive, reason)``; reason is empty when alive.
     """
-    import threading
+    from .chaos.policies import PolicyTimeoutError, Timeout
 
-    out: dict = {}
+    def run() -> float:
+        import jax
 
-    def run() -> None:
-        try:
-            import jax
+        # tiny but real: touches dispatch, device math, and D2H
+        return float(jax.device_get(
+            jax.numpy.ones(()) + jax.numpy.ones(())))
 
-            # tiny but real: touches dispatch, device math, and D2H
-            out["value"] = float(jax.device_get(
-                jax.numpy.ones(()) + jax.numpy.ones(())))
-        except Exception as e:  # noqa: BLE001 — any failure means dead
-            out["error"] = f"{type(e).__name__}: {e}"
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
+    try:
+        # daemon-thread timeout (chaos/policies): a wedged runtime yields
+        # (False, reason) and the stuck worker is abandoned, exactly the
+        # hand-rolled semantics this helper had before the consolidation
+        value = Timeout(timeout_s).call(run)
+    except PolicyTimeoutError:
         return False, f"device op exceeded {timeout_s}s"
-    if "error" in out:
-        return False, out["error"]
-    if out.get("value") != 2.0:
-        return False, f"device op returned {out.get('value')!r}, not 2.0"
+    except KeyboardInterrupt:
+        # Ctrl-C lands in the CALLER's frame (Timeout's join), not the
+        # probe — the user is aborting the process, not the backend dying
+        raise
+    except BaseException as e:  # noqa: BLE001 — ANY probe failure means
+        # dead: Timeout.call re-raises even SystemExit from a plugin's
+        # init, and a probe must report (False, why), never crash serving
+        return False, f"{type(e).__name__}: {e}"
+    if value != 2.0:
+        return False, f"device op returned {value!r}, not 2.0"
     return True, ""
 
 
@@ -185,23 +211,34 @@ def ensure_backend_or_cpu_fallback(
         # None and NaN both mean the default (a NaN window would make the
         # deadline comparison below always-false and the poll infinite)
         recovery_minutes = 2.0
-    deadline = time.time() + recovery_minutes * 60
-    attempt = 0
-    while True:
-        attempt += 1
-        ok, why = accelerator_healthy()
-        if ok:
-            return True
-        remaining = deadline - time.time()
-        print(f"backend probe: unhealthy ({why}), attempt {attempt}, "
-              f"{max(0, remaining) / 60:.1f} min of recovery window left",
-              file=sys.stderr)
-        if remaining <= 0:
-            break
-        # exponent clamped so an unbounded poll can't overflow float math
-        backoff = min(backoff_cap,
-                      backoff_base * (2 ** min(attempt - 1, 30)))
-        time.sleep(min(backoff, max(1.0, remaining)))
+
+    # The poll is chaos/policies.Retry in poll mode (until=healthy): same
+    # cadence as the hand-rolled loop it replaced — exponential backoff
+    # from base to cap, each nap floored at 1 s and capped by the
+    # remaining window, budget exhaustion returning the last (unhealthy)
+    # answer rather than raising.  clock/sleep are passed from the time
+    # module HERE so the bench-record tests' time patches keep driving
+    # the cadence they pin.
+    from .chaos.policies import Retry
+
+    def on_attempt(attempt, outcome, remaining):
+        print(f"backend probe: unhealthy ({outcome[1]}), "
+              f"attempt {attempt}, {max(0, remaining) / 60:.1f} min of "
+              "recovery window left", file=sys.stderr)
+
+    # retry_on=(): an exception FROM the probe propagates immediately,
+    # exactly as the hand-rolled loop behaved (the probe child already
+    # contains backend failures; an exception here is the poller itself
+    # breaking, which the CPU fallback must not paper over) — and
+    # on_attempt can therefore assume a (healthy, why) tuple outcome
+    ok, _why = Retry(
+        base_s=backoff_base, cap_s=backoff_cap,
+        deadline_s=recovery_minutes * 60, min_sleep_s=1.0,
+        clock=time.time, sleep=time.sleep,
+    ).call(lambda: accelerator_healthy(), retry_on=(),
+           until=lambda r: r[0], on_attempt=on_attempt)
+    if ok:
+        return True
     print("backend probe: falling back to CPU", file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
     return False

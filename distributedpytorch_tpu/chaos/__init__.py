@@ -1,0 +1,64 @@
+"""chaos — deterministic fault injection + unified failure policies.
+
+The framework already HANDLES failures (PreemptionGuard's graceful stop,
+serve load-shedding, the trainer's non-finite-loss detection, checkpoint
+restore fallback); this package is what PROVOKES them on demand, so the
+recovery paths are exercised by asserted scenarios instead of waiting
+for production to test them:
+
+* :mod:`sites`    — named injection sites woven into the real seams,
+  armed process-wide (one attribute check when disabled);
+* :mod:`faults`   — seeded, deterministic fault plans (latency, raised
+  errors, NaN payload poisoning, SIGTERM delivery, checkpoint
+  truncation), every firing booked as
+  ``chaos_injected_total{site,kind}``;
+* :mod:`policies` — the one Retry/backoff-with-jitter, Timeout and
+  CircuitBreaker (stdlib-only; adopted by ``backend_health``, the serve
+  client and the Comet writer);
+* :mod:`runner`   — JSON scenarios that run a short fit or serve burst
+  under a named plan and ASSERT the recovery invariants
+  (``dptpu-chaos`` / ``python -m distributedpytorch_tpu.chaos``).
+
+Import-light on purpose: importing this package touches neither jax nor
+the telemetry stack (``backend_health`` pulls :mod:`policies` before the
+platform is pinned).
+"""
+
+from . import faults, policies, sites
+from .faults import FaultPlan, FaultSpec, InjectedFaultError
+from .policies import (
+    CircuitBreaker,
+    CircuitOpenError,
+    PolicyTimeoutError,
+    Retry,
+    RetryBudgetExceededError,
+    Timeout,
+)
+from .sites import (
+    active_scenario,
+    arm,
+    armed,
+    armed_plan,
+    disarm,
+    fire,
+    inject,
+    maybe_arm_from_env,
+)
+
+__all__ = [
+    "CircuitBreaker", "CircuitOpenError", "FaultPlan", "FaultSpec",
+    "InjectedFaultError", "PolicyTimeoutError", "Retry",
+    "RetryBudgetExceededError", "Timeout", "active_scenario", "arm",
+    "armed", "armed_plan", "disarm", "faults", "fire", "inject",
+    "maybe_arm_from_env", "policies", "runner", "sites",
+]
+
+
+def __getattr__(name):  # lazy: runner pulls the train stack
+    if name == "runner":
+        import importlib
+
+        # importlib, not `from . import`: the from-import consults this
+        # very __getattr__ before importing and would recurse
+        return importlib.import_module(".runner", __name__)
+    raise AttributeError(name)

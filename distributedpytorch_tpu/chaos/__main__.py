@@ -1,0 +1,77 @@
+"""``dptpu-chaos`` / ``python -m distributedpytorch_tpu.chaos``.
+
+Run a chaos scenario (builtin name or a JSON file) and assert its
+invariants::
+
+    dptpu-chaos preempt_mid_epoch            # SIGTERM -> resume, exact
+    dptpu-chaos truncated_checkpoint         # torn file -> fallback
+    dptpu-chaos serve_latency_shed           # saturation -> 429/504
+    dptpu-chaos nan_loss                     # poisoned loss -> logged
+    dptpu-chaos my_scenario.json
+    dptpu-chaos --list
+    dptpu-chaos --plan preempt_mid_epoch     # print the plan JSON (for
+                                             # DPTPU_CHAOS_PLAN arming)
+
+Exit 0 when every invariant holds, 1 otherwise; the full report prints
+as the FINAL JSON object on stdout either way (an in-process fit's own
+warnings — e.g. the non-finite-loss sweep — may precede it).  Like the jaxaudit CLI, a standalone run
+pins the canonical 8-device CPU topology (tests/conftest.py's) before
+jax initializes so scenarios are deterministic anywhere; export
+``JAX_PLATFORMS`` to target real hardware instead.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="dptpu-chaos",
+        description="deterministic fault-injection scenarios "
+                    "(see docs/DESIGN.md 'Fault injection & failure "
+                    "policies')")
+    parser.add_argument("scenario", nargs="?",
+                        help="builtin scenario name or a JSON file")
+    parser.add_argument("--list", action="store_true",
+                        help="list builtin scenarios")
+    parser.add_argument("--plan", action="store_true",
+                        help="print the scenario's fault plan JSON "
+                             "(usable as DPTPU_CHAOS_PLAN) and exit")
+    parser.add_argument("--work-dir", default=None,
+                        help="keep scenario artifacts here instead of a "
+                             "throwaway temp dir")
+    parser.add_argument("--child", metavar="SPEC",
+                        help=argparse.SUPPRESS)  # internal phase runner
+    args = parser.parse_args(argv)
+
+    from ..backend_health import pin_cpu8_topology
+
+    pin_cpu8_topology()
+    from . import runner
+
+    if args.child:
+        return runner.child_fit(args.child)
+    if args.list:
+        for name, sc in runner.SCENARIOS.items():
+            first = (sc.get("invariants") or [""])[0]
+            print(f"{name:22s} mode={sc['mode']:10s} asserts {first}, ...")
+        return 0
+    if not args.scenario:
+        parser.error("a scenario name/file is required (or --list)")
+    sc = runner.load_scenario(args.scenario)
+    if args.plan:
+        plan = dict(sc.get("plan") or {})
+        plan.setdefault("name", sc["name"])
+        print(json.dumps(plan))
+        return 0
+    report = runner.run_scenario(sc, work_dir=args.work_dir)
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..chaos import sites as chaos_sites
+
 #: canonical axis names, in mesh order
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -247,6 +249,9 @@ def prefetch_to_device(batches, mesh: Mesh, size: int = 2,
             batch = transform(batch)
         if keys is not None:
             batch = {k: v for k, v in batch.items() if k in keys}
+        # chaos seam: latency here is a slow H2D pipe, raised errors are
+        # a dying transfer, poisoning tears the host batch pre-placement
+        batch = chaos_sites.fire("device/put", payload=batch)
         return shard_batch(mesh, batch)
 
     if size <= 0:  # synchronous degradation

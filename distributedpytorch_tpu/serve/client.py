@@ -86,6 +86,15 @@ class HealthCache:
             return self._result
 
 
+def _policies():
+    """Deferred chaos.policies import (module-level would be fine — it is
+    stdlib-only — but the client is also vendored into minimal consumer
+    snippets, so keep its import surface lean)."""
+    from ..chaos import policies
+
+    return policies
+
+
 #: HTTP status -> the in-process exception it round-trips to
 _STATUS_ERRORS = {
     429: QueueFullError,
@@ -104,7 +113,8 @@ class ServeClient:
     """
 
     def __init__(self, target: InferenceService | str,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0, shed_retries: int = 0,
+                 retry_seed: int | None = None):
         if isinstance(target, str):
             self._url = target.rstrip("/")
             self._service = None
@@ -113,11 +123,34 @@ class ServeClient:
             self._service = target
         self.timeout_s = timeout_s
         self._health_cache = HealthCache()
+        #: ``shed_retries > 0``: QueueFullError (HTTP 429) is retried
+        #: that many extra times with jittered backoff — the
+        #: "retry with backoff" the shed message advises, implemented
+        #: once (chaos/policies.Retry) instead of by every caller.
+        #: Jitter matters here specifically: N shed clients retrying in
+        #: lockstep re-arrive as the same thundering herd that got shed.
+        self._retry = None if shed_retries < 1 else _policies().Retry(
+            base_s=0.05, cap_s=2.0, jitter=0.5, attempts=shed_retries + 1,
+            seed=retry_seed)
 
     def predict(self, image: np.ndarray, points: Any,
                 deadline_s: float | None = None) -> np.ndarray:
         """Segment one object; blocks until the mask (or the shed/deadline
         error) comes back.  ``deadline_s`` rides to the server's batcher."""
+        if self._retry is not None:
+            try:
+                return self._retry.call(
+                    lambda: self._predict_once(image, points, deadline_s),
+                    retry_on=(QueueFullError,))
+            except _policies().RetryBudgetExceededError as e:
+                # budget spent: surface the ORIGINAL taxonomy (the last
+                # QueueFullError), not the policy wrapper — callers match
+                # on the shed/deadline exception types
+                raise e.__cause__ from None
+        return self._predict_once(image, points, deadline_s)
+
+    def _predict_once(self, image: np.ndarray, points: Any,
+                      deadline_s: float | None = None) -> np.ndarray:
         if self._service is not None:
             return self._service.predict(image, points,
                                          deadline_s=deadline_s,

@@ -55,6 +55,7 @@ from typing import Any
 
 import numpy as np
 
+from ..chaos import sites as chaos_sites
 from ..telemetry.trace import TraceCapture
 from ..utils.compile_watchdog import CompileWatchdog
 from . import batching
@@ -159,6 +160,9 @@ class InferenceService:
         deterministic multi-request batch is composed in tests."""
         if self._state != "new":
             raise RuntimeError(f"cannot start a {self._state} service")
+        # chaos: arm an env-named fault plan (DPTPU_CHAOS_PLAN) for this
+        # service's lifetime; one getenv when unset
+        chaos_sites.maybe_arm_from_env()
         self._state = "running"
         self._worker = threading.Thread(target=self._run, name="serve-batcher",
                                         daemon=True)
@@ -213,6 +217,10 @@ class InferenceService:
             raise ServiceUnhealthyError("service stopped")
         if self._unhealthy and self.strict_retrace:
             raise ServiceUnhealthyError(self._unhealthy)
+        # chaos seam, on the CALLER's thread: latency is a slow host
+        # preprocess (builds queue pressure), an error is a front-door
+        # dependency failing — both before anything is queued
+        chaos_sites.fire("serve/enqueue")
         if self._queue.full():
             # fast-path shed BEFORE the (expensive) host preprocessing:
             # under overload a rejection must not cost nearly as much host
@@ -365,6 +373,23 @@ class InferenceService:
         return batch
 
     def _process(self, batch: list[_Request]) -> None:
+        # chaos seam, on the WORKER thread before the deadline check:
+        # injected latency stalls the whole drain exactly like a slow
+        # device — queued deadlines expire (504 shed) and the bounded
+        # queue backs up (429 shed), which is the degradation the
+        # serve-latency scenario asserts instead of a crash.  A raised
+        # fault fails THIS batch and the worker serves on (the same
+        # fail-the-batch contract the forward's except clause keeps).
+        try:
+            chaos_sites.fire("serve/drain", batch_size=len(batch))
+        except Exception as e:
+            failed = 0
+            for req in batch:
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(e)
+                    failed += 1
+            self.metrics.count("failed", failed)
+            return
         now = time.perf_counter()
         live: list[_Request] = []
         for req in batch:

@@ -20,14 +20,38 @@ participates in the barrier; Orbax writes each shard once).
 from __future__ import annotations
 
 import glob
+import json
 import os
 import re
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
+from ..chaos import sites as chaos_sites
 from ..parallel import TrainState
 from ..telemetry import get_accountant, span
+
+
+def atomic_write_json(path: str, obj) -> None:
+    """Write ``obj`` as JSON such that ``path`` is either the old content
+    or the complete new content — never a torn intermediate: temp file in
+    the same directory, flush+fsync, ``os.replace``, then fsync the
+    directory so the rename itself survives a crash.  The write-side half
+    of the torn-checkpoint story (the read side is
+    :meth:`CheckpointManager.restore`'s fallback)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dir_fd = os.open(d, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
 
 
 def next_run_index(work_dir: str) -> int:
@@ -86,6 +110,40 @@ class CheckpointManager:
             max_to_keep=1, enable_async_checkpointing=async_save)
         self._best = ocp.CheckpointManager(
             os.path.join(self.directory, "best"), options=best_options)
+        self._async_save = async_save
+        #: steps :meth:`restore` skipped as unreadable (torn files) on the
+        #: way to the one it returned — the chaos runner's invariant hook
+        self.last_restore_fallback: list[int] = []
+
+    #: commit ledger sidecar (written via :func:`atomic_write_json`):
+    #: records which steps had fully LANDED saves, so a restore failure
+    #: can say "torn after commit" vs "save never finished"
+    _LEDGER = "COMMITTED.json"
+
+    def _write_ledger(self) -> None:
+        """Refresh the commit ledger from the managers' landed steps.
+        Called after sync saves and at :meth:`wait` (async saves are only
+        committed once their background write finishes).  Process 0 only:
+        multi-host training shares ONE checkpoint directory, and N
+        processes racing the same tmp-and-replace would tear the very
+        ledger that exists to diagnose torn writes."""
+        if jax.process_index() != 0:
+            return
+        atomic_write_json(
+            os.path.join(self.directory, self._LEDGER),
+            {"latest": sorted(int(s) for s in self._mgr.all_steps()),
+             "best": sorted(int(s) for s in self._best.all_steps())})
+
+    def committed_steps(self, best: bool = False) -> set[int]:
+        """Steps the ledger records as fully landed in the requested
+        slot (empty when the ledger predates this manager or was never
+        written)."""
+        try:
+            with open(os.path.join(self.directory, self._LEDGER)) as f:
+                return set(json.load(f).get(
+                    "best" if best else "latest", ()))
+        except (OSError, ValueError):
+            return set()
 
     def save(self, step: int, state: TrainState, metric: float | None = None,
              extra: dict | None = None) -> bool:
@@ -111,28 +169,82 @@ class CheckpointManager:
             self._mgr.save(step, args=ocp.args.Composite(**payload))
             if is_best:
                 self._best.save(step, args=ocp.args.Composite(**payload))
+            if not self._async_save:
+                # sync saves have landed; async ones commit at wait()
+                self._write_ledger()
+        # chaos seam: the truncation fault tears this step's files (the
+        # torn-write / post-commit-corruption scenario the restore
+        # fallback exists for).  Sync saves only — an async save's step
+        # dir is still a tmp name here, so firing would raise (no file
+        # under the final path) or tear a file mid-write, neither of
+        # which is the documented scenario.
+        if not self._async_save:
+            chaos_sites.fire("checkpoint/save", step=int(step),
+                             path=os.path.join(self.directory, "latest",
+                                               str(int(step))))
         return is_best
 
     def restore(self, state: TrainState, step: int | None = None,
                 best: bool = False) -> tuple[TrainState, dict]:
         """Restore ``(state, meta)``; ``state`` is the abstract target whose
         shapes/shardings the restored arrays adopt (so a checkpoint written on
-        one mesh restores onto another — the multi-host resume path)."""
+        one mesh restores onto another — the multi-host resume path).
+
+        Torn-file fallback: with no pinned ``step``, an unreadable newest
+        checkpoint (truncated array file, interrupted write, post-commit
+        corruption) is SKIPPED — loudly — and the next older step is
+        tried, so one torn file costs an epoch of progress instead of the
+        whole run.  The skipped steps land in ``last_restore_fallback``.
+        A caller-pinned ``step`` never falls back (they asked for that
+        exact checkpoint)."""
         mgr = self._best if best else self._mgr
-        if step is None:
-            step = mgr.latest_step()
-        if step is None:
+        pinned = step is not None
+        candidates = [step] if pinned else \
+            sorted((int(s) for s in mgr.all_steps()), reverse=True)
+        if not candidates:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        self.last_restore_fallback = []
+        committed = None
+        restored = None
         with get_accountant().account("checkpoint"), \
-                span("checkpoint/restore"):
-            restored = mgr.restore(
-                step,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(state),
-                    meta=ocp.args.JsonRestore(),
-                ),
-            )
-        return restored["state"], restored["meta"]
+                span("checkpoint/restore"), \
+                chaos_sites.inject("checkpoint/restore"):
+            for i, s in enumerate(candidates):
+                try:
+                    restored = mgr.restore(
+                        s,
+                        args=ocp.args.Composite(
+                            state=ocp.args.StandardRestore(state),
+                            meta=ocp.args.JsonRestore(),
+                        ),
+                    )
+                    break
+                except Exception as e:
+                    if pinned or i == len(candidates) - 1:
+                        raise
+                    if committed is None:
+                        committed = self.committed_steps(best=best)
+                    diagnosis = ("torn after commit" if s in committed
+                                 else "save may not have finished")
+                    print(f"warning: checkpoint step {s} is unreadable "
+                          f"({type(e).__name__}: {e}; {diagnosis}) — "
+                          f"falling back to step {candidates[i + 1]}",
+                          flush=True)
+                    self.last_restore_fallback.append(int(s))
+            # DONATION SAFETY: re-buffer every restored array.  The train
+            # step donates its state argument, and donating
+            # Orbax-restored buffers corrupts the heap on XLA CPU
+            # (deterministic segfault at the first resumed dispatch — the
+            # crash that forced tests/test_preemption.py's subprocess
+            # isolation).  One copy pass (~ms, transiently 2x state in
+            # memory) buys donation-safe, framework-owned buffers on
+            # every backend.  OUTSIDE the fallback try/except above: a
+            # copy failure (OOM, non-addressable multi-host array) is its
+            # own error and must never masquerade as a torn checkpoint.
+            fresh = jax.tree.map(
+                lambda x: jnp.copy(x) if isinstance(x, jax.Array)
+                else x, restored["state"])
+            return fresh, restored["meta"]
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -142,6 +254,8 @@ class CheckpointManager:
         with get_accountant().account("checkpoint"), span("checkpoint/wait"):
             self._mgr.wait_until_finished()
             self._best.wait_until_finished()
+            if self._async_save:
+                self._write_ledger()
 
     def close(self) -> None:
         self.wait()

@@ -173,11 +173,16 @@ class CometWriter(MetricWriter):
     def __init__(self, project: str | None = None,
                  workspace: str | None = None,
                  experiment_name: str | None = None):
+        from ..chaos.policies import CircuitBreaker
+
         self._exp = None
-        #: consecutive _guarded failures so far (reset on any success);
-        #: initialized here, not lazily via getattr — the counter is part
-        #: of the writer's state contract, not an accident of first error
-        self._fails = 0
+        #: the framework's one consecutive-failure breaker
+        #: (chaos/policies): _MAX_FAILS failures in a row open it, any
+        #: success resets; no half-open — on open the SDK handle is
+        #: dropped, so the writer is permanently (and quietly) done.
+        #: Constructed here, not lazily — the counter is part of the
+        #: writer's state contract, not an accident of first error.
+        self._breaker = CircuitBreaker(failure_threshold=self._MAX_FAILS)
         try:
             from comet_ml import Experiment
             if not os.environ.get("COMET_API_KEY"):
@@ -196,21 +201,25 @@ class CometWriter(MetricWriter):
     #: consecutive runtime failures tolerated before giving up on the SDK
     _MAX_FAILS = 5
 
+    @property
+    def _fails(self) -> int:
+        """Consecutive failures so far — kept as the writer's documented
+        state surface; the count now lives in the shared breaker."""
+        return self._breaker.failures
+
     def _guarded(self, call) -> None:
         """A live-experiment SDK/network error must degrade, not abort the
         training run (the 'never kills a run' contract of __init__).
         Transient blips are survived; only _MAX_FAILS consecutive errors
-        disable the writer (a permanently dead uplink should not print
-        per-step tracebacks forever)."""
+        open the breaker and disable the writer (a permanently dead
+        uplink should not print per-step tracebacks forever)."""
         try:
-            call()
-            self._fails = 0
+            self._breaker.call(call)
         except Exception as e:
-            self._fails += 1
-            if self._fails >= self._MAX_FAILS:
+            if self._breaker.is_open:
                 print(f"CometWriter error (disabled after "
-                      f"{self._fails} consecutive failures): {e}",
-                      flush=True)
+                      f"{self._breaker.failures} consecutive failures): "
+                      f"{e}", flush=True)
                 self._exp = None
             else:
                 print(f"CometWriter error (will retry): {e}", flush=True)

@@ -40,6 +40,7 @@ from ..data import (
     build_train_transform,
     make_fake_voc,
 )
+from ..chaos import sites as chaos_sites
 from ..models import build_model
 from ..parallel import (
     DEVICE_KEYS,
@@ -557,6 +558,9 @@ class Trainer:
             async_save=cfg.checkpoint.async_save)
         self.start_epoch = 0
         self._resume_start_batch = 0  # exact mid-epoch resume offset
+        #: steps the resume restore SKIPPED as unreadable (torn files) on
+        #: the way to the one it used — surfaced for ops/chaos assertions
+        self.resume_fallback_steps: list[int] = []
         if cfg.checkpoint.warm_start:
             self._warm_start(cfg.checkpoint.warm_start,
                              cfg.checkpoint.warm_start_partial)
@@ -695,6 +699,7 @@ class Trainer:
             os.path.abspath(os.path.join(self.run_dir, "checkpoints")) \
             else self.ckpt
         self.state, meta = mgr.restore(self.state)
+        self.resume_fallback_steps = list(mgr.last_restore_fallback)
         self.start_epoch = int(meta.get("epoch", 0)) + 1
         self.ckpt.best_metric = float(
             meta.get("best_metric", self.ckpt.best_metric))
@@ -967,6 +972,10 @@ class Trainer:
                         b = next(it)
                     except StopIteration:
                         return
+                    # chaos seam: injected latency here IS input stall
+                    # (books under input_wait); payload poisoning tears
+                    # the batch the step is about to consume
+                    b = chaos_sites.fire("trainer/batch_fetch", payload=b)
                 yield b
 
         def dispatches(placed):
@@ -996,7 +1005,12 @@ class Trainer:
                         self._note_step_cost(fn, (self.state, *args), n)
                 else:
                     self._prod_steps += n
-                return out
+                # chaos seam, between dispatches: sigterm here is a
+                # preemption landing mid-epoch (through the real guard),
+                # nan poisons the LOSS the loop observes (the divergence-
+                # detection driver — the state itself trained on real
+                # data and stays finite)
+                return chaos_sites.fire("trainer/train_step", payload=out)
 
             def one_step(b):
                 if cfg.data.coalesce_wire:
@@ -1363,6 +1377,9 @@ class Trainer:
         # so telemetry=false is the true zero-instrumentation baseline.
         telemetry_set_enabled(cfg.telemetry)
         get_accountant().reset(enabled=cfg.telemetry)
+        # chaos: arm an env-named fault plan (DPTPU_CHAOS_PLAN) for this
+        # fit; with the env unset and nothing armed this is one getenv.
+        chaos_sites.maybe_arm_from_env()
         self._prod_steps = 0
         with contextlib.ExitStack() as stack:
             if self._trace is not None:

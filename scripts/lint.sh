@@ -5,7 +5,9 @@
 #      the package (serve/ included — the batcher feeds a jitted forward
 #      and is exactly the code whose silent retraces the rules exist to
 #      catch; telemetry/ included — instrumentation sits at step-loop
-#      boundaries and must never smuggle a host sync into them) plus
+#      boundaries and must never smuggle a host sync into them; chaos/
+#      included — its injection sites are woven INTO those loops and the
+#      disabled path must stay one attribute check, no host syncs) plus
 #      bench.py, the official record.
 #   2. jaxaudit check — IR-level compile contracts: the canonical
 #      train/eval/serve programs are re-traced on the pinned 8-device
