@@ -60,6 +60,13 @@ _parser.add_argument(
     help="bench the serve/ inference service instead of the train step: "
          "synthetic client load against the micro-batcher, reporting "
          "requests/sec + p50/p99 latency in the standard record schema")
+_parser.add_argument(
+    "--sessions", action="store_true",
+    help="with --serve: bench the interactive click loop through "
+         "serve/sessions — 1 cold click + N warm clicks per session "
+         "against a split (guidance_inject='head') predictor, reporting "
+         "warm/cold latency and the cache counters in a `sessions` "
+         "record block")
 # this module is also imported (by tests and capture replay): only read
 # argv when bench.py IS the program, so a host process keeps its own
 # -h/--help and flags
@@ -297,6 +304,35 @@ SERVE_CLIENTS = 8
 SERVE_REQUESTS = 128 if ON_TPU else 64
 SERVE_MAX_BATCH = 8
 
+#: --serve --sessions click-loop shape: concurrent interactive sessions,
+#: each 1 cold click (encode+decode) + N warm refinement clicks (decode
+#: only) — the DEXTR refinement workload, measured
+SESSIONS_N = 16 if ON_TPU else 8
+SESSION_WARM_CLICKS = 8 if ON_TPU else 6
+
+
+def _sessions_block(store_snapshot: dict | None,
+                    swaps: dict | None,
+                    warm_ms: list | None = None,
+                    cold_ms: list | None = None) -> dict | None:
+    """The record's `sessions` block — keys ALWAYS present (the PR 4/5
+    schema-stability convention), the whole block null outside session
+    mode."""
+    if store_snapshot is None:
+        return None
+    from distributedpytorch_tpu.utils.profiling import percentile
+
+    warm_p50 = (round(percentile(warm_ms, 50.0), 3) if warm_ms else None)
+    cold_p50 = (round(percentile(cold_ms, 50.0), 3) if cold_ms else None)
+    return {
+        "warm_p50_ms": warm_p50,
+        "cold_p50_ms": cold_p50,
+        "warm_cold_ratio": (round(warm_p50 / cold_p50, 4)
+                            if warm_p50 and cold_p50 else None),
+        "evictions": sum((store_snapshot.get("evictions") or {}).values()),
+        "swaps": sum((swaps or {}).values()),
+    }
+
 
 def serve_bench() -> None:
     """Synthetic client load against serve.InferenceService.
@@ -404,6 +440,8 @@ def serve_bench() -> None:
     # none is armed — key ALWAYS present (schema stability), so record
     # consumers can tell a clean number from a chaos-conditioned one
     record["chaos"] = chaos_sites.active_scenario()
+    # sessions block: null outside --sessions mode, key always present
+    record["sessions"] = _sessions_block(None, None)
     # IR-audit fields: the top bucket's forward (the program serving the
     # measured burst), same schema as the train record.  Config-named —
     # never the canonical serve_forward_b<N> names, whose contracts pin
@@ -423,6 +461,138 @@ def serve_bench() -> None:
     print(json.dumps(record))
 
 
+def serve_sessions_bench() -> None:
+    """The interactive click loop through serve/sessions, measured.
+
+    SESSIONS_N concurrent sessions each place 1 cold click (encode +
+    decode + feature-cache install) and SESSION_WARM_CLICKS refinement
+    clicks (decode against the cached on-device features).  The headline
+    is the warm/cold latency ratio — the fraction of a full forward an
+    interactive refinement actually costs (acceptance: <= 0.5 on the
+    CPU smoke, tracking the decode/(encode+decode) contract FLOPs
+    split).  Buckets are warmed off the clock, as in the burst bench.
+    """
+    import threading
+
+    from distributedpytorch_tpu.models import build_model
+    from distributedpytorch_tpu.parallel import create_train_state
+    from distributedpytorch_tpu.predict import Predictor
+    from distributedpytorch_tpu.serve import InferenceService
+
+    model = build_model("danet", nclass=1, backbone=BACKBONE,
+                        output_stride=8, dtype=DTYPE,
+                        guidance_inject="head")
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(1e-3), (1, SIZE, SIZE, 4))
+    predictor = Predictor(model, state.params, state.batch_stats,
+                          resolution=(SIZE, SIZE), relax=50)
+    r = np.random.RandomState(0)
+    image = r.randint(0, 256, (SIZE, SIZE, 3)).astype(np.uint8)
+    quarter, mid = SIZE // 4, SIZE // 2
+    base_pts = np.array([[quarter, mid], [SIZE - quarter, mid],
+                         [mid, quarter], [mid, SIZE - quarter]],
+                        np.float64)
+
+    svc = InferenceService(predictor, max_batch=SERVE_MAX_BATCH,
+                           queue_depth=4 * SESSIONS_N, max_wait_s=0.002)
+    acct = get_accountant()
+    acct.reset()
+    with acct.account("compile"):
+        svc.warmup()
+    cold_ms: list[float] = []
+    warm_ms: list[float] = []
+    lock = threading.Lock()
+    errors: list[Exception] = []
+    served = [0]   # clicks actually answered with a mask — an errored
+    #                cold click aborts its session's whole loop, so the
+    #                headline must count answers, not scheduled clicks
+
+    def session_loop(k: int) -> None:
+        sid = f"bench-{k}"
+        try:
+            t0 = time.perf_counter()
+            svc.predict(image, base_pts + (k % 8), timeout=600,
+                        session_id=sid)
+            cold = (time.perf_counter() - t0) * 1e3
+            with lock:
+                served[0] += 1
+            warms = []
+            for c in range(SESSION_WARM_CLICKS):
+                t0 = time.perf_counter()
+                svc.predict(image, base_pts + (k % 8) + (c % 3),
+                            timeout=600, session_id=sid)
+                warms.append((time.perf_counter() - t0) * 1e3)
+                with lock:
+                    served[0] += 1
+            with lock:
+                cold_ms.append(cold)
+                warm_ms.extend(warms)
+        except Exception as e:  # noqa: BLE001 — recorded, reported
+            with lock:
+                errors.append(e)
+
+    with svc:
+        threads = [threading.Thread(target=session_loop, args=(k,))
+                   for k in range(SESSIONS_N)]
+        t0 = time.perf_counter()
+        with acct.account("step"):
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        dt = time.perf_counter() - t0
+        stats = svc.metrics.snapshot()
+        store_snap = svc.health()["sessions"]
+        swaps = svc.health()["swap"]["swaps"]
+    goodput_rep = acct.report()
+
+    clicks = served[0]
+    record = {
+        "metric": (f"danet_{BACKBONE}_{SIZE}px_sessions"
+                   f"_s{SESSIONS_N}x{SESSION_WARM_CLICKS}_click_loop"),
+        "value": round(clicks / dt, 3),
+        "unit": "clicks/sec",
+        "vs_baseline": 1.0,     # no published interactive baseline
+        "platform": jax.devices()[0].platform,
+        "sessions_n": SESSIONS_N,
+        "warm_clicks_per_session": SESSION_WARM_CLICKS,
+        "errors": len(errors),
+        "batches": stats["batches"],
+        "batch_buckets": stats["batch_buckets"],
+        "shed_queue_full": stats["shed_queue_full"],
+        "shed_session_lane": stats["shed_session_lane"],
+        "shed_deadline": stats["shed_deadline"],
+        "retrace_failures": stats["retrace_failures"],
+        "session_hits": store_snap["hits"],
+        "session_misses": store_snap["misses"],
+        "session_live_bytes": store_snap["live_bytes"],
+        "sessions": _sessions_block(store_snap, swaps, warm_ms, cold_ms),
+    }
+    record["goodput"] = round(goodput_rep["goodput"], 4)
+    record["goodput_breakdown"] = {
+        k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
+    record["mfu"] = None
+    record["chaos"] = chaos_sites.active_scenario()
+    # IR audit of the warm hot path (the decode program at the top
+    # bucket) — config-named, same convention as the burst bench
+    feats = predictor.feature_struct(1)
+    record.update(ir_audit_fields(
+        predictor.decode_jitted,
+        (jax.ShapeDtypeStruct((SERVE_MAX_BATCH, *feats.shape[1:]),
+                              feats.dtype),
+         jax.ShapeDtypeStruct((SERVE_MAX_BATCH, SIZE, SIZE, 1),
+                              np.float32)),
+        f"bench_serve_decode_{BACKBONE}_{SIZE}px_b{SERVE_MAX_BATCH}"))
+    from distributedpytorch_tpu.utils.profiling import device_memory_stats
+
+    record["peak_bytes_in_use"] = \
+        device_memory_stats()["peak_bytes_in_use"]
+    if not ON_TPU:
+        record["note"] = ("CPU fallback (downsized config), not a TPU "
+                          "number")
+    print(json.dumps(record))
+
+
 def main() -> None:
     # chaos: a DPTPU_CHAOS_PLAN env plan arms for the bench too, so the
     # record's `chaos` field names the scenario that conditioned the
@@ -431,8 +601,13 @@ def main() -> None:
     # effect (the same rule as the __main__-gated argv read above).
     chaos_sites.maybe_arm_from_env()
     if _CLI_ARGS.serve:
-        serve_bench()
+        if _CLI_ARGS.sessions:
+            serve_sessions_bench()
+        else:
+            serve_bench()
         return
+    if _CLI_ARGS.sessions:
+        raise SystemExit("--sessions is a serve mode; pass --serve too")
     if FELL_BACK_TO_CPU and not ON_TPU and _is_default_config():
         replay = try_replay_tpu_capture()
         if replay is not None:
@@ -573,6 +748,9 @@ def main() -> None:
     # chaos field: armed fault-plan name or null; key always present
     # (the PR 4 schema-stability convention)
     record["chaos"] = chaos_sites.active_scenario()
+    # sessions block: a serve-mode concept, null on train records — key
+    # always present (schema stability)
+    record["sessions"] = _sessions_block(None, None)
     # IR-audit fields (jaxaudit): collective inventory of the exact
     # compiled step + compile-contract status; keys always present
     record.update(audit_fields)

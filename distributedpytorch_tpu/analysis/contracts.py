@@ -37,17 +37,23 @@ import json
 import os
 import sys
 
-#: the canonical audited set: the trainer's two steps and two serve
-#: buckets (the bucket ladder's ends).  Any ``serve_forward_b<N>`` name
-#: is buildable on demand (``--programs serve_forward_b4``).
+#: the canonical audited set: the trainer's two steps, two serve buckets
+#: (the bucket ladder's ends), and the session-serving encode/decode
+#: split at the interactive click shape (b1).  Any ``serve_forward_b<N>``
+#: name is buildable on demand (``--programs serve_forward_b4``).
 PROGRAM_NAMES = ("train_step", "eval_step",
-                 "serve_forward_b1", "serve_forward_b8")
+                 "serve_forward_b1", "serve_forward_b8",
+                 "encode_step", "decode_step")
 
 _PROGRAM_HELP = {
     "train_step": "jitted mesh train step (fwd+loss+bwd+SGD, donated)",
     "eval_step": "jitted mesh eval step (fwd+loss)",
     "serve_forward_b1": "serve bucket forward, batch 1",
     "serve_forward_b8": "serve bucket forward, batch 8",
+    "encode_step": "session serving: RGB crop -> backbone features "
+                   "(guidance_inject='head', b1)",
+    "decode_step": "session serving: features + guidance -> mask "
+                   "probabilities (b1)",
 }
 
 #: relative FLOPs band and constant-bytes growth bound (see module doc)
@@ -242,7 +248,8 @@ def build_default_programs(names: tuple | list | None = None) -> dict:
 
     names = tuple(names) if names else PROGRAM_NAMES
     unknown = [n for n in names
-               if n not in ("train_step", "eval_step")
+               if n not in ("train_step", "eval_step",
+                            "encode_step", "decode_step")
                and not (n.startswith("serve_forward_b")
                         and n[len("serve_forward_b"):].isdigit())]
     if unknown:
@@ -287,6 +294,31 @@ def build_default_programs(names: tuple | list | None = None) -> dict:
             bucket = int(n[len("serve_forward_b"):])
             programs[n] = (pred.forward_jitted,
                            (sds((bucket, h, w, ch), jnp.float32),))
+
+    if {"encode_step", "decode_step"} & set(names):
+        # the session-serving split at the same canonical config, with
+        # the guidance channel re-entering at the head; b1 is the
+        # interactive single-click shape.  The FLOPs fields of these two
+        # contracts ARE the warm-vs-cold cost accounting: a warm click
+        # costs decode_step.flops, a cold click the sum — the serving
+        # acceptance pins decode <= 50% of the total.
+        split_model = build_model(
+            "danet", nclass=1, backbone="resnet18", output_stride=8,
+            dtype="float32", guidance_inject="head")
+        split_state = create_train_state(
+            jax.random.PRNGKey(0), split_model, tx, (1, h, w, ch))
+        split_pred = Predictor(split_model, split_state.params,
+                               split_state.batch_stats,
+                               resolution=(h, w), relax=50)
+        feats = split_pred.feature_struct(1)
+        if "encode_step" in names:
+            programs["encode_step"] = (
+                split_pred.encode_jitted,
+                (sds((1, h, w, ch - 1), jnp.float32),))
+        if "decode_step" in names:
+            programs["decode_step"] = (
+                split_pred.decode_jitted,
+                (feats, sds((1, h, w, 1), jnp.float32)))
     # preserve the caller's order
     return {n: programs[n] for n in names if n in programs}
 

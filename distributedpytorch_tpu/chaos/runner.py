@@ -117,6 +117,27 @@ SCENARIOS: dict[str, dict] = {
         "invariants": ["sheds_instead_of_crashing",
                        "recovers_after_disarm"],
     },
+    # Hot-swap mid-burst against a session-serving service: a GOOD
+    # checkpoint canaries and promotes while live sessions keep warm-
+    # clicking (zero session-visible errors — the zero-downtime
+    # invariant), then a NaN-poisoned checkpoint (the swap_params nan
+    # fault, firing on the SECOND swap's param load) is caught by the
+    # canary health check: its first poisoned output fails over to the
+    # active params (the client still gets a finite mask) and the swap
+    # rolls back.  Recovery = time from the rollback to a clean cold
+    # click on the active generation.
+    "hot_swap_under_load": {
+        "name": "hot_swap_under_load",
+        "mode": "serve_swap",
+        "plan": {"seed": 0, "faults": [
+            {"site": "serve/swap_params", "kind": "nan", "at": [2]}]},
+        "params": {"sessions": 3, "warm_clicks": 4, "size": 64,
+                   "max_batch": 4, "canary_fraction": 1.0},
+        "invariants": ["zero_session_errors_during_swap",
+                       "good_swap_promoted",
+                       "sessions_survive_swap",
+                       "bad_canary_rolled_back"],
+    },
     # NaN-poison the observed loss of one step: the trainer's
     # non-finite sweep logs train/nonfinite_steps, the fit CONTINUES
     # (debug_asserts off — production posture), and the final metrics
@@ -461,6 +482,144 @@ def _run_serve(sc: dict, work_dir: str) -> dict:
         "firings": plan.injected_total()}
 
 
+def _run_serve_swap(sc: dict, work_dir: str) -> dict:
+    """hot_swap_under_load: promote a good checkpoint and roll back a
+    poisoned one, under live session traffic (see SCENARIOS)."""
+    import threading
+
+    import jax
+    import numpy as np
+    import optax
+
+    from ..models import build_model
+    from ..parallel import create_train_state
+    from ..predict import Predictor
+    from ..serve import InferenceService
+    from ..serve.swap import load_swap_predictor
+
+    p = dict(sc.get("params") or {})
+    size = int(p.get("size", 64))
+    n_sessions = int(p.get("sessions", 3))
+    warm_clicks = int(p.get("warm_clicks", 4))
+    plan = FaultPlan.from_dict(dict(sc.get("plan") or {},
+                                    name=sc["name"]))
+    model = build_model("danet", nclass=1, backbone="resnet18",
+                        output_stride=8, guidance_inject="head")
+    tx = optax.sgd(1e-3)
+    state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                               (1, size, size, 4))
+    predictor = Predictor(model, state.params, state.batch_stats,
+                          resolution=(size, size), relax=20)
+    good = create_train_state(jax.random.PRNGKey(7), model, tx,
+                              (1, size, size, 4))
+    bad = create_train_state(jax.random.PRNGKey(9), model, tx,
+                             (1, size, size, 4))
+    r = np.random.RandomState(0)
+    image = r.randint(0, 256, (size, size, 3)).astype(np.uint8)
+    q, m = size // 4, size // 2
+    points = np.array([[q, m], [size - q, m], [m, q], [m, size - q]],
+                      np.float64)
+
+    svc = InferenceService(predictor,
+                           max_batch=int(p.get("max_batch", 4)),
+                           queue_depth=64, max_wait_s=0.0)
+    svc.warmup()
+    outcomes = {"completed": 0, "shed": 0, "other_error": 0}
+    lock = threading.Lock()
+
+    def count(key):
+        with lock:
+            outcomes[key] += 1
+
+    def click(session_id, pts):
+        from ..serve.service import (
+            DeadlineExceededError,
+            QueueFullError,
+        )
+        try:
+            mask = svc.predict(image, pts, timeout=120,
+                               session_id=session_id)
+            count("completed" if np.isfinite(mask).all()
+                  else "other_error")
+        except (QueueFullError, DeadlineExceededError):
+            count("shed")
+        except Exception:
+            count("other_error")
+
+    with svc, sites.armed_plan(plan):
+        # live sessions, established BEFORE the swap (1 cold click each)
+        for s in range(n_sessions):
+            click(f"pre-{s}", points)
+
+        # the burst: every session warm-clicks concurrently...
+        threads = [
+            threading.Thread(
+                target=lambda sid=f"pre-{s}": [
+                    click(sid, points + (k % 3))
+                    for k in range(warm_clicks)])
+            for s in range(n_sessions)]
+        for t in threads:
+            t.start()
+        # ...and the GOOD swap lands mid-burst (swap_params visit 1:
+        # no fault), canarying 100% of new sessions
+        pred_good = load_swap_predictor(predictor, good.params,
+                                        good.batch_stats)
+        gen_good = svc.swap(
+            pred_good, label="good",
+            canary_fraction=float(p.get("canary_fraction", 1.0)))
+        click("canary-0", points)      # canary traffic
+        for t in threads:
+            t.join()
+        outcomes_during_swap = dict(outcomes)
+        svc.promote()
+        # sessions established before the swap must still warm-hit their
+        # cached features (served by the now-draining generation 0)
+        hits_before = svc.health()["sessions"]["hits"]
+        click("pre-0", points + 1)
+        survived = (svc.health()["sessions"]["hits"] == hits_before + 1)
+
+        # the BAD swap: swap_params visit 2 NaN-poisons the param tree;
+        # the canary's first output rolls it back and fails over, so the
+        # client still sees a finite mask
+        pred_bad = load_swap_predictor(predictor, bad.params,
+                                       bad.batch_stats)
+        svc.swap(pred_bad, label="bad", canary_fraction=1.0)
+        t0 = time.perf_counter()
+        click("victim-0", points)
+        swap_state = svc.health()["swap"]
+        # recovery: the service serves a clean cold click on the active
+        # generation immediately after the rollback
+        try:
+            mask = svc.predict(image, points, timeout=120,
+                               session_id="post-rollback")
+            recovered = bool(np.isfinite(mask).all())
+        except Exception:
+            recovered = False
+        recovery_s = time.perf_counter() - t0
+        final_outcomes = dict(outcomes)
+        sessions_snap = svc.health()["sessions"]
+    _observe_recovery(sc["name"], recovery_s)
+    bad_gens = [g for g in swap_state["generations"]
+                if g["label"] == "bad"]
+    return {"phases": {"serve_swap": {
+        "outcomes_during_swap": outcomes_during_swap,
+        "outcomes": final_outcomes,
+        # clicks routed through the counting wrapper: per-session cold +
+        # warm bursts, the canary click, the post-promote warm check,
+        # and the bad-canary victim (the post-rollback recovery probe
+        # reports via recovered_after_rollback instead)
+        "submitted": n_sessions * (1 + warm_clicks) + 3,
+        "good_generation": gen_good,
+        "swap_state": swap_state,
+        "bad_generation": bad_gens[0] if bad_gens else None,
+        "old_sessions_warm_after_promote": survived,
+        "recovered_after_rollback": recovered,
+        "sessions": sessions_snap,
+        "stats": svc.metrics.snapshot(),
+    }}, "recovery_s": round(recovery_s, 3),
+        "firings": plan.injected_total()}
+
+
 # -------------------------------------------------------------- invariants
 
 def _check(sc: dict, result: dict) -> dict:
@@ -549,6 +708,41 @@ def _check_one(name, sc, result, phases, verdict):
             verdict(name, s["recovered_after_disarm"],
                     f"recovered={s['recovered_after_disarm']} in "
                     f"{result['recovery_s']}s")
+        elif name == "zero_session_errors_during_swap":
+            s = phases["serve_swap"]
+            o = s["outcomes"]
+            verdict(name,
+                    o["other_error"] == 0 and o["shed"] == 0
+                    and o["completed"] == s["submitted"],
+                    f"outcomes={o} submitted={s['submitted']} — every "
+                    "session click through both swaps must complete")
+        elif name == "good_swap_promoted":
+            s = phases["serve_swap"]
+            st = s["swap_state"]
+            verdict(name,
+                    st["swaps"]["promoted"] >= 1
+                    and st["active"] == s["good_generation"],
+                    f"promoted={st['swaps']['promoted']} "
+                    f"active={st['active']} "
+                    f"(good generation {s['good_generation']})")
+        elif name == "sessions_survive_swap":
+            s = phases["serve_swap"]
+            verdict(name, s["old_sessions_warm_after_promote"],
+                    "pre-swap session warm-hit its cached features "
+                    f"after promote: {s['old_sessions_warm_after_promote']}")
+        elif name == "bad_canary_rolled_back":
+            s = phases["serve_swap"]
+            st = s["swap_state"]
+            bad = s["bad_generation"] or {}
+            verdict(name,
+                    st["swaps"]["rolled_back"] >= 1
+                    and st["canary"] is None
+                    and bad.get("nonfinite", 0) >= 1
+                    and s["recovered_after_rollback"],
+                    f"rolled_back={st['swaps']['rolled_back']} "
+                    f"canary={st['canary']} bad={bad} "
+                    f"recovered={s['recovered_after_rollback']} in "
+                    f"{result['recovery_s']}s")
         elif name == "nonfinite_steps_logged":
             f = phases["fit"]
             # expected count = what the plan ACTUALLY fired (schedule
@@ -607,9 +801,11 @@ def run_scenario(scenario: str | dict, work_dir: str | None = None,
             result = _run_fit(sc, work_dir)
         elif mode == "serve":
             result = _run_serve(sc, work_dir)
+        elif mode == "serve_swap":
+            result = _run_serve_swap(sc, work_dir)
         else:
             raise ValueError(f"unknown scenario mode {mode!r} "
-                             "(fit | fit_resume | serve)")
+                             "(fit | fit_resume | serve | serve_swap)")
     finally:
         if cleanup:
             import shutil

@@ -11,6 +11,10 @@ The seams are woven into the REAL code paths (not shadow copies):
 * ``checkpoint/restore``     — before a checkpoint restore;
 * ``serve/enqueue``          — the serve front door (submit);
 * ``serve/drain``            — the batcher worker, before the forward;
+* ``serve/swap_params``      — the hot-swap param-load boundary
+  (serve/swap.load_swap_predictor; payload = the restored param tree, so
+  a ``nan`` fault models a poisoned/torn checkpoint arriving via swap —
+  the canary-rollback scenario's trigger);
 * ``device/put``             — host->device placement in the prefetcher.
 
 Disabled is the default and it is ~free: ``fire`` loads one module
@@ -44,6 +48,7 @@ SITES = (
     "checkpoint/restore",
     "serve/enqueue",
     "serve/drain",
+    "serve/swap_params",
     "device/put",
 )
 

@@ -53,7 +53,8 @@ def build_model(
                       "pam_sp_mesh": None, "pam_sp_axis": "model",
                       "pam_score_dtype": None,
                       "moe_experts": 0, "moe_hidden": None, "moe_k": 1,
-                      "moe_capacity_factor": 1.25}
+                      "moe_capacity_factor": 1.25,
+                      "guidance_inject": "stem"}
         for k, default in danet_only.items():
             if k in kw and kw.pop(k) != default:
                 raise ValueError(

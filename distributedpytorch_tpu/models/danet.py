@@ -227,6 +227,31 @@ class DANet(nn.Module):
     """Backbone + dual-attention head; ``__call__(x, train)`` -> 3-tuple of
     input-resolution logit maps, matching the reference model's output
     contract (tuple indexing at reference train_pascal.py:258-260).
+
+    ``guidance_inject`` picks where the click-guidance channel (the LAST
+    input channel, reference custom_transforms.py ConcatInputs) enters:
+
+    * ``'stem'`` (default, reference parity): the backbone consumes the
+      full RGB+guidance concat — every click pays the whole forward.
+    * ``'head'``: the backbone consumes only the RGB channels and the
+      guidance channel joins at the head via a zero-init 1x1 projection
+      added to the c4 features — making the backbone encoding a pure
+      function of the image.  This is the session-serving architecture:
+      ``stage='encode'`` (image -> c4 features, ~90% of the FLOPs) is
+      computed once per interactive session, ``stage='decode'``
+      (features + guidance -> logits) once per refinement click
+      (serve/sessions.py).  Zero-init keeps the module's residual-gate
+      idiom: at init the guidance is a no-op and training learns how
+      much to blend in.
+
+    Staged calls (``guidance_inject='head'`` only; ``stage`` is a static
+    Python string, so each stage traces its own program):
+
+    * ``stage='encode'``: ``x`` is the RGB crop (B, H, W, C-1); returns
+      the c4 feature map (B, H/os, W/os, C_feat).
+    * ``stage='decode'``: ``x`` is ``(features, guidance)`` with
+      guidance (B, H, W, 1) in crop space; ``out_size`` (static) is the
+      logit-map resolution (the full path's input size).
     """
 
     nclass: int = 1
@@ -246,11 +271,11 @@ class DANet(nn.Module):
     moe_hidden: int | None = None
     moe_k: int = 1
     moe_capacity_factor: float = 1.25
+    guidance_inject: str = "stem"  # stem | head (encode/decode split)
 
-    @nn.compact
-    def __call__(self, x, train: bool = False):
-        size = x.shape[1:3]
-        feats = ResNet(
+    def _encode(self, x, train: bool):
+        """Backbone features — the session-invariant stage."""
+        return ResNet(
             depth=self.backbone_depth,
             output_stride=self.output_stride,
             dtype=self.dtype,
@@ -259,9 +284,20 @@ class DANet(nn.Module):
             remat=self.remat,
             remat_policy=self.remat_policy,
             name="backbone",
-        )(x, train=train)
+        )(x, train=train)["c4"]
+
+    def _decode(self, feats, guidance, out_size: tuple[int, int],
+                train: bool):
+        """Head on (optionally guidance-conditioned) c4 features."""
+        if guidance is not None:
+            g = _resize_bilinear(guidance.astype(self.dtype),
+                                 feats.shape[1:3])
+            feats = feats + nn.Conv(
+                feats.shape[-1], (1, 1), use_bias=False, dtype=self.dtype,
+                kernel_init=nn.initializers.zeros,
+                name="guidance_proj")(g)
         norm = make_norm(train, self.dtype, self.bn_cross_replica_axis,
-                 fp32_stats=self.bn_fp32_stats)
+                         fp32_stats=self.bn_fp32_stats)
         outs = DANetHead(
             nclass=self.nclass,
             norm=norm,
@@ -276,5 +312,37 @@ class DANet(nn.Module):
             moe_k=self.moe_k,
             moe_capacity_factor=self.moe_capacity_factor,
             name="head",
-        )(feats["c4"], train=train)
-        return tuple(_resize_bilinear(o, size) for o in outs)
+        )(feats, train=train)
+        return tuple(_resize_bilinear(o, out_size) for o in outs)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, stage: str = "full",
+                 out_size: tuple[int, int] | None = None):
+        if self.guidance_inject not in ("stem", "head"):
+            raise ValueError(f"unknown guidance_inject: "
+                             f"{self.guidance_inject!r} (stem | head)")
+        if stage == "full":
+            size = out_size or x.shape[1:3]
+            if self.guidance_inject == "stem":
+                return self._decode(self._encode(x, train), None, size,
+                                    train)
+            # head injection: backbone sees RGB only; the guidance (last)
+            # channel re-enters at the head — x stays the SAME concat the
+            # stem path consumes, so the loss/eval/serve wire is unchanged
+            return self._decode(self._encode(x[..., :-1], train),
+                                x[..., -1:], size, train)
+        if self.guidance_inject != "head":
+            raise ValueError(
+                f"stage={stage!r} needs guidance_inject='head' — the stem "
+                "architecture folds the guidance into the backbone, so "
+                "its encoding cannot be reused across clicks")
+        if stage == "encode":
+            return self._encode(x, train)
+        if stage == "decode":
+            if out_size is None:
+                raise ValueError("stage='decode' needs out_size (the "
+                                 "logit-map resolution)")
+            feats, guidance = x
+            return self._decode(feats, guidance, tuple(out_size), train)
+        raise ValueError(f"unknown stage: {stage!r} "
+                         "(full | encode | decode)")

@@ -36,6 +36,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..chaos import sites as chaos_sites
 
+#: version-portable shard_map: the top-level ``jax.shard_map`` only exists
+#: on jax >= 0.5; older versions (this image ships 0.4.37) house it under
+#: jax.experimental and spell ``check_vma`` as ``check_rep``.  Every
+#: per-device-code module (ring, ulysses, pipeline) imports THIS name so
+#: the version probe lives in one place.
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect as _inspect
+
+_SHARD_MAP_KWARGS = frozenset(
+    _inspect.signature(_shard_map).parameters)
+
+
+def shard_map(*args, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_KWARGS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)
+
+
+def axis_size(axis_name: str):
+    """``jax.lax.axis_size`` where it exists; the static ``psum(1, axis)``
+    idiom (constant-folded at trace time, no runtime collective) on the
+    0.4.x line that predates it."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
 #: canonical axis names, in mesh order
 DATA_AXIS = "data"
 MODEL_AXIS = "model"

@@ -164,7 +164,8 @@ def model_from_config(cfg):
         moe_capacity_factor=cfg.model.moe_capacity_factor,
         aux_head=cfg.model.aux_head,
         encnet_codes=getattr(cfg.model, "encnet_codes", 32),
-        ccnet_recurrence=getattr(cfg.model, "ccnet_recurrence", 2))
+        ccnet_recurrence=getattr(cfg.model, "ccnet_recurrence", 2),
+        guidance_inject=getattr(cfg.model, "guidance_inject", "stem"))
 
 
 def load_run(run_dir: str, best: bool = True, cfg=None):
@@ -226,6 +227,28 @@ def _apply_with_normalize(model, variables, mean, std, x):
     return model.apply(variables, x, train=False)
 
 
+def _split_channel_stats(vals, n_channels: int):
+    """Split per-channel normalization stats into (rgb, guidance) parts.
+
+    The encode/decode split normalizes each part inside its own stage;
+    slicing here keeps that bitwise identical to normalizing the concat
+    and then splitting.  A broadcast scalar applies to both parts;
+    per-channel stats must cover every channel or the guidance lane
+    would silently reuse an RGB constant.
+    """
+    if vals is None:
+        return None, None
+    vals = tuple(vals)
+    if len(vals) == 1:
+        return vals, vals
+    if len(vals) != n_channels:
+        raise ValueError(
+            f"normalization stats have {len(vals)} entries for "
+            f"{n_channels} input channels — pass 1 (broadcast) or "
+            f"{n_channels} (per-channel incl. guidance)")
+    return vals[:-1], vals[-1:]
+
+
 def _click_kwargs_from_cfg(cfg, kwargs: dict) -> dict:
     """Default the click-predictor constructor kwargs from a run config."""
     kwargs.setdefault("resolution", tuple(cfg.data.crop_size))
@@ -268,6 +291,11 @@ class Predictor:
         self.alpha = alpha
         self.guidance = guidance
         self.mesh = mesh
+        #: the served weights, as handed in — the hot-swap path
+        #: (serve/swap.load_swap_predictor) and tests read them back;
+        #: the compiled forwards close over this exact tree
+        self.params = params
+        self.batch_stats = batch_stats
         variables = {"params": params, "batch_stats": batch_stats}
 
         def forward(x):
@@ -276,7 +304,53 @@ class Predictor:
             # the reference's metric consumes (train_pascal.py:283).
             return jax.nn.sigmoid(outputs[0].astype(jnp.float32))
 
-        if mesh is None:
+        #: guidance_inject='head' models split into two separately-jitted
+        #: stages: ``encode_jitted`` (RGB crop -> backbone features, the
+        #: session-invariant ~90% of the FLOPs) and ``decode_jitted``
+        #: (features + guidance -> probability maps).  Sessions are
+        #: single-device (the feature cache pins one device's HBM), so a
+        #: mesh predictor keeps the whole-forward jit and no stages.
+        self.supports_sessions = (
+            getattr(model, "guidance_inject", "stem") == "head"
+            and mesh is None)
+        self.encode_jitted = None
+        self.decode_jitted = None
+        if self.supports_sessions:
+            from .ops.augment import normalize as _normalize
+
+            rgb_mean, g_mean = _split_channel_stats(mean, in_channels)
+            rgb_std, g_std = _split_channel_stats(std, in_channels)
+
+            def _norm(x, m, s):
+                if m is None and s is None:
+                    return x
+                return _normalize({"concat": x}, m or (0.0,),
+                                  s or (255.0,))["concat"]
+
+            def encode_forward(rgb):
+                return model.apply(variables, _norm(rgb, rgb_mean, rgb_std),
+                                   train=False, stage="encode")
+
+            def decode_forward(feats, guidance):
+                outs = model.apply(
+                    variables, (feats, _norm(guidance, g_mean, g_std)),
+                    train=False, stage="decode",
+                    out_size=self.resolution)
+                return jax.nn.sigmoid(outs[0].astype(jnp.float32))
+
+            self.encode_jitted = jax.jit(encode_forward)
+            self.decode_jitted = jax.jit(decode_forward)
+
+            def staged_forward(x):
+                # THE forward of a split predictor IS the composition, so
+                # the stateless path and the session path (cached feats ->
+                # decode) run the exact same two compiled programs — warm
+                # and cold clicks are bitwise identical by construction.
+                return self.decode_jitted(self.encode_jitted(x[..., :-1]),
+                                          x[..., -1:])
+
+            self._forward = staged_forward
+        elif mesh is None:
             self._forward = jax.jit(forward)
         else:
             # Distributed inference: crops shard over the mesh's data axis
@@ -297,10 +371,49 @@ class Predictor:
 
     @property
     def forward_jitted(self):
-        """The exact jitted forward this predictor dispatches — the
-        callable the serve audit hooks and jaxaudit contracts trace
-        (``analysis.ir``); one compiled program per batch shape."""
+        """The exact forward this predictor dispatches — the callable the
+        serve audit hooks and jaxaudit contracts trace (``analysis.ir``);
+        one compiled program per batch shape.  For a split predictor
+        (``supports_sessions``) this is the encode∘decode COMPOSITION
+        (plain Python, not itself traceable) — audit the stages via
+        ``encode_jitted``/``decode_jitted`` instead."""
         return self._forward
+
+    def feature_struct(self, batch: int = 1):
+        """ShapeDtypeStruct of one encoded-feature batch — the session
+        cache entry's shape/dtype (and the byte cost the HBM budget
+        charges), computed without dispatching."""
+        if not self.supports_sessions:
+            raise ValueError("feature_struct: this predictor has no "
+                             "encode stage (guidance_inject='stem' or "
+                             "mesh-sharded)")
+        h, w = self.resolution
+        rgb = jax.ShapeDtypeStruct((batch, h, w, self.in_channels - 1),
+                                   jnp.float32)
+        return jax.eval_shape(self.encode_jitted, rgb)
+
+    def prepare_guidance(self, points: Any,
+                         bbox: tuple[int, int, int, int]) -> np.ndarray:
+        """Warm-click guidance: new clicks mapped into an EXISTING crop.
+
+        A session's first click established ``bbox`` (and the cached
+        backbone features of that crop); refinement clicks re-synthesize
+        only the guidance channel in the same crop coordinates — the
+        FixedResize point-scaling rule of :func:`prepare_input`, with the
+        bbox held fixed.  Returns (H, W, 1) float32 at ``resolution``.
+        """
+        points = np.asarray(points, np.float64)
+        if points.shape != (4, 2):
+            raise ValueError(f"expected 4 xy extreme points, got "
+                             f"{points.shape}")
+        res_h, res_w = self.resolution
+        scale = np.array([res_w / (bbox[2] - bbox[0] + 1),
+                          res_h / (bbox[3] - bbox[1] + 1)])
+        crop_pts = (points - np.array([bbox[0], bbox[1]])) * scale
+        crop_pts = np.clip(crop_pts, 0, [res_w - 1, res_h - 1])
+        heat = guidance_from_points((res_h, res_w), crop_pts,
+                                    alpha=self.alpha, family=self.guidance)
+        return heat.astype(np.float32)[..., None]
 
     @classmethod
     def from_run(cls, run_dir: str, best: bool = True, cfg=None,

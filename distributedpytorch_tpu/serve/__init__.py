@@ -12,6 +12,10 @@ docs/DESIGN.md "Serving"):
 * :mod:`batching` — the pure bucket/pad/unpad shape math
 * :mod:`service` — :class:`InferenceService`: queue, worker, deadlines,
   load shedding, CompileWatchdog retrace tripwire, metrics
+* :mod:`sessions` — :class:`SessionStore`: the per-session on-device
+  encoder cache (TTL + LRU under an HBM byte budget) behind warm clicks
+* :mod:`swap` — :class:`PredictorPool`: zero-downtime checkpoint
+  hot-swap with canary routing, promote/rollback, generation draining
 * :mod:`metrics` — counters + p50/p99 request latency (ops surface)
 * :mod:`client` — :class:`ServeClient` over in-process or HTTP targets
 * :mod:`__main__` — ``python -m distributedpytorch_tpu.serve`` HTTP shell
@@ -29,17 +33,25 @@ from .service import (
     InferenceService,
     QueueFullError,
     ServiceUnhealthyError,
+    SessionLaneFullError,
     warmup_buckets,
 )
+from .sessions import Session, SessionStore
+from .swap import PredictorPool, SwapInProgressError
 
 __all__ = [
     "DeadlineExceededError",
     "HealthCache",
     "InferenceService",
+    "PredictorPool",
     "QueueFullError",
     "ServeClient",
     "ServeMetrics",
     "ServiceUnhealthyError",
+    "Session",
+    "SessionLaneFullError",
+    "SessionStore",
+    "SwapInProgressError",
     "bucket_for",
     "bucket_sizes",
     "decode_array",

@@ -50,6 +50,7 @@ from .service import (
     InferenceService,
     QueueFullError,
     ServiceUnhealthyError,
+    SessionLaneFullError,
     warmup_buckets,  # noqa: F401  re-export; pre-consolidation import site
 )
 
@@ -166,8 +167,14 @@ def make_handler(service: InferenceService, health_cache: _HealthCache,
                 deadline_ms = body.get("deadline_ms")
                 deadline_s = None if deadline_ms is None \
                     else float(deadline_ms) / 1e3
+                # session-affine serving: absent session_id (the
+                # pre-session wire) stays the stateless path
+                session_id = body.get("session_id")
+                if session_id is not None:
+                    session_id = str(session_id)
                 t0 = time.perf_counter()
-                fut = service.submit(image, points, deadline_s=deadline_s)
+                fut = service.submit(image, points, deadline_s=deadline_s,
+                                     session_id=session_id)
                 # a request with a deadline can't legitimately outwait it
                 # (+grace for the drain-side check to answer first), and
                 # nobody outwaits the server-side cap — a huge client
@@ -180,6 +187,11 @@ def make_handler(service: InferenceService, health_cache: _HealthCache,
                     "mask": encode_array(mask),
                     "latency_ms": round(
                         (time.perf_counter() - t0) * 1e3, 3)})
+            except SessionLaneFullError as e:
+                # same 429 + Retry-After as a queue-full shed, but a
+                # distinct `code` so the client round-trips the type:
+                # only the offending session should back off
+                self._reply(429, {"error": str(e), "code": "session_lane"})
             except QueueFullError as e:
                 self._reply(429, {"error": str(e)})
             except DeadlineExceededError as e:
@@ -235,6 +247,16 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--warmup", action="store_true",
                         help="compile every bucket before accepting "
                              "traffic (first clicks pay no compile)")
+    parser.add_argument("--session-budget-mb", type=float, default=256.0,
+                        help="HBM byte budget for the per-session encoder "
+                             "cache (split predictors only); LRU evicts "
+                             "past it")
+    parser.add_argument("--session-ttl-s", type=float, default=600.0,
+                        help="idle seconds before an abandoned session's "
+                             "cached encoding is reaped")
+    parser.add_argument("--session-lane-depth", type=int, default=4,
+                        help="max queued requests ONE session may hold "
+                             "(fairness: excess sheds 429/session_lane)")
     parser.add_argument("--trace-dir", default=None,
                         help="where POST /debug/trace and SIGUSR2 write "
                              "bounded XPlane captures (default: "
@@ -251,6 +273,9 @@ def main(argv: list[str] | None = None) -> int:
         max_wait_s=args.max_wait_ms / 1e3,
         default_deadline_s=None if args.deadline_ms is None
         else args.deadline_ms / 1e3,
+        session_budget_bytes=int(args.session_budget_mb * 2**20),
+        session_ttl_s=args.session_ttl_s,
+        session_lane_depth=args.session_lane_depth,
         trace=trace)
     if args.warmup:
         # service.warmup (not bare warmup_buckets): it also registers the
@@ -271,7 +296,8 @@ def main(argv: list[str] | None = None) -> int:
     print(json.dumps({"serving": f"http://{args.host}:{args.port}",
                       "buckets": list(service.buckets),
                       "queue_depth": args.queue_depth,
-                      "resolution": list(predictor.resolution)}),
+                      "resolution": list(predictor.resolution),
+                      "sessions": service.sessions_enabled}),
           flush=True)
     try:
         httpd.serve_forever()

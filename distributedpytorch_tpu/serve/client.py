@@ -31,6 +31,7 @@ from .service import (
     InferenceService,
     QueueFullError,
     ServiceUnhealthyError,
+    SessionLaneFullError,
 )
 
 #: dtypes the wire accepts — closed set, so a hostile payload cannot name
@@ -103,6 +104,13 @@ _STATUS_ERRORS = {
     400: ValueError,
 }
 
+#: error-body ``code`` -> exception, refining the status mapping: both
+#: shed flavors are 429 (same retry advice), but a session-lane shed
+#: means only THIS session should back off — the type must round-trip
+_CODE_ERRORS = {
+    "session_lane": SessionLaneFullError,
+}
+
 
 class ServeClient:
     """Uniform client over an in-process service or a remote HTTP one.
@@ -134,33 +142,49 @@ class ServeClient:
             seed=retry_seed)
 
     def predict(self, image: np.ndarray, points: Any,
-                deadline_s: float | None = None) -> np.ndarray:
+                deadline_s: float | None = None,
+                session_id: str | None = None) -> np.ndarray:
         """Segment one object; blocks until the mask (or the shed/deadline
-        error) comes back.  ``deadline_s`` rides to the server's batcher."""
+        error) comes back.  ``deadline_s`` rides to the server's batcher.
+
+        ``session_id`` opts into session-affine serving (the interactive
+        click loop): reuse one id per image-under-refinement and every
+        click after the first costs only a decode on the server.  Absent
+        — the default, and the whole wire story for existing callers —
+        the request is stateless."""
         if self._retry is not None:
             try:
                 return self._retry.call(
-                    lambda: self._predict_once(image, points, deadline_s),
+                    lambda: self._predict_once(image, points, deadline_s,
+                                               session_id),
                     retry_on=(QueueFullError,))
             except _policies().RetryBudgetExceededError as e:
                 # budget spent: surface the ORIGINAL taxonomy (the last
                 # QueueFullError), not the policy wrapper — callers match
                 # on the shed/deadline exception types
                 raise e.__cause__ from None
-        return self._predict_once(image, points, deadline_s)
+        return self._predict_once(image, points, deadline_s, session_id)
 
     def _predict_once(self, image: np.ndarray, points: Any,
-                      deadline_s: float | None = None) -> np.ndarray:
+                      deadline_s: float | None = None,
+                      session_id: str | None = None) -> np.ndarray:
         if self._service is not None:
+            # session_id only rides when given: absent stays the exact
+            # pre-session call shape, so duck-typed service stands-ins
+            # (tests, wrappers) keep working unchanged
+            kwargs = ({} if session_id is None
+                      else {"session_id": session_id})
             return self._service.predict(image, points,
                                          deadline_s=deadline_s,
-                                         timeout=self.timeout_s)
+                                         timeout=self.timeout_s, **kwargs)
         body: dict = {
             "image": encode_array(np.asarray(image)),
             "points": np.asarray(points, np.float64).tolist(),
         }
         if deadline_s is not None:
             body["deadline_ms"] = deadline_s * 1e3
+        if session_id is not None:
+            body["session_id"] = str(session_id)
         reply = self._post("/v1/predict", body)
         return decode_array(reply["mask"])
 
@@ -192,12 +216,14 @@ class ServeClient:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return json.loads(r.read().decode("utf-8"))
         except urllib.error.HTTPError as e:
-            detail = ""
+            detail, code = "", None
             try:
-                detail = json.loads(e.read().decode("utf-8")).get("error", "")
+                payload = json.loads(e.read().decode("utf-8"))
+                detail = payload.get("error", "")
+                code = payload.get("code")
             except Exception:
                 pass
-            exc = _STATUS_ERRORS.get(e.code)
+            exc = _CODE_ERRORS.get(code) or _STATUS_ERRORS.get(e.code)
             if exc is not None:
                 raise exc(detail or f"HTTP {e.code}") from None
             raise RuntimeError(

@@ -39,6 +39,8 @@ _COUNTERS = {
     "completed": "requests answered with a mask",
     "failed": "requests answered with an error",
     "shed_queue_full": "requests rejected at the front door (queue full)",
+    "shed_session_lane": "requests rejected because one session "
+                         "overfilled its per-session lane",
     "shed_deadline": "requests dropped at drain time (deadline blown)",
     "batches": "compiled-forward dispatches",
     "retrace_failures": "steady-state recompiles the watchdog caught",

@@ -66,12 +66,39 @@ class QueueFullError(RuntimeError):
     """Load shed: the bounded request queue is full — retry later."""
 
 
+class SessionLaneFullError(QueueFullError):
+    """Load shed: ONE session overfilled its per-session lane.
+
+    A subclass of :class:`QueueFullError` (same HTTP 429, same retry
+    advice) so existing shed handling keeps working — but a distinct
+    type, because the remedies differ: a full queue means the SERVICE is
+    saturated; a full lane means one chatty session is outpacing its
+    fair share and only that session should back off.  Without the lane,
+    a single client looping warm clicks could occupy every queue slot
+    and starve every other session (the continuous-batching fairness
+    hole the taxonomy extension closes)."""
+
+
 class DeadlineExceededError(TimeoutError):
     """The request's deadline passed before its batch was dispatched."""
 
 
 class ServiceUnhealthyError(RuntimeError):
     """The service refused the request (stopped, or tripped unhealthy)."""
+
+
+class _NonFiniteOutputError(RuntimeError):
+    """A dispatch produced NaN/inf probabilities — the signal the swap
+    pool's canary health tracking keys on (a poisoned checkpoint's
+    signature failure mode)."""
+
+
+class _NonFiniteInputError(RuntimeError):
+    """BOTH generations produced non-finite output for the same batch —
+    the poison came in with the request (e.g. NaN pixels in a float
+    image), not from any params.  Counted as a plain failure, never as
+    a canary-health signal: a single hostile request must not be able
+    to veto a healthy deploy."""
 
 
 def warmup_buckets(predictor, buckets) -> list[tuple[int, int, int, int]]:
@@ -90,13 +117,29 @@ def warmup_buckets(predictor, buckets) -> list[tuple[int, int, int, int]]:
 
 @dataclasses.dataclass
 class _Request:
-    """One queued click-segmentation request, already host-preprocessed."""
-    concat: np.ndarray                    # (H, W, C) prepared network input
+    """One queued click-segmentation request, already host-preprocessed.
+
+    ``kind='full'``: a stateless request or a session's cold click —
+    ``concat`` holds the prepared (H, W, C) network input; with
+    ``store_session`` the encoded features are cached under
+    ``session_id``.  ``kind='decode'``: a warm click — ``guidance``
+    holds only the re-synthesized (H, W, 1) guidance channel and
+    ``session`` the cached entry whose features (and crop frame) the
+    decode rides on.  ``gen_id`` pins the params generation for the
+    request's whole life (serve/swap.py)."""
     bbox: tuple[int, int, int, int]       # paste-back crop box
     shape_hw: tuple[int, int]             # full-image size for paste-back
     future: Future                        # resolves to the (H, W) mask
     submitted: float                      # perf_counter at submit
     deadline: float | None                # absolute perf_counter, or None
+    kind: str = "full"                    # full | decode
+    concat: np.ndarray | None = None      # full: prepared network input
+    guidance: np.ndarray | None = None    # decode: (H, W, 1) guidance
+    session: object | None = None         # decode: the sessions.Session
+    session_id: str | None = None
+    store_session: bool = False           # full: cache features after encode
+    gen_id: int = 0                       # params generation (swap routing)
+    digest: int = 0                       # full+store: image fingerprint
 
 
 class InferenceService:
@@ -123,11 +166,17 @@ class InferenceService:
                  default_deadline_s: float | None = None,
                  strict_retrace: bool = True,
                  metrics: ServeMetrics | None = None,
-                 trace: TraceCapture | None = None):
+                 trace: TraceCapture | None = None,
+                 session_budget_bytes: int = 256 << 20,
+                 session_ttl_s: float = 600.0,
+                 session_lane_depth: int = 4):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         if max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        if session_lane_depth < 1:
+            raise ValueError(f"session_lane_depth must be >= 1, got "
+                             f"{session_lane_depth}")
         self.predictor = predictor
         self.buckets = batching.bucket_sizes(max_batch)
         self.max_batch = max_batch
@@ -135,6 +184,28 @@ class InferenceService:
         self.default_deadline_s = default_deadline_s
         self.strict_retrace = strict_retrace
         self.metrics = metrics or ServeMetrics()
+        #: session-affine serving (serve/sessions.py) — available when the
+        #: predictor has the encode/decode split (guidance_inject='head');
+        #: a stem predictor serves statelessly exactly as before
+        self.sessions_enabled = bool(
+            getattr(predictor, "supports_sessions", False))
+        self.session_lane_depth = session_lane_depth
+        self._store = None
+        if self.sessions_enabled:
+            from .sessions import SessionStore
+
+            self._store = SessionStore(budget_bytes=session_budget_bytes,
+                                       ttl_s=session_ttl_s)
+        #: params-generation pool (serve/swap.py): generation 0 is the
+        #: constructor predictor; hot-swaps add canary generations
+        from .swap import PredictorPool
+
+        self._pool = PredictorPool(predictor)
+        #: per-session queued-request counts (the fairness lane)
+        self._lane_lock = threading.Lock()
+        self._lanes: dict[str, int] = {}
+        #: zero-filled decode padding lanes, cached per bucket shape
+        self._feat_pad: dict = {}
         self._queue: queue.Queue[_Request] = queue.Queue(maxsize=queue_depth)
         # mute_jax_logs=False: this watchdog stays open for the service's
         # LIFETIME — the default propagation pause would silence every jax
@@ -203,20 +274,33 @@ class InferenceService:
     # ------------------------------------------------------------ front door
 
     def submit(self, image: np.ndarray, points: Any,
-               deadline_s: float | None = None) -> Future:
+               deadline_s: float | None = None,
+               session_id: str | None = None) -> Future:
         """Enqueue one request; returns a Future resolving to the mask.
 
         Host-side preprocessing runs here, on the caller's thread.  Raises
         :class:`QueueFullError` immediately when the bounded queue is full
-        (shed, don't wait) and :class:`ServiceUnhealthyError` when the
-        service is stopped or tripped unhealthy.  Bad inputs (malformed
-        points, clicks outside the image) raise ``ValueError`` here,
-        before anything is queued.
+        (shed, don't wait), :class:`SessionLaneFullError` when ONE session
+        overfilled its fair-share lane, and
+        :class:`ServiceUnhealthyError` when the service is stopped or
+        tripped unhealthy.  Bad inputs (malformed points, clicks outside
+        the image) raise ``ValueError`` here, before anything is queued.
+
+        ``session_id`` opts into session-affine serving (split predictors
+        only): the first click encodes and caches the crop's backbone
+        features; later clicks inside the same crop pay only a decode.
+        Absent (the default), the request is stateless — the pre-session
+        wire unchanged.
         """
         if self._state == "stopped":
             raise ServiceUnhealthyError("service stopped")
         if self._unhealthy and self.strict_retrace:
             raise ServiceUnhealthyError(self._unhealthy)
+        if session_id is not None and not self.sessions_enabled:
+            raise ValueError(
+                "session_id needs a split predictor (model built with "
+                "guidance_inject='head'); this service's predictor folds "
+                "the guidance into the backbone — submit statelessly")
         # chaos seam, on the CALLER's thread: latency is a slow host
         # preprocess (builds queue pressure), an error is a front-door
         # dependency failing — both before anything is queued
@@ -230,18 +314,18 @@ class InferenceService:
             raise QueueFullError(
                 f"request queue full ({self._queue.maxsize} deep) — "
                 "overloaded; retry with backoff")
-        concat, bbox = self.predictor.prepare(image, points)
-        now = time.perf_counter()
-        if deadline_s is None:
-            deadline_s = self.default_deadline_s
-        req = _Request(concat=concat, bbox=bbox,
-                       shape_hw=tuple(np.asarray(image).shape[:2]),
-                       future=Future(), submitted=now,
-                       deadline=None if deadline_s is None
-                       else now + deadline_s)
+        if session_id is not None:
+            self._check_session_lane(session_id)
+        req = self._build_request(image, points, deadline_s, session_id)
+        # reserve lane + generation-inflight accounting BEFORE the
+        # enqueue: booked after, a racing housekeeping gc could retire a
+        # generation whose request is already queued, and N concurrent
+        # submitters of one session could all clear the lane check
+        self._track_request(req)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
+            self._untrack_request(req)
             self.metrics.count("shed_queue_full")
             raise QueueFullError(
                 f"request queue full ({self._queue.maxsize} deep) — "
@@ -258,30 +342,239 @@ class InferenceService:
                 pass  # stop()'s drain got it first — already resolved
         return req.future
 
+    def _build_request(self, image, points, deadline_s,
+                       session_id) -> _Request:
+        """Route + host-preprocess one request on the caller's thread."""
+        now = time.perf_counter()
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        deadline = None if deadline_s is None else now + deadline_s
+        shape_hw = tuple(np.asarray(image).shape[:2])
+        if session_id is not None:
+            from .sessions import image_digest
+
+            # the warm path bypasses prepare_input, so it must apply the
+            # SAME input validation here: malformed/out-of-image points
+            # are a 400-class ValueError on every path, never an
+            # IndexError from covers() (a 500) nor a silently-served
+            # out-of-image click that the stateless path would reject
+            pts = np.asarray(points, np.float64)
+            if pts.shape != (4, 2):
+                raise ValueError(
+                    f"expected 4 xy extreme points, got {pts.shape}")
+            h_img, w_img = shape_hw
+            if (pts[:, 0].max() >= w_img or pts[:, 1].max() >= h_img
+                    or pts.min() < 0):
+                raise ValueError(f"points {pts.tolist()} outside image "
+                                 f"{w_img}x{h_img}")
+            digest = image_digest(image)
+            sess = self._store.get(session_id)
+            pred = (None if sess is None
+                    else self._pool.predictor_for(sess.generation))
+            if (sess is not None and pred is not None
+                    and sess.covers(points, shape_hw, digest=digest)):
+                # warm click: only the guidance channel is re-synthesized,
+                # in the SESSION's crop coordinates; the dispatch is a
+                # decode against the cached features, on the generation
+                # that encoded them (swap affinity).  A sess whose
+                # generation was retired under it (rollback-eviction
+                # race) degrades to the cold path below.
+                self._store.hit()
+                guidance = pred.prepare_guidance(points, sess.bbox)
+                return _Request(kind="decode", guidance=guidance,
+                                session=sess, session_id=session_id,
+                                bbox=sess.bbox, shape_hw=sess.shape_hw,
+                                gen_id=sess.generation, future=Future(),
+                                submitted=now, deadline=deadline)
+            # cold click (new session, TTL-expired, clicks outside the
+            # cached crop, or a different image under a reused id):
+            # full encode+decode, then cache the features
+            self._store.miss()
+            gen_id, pred = self._pool.route(session_id)
+            concat, bbox = pred.prepare(image, points)
+            return _Request(kind="full", concat=concat, bbox=bbox,
+                            shape_hw=shape_hw, session_id=session_id,
+                            store_session=True, gen_id=gen_id,
+                            digest=digest,
+                            future=Future(), submitted=now,
+                            deadline=deadline)
+        gen_id, pred = self._pool.route(None)
+        concat, bbox = pred.prepare(image, points)
+        return _Request(kind="full", concat=concat, bbox=bbox,
+                        shape_hw=shape_hw, gen_id=gen_id, future=Future(),
+                        submitted=now, deadline=deadline)
+
+    def _check_session_lane(self, session_id: str) -> None:
+        """Per-session fairness fast path: cap how many of the bounded
+        queue's slots one session may hold, checked BEFORE the
+        (expensive) host preprocessing — same move as the queue-full
+        fast path.  Best-effort under concurrency; the atomic
+        reservation in :meth:`_track_request` is authoritative."""
+        with self._lane_lock:
+            if self._lanes.get(session_id, 0) >= self.session_lane_depth:
+                self.metrics.count("shed_session_lane")
+                raise SessionLaneFullError(
+                    f"session {session_id!r} already holds "
+                    f"{self.session_lane_depth} queued request(s) — one "
+                    "session cannot starve the others; retry with backoff")
+
+    def _track_request(self, req: _Request) -> None:
+        """Atomically reserve the lane slot + generation in-flight count,
+        released by the future's done callback — which fires on EVERY
+        resolution path (result, error, shed at drain, cancel, stop
+        drain), so the counts can never leak.  The lane check here is
+        the authoritative one: check-and-increment under one lock, so
+        concurrent submitters of one session cannot overshoot the
+        depth."""
+        sid, gen = req.session_id, req.gen_id
+        if sid is not None:
+            with self._lane_lock:
+                n = self._lanes.get(sid, 0)
+                if n >= self.session_lane_depth:
+                    self.metrics.count("shed_session_lane")
+                    raise SessionLaneFullError(
+                        f"session {sid!r} already holds "
+                        f"{self.session_lane_depth} queued request(s) — "
+                        "one session cannot starve the others; retry "
+                        "with backoff")
+                self._lanes[sid] = n + 1
+        self._pool.track_inflight(gen, +1)
+        req.future.add_done_callback(lambda _f: self._untrack_request(req))
+
+    def _untrack_request(self, req: _Request) -> None:
+        sid = req.session_id
+        if sid is not None:
+            with self._lane_lock:
+                n = self._lanes.get(sid, 1) - 1
+                if n <= 0:
+                    self._lanes.pop(sid, None)
+                else:
+                    self._lanes[sid] = n
+        self._pool.track_inflight(req.gen_id, -1)
+
     def predict(self, image: np.ndarray, points: Any,
                 deadline_s: float | None = None,
-                timeout: float | None = None) -> np.ndarray:
+                timeout: float | None = None,
+                session_id: str | None = None) -> np.ndarray:
         """Blocking convenience: :meth:`submit` + ``Future.result``."""
-        return self.submit(image, points, deadline_s).result(timeout)
+        return self.submit(image, points, deadline_s,
+                           session_id=session_id).result(timeout)
 
     def warmup(self) -> None:
         """Compile every bucket's program before taking traffic: a cold
         service otherwise charges its first unlucky clients the XLA
         compile — exactly the latency cliff the bucket ladder prevents.
+        A split predictor warms TWO programs per bucket (encode at the
+        crop shape, decode at the feature shape).
 
         The warmed shapes are registered with the retrace tripwire: these
         compiles happen on the CALLING thread (invisible to the worker's
         thread-local watchdog), so without registration the budget would
         silently allow that many real steady-state retraces before
         tripping."""
+        if self.sessions_enabled:
+            self._warm_split_predictor(self.predictor)
+            return
         for shape in warmup_buckets(self.predictor, self.buckets):
-            self._warm_shapes.add(self._compiled_shape(shape))
+            self._warm_shapes.add((*self._compiled_shape(shape),
+                                   self._pred_key(self.predictor)))
+
+    def _warm_split_predictor(self, pred) -> None:
+        """Compile a split predictor's encode+decode ladder on the
+        CALLING thread (also the hot-swap admission path: a swapped-in
+        generation must pay its XLA compiles before it sees traffic, or
+        the first canary clicks eat seconds of compile AND the worker's
+        watchdog books compiles it has no shape budget for)."""
+        h, w = pred.resolution
+        ch = getattr(pred, "in_channels", 4)
+        for b in self.buckets:
+            feats = pred.encode_jitted(np.zeros((b, h, w, ch - 1),
+                                                np.float32))
+            pred.decode_jitted(feats, np.zeros((b, h, w, 1), np.float32))
+            self._warm_shapes.add(("enc", b, self._pred_key(pred)))
+            self._warm_shapes.add(("dec", b, self._pred_key(pred)))
+
+    # ------------------------------------------------------------- hot-swap
+
+    #: distinguishes "leave the pool's promote_after alone" from the
+    #: meaningful None (= manual promotion only)
+    _UNSET = object()
+
+    def swap(self, predictor, label: str = "",
+             canary_fraction: float | None = None,
+             warmup: bool = True,
+             min_observations: int | None = None,
+             max_error_rate: float | None = None,
+             promote_after=_UNSET) -> int:
+        """Admit a new checkpoint's predictor as the canary generation —
+        zero downtime: live sessions keep decoding on THEIR generation's
+        params; only a ``canary_fraction`` of new sessions/stateless
+        requests route to the new params until :meth:`promote` /
+        :meth:`rollback` (or the pool's auto-decision from observed
+        error rates; an injected-NaN checkpoint rolls back on its first
+        poisoned output).  The compile cost lands HERE, on the calling
+        thread, before any traffic routes to the new generation."""
+        if self.sessions_enabled and not getattr(
+                predictor, "supports_sessions", False):
+            raise ValueError(
+                "swap: this service serves sessions; the new predictor "
+                "must keep the encode/decode split "
+                "(guidance_inject='head')")
+        if tuple(predictor.resolution) != tuple(self.predictor.resolution):
+            raise ValueError(
+                f"swap: resolution {predictor.resolution} != the "
+                f"service's {self.predictor.resolution} — the bucket "
+                "ladder's compiled programs are resolution-keyed")
+        if self._pool.canary_generation is not None:
+            # fail fast BEFORE the (seconds of) warmup compile and before
+            # touching any decision thresholds: a refused swap must leave
+            # the in-flight canary's configuration untouched.  begin_swap
+            # re-checks under its lock (authoritative on a race).
+            from .swap import SwapInProgressError
+
+            raise SwapInProgressError(
+                f"generation {self._pool.canary_generation} is still "
+                "canarying — promote() or rollback() before swapping "
+                "again")
+        if warmup:
+            if getattr(predictor, "supports_sessions", False):
+                self._warm_split_predictor(predictor)
+            else:
+                for shape in warmup_buckets(predictor, self.buckets):
+                    self._warm_shapes.add((*self._compiled_shape(shape),
+                                           self._pred_key(predictor)))
+        gen = self._pool.begin_swap(predictor, label=label,
+                                    canary_fraction=canary_fraction)
+        # thresholds only after a successful admission — they configure
+        # THIS canary's decision rules, not whatever was already running
+        if min_observations is not None:
+            self._pool.min_observations = int(min_observations)
+        if max_error_rate is not None:
+            self._pool.max_error_rate = float(max_error_rate)
+        if promote_after is not InferenceService._UNSET:
+            self._pool.promote_after = promote_after
+        return gen
+
+    def promote(self) -> dict:
+        """Promote the canary to active; the old active generation drains
+        (serves its remaining sessions) and is retired when empty."""
+        return self._pool.promote()
+
+    def rollback(self) -> dict:
+        """Roll the canary back; its sessions are evicted (their features
+        came from the rolled-back params) and re-encode cold on the
+        active generation at their next click."""
+        gen = self._pool.canary_generation
+        out = self._pool.rollback()
+        if gen is not None and self._store is not None:
+            self._store.evict_generation(gen)
+        return out
 
     # ------------------------------------------------------------ ops surface
 
     def health(self) -> dict:
         """Liveness + the counters a probe needs to decide 'still good'."""
-        return {
+        out = {
             "ok": self._state == "running" and self._unhealthy is None,
             "running": self._state == "running",
             "state": self._state,
@@ -290,24 +583,49 @@ class InferenceService:
             "queue_capacity": self._queue.maxsize,
             "buckets": list(self.buckets),
             "stats": self.metrics.snapshot(),
+            "sessions": (self._store.snapshot()
+                         if self._store is not None else None),
+            "swap": self._pool.snapshot(),
         }
+        return out
 
     def audit_programs(self, buckets=None) -> dict:
         """``{serve_forward_b<N>: (fn, args)}`` for the EXACT jitted
         forward at each bucket's compiled shape (mesh padding included,
         :meth:`_compiled_shape`) — the hook jaxaudit (analysis.ir)
         traces and the checked-in serve contracts pin.  Args are
-        ShapeDtypeStructs; tracing never dispatches."""
+        ShapeDtypeStructs; tracing never dispatches.
+
+        A split predictor has no single jitted forward; its programs are
+        the two stages, named ``serve_encode_b<N>``/``serve_decode_b<N>``
+        per bucket (the canonical single-click pins are the
+        ``encode_step``/``decode_step`` contracts, analysis/contracts)."""
         import jax
         import jax.numpy as jnp
 
         h, w = self.predictor.resolution
         ch = getattr(self.predictor, "in_channels", 4)
+        buckets = buckets if buckets is not None else self.buckets
+        if self.sessions_enabled:
+            feats1 = self.predictor.feature_struct(1)
+            out: dict = {}
+            for b in buckets:
+                fstruct = jax.ShapeDtypeStruct((b, *feats1.shape[1:]),
+                                               feats1.dtype)
+                out[f"serve_encode_b{b}"] = (
+                    self.predictor.encode_jitted,
+                    (jax.ShapeDtypeStruct((b, h, w, ch - 1),
+                                          jnp.float32),))
+                out[f"serve_decode_b{b}"] = (
+                    self.predictor.decode_jitted,
+                    (fstruct,
+                     jax.ShapeDtypeStruct((b, h, w, 1), jnp.float32)))
+            return out
         fn = self.predictor.forward_jitted
         return {
             f"serve_forward_b{b}": (fn, (jax.ShapeDtypeStruct(
                 self._compiled_shape((b, h, w, ch)), jnp.float32),))
-            for b in (buckets if buckets is not None else self.buckets)
+            for b in buckets
         }
 
     def audit(self, buckets=None, **kwargs) -> dict:
@@ -323,8 +641,12 @@ class InferenceService:
 
     @property
     def buckets_compiled(self) -> set[int]:
-        """Bucket sizes dispatched (== compiled, absent retraces)."""
-        return {s[0] for s in self._shapes_dispatched}
+        """Bucket sizes dispatched (== compiled, absent retraces).
+        Split-predictor entries are kind-tagged ('enc'/'dec', bucket);
+        whole-forward entries are full compiled shapes — both reduce to
+        the bucket size here."""
+        return {s[1] if isinstance(s[0], str) else s[0]
+                for s in self._shapes_dispatched}
 
     # ------------------------------------------------------------ worker
 
@@ -334,6 +656,7 @@ class InferenceService:
         # every compile) happens here.  A watchdog entered on the caller's
         # thread would count nothing and silently disarm the retrace check.
         with self._watchdog:
+            last_sweep = time.perf_counter()
             while not self._stop.is_set():
                 batch = self._gather()
                 if self.trace is not None:
@@ -344,6 +667,30 @@ class InferenceService:
                     self.trace.tick(1 if batch else 0)
                 if batch:
                     self._process(batch)
+                now = time.perf_counter()
+                if now - last_sweep > 1.0:
+                    # periodic housekeeping between drains: TTL-reap
+                    # abandoned sessions, retire drained generations.
+                    # The gc runs store-less too — a stateless service
+                    # that hot-swaps still needs its old generations'
+                    # params freed once they drain.
+                    last_sweep = now
+                    if self._store is not None:
+                        self._store.sweep()
+                    freed = self._pool.gc(
+                        self._store.counts_by_generation()
+                        if self._store is not None else {})
+                    if freed and not self._pool.is_resident(
+                            self.predictor):
+                        # the base predictor's generation just retired:
+                        # re-point at the active generation so the old
+                        # params (and their compiled ladder) actually
+                        # free — keeping the constructor's reference
+                        # would pin one dead param set per service
+                        # forever.  Settings are interchangeable:
+                        # load_swap_predictor inherits them from the
+                        # predictor in service.
+                        self.predictor = self._pool.active_predictor
             if self.trace is not None:
                 self.trace.close()
 
@@ -404,16 +751,27 @@ class InferenceService:
             live.append(req)
         if not live:
             return
+        # continuous batching across sessions: one drain may hold decode
+        # requests from MANY sessions plus full forwards, and (during a
+        # swap window) several params generations.  A dispatch group is
+        # (kind, generation): decodes batch together whatever session
+        # they came from; generations can never share a program (their
+        # params differ).  Order is drain order — the group holding the
+        # oldest request dispatches first.
+        groups: dict[tuple[str, int], list[_Request]] = {}
+        for req in live:
+            groups.setdefault((req.kind, req.gen_id), []).append(req)
+        for (kind, gen_id), reqs in groups.items():
+            self._dispatch_group(kind, gen_id, reqs)
+
+    def _dispatch_group(self, kind: str, gen_id: int,
+                        live: list[_Request]) -> None:
         try:
             bucket = batching.bucket_for(len(live), self.buckets)
-            padded = batching.pad_to_bucket(
-                np.stack([r.concat for r in live]), bucket)
-            probs = batching.unpad(self.predictor.forward_prepared(padded),
-                                   len(live))
-            # register AFTER a successful forward: a dispatch that dies
-            # mid-compile must not leave a phantom shape that either
-            # false-trips the tripwire on retry or pads its budget
-            self._shapes_dispatched.add(self._compiled_shape(padded.shape))
+            if kind == "decode":
+                probs, gen_used = self._decode_batch(gen_id, live, bucket)
+            else:
+                probs, gen_used = self._full_batch(gen_id, live, bucket)
             self._check_retrace()
             for i, req in enumerate(live):
                 req.future.set_result(self.predictor.paste_back(
@@ -423,13 +781,136 @@ class InferenceService:
             done = time.perf_counter()
             for req in live:
                 self.metrics.observe_latency(done - req.submitted)
+                self._observe_generation(gen_used, ok=True)
         except Exception as e:                       # fail the batch, serve on
             failed = 0
             for req in live:
                 if not req.future.done():            # not the already-resolved
                     req.future.set_exception(e)
                     failed += 1
+                self._observe_generation(
+                    gen_id, ok=False,
+                    nonfinite=isinstance(e, _NonFiniteOutputError))
             self.metrics.count("failed", failed)
+
+    def _full_batch(self, gen_id: int, live: list[_Request],
+                    bucket: int) -> tuple[np.ndarray, int]:
+        """Dispatch a full (encode+decode or whole-forward) group; caches
+        features for cold session clicks.  Returns (probs, generation
+        that actually served) — a NaN-poisoned canary fails over to the
+        active generation so the clients still get masks (and the canary
+        observation triggers the rollback)."""
+        pred = self._pool.predictor_for(gen_id)
+        padded = batching.pad_to_bucket(
+            np.stack([r.concat for r in live]), bucket)
+        probs, feats = self._run_full(pred, padded, bucket)
+        if not np.isfinite(probs[:len(live)]).all():
+            active = self._pool.active_generation
+            if gen_id == active:
+                raise _NonFiniteOutputError(
+                    f"non-finite probabilities from active generation "
+                    f"{gen_id}")
+            # canary output poisoned — but only blame the CANARY PARAMS
+            # if the active generation can serve the same batch finitely
+            # (a request carrying NaN pixels poisons every generation
+            # equally and must not roll a healthy deploy back).  The
+            # cold click still has its full input, so the failover costs
+            # one extra forward, not an error surfaced to any client.
+            probs2, feats2 = self._run_full(
+                self._pool.predictor_for(active), padded, bucket)
+            if not np.isfinite(probs2[:len(live)]).all():
+                raise _NonFiniteInputError(
+                    "non-finite probabilities from BOTH generations — "
+                    "the request input is poisoned, not the params")
+            self._observe_generation(gen_id, ok=False, nonfinite=True)
+            gen_id, probs, feats = active, probs2, feats2
+        for i, req in enumerate(live):
+            if req.store_session and feats is not None:
+                self._store.put(req.session_id, feats[i:i + 1],
+                                req.bbox, req.shape_hw, gen_id,
+                                digest=req.digest)
+        return batching.unpad(probs, len(live)), gen_id
+
+    def _run_full(self, pred, padded: np.ndarray,
+                  bucket: int) -> tuple[np.ndarray, object]:
+        """One full forward at a bucket; split predictors run their two
+        stages explicitly so the encoded features are in hand for the
+        session cache (the same two programs the stateless composition
+        dispatches — warm/cold parity stays bitwise).
+
+        Retrace-budget keys carry the PREDICTOR identity
+        (:meth:`_pred_key`): each generation owns its own jit cache, so
+        an unwarmed swapped-in generation's first dispatches are new
+        compiles the budget must grow for — generation-agnostic keys
+        would false-trip the tripwire on the first swap(warmup=False)."""
+        if getattr(pred, "supports_sessions", False):
+            feats = pred.encode_jitted(padded[..., :-1])
+            probs = np.asarray(pred.decode_jitted(
+                feats, padded[..., -1:]))[..., 0]
+            # register AFTER a successful forward: a dispatch that dies
+            # mid-compile must not leave a phantom shape that either
+            # false-trips the tripwire on retry or pads its budget
+            self._shapes_dispatched.add(("enc", bucket, self._pred_key(pred)))
+            self._shapes_dispatched.add(("dec", bucket, self._pred_key(pred)))
+            return probs, feats
+        probs = pred.forward_prepared(padded)
+        self._shapes_dispatched.add(
+            (*self._compiled_shape(padded.shape), self._pred_key(pred)))
+        return probs, None
+
+    def _pred_key(self, pred) -> int:
+        """Stable per-predictor tag for warm/dispatched program keys.
+        ``id()`` is stable for the predictor's lifetime (the pool holds
+        it while any key matters); after retirement an id could in
+        principle be reused by a later predictor, whose ladder would
+        then inherit that slack — bounded at one ladder of budget,
+        accepted for the simplicity."""
+        return id(pred)
+
+    def _decode_batch(self, gen_id: int, live: list[_Request],
+                      bucket: int) -> tuple[np.ndarray, int]:
+        """Warm clicks: decode cached features from MANY sessions in one
+        bucketed dispatch.  Features stay on device end to end — the
+        stack is a device-side concatenate, never a host round trip."""
+        import jax.numpy as jnp
+
+        pred = self._pool.predictor_for(gen_id)
+        guidance = batching.pad_to_bucket(
+            np.stack([r.guidance for r in live]), bucket)
+        feat_list = [r.session.features for r in live]
+        n_pad = bucket - len(feat_list)
+        if n_pad:
+            shape = feat_list[0].shape
+            key = (n_pad, *shape[1:])
+            pad = self._feat_pad.get(key)
+            if pad is None:
+                pad = self._feat_pad[key] = jnp.zeros(
+                    (n_pad, *shape[1:]), feat_list[0].dtype)
+            feat_list = feat_list + [pad]
+        feats = (jnp.concatenate(feat_list, axis=0)
+                 if len(feat_list) > 1 else feat_list[0])
+        probs = np.asarray(pred.decode_jitted(feats, guidance))[..., 0]
+        self._shapes_dispatched.add(("dec", bucket, self._pred_key(pred)))
+        if not np.isfinite(probs[:len(live)]).all():
+            # a decode has no image to re-encode from, so there is no
+            # failover — but a poisoned canary is caught on its COLD
+            # click (which can fail over), so a non-finite decode means
+            # the generation degraded after admission: fail the group
+            # and let the observation roll the canary back
+            raise _NonFiniteOutputError(
+                f"non-finite probabilities decoding generation {gen_id}")
+        for req in live:
+            self._store.touch_click(req.session)
+        return batching.unpad(probs, len(live)), gen_id
+
+    def _observe_generation(self, gen_id: int, ok: bool,
+                            nonfinite: bool = False) -> None:
+        """Report one outcome to the swap pool; apply its decision (a
+        rollback evicts the rolled-back generation's sessions — their
+        features must never outlive their params)."""
+        action = self._pool.observe(gen_id, ok=ok, nonfinite=nonfinite)
+        if action == "rolled_back" and self._store is not None:
+            self._store.evict_generation(gen_id)
 
     def _compiled_shape(self, shape: tuple[int, ...]) -> tuple[int, ...]:
         """The shape the forward actually COMPILES for a bucket dispatch.

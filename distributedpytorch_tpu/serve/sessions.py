@@ -1,0 +1,284 @@
+"""Session-affine feature cache: pay the backbone once per image, not per click.
+
+The DEXTR workload is *interactive*: a user places extreme points, gets a
+mask, and refines it with further clicks on the SAME image.  With a split
+predictor (``predict.Predictor.supports_sessions`` — guidance_inject='head'
+models) the backbone+attention encoding of the session's crop is a pure
+function of the image, so it is computed once on the first (cold) click and
+cached ON DEVICE; every refinement (warm) click re-synthesizes only the
+guidance channel and pays a ``decode`` — the FFCV principle ("never
+recompute what is deterministic across iterations", PAPERS.md 2306.12517)
+applied to inference.
+
+This module is the pure store; the queueing/dispatch policy lives in
+:class:`service.InferenceService`.  What the store owns:
+
+* **Device-resident entries.**  ``Session.features`` is the encoded
+  (1, H/os, W/os, C) feature map, kept as a device array — a cache that
+  round-trips features through host numpy would pay two PCIe copies per
+  warm click and erase most of the win.
+* **An explicit HBM byte budget.**  Features are HBM; an unbounded cache
+  is an OOM with a delay.  ``put`` evicts least-recently-used entries
+  until the new entry fits (the budget bounds resident bytes at
+  ``max(budget_bytes, one entry)`` — a store that refused oversized
+  entries could never serve large-crop sessions at all).  The eviction
+  math, concretely: one 512² os=8 ResNet-101 session is
+  64·64·2048·4 B = 32 MiB, so a 2 GiB budget holds 64 live sessions; the
+  64px ResNet-18 test config is 8·8·512·4 B = 128 KiB per session.
+* **TTL expiry.**  Abandoned sessions (the user closed the tab) expire
+  ``ttl_s`` after their last use — reaped lazily on access and by the
+  service worker's periodic :meth:`sweep`.
+* **Generation affinity.**  Features encoded by params generation N are
+  only decodable by generation N (serve/swap.py); entries record their
+  generation so a hot-swap can pin old params until their last session
+  drains, and a rollback can evict exactly the canary's sessions.
+
+Observability rides the process-wide telemetry registry:
+``serve_session_live_bytes`` / ``serve_sessions_live`` gauges,
+``serve_session_evictions_total{reason=ttl|lru|explicit|generation}``,
+``serve_session_hits_total`` / ``serve_session_misses_total`` counters.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from ..telemetry.registry import MetricsRegistry, get_registry
+
+#: eviction reasons — the counter's closed label set
+EVICT_REASONS = ("ttl", "lru", "explicit", "generation")
+
+
+def image_digest(image) -> int:
+    """Cheap identity fingerprint of the full image (crc32 of the raw
+    bytes + shape) — computed once per click on the submitting thread
+    (~100µs at 512²) so a reused session id with a DIFFERENT image of
+    the same size re-encodes instead of decoding the old image's
+    features."""
+    arr = np.ascontiguousarray(np.asarray(image))
+    return zlib.crc32(arr.tobytes()) ^ hash(arr.shape) & 0xFFFFFFFF
+
+
+class Session:
+    """One live interactive session: the cached encoding + its crop frame."""
+
+    __slots__ = ("session_id", "features", "bbox", "shape_hw", "generation",
+                 "nbytes", "created", "last_used", "clicks", "digest")
+
+    def __init__(self, session_id: str, features, bbox, shape_hw,
+                 generation: int, now: float, digest: int = 0):
+        self.session_id = session_id
+        self.features = features
+        self.bbox = tuple(int(v) for v in bbox)
+        self.shape_hw = tuple(int(v) for v in shape_hw)
+        self.generation = int(generation)
+        self.nbytes = int(np.prod(features.shape)
+                          * np.dtype(features.dtype).itemsize)
+        self.created = now
+        self.last_used = now
+        self.clicks = 1
+        self.digest = int(digest)
+
+    def covers(self, points, shape_hw, digest: int | None = None) -> bool:
+        """Can a refinement click reuse this entry?  The clicks must fall
+        inside the session's established crop (guidance is synthesized in
+        that crop's coordinates) and the image must be THE image the
+        features encode (size + content fingerprint) — a different image
+        under a reused session id is a client bug that must degrade to a
+        re-encode, never to a mask from the wrong image's features."""
+        if tuple(int(v) for v in shape_hw) != self.shape_hw:
+            return False
+        if digest is not None and digest != self.digest:
+            return False
+        pts = np.asarray(points, np.float64)
+        x0, y0, x1, y1 = self.bbox
+        return bool((pts[:, 0] >= x0).all() and (pts[:, 0] <= x1).all()
+                    and (pts[:, 1] >= y0).all() and (pts[:, 1] <= y1).all())
+
+
+class SessionStore:
+    """TTL + LRU session cache under an explicit device-byte budget.
+
+    Thread-safe: the service's submit path (many client threads) and the
+    worker share it.  All mutation happens under one lock; the stored
+    feature arrays themselves are immutable device buffers.
+    """
+
+    def __init__(self, budget_bytes: int = 256 << 20, ttl_s: float = 600.0,
+                 registry: MetricsRegistry | None = None):
+        if budget_bytes < 1:
+            raise ValueError(f"budget_bytes must be >= 1, got {budget_bytes}")
+        if ttl_s <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
+        self.budget_bytes = int(budget_bytes)
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        #: insertion/use order IS the LRU order (move_to_end on touch)
+        self._entries: collections.OrderedDict[str, Session] = \
+            collections.OrderedDict()
+        self._live_bytes = 0
+        reg = registry or get_registry()
+        self._g_bytes = reg.gauge(
+            "serve_session_live_bytes",
+            "device bytes held by cached session encodings")
+        self._g_live = reg.gauge(
+            "serve_sessions_live", "live interactive sessions")
+        self._c_evict = {
+            reason: reg.counter(
+                "serve_session_evictions_total",
+                "session-cache evictions", labels={"reason": reason})
+            for reason in EVICT_REASONS}
+        self._c_hit = reg.counter(
+            "serve_session_hits_total",
+            "warm clicks served from the feature cache")
+        self._c_miss = reg.counter(
+            "serve_session_misses_total",
+            "clicks that had to (re-)encode (new/expired/out-of-crop)")
+        #: registry values at store construction — the registry keeps
+        #: process-lifetime totals; this store reports ITS OWN deltas
+        #: (the ServeMetrics baseline convention)
+        self._base = {
+            "hits": self._c_hit.value, "misses": self._c_miss.value,
+            **{f"evict_{r}": c.value for r, c in self._c_evict.items()}}
+
+    # ------------------------------------------------------------- accessors
+
+    def get(self, session_id: str, now: float | None = None
+            ) -> Session | None:
+        """The live entry (LRU-touched), or None (expired entries are
+        reaped here).  Hit/miss accounting is the CALLER's move
+        (:meth:`hit`/:meth:`miss`) — a miss by coverage happens after a
+        successful get."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            sess = self._entries.get(session_id)
+            if sess is None:
+                return None
+            if now - sess.last_used > self.ttl_s:
+                self._drop(session_id, "ttl")
+                return None
+            sess.last_used = now
+            self._entries.move_to_end(session_id)
+            return sess
+
+    def hit(self) -> None:
+        self._c_hit.inc()
+
+    def miss(self) -> None:
+        self._c_miss.inc()
+
+    # -------------------------------------------------------------- mutation
+
+    def put(self, session_id: str, features, bbox, shape_hw,
+            generation: int, now: float | None = None,
+            digest: int = 0) -> Session:
+        """Install/replace an entry, evicting LRU until it fits the
+        budget.  The NEW entry is always admitted (see module doc)."""
+        now = time.monotonic() if now is None else now
+        sess = Session(session_id, features, bbox, shape_hw, generation,
+                       now, digest=digest)
+        with self._lock:
+            if session_id in self._entries:
+                self._drop(session_id, "explicit")
+            while (self._entries
+                   and self._live_bytes + sess.nbytes > self.budget_bytes):
+                oldest = next(iter(self._entries))
+                self._drop(oldest, "lru")
+            self._entries[session_id] = sess
+            self._live_bytes += sess.nbytes
+            self._publish()
+            return sess
+
+    def touch_click(self, sess: Session) -> None:
+        with self._lock:
+            sess.clicks += 1
+
+    def sweep(self, now: float | None = None) -> int:
+        """Reap every TTL-expired entry; returns how many went."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            expired = [sid for sid, s in self._entries.items()
+                       if now - s.last_used > self.ttl_s]
+            for sid in expired:
+                self._drop(sid, "ttl")
+            return len(expired)
+
+    def evict(self, session_id: str, reason: str = "explicit") -> bool:
+        with self._lock:
+            if session_id not in self._entries:
+                return False
+            self._drop(session_id, reason)
+            return True
+
+    def evict_generation(self, generation: int) -> int:
+        """Drop every session bound to ``generation`` (hot-swap rollback:
+        canary features must never outlive the canary params)."""
+        with self._lock:
+            doomed = [sid for sid, s in self._entries.items()
+                      if s.generation == generation]
+            for sid in doomed:
+                self._drop(sid, "generation")
+            return len(doomed)
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            for sid in list(self._entries):
+                self._drop(sid, "explicit")
+            return n
+
+    # ------------------------------------------------------------------ ops
+
+    @property
+    def live_bytes(self) -> int:
+        with self._lock:
+            return self._live_bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def counts_by_generation(self) -> dict[int, int]:
+        with self._lock:
+            out: dict[int, int] = {}
+            for s in self._entries.values():
+                out[s.generation] = out.get(s.generation, 0) + 1
+            return out
+
+    def snapshot(self) -> dict:
+        """One dict for /healthz, /stats and the sessions bench."""
+        with self._lock:
+            return {
+                "live": len(self._entries),
+                "live_bytes": self._live_bytes,
+                "budget_bytes": self.budget_bytes,
+                "ttl_s": self.ttl_s,
+                "by_generation": {
+                    str(g): n
+                    for g, n in sorted(collections.Counter(
+                        s.generation
+                        for s in self._entries.values()).items())},
+                "evictions": {
+                    r: int(c.value - self._base[f"evict_{r}"])
+                    for r, c in self._c_evict.items()},
+                "hits": int(self._c_hit.value - self._base["hits"]),
+                "misses": int(self._c_miss.value - self._base["misses"]),
+            }
+
+    # ------------------------------------------------------------- internals
+
+    def _drop(self, session_id: str, reason: str) -> None:
+        """Remove one entry; caller holds the lock."""
+        sess = self._entries.pop(session_id)
+        self._live_bytes -= sess.nbytes
+        self._c_evict[reason].inc()
+        self._publish()
+
+    def _publish(self) -> None:
+        self._g_bytes.set(float(self._live_bytes))
+        self._g_live.set(float(len(self._entries)))

@@ -1,0 +1,323 @@
+"""Zero-downtime checkpoint hot-swap: canary a new generation, never drop
+a live session.
+
+A long-running service must pick up retrained checkpoints without a
+restart (a restart = every session re-encodes + a cold-compile cliff).
+The mechanism is a **generation pool**:
+
+* Every set of params is a *generation* (``Generation``): the initial
+  predictor is generation 0.  :meth:`PredictorPool.begin_swap` loads a
+  NEW predictor alongside the old (both resident — the HBM cost of a
+  swap window is one extra param set) and marks it the *canary*.
+* **Routing.**  New sessions and stateless requests hash (session id) or
+  round-robin (stateless) into the canary with probability
+  ``canary_fraction``; everything else stays on the active generation.
+  EXISTING sessions are never re-routed: features encoded by generation
+  N are only decodable by generation N's params, so a session sticks to
+  its generation for life — that affinity is what makes the swap
+  zero-downtime.
+* **Decide.**  The service worker reports every request outcome via
+  :meth:`observe`.  A non-finite output from the canary (NaN-poisoned
+  checkpoint) rolls back immediately; an error rate above
+  ``max_error_rate`` after ``min_observations`` rolls back; ``promote_after``
+  clean observations promote automatically (set None to require a manual
+  :meth:`promote` — the operator-gated posture).
+* **Drain, then retire.**  After promote, the old generation is
+  *draining*: it serves its remaining sessions' warm clicks until the
+  store holds none and its in-flight count is zero, then the pool drops
+  the last reference (params freed).  ``serve_params_generations_live``
+  gauges the window; ``serve_swaps_total{outcome=promoted|rolled_back}``
+  counts decisions.
+
+The pool is predictor-agnostic glue: it never touches the session store
+directly.  The service reacts to the action strings :meth:`observe`
+returns (evicting canary sessions on rollback) — one direction of
+dependency, no cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+
+#: generation lifecycle states
+STATES = ("active", "canary", "draining", "retired")
+
+
+def load_swap_predictor(base_predictor, params, batch_stats,
+                        model=None, **kwargs):
+    """Build the swapped-in generation's predictor from restored params.
+
+    THE seam new weights enter a live service through — every swap
+    source (a training run's fresh best checkpoint, a torch import)
+    funnels its restored ``params``/``batch_stats`` here, inheriting the
+    serving configuration (resolution, relax, guidance family, ...) from
+    the predictor already in service so the compiled-program ladder stays
+    compatible.  The ``serve/swap_params`` chaos site fires on the param
+    tree: a ``nan`` fault models a poisoned checkpoint arriving via
+    hot-swap, which the canary health check must roll back
+    (chaos scenario ``hot_swap_under_load``).
+    """
+    from ..chaos import sites as chaos_sites
+    from ..predict import Predictor
+
+    params = chaos_sites.fire("serve/swap_params", payload=params)
+    model = model if model is not None else base_predictor.model
+    for attr in ("resolution", "relax", "zero_pad", "alpha", "guidance",
+                 "in_channels"):
+        kwargs.setdefault(attr, getattr(base_predictor, attr))
+    kwargs.setdefault("mesh", getattr(base_predictor, "mesh", None))
+    return Predictor(model, params, batch_stats, **kwargs)
+
+
+class Generation:
+    """One resident param set + its health counters."""
+
+    __slots__ = ("gen_id", "predictor", "label", "state",
+                 "ok", "errors", "nonfinite", "inflight")
+
+    def __init__(self, gen_id: int, predictor, label: str,
+                 state: str = "active"):
+        self.gen_id = gen_id
+        self.predictor = predictor
+        self.label = label
+        self.state = state
+        self.ok = 0
+        self.errors = 0
+        self.nonfinite = 0
+        self.inflight = 0
+
+    def snapshot(self) -> dict:
+        return {"gen": self.gen_id, "label": self.label,
+                "state": self.state, "ok": self.ok, "errors": self.errors,
+                "nonfinite": self.nonfinite, "inflight": self.inflight}
+
+
+class SwapInProgressError(RuntimeError):
+    """begin_swap while a canary is still undecided — promote or roll
+    back first (two undecided canaries would make error attribution and
+    rollback targets ambiguous)."""
+
+
+class PredictorPool:
+    """Owns the predictor generations; thread-safe for the service's
+    submit threads + worker."""
+
+    def __init__(self, predictor, registry=None,
+                 canary_fraction: float = 0.1,
+                 min_observations: int = 20,
+                 max_error_rate: float = 0.1,
+                 promote_after: int | None = 50):
+        from ..telemetry.registry import get_registry
+
+        self._lock = threading.Lock()
+        self._gens: dict[int, Generation] = {
+            0: Generation(0, predictor, "initial", "active")}
+        self._next_id = 1
+        self._active = 0
+        self._canary: int | None = None
+        self._rr = 0  # stateless round-robin counter
+        self.canary_fraction = float(canary_fraction)
+        self.min_observations = int(min_observations)
+        self.max_error_rate = float(max_error_rate)
+        self.promote_after = promote_after
+        reg = registry or get_registry()
+        self._c_swap = {
+            outcome: reg.counter("serve_swaps_total",
+                                 "hot-swap decisions",
+                                 labels={"outcome": outcome})
+            for outcome in ("promoted", "rolled_back")}
+        self._base_swaps = {o: c.value for o, c in self._c_swap.items()}
+        self._g_live = reg.gauge("serve_params_generations_live",
+                                 "resident param generations")
+        self._g_live.set(1.0)
+
+    # ------------------------------------------------------------- routing
+
+    @property
+    def active_generation(self) -> int:
+        return self._active
+
+    @property
+    def canary_generation(self) -> int | None:
+        return self._canary
+
+    @property
+    def active_predictor(self):
+        return self._gens[self._active].predictor
+
+    def predictor_for(self, gen_id: int):
+        return self._gens[gen_id].predictor
+
+    def route(self, session_id: str | None) -> tuple[int, object]:
+        """(generation id, predictor) for a NEW session or a stateless
+        request.  Deterministic per session id (crc32 bucketing) so a
+        session that re-encodes mid-canary lands on the same side it
+        would have; stateless requests round-robin so a canary sees
+        traffic even from a single chatty client."""
+        with self._lock:
+            gen = self._active
+            if self._canary is not None:
+                if session_id is None:
+                    self._rr += 1
+                    frac = (self._rr % 1000) / 1000.0
+                else:
+                    frac = (zlib.crc32(session_id.encode("utf-8"))
+                            % 1000) / 1000.0
+                if frac < self.canary_fraction:
+                    gen = self._canary
+            g = self._gens[gen]
+            return gen, g.predictor
+
+    def track_inflight(self, gen_id: int, delta: int) -> None:
+        with self._lock:
+            g = self._gens.get(gen_id)
+            if g is not None:
+                g.inflight += delta
+
+    def is_resident(self, predictor) -> bool:
+        """Does any live generation still hold ``predictor``?  The
+        service uses this to drop ITS OWN base-predictor reference once
+        the generation retires — otherwise the constructor's param set
+        would stay pinned for the service's lifetime and every promote
+        would permanently grow the resident footprint."""
+        with self._lock:
+            return any(g.predictor is predictor
+                       for g in self._gens.values())
+
+    # ---------------------------------------------------------------- swap
+
+    def begin_swap(self, predictor, label: str = "",
+                   canary_fraction: float | None = None) -> int:
+        """Admit a new generation as the canary; returns its id.  The new
+        predictor must already be constructed (params resident) — loading
+        is the caller's move, so a failed restore can never leave the
+        pool half-swapped."""
+        with self._lock:
+            if self._canary is not None:
+                raise SwapInProgressError(
+                    f"generation {self._canary} is still canarying — "
+                    "promote() or rollback() before swapping again")
+            gen_id = self._next_id
+            self._next_id += 1
+            self._gens[gen_id] = Generation(
+                gen_id, predictor, label or f"swap-{gen_id}", "canary")
+            self._canary = gen_id
+            if canary_fraction is not None:
+                self.canary_fraction = float(canary_fraction)
+            self._publish()
+            return gen_id
+
+    def observe(self, gen_id: int, ok: bool,
+                nonfinite: bool = False) -> str | None:
+        """Record one request outcome; returns the decision it triggered
+        (``'promoted'`` | ``'rolled_back'``) or None.  The service calls
+        this from the worker after every resolved request and reacts to
+        the action (rollback -> evict that generation's sessions)."""
+        with self._lock:
+            g = self._gens.get(gen_id)
+            if g is None:
+                return None
+            if ok and not nonfinite:
+                g.ok += 1
+            else:
+                g.errors += 1
+                if nonfinite:
+                    g.nonfinite += 1
+            if gen_id != self._canary:
+                return None
+            # decision table, most urgent first
+            if g.nonfinite:
+                return self._rollback_locked()
+            total = g.ok + g.errors
+            if (total >= self.min_observations
+                    and g.errors / total > self.max_error_rate):
+                return self._rollback_locked()
+            if (self.promote_after is not None
+                    and g.ok >= self.promote_after
+                    and (total == 0
+                         or g.errors / total <= self.max_error_rate)):
+                return self._promote_locked()
+            return None
+
+    def promote(self) -> dict:
+        """Manually promote the canary to active (old active drains)."""
+        with self._lock:
+            if self._canary is None:
+                raise RuntimeError("no canary generation to promote")
+            self._promote_locked()
+            return self.snapshot_locked()
+
+    def rollback(self) -> dict:
+        """Manually roll the canary back (its sessions must be evicted by
+        the caller — see :meth:`observe`'s contract)."""
+        with self._lock:
+            if self._canary is None:
+                raise RuntimeError("no canary generation to roll back")
+            self._rollback_locked()
+            return self.snapshot_locked()
+
+    def gc(self, sessions_by_generation: dict[int, int]) -> list[int]:
+        """Retire drained generations: draining/retired, no live sessions
+        in the store, nothing in flight.  Returns the ids whose params
+        were just released."""
+        freed = []
+        with self._lock:
+            for gen_id, g in list(self._gens.items()):
+                if gen_id in (self._active, self._canary):
+                    continue
+                if (g.inflight == 0
+                        and sessions_by_generation.get(gen_id, 0) == 0
+                        and g.predictor is not None):
+                    g.predictor = None  # params freed with the last ref
+                    g.state = "retired"
+                    freed.append(gen_id)
+            if freed:
+                self._publish()
+        return freed
+
+    # ---------------------------------------------------------------- ops
+
+    def swaps(self) -> dict:
+        """{'promoted': n, 'rolled_back': n} since pool construction."""
+        return {o: int(c.value - self._base_swaps[o])
+                for o, c in self._c_swap.items()}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return self.snapshot_locked()
+
+    def snapshot_locked(self) -> dict:
+        return {
+            "active": self._active,
+            "canary": self._canary,
+            "canary_fraction": self.canary_fraction,
+            "swaps": {o: int(c.value - self._base_swaps[o])
+                      for o, c in self._c_swap.items()},
+            "generations": [g.snapshot()
+                            for _, g in sorted(self._gens.items())],
+        }
+
+    # ------------------------------------------------------------ internals
+
+    def _promote_locked(self) -> str:
+        old_active = self._gens[self._active]
+        self._gens[self._canary].state = "active"
+        self._active = self._canary
+        self._canary = None
+        old_active.state = "draining"
+        self._c_swap["promoted"].inc()
+        self._publish()
+        return "promoted"
+
+    def _rollback_locked(self) -> str:
+        g = self._gens[self._canary]
+        g.state = "draining"   # in-flight canary work still needs params
+        self._canary = None
+        self._c_swap["rolled_back"].inc()
+        self._publish()
+        return "rolled_back"
+
+    def _publish(self) -> None:
+        self._g_live.set(float(sum(
+            1 for g in self._gens.values() if g.predictor is not None)))

@@ -225,6 +225,17 @@ class ModelConfig:
     ccnet_recurrence: int = 2           # CCNet: weight-shared criss-cross
                                         # steps (R=2 = full-image receptive
                                         # field through one hop)
+    guidance_inject: str = "stem"       # DANet: where the click-guidance
+                                        # channel enters — 'stem'
+                                        # (reference parity: backbone sees
+                                        # the 4-channel concat) or 'head'
+                                        # (backbone sees RGB only; the
+                                        # guidance joins at the head via a
+                                        # zero-init 1x1 projection), which
+                                        # makes the backbone encoding
+                                        # reusable across a session's
+                                        # refinement clicks
+                                        # (serve/sessions.py)
 
 
 @dataclass

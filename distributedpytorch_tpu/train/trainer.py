@@ -465,7 +465,8 @@ class Trainer:
             moe_capacity_factor=cfg.model.moe_capacity_factor,
             aux_head=cfg.model.aux_head,
             encnet_codes=cfg.model.encnet_codes,
-            ccnet_recurrence=cfg.model.ccnet_recurrence)
+            ccnet_recurrence=cfg.model.ccnet_recurrence,
+            guidance_inject=cfg.model.guidance_inject)
         steps_per_epoch = len(self.train_loader)  # > 0: guarded above
         # Each loaded batch is stepped data.echo times, so schedules (poly
         # decay, warmup fractions) must span echo x the loader length or
@@ -676,8 +677,18 @@ class Trainer:
                 return old  # leaf absent from the checkpoint (partial)
             imported[0] += 1
             # numpy -> sharded device array in one hop, preserving the
-            # leaf's existing mesh placement (replicated or TP-sharded)
-            return jax.device_put(np.asarray(new), old.sharding)
+            # leaf's existing mesh placement (replicated or TP-sharded).
+            # DONATION SAFETY (the checkpoint.restore lesson): on CPU,
+            # device_put of a host numpy array can be ZERO-COPY — the
+            # jax.Array aliases the numpy buffer — and the first train
+            # step DONATES these leaves, handing XLA memory that the
+            # import pipeline still references.  That intermittently
+            # surfaced as a non-finite first loss from a clean batch and
+            # correct imported weights (timing-dependent: whether the
+            # put aliases depends on allocator state).  jnp.copy
+            # re-buffers into XLA-owned memory, donation-safe on every
+            # backend — one extra copy, paid once at warm start.
+            return jnp.copy(jax.device_put(np.asarray(new), old.sharding))
 
         self.state = self.state.replace(
             params=jax.tree.map(place, params, self.state.params),

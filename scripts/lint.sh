@@ -4,13 +4,18 @@
 #   1. jaxlint — AST-level TPU hazards over everything device-adjacent:
 #      the package (serve/ included — the batcher feeds a jitted forward
 #      and is exactly the code whose silent retraces the rules exist to
-#      catch; telemetry/ included — instrumentation sits at step-loop
-#      boundaries and must never smuggle a host sync into them; chaos/
-#      included — its injection sites are woven INTO those loops and the
-#      disabled path must stay one attribute check, no host syncs) plus
-#      bench.py, the official record.
+#      catch, and serve/sessions.py + serve/swap.py specifically: the
+#      session feature cache holds device buffers across requests and
+#      the swap pool routes between per-generation compiled programs,
+#      both one silent retrace away from a latency cliff; telemetry/
+#      included — instrumentation sits at step-loop boundaries and must
+#      never smuggle a host sync into them; chaos/ included — its
+#      injection sites are woven INTO those loops and the disabled path
+#      must stay one attribute check, no host syncs) plus bench.py, the
+#      official record.
 #   2. jaxaudit check — IR-level compile contracts: the canonical
-#      train/eval/serve programs are re-traced on the pinned 8-device
+#      train/eval/serve programs (incl. the session split's
+#      encode_step/decode_step) are re-traced on the pinned 8-device
 #      CPU topology and diffed against tests/contracts/ (collective
 #      counts, output shapes, donation aliasing, baked constants,
 #      FLOPs bounds).  After a REVIEWED program change, regenerate with

@@ -44,6 +44,43 @@ def fake_voc_root(tmp_path_factory):
     return make_fake_voc(str(root), n_images=6, size=(120, 160), n_val=2, seed=0)
 
 
+def _make_serve_predictor(guidance_inject: str):
+    import jax
+    import optax
+
+    from distributedpytorch_tpu.models import build_model
+    from distributedpytorch_tpu.parallel import create_train_state
+    from distributedpytorch_tpu.predict import Predictor
+
+    model = build_model("danet", nclass=1, backbone="resnet18",
+                        output_stride=8, guidance_inject=guidance_inject)
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(1e-3), (1, 64, 64, 4))
+    return Predictor(model, state.params, state.batch_stats,
+                     resolution=(64, 64), relax=10)
+
+
+@pytest.fixture(scope="session")
+def serve_stem_predictor():
+    """ONE stem (whole-forward) serve predictor per test session: the
+    predictor's jit cache holds the bucket ladder's compiled programs —
+    the heaviest compile-bearing fixture of the serve modules — and the
+    telemetry/lowering + jaxaudit trace caches key on the fn identity,
+    so sharing the instance across modules shares every one of those
+    compiles instead of re-paying them per module.  Tests that COUNT
+    compiles or monkeypatch forwards build their own private
+    predictors."""
+    return _make_serve_predictor("stem")
+
+
+@pytest.fixture(scope="session")
+def serve_split_predictor():
+    """The session-serving (encode/decode split) sibling, same sharing
+    rationale — two compiled stages per bucket make it twice as
+    compile-heavy as the stem ladder."""
+    return _make_serve_predictor("head")
+
+
 @pytest.fixture()
 def rng():
     return np.random.default_rng(1234)

@@ -155,7 +155,10 @@ class TestTrainStep:
         assert leaf.sharding.is_equivalent_to(replicated_sharding(mesh),
                                               leaf.ndim)
 
+    @pytest.mark.slow
     def test_overfits_fixed_batch(self, mesh, state_and_model):
+        # ~27s of convergence steps; the fast loss-decreases smoke above
+        # keeps the train-step path tier-1-covered
         """The can-it-learn signal: repeated steps on one fixed batch must
         drive the loss well below its starting point (not merely move
         params).  Guards the whole grads->update->BN-stats chain against

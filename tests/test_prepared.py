@@ -396,6 +396,31 @@ class TestTrainerIntegration:
         assert tr.train_set.n_prepared == len(tr.train_set)
         tr.close()
 
+    def test_steps_per_dispatch_smoke(self, tmp_path):
+        """Thin tier-1 smoke of the multi-step dispatch path: the fake
+        fixture at tiny shapes takes the 2-chunk path + the 1-batch tail
+        through a real fit — the full-pipeline variants (prepared cache +
+        uint8 wire + device guidance at 96x128, ~80s apiece) are `slow`."""
+        from tests.test_train import make_tiny_cfg
+        from distributedpytorch_tpu.data import make_fake_voc
+        from distributedpytorch_tpu.train import Trainer
+        root = make_fake_voc(str(tmp_path / "voc"), n_images=20,
+                             size=(96, 128), n_val=2, seed=4)
+        cfg = make_tiny_cfg(str(tmp_path / "runs"))
+        cfg = dataclasses.replace(
+            cfg, epochs=1, eval_every=0,
+            data=dataclasses.replace(cfg.data, fake=False, root=root,
+                                     train_batch=8, crop_size=(48, 48),
+                                     steps_per_dispatch=2))
+        tr = Trainer(cfg)
+        n_batches = len(tr.train_loader)
+        assert n_batches >= 3  # chunk + tail both exercised
+        history = tr.fit()
+        assert all(np.isfinite(l) for l in history["train_loss"])
+        assert int(tr.state.step) == n_batches
+        tr.close()
+
+    @pytest.mark.slow
     def test_fit_with_steps_per_dispatch(self, tmp_path):
         """Multi-step dispatch through the full Trainer: a 3-batch epoch at
         steps_per_dispatch=2 takes the 2-chunk path AND the 1-batch tail;
@@ -450,6 +475,7 @@ class TestTrainerIntegration:
         assert all(np.isfinite(r["train/loss"]) for r in logged)
         return n_steps, logged
 
+    @pytest.mark.slow
     def test_steps_per_dispatch_logs_at_boundary_steps(self, tmp_path):
         """The train/loss curve must be attributed to the step that crossed
         the log cadence, indexing that step's element of the (K,) dispatch
@@ -462,6 +488,7 @@ class TestTrainerIntegration:
         assert [r["step"] for r in logged] == \
             [3 * i for i in range(1, n_steps // 3 + 1)]
 
+    @pytest.mark.slow
     def test_dispatch_crossing_multiple_boundaries_logs_each(self, tmp_path):
         """K > log_every_steps: one dispatch crosses several cadence
         boundaries and every one must get its own train/loss point, not

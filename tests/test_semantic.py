@@ -483,7 +483,12 @@ class TestBf16ProbsWire:
 
     def test_bf16_wire_actually_ships_bf16(self, tmp_path, monkeypatch):
         """The cast must happen ON DEVICE, upstream of the device_get —
-        otherwise the knob pays bf16 rounding for zero wire savings."""
+        otherwise the knob pays bf16 rounding for zero wire savings.
+
+        eval_device_fullres must be OFF here: the device-side
+        fullres_argmax fast path ships only uint8 class maps (no prob
+        volume ever crosses the wire), so the spy below would observe
+        nothing — the bf16-wire knob is the fallback path's contract."""
         import sys
 
         import jax.numpy as jnp
@@ -497,7 +502,8 @@ class TestBf16ProbsWire:
             return real(arr)
 
         monkeypatch.setattr(eval_mod, "_local_rows", spy)
-        tr = self._trained(tmp_path, ["eval_full_res=true"])
+        tr = self._trained(tmp_path, ["eval_full_res=true",
+                                      "eval_device_fullres=false"])
         tr.validate(log_panels=False)
         tr.close()
         assert dtypes and all(dt == jnp.bfloat16 for dt in dtypes), dtypes

@@ -56,8 +56,10 @@ def _make_predictor(res=64):
 
 
 @pytest.fixture(scope="module")
-def predictor():
-    return _make_predictor()
+def predictor(serve_stem_predictor):
+    # session-scoped (conftest): the bucket ladder's compiled programs
+    # are shared across every module that serves this predictor
+    return serve_stem_predictor
 
 
 class TestBuckets:
@@ -487,6 +489,49 @@ class TestHttpEndToEnd:
             if not line.startswith("#"):
                 assert line_re.match(line), f"unparseable: {line!r}"
 
+    def test_debug_trace_endpoint_arms_and_rejects_concurrent(
+            self, predictor, tmp_path):
+        """Thin tier-1 smoke of the /debug/trace surface: arming answers
+        202 with a target dir, a second arm answers 409, and stopping
+        with no traffic cancels the never-started capture cleanly.  The
+        full XPlane-files-on-disk assertion (a real jax.profiler capture,
+        ~60s on CPU) is the `slow` variant below."""
+        import json
+        import urllib.error
+        import urllib.request
+        from http.server import ThreadingHTTPServer
+
+        from distributedpytorch_tpu.serve.__main__ import (
+            _HealthCache,
+            make_handler,
+        )
+        from distributedpytorch_tpu.telemetry import TraceCapture
+
+        svc = InferenceService(
+            predictor, max_batch=4, queue_depth=16, max_wait_s=0.002,
+            trace=TraceCapture(str(tmp_path), default_steps=1))
+        svc.start()
+        httpd = ThreadingHTTPServer(
+            ("127.0.0.1", 0), make_handler(svc, _HealthCache()))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            req = urllib.request.Request(url + "/debug/trace?steps=1",
+                                         data=b"", method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 202
+                assert json.loads(r.read())["trace_dir"]
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    urllib.request.Request(url + "/debug/trace", data=b"",
+                                           method="POST"), timeout=30)
+            assert e.value.code == 409
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            svc.stop()   # no batch ran: the armed capture cancels
+
+    @pytest.mark.slow
     def test_debug_trace_endpoint_captures_bounded_trace(
             self, predictor, tmp_path):
         import json

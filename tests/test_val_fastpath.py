@@ -370,6 +370,25 @@ class TestTrainerIntegration:
         assert abs(m_bf16["jaccard"] - m_f32["jaccard"]) < 1e-2
         tr.close()
 
+    def test_val_overlap_smoke(self, fake_voc_root, tmp_path):
+        """Thin tier-1 smoke: one overlapped fit completes with a val
+        entry per epoch and a best checkpoint.  The serial-vs-overlap
+        curve-parity A/B (two 3-epoch fits, ~25s) is the `slow` variant
+        below."""
+        import glob
+
+        from distributedpytorch_tpu.train import Trainer
+
+        tr = Trainer(self._cfg(fake_voc_root, tmp_path / "ov",
+                               **{"epochs": 2, "val_overlap": "true"}))
+        hist = tr.fit()
+        tr.close()
+        assert len(hist["val"]) == 2
+        assert all(np.isfinite(v["jaccard"]) for v in hist["val"])
+        assert glob.glob(str(tmp_path / "ov" / "**" / "best*"),
+                         recursive=True), "no best checkpoint"
+
+    @pytest.mark.slow
     def test_val_overlap_matches_serial_fit(self, fake_voc_root, tmp_path):
         """val_overlap runs each validation concurrently with the next
         train epoch.  The evaluated states are identical to the serial
