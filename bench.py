@@ -103,6 +103,9 @@ from distributedpytorch_tpu.telemetry.goodput import (  # noqa: E402
 )
 from distributedpytorch_tpu.chaos import sites as chaos_sites  # noqa: E402
 from distributedpytorch_tpu.telemetry import get_accountant  # noqa: E402
+from distributedpytorch_tpu.train.sentinel import (  # noqa: E402
+    recovery_block,
+)
 
 
 def ir_audit_fields(fn, args, program: str) -> dict:
@@ -442,6 +445,10 @@ def serve_bench() -> None:
     record["chaos"] = chaos_sites.active_scenario()
     # sessions block: null outside --sessions mode, key always present
     record["sessions"] = _sessions_block(None, None)
+    # recovery block (self-healing, train/sentinel.py): keys always
+    # present, all null — the bench's burst loop never runs Trainer.fit,
+    # so there is no sentinel to roll anything back
+    record["recovery"] = recovery_block()
     # IR-audit fields: the top bucket's forward (the program serving the
     # measured burst), same schema as the train record.  Config-named —
     # never the canonical serve_forward_b<N> names, whose contracts pin
@@ -573,6 +580,7 @@ def serve_sessions_bench() -> None:
         k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
     record["mfu"] = None
     record["chaos"] = chaos_sites.active_scenario()
+    record["recovery"] = recovery_block()  # null block; key stability
     # IR audit of the warm hot path (the decode program at the top
     # bucket) — config-named, same convention as the burst bench
     feats = predictor.feature_struct(1)
@@ -751,6 +759,10 @@ def main() -> None:
     # sessions block: a serve-mode concept, null on train records — key
     # always present (schema stability)
     record["sessions"] = _sessions_block(None, None)
+    # recovery block (train/sentinel.py): rollbacks / quarantined_steps /
+    # supervisor_restarts / recovery_p50_s — keys always present, null
+    # when the sentinel is off (this synthetic step loop never arms it)
+    record["recovery"] = recovery_block()
     # IR-audit fields (jaxaudit): collective inventory of the exact
     # compiled step + compile-contract status; keys always present
     record.update(audit_fields)

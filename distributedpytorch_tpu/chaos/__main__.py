@@ -6,7 +6,12 @@ invariants::
     dptpu-chaos preempt_mid_epoch            # SIGTERM -> resume, exact
     dptpu-chaos truncated_checkpoint         # torn file -> fallback
     dptpu-chaos serve_latency_shed           # saturation -> 429/504
-    dptpu-chaos nan_loss                     # poisoned loss -> logged
+    dptpu-chaos nan_loss                     # poison -> rollback+replay
+    dptpu-chaos nan_loss_legacy              # sentinel off: log+continue
+    dptpu-chaos divergence_rollback          # mid-run poison -> rollback
+                                             # to a COMMITTED checkpoint
+    dptpu-chaos crash_loop                   # SIGKILL x3 -> supervisor
+    dptpu-chaos preemption_storm             # SIGTERM storm -> exact chain
     dptpu-chaos my_scenario.json
     dptpu-chaos --list
     dptpu-chaos --plan preempt_mid_epoch     # print the plan JSON (for

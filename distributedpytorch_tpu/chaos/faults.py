@@ -18,6 +18,11 @@ Fault kinds:
   filled with NaN, scalars replaced — the divergence-detection driver;
 * ``sigterm``   — deliver SIGTERM to this process (preemption, the real
   signal through the real handler — nothing is simulated);
+* ``sigkill``   — SIGKILL this process: a hard crash with no graceful
+  stop, no final checkpoint, no exit handler (OOM-killer / scheduler
+  kill semantics) — the supervisor scenarios' driver.  The firing can
+  only be booked by a SURVIVING observer (the supervisor's restart
+  counters); this process's registry dies with it;
 * ``truncate``  — cut the tail off a file under the site's ``path``
   context (torn checkpoint write / post-commit corruption).
 
@@ -32,10 +37,11 @@ import json
 import os
 import random
 import signal
+import sys
 import threading
 import time
 
-KINDS = ("latency", "error", "nan", "sigterm", "truncate")
+KINDS = ("latency", "error", "nan", "sigterm", "sigkill", "truncate")
 
 
 class InjectedFaultError(RuntimeError):
@@ -283,6 +289,15 @@ class FaultPlan:
                     f"(visit {visit}, plan {self.name!r})")
             elif spec.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
+            elif spec.kind == "sigkill":
+                # flush whatever the process has written — the POINT is
+                # that nothing else (handlers, atexit, orbax waits) runs
+                try:
+                    sys.stdout.flush()
+                    sys.stderr.flush()
+                except Exception:
+                    pass
+                os.kill(os.getpid(), signal.SIGKILL)
             elif spec.kind == "truncate":
                 path = ctx.get("path")
                 if not path:

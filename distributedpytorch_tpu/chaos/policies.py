@@ -101,6 +101,13 @@ class Retry:
             b *= 1.0 + self._rng.uniform(-self.jitter, self.jitter)
         return b
 
+    def sleep(self, seconds: float) -> None:
+        """Nap through this policy's (injectable) sleep — the public
+        surface for callers that drive their own retry loop but want the
+        policy's backoff curve and test injection (the supervisor)."""
+        if seconds > 0:
+            (self._sleep or time.sleep)(seconds)
+
     def call(self, fn: Callable[[], Any], *,
              retry_on: tuple = (Exception,),
              until: Callable[[Any], bool] | None = None,

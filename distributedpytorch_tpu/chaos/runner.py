@@ -24,7 +24,11 @@ Modes:
   runner's own process;
 * ``serve``      — an in-process :class:`serve.InferenceService` burst
   under injected drain latency, asserting the service SHEDS (429/504)
-  rather than crashing and serves again once the plan is disarmed.
+  rather than crashing and serves again once the plan is disarmed;
+* ``supervise``  — a REAL :class:`train.supervise.Supervisor` driving
+  chaos child processes through SIGKILL crashes (``crash_loop``) or a
+  SIGTERM storm (``preemption_storm``): every restart resumes from a
+  committed checkpoint and the final trajectory completes the schedule.
 
 Every run returns a report dict carrying per-invariant verdicts, the
 ``chaos_injected_total{site,kind}`` firings (child-process firings are
@@ -35,7 +39,6 @@ the whole scenario), and the measured recovery time, observed into the
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import subprocess
@@ -138,12 +141,30 @@ SCENARIOS: dict[str, dict] = {
                        "sessions_survive_swap",
                        "bad_canary_rolled_back"],
     },
-    # NaN-poison the observed loss of one step: the trainer's
-    # non-finite sweep logs train/nonfinite_steps, the fit CONTINUES
-    # (debug_asserts off — production posture), and the final metrics
-    # are finite because the state itself never saw the poison.
+    # NaN-poison the observed loss of one step WITH the step-health
+    # sentinel armed: the run must RECOVER, not merely survive — the
+    # sentinel's 'diverged' verdict rolls the trainer back to the last
+    # committed checkpoint (the step-0 checkpoint fit() lands when the
+    # sentinel is on), the poisoned window is quarantined to
+    # run_dir/quarantine.jsonl, the replay skips it, and the schedule
+    # still finishes with finite metrics — zero manual intervention.
     "nan_loss": {
         "name": "nan_loss",
+        "mode": "fit",
+        "plan": {"seed": 0, "faults": [
+            {"site": "trainer/train_step", "kind": "nan", "at": [2]}]},
+        "overrides": {"epochs": 1, "eval_every": 1, "log_every_steps": 1,
+                      "debug_asserts": False, "sentinel.enabled": True},
+        "params": {"big_dataset": True, "n_images": 16},
+        "invariants": ["rollback_fired", "quarantine_written",
+                       "fit_completes", "final_metrics_finite"],
+    },
+    # The pre-sentinel contract, pinned for back-compat: with
+    # sentinel off the trainer's only response to a poisoned loss is
+    # log-and-continue (train/nonfinite_steps), the fit completes and
+    # final metrics stay finite because the state never saw the poison.
+    "nan_loss_legacy": {
+        "name": "nan_loss_legacy",
         "mode": "fit",
         "plan": {"seed": 0, "faults": [
             {"site": "trainer/train_step", "kind": "nan", "at": [1]}]},
@@ -151,6 +172,64 @@ SCENARIOS: dict[str, dict] = {
                       "debug_asserts": False},
         "invariants": ["nonfinite_steps_logged", "fit_completes",
                        "final_metrics_finite"],
+    },
+    # The headline self-healing scenario: NaN-poison strikes MID-RUN,
+    # after real checkpoints have committed.  The sentinel rolls back to
+    # the newest COMMITTED snapshot (not the initial state), quarantines
+    # the poisoned window, replays past it, and the run finishes with
+    # finite metrics — the "runs heal themselves" acceptance gate.
+    "divergence_rollback": {
+        "name": "divergence_rollback",
+        "mode": "fit",
+        "plan": {"seed": 0, "faults": [
+            {"site": "trainer/train_step", "kind": "nan", "at": [10]}]},
+        "overrides": {"epochs": 2, "eval_every": 1, "log_every_steps": 1,
+                      "checkpoint.snapshot_every": 1,
+                      "debug_asserts": False, "sentinel.enabled": True},
+        "params": {"big_dataset": True},
+        "invariants": ["rollback_fired", "rolled_back_to_committed",
+                       "quarantine_written", "fit_completes",
+                       "final_metrics_finite"],
+    },
+    # SIGKILL mid-epoch, three times: no graceful stop, no final save —
+    # the supervisor must restart each corpse, every restart must resume
+    # from a COMMITTED checkpoint whose meta digest matches the restored
+    # params byte-for-byte (checkpoint.digest), and the final trajectory
+    # must complete the schedule.  The kill lands at per-process visit
+    # 10 (> one epoch of steps), so every attempt first commits fresh
+    # progress — which is exactly what keeps the supervisor's crash-loop
+    # detector (3 identical no-progress crashes) from giving up.
+    "crash_loop": {
+        "name": "crash_loop",
+        "mode": "supervise",
+        "plan": {"seed": 0, "faults": [
+            {"site": "trainer/train_step", "kind": "sigkill",
+             "at": [10]}]},
+        "overrides": {"epochs": 4, "eval_every": 0,
+                      "checkpoint.snapshot_every": 1,
+                      "checkpoint.digest": True},
+        "params": {"big_dataset": True, "expected_crashes": 3,
+                   "max_restarts": 8},
+        "invariants": ["supervisor_recovered_each_crash",
+                       "restored_digest_matches_committed",
+                       "completed_schedule"],
+    },
+    # Repeated SIGTERM across epochs: every wave stops gracefully
+    # (consensus stop -> exact-resume checkpoint), the supervisor
+    # restarts without backoff, and across the whole storm not one
+    # optimizer step is lost or duplicated — the PR 5 invariant,
+    # extended over N process generations.
+    "preemption_storm": {
+        "name": "preemption_storm",
+        "mode": "supervise",
+        "plan": {"seed": 0, "faults": [
+            {"site": "trainer/train_step", "kind": "sigterm",
+             "at": [4]}]},
+        "overrides": {"epochs": 2, "checkpoint.preempt_check_every": 1},
+        "params": {"big_dataset": True, "expected_preemptions": 3,
+                   "max_restarts": 8},
+        "invariants": ["preempted_each_wave", "exact_resume_chain",
+                       "zero_lost_or_duplicated_steps_storm"],
     },
 }
 
@@ -170,14 +249,14 @@ def load_scenario(name_or_path: str) -> dict:
 
 def param_digest(tree) -> str:
     """Order-stable sha256 over a param tree's raw bytes — the
-    restored-vs-saved equality check that works across processes."""
-    import jax
-    import numpy as np
+    restored-vs-saved equality check that works across processes.
+    Canonical implementation lives in train/checkpoint.py (the
+    ``checkpoint.digest`` config stamps the same digest into save
+    metas, which is what makes the crash_loop scenario's continuity
+    check possible across SIGKILLed processes)."""
+    from ..train.checkpoint import param_digest as _param_digest
 
-    h = hashlib.sha256()
-    for leaf in jax.tree.leaves(tree):
-        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
-    return h.hexdigest()
+    return _param_digest(tree)
 
 
 class RecordingWriter:
@@ -213,6 +292,38 @@ class RecordingWriter:
         the right read for per-epoch counts like train/nonfinite_steps,
         which the trainer emits once per epoch with that epoch's tally."""
         return sum(m[key] for _step, m in self.scalars_seen if key in m)
+
+
+def _maybe_big_dataset(params: dict, overrides: dict,
+                       work_dir: str) -> dict:
+    """``params.big_dataset``: several batches per epoch, so something
+    can strike (and be quarantined / resumed past) MID-epoch — the
+    trainer's own fake fixture is ~1 batch.  ``params.n_images`` sizes
+    it (default 32 ≈ 7 batches/epoch; the tier-1 nan_loss smoke uses 16
+    to stay inside the suite budget)."""
+    if params.get("big_dataset"):
+        from ..data import make_fake_voc
+
+        overrides = dict(overrides)
+        overrides["data.root"] = make_fake_voc(
+            os.path.join(work_dir, "voc"),
+            n_images=int(params.get("n_images", 32)), size=(96, 128),
+            n_val=2, seed=0)
+    return overrides
+
+
+def _read_quarantine(run_dir: str) -> list[dict]:
+    """Parsed ``quarantine.jsonl`` records (empty when none written)."""
+    path = os.path.join(run_dir, "quarantine.jsonl")
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    records.append(json.loads(line))
+    except OSError:
+        pass
+    return records
 
 
 def _build_cfg(overrides: dict, work_dir: str):
@@ -283,15 +394,26 @@ def child_fit(spec_path: str) -> int:
         "resume_start_batch": tr._resume_start_batch,
         "restore_fallback": list(getattr(tr, "resume_fallback_steps", [])),
         "param_digest_at_restore": param_digest(tr.state.params),
+        # the digest the restored checkpoint's meta CLAIMS
+        # (checkpoint.digest runs; None otherwise) — byte-identical
+        # restore is provable even when this process is later SIGKILLed
+        "restored_meta_digest": tr.resume_meta.get("param_digest"),
     }
+    # Preflight sidecar, BEFORE fit: a supervised child that dies
+    # mid-fit (sigkill faults) still leaves its restore evidence for
+    # the parent's continuity invariants.
+    with open(spec["report"] + ".pre", "w") as f:
+        json.dump(report, f)
     history = tr.fit()
     report.update({
         "final_step": int(tr.state.step),
         "preempted": bool(history.get("preempted")),
         "epochs_recorded": len(history["train_loss"]),
         "latest_step": tr.ckpt.latest_step(),
-        "saved_steps": sorted(int(s) for s in tr.ckpt._mgr.all_steps()),
+        "saved_steps": tr.ckpt.all_steps(),
         "param_digest": param_digest(tr.state.params),
+        "recovery": history.get("recovery"),
+        "quarantine": _read_quarantine(tr.run_dir),
     })
     tr.close()
     if plan is not None:
@@ -339,15 +461,8 @@ def _run_child(spec: dict, tag: str, scratch: str, timeout_s: float = 600
 
 def _run_fit_resume(sc: dict, work_dir: str) -> dict:
     params = sc.get("params") or {}
-    overrides = dict(sc.get("overrides") or {})
-    if params.get("big_dataset"):
-        # one epoch must span several batches or nothing can stop
-        # mid-epoch (the trainer's own fake fixture is ~1 batch)
-        from ..data import make_fake_voc
-
-        overrides["data.root"] = make_fake_voc(
-            os.path.join(work_dir, "voc"), n_images=32, size=(96, 128),
-            n_val=2, seed=0)
+    overrides = _maybe_big_dataset(params, dict(sc.get("overrides") or {}),
+                                   work_dir)
     p1 = _run_child({"phase": "fault", "plan": sc.get("plan"),
                      "overrides": overrides, "work_dir": work_dir},
                     "fault", work_dir)
@@ -374,21 +489,34 @@ def _run_fit(sc: dict, work_dir: str) -> dict:
     plan = FaultPlan.from_dict(dict(sc.get("plan") or {},
                                     name=sc["name"]))
     writer = RecordingWriter()
-    cfg = _build_cfg(sc.get("overrides") or {}, work_dir)
+    overrides = _maybe_big_dataset(sc.get("params") or {},
+                                   dict(sc.get("overrides") or {}),
+                                   work_dir)
+    cfg = _build_cfg(overrides, work_dir)
     with sites.armed_plan(plan):
         tr = Trainer(cfg, writers=writer)
+        nb = len(tr.train_loader)
         t0 = time.perf_counter()
         history = tr.fit()
         fit_s = time.perf_counter() - t0
         tr.close()
-    _observe_recovery(sc["name"], fit_s)
+    # sentinel scenarios: recovery = the measured rollback restore
+    # time(s), not the whole fit (a fit that mostly trains healthily
+    # would otherwise read as slow recovery)
+    rec = history.get("recovery") or {}
+    recovery_s = rec.get("recovery_p50_s")
+    _observe_recovery(sc["name"],
+                      fit_s if recovery_s is None else recovery_s)
     return {"phases": {"fit": {
+        "nb": nb,
         "final_step": int(tr.state.step),
         "epochs_recorded": len(history["train_loss"]),
         "val": history["val"],
         "nonfinite_steps_logged": writer.total("train/nonfinite_steps"),
         "preempted": bool(history.get("preempted")),
-    }}, "recovery_s": round(fit_s, 3),
+        "recovery": history.get("recovery"),
+        "quarantine": _read_quarantine(tr.run_dir),
+    }}, "recovery_s": round(fit_s if recovery_s is None else recovery_s, 3),
         "firings": plan.injected_total()}
 
 
@@ -620,6 +748,81 @@ def _run_serve_swap(sc: dict, work_dir: str) -> dict:
         "firings": plan.injected_total()}
 
 
+def _run_supervise(sc: dict, work_dir: str) -> dict:
+    """crash_loop / preemption_storm: a REAL supervisor
+    (train/supervise.Supervisor) drives chaos child processes.  Every
+    attempt is ``dptpu-chaos --child`` with its own spec/report pair and
+    ``resume=auto``; the armed plan rides in each spec, so per-process
+    visit schedules decide which attempts get struck (an attempt whose
+    remaining steps stay below the fault's visit index completes
+    cleanly — the storm ends by construction, not by disarming)."""
+    from ..backend_health import pin_cpu8_topology
+    from ..train.supervise import CrashLoopError, Supervisor
+    from .policies import Retry
+
+    params = dict(sc.get("params") or {})
+    overrides = _maybe_big_dataset(params, dict(sc.get("overrides") or {}),
+                                   work_dir)
+    overrides["resume"] = "auto"  # harmless on attempt 0 (no prior run)
+
+    def make_argv(attempt: int) -> list[str]:
+        spec = {"phase": f"attempt{attempt}", "plan": sc.get("plan"),
+                "overrides": overrides, "work_dir": work_dir,
+                "report": os.path.join(work_dir,
+                                       f"report_attempt{attempt}.json")}
+        path = os.path.join(work_dir, f"spec_attempt{attempt}.json")
+        with open(path, "w") as f:
+            json.dump(spec, f)
+        return [sys.executable, "-m", "distributedpytorch_tpu.chaos",
+                "--child", path]
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = pin_cpu8_topology(dict(os.environ))
+    env.pop(sites.PLAN_ENV, None)  # the plan rides in the specs
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    sup = Supervisor(
+        make_argv, work_dir=work_dir,
+        max_restarts=int(params.get("max_restarts", 8)),
+        crash_loop_threshold=int(params.get("crash_loop_threshold", 3)),
+        # test-scale naps: the schedule shape is Retry's, the constants
+        # are not what the scenario asserts
+        backoff=Retry(base_s=0.05, cap_s=0.2),
+        env=env, capture_output=True)
+    try:
+        sreport = sup.run()
+    except CrashLoopError as e:
+        sreport = e.report  # a failed invariant, not a runner crash
+    attempts = []
+    for k in range(sreport["attempts"]):
+        rp = os.path.join(work_dir, f"report_attempt{k}.json")
+        at: dict = {"attempt": k, "completed_report": False}
+        try:
+            with open(rp + ".pre") as f:
+                at.update(json.load(f))
+        except (OSError, ValueError):
+            pass
+        try:
+            with open(rp) as f:
+                full = json.load(f)
+            at.update(full)
+            at["completed_report"] = True
+            _book_child_firings(full)
+        except (OSError, ValueError):
+            pass  # SIGKILLed attempt: preflight evidence only
+        attempts.append(at)
+    # recovery = supervisor downtime per restart (child death -> next
+    # child spawned), each observed into the histogram
+    downtimes = sreport.get("recovery_seconds") or []
+    for s in downtimes:
+        _observe_recovery(sc["name"], s)
+    recovery_s = max(downtimes) if downtimes else 0.0
+    return {"phases": {"supervise": {
+        "supervisor": sreport,
+        "attempts": attempts,
+    }}, "recovery_s": round(recovery_s, 3)}
+
+
 # -------------------------------------------------------------- invariants
 
 def _check(sc: dict, result: dict) -> dict:
@@ -763,6 +966,108 @@ def _check_one(name, sc, result, phases, verdict):
                     and f["epochs_recorded"] == _scenario_epochs(sc),
                     f"epochs_recorded={f['epochs_recorded']} "
                     f"preempted={f['preempted']}")
+        elif name == "rollback_fired":
+            f = phases["fit"]
+            rec = f.get("recovery") or {}
+            poisoned = sum(n for (_s, kind), n in
+                           (result.get("firings") or {}).items()
+                           if kind == "nan")
+            rollbacks = rec.get("rollbacks") or 0
+            # one rollback per poisoned window; several poisons landing
+            # in ONE observation window legitimately share a rollback
+            verdict(name,
+                    poisoned > 0 and 1 <= rollbacks <= poisoned,
+                    f"recovery={rec} (want 1..{poisoned} rollbacks for "
+                    f"{poisoned} nan firings)")
+        elif name == "rolled_back_to_committed":
+            f = phases["fit"]
+            q = f.get("quarantine") or []
+            targets = [r.get("rollback_to_step") for r in q]
+            # a MID-RUN committed checkpoint, not the step-0 bootstrap
+            verdict(name, bool(targets) and all(t > 0 for t in targets),
+                    f"rollback targets {targets} (want all > step 0)")
+        elif name == "quarantine_written":
+            f = phases["fit"]
+            q = f.get("quarantine") or []
+            rec = f.get("recovery") or {}
+            complete = q and all(
+                r.get("batch_indices")
+                and r.get("step_start") is not None
+                and r.get("step_end") is not None
+                and "losses" in r for r in q)
+            verdict(name,
+                    bool(complete)
+                    and (rec.get("quarantined_steps") or 0) >= 1,
+                    f"quarantine.jsonl records={q} "
+                    f"quarantined_steps={rec.get('quarantined_steps')}")
+        elif name == "supervisor_recovered_each_crash":
+            s = phases["supervise"]
+            sup = s["supervisor"]
+            expected = int((sc.get("params") or {}).get(
+                "expected_crashes", 1))
+            verdict(name,
+                    sup["outcome"] == "clean"
+                    and sup["restarts"]["crashed"] == expected,
+                    f"outcome={sup['outcome']} restarts={sup['restarts']} "
+                    f"(want {expected} crash restarts, clean finish)")
+        elif name == "restored_digest_matches_committed":
+            s = phases["supervise"]
+            resumed = [a for a in s["attempts"]
+                       if a.get("restored_step", 0) > 0
+                       and a.get("param_digest_at_restore")]
+            mismatches = [
+                a["attempt"] for a in resumed
+                if a.get("restored_meta_digest")
+                != a["param_digest_at_restore"]]
+            verdict(name, bool(resumed) and not mismatches,
+                    f"{len(resumed)} resumed attempts, digest mismatches "
+                    f"at attempts {mismatches} (checkpoint.digest meta vs "
+                    "restored param bytes)")
+        elif name == "completed_schedule":
+            s = phases["supervise"]
+            done = [a for a in s["attempts"] if a.get("completed_report")]
+            last = done[-1] if done else {}
+            expected = (last.get("nb") or 0) * _scenario_epochs(sc)
+            verdict(name,
+                    bool(last) and not last.get("preempted")
+                    and last.get("final_step") == expected,
+                    f"final attempt {last.get('attempt')}: "
+                    f"final_step={last.get('final_step')} "
+                    f"(want {expected}), preempted={last.get('preempted')}")
+        elif name == "preempted_each_wave":
+            s = phases["supervise"]
+            sup = s["supervisor"]
+            expected = int((sc.get("params") or {}).get(
+                "expected_preemptions", 1))
+            verdict(name,
+                    sup["outcome"] == "clean"
+                    and sup["restarts"]["preempted"] == expected,
+                    f"outcome={sup['outcome']} restarts={sup['restarts']} "
+                    f"(want {expected} preempt restarts, clean finish)")
+        elif name == "exact_resume_chain":
+            s = phases["supervise"]
+            atts = s["attempts"]
+            breaks = [
+                atts[k]["attempt"] for k in range(1, len(atts))
+                if atts[k - 1].get("param_digest")
+                and atts[k].get("param_digest_at_restore")
+                != atts[k - 1]["param_digest"]]
+            verdict(name, len(atts) >= 2 and not breaks,
+                    f"{len(atts)} attempts; restored-digest chain breaks "
+                    f"at attempts {breaks} (each wave must resume the "
+                    "exact params the previous wave saved)")
+        elif name == "zero_lost_or_duplicated_steps_storm":
+            s = phases["supervise"]
+            done = [a for a in s["attempts"] if a.get("completed_report")]
+            expected = (done[-1].get("nb") or 0) * _scenario_epochs(sc) \
+                if done else -1
+            trained = sum(a["final_step"] - a["restored_step"]
+                          for a in done)
+            final = done[-1]["final_step"] if done else -1
+            verdict(name, bool(done) and trained == expected
+                    and final == expected,
+                    f"trained {trained} steps across {len(done)} waves, "
+                    f"final {final} (want {expected} for both)")
         elif name == "final_metrics_finite":
             import math
 
@@ -803,9 +1108,12 @@ def run_scenario(scenario: str | dict, work_dir: str | None = None,
             result = _run_serve(sc, work_dir)
         elif mode == "serve_swap":
             result = _run_serve_swap(sc, work_dir)
+        elif mode == "supervise":
+            result = _run_supervise(sc, work_dir)
         else:
-            raise ValueError(f"unknown scenario mode {mode!r} "
-                             "(fit | fit_resume | serve | serve_swap)")
+            raise ValueError(
+                f"unknown scenario mode {mode!r} "
+                "(fit | fit_resume | serve | serve_swap | supervise)")
     finally:
         if cleanup:
             import shutil

@@ -344,6 +344,7 @@ def make_train_step(
     steps_per_call: int = 1,
     packbits_masks: bool = False,
     wire_spec: tuple | None = None,
+    sentinel_metrics: bool = False,
 ) -> Callable[..., tuple[TrainState, jax.Array]]:
     """Build the jitted ``(state, batch) -> (state, loss)`` train step.
 
@@ -376,6 +377,14 @@ def make_train_step(
     with :func:`unpack_wire` before any other stage — composes with
     ``packbits_masks`` (the packed row rides the buffer) and with the
     multi-step program (the scan body unpacks each step's buffer).
+
+    ``sentinel_metrics`` (sentinel.monitor_grads): the step's second
+    output becomes ``(loss, aux)`` with ``aux = [grad_norm,
+    ||update||/||param||]`` — the divergence signals the step-health
+    sentinel judges.  Both norms are computed from arrays the update
+    already produced, so the cost is a handful of fused reductions; the
+    readback stays on the trainer's existing loss-fetch boundary (no
+    extra host syncs).  Multi-step programs return ``((K,), (K, 2))``.
     """
 
     def grads_of(params, batch_stats, batch, rng):
@@ -438,6 +447,14 @@ def make_train_step(
             opt_state=new_opt,
             rng=new_rng,
         )
+        if sentinel_metrics:
+            # sentinel.monitor_grads: global grad norm + the update/param
+            # ratio (a single update rewriting a macroscopic fraction of
+            # the weights is divergence even at a plausible loss)
+            gnorm = optax.global_norm(grads)
+            ratio = optax.global_norm(updates) / (
+                optax.global_norm(state.params) + 1e-12)
+            return new_state, (loss, jnp.stack([gnorm, ratio]))
         return new_state, loss
 
     if steps_per_call > 1:

@@ -9,6 +9,7 @@ from .config import (
     MeshConfig,
     ModelConfig,
     OptimConfig,
+    SentinelConfig,
     apply_overrides,
     flatten,
     from_json,
@@ -27,6 +28,7 @@ from .logging import (
 )
 from .optim import make_optimizer, make_param_labeler, make_schedule
 from .preemption import PreemptionGuard
+from .sentinel import StepSentinel, recovery_block
 from .trainer import Trainer
 
 __all__ = [
@@ -43,8 +45,11 @@ __all__ = [
     "MultiWriter",
     "OptimConfig",
     "PreemptionGuard",
+    "SentinelConfig",
+    "StepSentinel",
     "TensorBoardWriter",
     "Trainer",
+    "recovery_block",
     "apply_overrides",
     "batch_debug_asserts",
     "evaluate",

@@ -20,6 +20,7 @@ participates in the barrier; Orbax writes each shard once).
 from __future__ import annotations
 
 import glob
+import hashlib
 import json
 import os
 import re
@@ -52,6 +53,20 @@ def atomic_write_json(path: str, obj) -> None:
         os.fsync(dir_fd)
     finally:
         os.close(dir_fd)
+
+
+def param_digest(tree) -> str:
+    """Order-stable sha256 over a param tree's raw bytes — the
+    byte-identical restored-vs-saved equality check that works across
+    processes (chaos invariants; ``CheckpointConfig.digest`` stamps it
+    into each save's meta).  Forces a full host readback of the tree —
+    call it on state that is about to be serialized anyway."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(tree):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
 
 
 def next_run_index(work_dir: str) -> int:
@@ -95,7 +110,8 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, keep_latest: int = 3,
-                 best_metric_init: float = 0.0, async_save: bool = True):
+                 best_metric_init: float = 0.0, async_save: bool = True,
+                 digest: bool = False):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.best_metric = best_metric_init
@@ -111,6 +127,10 @@ class CheckpointManager:
         self._best = ocp.CheckpointManager(
             os.path.join(self.directory, "best"), options=best_options)
         self._async_save = async_save
+        #: checkpoint.digest: stamp each save's meta with
+        #: ``param_digest(state.params)`` so byte-identical restore is
+        #: checkable across process deaths (costs a param readback/save)
+        self._digest = digest
         #: steps :meth:`restore` skipped as unreadable (torn files) on the
         #: way to the one it returned — the chaos runner's invariant hook
         self.last_restore_fallback: list[int] = []
@@ -158,6 +178,8 @@ class CheckpointManager:
             self.best_metric = float(metric)
         payload = {"state": ocp.args.StandardSave(state)}
         meta = {"step": int(step), "best_metric": self.best_metric}
+        if self._digest:
+            meta["param_digest"] = param_digest(state.params)
         if metric is not None:
             meta["metric"] = float(metric)
         if extra:
@@ -166,6 +188,16 @@ class CheckpointManager:
         # goodput: async saves charge only the enqueue here; the Orbax
         # write itself lands in wait()'s checkpoint bucket
         with get_accountant().account("checkpoint"), span("checkpoint/save"):
+            if self._async_save:
+                # Refresh the ledger from the saves that have LANDED so
+                # far, BEFORE enqueueing this one: Orbax serializes async
+                # saves (a new save waits out the previous), so at entry
+                # every earlier step in all_steps() is fully committed.
+                # Without this the ledger only appears at wait() — i.e.
+                # never in a process that crashes mid-run, starving both
+                # the supervisor's progress signal (train/supervise.py)
+                # and the sentinel's committed-rollback targets.
+                self._write_ledger()
             self._mgr.save(step, args=ocp.args.Composite(**payload))
             if is_best:
                 self._best.save(step, args=ocp.args.Composite(**payload))
@@ -248,6 +280,10 @@ class CheckpointManager:
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        """Steps present in the rolling (latest) slot, ascending."""
+        return sorted(int(s) for s in self._mgr.all_steps())
 
     def wait(self) -> None:
         """Block until async saves land (call before process exit)."""

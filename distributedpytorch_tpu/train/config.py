@@ -307,6 +307,44 @@ class CheckpointConfig:
                                         # twice); false = replay the epoch
                                         # from its start (batches repeat,
                                         # none skipped)
+    digest: bool = False                # stamp each save's meta with a
+                                        # sha256 over the param bytes —
+                                        # the byte-identical-restore
+                                        # invariant becomes checkable
+                                        # across process deaths (the
+                                        # chaos crash_loop scenario's
+                                        # hook).  Costs one full param
+                                        # readback per save; off by
+                                        # default.
+
+
+@dataclass
+class SentinelConfig:
+    """Self-healing training (train/sentinel.py): detection thresholds
+    and the rollback budget.  Off by default — the trainer's legacy
+    responses (log-and-continue / debug_asserts abort) stay pinned."""
+    enabled: bool = False               # verdicts + rollback-and-replay
+    ema_beta: float = 0.9               # loss-EMA smoothing
+    suspect_factor: float = 3.0         # loss > f x EMA -> suspect
+    diverged_factor: float = 10.0       # loss > f x EMA -> diverged
+    warmup_steps: int = 8               # EMA updates before spike
+                                        # verdicts arm (non-finite always
+                                        # armed)
+    monitor_grads: bool = False         # train step also emits
+                                        # (grad_norm, update/param ratio)
+                                        # — a second (2,) output on the
+                                        # compiled program, so contracts
+                                        # of sentinel-monitored programs
+                                        # differ from the canonical ones
+    grad_factor: float = 10.0           # grad_norm > f x EMA -> suspect
+    update_ratio_max: float | None = None
+                                        # ||update||/||param|| above this
+                                        # -> diverged (None = off)
+    max_rollbacks: int = 2              # rollback budget: consecutive
+                                        # rollbacks without a cleanly
+                                        # completed epoch in between
+                                        # before the run fails loudly
+                                        # (chaos CircuitBreaker)
 
 
 @dataclass
@@ -317,6 +355,7 @@ class Config:
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    sentinel: SentinelConfig = field(default_factory=SentinelConfig)
     epochs: int = 100
     eval_every: int = 1                 # nTestInterval (train_pascal.py:62)
     val_overlap: bool = False           # run each validation on a thread
@@ -427,7 +466,8 @@ def _from_dict(cls, d: dict):
 
 
 _SUBCONFIGS = {"data": DataConfig, "model": ModelConfig, "optim": OptimConfig,
-               "mesh": MeshConfig, "checkpoint": CheckpointConfig}
+               "mesh": MeshConfig, "checkpoint": CheckpointConfig,
+               "sentinel": SentinelConfig}
 
 
 def to_json(cfg: Config, path: str | None = None) -> str:

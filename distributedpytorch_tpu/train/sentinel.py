@@ -1,0 +1,234 @@
+"""Step-health sentinel: typed verdicts over the training loss stream.
+
+The reference could not survive a bad step at all (SURVEY §0/§5.3: a NaN
+loss trained garbage until someone looked at the curves), and until this
+module the trainer had exactly two responses — log-and-continue
+(``train/nonfinite_steps``) or abort hard (the ``debug_asserts``
+FloatingPointError watchdogs).  The sentinel is the third response's
+detection half: it watches the loss values the trainer ALREADY reads
+back on the host (the log-cadence readback and the epoch-end bulk
+fetch — no new host syncs, no reads inside compiled programs) and turns
+them into typed verdicts:
+
+* ``healthy``  — finite, within the spike envelope;
+* ``suspect``  — finite but > ``suspect_factor`` x the loss EMA (or a
+  grad-norm spike, when the optional monitor is on): logged and
+  counted, training continues;
+* ``diverged`` — non-finite, > ``diverged_factor`` x the EMA, or an
+  update/param-norm ratio above ``update_ratio_max``: the trainer's
+  rollback-and-replay path fires (see Trainer._handle_divergence).
+
+Detection is deterministic on replicated values: every host reads the
+same loss, computes the same EMA, and reaches the same verdict at the
+same step — which is what lets multi-host rollback happen without any
+extra consensus traffic.
+
+Two observation passes, by design: the log-cadence pass judges the
+latest dispatch against the CURRENT EMA without updating it
+(``update=False``), and the epoch-end sweep — the one place the full
+ordered loss stream exists on host — is the single EMA-updating pass.
+The EMA therefore advances in strict step order and no deduplication
+bookkeeping is needed.
+
+Metrics (process registry, ``telemetry`` config gate): verdict counts
+as ``train_sentinel_verdicts_total{verdict}``, the EMA as the
+``train_sentinel_loss_ema`` gauge; the trainer books
+``train_sentinel_rollbacks_total`` / ``train_sentinel_quarantined_steps_total``
+and rollback restore times into ``train_sentinel_recovery_seconds``.
+"""
+
+from __future__ import annotations
+
+import math
+
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+DIVERGED = "diverged"
+
+
+class SentinelReport:
+    """One observation pass's outcome: the worst verdict, plus where the
+    first ``diverged`` step sits (the rollback window's right edge)."""
+
+    __slots__ = ("verdict", "step", "value", "reason")
+
+    def __init__(self, verdict: str = HEALTHY, step: int | None = None,
+                 value: float | None = None, reason: str = ""):
+        self.verdict = verdict
+        self.step = step          # first diverged/suspect global step
+        self.value = value        # the observed value that tripped it
+        self.reason = reason      # nonfinite_loss | loss_spike | ...
+
+    @property
+    def diverged(self) -> bool:
+        return self.verdict == DIVERGED
+
+    def __repr__(self) -> str:  # quarantine records / error messages
+        return (f"SentinelReport({self.verdict}, step={self.step}, "
+                f"value={self.value}, reason={self.reason!r})")
+
+
+class StepSentinel:
+    """Loss-EMA spike + non-finite detection (and an optional grad-norm /
+    update-ratio monitor) over host-side loss readbacks.
+
+    ``warmup_steps`` observations must update the EMA before spike
+    verdicts arm — the first steps of a fresh run legitimately fall fast
+    and a factor-of-N test against a 1-sample EMA would false-trip.
+    Non-finite detection is always armed, warmup included.
+    """
+
+    def __init__(self, *, ema_beta: float = 0.9,
+                 suspect_factor: float = 3.0,
+                 diverged_factor: float = 10.0,
+                 warmup_steps: int = 8,
+                 grad_factor: float = 10.0,
+                 update_ratio_max: float | None = None,
+                 telemetry: bool = True):
+        if not 0.0 < ema_beta < 1.0:
+            raise ValueError(f"ema_beta must be in (0, 1), got {ema_beta}")
+        if suspect_factor > diverged_factor:
+            raise ValueError(
+                f"suspect_factor {suspect_factor} > diverged_factor "
+                f"{diverged_factor} — suspect must trip first")
+        self.ema_beta = float(ema_beta)
+        self.suspect_factor = float(suspect_factor)
+        self.diverged_factor = float(diverged_factor)
+        self.warmup_steps = int(warmup_steps)
+        self.grad_factor = float(grad_factor)
+        self.update_ratio_max = update_ratio_max
+        self._telemetry = telemetry
+        self.ema: float | None = None
+        self.grad_ema: float | None = None
+        self.n_observed = 0
+
+    # ------------------------------------------------------------ observing
+    def observe(self, first_step: int, losses, grad_norms=None,
+                update_ratios=None, update: bool = True) -> SentinelReport:
+        """Judge ``losses[i]`` as global step ``first_step + i``; returns
+        the WORST verdict (first ``diverged`` wins — its step bounds the
+        quarantine window).  ``update=False`` judges against the current
+        EMA without advancing it (the log-cadence pass)."""
+        report = SentinelReport()
+        for i, loss in enumerate(losses):
+            step = first_step + i
+            loss = float(loss)
+            gnorm = (None if grad_norms is None
+                     else float(grad_norms[i]))
+            ratio = (None if update_ratios is None
+                     else float(update_ratios[i]))
+            verdict, reason, value = self._judge(loss, gnorm, ratio)
+            if update and math.isfinite(loss) and verdict != DIVERGED:
+                # a diverged loss must not drag the EMA to its own scale
+                # (or to NaN) — the envelope keeps describing health
+                self.ema = loss if self.ema is None else \
+                    self.ema_beta * self.ema + (1 - self.ema_beta) * loss
+                if gnorm is not None and math.isfinite(gnorm):
+                    self.grad_ema = gnorm if self.grad_ema is None else \
+                        (self.ema_beta * self.grad_ema
+                         + (1 - self.ema_beta) * gnorm)
+                self.n_observed += 1
+            if update:
+                self._book(verdict)
+            if verdict == DIVERGED:
+                report.verdict = DIVERGED
+                report.step, report.value, report.reason = step, value, reason
+                if not update:
+                    self._book(DIVERGED)  # raised before any update pass
+                break
+            if verdict == SUSPECT and report.verdict == HEALTHY:
+                report.verdict = SUSPECT
+                report.step, report.value, report.reason = step, value, reason
+        if update:
+            self._gauge()
+        return report
+
+    def _judge(self, loss: float, gnorm, ratio):
+        if not math.isfinite(loss):
+            return DIVERGED, "nonfinite_loss", loss
+        if gnorm is not None and not math.isfinite(gnorm):
+            return DIVERGED, "nonfinite_grad_norm", gnorm
+        if ratio is not None and not math.isfinite(ratio):
+            return DIVERGED, "nonfinite_update_ratio", ratio
+        if self.update_ratio_max is not None and ratio is not None \
+                and ratio > self.update_ratio_max:
+            # one update rewriting a macroscopic fraction of the weights
+            # IS divergence even while the loss still looks plausible
+            return DIVERGED, "update_ratio", ratio
+        armed = self.n_observed >= self.warmup_steps
+        if armed and self.ema is not None and self.ema > 0 \
+                and loss > self.diverged_factor * self.ema:
+            return DIVERGED, "loss_spike", loss
+        if armed and self.ema is not None and self.ema > 0 \
+                and loss > self.suspect_factor * self.ema:
+            return SUSPECT, "loss_spike", loss
+        if armed and gnorm is not None and self.grad_ema is not None \
+                and self.grad_ema > 0 and gnorm > self.grad_factor \
+                * self.grad_ema:
+            return SUSPECT, "grad_norm_spike", gnorm
+        return HEALTHY, "", loss
+
+    # ------------------------------------------------------------- rollback
+    def reset(self) -> None:
+        """Post-rollback re-arm: the EMA (a description of healthy loss
+        scale) survives, but spike verdicts re-warm so the replayed
+        window's recovery transient cannot immediately re-trip."""
+        self.n_observed = 0
+
+    # ------------------------------------------------------------ telemetry
+    def _book(self, verdict: str) -> None:
+        if not self._telemetry:
+            return
+        from ..telemetry import get_registry
+        from ..telemetry.registry import is_enabled
+
+        if not is_enabled():
+            return
+        get_registry().counter(
+            "train_sentinel_verdicts_total",
+            "Step-health sentinel verdicts (train/sentinel.py)",
+            labels={"verdict": verdict}).inc()
+
+    def _gauge(self) -> None:
+        if not self._telemetry or self.ema is None:
+            return
+        from ..telemetry import get_registry
+        from ..telemetry.registry import is_enabled
+
+        if not is_enabled():
+            return
+        get_registry().gauge(
+            "train_sentinel_loss_ema",
+            "EMA of the observed train loss").set(self.ema)
+
+
+#: the bench/report schema for self-healing outcomes — keys ALWAYS
+#: present (the PR 4 convention), every value null when the sentinel
+#: never ran
+RECOVERY_KEYS = ("rollbacks", "quarantined_steps", "supervisor_restarts",
+                 "recovery_p50_s")
+
+
+def make_recovery_block(*, rollbacks: int, quarantined_steps: int,
+                        recovery_p50_s: float | None,
+                        supervisor_restarts: int | None = None) -> dict:
+    """Construct a populated recovery block — the ONE place the schema's
+    keys are written (``Trainer.fit`` builds its history/fit-summary
+    block through this; ``recovery_block`` below re-projects it for
+    bench records), so the two surfaces cannot drift."""
+    out = dict.fromkeys(RECOVERY_KEYS)
+    out.update(rollbacks=rollbacks, quarantined_steps=quarantined_steps,
+               supervisor_restarts=supervisor_restarts,
+               recovery_p50_s=recovery_p50_s)
+    return out
+
+
+def recovery_block(history: dict | None = None) -> dict:
+    """The ``recovery`` block for bench records / fit summaries: populated
+    from a ``Trainer.fit`` history when it carries one, all-null
+    otherwise (sentinel off, or a bench loop that never ran ``fit``)."""
+    rec = (history or {}).get("recovery") if history else None
+    out = {k: None for k in RECOVERY_KEYS}
+    if rec:
+        out.update({k: rec.get(k) for k in RECOVERY_KEYS})
+    return out

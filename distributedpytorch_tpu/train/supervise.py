@@ -1,0 +1,430 @@
+"""``dptpu-supervise``: a crash-loop supervisor for training runs.
+
+The third layer of self-healing (after the in-process sentinel and its
+rollback-and-replay, train/sentinel.py): some failures kill the whole
+process — OOM, a segfaulting extension, SIGKILL from a scheduler — and
+no in-process machinery survives them.  The supervisor runs the training
+command as a CHILD, watches how it exits, and restarts it:
+
+* **clean**        — exit 0 and the newest run's ``fit_summary.json``
+  says the schedule completed: done.
+* **preempted**    — exit 0 but the summary says the run stopped on a
+  termination signal (the PreemptionGuard's graceful stop): restarted
+  immediately (``restart_on_preempt``), because a preemption is the
+  scheduler's problem, not the run's.
+* **crashed**      — non-zero exit or death by signal: restarted after
+  an exponential-backoff nap (the one :class:`chaos.policies.Retry`
+  schedule).
+* **crash-looping** — ``crash_loop_threshold`` crashes with the SAME
+  fingerprint (exit code + last stderr line) inside
+  ``crash_loop_window_s``, with NO checkpoint progress between them:
+  give up loudly (:class:`CrashLoopError`).  Progress resets the count —
+  a run that dies every hour but advances its committed step is limping,
+  not looping, and restarts are exactly what it needs.
+
+Progress is read from the checkpoint commit ledger
+(``run_*/checkpoints/COMMITTED.json``, plain JSON — no Orbax, no jax),
+and run outcomes from ``fit_summary.json`` (written atomically by
+``Trainer.fit``), so the supervisor itself stays a stdlib process that
+can never be taken down by the failure it is supervising.  Deliberately
+importable before jax, like ``chaos/policies.py``; telemetry booking is
+lazy and best-effort.
+
+Restart downtime (child death -> next child spawned) lands in the
+``train_supervisor_recovery_seconds{reason}`` histogram and restart
+counts in ``train_supervisor_restarts_total{reason}`` — the
+``chaos_recovery_seconds``-shaped surface the chaos scenarios assert
+against.  Every event is also appended to ``<work_dir>/supervisor.jsonl``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Callable, Sequence
+
+from ..chaos.policies import Retry
+
+#: classification outcomes (the ``outcome`` field of run() reports)
+CLEAN = "clean"
+PREEMPTED = "preempted"
+CRASHED = "crashed"
+CRASH_LOOP = "crash_loop"
+GAVE_UP = "gave_up"
+
+
+class CrashLoopError(RuntimeError):
+    """The child died with the same fingerprint, without progress, too
+    many times in a row; the supervisor's report rides on the exception."""
+
+    def __init__(self, report: dict):
+        self.report = report
+        fp = report.get("last_fingerprint")
+        super().__init__(
+            f"crash loop: {report['restarts']['crashed']} crashes, "
+            f"{report['crash_loop_count']} identical without progress "
+            f"(fingerprint {fp!r}) — giving up")
+
+
+def _scan_runs(work_dir: str) -> list[tuple[int, str]]:
+    """(index, path) of every ``run_<N>`` under ``work_dir``, ascending."""
+    runs = glob.glob(os.path.join(work_dir, "run_*"))
+    return sorted((int(m.group(1)), r) for r in runs
+                  if (m := re.search(r"run_(\d+)$", r)))
+
+
+def latest_fit_summary(work_dir: str) -> dict | None:
+    """The newest run's ``fit_summary.json`` (None when no run wrote
+    one — e.g. the child died before finishing a fit)."""
+    for _idx, run in reversed(_scan_runs(work_dir)):
+        path = os.path.join(run, "fit_summary.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def latest_committed_step(work_dir: str) -> int | None:
+    """Max step any run has durably landed — the supervisor's progress
+    signal.  Two stdlib-only sources, unioned: the ``COMMITTED.json``
+    ledger (written at sync saves, async-save entry, and ``wait()``) and
+    the finalized numeric step dirs under ``checkpoints/latest/`` —
+    Orbax writes to a tmp-suffixed name and renames on commit, so a
+    purely-numeric dir IS a landed save.  The dir scan covers the child
+    that enqueued exactly ONE async save and was then killed (its ledger
+    refresh never saw a landed predecessor), which is precisely the
+    crash the progress signal must not starve on."""
+    best: int | None = None
+
+    def take(s: int) -> None:
+        nonlocal best
+        best = s if best is None else max(best, s)
+
+    for _idx, run in _scan_runs(work_dir):
+        ck = os.path.join(run, "checkpoints")
+        try:
+            with open(os.path.join(ck, "COMMITTED.json")) as f:
+                for s in json.load(f).get("latest") or []:
+                    take(int(s))
+        except (OSError, ValueError):
+            pass
+        try:
+            for d in os.listdir(os.path.join(ck, "latest")):
+                if d.isdigit():
+                    take(int(d))
+        except OSError:
+            pass
+    return best
+
+
+class Supervisor:
+    """Run ``argv`` as a child until it completes, restarting per the
+    policy above.
+
+    ``argv`` is the child command, or a callable ``attempt -> argv``
+    (the chaos runner uses this to give each attempt its own spec file).
+    ``resume_arg`` (e.g. ``"resume=auto"``) is appended to list-style
+    commands on every RESTART — the knob that makes a plain
+    ``dptpu-train`` command continue instead of starting over; callables
+    own their resume handling and never get it.
+    """
+
+    def __init__(self, argv: Sequence[str] | Callable[[int], Sequence[str]],
+                 *, work_dir: str,
+                 max_restarts: int = 16,
+                 crash_loop_threshold: int = 3,
+                 crash_loop_window_s: float = 600.0,
+                 restart_on_preempt: bool = True,
+                 backoff: Retry | None = None,
+                 resume_arg: str | None = None,
+                 env: dict | None = None,
+                 child_env: Callable[[int], dict | None] | None = None,
+                 capture_output: bool = True,
+                 telemetry: bool = True):
+        if crash_loop_threshold < 1:
+            raise ValueError(f"crash_loop_threshold must be >= 1, got "
+                             f"{crash_loop_threshold}")
+        self._argv = argv
+        self.work_dir = work_dir
+        self.max_restarts = int(max_restarts)
+        self.crash_loop_threshold = int(crash_loop_threshold)
+        self.crash_loop_window_s = float(crash_loop_window_s)
+        self.restart_on_preempt = restart_on_preempt
+        #: nap schedule between crash restarts — THE Retry policy's
+        #: backoff curve (chaos/policies.py), not a third reimplementation
+        self.backoff = backoff or Retry(base_s=1.0, cap_s=60.0)
+        self.resume_arg = resume_arg
+        self.env = env
+        self.child_env = child_env
+        self.capture_output = capture_output
+        self._telemetry = telemetry
+        self.events: list[dict] = []
+
+    # --------------------------------------------------------------- pieces
+    def _argv_for(self, attempt: int) -> list[str]:
+        if callable(self._argv):
+            return list(self._argv(attempt))
+        argv = list(self._argv)
+        if attempt > 0 and self.resume_arg:
+            argv.append(self.resume_arg)
+        return argv
+
+    def _spawn(self, attempt: int) -> tuple[int, str]:
+        """Run one child; returns ``(returncode, stderr_tail)``.
+
+        stderr is ALWAYS tapped — the crash fingerprint (exit code +
+        last stderr line) is what keeps distinct failures from
+        conflating into one crash loop — but only a BOUNDED tail is
+        kept: a multi-day child emitting a warning per step must not
+        grow the supervisor's memory with it.  With
+        ``capture_output=False`` (the CLI) every stderr line is teed
+        through live; ``True`` (tests, the chaos runner) silences the
+        child entirely (stdout to devnull, stderr tail only)."""
+        import collections
+        import threading
+
+        env = dict(self.env if self.env is not None else os.environ)
+        if self.child_env is not None:
+            extra = self.child_env(attempt)
+            if extra:
+                env.update(extra)
+        proc = subprocess.Popen(
+            self._argv_for(attempt),
+            stdout=subprocess.DEVNULL if self.capture_output else None,
+            stderr=subprocess.PIPE, text=True, env=env)
+        tail: collections.deque = collections.deque(maxlen=40)
+
+        def drain() -> None:
+            for line in proc.stderr:
+                tail.append(line)
+                if not self.capture_output:
+                    sys.stderr.write(line)
+                    sys.stderr.flush()
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        rc = proc.wait()
+        t.join(timeout=10)
+        proc.stderr.close()
+        return rc, "".join(tail)
+
+    @staticmethod
+    def _fingerprint(rc: int, stderr_tail: str) -> str:
+        """Identity of a failure: exit code (negative = signal) + the
+        last non-empty stderr line.  Two OOMs look the same; an OOM and
+        an assertion do not — only the former pair counts toward the
+        crash-loop give-up."""
+        tail = ""
+        for line in reversed(stderr_tail.splitlines()):
+            if line.strip():
+                tail = line.strip()[-200:]
+                break
+        return f"rc={rc}|{tail}"
+
+    def _event(self, kind: str, **fields) -> None:
+        ev = {"event": kind, "t": round(time.time(), 3), **fields}
+        self.events.append(ev)
+        try:
+            os.makedirs(self.work_dir, exist_ok=True)
+            with open(os.path.join(self.work_dir, "supervisor.jsonl"),
+                      "a") as f:
+                f.write(json.dumps(ev) + "\n")
+        except OSError:
+            pass  # a read-only work dir must not kill supervision
+
+    def _book(self, reason: str, downtime_s: float | None) -> None:
+        if not self._telemetry:
+            return
+        try:  # lazy + best-effort: the supervisor must outlive telemetry
+            from ..telemetry import get_registry
+            from ..telemetry.registry import is_enabled
+
+            if not is_enabled():
+                return
+            get_registry().counter(
+                "train_supervisor_restarts_total",
+                "Supervisor child restarts (train/supervise.py)",
+                labels={"reason": reason}).inc()
+            if downtime_s is not None:
+                get_registry().histogram(
+                    "train_supervisor_recovery_seconds",
+                    "Child death -> next child spawned",
+                    labels={"reason": reason}).observe(downtime_s)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> dict:
+        """Supervise to completion; returns the report dict.  Raises
+        :class:`CrashLoopError` on give-up (report attached)."""
+        restarts = {PREEMPTED: 0, CRASHED: 0}
+        loop_count = 0
+        loop_t0: float | None = None
+        last_fp: str | None = None
+        last_progress = latest_committed_step(self.work_dir)
+        attempt = 0
+        consecutive_crashes = 0
+        report: dict = {"outcome": None, "attempts": 0,
+                        "restarts": restarts, "crash_loop_count": 0,
+                        "last_fingerprint": None,
+                        "recovery_seconds": []}
+        while True:
+            self._event("spawn", attempt=attempt,
+                        argv=self._argv_for(attempt))
+            rc, stderr_tail = self._spawn(attempt)
+            exit_t = time.monotonic()
+            attempt += 1
+            report["attempts"] = attempt
+
+            if rc == 0:
+                summary = latest_fit_summary(self.work_dir)
+                if summary and summary.get("preempted"):
+                    if not self.restart_on_preempt:
+                        # the operator opted out of restarts: report the
+                        # truth — a preempted run is NOT a completed one
+                        self._event("preempted_final", attempt=attempt - 1,
+                                    summary=summary)
+                        report["outcome"] = PREEMPTED
+                        return report
+                    outcome = PREEMPTED
+                else:
+                    if summary is None:
+                        # exit 0 but NO fit summary under work_dir: the
+                        # contract can't be checked (work-dir mismatch?
+                        # a command that never runs fit?).  Restarting
+                        # would loop a non-training command forever, so
+                        # accept the exit — LOUDLY, because a preempted
+                        # run whose summary we cannot find would
+                        # otherwise be silently declared complete.
+                        msg = (f"dptpu-supervise: child exited 0 but no "
+                               f"run under {self.work_dir!r} has a "
+                               "fit_summary.json — accepting the exit "
+                               "as clean UNVERIFIED (is --work-dir the "
+                               "training run's work_dir?)")
+                        print(msg, file=sys.stderr)
+                        self._event("clean_exit_unverified",
+                                    attempt=attempt - 1, warning=msg)
+                    self._event("clean_exit", attempt=attempt - 1,
+                                summary=summary)
+                    report["outcome"] = CLEAN
+                    return report
+            else:
+                outcome = CRASHED
+
+            # ---- give-up checks before any restart
+            if attempt > self.max_restarts:
+                self._event("gave_up", reason="max_restarts",
+                            attempts=attempt)
+                report["outcome"] = GAVE_UP
+                raise CrashLoopError(report)
+            if outcome == CRASHED:
+                consecutive_crashes += 1
+                fp = self._fingerprint(rc, stderr_tail)
+                progress = latest_committed_step(self.work_dir)
+                progressed = (progress is not None
+                              and (last_progress is None
+                                   or progress > last_progress))
+                now = time.monotonic()
+                in_window = (loop_t0 is not None
+                             and now - loop_t0 <= self.crash_loop_window_s)
+                if fp == last_fp and not progressed and in_window:
+                    loop_count += 1
+                else:
+                    loop_count = 1
+                    loop_t0 = now
+                last_fp, last_progress = fp, progress
+                report["last_fingerprint"] = fp
+                report["crash_loop_count"] = loop_count
+                self._event("crash", attempt=attempt - 1,
+                            rc=rc, fingerprint=fp,
+                            progressed=progressed,
+                            stderr_tail=stderr_tail[-800:])
+                if loop_count >= self.crash_loop_threshold:
+                    self._event("gave_up", reason="crash_loop",
+                                fingerprint=fp, count=loop_count)
+                    report["outcome"] = CRASH_LOOP
+                    raise CrashLoopError(report)
+                nap = self.backoff.backoff_s(consecutive_crashes)
+            else:  # preempted: graceful, restart without backoff
+                consecutive_crashes = 0
+                loop_count = 0
+                nap = 0.0
+                self._event("preempted", attempt=attempt - 1)
+
+            restarts[outcome] += 1
+            self.backoff.sleep(nap)
+            downtime = time.monotonic() - exit_t
+            report["recovery_seconds"].append(round(downtime, 3))
+            self._book(outcome, downtime)
+            self._event("restart", attempt=attempt, reason=outcome,
+                        downtime_s=round(downtime, 3))
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="dptpu-supervise",
+        description="crash-loop supervisor: run a training command as a "
+                    "child, restart it on crash or preemption, give up "
+                    "loudly on a genuine crash loop (see docs/DESIGN.md "
+                    "'Self-healing training')",
+        epilog="example: dptpu-supervise --work-dir runs -- "
+               "dptpu-train data.root=/data/voc epochs=100")
+    parser.add_argument("--work-dir", default="runs",
+                        help="the training work_dir (run_<N> dirs): where "
+                             "fit summaries, checkpoint ledgers and "
+                             "supervisor.jsonl live")
+    parser.add_argument("--max-restarts", type=int, default=16)
+    parser.add_argument("--crash-loop", type=int, default=3,
+                        metavar="N",
+                        help="identical no-progress crashes before giving "
+                             "up (default 3)")
+    parser.add_argument("--crash-loop-window", type=float, default=600.0,
+                        metavar="SECONDS")
+    parser.add_argument("--no-restart-on-preempt", action="store_true",
+                        help="treat a graceful preemption stop as final")
+    parser.add_argument("--backoff-base", type=float, default=1.0,
+                        help="first crash-restart nap (doubles, capped)")
+    parser.add_argument("--backoff-cap", type=float, default=60.0)
+    parser.add_argument("--resume-arg", default="resume=auto",
+                        help="override appended to the command on every "
+                             "restart ('' disables); the default makes "
+                             "dptpu-train continue from the newest "
+                             "checkpoint")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the child command (prefix with -- )")
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("a child command is required (after --)")
+
+    sup = Supervisor(
+        command, work_dir=args.work_dir, max_restarts=args.max_restarts,
+        crash_loop_threshold=args.crash_loop,
+        crash_loop_window_s=args.crash_loop_window,
+        restart_on_preempt=not args.no_restart_on_preempt,
+        backoff=Retry(base_s=args.backoff_base, cap_s=args.backoff_cap),
+        resume_arg=args.resume_arg or None,
+        capture_output=False)  # interactive: child logs stream through
+    try:
+        report = sup.run()
+    except CrashLoopError as e:
+        print(json.dumps(e.report, indent=2), file=sys.stderr)
+        print(f"dptpu-supervise: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

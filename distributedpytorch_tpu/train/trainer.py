@@ -23,6 +23,7 @@ threshold-max Jaccard gating best saves.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import time
 
@@ -58,9 +59,11 @@ from ..telemetry import TraceCapture, get_accountant, mfu_estimate
 from ..telemetry import set_enabled as telemetry_set_enabled
 from ..utils.helpers import generate_param_report
 from ..utils.profiling import device_memory_stats
+from ..chaos.policies import CircuitBreaker, CircuitOpenError
 from . import config as config_lib
 from .checkpoint import (
     CheckpointManager,
+    atomic_write_json,
     latest_checkpoint_dir,
     next_run_dir,
 )
@@ -78,6 +81,34 @@ from .logging import (
 )
 from .optim import make_optimizer
 from .preemption import PreemptionGuard
+from .sentinel import StepSentinel
+
+
+class _RollbackBudgetTick(Exception):
+    """Internal: one rollback counted against the CircuitBreaker budget
+    (raised inside the breaker so the rollback books as a failure, caught
+    immediately by the handler)."""
+
+
+class _DivergenceDetected(RuntimeError):
+    """Internal control flow: the sentinel returned ``diverged`` inside
+    ``train_epoch``; ``fit`` catches this and runs rollback-and-replay.
+    Escapes only when no sentinel rollback is possible (budget spent /
+    no checkpoint), converted to a loud ``FloatingPointError``."""
+
+    def __init__(self, epoch: int, step_start: int, step_end: int,
+                 batch_indices: list[int], losses: list, report):
+        self.epoch = epoch
+        self.step_start = step_start      # global steps, inclusive window
+        self.step_end = step_end
+        self.batch_indices = batch_indices
+        self.losses = losses              # observed losses in the window
+        self.report = report              # the SentinelReport that tripped
+        super().__init__(
+            f"sentinel verdict 'diverged' at step {report.step} "
+            f"({report.reason}: {report.value}) — window "
+            f"[{step_start}, {step_end}] of epoch {epoch}, "
+            f"{len(batch_indices)} batch(es) to quarantine")
 
 
 class Trainer:
@@ -501,6 +532,32 @@ class Trainer:
                 rots=cfg.data.rots, scales=cfg.data.scales,
                 semantic=cfg.task == "semantic",
                 guidance_fn=guidance_fn)
+        # --- self-healing sentinel (train/sentinel.py; see fit()): built
+        # before the steps because monitor_grads changes their outputs
+        sc = cfg.sentinel
+        self._sentinel = StepSentinel(
+            ema_beta=sc.ema_beta, suspect_factor=sc.suspect_factor,
+            diverged_factor=sc.diverged_factor,
+            warmup_steps=sc.warmup_steps, grad_factor=sc.grad_factor,
+            update_ratio_max=sc.update_ratio_max,
+            telemetry=cfg.telemetry) if sc.enabled else None
+        #: rollback budget — THE CircuitBreaker (chaos/policies.py):
+        #: each rollback books a failure, each cleanly completed epoch a
+        #: success, so only max_rollbacks CONSECUTIVE rollbacks open it
+        #: (and the run then fails loudly instead of looping)
+        self._rollback_breaker = CircuitBreaker(
+            failure_threshold=max(1, sc.max_rollbacks)) \
+            if sc.enabled else None
+        #: epoch -> loader batch indices quarantined by past rollbacks
+        #: (skipped on replay); the JSONL ledger under the run dir is the
+        #: durable record, this index is the live skip set
+        self._quarantine: dict[int, set[int]] = {}
+        #: loader batch index actually dispatched for each epoch-step of
+        #: the CURRENT epoch (quarantine skips make `start + i` wrong)
+        self._epoch_batch_order: list[int] = []
+        self.sentinel_rollbacks = 0
+        self.sentinel_quarantined_steps = 0
+        self._rollback_seconds: list[float] = []
         step_kwargs = dict(
             loss_weights=cfg.model.loss_weights,
             accum_steps=cfg.optim.accum_steps, mesh=self.mesh,
@@ -508,7 +565,8 @@ class Trainer:
             aux_loss_weight=(cfg.model.moe_aux_weight
                              if cfg.model.moe_experts else 0.0),
             loss_scale=cfg.optim.loss_scale,
-            packbits_masks=cfg.data.packbits_masks)
+            packbits_masks=cfg.data.packbits_masks,
+            sentinel_metrics=sc.enabled and sc.monitor_grads)
         self._step_kwargs = step_kwargs
         self.train_step, self.multi_train_step = self._build_steps()
         #: data.coalesce_wire: the wire-consuming twins of the two programs
@@ -556,12 +614,16 @@ class Trainer:
             os.path.join(self.run_dir, "checkpoints"),
             keep_latest=cfg.checkpoint.keep_latest,
             best_metric_init=cfg.checkpoint.best_metric_init,
-            async_save=cfg.checkpoint.async_save)
+            async_save=cfg.checkpoint.async_save,
+            digest=cfg.checkpoint.digest)
         self.start_epoch = 0
         self._resume_start_batch = 0  # exact mid-epoch resume offset
         #: steps the resume restore SKIPPED as unreadable (torn files) on
         #: the way to the one it used — surfaced for ops/chaos assertions
         self.resume_fallback_steps: list[int] = []
+        #: the restored checkpoint's meta dict (empty when not resumed) —
+        #: the chaos runner's digest-continuity invariants read it
+        self.resume_meta: dict = {}
         if cfg.checkpoint.warm_start:
             self._warm_start(cfg.checkpoint.warm_start,
                              cfg.checkpoint.warm_start_partial)
@@ -710,6 +772,7 @@ class Trainer:
             os.path.abspath(os.path.join(self.run_dir, "checkpoints")) \
             else self.ckpt
         self.state, meta = mgr.restore(self.state)
+        self.resume_meta = dict(meta)
         self.resume_fallback_steps = list(mgr.last_restore_fallback)
         self.start_epoch = int(meta.get("epoch", 0)) + 1
         self.ckpt.best_metric = float(
@@ -944,6 +1007,11 @@ class Trainer:
         cfg = self.cfg
         self.train_loader.set_epoch(epoch, start_batch=start_batch)
         losses = []
+        #: per-dispatch (grad_norm, update_ratio) outputs, aligned with
+        #: ``losses`` (sentinel.monitor_grads only; else stays empty)
+        aux_outs = []
+        monitor = bool(self._step_kwargs.get("sentinel_metrics"))
+        self._epoch_batch_order = []
         t0 = time.perf_counter()
         acct = get_accountant()
         # Track the step as a python int (start + i): reading
@@ -952,13 +1020,23 @@ class Trainer:
         step0 = int(self.state.step)
 
         def host_batches():
-            for batch in self.train_loader:
+            # quarantine (sentinel rollback-and-replay): loader indices a
+            # past rollback blamed for divergence are skipped on replay;
+            # the order list maps each dispatched step back to its loader
+            # index so a LATER divergence in this epoch quarantines the
+            # right batches even after skips.
+            qset = self._quarantine.get(epoch)
+            for i, batch in enumerate(self.train_loader):
+                idx = start_batch + i
+                if qset and idx in qset:
+                    continue
                 if cfg.debug_asserts:
                     if cfg.task == "instance":
                         batch_debug_asserts(
                             batch, packed_masks=cfg.data.packbits_masks)
                     else:
                         semantic_batch_debug_asserts(batch, cfg.model.nclass)
+                self._epoch_batch_order.append(idx)
                 yield batch
 
         def echoed(it):
@@ -1073,7 +1151,12 @@ class Trainer:
             # cadence comes from the guard itself (a caller-provided guard
             # may carry its own check_every)
             check = guard.check_every if guard is not None else 1
-            for n_steps, loss in dispatches(batches):
+            for n_steps, out in dispatches(batches):
+                if monitor:  # step emits (loss, (grad_norm, ratio))
+                    loss, aux = out
+                    aux_outs.append(aux)
+                else:
+                    loss = out
                 losses.append(loss)  # device scalar or (K,); sync deferred
                 steps_done += n_steps
                 step = step0 + steps_done
@@ -1101,7 +1184,24 @@ class Trainer:
                     # same value) — a main-only raise would leave the other
                     # processes blocked forever at their next collective.
                     loss_vec = np.atleast_1d(jax.device_get(loss))
-                    if cfg.debug_asserts and \
+                    if self._sentinel is not None:
+                        # sentinel absorbs the isfinite watchdog: judge
+                        # the latest dispatch against the current EMA
+                        # (update=False — the epoch-end sweep owns EMA
+                        # advancement, in strict step order) and hand a
+                        # diverged verdict to fit's rollback path
+                        g_vec = r_vec = None
+                        if monitor:
+                            a = np.atleast_2d(
+                                np.asarray(jax.device_get(aux_outs[-1])))
+                            g_vec, r_vec = a[:, 0], a[:, 1]
+                        rep = self._sentinel.observe(
+                            step - n_steps + 1, loss_vec, grad_norms=g_vec,
+                            update_ratios=r_vec, update=False)
+                        if rep.diverged:
+                            raise self._divergence(
+                                epoch, step0, rep, step, loss_vec)
+                    elif cfg.debug_asserts and \
                             not np.all(np.isfinite(loss_vec)):
                         # bf16 watchdog: surface divergence at the log
                         # cadence instead of training garbage for the rest
@@ -1145,14 +1245,32 @@ class Trainer:
         # steps landing — productive time, not idle.
         if losses:
             with acct.account("step"):
-                fetched = jax.device_get(losses)
+                fetched, fetched_aux = jax.device_get((losses, aux_outs))
             loss_arr = np.concatenate([np.atleast_1d(x) for x in fetched])
         else:
             loss_arr = np.array([np.nan])
+        if self._sentinel is not None and losses:
+            # THE EMA-updating sentinel pass: the full epoch's losses in
+            # strict step order (free — the bulk readback above already
+            # landed them).  Mid-epoch cadence checks judged against a
+            # per-epoch-stale EMA; this is where it advances.
+            g_arr = r_arr = None
+            if monitor and fetched_aux:
+                aux_arr = np.concatenate(
+                    [np.atleast_2d(np.asarray(x)) for x in fetched_aux])
+                g_arr, r_arr = aux_arr[:, 0], aux_arr[:, 1]
+            rep = self._sentinel.observe(
+                step0 + 1, loss_arr, grad_norms=g_arr,
+                update_ratios=r_arr, update=True)
+            if rep.diverged:
+                raise self._divergence(epoch, step0, rep,
+                                       step0 + loss_arr.size, loss_arr)
         bad = np.flatnonzero(~np.isfinite(loss_arr))
-        if bad.size and losses:
+        if bad.size and losses and self._sentinel is None:
             # Epoch-end non-finite sweep (free: the losses are already on
-            # host).  Always logged; fatal under debug_asserts.
+            # host).  Always logged; fatal under debug_asserts.  With the
+            # sentinel enabled this legacy response is absorbed: a
+            # non-finite loss is a 'diverged' verdict handled above.
             msg = (f"{bad.size}/{loss_arr.size} non-finite train losses this "
                    f"epoch (first at epoch step {int(bad[0])}) — divergence "
                    "or bf16 underflow; lower optim.lr, enable "
@@ -1166,6 +1284,10 @@ class Trainer:
                     int(self.state.step))
         mean_loss = float(np.mean(loss_arr)) if losses else float("nan")
         dt = time.perf_counter() - t0
+        if not losses and self._quarantine.get(epoch):
+            # every batch of the epoch is quarantined: nothing trained,
+            # nothing to log — the caller's loop moves on
+            return float("nan")
         # Distinct images ingested — echoed repeats of a batch are not fresh
         # data; reporting them would make any echo setting look like a win.
         n_imgs = steps_done * cfg.data.train_batch / cfg.data.echo
@@ -1183,6 +1305,142 @@ class Trainer:
                 scalars["train/peak_hbm_gb"] = round(peak / 2**30, 3)
             self.writer.scalars(scalars, int(self.state.step))
         return mean_loss
+
+    # ------------------------------------------------- sentinel rollback
+    def _divergence(self, epoch: int, step0: int, report, end_step: int,
+                    observed) -> _DivergenceDetected:
+        """Build the rollback request for a ``diverged`` verdict: the
+        quarantine window runs from the verdict's step through the end of
+        the observed vector (later steps in the same dispatch trained on
+        a state the bad step already poisoned), mapped back to loader
+        batch indices via this epoch's dispatch order."""
+        first = end_step - len(observed) + 1
+        w0 = int(report.step)
+        window = [float(x) for x in observed[w0 - first:]]
+        echo = max(1, self.cfg.data.echo)
+        order = self._epoch_batch_order
+        idxs = sorted({
+            order[j] for s in range(w0, end_step + 1)
+            if 0 <= (j := (s - step0 - 1) // echo) < len(order)})
+        return _DivergenceDetected(epoch, w0, end_step, idxs, window,
+                                   report)
+
+    def _budget_tick(self) -> None:
+        raise _RollbackBudgetTick()
+
+    def _last_committed_step(self) -> int | None:
+        """Newest checkpoint step the commit ledger vouches for (rollback
+        must never target a possibly-torn write; a torn restore target
+        would turn one bad batch into a dead run).  With no ledger yet
+        (a pre-ledger directory) the manager's newest step is trusted."""
+        committed = self.ckpt.committed_steps()
+        for s in sorted((int(s) for s in self.ckpt.all_steps()),
+                        reverse=True):
+            if not committed or s in committed:
+                return s
+        return None
+
+    def _handle_divergence(self, d: _DivergenceDetected,
+                           history: dict) -> int:
+        """Rollback-and-replay: budget-check, quarantine the bad window,
+        restore the last COMMITTED checkpoint in-process, and return the
+        epoch to resume from.  Runs identically on every host (all inputs
+        are replicated values or collective ops), so multi-host rollback
+        needs no extra consensus."""
+        cfg = self.cfg
+        # budget FIRST: a run that diverges after every rollback must
+        # fail loudly, not loop.  Each rollback books one failure on the
+        # breaker; a cleanly completed epoch (fit loop) books a success.
+        try:
+            self._rollback_breaker.call(self._budget_tick)
+        except CircuitOpenError:
+            raise FloatingPointError(
+                f"sentinel: rollback budget exhausted "
+                f"({cfg.sentinel.max_rollbacks} consecutive rollbacks "
+                f"without a cleanly completed epoch) — still diverging: "
+                f"{d}") from d
+        except _RollbackBudgetTick:
+            pass
+        self._discard_overlapped_val()
+        t0 = time.perf_counter()
+        self.ckpt.wait()  # land in-flight async saves + refresh the ledger
+        target = self._last_committed_step()
+        if target is None:
+            # fit() saves a step-0 checkpoint when the sentinel is armed,
+            # so this means checkpointing itself is broken — surface it
+            raise FloatingPointError(
+                f"sentinel: diverged with NO committed checkpoint to roll "
+                f"back to ({d})") from d
+        self.state, meta = self.ckpt.restore(self.state, step=target)
+        dt = time.perf_counter() - t0
+        self._rollback_seconds.append(dt)
+        self.sentinel_rollbacks += 1
+        self.sentinel_quarantined_steps += len(d.batch_indices)
+        self._quarantine.setdefault(d.epoch, set()).update(d.batch_indices)
+        self._sentinel.reset()  # spike verdicts re-warm on the replay
+        self._book_rollback(d, target, dt)
+        resume_epoch = int(meta.get("epoch", -1)) + 1
+        # completed-epoch history about to be replayed is dropped — the
+        # replay logs the real entries (same rule as preempt resume).
+        # val entries carry their epoch stamp, so a rollback past a
+        # validated epoch (e.g. its best-save was the torn write) cannot
+        # leave duplicate val records after the replay re-validates.
+        del history["train_loss"][max(0, resume_epoch - self.start_epoch):]
+        history["val"] = [m for m in history["val"]
+                          if m.get("epoch", -1) < resume_epoch]
+        self._resume_start_batch = 0
+        if self.is_main:
+            print(f"sentinel: diverged at step {d.report.step} "
+                  f"({d.report.reason}) — rolled back to committed step "
+                  f"{target} in {dt:.2f}s, quarantined batches "
+                  f"{d.batch_indices} of epoch {d.epoch}, resuming at "
+                  f"epoch {resume_epoch} (rollback "
+                  f"{self.sentinel_rollbacks}/"
+                  f"{cfg.sentinel.max_rollbacks})", flush=True)
+        return resume_epoch
+
+    def _book_rollback(self, d: _DivergenceDetected, target: int,
+                       seconds: float) -> None:
+        """Durable + telemetry record of one rollback: a quarantine.jsonl
+        line (the ledger ops reads back), registry counters, and writer
+        scalars."""
+        if self.is_main:
+            rec = {"epoch": d.epoch, "step_start": d.step_start,
+                   "step_end": d.step_end,
+                   "batch_indices": list(d.batch_indices),
+                   # JSON has no NaN/Inf: non-finite observed losses are
+                   # null (the same rule JsonlWriter applies)
+                   "losses": [x if np.isfinite(x) else None
+                              for x in d.losses],
+                   "reason": d.report.reason,
+                   "rollback_to_step": int(target),
+                   "restore_seconds": round(seconds, 3)}
+            with open(os.path.join(self.run_dir, "quarantine.jsonl"),
+                      "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            self.writer.scalars(
+                {"train/sentinel_rollbacks": self.sentinel_rollbacks,
+                 "train/sentinel_quarantined_steps":
+                     self.sentinel_quarantined_steps,
+                 "train/sentinel_rollback_to_step": int(target)},
+                d.step_end)
+        if self.cfg.telemetry:
+            from ..telemetry import get_registry
+            from ..telemetry.registry import is_enabled
+
+            if is_enabled():
+                reg = get_registry()
+                reg.counter(
+                    "train_sentinel_rollbacks_total",
+                    "Sentinel-triggered in-process rollbacks").inc()
+                reg.counter(
+                    "train_sentinel_quarantined_steps_total",
+                    "Steps quarantined by sentinel rollbacks"
+                ).inc(len(d.batch_indices))
+                reg.histogram(
+                    "train_sentinel_recovery_seconds",
+                    "Rollback restore time (divergence -> resumed state)"
+                ).observe(seconds)
 
     # ------------------------------------------------------------------- eval
     def _eval_metrics(self, state, epoch: int | None = None
@@ -1343,7 +1601,10 @@ class Trainer:
         checkpoint of ``state`` at ``step``)."""
         self._log_val(metrics, first, epoch, step)
         if history is not None:
-            history["val"].append(metrics)
+            # epoch-stamped: a sentinel rollback must be able to drop the
+            # entries of epochs it is about to replay (see
+            # _handle_divergence) without positional guesswork
+            history["val"].append(dict(metrics, epoch=epoch))
         is_best = self.ckpt.save(step, state, metric=metrics["jaccard"],
                                  extra={"epoch": epoch})
         if is_best and self.is_main:
@@ -1405,7 +1666,18 @@ class Trainer:
             # loader and pin the snapshot's HBM.  Normal completion joins
             # with full bookkeeping below, making this a no-op.
             stack.callback(self._discard_overlapped_val)
-            for epoch in range(self.start_epoch, cfg.epochs):
+            if self._sentinel is not None and \
+                    self._last_committed_step() is None:
+                # the sentinel's rollback target must EXIST before the
+                # first divergence can strike: a fresh run commits its
+                # initial state (step 0, or the resumed step) up front, so
+                # an epoch-0 divergence rolls back to init instead of
+                # failing with nothing to restore
+                self.ckpt.save(int(self.state.step), self.state,
+                               extra={"epoch": self.start_epoch - 1})
+                self.ckpt.wait()
+            epoch = self.start_epoch
+            while epoch < cfg.epochs:
                 t0 = time.perf_counter()
                 sb = self._resume_start_batch  # only the run's first epoch
                 self._resume_start_batch = 0
@@ -1418,11 +1690,19 @@ class Trainer:
                     ctx = trace(os.path.join(self.run_dir, "profile"))
                 else:
                     ctx = contextlib.nullcontext()
-                with ctx:
-                    epoch_loss = self.train_epoch(
-                        epoch, guard=guard, start_batch=sb,
-                        abort_check=(self._poll_overlapped_val_error
-                                     if cfg.val_overlap else None))
+                try:
+                    with ctx:
+                        epoch_loss = self.train_epoch(
+                            epoch, guard=guard, start_batch=sb,
+                            abort_check=(self._poll_overlapped_val_error
+                                         if cfg.val_overlap else None))
+                except _DivergenceDetected as d:
+                    # rollback-and-replay: restore the last committed
+                    # checkpoint, quarantine the bad window, re-enter the
+                    # loop at the restored epoch (budget-bounded — the
+                    # handler raises when the CircuitBreaker is open)
+                    epoch = self._handle_divergence(d, history)
+                    continue
                 # the previous epoch's overlapped validation ran during
                 # this train epoch; land its bookkeeping (best save, logs)
                 # before this epoch's own epoch-end work
@@ -1464,6 +1744,11 @@ class Trainer:
                             {"preempted_at_epoch": epoch}, step)
                     break
                 history["train_loss"].append(epoch_loss)
+                if self._rollback_breaker is not None:
+                    # a cleanly completed epoch closes the rollback
+                    # breaker: the budget bounds CONSECUTIVE rollbacks,
+                    # not lifetime ones (config.sentinel.max_rollbacks)
+                    self._rollback_breaker.call(lambda: None)
                 extra = {"epoch": epoch}
                 if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                     if cfg.val_overlap:
@@ -1484,6 +1769,7 @@ class Trainer:
                         {"epoch": epoch,
                          "epoch_total_seconds": time.perf_counter() - t0},
                         step)
+                epoch += 1
             # Flush inside the stack (and shielded): the graceful-stop
             # handlers must stay installed, and escalation deferred, until
             # the last async save has committed.
@@ -1494,6 +1780,37 @@ class Trainer:
                 self.ckpt.wait()
             # after the last save has landed, so its wait is in the books
             self._report_goodput(history)
+            # recovery block (the bench/report schema, train/sentinel.py):
+            # populated when the sentinel ran, None when it was off — the
+            # key itself is always present
+            if self._sentinel is not None:
+                from ..utils.profiling import percentile
+                from .sentinel import make_recovery_block
+                history["recovery"] = make_recovery_block(
+                    rollbacks=self.sentinel_rollbacks,
+                    quarantined_steps=self.sentinel_quarantined_steps,
+                    # supervisor_restarts stays None here — a supervisor
+                    # concept; dptpu-supervise folds its own count into
+                    # the summaries it aggregates
+                    recovery_p50_s=(
+                        round(percentile(self._rollback_seconds, 50), 3)
+                        if self._rollback_seconds else None))
+            else:
+                history["recovery"] = None
+            if self.is_main:
+                # fit_summary.json: the one file a SUPERVISOR (or operator)
+                # can classify an exited run by without Orbax — written
+                # atomically so a crash mid-write reads as "no summary"
+                # (= crashed), never as a torn verdict
+                atomic_write_json(
+                    os.path.join(self.run_dir, "fit_summary.json"),
+                    {"preempted": bool(history.get("preempted")),
+                     "completed": not history.get("preempted"),
+                     "final_step": int(self.state.step),
+                     "start_epoch": self.start_epoch,
+                     "epochs": cfg.epochs,
+                     "epochs_recorded": len(history["train_loss"]),
+                     "recovery": history["recovery"]})
             self.writer.flush()
         return history
 

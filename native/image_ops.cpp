@@ -158,6 +158,16 @@ void resize_f32(const float* src, int sh, int sw, int c,
 // Inverse-map affine warp: for each dst pixel, sample src at M^-1 * (x, y).
 // M is the 2x3 forward matrix (cv2.warpAffine convention); border is constant.
 // mode: 0 = nearest, 2 = bicubic.
+//
+// Coordinates follow cv2's FIXED-POINT pipeline, not exact float math:
+// warpAffine quantizes the inverse-mapped source coordinate to 1/32 px
+// (AB_SCALE = 1024 per-term rounding, then >> (AB_BITS - INTER_BITS)).
+// Sampling a high-gradient image at a coordinate that differs by up to
+// 1/64 px moves bicubic output by whole units on [0,255] data, so exact
+// float coordinates are NOT "more cv2-compatible" — they were the source
+// of the old p99≈3.8 parity gap vs cv2 (the tests' 0.1 bound).  The
+// interpolation weights themselves stay float, which matches cv2's float
+// weight tables for float images.
 void warp_affine_f32(const float* src, int sh, int sw, int c,
                      float* dst, int dh, int dw,
                      const double* m, int mode, float border) {
@@ -168,14 +178,32 @@ void warp_affine_f32(const float* src, int sh, int sw, int c,
   const double ia = e / det, ib = -b / det, id = -d / det, ie = a / det;
   const double itx = -(ia * tx + ib * ty), ity = -(id * tx + ie * ty);
 
+  // cv2 constants: AB_BITS=10 coordinate scale; INTER_BITS=5 fractional
+  // bits (1/32 px); round_delta centers the truncation that follows.
+  constexpr int kAbBits = 10, kInterBits = 5;
+  constexpr long long kAbScale = 1LL << kAbBits;
+  const long long round_delta =
+      mode == 0 ? kAbScale / 2 : kAbScale / (1 << kInterBits) / 2;
+
+  // Per-column terms, rounded SEPARATELY from the per-row terms and then
+  // summed — cv2's adelta[x]/bdelta[x] tables; matching its rounding
+  // composition is what makes parity bit-tight.
+  std::vector<long long> adelta(dw), bdelta(dw);
+  for (int x = 0; x < dw; ++x) {
+    adelta[x] = llrint(ia * x * kAbScale);
+    bdelta[x] = llrint(id * x * kAbScale);
+  }
+
   for (int y = 0; y < dh; ++y) {
+    const long long x_row = llrint((ib * y + itx) * kAbScale) + round_delta;
+    const long long y_row = llrint((ie * y + ity) * kAbScale) + round_delta;
     for (int x = 0; x < dw; ++x) {
-      const float fx = static_cast<float>(ia * x + ib * y + itx);
-      const float fy = static_cast<float>(id * x + ie * y + ity);
+      const long long xf = x_row + adelta[x];
+      const long long yf = y_row + bdelta[x];
       float* out = dst + (static_cast<int64_t>(y) * dw + x) * c;
       if (mode == 0) {
-        const int xs = static_cast<int>(std::lround(fx));
-        const int ys = static_cast<int>(std::lround(fy));
+        const int xs = static_cast<int>(xf >> kAbBits);
+        const int ys = static_cast<int>(yf >> kAbBits);
         if (xs < 0 || xs >= sw || ys < 0 || ys >= sh) {
           for (int k = 0; k < c; ++k) out[k] = border;
         } else {
@@ -183,12 +211,20 @@ void warp_affine_f32(const float* src, int sh, int sw, int c,
           std::memcpy(out, in, sizeof(float) * c);
         }
       } else {
-        const int x0 = static_cast<int>(std::floor(fx));
-        const int y0 = static_cast<int>(std::floor(fy));
+        const long long xq = xf >> (kAbBits - kInterBits);
+        const long long yq = yf >> (kAbBits - kInterBits);
+        const int x0 = static_cast<int>(xq >> kInterBits);
+        const int y0 = static_cast<int>(yq >> kInterBits);
+        const float fx =
+            static_cast<float>(xq & ((1 << kInterBits) - 1)) /
+            (1 << kInterBits);
+        const float fy =
+            static_cast<float>(yq & ((1 << kInterBits) - 1)) /
+            (1 << kInterBits);
         float wx[4], wy[4];
         for (int t = 0; t < 4; ++t) {
-          wx[t] = cubic_w(fx - (x0 - 1 + t));
-          wy[t] = cubic_w(fy - (y0 - 1 + t));
+          wx[t] = cubic_w(fx - (t - 1));
+          wy[t] = cubic_w(fy - (t - 1));
         }
         for (int k = 0; k < c; ++k) {
           float acc = 0.0f;

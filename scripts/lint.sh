@@ -11,7 +11,11 @@
 #      included — instrumentation sits at step-loop boundaries and must
 #      never smuggle a host sync into them; chaos/ included — its
 #      injection sites are woven INTO those loops and the disabled path
-#      must stay one attribute check, no host syncs) plus bench.py, the
+#      must stay one attribute check, no host syncs; train/sentinel.py +
+#      train/supervise.py included — the sentinel's verdicts consume
+#      ONLY the trainer's existing loss readbacks (no new host syncs
+#      inside compiled programs, the JL-rule gate pins it) and the
+#      supervisor must stay a stdlib process) plus bench.py, the
 #      official record.
 #   2. jaxaudit check — IR-level compile contracts: the canonical
 #      train/eval/serve programs (incl. the session split's

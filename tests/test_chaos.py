@@ -407,14 +407,39 @@ class TestCheckpointTornFiles:
 class TestScenarioSmoke:
     """The fast tier-1 chaos smokes: full runner path, in-process."""
 
-    def test_nan_loss_scenario(self, tmp_path):
+    def test_nan_loss_scenario_recovers(self, tmp_path):
+        """PR 7 upgrade: with the sentinel armed, nan_loss asserts the
+        run RECOVERS (rollback + quarantine + finite finish), not merely
+        that it survives — the legacy log-and-continue contract moved to
+        nan_loss_legacy below."""
         from distributedpytorch_tpu.chaos import runner
 
+        before = get_registry().counter(
+            "train_sentinel_rollbacks_total").value
         report = runner.run_scenario("nan_loss",
                                      work_dir=str(tmp_path / "w"),
                                      strict=True)
         assert report["ok"]
+        f = report["phases"]["fit"]
+        assert f["recovery"]["rollbacks"] == 1
+        assert f["recovery"]["quarantined_steps"] >= 1
+        assert f["quarantine"] and f["quarantine"][0]["batch_indices"]
+        # the sentinel never logs the legacy counter — it rolls back
+        assert f["nonfinite_steps_logged"] == 0
+        assert injected_counter("trainer/train_step", "nan") >= 1
+        assert get_registry().counter(
+            "train_sentinel_rollbacks_total").value == before + 1
+
+    def test_nan_loss_legacy_scenario(self, tmp_path):
+        """Back-compat pin: sentinel off -> today's log-and-continue."""
+        from distributedpytorch_tpu.chaos import runner
+
+        report = runner.run_scenario("nan_loss_legacy",
+                                     work_dir=str(tmp_path / "w"),
+                                     strict=True)
+        assert report["ok"]
         assert report["phases"]["fit"]["nonfinite_steps_logged"] == 1
+        assert report["phases"]["fit"]["recovery"] is None
         assert injected_counter("trainer/train_step", "nan") >= 1
 
     def test_serve_latency_shed_scenario(self, tmp_path):
@@ -473,7 +498,9 @@ class TestCLI:
             cwd=repo)
         assert r.returncode == 0
         for name in ("preempt_mid_epoch", "truncated_checkpoint",
-                     "serve_latency_shed", "nan_loss"):
+                     "serve_latency_shed", "nan_loss", "nan_loss_legacy",
+                     "divergence_rollback", "crash_loop",
+                     "preemption_storm"):
             assert name in r.stdout
         r = subprocess.run(
             [sys.executable, "-m", "distributedpytorch_tpu.chaos",

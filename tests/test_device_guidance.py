@@ -259,6 +259,9 @@ class TestTrainerIntegration:
         with pytest.raises(ValueError, match="device_guidance supports"):
             Trainer(cfg)
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): per-family e2e fit
+    # (~12s); the device-guidance trainer path stays fast-gated by
+    # test_e2e_device_guidance
     def test_e2e_confidence_family(self, tmp_path):
         from distributedpytorch_tpu.train import Trainer
 

@@ -45,6 +45,9 @@ class TestElasticResume:
         tr.close()
         return cfg, params, step, ck
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): the fit-onward half of
+    # the cross-layout story; the fast restore-only cross-layout gate
+    # is test_tp_checkpoint_resumes_on_wider_tp (shared fixture)
     def test_tp_checkpoint_resumes_on_pure_dp(self, first_run):
         cfg, params, step, ck = first_run
         # same work_dir, resume=auto, but an (8, 1) replicated layout
@@ -79,6 +82,8 @@ class TestElasticResume:
             specs, is_leaf=lambda s: hasattr(s, "index"))), specs
         tr2.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): two trainers + two fits
+    # (~28s); the tp->dp/tp->wider-tp direction stays fast above
     def test_dp_checkpoint_resumes_on_tp(self, tmp_path):
         """Reverse direction: replicated checkpoint -> sharded restore."""
         work = str(tmp_path)

@@ -203,6 +203,9 @@ class TestGrainInTrainer:
         with _pytest.raises(ValueError, match="data.loader"):
             Trainer(dataclasses.replace(cfg, work_dir=str(tmp_path)))
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): grain trainer fit
+    # (~15s); grain worker/cache behavior stays fast-gated in
+    # test_prepared.TestGrainProcessWorkers
     def test_len_accounts_for_per_worker_batching(self, fake_voc_root):
         from distributedpytorch_tpu.data import (
             GrainDataLoader,

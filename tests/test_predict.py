@@ -239,6 +239,9 @@ class TestFromTorch:
                                    p_native.predict(img, _points()),
                                    atol=1e-5)
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): torch-script export
+    # roundtrip (~11s); torch interop stays fast-gated in
+    # test_torch_interop
     def test_export_torch_script_roundtrip(self, tmp_path):
         """run dir -> scripts/export_torch.py -> .pth -> from_torch gives
         the same predictions as from_run (full interop loop)."""

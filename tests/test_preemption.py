@@ -350,6 +350,9 @@ class TestExactResumeFallbacks:
         tr.close()
         return nb, ckpt_dir
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): same stale-offset
+    # fallback path as test_changed_batch_falls_back_to_replay
+    # (fast), different stale key (~12s)
     def test_changed_echo_falls_back_to_replay(self, tmp_path):
         cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path),
                                     "epochs": 2,

@@ -396,6 +396,9 @@ class TestTrainerIntegration:
         assert tr.train_set.n_prepared == len(tr.train_set)
         tr.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): trainer e2e (~21s); the
+    # K-step == sequential semantics contract stays fast-gated in
+    # test_parallel.TestMultiStepDispatch
     def test_steps_per_dispatch_smoke(self, tmp_path):
         """Thin tier-1 smoke of the multi-step dispatch path: the fake
         fixture at tiny shapes takes the 2-chunk path + the 1-batch tail
@@ -626,6 +629,8 @@ class TestPackBitsWire:
         with pytest.raises(ValueError, match="packbits_masks"):
             Trainer(bad)
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): two fits (~18s); the
+    # packbits wire stays fast-gated by test_trainer_packbits_e2e
     def test_packed_loss_matches_unpacked(self, tmp_path):
         """Same seeds, packed vs plain wire: the training losses must be
         bitwise-identical — packing is wire format, not semantics."""
@@ -688,7 +693,13 @@ class TestCoalesceWire:
         with pytest.raises(ValueError, match="coalesce_wire"):
             Trainer(bad)
 
-    @pytest.mark.parametrize("packbits", [False, True])
+    @pytest.mark.parametrize("packbits", [
+        False,
+        # tier-1 budget (PR 7): the packbits-riding variant is slow-gated
+        # (~19s); the packed row keeps its own fast gate
+        # (test_trainer_packbits_e2e) and the plain coalesce parity stays
+        pytest.param(True, marks=pytest.mark.slow),
+    ])
     def test_coalesced_loss_matches_plain(self, tmp_path, packbits):
         """Same seeds, coalesced vs per-key wire: training losses must be
         bitwise-identical — coalescing is transfer shape, not semantics.
@@ -713,6 +724,8 @@ class TestCoalesceWire:
         np.testing.assert_array_equal(run(True, f"c{packbits}"),
                                       run(False, f"p{packbits}"))
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): composition smoke
+    # (~17s); each composed feature keeps its own fast gate
     def test_coalesced_multi_step_dispatch(self, tmp_path):
         """coalesce_wire + steps_per_dispatch>1: the K-step scan unpacks
         each step's buffer; losses match the K=1 coalesced run."""

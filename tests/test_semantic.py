@@ -103,6 +103,9 @@ class TestSemanticTrainerEndToEnd:
 
 
 class TestEncNetSemantic:
+    @pytest.mark.slow  # tier-1 budget (PR 7): per-model fit (~9s);
+    # EncNet forward/grad stays fast-gated in test_models, and
+    # the semantic fit path by TestAuxHead's deeplab fit
     def test_fit_encnet_semantic(self, tmp_path):
         """EncNet through the full Trainer: the 2D SE-presence output rides
         the multi_softmax loss (ndim dispatch) in train AND eval, and the
@@ -300,6 +303,8 @@ class TestSemanticTTA:
         assert base["miou"] == triv["miou"]
         tr.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): full TTA sweep (~8s);
+    # the TTA e2e stays fast-gated by test_e2e_trainer_with_tta
     def test_full_tta_runs_and_scores(self, tmp_path):
         from distributedpytorch_tpu.train.evaluate import evaluate_semantic
 
@@ -553,6 +558,8 @@ class TestCCNetSemantic:
                                              * np.stack(vecs)).sum(0)
         np.testing.assert_allclose(got, want, atol=2e-4)
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): per-model fit (~10s);
+    # CCNet forward/grad stays fast-gated in test_models
     def test_fit_ccnet_semantic(self, tmp_path):
         """CCNet end-to-end through the Trainer on the 8-device mesh."""
         cfg = apply_overrides(Config(), [

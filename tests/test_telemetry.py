@@ -255,6 +255,8 @@ class TestPrometheusExposition:
 
 
 class TestTraceCapture:
+    @pytest.mark.slow  # tier-1 budget (PR 7): real XPlane capture
+    # (~18s); arming/refusal logic stays fast-gated below
     def test_bounded_capture_writes_xplane(self, tmp_path):
         import jax.numpy as jnp
         trig = TraceCapture(str(tmp_path), default_steps=2)

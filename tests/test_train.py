@@ -313,6 +313,9 @@ class TestCrashRecoveryTrajectory:
     could not make this guarantee (optimizer/RNG state never saved,
     SURVEY §3.5)."""
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): three full fits (~21s);
+    # the fast gates are test_resume_restores_exact_state (exact
+    # restore) + test_chaos's donation-safety regression unit
     def test_resumed_run_matches_straight_run(self, tiny_cfg):
         base = dataclasses.replace(
             tiny_cfg, eval_every=0, debug_asserts=False,
@@ -377,6 +380,9 @@ class TestEmptyLoaderGuard:
 
 
 class TestProfileEpoch:
+    @pytest.mark.slow  # tier-1 budget (PR 7): full fit under the
+    # profiler (~22s); trace file writing stays fast-gated in
+    # test_profiling.TestTrace
     def test_profile_epoch_writes_trace(self, tiny_cfg, tmp_path):
         cfg = dataclasses.replace(
             tiny_cfg, epochs=1, eval_every=0, work_dir=str(tmp_path / "runs"),
@@ -395,6 +401,8 @@ class TestProfileEpoch:
 class TestMoEConfig:
     """DANet-MoE variant end-to-end: router aux loss in the objective."""
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): full MoE fit (~9s);
+    # router math/aux-loss semantics stay fast-gated in test_moe
     def test_fit_one_epoch_moe(self, tiny_cfg):
         cfg = dataclasses.replace(
             tiny_cfg,
@@ -559,6 +567,9 @@ class TestAutoResume:
 
 
 class TestDeviceGeomAugment:
+    @pytest.mark.slow  # tier-1 budget (PR 7): full fit (~10s); the
+    # device geom-augment fit path stays fast-gated by
+    # test_grain_augment's semantic device-geom trainer fit
     def test_fit_with_on_device_scale_rotate(self, tiny_cfg):
         cfg = dataclasses.replace(
             tiny_cfg,

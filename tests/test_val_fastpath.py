@@ -227,6 +227,9 @@ class TestTrainerIntegration:
         tr_plain.close()
         tr_fast.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): packbits val parity
+    # (~9s); the packbits wire keeps its fast train-side gate in
+    # test_prepared
     def test_val_parity_with_packed_mask_wire(self, fake_voc_root,
                                               tmp_path):
         """data.packbits_masks now rides the VAL wire too (1-bit crop_gt,
@@ -283,6 +286,8 @@ class TestTrainerIntegration:
         tr_plain.close()
         tr_fast.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 7): TTA x prepared-val
+    # composition (~12s); each half keeps its own fast gate
     def test_semantic_tta_composes_with_prepared_val(self, tmp_path):
         """Multi-scale + flip TTA reads the val batch host-side and
         re-forwards resized copies — it must compose with the uint8

@@ -126,6 +126,9 @@ class TestZeroState:
 
 
 class TestTrainerIntegration:
+    @pytest.mark.slow  # tier-1 budget (PR 7): fit+resume e2e (~13s);
+    # ZeRO-1 numerics stay fast-gated by
+    # test_step_matches_replicated_numerics
     def test_fit_and_resume_with_zero1(self, tmp_path):
         from tests.test_train import make_tiny_cfg
         from distributedpytorch_tpu.train import Trainer
