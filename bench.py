@@ -7,7 +7,12 @@ Prints ONE JSON line:
 Measures the full jitted train step (forward + multi-output loss + backward +
 SGD update) for DANet-ResNet101 on 512x512 4-channel inputs — the reference's
 exact training configuration (train_pascal.py:65,86,118,127) — on whatever
-devices are present (one real TPU chip under the driver).
+devices are present (one real TPU chip under the driver).  On TPU the step
+runs the PR-8 fast path by default: bf16 mixed precision (f32 master params,
+`precision` block in the record), the fused Pallas dual-attention kernels
+(model.attention_impl=auto), and the bucketed overlapped gradient all-reduce
+(`reduce_buckets`); ``--check-regression`` gates the number against the
+newest committed same-config BENCH record (>10% drop exits non-zero).
 
 ``vs_baseline``: the reference published no numbers (BASELINE.json.published
 == {}; its epoch timer printed to a console nobody recorded), so there is no
@@ -67,6 +72,12 @@ _parser.add_argument(
          "against a split (guidance_inject='head') predictor, reporting "
          "warm/cold latency and the cache counters in a `sessions` "
          "record block")
+_parser.add_argument(
+    "--check-regression", action="store_true",
+    help="after the record prints, compare it against the NEWEST "
+         "same-config committed BENCH_*.json and exit non-zero on a "
+         ">10%% throughput regression — the bench trajectory as a gate, "
+         "not a single data point")
 # this module is also imported (by tests and capture replay): only read
 # argv when bench.py IS the program, so a host process keeps its own
 # -h/--help and flags
@@ -103,12 +114,16 @@ from distributedpytorch_tpu.telemetry.goodput import (  # noqa: E402
 )
 from distributedpytorch_tpu.chaos import sites as chaos_sites  # noqa: E402
 from distributedpytorch_tpu.telemetry import get_accountant  # noqa: E402
+from distributedpytorch_tpu.train.precision import (  # noqa: E402
+    precision_block,
+    precision_policy,
+)
 from distributedpytorch_tpu.train.sentinel import (  # noqa: E402
     recovery_block,
 )
 
 
-def ir_audit_fields(fn, args, program: str) -> dict:
+def ir_audit_fields(fn, args, program: str, **audit_kw) -> dict:
     """The record's IR-audit fields (jaxaudit, analysis/ir.py): the
     compiled program's collective inventory and its compile-contract
     status ('pass' | 'drift' | 'no_contract' | 'skipped' | 'error').
@@ -125,7 +140,12 @@ def ir_audit_fields(fn, args, program: str) -> dict:
     A fresh setup therefore starts at 'no_contract':
     DPTPU_BENCH_AUDIT_UPDATE=1 pins the current program as that
     config's contract, after which every later record reports
-    pass/drift against it."""
+    pass/drift against it.
+
+    ``audit_kw`` passes through to the auditor: the bf16 bench step
+    audits against the precision policy's declared accumulation points
+    (f32_allow), and the bucketed step stamps overlap_expected so a
+    TPU-pinned bench contract requires async -start collectives."""
     fields = {"collectives": None, "ir_contract": "skipped"}
     if os.environ.get("DPTPU_BENCH_AUDIT", "1") == "0":
         return fields
@@ -133,7 +153,8 @@ def ir_audit_fields(fn, args, program: str) -> dict:
         from distributedpytorch_tpu.analysis import contracts as _contracts
         from distributedpytorch_tpu.analysis import ir as _ir
 
-        rep = _ir.audit(fn, _ir.struct_of(tuple(args)), name=program)
+        rep = _ir.audit(fn, _ir.struct_of(tuple(args)), name=program,
+                        **audit_kw)
         fields["collectives"] = rep["collectives"]
         if os.environ.get("DPTPU_BENCH_AUDIT_UPDATE") == "1":
             _contracts.save_contract(
@@ -201,6 +222,19 @@ REMAT_POLICY = os.environ.get("DPTPU_BENCH_REMAT_POLICY") or None
 #: os=16, 513², 21-class softmax CE, 3-channel input) with the same
 #: MFU/roofline fields as the flagship.  Default: the flagship DANet.
 BENCH_MODEL = os.environ.get("DPTPU_BENCH_MODEL", "danet")
+#: train.precision for the bench step: the mixed-precision policy (bf16
+#: compute, f32 master params — train/precision.py) rides the existing
+#: DTYPE split (bf16 on TPU, f32 on CPU smoke); DPTPU_BENCH_PRECISION
+#: overrides for A/Bs.  The record's `precision` block carries it
+#: (null when f32 — keys always present).
+PRECISION = os.environ.get("DPTPU_BENCH_PRECISION") or DTYPE
+#: train.reduce_buckets for the bench step: reverse-topo bucketed
+#: gradient all-reduce (comm/compute overlap) — default 8 on TPU where
+#: the async scheduler exploits it, 0 on the CPU smoke (keeps the
+#: downsized program aligned with the cpu8 canonical contract shapes).
+#: DPTPU_BENCH_REDUCE_BUCKETS overrides for the overlap A/B.
+REDUCE_BUCKETS = int(os.environ.get("DPTPU_BENCH_REDUCE_BUCKETS",
+                                    "8" if ON_TPU else "0"))
 
 #: Sidecar holding the most recent on-chip capture of the DEFAULT bench
 #: config.  Written on every healthy TPU run; replayed (clearly labeled,
@@ -218,7 +252,9 @@ REPLAY_MAX_AGE_HOURS = 24.0
 def _is_default_config() -> bool:
     return (BENCH_MODEL == "danet" and not SCORE_DTYPE
             and BN_FP32_STATS and not REMAT
-            and not os.environ.get("DPTPU_BENCH_BATCH"))
+            and not os.environ.get("DPTPU_BENCH_BATCH")
+            and not os.environ.get("DPTPU_BENCH_PRECISION")
+            and not os.environ.get("DPTPU_BENCH_REDUCE_BUCKETS"))
 
 
 def save_latest_tpu_capture(record: dict) -> None:
@@ -301,6 +337,99 @@ def try_replay_tpu_capture() -> dict | None:
     return rec
 
 
+# -------------------------------------------------- regression gate
+#: --check-regression failure threshold: a >10% throughput drop against
+#: the newest committed same-config record fails the run
+REGRESSION_THRESHOLD = 0.10
+
+
+def load_bench_history(history_dir: str | None = None) -> list:
+    """``[(path, record), ...]`` from the committed ``BENCH_*.json``
+    round records, oldest-first (lexicographic — the driver names them
+    ``BENCH_r<NN>.json``).  Each file is either a bare record or the
+    driver's ``{"cmd": ..., "parsed": {record}}`` wrapper; unreadable
+    files are skipped (history must never crash a record run)."""
+    import glob
+
+    history_dir = history_dir or os.path.dirname(os.path.abspath(__file__))
+    out = []
+    for path in sorted(glob.glob(os.path.join(history_dir,
+                                              "BENCH_*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except Exception:
+            continue
+        rec = data.get("parsed") if isinstance(data, dict) else None
+        if not isinstance(rec, dict):
+            rec = data if isinstance(data, dict) else None
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            out.append((path, rec))
+    return out
+
+
+def check_regression(record: dict, history: list | None = None,
+                     threshold: float = REGRESSION_THRESHOLD
+                     ) -> tuple[bool, str]:
+    """Compare ``record`` against the NEWEST committed record of the
+    SAME config: same ``metric`` string (the metric name carries
+    model/backbone/size/batch), same ``platform`` (a CPU-fallback
+    number must never gate against a TPU record), and same
+    ``precision`` block + ``reduce_buckets`` (a bf16+bucketed fast-path
+    number and an f32 serialized-reduce number are different
+    trajectories — neither may baseline the other, even if a variant
+    record was committed into history).  Replayed capture records are
+    not comparison targets (they are themselves old numbers).  Returns
+    ``(ok, message)``; ``ok=False`` means the throughput dropped more
+    than ``threshold``.  No prior record -> ok (a fresh config starts
+    its own trajectory)."""
+    history = load_bench_history() if history is None else history
+    prior = [(p, r) for p, r in history
+             if r.get("metric") == record.get("metric")
+             and r.get("platform") == record.get("platform")
+             and r.get("precision") == record.get("precision")
+             and r.get("reduce_buckets") == record.get("reduce_buckets")
+             and not r.get("replayed_from_session_capture")]
+    if not prior:
+        return True, (f"no prior {record.get('metric')} record on "
+                      f"{record.get('platform')}; nothing to compare")
+    path, ref = prior[-1]
+    old, new = float(ref["value"]), float(record["value"])
+    if old <= 0:
+        return True, f"prior record in {os.path.basename(path)} is <= 0"
+    delta = new / old - 1.0
+    msg = (f"{record.get('metric')}: {new:.3f} vs {old:.3f} "
+           f"{ref.get('unit', '')} in {os.path.basename(path)} "
+           f"({delta:+.1%})")
+    if -delta > threshold:
+        return False, f"throughput regression past {threshold:.0%}: {msg}"
+    return True, msg
+
+
+def _maybe_check_regression(record: dict) -> None:
+    """The --check-regression tail of every bench mode: report to
+    stderr (stdout is the record), exit 1 on a gated regression."""
+    if not _CLI_ARGS.check_regression:
+        return
+    if record.get("replayed_from_session_capture"):
+        print("check-regression: skipped (replayed capture, not a fresh "
+              "measurement)", file=sys.stderr)
+        return
+    if not _is_default_config():
+        # A/B variants (DPTPU_BENCH_PRECISION=float32, REDUCE_BUCKETS=0,
+        # batch/score-dtype overrides, ...) are exploratory measurements,
+        # not trajectory records: a slower-by-design variant must never
+        # fail the gate, and committed history only holds default runs
+        print("check-regression: skipped (non-default A/B config — the "
+              "gate protects the default-config trajectory)",
+              file=sys.stderr)
+        return
+    ok, msg = check_regression(record)
+    print(f"check-regression: {msg}", file=sys.stderr)
+    if not ok:
+        raise SystemExit(1)
+
+
 #: --serve load shape: enough concurrent closed-loop clients to keep the
 #: top bucket fillable, enough requests for a stable p99
 SERVE_CLIENTS = 8
@@ -337,7 +466,7 @@ def _sessions_block(store_snapshot: dict | None,
     }
 
 
-def serve_bench() -> None:
+def serve_bench():
     """Synthetic client load against serve.InferenceService.
 
     Fresh-init weights (throughput does not depend on the checkpoint),
@@ -449,6 +578,10 @@ def serve_bench() -> None:
     # present, all null — the bench's burst loop never runs Trainer.fit,
     # so there is no sentinel to roll anything back
     record["recovery"] = recovery_block()
+    # precision block (train/precision.py): the compute regime the
+    # served model actually runs (bf16 on TPU); null when f32 — key
+    # always present (schema stability)
+    record["precision"] = precision_block(precision_policy(DTYPE))
     # IR-audit fields: the top bucket's forward (the program serving the
     # measured burst), same schema as the train record.  Config-named —
     # never the canonical serve_forward_b<N> names, whose contracts pin
@@ -466,9 +599,10 @@ def serve_bench() -> None:
         record["note"] = ("CPU fallback (downsized config), not a TPU "
                           "number")
     print(json.dumps(record))
+    return record
 
 
-def serve_sessions_bench() -> None:
+def serve_sessions_bench():
     """The interactive click loop through serve/sessions, measured.
 
     SESSIONS_N concurrent sessions each place 1 cold click (encode +
@@ -581,6 +715,8 @@ def serve_sessions_bench() -> None:
     record["mfu"] = None
     record["chaos"] = chaos_sites.active_scenario()
     record["recovery"] = recovery_block()  # null block; key stability
+    # precision block: the served model's compute regime; null when f32
+    record["precision"] = precision_block(precision_policy(DTYPE))
     # IR audit of the warm hot path (the decode program at the top
     # bucket) — config-named, same convention as the burst bench
     feats = predictor.feature_struct(1)
@@ -599,6 +735,7 @@ def serve_sessions_bench() -> None:
         record["note"] = ("CPU fallback (downsized config), not a TPU "
                           "number")
     print(json.dumps(record))
+    return record
 
 
 def main() -> None:
@@ -609,10 +746,9 @@ def main() -> None:
     # effect (the same rule as the __main__-gated argv read above).
     chaos_sites.maybe_arm_from_env()
     if _CLI_ARGS.serve:
-        if _CLI_ARGS.sessions:
-            serve_sessions_bench()
-        else:
-            serve_bench()
+        record = (serve_sessions_bench() if _CLI_ARGS.sessions
+                  else serve_bench())
+        _maybe_check_regression(record)
         return
     if _CLI_ARGS.sessions:
         raise SystemExit("--sessions is a serve mode; pass --serve too")
@@ -620,6 +756,7 @@ def main() -> None:
         replay = try_replay_tpu_capture()
         if replay is not None:
             print(json.dumps(replay))
+            _maybe_check_regression(replay)
             return
     from distributedpytorch_tpu.models import build_model
     from distributedpytorch_tpu.parallel import (
@@ -634,9 +771,21 @@ def main() -> None:
     semantic = BENCH_MODEL != "danet"
     size = (SIZE + 1) if semantic and ON_TPU else SIZE  # 513² protocol
     in_ch, nclass = (3, 21) if semantic else (4, 1)
-    common = dict(nclass=nclass, backbone=BACKBONE, dtype=DTYPE,
+    # train.precision + train.reduce_buckets — the PR-8 fast path: bf16
+    # compute under the policy (f32 master params), bucketed overlapped
+    # gradient reduce (cross-replica BN rides the shard_map region).
+    policy = precision_policy(PRECISION)
+    # no policy -> the model dtype IS the resolved PRECISION (i.e. f32):
+    # DPTPU_BENCH_PRECISION=float32 must measure a genuinely-f32 model,
+    # and the record's null `precision` block must mean what it says —
+    # falling back to the platform DTYPE here would silently rebuild the
+    # legacy bf16-model-dtype config while labeling the record f32
+    common = dict(nclass=nclass, backbone=BACKBONE,
+                  dtype=(policy.compute_dtype if policy else PRECISION),
                   bn_fp32_stats=BN_FP32_STATS, remat=REMAT,
-                  remat_policy=REMAT_POLICY)
+                  remat_policy=REMAT_POLICY,
+                  bn_cross_replica_axis=("data" if REDUCE_BUCKETS
+                                         else None))
     if semantic:
         # aux_head=True: BASELINE config 4 was measured multi-output
         # (primary + 0.4-weighted aux CE) — benching without it would be
@@ -664,7 +813,8 @@ def main() -> None:
                                    (1, size, size, in_ch), mesh=mesh)
         step = make_train_step(
             model, tx, mesh=mesh,
-            loss_type="multi_softmax" if semantic else "multi_sigmoid")
+            loss_type="multi_softmax" if semantic else "multi_sigmoid",
+            precision=policy, reduce_buckets=REDUCE_BUCKETS)
         batch = shard_batch(mesh, host_batch)
         cost = step_cost(step, state, batch)
         flops = cost["flops"]
@@ -696,10 +846,19 @@ def main() -> None:
         # after the measurement (never before: the audit's trace must not
         # share the timed window); struct args — the real state was
         # donated to the steps above.  The name carries the bench config
-        # so each A/B variant pins its own contract.
+        # so each A/B variant pins its own contract.  Under the policy
+        # the JA002 pass uses the declared accumulation points, and the
+        # bucketed step's contract (pinned on TPU) requires async
+        # -start collectives — the overlap gate of ROADMAP item 4.
+        audit_kw = {}
+        if policy is not None:
+            audit_kw["f32_allow"] = policy.ja002_allow()
+        if REDUCE_BUCKETS:
+            audit_kw["overlap_expected"] = True
         audit_fields = ir_audit_fields(
             step, (state, batch),
-            f"bench_{BENCH_MODEL}_{BACKBONE}_{size}px_b{BATCH}")
+            f"bench_{BENCH_MODEL}_{BACKBONE}_{size}px_b{BATCH}",
+            **audit_kw)
 
     per_chip = stats["items_per_sec"] / n_chips
     record = {
@@ -763,6 +922,11 @@ def main() -> None:
     # supervisor_restarts / recovery_p50_s — keys always present, null
     # when the sentinel is off (this synthetic step loop never arms it)
     record["recovery"] = recovery_block()
+    # precision block (train/precision.py): the mixed-precision regime
+    # the measured step ran under; null when f32 — key always present
+    record["precision"] = precision_block(policy)
+    if REDUCE_BUCKETS:
+        record["reduce_buckets"] = REDUCE_BUCKETS
     # IR-audit fields (jaxaudit): collective inventory of the exact
     # compiled step + compile-contract status; keys always present
     record.update(audit_fields)
@@ -788,6 +952,7 @@ def main() -> None:
     if ON_TPU and _is_default_config():
         save_latest_tpu_capture(record)
     print(json.dumps(record))
+    _maybe_check_regression(record)
 
 
 if __name__ == "__main__":

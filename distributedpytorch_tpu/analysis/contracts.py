@@ -41,12 +41,16 @@ import sys
 #: (the bucket ladder's ends), and the session-serving encode/decode
 #: split at the interactive click shape (b1).  Any ``serve_forward_b<N>``
 #: name is buildable on demand (``--programs serve_forward_b4``).
-PROGRAM_NAMES = ("train_step", "eval_step",
+PROGRAM_NAMES = ("train_step", "train_step_bf16", "eval_step",
                  "serve_forward_b1", "serve_forward_b8",
                  "encode_step", "decode_step")
 
 _PROGRAM_HELP = {
     "train_step": "jitted mesh train step (fwd+loss+bwd+SGD, donated)",
+    "train_step_bf16": "mixed-precision (train.precision=bfloat16) train "
+                       "step with bucketed overlapped gradient reduce — "
+                       "JA002 audited against the policy's declared "
+                       "accumulation points",
     "eval_step": "jitted mesh eval step (fwd+loss)",
     "serve_forward_b1": "serve bucket forward, batch 1",
     "serve_forward_b8": "serve bucket forward, batch 8",
@@ -92,8 +96,20 @@ def contract_path(contracts_dir: str, program: str, key: str) -> str:
 # ----------------------------------------------------------------- contracts
 
 def contract_from_report(report: dict) -> dict:
-    """The pinned subset of an :func:`ir.audit` report."""
-    return {
+    """The pinned subset of an :func:`ir.audit` report.
+
+    A report stamped ``overlap_expected`` (the bucketed train step)
+    additionally pins ``require_async_starts`` on MULTI-DEVICE TPU
+    platform keys: ``check`` then demands at least one async ``-start``
+    collective in the live HLO — the comm/compute-overlap regression
+    gate.  Single-chip TPU keys never pin it (XLA deletes the
+    singleton-group all-reduces — there is nothing to overlap).  CPU
+    keys never pin it (XLA:CPU lowers every collective synchronously); there
+    the overlap structure is gated by the exact psum-bucket counts in
+    the jaxpr inventory instead (a step silently regressing to
+    replicated zeroes them — the same failure class, caught at the
+    jaxpr level)."""
+    out = {
         "program": report["program"],
         "platform_key": platform_key(report["platform"],
                                      report["n_devices"]),
@@ -110,6 +126,14 @@ def contract_from_report(report: dict) -> dict:
         "flops": report["flops"],
         "finding_counts": dict(report["finding_counts"]),
     }
+    if (report.get("overlap_expected") and report["platform"] == "tpu"
+            and int(report.get("n_devices") or 0) > 1):
+        # single-chip meshes have nothing to overlap: XLA deletes the
+        # singleton-group all-reduces, so a tpu1-keyed contract pinning
+        # async starts would self-drift forever — only multi-device
+        # topologies can (and must) show `-start` forms
+        out["require_async_starts"] = True
+    return out
 
 
 def diff_contract(contract: dict, report: dict) -> list[str]:
@@ -128,6 +152,19 @@ def diff_contract(contract: dict, report: dict) -> list[str]:
         elif want != have:
             drift.append(f"collectives[{level}]: contract {want} "
                          f"!= live {have}")
+
+    if contract.get("require_async_starts"):
+        from .ir import async_start_count
+
+        hlo = (report.get("collectives") or {}).get("hlo")
+        n_async = async_start_count(hlo)
+        if n_async == 0:
+            drift.append(
+                "async overlap: contract requires async -start "
+                "collectives (> 0) but the live HLO lowered "
+                f"{'none' if hlo else 'no collectives at all'} — the "
+                "bucketed reduce re-serialized (or the step regressed "
+                "to replicated)")
 
     want_out, have_out = contract["outputs"], report["outputs"]
     if want_out != have_out:
@@ -248,7 +285,7 @@ def build_default_programs(names: tuple | list | None = None) -> dict:
 
     names = tuple(names) if names else PROGRAM_NAMES
     unknown = [n for n in names
-               if n not in ("train_step", "eval_step",
+               if n not in ("train_step", "train_step_bf16", "eval_step",
                             "encode_step", "decode_step")
                and not (n.startswith("serve_forward_b")
                         and n[len("serve_forward_b"):].isdigit())]
@@ -283,6 +320,40 @@ def build_default_programs(names: tuple | list | None = None) -> dict:
                 ev = make_eval_step(model, mesh=mesh,
                                     loss_type="multi_sigmoid")
                 programs["eval_step"] = (ev, (state_struct, batch))
+
+    if "train_step_bf16" in names:
+        # the fast-path twin: mixed-precision policy (bf16 compute, f32
+        # master params — train/precision.py) + bucketed overlapped
+        # gradient reduce (4 reverse-topo psum buckets) + cross-replica
+        # BN (the bucketed step's shard_map region computes per-device,
+        # so BN batch stats psum explicitly).  Audited against the
+        # POLICY's JA002 allowlist — zero dtype_upcast findings pinned
+        # means every f32 op on the bf16 path is a declared accumulation
+        # point — and stamped overlap_expected, so a TPU-keyed contract
+        # additionally requires async -start collectives (> 0).
+        from ..train.precision import precision_policy
+
+        policy = precision_policy("bfloat16")
+        mesh_bf16 = make_mesh()
+        b = mesh_bf16.devices.size
+        batch = {"concat": sds((b, h, w, ch), jnp.float32),
+                 "crop_gt": sds((b, h, w), jnp.float32)}
+        model_bf16 = build_model(
+            "danet", nclass=1, backbone="resnet18", output_stride=8,
+            dtype=policy.compute_dtype,
+            bn_cross_replica_axis="data")
+        with mesh_bf16:
+            state_struct = jax.eval_shape(
+                lambda: create_train_state(
+                    jax.random.PRNGKey(0), model_bf16, tx, (1, h, w, ch),
+                    mesh=mesh_bf16))
+            step = make_train_step(model_bf16, tx, mesh=mesh_bf16,
+                                   loss_type="multi_sigmoid",
+                                   precision=policy, reduce_buckets=4)
+            programs["train_step_bf16"] = (
+                step, (state_struct, batch),
+                {"f32_allow": policy.ja002_allow(),
+                 "overlap_expected": True})
 
     serve = [n for n in names if n.startswith("serve_forward_b")]
     if serve:

@@ -61,6 +61,10 @@ _HLO_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
 #: bytes for math the bf16 units could do.
 DEFAULT_F32_ACCUM_ALLOW = frozenset({
     "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    # cross-device reductions are reductions: a bf16 gradient
+    # contribution upcast into an f32 psum (the bucketed all-reduce)
+    # is the mixed-precision master-grad accumulation, device-spanning
+    "psum", "psum2", "pmax", "pmin",
     "dot_general", "conv_general_dilated",
     "convert_element_type", "reduce_precision", "stop_gradient",
     # layout/movement, no arithmetic
@@ -151,7 +155,17 @@ def collective_inventory(closed_jaxpr) -> dict:
 def hlo_collective_counts(compiled) -> dict | None:
     """Collective op counts in the compiled module's HLO text — the
     all-reduces GSPMD inserted for sharded programs, invisible at the
-    jaxpr level.  None when the text is unavailable."""
+    jaxpr level.  None when the text is unavailable.
+
+    Each base op's count covers BOTH forms (sync + async ``-start``) —
+    the total collective volume, stable across a backend flipping its
+    async lowering.  When async forms are present, a separate
+    ``<op>-start`` key additionally reports just those: the overlap
+    signal (an async-started collective is one the scheduler can hide
+    behind compute; its count dropping to zero means the collectives
+    re-serialized).  Backends that lower everything synchronously (the
+    cpu8 tier-1 topology) emit no ``-start`` keys, so pre-existing
+    contracts are unaffected."""
     try:
         text = compiled.as_text()
     except Exception:
@@ -163,7 +177,18 @@ def hlo_collective_counts(compiled) -> dict | None:
         n = len(re.findall(rf" {op}(?:-start)?\(", text))
         if n:
             counts[op] = n
+        n_start = len(re.findall(rf" {op}-start\(", text))
+        if n_start:
+            counts[f"{op}-start"] = n_start
     return counts
+
+
+def async_start_count(hlo_counts: dict | None) -> int:
+    """Total async ``-start`` collectives in one HLO inventory — the
+    scalar the overlap contracts gate on."""
+    if not hlo_counts:
+        return 0
+    return sum(n for op, n in hlo_counts.items() if op.endswith("-start"))
 
 
 # ------------------------------------------------------------ dtype findings
@@ -382,13 +407,25 @@ def _format_aval(aval) -> str:
 def audit(fn, args: tuple = (), *, name: str = "program",
           compile: bool = True,
           f32_allow: frozenset = DEFAULT_F32_ACCUM_ALLOW,
-          large_const_bytes: int = DEFAULT_LARGE_CONST_BYTES) -> dict:
+          large_const_bytes: int = DEFAULT_LARGE_CONST_BYTES,
+          overlap_expected: bool = False) -> dict:
     """Audit one jitted callable at ``args`` (concrete arrays or
     ShapeDtypeStructs — tracing never executes the program).
 
     ``compile=False`` stops at the jaxpr: collective/dtype/output/const
     checks only, no HLO inventory, no donation-aliasing or FLOPs fields
     (trace-only costs well under a second even for the full train step).
+
+    ``f32_allow`` widens JA002's accumulation allowlist — a
+    mixed-precision policy passes its declared accumulation points
+    (``train.precision.Policy.ja002_allow``) so the bf16 step audits
+    strictly against what the policy actually declared.
+
+    ``overlap_expected`` stamps the report as one whose collectives are
+    structured for comm/compute overlap (the bucketed train step);
+    :mod:`contracts` turns that into a ``require_async_starts``
+    expectation on platforms whose compiler lowers async collectives
+    (TPU) — see ``contract_from_report``.
 
     Returns the JSON-able report :mod:`contracts` pins.
     """
@@ -419,6 +456,7 @@ def audit(fn, args: tuple = (), *, name: str = "program",
         "program": name,
         "platform": jax.devices()[0].platform,
         "n_devices": len(jax.devices()),
+        "overlap_expected": overlap_expected,
         "collectives": {
             "jaxpr": collective_inventory(closed),
             "hlo": hlo_collective_counts(compiled) if compile else None,
@@ -443,9 +481,17 @@ def audit(fn, args: tuple = (), *, name: str = "program",
 
 
 def audit_many(programs: dict, **kwargs) -> dict:
-    """``{name: (fn, args)} -> {name: report}`` (see :func:`audit`)."""
-    return {nm: audit(fn, args, name=nm, **kwargs)
-            for nm, (fn, args) in programs.items()}
+    """``{name: (fn, args)} -> {name: report}`` (see :func:`audit`).
+
+    An entry may also be ``(fn, args, audit_kwargs)`` — per-program
+    audit options (a mixed-precision program's ``f32_allow``, the
+    bucketed step's ``overlap_expected``) merged over ``kwargs``."""
+    reports = {}
+    for nm, entry in programs.items():
+        fn, args, *rest = entry
+        per = dict(kwargs, **rest[0]) if rest else kwargs
+        reports[nm] = audit(fn, args, name=nm, **per)
+    return reports
 
 
 def struct_of(tree) -> Any:

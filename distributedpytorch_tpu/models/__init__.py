@@ -44,19 +44,41 @@ def build_model(
     if isinstance(kw.get("pam_score_dtype"), str):
         kw["pam_score_dtype"] = jnp.dtype(kw["pam_score_dtype"])
     depth = _BACKBONE_DEPTH[backbone]
-    if name != "danet":
+    if name == "danet":
+        # model.attention_impl — ONE knob for both attention branches:
+        # 'auto' (default: the fused Pallas kernels for bf16 compute on
+        # TPU — the mixed-precision hot path — XLA einsum otherwise; the
+        # module resolves backend+dtype at trace time), 'xla' (einsum
+        # everywhere, reference parity), 'flash' (force Pallas).
+        # model.pam_impl, when set, overrides the position branch (its
+        # extra forms — ring, blocked — stay reachable).
+        attention_impl = kw.pop("attention_impl", "auto") or "auto"
+        branch = {"auto": "auto", "xla": "einsum",
+                  "flash": "flash"}.get(attention_impl)
+        if branch is None:
+            raise ValueError(
+                f"unknown attention_impl: {attention_impl!r} "
+                "(auto | xla | flash)")
+        kw["pam_impl"] = kw.pop("pam_impl", "") or branch
+        kw.setdefault("cam_impl", branch)
+    else:
         # PAM/MoE options are DANet-only.  One config schema drives every
         # model family, so default values are silently dropped — but a
         # non-default setting on another model is a misconfiguration, not
         # something to train past.
-        danet_only = {"pam_block_size": None, "pam_impl": "einsum",
-                      "pam_sp_mesh": None, "pam_sp_axis": "model",
-                      "pam_score_dtype": None,
-                      "moe_experts": 0, "moe_hidden": None, "moe_k": 1,
-                      "moe_capacity_factor": 1.25,
-                      "guidance_inject": "stem"}
-        for k, default in danet_only.items():
-            if k in kw and kw.pop(k) != default:
+        danet_only = {"pam_block_size": (None,),
+                      # both the inherit sentinel and the legacy spelled-
+                      # out default (pre-attention_impl configs on disk)
+                      "pam_impl": ("", "einsum"),
+                      "attention_impl": ("auto",),
+                      "cam_impl": ("einsum",),
+                      "pam_sp_mesh": (None,), "pam_sp_axis": ("model",),
+                      "pam_score_dtype": (None,),
+                      "moe_experts": (0,), "moe_hidden": (None,),
+                      "moe_k": (1,), "moe_capacity_factor": (1.25,),
+                      "guidance_inject": ("stem",)}
+        for k, defaults in danet_only.items():
+            if k in kw and kw.pop(k) not in defaults:
                 raise ValueError(
                     f"{k} is DANet-only; model {name!r} does not support it")
     if name != "encnet" and kw.pop("encnet_codes", 32) != 32:

@@ -39,13 +39,31 @@ from ..ops.attention import (
 from .resnet import ResNet, make_norm
 
 
-#: pam_impl='auto' switch point (scripts/pam_crossover.py on the v5e, table
-#: in BASELINE.md): XLA's fused einsum is FASTER at every measurable token
-#: count — 4k through 32k (e.g. 32k: 147 ms vs flash's 185 ms fwd+bwd) — so
-#: the switch is memory-feasibility, not speed: at 64k tokens the N^2 f32
-#: score matrix alone is ~17 GB > v5e HBM, and flash's O(N*block) VMEM
-#: schedule is the only form that can run at all.
+#: 'auto' switch point for the position branch outside the bf16-TPU hot
+#: path (scripts/pam_crossover.py on the v5e, table in BASELINE.md): the
+#: f32 sweep measured XLA's fused einsum FASTER at every compilable token
+#: count (32k: 147 ms vs flash's 185 ms fwd+bwd), so for f32 compute —
+#: and on CPU meshes, which run pallas through the slow interpreter —
+#: 'auto' keeps einsum while the N^2 scores fit HBM and switches to
+#: flash only for memory feasibility: at 64k tokens the N^2 f32 score
+#: matrix alone is ~17 GB > v5e HBM.  Under BF16 COMPUTE ON TPU 'auto'
+#: is simply flash: the fused VMEM schedule is the default hot path of
+#: the mixed-precision regime (model.attention_impl + train.precision,
+#: ROADMAP item 4 — the default flip is the bf16-era call; the f32
+#: verdict stands).
 AUTO_FLASH_MIN_TOKENS = 65536
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _auto_wants_flash(dtype) -> bool:
+    """'auto' promotes the fused Pallas kernels only on TPU and only for
+    bf16 compute — see :data:`AUTO_FLASH_MIN_TOKENS`: the f32 crossover
+    sweep still favors XLA's einsum, so an f32 run (reference parity,
+    ``train.precision=float32``) keeps the measured-faster form."""
+    return _on_tpu() and jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16)
 
 
 def _resize_bilinear(x: jax.Array, size: tuple[int, int]) -> jax.Array:
@@ -79,12 +97,19 @@ class PositionAttentionModule(nn.Module):
         v = conv(self.channels, (1, 1), name="value")(x).reshape(b, h * w, -1)
         impl = self.impl
         if impl == "auto":
-            # einsum while the N^2 scores fit HBM (it measured faster at
-            # every count up to 32k on the v5e), flash beyond (where einsum
-            # cannot run at all) — see AUTO_FLASH_MIN_TOKENS.  Token count
-            # is static at trace time: a compile-time choice, one program
-            # per shape.
-            impl = "einsum" if h * w < AUTO_FLASH_MIN_TOKENS else "flash"
+            # bf16 compute on TPU: the fused Pallas kernel IS the hot
+            # path.  Otherwise (f32 — where einsum measured faster at
+            # every compilable count — or CPU meshes, which run pallas
+            # through the interpreter): einsum while the N^2 scores fit
+            # HBM, flash beyond (where einsum cannot run at all) — see
+            # AUTO_FLASH_MIN_TOKENS.  Backend, dtype and token count
+            # are static at trace time: a compile-time choice, one
+            # program per shape.
+            if _auto_wants_flash(self.dtype):
+                impl = "flash"
+            else:
+                impl = "einsum" if h * w < AUTO_FLASH_MIN_TOKENS \
+                    else "flash"
         if impl == "flash":
             from ..ops.pallas_attention import flash_position_attention
             blk = self.block_size or 256
@@ -134,14 +159,36 @@ class PositionAttentionModule(nn.Module):
 
 
 class ChannelAttentionModule(nn.Module):
-    """Channel gram-matrix attention with a learned zero-init residual gate."""
+    """Channel gram-matrix attention with a learned zero-init residual gate.
+
+    ``impl``: ``einsum`` (XLA, reference parity) | ``flash`` (the fused
+    Pallas gram+softmax kernel, ops.pallas_attention) | ``auto`` (flash
+    for bf16 compute on TPU — the mixed-precision hot path — einsum
+    elsewhere, including f32 TPU runs, matching the position branch's
+    measured crossover verdict).  Parameter-free either way, so the
+    impl choice never touches checkpoints.
+    """
 
     dtype: jnp.dtype = jnp.float32
+    impl: str = "einsum"           # auto | einsum | flash
+    block_size: int | None = None  # flash: token-block rows per VMEM tile
 
     @nn.compact
     def __call__(self, x):
         b, h, w, c = x.shape
-        out = channel_attention(x.reshape(b, h * w, c)).reshape(b, h, w, c)
+        impl = self.impl
+        if impl == "auto":
+            impl = "flash" if _auto_wants_flash(self.dtype) else "einsum"
+        tokens = x.reshape(b, h * w, c)
+        if impl == "flash":
+            from ..ops.pallas_attention import flash_channel_attention
+            out = flash_channel_attention(tokens, self.block_size or 256)
+        elif impl == "einsum":
+            out = channel_attention(tokens)
+        else:
+            raise ValueError(f"unknown channel-attention impl: "
+                             f"{self.impl!r} (auto | einsum | flash)")
+        out = out.reshape(b, h, w, c)
         gamma = self.param("gamma", nn.initializers.zeros, (), jnp.float32)
         return gamma.astype(x.dtype) * out + x
 
@@ -160,6 +207,7 @@ class DANetHead(nn.Module):
     pam_sp_mesh: Any = None
     pam_sp_axis: str = "model"
     pam_score_dtype: Any = None
+    cam_impl: str = "einsum"
     dropout_rate: float = 0.1
     moe_experts: int = 0        # >0: MoE FFN on the fused features
     moe_hidden: int | None = None
@@ -191,7 +239,8 @@ class DANetHead(nn.Module):
         pa = conv_bn_relu(pa, "pam_out")
 
         ca = conv_bn_relu(x, "cam_in")
-        ca = ChannelAttentionModule(dtype=self.dtype, name="cam")(ca)
+        ca = ChannelAttentionModule(dtype=self.dtype, impl=self.cam_impl,
+                                    name="cam")(ca)
         ca = conv_bn_relu(ca, "cam_out")
 
         fused = pa + ca
@@ -261,10 +310,11 @@ class DANet(nn.Module):
     bn_cross_replica_axis: str | None = None
     bn_fp32_stats: bool = True  # False: BN stats in compute dtype (see make_norm)
     pam_block_size: int | None = None
-    pam_impl: str = "einsum"  # einsum | flash | ring (sequence-parallel)
+    pam_impl: str = "einsum"  # auto | einsum | flash | ring (seq-parallel)
     pam_sp_mesh: Any = None   # ring: mesh whose axis shards the tokens
     pam_sp_axis: str = "model"
     pam_score_dtype: Any = None  # einsum: N x N score materialization dtype
+    cam_impl: str = "einsum"  # auto | einsum | flash (fused Pallas gram)
     remat: bool = False
     remat_policy: str | None = None  # jax.checkpoint_policies name (see ResNet)
     moe_experts: int = 0      # >0: MoE FFN in the head (see DANetHead)
@@ -307,6 +357,7 @@ class DANet(nn.Module):
             pam_sp_mesh=self.pam_sp_mesh,
             pam_sp_axis=self.pam_sp_axis,
             pam_score_dtype=self.pam_score_dtype,
+            cam_impl=self.cam_impl,
             moe_experts=self.moe_experts,
             moe_hidden=self.moe_hidden,
             moe_k=self.moe_k,

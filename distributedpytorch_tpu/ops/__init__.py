@@ -14,7 +14,10 @@ from .attention import (
     blocked_position_attention,
     channel_attention,
 )
-from .pallas_attention import flash_position_attention
+from .pallas_attention import (
+    flash_channel_attention,
+    flash_position_attention,
+)
 from .losses import (
     sigmoid_balanced_bce,
     multi_output_loss,
@@ -36,6 +39,7 @@ __all__ = [
     "position_attention",
     "blocked_position_attention",
     "channel_attention",
+    "flash_channel_attention",
     "flash_position_attention",
     "sigmoid_balanced_bce",
     "multi_output_loss",

@@ -1,25 +1,36 @@
-"""Pallas TPU flash-attention kernel for the position-attention hot path.
+"""Pallas TPU kernels for BOTH DANet attention branches — the hot path.
 
-The reference's position-attention module materializes the full
-(H·W/64)² score matrix in external CUDA code (PyTorch-Encoding's DANet head,
-reference train_pascal.py:32,86).  :func:`ops.attention.position_attention`
-is the XLA einsum re-expression; this module is the hand-scheduled form for
-when the fused-by-XLA version is memory- or bandwidth-bound: one kernel
-computes Q·Kᵀ on the MXU, the online softmax on the VPU, and the P·V matmul
-on the MXU per (Q-block, K-block) tile, keeping everything in VMEM and never
-writing an N×N intermediate to HBM.
+The reference's dual-attention head materializes its intermediates in
+external CUDA code (PyTorch-Encoding's DANet head, reference
+train_pascal.py:32,86): the (H·W/64)² position-attention score matrix and
+the C×C channel gram matrix.  :mod:`ops.attention` is the XLA einsum
+re-expression; this module is the hand-scheduled TPU form — the default
+hot path on TPU (``model.attention_impl=auto``), with the XLA forms as
+the off-TPU fallback:
 
-Grid layout: ``(batch, q_blocks, k_blocks)`` with the K dimension innermost;
-the running (max, sum, accumulator) state lives in VMEM scratch that persists
-across the K sweep for each Q block (the canonical flash-attention TPU
-schedule).  Block sizes default to 256×256, aligned to the (8,128) f32 tile.
+* :func:`flash_position_attention` — one kernel computes Q·Kᵀ on the
+  MXU, the online softmax on the VPU, and the P·V matmul on the MXU per
+  (Q-block, K-block) tile, keeping everything in VMEM and never writing
+  an N×N intermediate to HBM.  Grid ``(batch, q_blocks, k_blocks)``,
+  K innermost; the running (max, sum, accumulator) state lives in VMEM
+  scratch across the K sweep (the canonical flash-attention schedule).
+  Blocks default 256×256, aligned to the (8,128) f32 tile.
+* :func:`flash_channel_attention` — the gram branch: one kernel streams
+  the (N, C) tokens through VMEM in row blocks, accumulates the C×C
+  gram on the MXU in VMEM scratch and finishes with DANet's
+  max-subtraction softmax on the VPU *in the same kernel* (the energy
+  matrix never round-trips HBM between the einsum and the softmax);
+  a second streamed kernel applies the attention back over channels.
+  Only the C×C attention map (≤1 MB at C=512) crosses HBM between the
+  two.
 
-Backward: a ``jax.custom_vjp`` whose reverse pass recomputes attention with
-:func:`ops.attention.blocked_position_attention` (O(N·block) memory) and
-differentiates that — recompute-not-store, the standard flash trade.
+Backward for both: a ``jax.custom_vjp`` whose reverse pass recomputes
+with the O(N·block) / jnp reference form and differentiates that —
+recompute-not-store, the standard flash trade.
 
-Tests run this kernel with ``interpret=True`` on CPU (pallas's interpreter
-executes the same program the Mosaic compiler lowers on TPU).
+Tests run these kernels with ``interpret=True`` on CPU (pallas's
+interpreter executes the same program the Mosaic compiler lowers on
+TPU), including forward AND backward parity against the XLA forms.
 """
 
 from __future__ import annotations
@@ -31,7 +42,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import blocked_position_attention
+from .attention import blocked_position_attention, channel_attention
 
 _NEG_INF = -1e30
 
@@ -149,3 +160,101 @@ def _bwd(block_q, block_k, scale, interpret, res, g):
 
 
 flash_position_attention.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------- channel (gram) branch
+
+def _cam_energy_kernel(x_ref, attn_ref, energy_ref):
+    """Fused gram + softmax: accumulate Xᵀ·X over row blocks in VMEM
+    scratch; on the last block run DANet's max-subtraction softmax on
+    the VPU and emit the (C, C) attention map.  Zero-padded rows (N not
+    a block multiple) contribute zero to the gram — no masking needed."""
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        energy_ref[:] = jnp.zeros_like(energy_ref)
+
+    x = x_ref[0]  # (block_n, C)
+    energy_ref[:] += jax.lax.dot_general(
+        x, x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (C, C)
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        energy = energy_ref[:]
+        # DANet CAM: attend to the LEAST similar channels — rowmax - E
+        energy = energy.max(axis=-1, keepdims=True) - energy
+        m = energy.max(axis=-1, keepdims=True)
+        p = jnp.exp(energy - m)
+        attn_ref[0] = (p / p.sum(axis=-1, keepdims=True)
+                       ).astype(attn_ref.dtype)
+
+
+def _cam_apply_kernel(attn_ref, x_ref, o_ref):
+    """Streamed apply: out row block = X_block · Attnᵀ (MXU), the
+    attention map resident in VMEM for the whole sweep."""
+    x = x_ref[0].astype(jnp.float32)  # (block_n, C)
+    attn = attn_ref[0]                # (C, C), f32
+    o_ref[0] = jax.lax.dot_general(
+        x, attn, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _cam_forward(x, block_n: int, interpret: bool | None):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, n, c = x.shape
+    nb = pl.cdiv(n, block_n)
+    pad = nb * block_n - n
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    attn = pl.pallas_call(
+        _cam_energy_kernel,
+        grid=(b, nb),
+        in_specs=[pl.BlockSpec((1, block_n, c), lambda b_, j: (b_, j, 0))],
+        out_specs=pl.BlockSpec((1, c, c), lambda b_, j: (b_, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, c, c), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((c, c), jnp.float32)],
+        interpret=interpret,
+    )(x)
+    out = pl.pallas_call(
+        _cam_apply_kernel,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((1, c, c), lambda b_, j: (b_, 0, 0)),
+            pl.BlockSpec((1, block_n, c), lambda b_, j: (b_, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_n, c), lambda b_, j: (b_, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, nb * block_n, c), x.dtype),
+        interpret=interpret,
+    )(attn, x)
+    return out[:, :n, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def flash_channel_attention(x, block_n: int = 256,
+                            interpret: bool | None = None):
+    """Fused channel (gram-matrix) attention: same math as
+    :func:`ops.attention.channel_attention` — C×C gram of the (B, N, C)
+    tokens, max-subtraction softmax, applied back over channels — with
+    the gram accumulation and softmax fused into one VMEM-resident
+    kernel and the apply streamed.  ``(B, N, C) -> (B, N, C)``."""
+    return _cam_forward(x, block_n, interpret)
+
+
+def _cam_fwd(x, block_n, interpret):
+    return _cam_forward(x, block_n, interpret), (x,)
+
+
+def _cam_bwd(block_n, interpret, res, g):
+    (x,) = res
+    # Recompute with the jnp reference form and differentiate that — the
+    # gram is cheap to rebuild (one (C, C) matmul) vs storing the
+    # attention map's softmax residuals.
+    _, vjp = jax.vjp(channel_attention, x)
+    return vjp(g)
+
+
+flash_channel_attention.defvjp(_cam_fwd, _cam_bwd)

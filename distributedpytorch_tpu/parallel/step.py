@@ -150,6 +150,57 @@ def unpack_wire(batch: Batch, spec: tuple) -> dict:
     return out
 
 
+def bucket_grad_leaves(leaves: list, n_buckets: int) -> list[list[int]]:
+    """Partition gradient-leaf INDICES into ``n_buckets`` byte-balanced
+    buckets in reverse-topological order.
+
+    The flattened param tree sorts backbone-before-head; backward
+    produces gradients output-side first, so the REVERSED flat order
+    approximates the order grads become available during the backward
+    pass.  Bucket 0 therefore holds the head/classifier grads — the
+    ones ready earliest — and its all-reduce is schedulable while the
+    backbone backward is still computing: the bucketed-overlap recipe
+    of "Efficient Training of CNNs on Large Distributed Systems"
+    (arxiv 1711.00705), expressed as dataflow XLA's latency-hiding
+    scheduler can exploit.  Buckets are cut at byte-balanced boundaries
+    so no single reduce dominates the tail."""
+    if n_buckets < 1:
+        raise ValueError(f"reduce_buckets must be >= 1 (got {n_buckets})")
+    order = list(range(len(leaves)))[::-1]
+    sizes = [int(np.prod(leaves[i].shape, dtype=np.int64))
+             * jnp.dtype(leaves[i].dtype).itemsize for i in order]
+    total = sum(sizes)
+    n_buckets = min(n_buckets, len(order)) or 1
+    per = max(1, total // n_buckets)
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    acc = 0
+    for i, s in zip(order, sizes):
+        cur.append(i)
+        acc += s
+        if acc >= per and len(buckets) < n_buckets - 1:
+            buckets.append(cur)
+            cur, acc = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _bucketed_psum(grads, n_buckets: int, axis_name: str):
+    """All-reduce a gradient pytree in reverse-topo buckets: one
+    ``lax.psum`` per bucket (independent equations — no dataflow edge
+    forces bucket K to wait for bucket K-1, so async lowerings overlap
+    them with each other and with the still-running backward that feeds
+    the later buckets)."""
+    leaves, treedef = jax.tree.flatten(grads)
+    out = list(leaves)
+    for bucket in bucket_grad_leaves(leaves, n_buckets):
+        reduced = jax.lax.psum([leaves[i] for i in bucket], axis_name)
+        for i, g in zip(bucket, reduced):
+            out[i] = g
+    return jax.tree.unflatten(treedef, out)
+
+
 class TrainState(struct.PyTreeNode):
     """Everything that evolves during training, as one pytree.
 
@@ -301,15 +352,26 @@ def _compute_loss(outputs, batch: Batch, weights, loss_type: str):
 
 def _loss_and_updates(model, params, batch_stats, batch: Batch, rng,
                       loss_weights, train: bool, loss_type: str,
-                      aux_loss_weight: float = 0.0):
+                      aux_loss_weight: float = 0.0, precision=None):
     """Forward + loss; returns (loss, new_batch_stats).
 
     ``aux_loss_weight`` scales any auxiliary losses the model ``sow``s into
     its ``losses`` collection (e.g. the MoE router's load-balancing term,
     parallel/moe.py) into the training objective.
+
+    ``precision`` (train.precision policy): the two declared dtype
+    boundaries of the mixed regime live HERE — inputs cast down to the
+    compute dtype before the model (halving the input tensor's HBM
+    read; the first conv would cast anyway, after paying f32 bytes) and
+    outputs cast up to the loss dtype after it (the explicit
+    bf16-compute → f32-loss accumulation seam JA002 audits).  The loss
+    kernels upcast defensively regardless; under a policy the boundary
+    is explicit and auditable.
     """
     variables = {"params": params, "batch_stats": batch_stats}
     inputs = batch[INPUT_KEY]
+    if precision is not None:
+        inputs = precision.cast_to_compute(inputs)
     if train:
         outputs, mutated = model.apply(
             variables, inputs, train=True,
@@ -323,6 +385,8 @@ def _loss_and_updates(model, params, batch_stats, batch: Batch, rng,
         outputs = model.apply(variables, inputs, train=False)
         new_stats = batch_stats
         aux = jnp.float32(0.0)
+    if precision is not None:
+        outputs = precision.cast_to_loss(outputs)
     loss = _compute_loss(outputs, batch, loss_weights, loss_type)
     if aux_loss_weight:
         loss = loss + aux_loss_weight * aux
@@ -345,6 +409,8 @@ def make_train_step(
     packbits_masks: bool = False,
     wire_spec: tuple | None = None,
     sentinel_metrics: bool = False,
+    precision=None,
+    reduce_buckets: int = 0,
 ) -> Callable[..., tuple[TrainState, jax.Array]]:
     """Build the jitted ``(state, batch) -> (state, loss)`` train step.
 
@@ -385,19 +451,121 @@ def make_train_step(
     already produced, so the cost is a handful of fused reductions; the
     readback stays on the trainer's existing loss-fetch boundary (no
     extra host syncs).  Multi-step programs return ``((K,), (K, 2))``.
+
+    ``precision`` (train.precision policy, train/precision.py): the
+    mixed-precision dtype boundaries — inputs cast to the compute dtype
+    at the model, outputs upcast to f32 at the loss.  The model itself
+    must be built with ``dtype=policy.compute_dtype`` (the trainer
+    couples both from one knob); grads/optimizer math stay f32 because
+    the master params are f32 — nothing here to get wrong.
+
+    ``reduce_buckets > 0`` (train.reduce_buckets): the gradient
+    all-reduce is restructured for comm/compute overlap.  The
+    forward+backward run per-device inside a ``shard_map`` over the
+    ``data`` axis (each device differentiates ITS batch shard — local
+    grads, exactly DDP's structure) and the grads are then explicitly
+    ``psum``-reduced in ``reduce_buckets`` reverse-topological buckets:
+    bucket 0 (head params, produced earliest in backward) has no
+    dataflow dependence on the backbone backward still running, so an
+    async-collective backend (TPU: all-reduce-start/-done + the
+    latency-hiding scheduler) overlaps its reduce with the remaining
+    compute instead of serializing one fused all-reduce after the whole
+    backward.  Semantics shift to DDP's: the loss is the mean of
+    per-shard losses (per-shard normalization — balanced-BCE
+    denominators are shard-local), dropout draws per-device streams,
+    and BN batch stats must psum explicitly — the model MUST be built
+    with ``bn_cross_replica_axis='data'`` (validated).  Pure data
+    parallel only: composes with accum/echo/multi-step/wire stages but
+    not with TP/ZeRO layouts (``state_shardings``) or ring PAM.
     """
+    if reduce_buckets:
+        if mesh is None:
+            raise ValueError("reduce_buckets needs a mesh (the data axis "
+                             "the buckets psum over)")
+        if state_shardings is not None:
+            raise ValueError(
+                "reduce_buckets is pure data parallel: TP/ZeRO layouts "
+                "(state_shardings) keep the GSPMD-implicit reduce "
+                "(reduce_buckets=0)")
+        if getattr(model, "bn_cross_replica_axis", None) != \
+                mesh_lib.DATA_AXIS:
+            raise ValueError(
+                "reduce_buckets runs the forward per-device inside "
+                "shard_map, so BatchNorm batch stats must reduce "
+                "explicitly: build the model with "
+                f"bn_cross_replica_axis={mesh_lib.DATA_AXIS!r} (the "
+                "trainer couples this automatically)")
 
     def grads_of(params, batch_stats, batch, rng):
         def loss_fn(p):
             loss, new_stats = _loss_and_updates(
                 model, p, batch_stats, batch, rng, loss_weights, train=True,
-                loss_type=loss_type, aux_loss_weight=aux_loss_weight)
+                loss_type=loss_type, aux_loss_weight=aux_loss_weight,
+                precision=precision)
             return loss * loss_scale, (loss, new_stats)
         (_, (loss, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         if loss_scale != 1.0:
             grads = jax.tree.map(lambda g: g / loss_scale, grads)
         return loss, new_stats, grads
+
+    def accum_grads_of(params, batch_stats, batch, rng):
+        """(loss, new_stats, grads) over the (possibly accumulated)
+        batch — the whole differentiation stage, shared verbatim by the
+        GSPMD path and the shard_map body (where ``batch`` is the
+        device-local shard and the grads come back unreduced)."""
+        if accum_steps == 1:
+            return grads_of(params, batch_stats, batch, rng)
+        # (B, ...) -> (accum, B/accum, ...): scan carries running grad
+        # sum + evolving BN stats; XLA keeps it one fused program.
+        def resh(x):
+            return x.reshape((accum_steps, x.shape[0] // accum_steps)
+                             + x.shape[1:])
+        micro = jax.tree.map(resh, dict(batch))
+        rngs = jax.random.split(rng, accum_steps)
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+
+        def body(carry, xs):
+            gsum, stats = carry
+            mb, r = xs
+            loss, new_stats, g = grads_of(params, stats, mb, r)
+            gsum = jax.tree.map(jnp.add, gsum, g)
+            return (gsum, new_stats), loss
+
+        (gsum, new_stats), losses = jax.lax.scan(
+            body, (zero_grads, batch_stats), (micro, rngs))
+        grads = jax.tree.map(lambda g: g / accum_steps, gsum)
+        return losses.mean(), new_stats, grads
+
+    def bucketed_grads_of(params, batch_stats, batch, rng):
+        """The shard_map twin of :func:`accum_grads_of`: per-device
+        fwd+bwd over the local batch shard, then the reverse-topo
+        bucketed psum.  Gradients come back pmean'd (psum / axis size —
+        DDP averaging), the loss as the mean of per-shard losses; BN
+        stats reduced inside the model (bn_cross_replica_axis)."""
+        from jax.sharding import PartitionSpec as P
+
+        def body(params, batch_stats, batch, rng):
+            # de-correlate per-device dropout/augment draws: each shard
+            # is a different slice of the batch and must not share masks
+            rng = jax.random.fold_in(
+                rng, jax.lax.axis_index(mesh_lib.DATA_AXIS))
+            loss, new_stats, grads = accum_grads_of(
+                params, batch_stats, batch, rng)
+            n = mesh_lib.axis_size(mesh_lib.DATA_AXIS)
+            grads = _bucketed_psum(grads, reduce_buckets,
+                                   mesh_lib.DATA_AXIS)
+            grads = jax.tree.map(lambda g: g / n, grads)
+            loss = jax.lax.pmean(loss, mesh_lib.DATA_AXIS)
+            # new_stats are already identical across devices (the model's
+            # cross-replica BN pmean'd them) — returned replicated as-is
+            return loss, new_stats, grads
+
+        return mesh_lib.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(), P(mesh_lib.DATA_AXIS), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False)(params, batch_stats, batch, rng)
 
     def step_fn(state: TrainState, batch: Batch):
         if wire_spec is not None:
@@ -413,30 +581,10 @@ def make_train_step(
         if augment is not None:
             rng, aug_rng = jax.random.split(rng)
             batch = augment(batch, aug_rng)
-        if accum_steps == 1:
-            loss, new_stats, grads = grads_of(
-                state.params, state.batch_stats, batch, rng)
-        else:
-            # (B, ...) -> (accum, B/accum, ...): scan carries running grad
-            # sum + evolving BN stats; XLA keeps it one fused program.
-            def resh(x):
-                return x.reshape((accum_steps, x.shape[0] // accum_steps)
-                                 + x.shape[1:])
-            micro = jax.tree.map(resh, dict(batch))
-            rngs = jax.random.split(rng, accum_steps)
-            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-
-            def body(carry, xs):
-                gsum, stats = carry
-                mb, r = xs
-                loss, new_stats, g = grads_of(state.params, stats, mb, r)
-                gsum = jax.tree.map(jnp.add, gsum, g)
-                return (gsum, new_stats), loss
-
-            (gsum, new_stats), losses = jax.lax.scan(
-                body, (zero_grads, state.batch_stats), (micro, rngs))
-            grads = jax.tree.map(lambda g: g / accum_steps, gsum)
-            loss = losses.mean()
+        differentiate = bucketed_grads_of if reduce_buckets \
+            else accum_grads_of
+        loss, new_stats, grads = differentiate(
+            state.params, state.batch_stats, dict(batch), rng)
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

@@ -146,15 +146,24 @@ def load_run_config(run_dir: str):
 def model_from_config(cfg):
     """Rebuild the model exactly as the Trainer did, minus mesh couplings:
     ring PAM needs a sequence-parallel mesh, so inference falls back to the
-    numerically identical einsum form.  The moe_* options shape the param
-    tree and MUST match or checkpoint restore fails."""
+    numerically identical einsum form, and the bucketed-reduce run's
+    cross-replica BN stays off (train-time only; inference never computes
+    batch stats).  The moe_* options shape the param tree and MUST match
+    or checkpoint restore fails.  train.precision carries over: a
+    bf16-trained run serves bf16 (master params are f32 either way, so
+    restore is dtype-independent)."""
     from .models import build_model
+    from .train.precision import precision_policy
 
+    policy = precision_policy(
+        getattr(getattr(cfg, "train", None), "precision", None))
     return build_model(
         name=cfg.model.name, nclass=cfg.model.nclass,
         backbone=cfg.model.backbone,
-        output_stride=cfg.model.output_stride, dtype=cfg.model.dtype,
+        output_stride=cfg.model.output_stride,
+        dtype=(policy.compute_dtype if policy else cfg.model.dtype),
         pam_block_size=cfg.model.pam_block_size,
+        attention_impl=getattr(cfg.model, "attention_impl", "auto"),
         pam_impl="einsum" if cfg.model.pam_impl == "ring"
         else cfg.model.pam_impl,
         pam_score_dtype=getattr(cfg.model, "pam_score_dtype", None),

@@ -10,6 +10,7 @@ from .config import (
     ModelConfig,
     OptimConfig,
     SentinelConfig,
+    TrainConfig,
     apply_overrides,
     flatten,
     from_json,
@@ -27,6 +28,7 @@ from .logging import (
     make_writer,
 )
 from .optim import make_optimizer, make_param_labeler, make_schedule
+from .precision import Policy, precision_block, precision_policy
 from .preemption import PreemptionGuard
 from .sentinel import StepSentinel, recovery_block
 from .trainer import Trainer
@@ -44,11 +46,15 @@ __all__ = [
     "ModelConfig",
     "MultiWriter",
     "OptimConfig",
+    "Policy",
     "PreemptionGuard",
     "SentinelConfig",
     "StepSentinel",
     "TensorBoardWriter",
+    "TrainConfig",
     "Trainer",
+    "precision_block",
+    "precision_policy",
     "recovery_block",
     "apply_overrides",
     "batch_debug_asserts",

@@ -187,14 +187,26 @@ class ModelConfig:
     dtype: str = "float32"              # 'bfloat16' = BASELINE config 3
     loss_weights: tuple[float, ...] | None = None
     pam_block_size: int | None = None   # blocked position-attention
-    pam_impl: str = "einsum"            # auto | einsum | flash (pallas)
-                                        # | ring.  auto = einsum while the
-                                        # N^2 scores fit HBM (measured
-                                        # fastest through 32k tokens on
-                                        # v5e), flash at >=64k tokens where
-                                        # einsum cannot run at all
-                                        # (ring = sequence-parallel PAM over
-                                        # the mesh's model axis)
+    attention_impl: str = "auto"        # BOTH DANet attention branches at
+                                        # once: auto (flash Pallas kernels
+                                        # for bf16 compute on TPU — the
+                                        # mixed-precision hot path — XLA
+                                        # einsum otherwise, per the f32
+                                        # crossover sweep) | xla (einsum
+                                        # everywhere, the reference-parity
+                                        # form) | flash (force the Pallas
+                                        # kernels; interpret-mode off-TPU).
+                                        # pam_impl below overrides the
+                                        # position branch when set.
+    pam_impl: str = ""                  # position-branch override of
+                                        # attention_impl: auto | einsum |
+                                        # flash (pallas) | ring (sequence-
+                                        # parallel PAM over the mesh's
+                                        # model axis).  "" = inherit
+                                        # attention_impl.  auto = flash for
+                                        # bf16-TPU; otherwise einsum while
+                                        # the N^2 scores fit HBM, flash
+                                        # beyond (memory feasibility)
     pam_score_dtype: str | None = None  # einsum PAM only: dtype the N x N
                                         # score matrix materializes in.
                                         # 'bfloat16' halves the dominant
@@ -236,6 +248,42 @@ class ModelConfig:
                                         # reusable across a session's
                                         # refinement clicks
                                         # (serve/sessions.py)
+
+
+@dataclass
+class TrainConfig:
+    """Raw step-speed levers (train/precision.py + parallel/step.py):
+    the ROADMAP item-4 trio, each off by default for reference parity."""
+    precision: str = "float32"          # float32 | bfloat16: 'bfloat16' is
+                                        # the mixed-precision policy (bf16
+                                        # compute, f32 master params/
+                                        # optimizer/loss — train/precision
+                                        # .py) threaded through the model
+                                        # build and the compiled steps;
+                                        # overrides model.dtype.  jaxaudit
+                                        # JA002 audits the bf16 step
+                                        # against the policy's declared
+                                        # accumulation points.
+    reduce_buckets: int = 0             # >0: data-parallel gradients are
+                                        # all-reduced in this many reverse-
+                                        # topological buckets (explicit
+                                        # shard_map psums) instead of the
+                                        # compiler's fused end-of-backward
+                                        # reduce — head-param buckets
+                                        # become schedulable as soon as the
+                                        # early backward produces them, so
+                                        # their reduce overlaps the
+                                        # remaining backbone backward (the
+                                        # arxiv 1711.00705 bucketed-
+                                        # overlap recipe; async -start
+                                        # forms contract-pinned on TPU).
+                                        # Pure data parallel only (no TP/
+                                        # ring PAM); loss/BN take DDP
+                                        # semantics (per-shard loss
+                                        # normalization averaged across
+                                        # shards, cross-replica BN stats).
+                                        # 0 = GSPMD-implicit (reference-
+                                        # parity numerics).
 
 
 @dataclass
@@ -352,6 +400,7 @@ class Config:
     task: str = "instance"              # instance (reference) | semantic
     data: DataConfig = field(default_factory=DataConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
@@ -465,7 +514,8 @@ def _from_dict(cls, d: dict):
     return cls(**kwargs)
 
 
-_SUBCONFIGS = {"data": DataConfig, "model": ModelConfig, "optim": OptimConfig,
+_SUBCONFIGS = {"data": DataConfig, "model": ModelConfig,
+               "train": TrainConfig, "optim": OptimConfig,
                "mesh": MeshConfig, "checkpoint": CheckpointConfig,
                "sentinel": SentinelConfig}
 

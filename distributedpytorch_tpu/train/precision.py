@@ -1,0 +1,143 @@
+"""Mixed-precision policy: bf16 compute, f32 master params and optimizer.
+
+The reference trained f32 end-to-end (PyTorch defaults, reference
+train_pascal.py — no AMP/GradScaler anywhere).  On TPU the MXU runs
+bf16 matmuls at twice the f32 rate and halves every activation's HBM
+round trip, so the flagship step leaves ~2x on the table until the whole
+train path computes in bf16.  This module is the ONE place that regime
+is declared:
+
+* **compute** runs in ``bfloat16`` — the flax modules are built with
+  ``dtype=bfloat16``, so convs/matmuls/attention promote their (f32)
+  params down and do bf16 math;
+* **master params, gradients and optimizer state stay float32** — flax's
+  ``param_dtype`` default keeps params f32, so ``jax.grad`` w.r.t. them
+  accumulates the bf16 backward contributions into f32 buffers and the
+  optimizer update runs entirely in f32 (no precision loss across
+  steps, the standard mixed-precision contract);
+* **the loss and BatchNorm batch statistics accumulate in float32** —
+  the loss kernels (:mod:`ops.losses`) upcast logits on entry, and flax
+  BN's ``force_float32_reductions`` keeps mean/var f32
+  (``model.bn_fp32_stats``).
+
+Those three f32 islands are not accidents — they are the policy's
+*declared accumulation points*, and :attr:`Policy.ja002_allow` names the
+exact primitives they are allowed to run on upcast bf16 data
+(:data:`POLICY_ACCUM_PRIMS`).  jaxaudit's JA002 dtype-flow check audits
+the bf16 train step against THAT allowlist: zero findings means every
+f32 op in the program is one the policy declared, and any new silent
+upcast (a layer accidentally computing f32, an f32 copy of an
+activation) is a contract failure, not a vibe.  Audits of programs
+without a policy keep the strict default allowlist.
+
+``train.precision`` is the config knob (``float32`` | ``bfloat16``);
+:func:`precision_block` is the schema-stable record block bench.py
+stamps into train/serve/sessions records (null when f32).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+#: primitives the policy's declared f32 accumulation points run on upcast
+#: bf16 data, beyond the strict default allowlist (reductions + matmul/conv
+#: accumulation, analysis/ir.py DEFAULT_F32_ACCUM_ALLOW).  Every entry is
+#: tied to a declared island, observed on the audited bf16 train step:
+#:
+#: * ``add`` — the f32 master-gradient accumulation: each param's bf16
+#:   backward contributions are upcast and summed into its f32 gradient
+#:   (multiple use sites of one kernel -> one `add` tree per kernel);
+#: * ``mul``/``square``/``sub`` — BatchNorm's f32 batch statistics
+#:   (mean of x², centered variance) over upcast bf16 activations;
+#: * ``abs``/``eq``/``ge``/``max``/``div`` — the loss kernels' f32
+#:   arithmetic (balanced-BCE masking/normalization, softmax-CE guards)
+#:   on upcast logits/targets;
+#: * ``exp``/``log``/``select_n`` — the softmax-CE loss's log-sum-exp
+#:   and ignore-index select on upcast logits (the semantic task's loss).
+#:
+#: Deliberately NOT here: activation-function transcendentals and the
+#: rest of the elementwise zoo (`sin`, `tanh`, `logistic`, `rsqrt`,
+#: `pow`, ...) — an activation or normalization chain silently running
+#: f32 on the bf16 path still fails JA002 under the policy allowlist.
+POLICY_ACCUM_PRIMS = frozenset({
+    "add", "sub", "mul", "div", "square", "abs", "eq", "ge", "max",
+    "exp", "log", "select_n",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """One mixed-precision regime, immutable and JSON-able.
+
+    ``compute_dtype`` is what the model computes in (flax ``dtype``);
+    ``param_dtype`` what params/grads/optimizer state live in (flax
+    ``param_dtype`` — always f32 here: bf16 master weights lose ~8
+    mantissa bits of every SGD update and are not worth the memory on a
+    framework whose optimizer state already shards, see parallel.zero);
+    ``loss_dtype`` what the loss accumulates in.
+    """
+
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    loss_dtype: str = "float32"
+
+    def cast_to_compute(self, x: Any):
+        """Cast one array (or pytree) of inputs to the compute dtype —
+        the train step applies this at the model boundary so the input
+        tensor's HBM traffic is halved before the first conv (which
+        would otherwise do the cast itself, after the f32 read)."""
+        import jax
+        import jax.numpy as jnp
+
+        dt = jnp.dtype(self.compute_dtype)
+        return jax.tree.map(
+            lambda v: v.astype(dt)
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating)
+            and v.dtype != dt else v, x)
+
+    def cast_to_loss(self, outputs):
+        """Upcast model outputs to the loss dtype — the declared
+        accumulation boundary between bf16 compute and f32 loss math
+        (the loss kernels upcast defensively too; under this policy the
+        boundary is explicit and auditable)."""
+        import jax
+        import jax.numpy as jnp
+
+        dt = jnp.dtype(self.loss_dtype)
+        return jax.tree.map(lambda v: v.astype(dt), outputs)
+
+    def ja002_allow(self) -> frozenset:
+        """The JA002 allowlist for programs built under this policy:
+        the strict default set plus :data:`POLICY_ACCUM_PRIMS`."""
+        from ..analysis.ir import DEFAULT_F32_ACCUM_ALLOW
+
+        return DEFAULT_F32_ACCUM_ALLOW | POLICY_ACCUM_PRIMS
+
+    def block(self) -> dict:
+        """The bench-record ``precision`` block (keys stable)."""
+        return {
+            "compute_dtype": self.compute_dtype,
+            "param_dtype": self.param_dtype,
+            "loss_dtype": self.loss_dtype,
+        }
+
+
+def precision_policy(name: str | None) -> Policy | None:
+    """``train.precision`` -> policy.  ``'float32'``/``None``/``''`` is
+    the f32 end-to-end regime (no policy object: every consumer's
+    ``policy is None`` branch is the exact pre-policy code path);
+    ``'bfloat16'`` is bf16 compute + f32 master params/loss."""
+    if not name or name == "float32":
+        return None
+    if name == "bfloat16":
+        return Policy()
+    raise ValueError(
+        f"unknown train.precision: {name!r} (float32 | bfloat16)")
+
+
+def precision_block(policy: Policy | None) -> dict | None:
+    """The record block for bench/telemetry consumers: the policy's
+    declared dtypes, or ``None`` under f32 (key always present in the
+    record, the PR 4 schema-stability convention)."""
+    return None if policy is None else policy.block()

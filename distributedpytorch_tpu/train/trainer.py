@@ -44,6 +44,7 @@ from ..data import (
 from ..chaos import sites as chaos_sites
 from ..models import build_model
 from ..parallel import (
+    DATA_AXIS,
     DEVICE_KEYS,
     WIRE_KEY,
     create_train_state,
@@ -80,6 +81,7 @@ from .logging import (
     make_writer,
 )
 from .optim import make_optimizer
+from .precision import precision_policy
 from .preemption import PreemptionGuard
 from .sentinel import StepSentinel
 
@@ -480,11 +482,34 @@ class Trainer:
                 "enlarge the dataset")
 
         # --- model / optimizer / state
+        # train.precision (train/precision.py): the bf16 policy owns the
+        # model's compute dtype (master params stay f32 via flax's
+        # param_dtype default); train.reduce_buckets runs the step's
+        # fwd/bwd per-device inside shard_map, so BN batch stats must
+        # reduce explicitly — the model is built cross-replica.
+        self.precision = precision_policy(cfg.train.precision)
+        if cfg.train.reduce_buckets:
+            if cfg.mesh.shard_params or cfg.mesh.shard_opt_state:
+                raise ValueError(
+                    "train.reduce_buckets is pure data parallel — it "
+                    "cannot compose with mesh.shard_params (TP) or "
+                    "mesh.shard_opt_state (ZeRO-1); the GSPMD-implicit "
+                    "reduce (reduce_buckets=0) handles those layouts")
+            if cfg.mesh.model > 1 or cfg.model.pam_impl == "ring":
+                raise ValueError(
+                    "train.reduce_buckets needs a data-only mesh "
+                    "(mesh.model=1) and a non-ring PAM — its shard_map "
+                    "region owns the data axis")
         self.model = build_model(
             name=cfg.model.name, nclass=cfg.model.nclass,
             backbone=cfg.model.backbone, output_stride=cfg.model.output_stride,
-            dtype=cfg.model.dtype, bn_fp32_stats=cfg.model.bn_fp32_stats,
+            dtype=(self.precision.compute_dtype if self.precision
+                   else cfg.model.dtype),
+            bn_fp32_stats=cfg.model.bn_fp32_stats,
+            bn_cross_replica_axis=(DATA_AXIS if cfg.train.reduce_buckets
+                                   else None),
             pam_block_size=cfg.model.pam_block_size,
+            attention_impl=cfg.model.attention_impl,
             pam_impl=cfg.model.pam_impl,
             pam_score_dtype=cfg.model.pam_score_dtype,
             # ring PAM shards the spatial tokens over this mesh's model axis
@@ -566,7 +591,9 @@ class Trainer:
                              if cfg.model.moe_experts else 0.0),
             loss_scale=cfg.optim.loss_scale,
             packbits_masks=cfg.data.packbits_masks,
-            sentinel_metrics=sc.enabled and sc.monitor_grads)
+            sentinel_metrics=sc.enabled and sc.monitor_grads,
+            precision=self.precision,
+            reduce_buckets=cfg.train.reduce_buckets)
         self._step_kwargs = step_kwargs
         self.train_step, self.multi_train_step = self._build_steps()
         #: data.coalesce_wire: the wire-consuming twins of the two programs
@@ -980,12 +1007,23 @@ class Trainer:
         """Run jaxaudit over :meth:`audit_programs`; returns
         ``{name: report}``.  With ``check``, each report additionally
         carries ``contract_drift`` (the drift lines against the
-        checked-in contracts — empty means clean)."""
+        checked-in contracts — empty means clean).
+
+        Under ``train.precision`` the JA002 pass audits against the
+        policy's declared accumulation points (``ja002_allow``) — the
+        strict default would flag the policy's own f32 islands (master-
+        grad accumulation, BN stats, the loss) on every report."""
         from ..analysis import contracts as contracts_lib
         from ..analysis import ir as ir_lib
 
+        audit_kwargs = {}
+        if getattr(self, "precision", None) is not None:
+            audit_kwargs["f32_allow"] = self.precision.ja002_allow()
+        if self.cfg.train.reduce_buckets:
+            audit_kwargs["overlap_expected"] = True
         with self.mesh:
-            reports = ir_lib.audit_many(self.audit_programs(**batches))
+            reports = ir_lib.audit_many(self.audit_programs(**batches),
+                                        **audit_kwargs)
         if check:
             for rep in reports.values():
                 rep["contract_drift"] = contracts_lib.check_report(

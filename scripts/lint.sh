@@ -15,13 +15,19 @@
 #      train/supervise.py included — the sentinel's verdicts consume
 #      ONLY the trainer's existing loss readbacks (no new host syncs
 #      inside compiled programs, the JL-rule gate pins it) and the
-#      supervisor must stay a stdlib process) plus bench.py, the
-#      official record.
+#      supervisor must stay a stdlib process; train/precision.py +
+#      ops/pallas_attention.py included — the mixed-precision policy
+#      and the fused dual-attention kernels ARE the hot path, and a
+#      host sync or silent retrace there costs every step) plus
+#      bench.py, the official record.
 #   2. jaxaudit check — IR-level compile contracts: the canonical
 #      train/eval/serve programs (incl. the session split's
-#      encode_step/decode_step) are re-traced on the pinned 8-device
-#      CPU topology and diffed against tests/contracts/ (collective
-#      counts, output shapes, donation aliasing, baked constants,
+#      encode_step/decode_step AND train_step_bf16 — the mixed-
+#      precision bucketed-reduce fast path, JA002-audited against the
+#      policy's declared accumulation points, its psum buckets pinned)
+#      are re-traced on the pinned 8-device CPU topology and diffed
+#      against tests/contracts/ (collective counts incl. async -start
+#      forms, output shapes, donation aliasing, baked constants,
 #      FLOPs bounds).  After a REVIEWED program change, regenerate with
 #      `python -m distributedpytorch_tpu.analysis --ir update`.
 #

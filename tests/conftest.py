@@ -44,6 +44,31 @@ def fake_voc_root(tmp_path_factory):
     return make_fake_voc(str(root), n_images=6, size=(120, 160), n_val=2, seed=0)
 
 
+def assert_grads_close(g0, g1, rel: float = 5e-4, frob: float = 1e-5):
+    """Scale-aware gradient parity (the PR 7 remat idiom, shared by the
+    remat and pallas-backward tests): every leaf's inf-norm diff bounded
+    by ``rel`` x that leaf's own gradient scale, AND the whole tree's
+    Frobenius-norm diff by ``frob`` x the tree's norm — catches a single
+    corrupted leaf and broad systematic drift while tolerating XLA's
+    reassociation of recomputed forwards."""
+    leaves0 = jax.tree.leaves(g0)
+    leaves1 = jax.tree.leaves(g1)
+    assert len(leaves0) == len(leaves1)
+    sq0 = sqd = 0.0
+    for a, b in zip(leaves0, leaves1):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        scale = max(float(np.abs(a).max()), 1.0)
+        worst = float(np.abs(a - b).max())
+        assert worst <= rel * scale, (
+            f"leaf diff {worst:.3e} vs scale {scale:.3e} "
+            f"(rel {worst / scale:.3e} > {rel})")
+        sq0 += float((a ** 2).sum())
+        sqd += float(((a - b) ** 2).sum())
+    assert sqd ** 0.5 <= frob * max(sq0 ** 0.5, 1e-30), (
+        f"tree-wide relative diff {(sqd ** 0.5) / (sq0 ** 0.5):.3e} "
+        f"> {frob}")
+
+
 def _make_serve_predictor(guidance_inject: str):
     import jax
     import optax

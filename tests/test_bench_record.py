@@ -246,3 +246,134 @@ class TestReplayCapture:
         out = bench.try_replay_tpu_capture()
         assert out is not None and out["value"] == 67.0
         assert "captured_iso" in out and "captured_unix" in out
+
+
+class TestCheckRegression:
+    """bench.py --check-regression: the committed BENCH_*.json records as
+    a throughput regression gate (exit non-zero past the 10% band)."""
+
+    METRIC = "danet_resnet101_512px_b8_train_step_throughput"
+
+    def _history_dir(self, tmp_path, values, platform="tpu",
+                     metric=None, wrap=True):
+        for i, v in enumerate(values, start=1):
+            rec = {"metric": metric or self.METRIC, "value": v,
+                   "unit": "imgs/sec/chip", "platform": platform}
+            data = {"n": i, "cmd": "python bench.py", "rc": 0,
+                    "parsed": rec} if wrap else rec
+            with open(tmp_path / f"BENCH_r{i:02d}.json", "w") as f:
+                json.dump(data, f)
+        return str(tmp_path)
+
+    def _rec(self, value, platform="tpu", metric=None):
+        return {"metric": metric or self.METRIC, "value": value,
+                "unit": "imgs/sec/chip", "platform": platform}
+
+    def test_history_parses_driver_wrapper_and_bare_records(self,
+                                                           tmp_path):
+        d = self._history_dir(tmp_path, [60.0])
+        with open(tmp_path / "BENCH_r02.json", "w") as f:
+            json.dump(self._rec(65.0), f)  # bare record form
+        (tmp_path / "BENCH_r03.json").write_text("not json")  # skipped
+        hist = bench.load_bench_history(d)
+        assert [r["value"] for _, r in hist] == [60.0, 65.0]
+
+    def test_newest_same_config_record_is_the_baseline(self, tmp_path):
+        d = self._history_dir(tmp_path, [60.0, 70.0])
+        hist = bench.load_bench_history(d)
+        # the baseline is 70 (the NEWEST record), not 60: a value equal
+        # to the OLD record still fails the 10% band against the new one
+        ok, msg = bench.check_regression(self._rec(60.0), hist)
+        assert not ok and "BENCH_r02" in msg
+        ok, _ = bench.check_regression(self._rec(63.1), hist)
+        assert ok  # within 10% of 70
+
+    def test_regression_past_threshold_fails(self, tmp_path):
+        hist = bench.load_bench_history(self._history_dir(tmp_path,
+                                                          [67.5]))
+        ok, msg = bench.check_regression(self._rec(55.0), hist)
+        assert not ok and "regression" in msg
+        ok, msg = bench.check_regression(self._rec(75.0), hist)
+        assert ok  # improvements always pass
+
+    def test_platform_and_metric_never_cross_compare(self, tmp_path):
+        hist = bench.load_bench_history(self._history_dir(tmp_path,
+                                                          [67.5]))
+        # a CPU-fallback number must not gate against the TPU record
+        ok, msg = bench.check_regression(self._rec(1.2, platform="cpu"),
+                                         hist)
+        assert ok and "nothing to compare" in msg
+        # a different bench config (metric carries model/size/batch)
+        ok, msg = bench.check_regression(
+            self._rec(1.0, metric="danet_resnet18_64px_b2_x"), hist)
+        assert ok and "nothing to compare" in msg
+
+    def test_replayed_captures_are_not_baselines(self, tmp_path):
+        rec = self._rec(99.0)
+        rec["replayed_from_session_capture"] = True
+        with open(tmp_path / "BENCH_r01.json", "w") as f:
+            json.dump({"parsed": rec}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        ok, msg = bench.check_regression(self._rec(50.0), hist)
+        assert ok and "nothing to compare" in msg
+
+    def test_empty_history_passes(self, tmp_path):
+        ok, msg = bench.check_regression(
+            self._rec(1.0), bench.load_bench_history(str(tmp_path)))
+        assert ok and "nothing to compare" in msg
+
+    def test_precision_and_bucket_variants_never_cross_compare(
+            self, tmp_path):
+        # a committed bf16+bucketed fast-path record must not baseline
+        # an f32/serialized run (slower by design), and vice versa —
+        # the filter keys on the record's precision block + bucket count
+        fast = self._rec(67.5)
+        fast["precision"] = {"compute_dtype": "bfloat16",
+                             "param_dtype": "float32",
+                             "loss_dtype": "float32"}
+        fast["reduce_buckets"] = 8
+        with open(tmp_path / "BENCH_r01.json", "w") as f:
+            json.dump({"parsed": fast}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        # f32 record (precision null, no buckets): different trajectory
+        ok, msg = bench.check_regression(self._rec(40.0), hist)
+        assert ok and "nothing to compare" in msg
+        # the matching fast-path variant DOES gate
+        probe = self._rec(50.0)
+        probe["precision"] = dict(fast["precision"])
+        probe["reduce_buckets"] = 8
+        ok, msg = bench.check_regression(probe, hist)
+        assert not ok and "regression" in msg
+
+    def test_non_default_config_never_gates(self, monkeypatch, capsys):
+        # DPTPU_BENCH_* A/B overrides are exploratory measurements: the
+        # gate skips them instead of failing a slower-by-design variant
+        monkeypatch.setattr(bench, "_is_default_config", lambda: False)
+        monkeypatch.setattr(
+            bench, "_CLI_ARGS",
+            type("A", (), {"check_regression": True})())
+        bench._maybe_check_regression(self._rec(1.0))  # no SystemExit
+        assert "skipped (non-default A/B config" in capsys.readouterr().err
+
+    def test_repo_history_loads(self):
+        # the committed BENCH_r*.json set parses (schema guard)
+        hist = bench.load_bench_history()
+        assert hist, "no committed BENCH_*.json parsed"
+        for _, rec in hist:
+            assert "metric" in rec and "value" in rec
+
+
+class TestPrecisionBlock:
+    def test_bench_precision_block_schema(self):
+        # the bench stamps `precision` into every record: null when f32,
+        # the policy dtypes under bf16 — via the one shared helper
+        from distributedpytorch_tpu.train.precision import (
+            precision_block,
+            precision_policy,
+        )
+
+        assert precision_block(precision_policy("float32")) is None
+        blk = precision_block(precision_policy("bfloat16"))
+        assert blk == {"compute_dtype": "bfloat16",
+                       "param_dtype": "float32",
+                       "loss_dtype": "float32"}

@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from conftest import assert_grads_close as _assert_grads_close
 
 from distributedpytorch_tpu.models import (DANet, DeepLabV3, EncNet, FCN,
                                            ResNet, build_model)
@@ -350,30 +351,6 @@ class TestFactory:
     def test_unknown_raises(self):
         with pytest.raises(ValueError):
             build_model("segformer")
-
-
-def _assert_grads_close(g0, g1, rel: float = 5e-4, frob: float = 1e-5):
-    """Remat math-neutrality, scale-aware: every leaf's inf-norm diff is
-    bounded by ``rel`` x that leaf's own gradient scale, AND the whole
-    tree's Frobenius-norm diff by ``frob`` x the tree's norm.  The pair
-    catches both a single corrupted leaf and broad systematic drift,
-    while tolerating XLA's reassociation of the recomputed forward."""
-    leaves0 = jax.tree.leaves(g0)
-    leaves1 = jax.tree.leaves(g1)
-    assert len(leaves0) == len(leaves1)
-    sq0 = sqd = 0.0
-    for a, b in zip(leaves0, leaves1):
-        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
-        scale = max(float(np.abs(a).max()), 1.0)
-        worst = float(np.abs(a - b).max())
-        assert worst <= rel * scale, (
-            f"leaf diff {worst:.3e} vs scale {scale:.3e} "
-            f"(rel {worst / scale:.3e} > {rel})")
-        sq0 += float((a ** 2).sum())
-        sqd += float(((a - b) ** 2).sum())
-    assert sqd ** 0.5 <= frob * max(sq0 ** 0.5, 1e-30), (
-        f"tree-wide relative diff {(sqd ** 0.5) / (sq0 ** 0.5):.3e} "
-        f"> {frob}")
 
 
 class TestRemat:
