@@ -228,13 +228,26 @@ BENCH_MODEL = os.environ.get("DPTPU_BENCH_MODEL", "danet")
 #: overrides for A/Bs.  The record's `precision` block carries it
 #: (null when f32 — keys always present).
 PRECISION = os.environ.get("DPTPU_BENCH_PRECISION") or DTYPE
+#: parallel plan for the bench step (parallel/plan.py):
+#: DPTPU_BENCH_STRATEGY names a ladder rung (dp | dp_tp | dp_zero1 |
+#: dp_tp_zero1) and the planner resolves mesh + composed shardings —
+#: the dp_tp A/B measures the TP boundary collectives' cost on real
+#: hardware.  Default: plain dp (the committed trajectory).  The
+#: record's `plan` block carries it (null for the trivial dp default,
+#: the precision-block convention, so pre-planner history stays
+#: comparable).
+BENCH_STRATEGY = os.environ.get("DPTPU_BENCH_STRATEGY", "") or "dp"
 #: train.reduce_buckets for the bench step: reverse-topo bucketed
 #: gradient all-reduce (comm/compute overlap) — default 8 on TPU where
 #: the async scheduler exploits it, 0 on the CPU smoke (keeps the
-#: downsized program aligned with the cpu8 canonical contract shapes).
+#: downsized program aligned with the cpu8 canonical contract shapes)
+#: and 0 under model-axis plans (buckets compose with dp/dp_zero1 only
+#: — plan.BUCKET_COMPATIBLE; an explicit env override of both knobs
+#: fails loudly through the step's planner-routed guard).
 #: DPTPU_BENCH_REDUCE_BUCKETS overrides for the overlap A/B.
-REDUCE_BUCKETS = int(os.environ.get("DPTPU_BENCH_REDUCE_BUCKETS",
-                                    "8" if ON_TPU else "0"))
+REDUCE_BUCKETS = int(os.environ.get(
+    "DPTPU_BENCH_REDUCE_BUCKETS",
+    "8" if ON_TPU and BENCH_STRATEGY in ("dp", "dp_zero1") else "0"))
 
 #: Sidecar holding the most recent on-chip capture of the DEFAULT bench
 #: config.  Written on every healthy TPU run; replayed (clearly labeled,
@@ -254,7 +267,8 @@ def _is_default_config() -> bool:
             and BN_FP32_STATS and not REMAT
             and not os.environ.get("DPTPU_BENCH_BATCH")
             and not os.environ.get("DPTPU_BENCH_PRECISION")
-            and not os.environ.get("DPTPU_BENCH_REDUCE_BUCKETS"))
+            and not os.environ.get("DPTPU_BENCH_REDUCE_BUCKETS")
+            and not os.environ.get("DPTPU_BENCH_STRATEGY"))
 
 
 def save_latest_tpu_capture(record: dict) -> None:
@@ -375,10 +389,11 @@ def check_regression(record: dict, history: list | None = None,
     SAME config: same ``metric`` string (the metric name carries
     model/backbone/size/batch), same ``platform`` (a CPU-fallback
     number must never gate against a TPU record), and same
-    ``precision`` block + ``reduce_buckets`` (a bf16+bucketed fast-path
-    number and an f32 serialized-reduce number are different
-    trajectories — neither may baseline the other, even if a variant
-    record was committed into history).  Replayed capture records are
+    ``precision`` block + ``reduce_buckets`` + ``plan`` block (a
+    bf16+bucketed fast-path number, an f32 serialized-reduce number and
+    a dp_tp sharded-plan number are all different trajectories —
+    none may baseline another, even if a variant record was committed
+    into history).  Replayed capture records are
     not comparison targets (they are themselves old numbers).  Returns
     ``(ok, message)``; ``ok=False`` means the throughput dropped more
     than ``threshold``.  No prior record -> ok (a fresh config starts
@@ -389,6 +404,11 @@ def check_regression(record: dict, history: list | None = None,
              and r.get("platform") == record.get("platform")
              and r.get("precision") == record.get("precision")
              and r.get("reduce_buckets") == record.get("reduce_buckets")
+             # the plan block joins the config key: a dp_tp (or any
+             # sharded-plan) record and a pure-dp record are different
+             # trajectories — neither may baseline the other.  Null ==
+             # the trivial dp default, so pre-planner history compares.
+             and r.get("plan") == record.get("plan")
              and not r.get("replayed_from_session_capture")]
     if not prior:
         return True, (f"no prior {record.get('metric')} record on "
@@ -582,6 +602,9 @@ def serve_bench():
     # served model actually runs (bf16 on TPU); null when f32 — key
     # always present (schema stability)
     record["precision"] = precision_block(precision_policy(DTYPE))
+    # plan block: a TRAIN-side concept (serve replicates the predictor),
+    # null on serve records — key always present (schema stability)
+    record["plan"] = None
     # IR-audit fields: the top bucket's forward (the program serving the
     # measured burst), same schema as the train record.  Config-named —
     # never the canonical serve_forward_b<N> names, whose contracts pin
@@ -717,6 +740,8 @@ def serve_sessions_bench():
     record["recovery"] = recovery_block()  # null block; key stability
     # precision block: the served model's compute regime; null when f32
     record["precision"] = precision_block(precision_policy(DTYPE))
+    # plan block: train-side concept, null on serve records; key present
+    record["plan"] = None
     # IR audit of the warm hot path (the decode program at the top
     # bucket) — config-named, same convention as the burst bench
     feats = predictor.feature_struct(1)
@@ -761,12 +786,16 @@ def main() -> None:
     from distributedpytorch_tpu.models import build_model
     from distributedpytorch_tpu.parallel import (
         create_train_state,
-        make_mesh,
-        make_train_step,
         shard_batch,
     )
+    from distributedpytorch_tpu.parallel import plan as plan_lib
 
-    mesh = make_mesh()
+    # parallel plan: the bench step is built THROUGH the planner, so a
+    # DPTPU_BENCH_STRATEGY=dp_tp A/B measures exactly the program the
+    # trainer would run under that strategy (composed shardings and all)
+    plan = plan_lib.resolve_plan(BENCH_STRATEGY,
+                                 n_devices=len(jax.devices()))
+    mesh = plan.make_mesh()
     n_chips = mesh.devices.size
     semantic = BENCH_MODEL != "danet"
     size = (SIZE + 1) if semantic and ON_TPU else SIZE  # 513² protocol
@@ -810,9 +839,11 @@ def main() -> None:
 
     with mesh:
         state = create_train_state(jax.random.PRNGKey(0), model, tx,
-                                   (1, size, size, in_ch), mesh=mesh)
-        step = make_train_step(
-            model, tx, mesh=mesh,
+                                   (1, size, size, in_ch), mesh=mesh,
+                                   shard_params=plan.shard_params,
+                                   shard_opt_state=plan.shard_opt_state)
+        step = plan.make_train_step(
+            model, tx, mesh=mesh, state=state,
             loss_type="multi_softmax" if semantic else "multi_sigmoid",
             precision=policy, reduce_buckets=REDUCE_BUCKETS)
         batch = shard_batch(mesh, host_batch)
@@ -855,9 +886,16 @@ def main() -> None:
             audit_kw["f32_allow"] = policy.ja002_allow()
         if REDUCE_BUCKETS:
             audit_kw["overlap_expected"] = True
+        # sharded plans name their own bench program (the config-naming
+        # rule): a dp_tp 512px step must never pin/check the dp config's
+        # contract.  mesh_axes rides along so a pinned strategy contract
+        # carries the per-axis collective inventory.
+        suffix = "" if BENCH_STRATEGY == "dp" else f"_{BENCH_STRATEGY}"
+        if plan.sharded:
+            audit_kw["mesh_axes"] = plan.axis_sizes(n_chips)
         audit_fields = ir_audit_fields(
             step, (state, batch),
-            f"bench_{BENCH_MODEL}_{BACKBONE}_{size}px_b{BATCH}",
+            f"bench_{BENCH_MODEL}_{BACKBONE}_{size}px_b{BATCH}{suffix}",
             **audit_kw)
 
     per_chip = stats["items_per_sec"] / n_chips
@@ -925,6 +963,13 @@ def main() -> None:
     # precision block (train/precision.py): the mixed-precision regime
     # the measured step ran under; null when f32 — key always present
     record["precision"] = precision_block(policy)
+    # plan block (parallel/plan.py): the sharding strategy the measured
+    # step was built under — null for the trivial pure-dp default (the
+    # precision-block convention: committed pre-planner history stays
+    # comparable), the full resolved block for any sharded plan.  Key
+    # always present; --check-regression keys its same-config filter on
+    # it so a dp_tp record can never baseline the dp trajectory.
+    record["plan"] = plan_lib.plan_record_block(plan)
     if REDUCE_BUCKETS:
         record["reduce_buckets"] = REDUCE_BUCKETS
     # IR-audit fields (jaxaudit): collective inventory of the exact

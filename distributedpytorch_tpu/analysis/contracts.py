@@ -41,9 +41,18 @@ import sys
 #: (the bucket ladder's ends), and the session-serving encode/decode
 #: split at the interactive click shape (b1).  Any ``serve_forward_b<N>``
 #: name is buildable on demand (``--programs serve_forward_b4``).
-PROGRAM_NAMES = ("train_step", "train_step_bf16", "eval_step",
+PROGRAM_NAMES = ("train_step", "train_step_bf16",
+                 "train_step_dp_tp", "train_step_dp_zero1",
+                 "train_step_dp_tp_zero1", "eval_step",
                  "serve_forward_b1", "serve_forward_b8",
                  "encode_step", "decode_step")
+
+#: the plan-built canonical programs: ``train_step_<strategy>`` for each
+#: resolvable non-trivial rung of parallel/plan.py's ladder (plain dp IS
+#: ``train_step``).  Their contracts additionally pin the per-mesh-axis
+#: HLO collective inventory (``collectives.hlo_axes``).
+PLAN_PROGRAM_NAMES = ("train_step_dp_tp", "train_step_dp_zero1",
+                      "train_step_dp_tp_zero1")
 
 _PROGRAM_HELP = {
     "train_step": "jitted mesh train step (fwd+loss+bwd+SGD, donated)",
@@ -51,6 +60,14 @@ _PROGRAM_HELP = {
                        "step with bucketed overlapped gradient reduce — "
                        "JA002 audited against the policy's declared "
                        "accumulation points",
+    "train_step_dp_tp": "plan dp_tp: params/momentum sharded over the "
+                        "model axis — contract pins per-mesh-axis "
+                        "collectives (model-axis counts nonzero)",
+    "train_step_dp_zero1": "plan dp_zero1: optimizer state sharded over "
+                           "data — per-mesh-axis collectives pinned",
+    "train_step_dp_tp_zero1": "plan dp_tp_zero1: TP x ZeRO-1 composed "
+                              "on one spec tree — per-mesh-axis "
+                              "collectives pinned",
     "eval_step": "jitted mesh eval step (fwd+loss)",
     "serve_forward_b1": "serve bucket forward, batch 1",
     "serve_forward_b8": "serve bucket forward, batch 8",
@@ -141,7 +158,12 @@ def diff_contract(contract: dict, report: dict) -> list[str]:
     matches its pins."""
     drift: list[str] = []
 
-    for level in ("jaxpr", "hlo"):
+    # "hlo_axes" is the per-mesh-axis inventory plan-built programs pin
+    # (ir.mesh_axis_collective_counts): a 2-D-mesh step regressing to
+    # replicated zeroes its model-axis counts and fails here.  Contracts
+    # that predate it (every pre-plan program) simply don't pin the
+    # level and are skipped.
+    for level in ("jaxpr", "hlo", "hlo_axes"):
         want = (contract.get("collectives") or {}).get(level)
         have = (report.get("collectives") or {}).get(level)
         if want is None:
@@ -287,6 +309,7 @@ def build_default_programs(names: tuple | list | None = None) -> dict:
     unknown = [n for n in names
                if n not in ("train_step", "train_step_bf16", "eval_step",
                             "encode_step", "decode_step")
+               and n not in PLAN_PROGRAM_NAMES
                and not (n.startswith("serve_forward_b")
                         and n[len("serve_forward_b"):].isdigit())]
     if unknown:
@@ -354,6 +377,36 @@ def build_default_programs(names: tuple | list | None = None) -> dict:
                 step, (state_struct, batch),
                 {"f32_allow": policy.ja002_allow(),
                  "overlap_expected": True})
+
+    plan_names = [n for n in names if n in PLAN_PROGRAM_NAMES]
+    if plan_names:
+        # the per-strategy plan programs: each is THE train step the
+        # planner builds for that rung of the ladder, at the canonical
+        # audit config — state layout composed by plan.state_specs
+        # (tp_param_specs x zero_opt_specs on one tree), shardings
+        # threaded from a struct-only state (weights never initialize).
+        # mesh_axes rides each entry so the audit attributes every HLO
+        # collective to the mesh axis its replica groups span; the
+        # checked-in contract pins that inventory exactly — deleting the
+        # model-axis traffic (a step silently regressing to replicated)
+        # fails `jaxaudit check`.
+        from ..parallel import plan as plan_lib
+
+        for n in plan_names:
+            plan = plan_lib.resolve_plan(n[len("train_step_"):],
+                                         n_devices=len(jax.devices()))
+            mesh_p = plan.make_mesh()
+            b = mesh_p.devices.size
+            batch = {"concat": sds((b, h, w, ch), jnp.float32),
+                     "crop_gt": sds((b, h, w), jnp.float32)}
+            state_struct = plan.abstract_state(model, tx, (1, h, w, ch),
+                                               mesh=mesh_p)
+            with mesh_p:
+                step = plan.make_train_step(
+                    model, tx, mesh=mesh_p, state=state_struct,
+                    loss_type="multi_sigmoid")
+            programs[n] = (step, (state_struct, batch),
+                           {"mesh_axes": plan.axis_sizes(b)})
 
     serve = [n for n in names if n.startswith("serve_forward_b")]
     if serve:

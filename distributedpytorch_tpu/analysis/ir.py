@@ -191,6 +191,154 @@ def async_start_count(hlo_counts: dict | None) -> int:
     return sum(n for op, n in hlo_counts.items() if op.endswith("-start"))
 
 
+# ------------------------------------------- per-mesh-axis HLO attribution
+
+def _parse_group_list(body: str) -> list[list[int]]:
+    """``{0,1},{2,3}`` (the inside of an explicit replica_groups or
+    source_target_pairs attribute) -> ``[[0,1],[2,3]]``."""
+    return [[int(x) for x in g.split(",") if x.strip() != ""]
+            for g in re.findall(r"\{([\d,\s]*)\}", body)]
+
+
+def _parse_iota_groups(shape: str, dims: str, perm: str | None
+                       ) -> list[list[int]]:
+    """XLA's iota replica-group format ``[G,S]<=[dims]`` (optionally
+    ``T(perm)``): the flat device list is
+    ``transpose(reshape(arange(prod(dims)), dims), perm)`` reshaped to
+    ``(G, S)`` — each row one group."""
+    import numpy as np
+
+    g, s = (int(x) for x in shape.split(","))
+    d = [int(x) for x in dims.split(",")]
+    ids = np.arange(int(np.prod(d)), dtype=np.int64).reshape(d)
+    if perm:
+        ids = ids.transpose([int(x) for x in perm.split(",")])
+    return ids.reshape(g, s).tolist()
+
+
+_GROUPS_RE = re.compile(
+    r"replica_groups=(?:\{((?:\{[\d,\s]*\},?)*)\}"
+    r"|\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?)")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{[\d,\s]*\},?)*)\}")
+
+
+def _axis_groups(axes: "dict[str, int]") -> dict:
+    """Expected replica-group sets per mesh axis, for the row-major
+    device order every mesh in this framework uses (``make_mesh``
+    reshapes ``jax.devices()``): axis k's groups hold the device ids
+    reached by varying ONLY coordinate k."""
+    import itertools
+
+    import numpy as np
+
+    sizes = list(axes.values())
+    ids = np.arange(int(np.prod(sizes)), dtype=np.int64).reshape(sizes)
+    out = {}
+    for k, name in enumerate(axes):
+        groups = set()
+        other = [range(s) for i, s in enumerate(sizes) if i != k]
+        for coord in itertools.product(*other):
+            idx = list(coord)
+            idx.insert(k, slice(None))
+            groups.add(frozenset(int(x) for x in ids[tuple(idx)].ravel()))
+        out[name] = groups
+    return out
+
+
+def _classify_groups(groups: list[list[int]], expected: dict,
+                     n_devices: int) -> str:
+    """One collective's replica groups -> the mesh axis they span:
+    ``"data"``/``"model"`` for exact single-axis group sets, ``"global"``
+    for one all-device group, ``"other"`` for anything else (sub-axis or
+    mixed groupings)."""
+    gset = frozenset(frozenset(g) for g in groups if g)
+    if not gset:
+        return "other"
+    for axis, want in expected.items():
+        if gset == want:
+            return axis
+    if gset == {frozenset(range(n_devices))}:
+        return "global"
+    return "other"
+
+
+def _classify_pairs(pairs: list[list[int]], axes: "dict[str, int]"
+                    ) -> str:
+    """A collective-permute's source→target pairs -> the one mesh axis
+    every hop moves along (``"other"`` when hops mix axes)."""
+    import numpy as np
+
+    sizes = list(axes.values())
+    n = int(np.prod(sizes))
+    moved = set()
+    for src, dst in pairs:
+        if not (0 <= src < n and 0 <= dst < n):
+            return "other"
+        cs = np.unravel_index(src, sizes)
+        cd = np.unravel_index(dst, sizes)
+        diff = [i for i, (a, b) in enumerate(zip(cs, cd)) if a != b]
+        if len(diff) != 1:
+            return "other"
+        moved.add(diff[0])
+    if len(moved) != 1:
+        return "other"
+    return list(axes)[moved.pop()]
+
+
+def mesh_axis_collective_counts(compiled, mesh_axes: "dict[str, int]"
+                                ) -> dict | None:
+    """``{op: {axis: count}}`` over the compiled module's collectives,
+    each attributed to the mesh axis its replica groups (or permute
+    pairs) span — the pin that makes "this 2-D step really communicates
+    over ``model``" a checkable contract fact instead of an aggregate op
+    count a replicated regression could imitate.
+
+    ``mesh_axes`` is the ordered ``{axis_name: size}`` of the mesh the
+    program was built on (row-major device order, as ``make_mesh``
+    lays it out).  Handles XLA's explicit (``{{0,1},{2,3}}``) and iota
+    (``[4,2]<=[8]``, ``[2,4]<=[4,2]T(1,0)``) group encodings plus
+    ``source_target_pairs``.  Sync and async ``-start`` forms count
+    under the base op.  ``None`` when the HLO text is unavailable.
+    """
+    import numpy as np
+
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    if not text:
+        return None
+    axes = dict(mesh_axes)
+    n = int(np.prod(list(axes.values())))
+    expected = _axis_groups(axes)
+    counts: dict[str, dict[str, int]] = {}
+    op_re = re.compile(
+        rf" ({'|'.join(_HLO_COLLECTIVES)})(?:-start)?\(")
+    for line in text.splitlines():
+        m = op_re.search(line)
+        if not m:
+            continue
+        op = m.group(1)
+        gm = _GROUPS_RE.search(line)
+        pm = _PAIRS_RE.search(line)
+        if gm is not None:
+            if gm.group(1) is not None:
+                groups = _parse_group_list(gm.group(1))
+            else:
+                groups = _parse_iota_groups(gm.group(2), gm.group(3),
+                                            gm.group(4))
+            if not groups:
+                groups = [list(range(n))]  # replica_groups={} = all
+            label = _classify_groups(groups, expected, n)
+        elif pm is not None:
+            label = _classify_pairs(_parse_group_list(pm.group(1)), axes)
+        else:
+            label = "other"
+        per = counts.setdefault(op, {})
+        per[label] = per.get(label, 0) + 1
+    return counts
+
+
 # ------------------------------------------------------------ dtype findings
 
 def _has_subjaxpr(eqn) -> bool:
@@ -408,7 +556,8 @@ def audit(fn, args: tuple = (), *, name: str = "program",
           compile: bool = True,
           f32_allow: frozenset = DEFAULT_F32_ACCUM_ALLOW,
           large_const_bytes: int = DEFAULT_LARGE_CONST_BYTES,
-          overlap_expected: bool = False) -> dict:
+          overlap_expected: bool = False,
+          mesh_axes: dict | None = None) -> dict:
     """Audit one jitted callable at ``args`` (concrete arrays or
     ShapeDtypeStructs — tracing never executes the program).
 
@@ -426,6 +575,15 @@ def audit(fn, args: tuple = (), *, name: str = "program",
     :mod:`contracts` turns that into a ``require_async_starts``
     expectation on platforms whose compiler lowers async collectives
     (TPU) — see ``contract_from_report``.
+
+    ``mesh_axes`` (ordered ``{axis: size}`` of the program's mesh, e.g.
+    ``{"data": 4, "model": 2}``) adds a per-mesh-axis HLO collective
+    inventory under ``collectives["hlo_axes"]``
+    (:func:`mesh_axis_collective_counts`) — the pin the per-strategy
+    plan contracts use so a 2-D step regressing to replicated fails
+    ``check`` on its vanished model-axis collectives, not on vibes.
+    Reports without it keep the pre-existing two-level collectives dict,
+    so older contracts stay byte-stable.
 
     Returns the JSON-able report :mod:`contracts` pins.
     """
@@ -457,9 +615,15 @@ def audit(fn, args: tuple = (), *, name: str = "program",
         "platform": jax.devices()[0].platform,
         "n_devices": len(jax.devices()),
         "overlap_expected": overlap_expected,
+        # "hlo_axes" (per-mesh-axis attribution) joins the dict only
+        # when the caller named the mesh (plan-built programs) — absent
+        # otherwise, keeping pre-existing contracts byte-stable
         "collectives": {
             "jaxpr": collective_inventory(closed),
             "hlo": hlo_collective_counts(compiled) if compile else None,
+            **({} if mesh_axes is None else {
+                "hlo_axes": mesh_axis_collective_counts(
+                    compiled, mesh_axes) if compile else None}),
         },
         "outputs": [_format_aval(getattr(v, "aval", None))
                     for v in closed.jaxpr.outvars],

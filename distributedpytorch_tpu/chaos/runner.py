@@ -141,6 +141,28 @@ SCENARIOS: dict[str, dict] = {
                        "sessions_survive_swap",
                        "bad_canary_rolled_back"],
     },
+    # Restore under a DIFFERENT parallel plan than saved: a dp run is
+    # preempted mid-epoch, and the fresh process resumes it with
+    # parallel.strategy=dp_tp — the pod-resized-between-runs shape.
+    # The sharding-aware restore must RESHARD (params byte-identical to
+    # the saved ones after gather, layout the new plan's), announce the
+    # plan crossing loudly (every checkpoint meta names the plan that
+    # laid it out — the discriminator the trainer prints on), and the
+    # resumed fit must complete the schedule under the new plan with
+    # zero optimizer steps lost or duplicated.  Never garbage: digest
+    # inequality anywhere in the chain fails params_restored_exactly.
+    "plan_mismatch_restore": {
+        "name": "plan_mismatch_restore",
+        "mode": "fit_resume",
+        "plan": {"seed": 0, "faults": [
+            {"site": "trainer/train_step", "kind": "sigterm", "at": [2]}]},
+        "overrides": {"checkpoint.preempt_check_every": 3},
+        "params": {"big_dataset": True,
+                   "resume_overrides": {"parallel.strategy": "dp_tp"}},
+        "invariants": ["preempted_cleanly", "params_restored_exactly",
+                       "resharded_across_plans",
+                       "zero_lost_or_duplicated_steps"],
+    },
     # NaN-poison the observed loss of one step WITH the step-health
     # sentinel armed: the run must RECOVER, not merely survive — the
     # sentinel's 'diverged' verdict rolls the trainer back to the last
@@ -398,6 +420,12 @@ def child_fit(spec_path: str) -> int:
         # (checkpoint.digest runs; None otherwise) — byte-identical
         # restore is provable even when this process is later SIGKILLed
         "restored_meta_digest": tr.resume_meta.get("param_digest"),
+        # the parallel plan THIS process resolved, and the plan the
+        # restored checkpoint's meta says laid the state out — the
+        # plan_mismatch_restore scenario's evidence pair: differing is
+        # fine (sharding-aware restore resharded), but only KNOWINGLY
+        "plan": tr.plan.block(),
+        "restored_meta_plan": tr.resume_meta.get("plan"),
     }
     # Preflight sidecar, BEFORE fit: a supervised child that dies
     # mid-fit (sigkill faults) still leaves its restore evidence for
@@ -470,6 +498,10 @@ def _run_fit_resume(sc: dict, work_dir: str) -> dict:
     resume_overrides["resume"] = "auto"
     if params.get("resume_epochs"):
         resume_overrides["epochs"] = params["resume_epochs"]
+    # phase-2-ONLY overrides: the resumed process's config may differ
+    # from the saver's (plan_mismatch_restore resumes a dp run under
+    # parallel.strategy=dp_tp — the pod-resized-between-runs shape)
+    resume_overrides.update(params.get("resume_overrides") or {})
     p2 = _run_child({"phase": "resume", "plan": None,
                      "overrides": resume_overrides, "work_dir": work_dir},
                     "resume", work_dir)
@@ -879,6 +911,21 @@ def _check_one(name, sc, result, phases, verdict):
                     f"trained {trained} "
                     f"(phase1 {p1['final_step']} + phase2 "
                     f"{p2['final_step'] - p2['restored_step']})")
+        elif name == "resharded_across_plans":
+            p1, p2 = phases["fault"], phases["resume"]
+            saved = p2.get("restored_meta_plan") or {}
+            live = p2.get("plan") or {}
+            verdict(name,
+                    bool(saved) and bool(live)
+                    # the meta named the SAVER's plan (the loud half:
+                    # the crossing is detectable, never silent)...
+                    and saved == (p1.get("plan") or {})
+                    # ...and the resumed process really crossed into a
+                    # model-axis-sharded layout
+                    and saved != live and bool(live.get("shard_params")),
+                    f"checkpoint meta plan {saved} -> restored under "
+                    f"{live} (phase-1 plan "
+                    f"{(p1.get('plan') or {}).get('strategy')})")
         elif name == "fell_back_past_torn_checkpoint":
             p1, p2 = phases["fault"], phases["resume"]
             torn = max(p1["saved_steps"])

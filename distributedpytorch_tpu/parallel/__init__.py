@@ -42,6 +42,17 @@ from .ring import (
     make_ring_attention_inline,
     ring_attention_local,
 )
+from .plan import (
+    BUCKET_COMPATIBLE,
+    STRATEGIES,
+    Plan,
+    PlanError,
+    auto_plan,
+    estimate_plan_memory,
+    plan_from_config,
+    plan_record_block,
+    resolve_plan,
+)
 from .tp import state_shardings, tp_param_specs
 from .zero import zero_opt_specs
 from .ulysses import make_ulysses_attention, ulysses_attention_local
@@ -59,6 +70,15 @@ from .step import (
 )
 
 __all__ = [
+    "BUCKET_COMPATIBLE",
+    "STRATEGIES",
+    "Plan",
+    "PlanError",
+    "auto_plan",
+    "estimate_plan_memory",
+    "plan_from_config",
+    "plan_record_block",
+    "resolve_plan",
     "DATA_AXIS",
     "EXPERT_AXIS",
     "MODEL_AXIS",

@@ -474,19 +474,41 @@ def make_train_step(
     per-shard losses (per-shard normalization — balanced-BCE
     denominators are shard-local), dropout draws per-device streams,
     and BN batch stats must psum explicitly — the model MUST be built
-    with ``bn_cross_replica_axis='data'`` (validated).  Pure data
-    parallel only: composes with accum/echo/multi-step/wire stages but
-    not with TP/ZeRO layouts (``state_shardings``) or ring PAM.
+    with ``bn_cross_replica_axis='data'`` (validated).  Composes with
+    accum/echo/multi-step/wire stages AND with ZeRO-1
+    (``plan.BUCKET_COMPATIBLE``: the shard_map region owns only the
+    replicated params and the batch shard, while ZeRO's data-sharded
+    optimizer leaves live entirely in the update OUTSIDE it — GSPMD
+    partitions that elementwise update over the shards as usual).  NOT
+    with tensor parallelism or ring PAM: model-axis-sharded params
+    cannot enter the region's replicated in_specs, and per-device
+    fwd/bwd over sharded kernels would be a different algorithm, not a
+    layout — rejected through the planner with the nearest supported
+    strategy named.
     """
     if reduce_buckets:
+        from .plan import PlanError, reduce_buckets_conflict, \
+            shardings_use_axis
+
         if mesh is None:
             raise ValueError("reduce_buckets needs a mesh (the data axis "
                              "the buckets psum over)")
-        if state_shardings is not None:
-            raise ValueError(
-                "reduce_buckets is pure data parallel: TP/ZeRO layouts "
-                "(state_shardings) keep the GSPMD-implicit reduce "
-                "(reduce_buckets=0)")
+        if mesh_lib.MODEL_AXIS in mesh.shape and \
+                mesh.shape[mesh_lib.MODEL_AXIS] > 1:
+            raise PlanError(
+                "train.reduce_buckets needs a 1-wide model axis: the "
+                "shard_map region owns the data axis and would "
+                "silently replicate compute across a live model axis "
+                f"(mesh is {dict(mesh.shape)}) — use "
+                "parallel.strategy=dp or dp_zero1, or drop "
+                "train.reduce_buckets for model-axis plans")
+        if state_shardings is not None and \
+                shardings_use_axis(state_shardings, mesh_lib.MODEL_AXIS):
+            # TP layout: route the rejection through the planner so the
+            # error names the nearest strategy that keeps the buckets
+            raise reduce_buckets_conflict(
+                "dp_tp_zero1" if shardings_use_axis(
+                    state_shardings, mesh_lib.DATA_AXIS) else "dp_tp")
         if getattr(model, "bn_cross_replica_axis", None) != \
                 mesh_lib.DATA_AXIS:
             raise ValueError(

@@ -111,7 +111,8 @@ class CheckpointManager:
 
     def __init__(self, directory: str, keep_latest: int = 3,
                  best_metric_init: float = 0.0, async_save: bool = True,
-                 digest: bool = False):
+                 digest: bool = False,
+                 static_meta: dict | None = None):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.best_metric = best_metric_init
@@ -134,6 +135,10 @@ class CheckpointManager:
         #: steps :meth:`restore` skipped as unreadable (torn files) on the
         #: way to the one it returned — the chaos runner's invariant hook
         self.last_restore_fallback: list[int] = []
+        #: keys merged into EVERY save's meta (the trainer stamps its
+        #: resolved parallel plan here, so any restore can tell whether
+        #: it is crossing plans); per-save ``extra`` wins on collision
+        self._static_meta = dict(static_meta or {})
 
     #: commit ledger sidecar (written via :func:`atomic_write_json`):
     #: records which steps had fully LANDED saves, so a restore failure
@@ -177,7 +182,8 @@ class CheckpointManager:
         if is_best:
             self.best_metric = float(metric)
         payload = {"state": ocp.args.StandardSave(state)}
-        meta = {"step": int(step), "best_metric": self.best_metric}
+        meta = dict(self._static_meta)
+        meta.update({"step": int(step), "best_metric": self.best_metric})
         if self._digest:
             meta["param_digest"] = param_digest(state.params)
         if metric is not None:

@@ -318,6 +318,32 @@ class OptimConfig:
 
 
 @dataclass
+class ParallelConfig:
+    """The declarative sharding strategy (parallel/plan.py): one knob
+    that resolves to a validated mesh + composed state layout.  Leave
+    ``strategy`` unset to keep driving the low-level ``mesh.*`` knobs —
+    the planner then derives the plan FROM them, so every run carries
+    one either way."""
+    strategy: str = ""                  # "" = derive from mesh.* |
+                                        # dp | dp_tp | dp_zero1 |
+                                        # dp_tp_zero1 | auto (walk the
+                                        # mesh-shape ladder with the
+                                        # memory model, smallest model
+                                        # axis that fits per-chip HBM)
+    data: int | None = None             # explicit data-axis size
+                                        # (None = all devices not
+                                        # claimed by model, per slice)
+    model: int = 0                      # explicit model-axis size
+                                        # (0 = derive: 1 for the dp
+                                        # family, 2 for the tp family)
+    hbm_budget_gb: float = 0.0          # auto only: per-chip HBM budget
+                                        # override (0 = detect from the
+                                        # backend's bytes_limit, 16 GiB
+                                        # fallback on backends without
+                                        # memory stats)
+
+
+@dataclass
 class MeshConfig:
     data: int | None = None             # None = all devices (per slice
                                         # when slices > 1)
@@ -402,6 +428,7 @@ class Config:
     model: ModelConfig = field(default_factory=ModelConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     sentinel: SentinelConfig = field(default_factory=SentinelConfig)
@@ -516,7 +543,8 @@ def _from_dict(cls, d: dict):
 
 _SUBCONFIGS = {"data": DataConfig, "model": ModelConfig,
                "train": TrainConfig, "optim": OptimConfig,
-               "mesh": MeshConfig, "checkpoint": CheckpointConfig,
+               "parallel": ParallelConfig, "mesh": MeshConfig,
+               "checkpoint": CheckpointConfig,
                "sentinel": SentinelConfig}
 
 

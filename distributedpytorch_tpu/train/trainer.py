@@ -49,13 +49,11 @@ from ..parallel import (
     WIRE_KEY,
     create_train_state,
     make_eval_step,
-    make_hybrid_mesh,
-    make_mesh,
     make_train_step,
     pack_wire,
     prefetch_to_device,
-    state_shardings,
 )
+from ..parallel import plan as plan_lib
 from ..telemetry import TraceCapture, get_accountant, mfu_estimate
 from ..telemetry import set_enabled as telemetry_set_enabled
 from ..utils.helpers import generate_param_report
@@ -171,15 +169,22 @@ class Trainer:
                 "instance protocol already scores at full resolution via "
                 "crop2fullmask paste-back)")
 
-        # --- mesh  (slices != 1 routes through make_hybrid_mesh so its
-        # validation also catches slices<1 typos instead of silently
-        # training on a flat mesh)
-        if cfg.mesh.slices != 1:
-            self.mesh = make_hybrid_mesh(
-                cfg.mesh.slices, data=cfg.mesh.data, model=cfg.mesh.model,
-                process_is_granule=cfg.mesh.process_is_granule)
-        else:
-            self.mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
+        # --- parallel plan (parallel/plan.py): the declarative strategy
+        # -> validated mesh + composed sharding layout.  With
+        # parallel.strategy unset the legacy mesh.* knobs still derive a
+        # plan, so EVERY run carries one — recorded in fit_summary.json,
+        # every checkpoint's meta (the cross-plan restore discriminator)
+        # and the bench record's plan block.  strategy=auto walks the
+        # mesh-shape ladder with the memory model; the resolution is
+        # printed so the run's layout is never a mystery.
+        self.plan = plan_lib.plan_from_config(
+            cfg, memory_inputs=(self._plan_memory_inputs
+                                if cfg.parallel.strategy == "auto"
+                                else None))
+        if self.is_main and cfg.parallel.strategy == "auto":
+            print(f"parallel.strategy=auto resolved to "
+                  f"{self.plan.describe()}", flush=True)
+        self.mesh = self.plan.make_mesh()
 
         # --- data
         root = cfg.data.root
@@ -489,17 +494,18 @@ class Trainer:
         # reduce explicitly — the model is built cross-replica.
         self.precision = precision_policy(cfg.train.precision)
         if cfg.train.reduce_buckets:
-            if cfg.mesh.shard_params or cfg.mesh.shard_opt_state:
-                raise ValueError(
-                    "train.reduce_buckets is pure data parallel — it "
-                    "cannot compose with mesh.shard_params (TP) or "
-                    "mesh.shard_opt_state (ZeRO-1); the GSPMD-implicit "
-                    "reduce (reduce_buckets=0) handles those layouts")
-            if cfg.mesh.model > 1 or cfg.model.pam_impl == "ring":
-                raise ValueError(
+            # the planner owns compatibility: buckets compose with the
+            # dp family incl. ZeRO-1 (plan.BUCKET_COMPATIBLE — the
+            # sharded optimizer update lives outside the shard_map
+            # region), never with TP or a live model axis
+            if self.plan.strategy not in plan_lib.BUCKET_COMPATIBLE:
+                raise plan_lib.reduce_buckets_conflict(self.plan.strategy)
+            if self.plan.model > 1 or cfg.model.pam_impl == "ring":
+                raise plan_lib.PlanError(
                     "train.reduce_buckets needs a data-only mesh "
-                    "(mesh.model=1) and a non-ring PAM — its shard_map "
-                    "region owns the data axis")
+                    "(model axis 1) and a non-ring PAM — its shard_map "
+                    "region owns the data axis; nearest supported: "
+                    "parallel.strategy=dp (or dp_zero1)")
         self.model = build_model(
             name=cfg.model.name, nclass=cfg.model.nclass,
             backbone=cfg.model.backbone, output_stride=cfg.model.output_stride,
@@ -534,14 +540,14 @@ class Trainer:
             self.state = create_train_state(
                 jax.random.PRNGKey(cfg.seed), self.model, self.tx,
                 (1, h, w, cfg.model.in_channels), mesh=self.mesh,
-                shard_params=cfg.mesh.shard_params,
-                shard_opt_state=cfg.mesh.shard_opt_state)
+                shard_params=self.plan.shard_params,
+                shard_opt_state=self.plan.shard_opt_state)
         loss_type = ("multi_softmax" if cfg.task == "semantic"
                      else "multi_sigmoid")
-        # TP / ZeRO-1 layouts flow from the created state into the
-        # compiled steps.
-        st_sh = state_shardings(self.state) \
-            if (cfg.mesh.shard_params or cfg.mesh.shard_opt_state) else None
+        # The plan's TP / ZeRO-1 layouts flow from the created state
+        # into the compiled steps (live shardings — exactly what
+        # create_train_state placed); the plan owns the threading rule.
+        st_sh = self.plan.state_shardings(self.state, self.mesh)
         augment = None
         if cfg.data.device_augment or cfg.data.device_guidance:
             from ..ops.augment import make_device_augment
@@ -642,7 +648,11 @@ class Trainer:
             keep_latest=cfg.checkpoint.keep_latest,
             best_metric_init=cfg.checkpoint.best_metric_init,
             async_save=cfg.checkpoint.async_save,
-            digest=cfg.checkpoint.digest)
+            digest=cfg.checkpoint.digest,
+            # every save's meta names the plan that laid the state out —
+            # the cross-plan restore discriminator (chaos
+            # plan_mismatch_restore asserts it)
+            static_meta={"plan": self.plan.block()})
         self.start_epoch = 0
         self._resume_start_batch = 0  # exact mid-epoch resume offset
         #: steps the resume restore SKIPPED as unreadable (torn files) on
@@ -673,6 +683,9 @@ class Trainer:
             flat = config_lib.flatten(cfg)
             flat["n_params"] = self.n_params
             flat["n_devices"] = self.mesh.devices.size
+            # the RESOLVED plan (config.json only records the request —
+            # under strategy=auto the two differ)
+            flat["resolved_plan"] = self.plan.describe()
             flat["train_set"] = str(self.train_set)
             flat["val_set"] = str(self.val_set)
             generate_param_report(
@@ -686,6 +699,39 @@ class Trainer:
         train_pascal.py:105)."""
         return sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(self.state.params))
+
+    def _plan_memory_inputs(self) -> tuple:
+        """``strategy=auto``'s memory-model inputs: a shape-only
+        ``TrainState`` template of THIS config's model/optimizer (via
+        ``jax.eval_shape`` — no weights initialized, no mesh needed:
+        state shapes are layout-independent) and the global train
+        batch's byte count.  Built from the config alone, before the
+        mesh exists — the plan decides the mesh."""
+        cfg = self.cfg
+        h, w = cfg.data.crop_size
+        in_ch = cfg.model.in_channels
+        model = build_model(
+            name=cfg.model.name, nclass=cfg.model.nclass,
+            backbone=cfg.model.backbone,
+            output_stride=cfg.model.output_stride,
+            dtype=(precision_policy(cfg.train.precision).compute_dtype
+                   if precision_policy(cfg.train.precision)
+                   else cfg.model.dtype),
+            moe_experts=cfg.model.moe_experts,
+            moe_hidden=cfg.model.moe_hidden, moe_k=cfg.model.moe_k,
+            moe_capacity_factor=cfg.model.moe_capacity_factor,
+            aux_head=cfg.model.aux_head,
+            encnet_codes=cfg.model.encnet_codes,
+            ccnet_recurrence=cfg.model.ccnet_recurrence,
+            guidance_inject=cfg.model.guidance_inject)
+        tx, _ = make_optimizer(cfg.optim, 100)  # shapes don't see steps
+        state_struct = jax.eval_shape(
+            lambda: create_train_state(
+                jax.random.PRNGKey(0), model, tx, (1, h, w, in_ch)))
+        # device-bound train tensors, f32 on device (the uint8 wire
+        # dequantizes inside the step): concat + crop_gt (+void)
+        batch_bytes = cfg.data.train_batch * h * w * (in_ch + 2) * 4
+        return state_struct, batch_bytes
 
     def _warm_start(self, path: str, partial: bool) -> None:
         """Import model weights from a torch ``.pth`` state_dict — the
@@ -800,6 +846,22 @@ class Trainer:
             else self.ckpt
         self.state, meta = mgr.restore(self.state)
         self.resume_meta = dict(meta)
+        saved_plan = meta.get("plan")
+        n_dev = self.mesh.devices.size
+        if saved_plan and (plan_lib.normalized_block(saved_plan, n_dev)
+                           != plan_lib.normalized_block(
+                               self.plan.block(), n_dev)):
+            # Cross-plan restore: StandardRestore adopts the TARGET
+            # state's shardings, so the arrays land resharded into this
+            # plan's layout (and restore's re-buffer pass keeps them
+            # donation-safe) — announce it loudly; a silent layout
+            # change under a resumed run is how garbage gets loaded.
+            if self.is_main:
+                print("cross-plan restore: checkpoint was saved under "
+                      f"plan {saved_plan} and is resharding into "
+                      f"{self.plan.block()} (strategy "
+                      f"{saved_plan.get('strategy')} -> "
+                      f"{self.plan.strategy})", flush=True)
         self.resume_fallback_steps = list(mgr.last_restore_fallback)
         self.start_epoch = int(meta.get("epoch", 0)) + 1
         self.ckpt.best_metric = float(
@@ -1848,7 +1910,10 @@ class Trainer:
                      "start_epoch": self.start_epoch,
                      "epochs": cfg.epochs,
                      "epochs_recorded": len(history["train_loss"]),
-                     "recovery": history["recovery"]})
+                     "recovery": history["recovery"],
+                     # the resolved plan this run actually trained under
+                     # (under strategy=auto, the ladder's pick)
+                     "plan": self.plan.block()})
             self.writer.flush()
         return history
 

@@ -18,17 +18,25 @@
 #      supervisor must stay a stdlib process; train/precision.py +
 #      ops/pallas_attention.py included — the mixed-precision policy
 #      and the fused dual-attention kernels ARE the hot path, and a
-#      host sync or silent retrace there costs every step) plus
-#      bench.py, the official record.
+#      host sync or silent retrace there costs every step;
+#      parallel/plan.py included — the sharding-strategy planner
+#      resolves every run's mesh + composed state layout, and its
+#      memory-model arithmetic must stay pure host code: no device
+#      touches, no traces at plan time) plus bench.py, the official
+#      record.
 #   2. jaxaudit check — IR-level compile contracts: the canonical
 #      train/eval/serve programs (incl. the session split's
-#      encode_step/decode_step AND train_step_bf16 — the mixed-
+#      encode_step/decode_step, train_step_bf16 — the mixed-
 #      precision bucketed-reduce fast path, JA002-audited against the
-#      policy's declared accumulation points, its psum buckets pinned)
-#      are re-traced on the pinned 8-device CPU topology and diffed
-#      against tests/contracts/ (collective counts incl. async -start
-#      forms, output shapes, donation aliasing, baked constants,
-#      FLOPs bounds).  After a REVIEWED program change, regenerate with
+#      policy's declared accumulation points, its psum buckets pinned —
+#      AND the per-strategy plan programs train_step_dp_tp /
+#      train_step_dp_zero1 / train_step_dp_tp_zero1, whose contracts
+#      pin the PER-MESH-AXIS collective inventory so a 2-D-mesh step
+#      silently regressing to replicated fails on its vanished
+#      model-axis collectives) are re-traced on the pinned 8-device
+#      CPU topology and diffed against tests/contracts/ (collective
+#      counts incl. async -start forms, output shapes, donation
+#      aliasing, baked constants, FLOPs bounds).  After a REVIEWED program change, regenerate with
 #      `python -m distributedpytorch_tpu.analysis --ir update`.
 #
 # Mirror of the tier-1 gates (tests/test_lint_clean.py +

@@ -345,6 +345,44 @@ class TestCheckRegression:
         ok, msg = bench.check_regression(probe, hist)
         assert not ok and "regression" in msg
 
+    def test_plan_variants_never_cross_compare(self, tmp_path):
+        # a committed dp_tp (sharded-plan) record must never baseline
+        # the pure-dp trajectory, and vice versa — the filter keys on
+        # the record's plan block (null == the trivial dp default, so
+        # committed pre-planner history still gates dp runs)
+        tp = self._rec(30.0)
+        tp["plan"] = {"strategy": "dp_tp", "data": 4, "model": 2,
+                      "slices": 1, "shard_params": True,
+                      "shard_opt_state": False}
+        with open(tmp_path / "BENCH_r01.json", "w") as f:
+            json.dump({"parsed": tp}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        # dp record (plan null): different trajectory, never gated by tp
+        ok, msg = bench.check_regression(self._rec(10.0), hist)
+        assert ok and "nothing to compare" in msg
+        # the matching dp_tp record DOES gate
+        probe = self._rec(20.0)
+        probe["plan"] = dict(tp["plan"])
+        ok, msg = bench.check_regression(probe, hist)
+        assert not ok and "regression" in msg
+        # and a pre-planner record (no plan key at all) still gates a
+        # fresh default-dp record whose plan block is null
+        old = self._rec(67.5)
+        with open(tmp_path / "BENCH_r02.json", "w") as f:
+            json.dump({"parsed": old}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        fresh = self._rec(50.0)
+        fresh["plan"] = None
+        ok, msg = bench.check_regression(fresh, hist)
+        assert not ok and "BENCH_r02" in msg
+
+    def test_strategy_env_is_a_non_default_config(self, monkeypatch):
+        # DPTPU_BENCH_STRATEGY is an A/B knob: the regression gate must
+        # skip it (a dp_tp run is a measurement, not a trajectory point)
+        monkeypatch.setenv("DPTPU_BENCH_STRATEGY", "dp_tp")
+        assert not bench._is_default_config()
+        monkeypatch.delenv("DPTPU_BENCH_STRATEGY")
+
     def test_non_default_config_never_gates(self, monkeypatch, capsys):
         # DPTPU_BENCH_* A/B overrides are exploratory measurements: the
         # gate skips them instead of failing a slower-by-design variant

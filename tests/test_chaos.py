@@ -486,6 +486,26 @@ class TestScenariosEndToEnd:
         assert p2["restore_fallback"] == [
             max(report["phases"]["fault"]["saved_steps"])]
 
+    @pytest.mark.slow  # same two-child cost
+    def test_plan_mismatch_restore(self, tmp_path):
+        # dp run preempted, resumed under parallel.strategy=dp_tp: the
+        # restore must RESHARD (saved params byte-identical after
+        # gather), the plan crossing must be meta-recorded (loud, never
+        # silent), and the schedule completes under the new plan
+        from distributedpytorch_tpu.chaos import runner
+
+        report = runner.run_scenario("plan_mismatch_restore",
+                                     work_dir=str(tmp_path / "w"),
+                                     strict=True)
+        p1 = report["phases"]["fault"]
+        p2 = report["phases"]["resume"]
+        assert p1["plan"]["strategy"] == "dp"
+        assert p2["plan"]["strategy"] == "dp_tp"
+        assert p2["plan"]["shard_params"]
+        assert p2["restored_meta_plan"] == p1["plan"]
+        assert p2["param_digest_at_restore"] == p1["param_digest"]
+        assert p2["final_step"] == 2 * p2["nb"]
+
 
 class TestCLI:
     def test_list_and_plan(self):
@@ -498,7 +518,8 @@ class TestCLI:
             cwd=repo)
         assert r.returncode == 0
         for name in ("preempt_mid_epoch", "truncated_checkpoint",
-                     "serve_latency_shed", "nan_loss", "nan_loss_legacy",
+                     "plan_mismatch_restore", "serve_latency_shed",
+                     "nan_loss", "nan_loss_legacy",
                      "divergence_rollback", "crash_loop",
                      "preemption_storm"):
             assert name in r.stdout

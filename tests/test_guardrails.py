@@ -72,6 +72,8 @@ class TestNanWatchdog:
             tr.fit()
         tr.close()
 
+    @pytest.mark.slow  # full 2-epoch fit; the debug_asserts variant
+    # above is the fast detection gate
     def test_hot_run_warns_and_survives_without_debug(self, tmp_path,
                                                       capsys):
         from distributedpytorch_tpu.train import Trainer

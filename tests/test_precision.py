@@ -192,12 +192,20 @@ class TestStepValidation:
         with pytest.raises(ValueError, match="mesh"):
             make_train_step(m, optax.sgd(1e-3), reduce_buckets=4)
 
-    def test_rejects_state_shardings(self):
+    def test_rejects_model_axis_state_shardings(self):
+        # Since the planner (parallel/plan.py) buckets compose with
+        # data-axis layouts (ZeRO-1, pinned in test_plan), so the guard
+        # rejects only MODEL-axis-sharded trees — through the planner,
+        # naming the nearest bucket-keeping strategy.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         m = build_model("danet", nclass=1, backbone="resnet18",
                         output_stride=8, bn_cross_replica_axis="data")
-        with pytest.raises(ValueError, match="data parallel"):
-            make_train_step(m, optax.sgd(1e-3), mesh=make_mesh(),
-                            reduce_buckets=4, state_shardings={})
+        msh = make_mesh()
+        tp_sh = {"kernel": NamedSharding(msh, P(None, "model"))}
+        with pytest.raises(ValueError, match="strategy"):
+            make_train_step(m, optax.sgd(1e-3), mesh=msh,
+                            reduce_buckets=4, state_shardings=tp_sh)
 
     def test_requires_cross_replica_bn(self):
         m = build_model("danet", nclass=1, backbone="resnet18",

@@ -264,6 +264,8 @@ class TestUint8Wire:
         np.testing.assert_array_equal(np.asarray(out["concat"]),
                                       sf["concat"])
 
+    @pytest.mark.slow  # full fit; the dequant/dtype wire pins above
+    # are the fast gates
     def test_trainer_uint8_transfer(self, tmp_path):
         from tests.test_train import make_tiny_cfg
         from distributedpytorch_tpu.train import Trainer
@@ -510,6 +512,9 @@ class TestTrainerIntegration:
         with pytest.raises(ValueError, match="steps_per_dispatch"):
             Trainer(cfg)
 
+    @pytest.mark.slow  # full fit; test_fit_with_prepared_cache is the
+    # fast prepared-cache fit gate, and the semantic x prepared
+    # composition parity is pinned in test_val_fastpath
     def test_semantic_task_with_prepared_cache(self, tmp_path):
         from tests.test_train import make_tiny_cfg
         from distributedpytorch_tpu.data import make_fake_voc

@@ -334,6 +334,7 @@ class TestGoodputEndToEnd:
         assert rec["goodput/productive_frac"] == pytest.approx(
             rep["goodput"], abs=1e-3)
 
+    @pytest.mark.slow  # full fit; test_disabled_is_noop is the fast gate
     def test_telemetry_disabled_fit_still_works(self, tmp_path):
         from distributedpytorch_tpu.telemetry import (
             MetricsRegistry,
