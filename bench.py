@@ -113,6 +113,7 @@ from distributedpytorch_tpu.telemetry.goodput import (  # noqa: E402
     xla_step_cost,
 )
 from distributedpytorch_tpu.chaos import sites as chaos_sites  # noqa: E402
+from distributedpytorch_tpu.data.governor import feed_block  # noqa: E402
 from distributedpytorch_tpu.telemetry import get_accountant  # noqa: E402
 from distributedpytorch_tpu.train.precision import (  # noqa: E402
     precision_block,
@@ -248,6 +249,25 @@ BENCH_STRATEGY = os.environ.get("DPTPU_BENCH_STRATEGY", "") or "dp"
 REDUCE_BUCKETS = int(os.environ.get(
     "DPTPU_BENCH_REDUCE_BUCKETS",
     "8" if ON_TPU and BENCH_STRATEGY in ("dp", "dp_zero1") else "0"))
+#: DPTPU_BENCH_GOVERNOR=observe|auto stamps the train record's `feed`
+#: block as GOVERNED and arms the --check-regression feed gate: the
+#: record's measured input_wait fraction must sit at or below the
+#: governor target (DPTPU_BENCH_GOVERNOR_TARGET, default the config's
+#: data.governor_target) — ROADMAP item 2's "input_wait ≈ 0 on the
+#: bench config" acceptance, made mechanical.  Unset = ungoverned
+#: (feed.governor null): the fraction is still measured and recorded,
+#: nothing gates.  Observation-only either way: the bench's timed loop
+#: is never actuated.
+BENCH_GOVERNOR = os.environ.get("DPTPU_BENCH_GOVERNOR") or None
+
+
+def _governor_target() -> float:
+    env = os.environ.get("DPTPU_BENCH_GOVERNOR_TARGET")
+    if env:
+        return float(env)
+    from distributedpytorch_tpu.train.config import DataConfig
+
+    return DataConfig().governor_target
 
 #: Sidecar holding the most recent on-chip capture of the DEFAULT bench
 #: config.  Written on every healthy TPU run; replayed (clearly labeled,
@@ -426,6 +446,30 @@ def check_regression(record: dict, history: list | None = None,
     return True, msg
 
 
+def check_feed(record: dict, target: float | None = None
+               ) -> tuple[bool, str]:
+    """The feed gate of ``--check-regression``: a GOVERNED record's
+    measured ``feed.input_wait_fraction`` must sit at or below the
+    governor target — the mechanical form of ROADMAP item 2's
+    "input_wait ≈ 0 on the bench config" acceptance.  Ungoverned
+    records (``feed`` null or ``feed.governor`` null) pass trivially
+    with an explanatory message; a governed record missing the measured
+    fraction FAILS (an unmeasured gate is no gate)."""
+    feed = record.get("feed")
+    if not feed or not feed.get("governor"):
+        return True, "ungoverned record; feed gate not armed"
+    target = _governor_target() if target is None else float(target)
+    frac = feed.get("input_wait_fraction")
+    if frac is None:
+        return False, ("governed record carries no measured "
+                       "input_wait fraction — nothing to gate")
+    if frac > target:
+        return False, (f"input_wait fraction {frac:.4f} above the "
+                       f"governor target {target} (feed-bound, not "
+                       "chip-bound)")
+    return True, (f"input_wait fraction {frac:.4f} <= target {target}")
+
+
 def _maybe_check_regression(record: dict) -> None:
     """The --check-regression tail of every bench mode: report to
     stderr (stdout is the record), exit 1 on a gated regression."""
@@ -435,6 +479,13 @@ def _maybe_check_regression(record: dict) -> None:
         print("check-regression: skipped (replayed capture, not a fresh "
               "measurement)", file=sys.stderr)
         return
+    # the feed gate runs for every fresh record — including A/B
+    # variants: a governed variant's stall measurement is exactly what
+    # the gate exists to judge, independent of the throughput baseline
+    ok, msg = check_feed(record)
+    print(f"check-regression (feed): {msg}", file=sys.stderr)
+    if not ok:
+        raise SystemExit(1)
     if not _is_default_config():
         # A/B variants (DPTPU_BENCH_PRECISION=float32, REDUCE_BUCKETS=0,
         # batch/score-dtype overrides, ...) are exploratory measurements,
@@ -588,6 +639,9 @@ def serve_bench():
     record["goodput_breakdown"] = {
         k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
     record["mfu"] = None
+    # feed block: a train-side concept (serving has no input pipeline to
+    # govern), null on serve records — key always present
+    record["feed"] = None
     # chaos field: the armed fault-injection scenario's name, null when
     # none is armed — key ALWAYS present (schema stability), so record
     # consumers can tell a clean number from a chaos-conditioned one
@@ -736,6 +790,7 @@ def serve_sessions_bench():
     record["goodput_breakdown"] = {
         k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
     record["mfu"] = None
+    record["feed"] = None  # train-side concept, null on serve records
     record["chaos"] = chaos_sites.active_scenario()
     record["recovery"] = recovery_block()  # null block; key stability
     # precision block: the served model's compute regime; null when f32
@@ -950,6 +1005,14 @@ def main() -> None:
     record["goodput"] = round(goodput_rep["goodput"], 4)
     record["goodput_breakdown"] = {
         k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
+    # feed block (data/governor.py): the measured input-stall fraction
+    # of the record's own goodput books (the timed loop steps pre-placed
+    # batches, so ≈ 0 by construction — and the gate catches it if a
+    # future bench change makes the loop feed-bound), the governing mode
+    # (null = ungoverned), the echo factor (null: the bench loop never
+    # echoes).  Keys always present; --check-regression gates the
+    # fraction against the governor target when governed.
+    record["feed"] = feed_block(goodput_rep, governor=BENCH_GOVERNOR)
     # chaos field: armed fault-plan name or null; key always present
     # (the PR 4 schema-stability convention)
     record["chaos"] = chaos_sites.active_scenario()

@@ -12,6 +12,8 @@ invariants::
                                              # to a COMMITTED checkpoint
     dptpu-chaos crash_loop                   # SIGKILL x3 -> supervisor
     dptpu-chaos preemption_storm             # SIGTERM storm -> exact chain
+    dptpu-chaos input_stall_recovery         # slow feed -> governor arms
+                                             # echo -> recovers -> disarms
     dptpu-chaos my_scenario.json
     dptpu-chaos --list
     dptpu-chaos --plan preempt_mid_epoch     # print the plan JSON (for

@@ -213,6 +213,33 @@ SCENARIOS: dict[str, dict] = {
                        "quarantine_written", "fit_completes",
                        "final_metrics_finite"],
     },
+    # Sustained latency injected at the trainer's batch-fetch boundary
+    # (the REAL input_wait seam): the feed governor (data/governor.py,
+    # armed data.governor=auto) must climb its ladder unattended —
+    # hot prefetch raises, then (flip ineligible: the device path is
+    # already on, so the rung logs its recommendation) ARM data echoing
+    # sized from the measured stall — and once the fault plan exhausts,
+    # the windowed input_wait fraction must drain below
+    # data.governor_target and the governor must DISARM echo with
+    # hysteresis.  The whole decision sequence is asserted from
+    # run_dir/governor.jsonl; recovery = the arm -> disarm span.
+    "input_stall_recovery": {
+        "name": "input_stall_recovery",
+        "mode": "fit",
+        "plan": {"seed": 0, "faults": [
+            {"site": "trainer/batch_fetch", "kind": "latency",
+             "delay_s": 0.5, "every": 1, "times": 14}]},
+        "overrides": {"epochs": 4, "eval_every": 0, "log_every_steps": 1,
+                      "data.governor": "auto",
+                      "data.governor_target": 0.2,
+                      "data.governor_window": 8, "data.max_echo": 4,
+                      "data.device_augment": True,
+                      "data.device_guidance": True},
+        "params": {"big_dataset": True},
+        "invariants": ["governor_armed_echo",
+                       "stall_recovered_below_target",
+                       "echo_disarmed_after_clear", "fit_completes"],
+    },
     # SIGKILL mid-epoch, three times: no graceful stop, no final save —
     # the supervisor must restart each corpse, every restart must resume
     # from a COMMITTED checkpoint whose meta digest matches the restored
@@ -334,18 +361,43 @@ def _maybe_big_dataset(params: dict, overrides: dict,
     return overrides
 
 
-def _read_quarantine(run_dir: str) -> list[dict]:
-    """Parsed ``quarantine.jsonl`` records (empty when none written)."""
-    path = os.path.join(run_dir, "quarantine.jsonl")
+def _read_jsonl(run_dir: str, name: str) -> list[dict]:
+    """Parsed records of a run-dir JSONL ledger (empty when none)."""
     records = []
     try:
-        with open(path) as f:
+        with open(os.path.join(run_dir, name)) as f:
             for line in f:
                 if line.strip():
                     records.append(json.loads(line))
     except OSError:
         pass
     return records
+
+
+def _read_governor(run_dir: str) -> list[dict]:
+    """Parsed ``governor.jsonl`` decision records (empty when none)."""
+    return _read_jsonl(run_dir, "governor.jsonl")
+
+
+def _governor_recovery_s(records: list[dict]) -> float | None:
+    """arm -> disarm wall-clock from the governor ledger (the
+    input_stall_recovery scenario's recovery measure): time from the
+    first applied escalation past rung 1 to the disarm that closed the
+    episode.  None when the ledger holds no such pair."""
+    armed_ts = None
+    for r in records:
+        if r.get("action") in ("arm_echo", "flip_device_path") \
+                and r.get("applied") and armed_ts is None:
+            armed_ts = r.get("ts")
+        if r.get("action") == "disarm_echo" and r.get("applied") \
+                and armed_ts is not None:
+            return max(0.0, float(r["ts"]) - float(armed_ts))
+    return None
+
+
+def _read_quarantine(run_dir: str) -> list[dict]:
+    """Parsed ``quarantine.jsonl`` records (empty when none written)."""
+    return _read_jsonl(run_dir, "quarantine.jsonl")
 
 
 def _build_cfg(overrides: dict, work_dir: str):
@@ -533,10 +585,14 @@ def _run_fit(sc: dict, work_dir: str) -> dict:
         fit_s = time.perf_counter() - t0
         tr.close()
     # sentinel scenarios: recovery = the measured rollback restore
-    # time(s), not the whole fit (a fit that mostly trains healthily
-    # would otherwise read as slow recovery)
+    # time(s); governor scenarios: the arm -> disarm span from the
+    # decision ledger.  Neither = the whole fit (a fit that mostly
+    # trains healthily would otherwise read as slow recovery).
+    governor_records = _read_governor(tr.run_dir)
     rec = history.get("recovery") or {}
     recovery_s = rec.get("recovery_p50_s")
+    if recovery_s is None:
+        recovery_s = _governor_recovery_s(governor_records)
     _observe_recovery(sc["name"],
                       fit_s if recovery_s is None else recovery_s)
     return {"phases": {"fit": {
@@ -548,6 +604,8 @@ def _run_fit(sc: dict, work_dir: str) -> dict:
         "preempted": bool(history.get("preempted")),
         "recovery": history.get("recovery"),
         "quarantine": _read_quarantine(tr.run_dir),
+        "feed": history.get("feed"),
+        "governor": governor_records,
     }}, "recovery_s": round(fit_s if recovery_s is None else recovery_s, 3),
         "firings": plan.injected_total()}
 
@@ -1047,6 +1105,48 @@ def _check_one(name, sc, result, phases, verdict):
                     and (rec.get("quarantined_steps") or 0) >= 1,
                     f"quarantine.jsonl records={q} "
                     f"quarantined_steps={rec.get('quarantined_steps')}")
+        elif name == "governor_armed_echo":
+            f = phases["fit"]
+            arms = [r for r in f.get("governor") or []
+                    if r["action"] in ("arm_echo", "raise_echo")
+                    and r["applied"]]
+            factors = [(r.get("detail") or {}).get("factor")
+                       for r in arms]
+            verdict(name,
+                    bool(arms) and all(b > a >= 1 and b >= 2
+                                       for a, b in factors)
+                    and all(r["stall"] is not None
+                            and r["stall"] > r["target"] for r in arms),
+                    f"applied echo arms {factors} at stalls "
+                    f"{[r['stall'] for r in arms]} (want >= 1 applied "
+                    "arm with factor >= 2, decided above target)")
+        elif name == "stall_recovered_below_target":
+            f = phases["fit"]
+            feed = f.get("feed") or {}
+            frac, target = feed.get("input_wait_fraction"), \
+                feed.get("target")
+            verdict(name,
+                    frac is not None and target is not None
+                    and frac <= target,
+                    f"final windowed input_wait fraction {frac} vs "
+                    f"target {target}")
+        elif name == "echo_disarmed_after_clear":
+            f = phases["fit"]
+            recs = f.get("governor") or []
+            arm_ts = [r["ts"] for r in recs
+                      if r["action"] == "arm_echo" and r["applied"]]
+            disarms = [r for r in recs
+                       if r["action"] == "disarm_echo" and r["applied"]]
+            feed = f.get("feed") or {}
+            verdict(name,
+                    bool(arm_ts) and bool(disarms)
+                    and disarms[-1]["ts"] >= arm_ts[0]
+                    and not feed.get("echo_armed")
+                    and feed.get("echo_effective") == 1,
+                    f"arms at {arm_ts}, disarms at "
+                    f"{[r['ts'] for r in disarms]}, final echo "
+                    f"{feed.get('echo_effective')} "
+                    f"(armed={feed.get('echo_armed')})")
         elif name == "supervisor_recovered_each_crash":
             s = phases["supervise"]
             sup = s["supervisor"]

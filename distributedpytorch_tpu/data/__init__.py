@@ -2,6 +2,7 @@
 
 from . import guidance, transforms
 from .combine import CombinedDataset
+from .governor import GOVERNOR_MODES, FeedActuators, FeedGovernor, feed_block
 from .fake import make_fake_sbd, make_fake_voc
 from .sbd import SBDInstanceSegmentation, SBDSemanticSegmentation
 from .grain_pipeline import (GrainDataLoader, HAVE_GRAIN,
@@ -32,6 +33,10 @@ __all__ = [
     "CATEGORY_NAMES",
     "CombinedDataset",
     "DataLoader",
+    "FeedActuators",
+    "FeedGovernor",
+    "GOVERNOR_MODES",
+    "feed_block",
     "VOCInstanceSegmentation",
     "ensure_voc",
     "VOCSemanticSegmentation",

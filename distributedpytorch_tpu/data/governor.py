@@ -1,0 +1,422 @@
+"""Input-feed governor: the feedback loop from measured stall to actuation.
+
+Every mechanism the roadmap names for killing input stalls already exists
+as a *static, opt-in* knob — host/device prefetch depth, the on-device
+augmentation + guidance stages, the prepared-sample cache, data echoing —
+and the telemetry layer already measures ``input_wait`` as a first-class
+goodput bucket that nothing acts on.  The :class:`FeedGovernor` closes
+the loop: it watches the windowed stall fraction (a
+:class:`~..telemetry.goodput.FeedWindow` fed from the goodput snapshots
+the trainer already takes at the log cadence — no new host syncs) and
+works the knobs through an **escalation ladder with hysteresis**:
+
+1. **Hot prefetch resize** (any tick): double host + device prefetch
+   depth, bounded.  Cheap (host RAM / HBM for a few more in-flight
+   batches), reversible, and applies immediately — both prefetchers read
+   their depth live.
+2. **Device-path flip** (epoch boundaries — the recompile-safe seam):
+   move augmentation + guidance synthesis on device when the config
+   allows it (plain thread-loader pipeline, device-supported guidance
+   family).  When the config does NOT allow it (prepared cache / grain
+   loader / unsupported family), the governor logs a *recommendation*
+   naming the exact config keys instead — the operator's move, loudly.
+3. **Arm data echoing** (epoch boundaries): step each loaded batch
+   ``ceil(1 / (1 - stall))`` times (Choi et al., arXiv:1907.05550 — the
+   factor that recovers step throughput when the pipeline, not the chip,
+   is the bound), clamped to ``data.max_echo``.  Echoed steps are real
+   optimizer steps with fresh on-device augmentation randomness; later
+   boundaries may raise the factor (target-aware) while the stall
+   persists.
+4. **Disarm with hysteresis**: once the windowed stall holds below
+   ``disarm_factor x target`` for ``disarm_patience`` ticks, echo
+   returns to its configured base at the next boundary.  Flips are
+   never reverted (strictly better); prefetch stays raised (idle depth
+   is free).
+5. **Persistent shortfall**: stalled at the top of the ladder, the
+   governor reports loudly (stderr + ledger + counter) — never hidden.
+
+Modes (``data.governor``): ``off`` | ``observe`` (default — every
+decision is logged to ``run_dir/governor.jsonl`` and the registry, but
+nothing is actuated; the ladder advances *virtually* so the log shows
+the full would-be sequence) | ``auto`` (decisions applied).  ``auto``
+is single-process only: decisions derive from host wall-clock, which is
+not replicated, and hosts disagreeing about the echo factor would
+desynchronize collective step counts.
+
+FFCV's thesis (arXiv:2306.12517) is that data-bottleneck removal must
+be *measured*, not assumed — hence ``observe`` as the default, and the
+bench record's ``feed`` block + ``--check-regression`` gate as the
+mechanical form of the roadmap's "input_wait ≈ 0" acceptance.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+
+GOVERNOR_MODES = ("off", "observe", "auto")
+
+#: rung-1 bounds: prefetch depth doubles up to these caps (batches)
+MAX_HOST_PREFETCH = 8
+MAX_DEVICE_PREFETCH = 8
+
+#: ladder actions, as they appear in governor.jsonl / the actions counter
+ACTIONS = ("raise_prefetch", "flip_device_path", "recommend",
+           "arm_echo", "raise_echo", "disarm_echo", "shortfall")
+
+
+def echo_factor(stall: float, max_echo: int, current: int = 1,
+                target: float | None = None) -> int:
+    """The echo factor for a measured stall fraction.
+
+    Unarmed (``current == 1``): the Choi et al. arming factor
+    ``ceil(1 / (1 - stall))`` — each loaded batch stepped that many
+    times amortizes the per-batch wait over as many optimizer steps as
+    the stall ratio says were lost.  Already armed: the target-aware
+    escalation ``ceil(current * stall * (1 - target) / (target * (1 -
+    stall)))`` — the factor that brings the *armed* measurement (whose
+    waits are already amortized over ``current`` echoes) down to
+    ``target``.  Clamped to ``[current, max_echo]``; a stall at or past
+    1.0 pins the top.
+    """
+    max_echo = max(1, int(max_echo))
+    if stall >= 1.0:
+        return max_echo
+    if stall <= 0.0:
+        return max(1, int(current))
+    if current <= 1:
+        want = math.ceil(1.0 / (1.0 - stall))
+    else:
+        t = min(max(target if target is not None else 0.1, 1e-3), 0.999)
+        want = math.ceil(current * stall * (1.0 - t) / (t * (1.0 - stall)))
+    return max(max(1, int(current)), min(max_echo, int(want)))
+
+
+class FeedActuators:
+    """The knobs the governor works, duck-typed so tests can stub them.
+
+    The trainer implements this over its live feed state (host/device
+    prefetch depth, the effective echo factor, the device-path flip);
+    ``observe`` mode never calls the setters.  Every getter must be
+    cheap — they run at the tick cadence.
+    """
+
+    def get_prefetch(self) -> tuple[int, int]:
+        raise NotImplementedError
+
+    def set_prefetch(self, host: int, device: int) -> None:
+        raise NotImplementedError
+
+    def flip_available(self) -> tuple[bool, str]:
+        """(eligible, reason/recommendation).  ``reason`` names the
+        config keys the operator would flip when ineligible."""
+        raise NotImplementedError
+
+    def flip_device_path(self) -> None:
+        raise NotImplementedError
+
+    def get_echo(self) -> int:
+        raise NotImplementedError
+
+    def base_echo(self) -> int:
+        raise NotImplementedError
+
+    def can_set_echo(self) -> tuple[bool, str]:
+        raise NotImplementedError
+
+    def set_echo(self, factor: int) -> None:
+        raise NotImplementedError
+
+
+class FeedGovernor:
+    """Escalation-ladder controller over the windowed input-stall signal.
+
+    ``tick(busy_s, wait_s, ...)`` at the log cadence pushes one window
+    sample and may hot-apply rung 1; ``epoch_boundary(...)`` applies the
+    recompile-unsafe rungs (flip, echo) and the disarm.  Every decision
+    — applied or observed — lands as one JSONL line and one
+    ``train_governor_actions_total{action}`` increment; the rolling
+    stall fraction is published to the ``train_feed_stall_fraction``
+    gauge and the armed echo factor to ``train_feed_echo_armed``.
+    """
+
+    def __init__(self, mode: str, target: float,
+                 actuators: FeedActuators, *,
+                 max_echo: int = 4,
+                 window=None,
+                 jsonl_path: str | None = None,
+                 min_samples: int = 2,
+                 patience: int = 2,
+                 disarm_factor: float = 0.5,
+                 disarm_patience: int = 4,
+                 telemetry: bool = True,
+                 clock=time.time):
+        from ..telemetry.goodput import FeedWindow
+
+        if mode not in GOVERNOR_MODES:
+            raise ValueError(f"data.governor must be one of "
+                             f"{GOVERNOR_MODES}, got {mode!r}")
+        if not 0.0 < target < 1.0:
+            raise ValueError(
+                f"data.governor_target must be in (0, 1), got {target}")
+        if max_echo < 1:
+            raise ValueError(f"data.max_echo must be >= 1, got {max_echo}")
+        self.mode = mode
+        self.target = float(target)
+        self.actuators = actuators
+        self.max_echo = int(max_echo)
+        self.window = window if window is not None else FeedWindow()
+        self.jsonl_path = jsonl_path
+        self.min_samples = int(min_samples)
+        self.patience = int(patience)
+        self.disarm_factor = float(disarm_factor)
+        self.disarm_patience = int(disarm_patience)
+        self._telemetry = telemetry
+        self._clock = clock
+        # hysteresis counters: consecutive ticks above target / below the
+        # disarm threshold; the band between them holds both at zero
+        self._above = 0
+        self._below = 0
+        #: rung-1 state in observe mode advances virtually (the log shows
+        #: the full would-be ladder without touching the live knobs)
+        self._virtual_prefetch: tuple[int, int] | None = None
+        self._virtual_echo: int | None = None
+        self._flip_attempted = False
+        self._echo_armed = False
+        self._wants_escalation = False
+        self._shortfall = False
+        self.decisions: list[dict] = []
+        self.actions_count: dict[str, int] = {}
+
+    # ------------------------------------------------------------ helpers
+    @property
+    def applies(self) -> bool:
+        return self.mode == "auto"
+
+    def stall_fraction(self) -> float | None:
+        return self.window.stall_fraction()
+
+    def _get_prefetch(self) -> tuple[int, int]:
+        if not self.applies and self._virtual_prefetch is not None:
+            return self._virtual_prefetch
+        return self.actuators.get_prefetch()
+
+    def _get_echo(self) -> int:
+        if not self.applies and self._virtual_echo is not None:
+            return self._virtual_echo
+        return self.actuators.get_echo()
+
+    def _decide(self, action: str, *, step: int, epoch: int,
+                stall: float | None, applied: bool, detail) -> dict:
+        rec = {"ts": round(float(self._clock()), 3), "step": int(step),
+               "epoch": int(epoch), "action": action,
+               "applied": bool(applied),
+               "stall": (round(stall, 4) if stall is not None else None),
+               "target": self.target, "detail": detail}
+        self.decisions.append(rec)
+        self.actions_count[action] = self.actions_count.get(action, 0) + 1
+        if self.jsonl_path:
+            try:
+                with open(self.jsonl_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            except OSError as e:  # a full disk must not kill training
+                print(f"governor: could not append to {self.jsonl_path}: "
+                      f"{e}", file=sys.stderr)
+        if self._telemetry:
+            from ..telemetry import get_registry
+            from ..telemetry.registry import is_enabled
+
+            if is_enabled():
+                get_registry().counter(
+                    "train_governor_actions_total",
+                    "Feed-governor ladder decisions (data/governor.py)",
+                    labels={"action": action}).inc()
+        return rec
+
+    def _publish_gauges(self, stall: float | None) -> None:
+        if not self._telemetry:
+            return
+        from ..telemetry import get_registry
+        from ..telemetry.registry import is_enabled
+
+        if not is_enabled():
+            return
+        reg = get_registry()
+        if stall is not None:
+            reg.gauge("train_feed_stall_fraction",
+                      "Rolling input-stall fraction over the feed window"
+                      ).set(stall)
+        reg.gauge("train_feed_echo_armed",
+                  "Governor-armed echo factor (0 = not armed)"
+                  ).set(self._get_echo() if self._echo_armed else 0)
+
+    # --------------------------------------------------------------- tick
+    def tick(self, busy_s: float, wait_s: float, *, step: int,
+             epoch: int) -> None:
+        """One log-cadence observation: push the goodput delta, update
+        the hysteresis counters, and (rung 1) hot-resize prefetch."""
+        self.window.push(busy_s, wait_s)
+        stall = self.window.stall_fraction()
+        self._publish_gauges(stall)
+        if stall is None or len(self.window) < self.min_samples:
+            return
+        if stall > self.target:
+            self._above += 1
+            self._below = 0
+        elif stall < self.target * self.disarm_factor:
+            self._below += 1
+            self._above = 0
+        else:  # hysteresis band: hold
+            self._above = 0
+            self._below = 0
+        if self._above >= self.patience:
+            self._above = 0
+            host, dev = self._get_prefetch()
+            if host < MAX_HOST_PREFETCH or dev < MAX_DEVICE_PREFETCH:
+                # never below current: an operator-configured depth
+                # above the governor's cap stays put (the raise rung
+                # must not SHRINK the pipeline mid-stall)
+                new = (max(host, min(MAX_HOST_PREFETCH, max(1, host) * 2)),
+                       max(dev, min(MAX_DEVICE_PREFETCH, max(1, dev) * 2)))
+                if self.applies:
+                    self.actuators.set_prefetch(*new)
+                else:
+                    self._virtual_prefetch = new
+                self._decide(
+                    "raise_prefetch", step=step, epoch=epoch, stall=stall,
+                    applied=self.applies,
+                    detail={"host": [host, new[0]], "device": [dev, new[1]]})
+            else:
+                # rung 1 exhausted: the recompile-unsafe rungs wait for
+                # the epoch boundary
+                self._wants_escalation = True
+
+    # ---------------------------------------------------------- boundary
+    def epoch_boundary(self, *, epoch: int, step: int) -> list[dict]:
+        """The recompile-safe seam: flip / arm / raise / disarm echo.
+        Returns the decisions made at this boundary."""
+        made: list[dict] = []
+        stall = self.window.stall_fraction()
+
+        def decide(action, applied, detail):
+            made.append(self._decide(action, step=step, epoch=epoch,
+                                     stall=stall, applied=applied,
+                                     detail=detail))
+
+        # a mid-epoch escalation request whose stall has since cleared
+        # (fault ended late in the epoch, window drained) is dropped —
+        # it must not shadow the disarm check below
+        wants = self._wants_escalation and stall is not None \
+            and stall > self.target
+        self._wants_escalation = False
+        if wants:
+            escalated = False
+            if not self._flip_attempted:
+                self._flip_attempted = True
+                ok, reason = self.actuators.flip_available()
+                if ok and self.applies:
+                    self.actuators.flip_device_path()
+                    decide("flip_device_path", True, reason)
+                    escalated = True  # give the flip an epoch to measure
+                elif ok:
+                    decide("flip_device_path", False, reason)
+                    escalated = True
+                else:
+                    # config does not allow the flip: recommend, loudly,
+                    # and fall through to the echo rung at THIS boundary
+                    decide("recommend", False, reason)
+            if not escalated:
+                can, why = self.actuators.can_set_echo()
+                cur = self._get_echo()
+                if not can:
+                    decide("shortfall", False,
+                           f"stall {stall:.2f} > target {self.target} at "
+                           f"the top of the ladder and echo is "
+                           f"unavailable ({why})")
+                    self._shout(stall, why)
+                else:
+                    want = echo_factor(stall, self.max_echo, current=cur,
+                                       target=self.target)
+                    if want > cur:
+                        if self.applies:
+                            self.actuators.set_echo(want)
+                        else:
+                            self._virtual_echo = want
+                        decide("arm_echo" if not self._echo_armed
+                               else "raise_echo", self.applies,
+                               {"factor": [cur, want],
+                                "max_echo": self.max_echo})
+                        self._echo_armed = True
+                    else:
+                        detail = (f"stall {stall:.2f} > target "
+                                  f"{self.target} with echo already at "
+                                  f"{cur}/{self.max_echo} — the ladder "
+                                  "is out of rungs (raise data.max_echo, "
+                                  "add loader workers, or move to a "
+                                  "prepared cache)")
+                        decide("shortfall", False, detail)
+                        self._shout(stall, detail)
+        if not wants and self._echo_armed \
+                and self._below >= self.disarm_patience:
+            base = self.actuators.base_echo()
+            cur = self._get_echo()
+            if self.applies:
+                self.actuators.set_echo(base)
+            else:
+                self._virtual_echo = base
+            decide("disarm_echo", self.applies,
+                   {"factor": [cur, base]})
+            self._echo_armed = False
+            self._shortfall = False
+            self._below = 0
+        self._publish_gauges(stall)
+        return made
+
+    def _shout(self, stall: float, detail: str) -> None:
+        """A shortfall the ladder cannot fix is reported loudly, never
+        hidden — once per escalation episode, not per boundary."""
+        if self._shortfall:
+            return
+        self._shortfall = True
+        print(f"governor: PERSISTENT INPUT SHORTFALL — windowed stall "
+              f"{stall:.2f} above target {self.target} with every rung "
+              f"exhausted ({detail})", file=sys.stderr, flush=True)
+
+    # ---------------------------------------------------------- reporting
+    def summary_block(self) -> dict:
+        """The fit-history / fit_summary ``feed`` block."""
+        return {
+            "mode": self.mode,
+            "target": self.target,
+            "input_wait_fraction": self.window.stall_fraction(),
+            "echo_effective": self.actuators.get_echo(),
+            "echo_armed": self._echo_armed,
+            "shortfall": self._shortfall,
+            "actions": dict(self.actions_count),
+        }
+
+
+def feed_block(goodput_report: dict | None, governor: str | None = None,
+               echo_effective: int | None = None) -> dict:
+    """The bench record's ``feed`` block — keys ALWAYS present (the PR 4
+    schema-stability convention), null-valued when off/unknowable.
+
+    ``input_wait_fraction`` is derived from a goodput report's buckets
+    (wait / (wait + step + compile)); ``governor`` names the governing
+    mode conditioning the record (null = ungoverned); ``echo_effective``
+    is the echo factor in effect (null when echoing is off/NA).
+    """
+    frac = None
+    buckets = (goodput_report or {}).get("buckets") or {}
+    busy = (buckets.get("step", 0.0) or 0.0) \
+        + (buckets.get("compile", 0.0) or 0.0)
+    wait = buckets.get("input_wait", 0.0) or 0.0
+    if busy + wait > 0:
+        frac = round(wait / (busy + wait), 4)
+    return {
+        "input_wait_fraction": frac,
+        "governor": governor,
+        "echo_effective": echo_effective,
+    }

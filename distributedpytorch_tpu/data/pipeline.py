@@ -460,20 +460,37 @@ class DataLoader:
         yield from self._iter_prefetched(batches)
 
     def _iter_prefetched(self, batches: list[np.ndarray]) -> Iterator[dict]:
-        out_q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        # The queue itself is unbounded; the prefetch bound is enforced
+        # below against the LIVE ``self.prefetch`` so the feed governor's
+        # hot resize (data/governor.py rung 1) takes effect mid-epoch:
+        # growing admits more batches immediately, shrinking just waits
+        # for the consumer to drain below the new bound — a shrink can
+        # never strand an already-full queue (queue.Queue's maxsize is
+        # fixed at construction, which is exactly why it isn't used as
+        # the bound here).
+        out_q: queue.Queue = queue.Queue()
         sentinel = object()
         stop = threading.Event()
+        # admission is condition-notified, not polled: the consumer's get
+        # wakes the producer the instant a slot drains (the latency a
+        # timed poll would add lands straight in input_wait); the wait
+        # timeout only backstops a bound grown by the governor while the
+        # consumer sits idle (no get, so no notify)
+        room = threading.Condition()
 
-        def put(item) -> bool:
+        def put_bounded(item) -> bool:
             """Bounded put that gives up when the consumer is gone — an
             abandoned iterator (early break / exception in the train loop)
-            must not leave the producer blocked forever on a full queue."""
-            while not stop.is_set():
-                try:
-                    out_q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
+            must not leave the producer blocked forever at the bound."""
+            with room:
+                while not stop.is_set():
+                    if out_q.qsize() < max(1, int(self.prefetch)):
+                        # single producer: qsize only shrinks
+                        # concurrently, so the bound check cannot
+                        # over-admit
+                        out_q.put(item)
+                        return True
+                    room.wait(0.1)
             return False
 
         def producer():
@@ -483,18 +500,24 @@ class DataLoader:
                         if stop.is_set():
                             return
                         samples = list(pool.map(self._load_one, idxs))
-                        if not put(collate(samples)):
+                        if not put_bounded(collate(samples)):
                             return
                 except BaseException as e:  # surface worker errors to consumer
-                    put(e)
+                    # UNbounded put: an error must reach the consumer
+                    # promptly even when the queue sits at the prefetch
+                    # bound — waiting for drain here is how a producer
+                    # death turns into a consumer deadlock
+                    out_q.put(e)
                 finally:
-                    put(sentinel)
+                    out_q.put(sentinel)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         try:
             while True:
                 item = out_q.get()
+                with room:
+                    room.notify()
                 if item is sentinel:
                     break
                 if isinstance(item, BaseException):
@@ -502,4 +525,6 @@ class DataLoader:
                 yield item
         finally:
             stop.set()
+            with room:
+                room.notify()
             t.join()

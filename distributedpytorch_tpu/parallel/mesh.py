@@ -257,7 +257,11 @@ def prefetch_to_device(batches, mesh: Mesh, size: int = 2,
 
     ``keys`` filters each dict to the device-bound arrays (eval batches
     carry ragged host-side lists that cannot be placed).  ``size=0``
-    degrades to synchronous per-step placement.
+    degrades to synchronous per-step placement.  ``size`` may also be a
+    zero-arg callable returning the CURRENT window depth — re-read every
+    iteration, so the feed governor's hot resize (data/governor.py)
+    applies mid-epoch: a grow admits deeper pipelining immediately, a
+    shrink just drains the window to the new bound (never below 1).
 
     Placement runs on a dedicated thread: ``device_put`` of a large batch
     is far from free on the calling thread (layout/copy work before the DMA
@@ -284,17 +288,18 @@ def prefetch_to_device(batches, mesh: Mesh, size: int = 2,
         batch = chaos_sites.fire("device/put", payload=batch)
         return shard_batch(mesh, batch)
 
-    if size <= 0:  # synchronous degradation
+    if not callable(size) and size <= 0:  # synchronous degradation
         for batch in batches:
             yield place(batch)
         return
+    live_size = size if callable(size) else (lambda: size)
 
     futures: collections.deque = collections.deque()
     with cf.ThreadPoolExecutor(max_workers=1) as pool:
         try:
             for batch in batches:
                 futures.append(pool.submit(place, batch))
-                if len(futures) > size:
+                while len(futures) > max(1, int(live_size())):
                     yield futures.popleft().result()
             while futures:
                 yield futures.popleft().result()

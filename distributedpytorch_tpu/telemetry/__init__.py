@@ -23,6 +23,7 @@ checkpoint manager, the evaluator and the serve front are already wired.
 from . import goodput, lowering, prometheus, registry, spans, trace
 from .goodput import (
     BUCKETS,
+    FeedWindow,
     GoodputAccountant,
     get_accountant,
     mfu_estimate,
@@ -35,7 +36,8 @@ from .spans import current_span, span
 from .trace import TraceCapture
 
 __all__ = [
-    "BUCKETS", "GoodputAccountant", "LoweredProgram", "MetricsRegistry",
+    "BUCKETS", "FeedWindow", "GoodputAccountant", "LoweredProgram",
+    "MetricsRegistry",
     "TraceCapture", "current_span", "get_accountant", "get_registry",
     "goodput", "is_enabled", "lower_cached", "lowering", "mfu_estimate",
     "peak_flops_for", "prometheus", "registry", "render_text",

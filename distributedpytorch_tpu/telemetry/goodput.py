@@ -31,6 +31,7 @@ estimate is always produced, labeled with its source.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
 import time
@@ -224,6 +225,14 @@ class GoodputAccountant:
             return _NOOP
         return _Account(self, bucket)
 
+    def snapshot(self) -> dict:
+        """Current per-bucket seconds, no derived fields, no publishing —
+        the cheap read the feed governor's tick differences against its
+        previous snapshot (one lock, one dict copy; safe at the log
+        cadence)."""
+        with self._lock:
+            return dict(self._seconds)
+
     # ------------------------------------------------------------- reporting
     def report(self, publish: bool = True) -> dict:
         """Breakdown since the last reset.  ``idle`` is derived (total -
@@ -258,6 +267,59 @@ class GoodputAccountant:
                       "fraction of wall-clock in productive steps"
                       ).set(rep["goodput"])
         return rep
+
+
+class FeedWindow:
+    """Bounded ring of per-tick ``(busy_s, input_wait_s)`` samples — the
+    windowed view of the input-stall signal the feed governor
+    (data/governor.py) acts on.
+
+    The source is the EXISTING exclusive goodput attribution: callers
+    difference :meth:`GoodputAccountant.snapshot` between ticks (the log
+    cadence the trainer already pays — no new host syncs) and push the
+    deltas here.  ``busy_s`` is productive device-side wall-clock of the
+    interval (step + compile); ``input_wait_s`` is host time blocked on
+    the data pipeline.  The rolling stall fraction is
+    ``sum(wait) / sum(wait + busy)`` over the ring — a per-step fraction
+    would whipsaw on echo/multi-step configs where waits land on a
+    subset of ticks.
+    """
+
+    def __init__(self, size: int = 16):
+        if size < 1:
+            raise ValueError(f"window size must be >= 1, got {size}")
+        self._ring: collections.deque = collections.deque(maxlen=int(size))
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def size(self) -> int:
+        return self._ring.maxlen
+
+    def push(self, busy_s: float, input_wait_s: float) -> None:
+        if busy_s < 0 or input_wait_s < 0:
+            # clock skew / reset between snapshots: drop, never poison
+            return
+        self._ring.append((float(busy_s), float(input_wait_s)))
+
+    def reset(self) -> None:
+        self._ring.clear()
+
+    def totals(self) -> tuple[float, float]:
+        """(busy_s, input_wait_s) summed over the ring."""
+        busy = sum(b for b, _ in self._ring)
+        wait = sum(w for _, w in self._ring)
+        return busy, wait
+
+    def stall_fraction(self) -> float | None:
+        """Rolling input-stall fraction over the ring; None until a
+        sample with nonzero tracked time lands."""
+        busy, wait = self.totals()
+        total = busy + wait
+        if total <= 0:
+            return None
+        return wait / total
 
 
 #: process-wide accountant (reset at each fit; checkpoint/eval wiring

@@ -163,6 +163,33 @@ class DataConfig:
                                         # bottleneck.  With device_augment
                                         # each echo draws fresh augmentation
                                         # randomness.
+    governor: str = "observe"           # input-feed governor
+                                        # (data/governor.py): off |
+                                        # observe (default: the ladder's
+                                        # decisions are logged to
+                                        # run_dir/governor.jsonl and the
+                                        # registry, nothing is actuated)
+                                        # | auto (decisions applied: hot
+                                        # prefetch resize, epoch-boundary
+                                        # device-path flip, auto-armed
+                                        # echo with hysteresis disarm).
+                                        # auto is single-process only —
+                                        # decisions derive from host
+                                        # wall-clock, which is not
+                                        # replicated across hosts.
+    governor_target: float = 0.1        # windowed input-stall fraction
+                                        # the governor keeps the feed
+                                        # under (and the bench feed
+                                        # gate's threshold)
+    governor_window: int = 16           # stall-window size in ticks
+                                        # (log-cadence samples); smaller
+                                        # reacts faster, larger resists
+                                        # transients
+    max_echo: int = 4                   # clamp for the governor's auto-
+                                        # armed echo factor
+                                        # (ceil(1/(1-stall)) capped here;
+                                        # a manually-set data.echo is
+                                        # never clamped)
 
 
 @dataclass

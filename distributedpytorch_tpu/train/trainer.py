@@ -41,6 +41,7 @@ from ..data import (
     build_train_transform,
     make_fake_voc,
 )
+from ..data.governor import GOVERNOR_MODES, FeedActuators, FeedGovernor
 from ..chaos import sites as chaos_sites
 from ..models import build_model
 from ..parallel import (
@@ -111,6 +112,52 @@ class _DivergenceDetected(RuntimeError):
             f"{len(batch_indices)} batch(es) to quarantine")
 
 
+class _TrainerFeedActuators(FeedActuators):
+    """The feed governor's knobs, bound to a live trainer (see
+    data/governor.py): prefetch depths resize hot (both prefetchers read
+    their bound live), the device-path flip and echo factor apply at
+    epoch boundaries only — the governor owns that discipline."""
+
+    def __init__(self, trainer: "Trainer"):
+        self._t = trainer
+
+    def get_prefetch(self) -> tuple[int, int]:
+        return self._t._host_prefetch, self._t._device_prefetch
+
+    def set_prefetch(self, host: int, device: int) -> None:
+        t = self._t
+        t._host_prefetch = int(host)
+        t._device_prefetch = int(device)
+        if hasattr(t.train_loader, "prefetch"):  # grain has no live bound
+            t.train_loader.prefetch = int(host)
+
+    def flip_available(self) -> tuple[bool, str]:
+        return self._t._feed_flip_available()
+
+    def flip_device_path(self) -> None:
+        self._t._flip_device_path()
+
+    def get_echo(self) -> int:
+        return self._t._echo
+
+    def base_echo(self) -> int:
+        return self._t.cfg.data.echo
+
+    def can_set_echo(self) -> tuple[bool, str]:
+        if self._t.cfg.data.steps_per_dispatch > 1:
+            return False, ("data.steps_per_dispatch > 1 packs distinct "
+                           "batches per dispatch — mutually exclusive "
+                           "with echo")
+        return True, ""
+
+    def set_echo(self, factor: int) -> None:
+        # takes effect at the next epoch (train_epoch reads it at entry);
+        # schedules were sized for the BASE echo, so a governor-armed
+        # factor shortens the poly/cosine horizon rather than extending
+        # it — constant LR (the default) is unaffected
+        self._t._echo = max(1, int(factor))
+
+
 class Trainer:
     """Build once, ``fit()`` to train, ``validate()`` to eval.
 
@@ -148,6 +195,26 @@ class Trainer:
                 "multi-class")
         if cfg.data.echo < 1:
             raise ValueError(f"data.echo must be >= 1, got {cfg.data.echo}")
+        if cfg.data.governor not in GOVERNOR_MODES:
+            raise ValueError(
+                f"data.governor must be one of {GOVERNOR_MODES}, got "
+                f"{cfg.data.governor!r}")
+        if cfg.data.max_echo < 1:
+            raise ValueError(
+                f"data.max_echo must be >= 1, got {cfg.data.max_echo}")
+        if cfg.data.governor == "auto":
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "data.governor=auto is single-process only: decisions "
+                    "derive from host wall-clock (not replicated), and "
+                    "hosts disagreeing about the echo factor would "
+                    "desynchronize collective step counts — use "
+                    "data.governor=observe on multi-host runs")
+            if not cfg.telemetry:
+                raise ValueError(
+                    "data.governor=auto needs telemetry=true: the goodput "
+                    "accountant's input_wait attribution IS the stall "
+                    "signal the governor acts on")
         if cfg.data.steps_per_dispatch < 1:
             raise ValueError(f"data.steps_per_dispatch must be >= 1, got "
                              f"{cfg.data.steps_per_dispatch}")
@@ -185,6 +252,17 @@ class Trainer:
             print(f"parallel.strategy=auto resolved to "
                   f"{self.plan.describe()}", flush=True)
         self.mesh = self.plan.make_mesh()
+
+        # --- live feed knobs (data/governor.py): the governor's
+        # actuation surface.  Config values seed them; the governor (auto
+        # mode) may move them — prefetch depths hot (both prefetchers
+        # read their bound live), echo at epoch boundaries only.
+        self._host_prefetch = cfg.data.prefetch
+        self._device_prefetch = cfg.data.device_prefetch
+        self._echo = cfg.data.echo
+        #: set when the governor's epoch-boundary flip moved augmentation
+        #: (+ guidance) on device mid-run
+        self._feed_flipped = False
 
         # --- data
         root = cfg.data.root
@@ -548,21 +626,8 @@ class Trainer:
         # into the compiled steps (live shardings — exactly what
         # create_train_state placed); the plan owns the threading rule.
         st_sh = self.plan.state_shardings(self.state, self.mesh)
-        augment = None
-        if cfg.data.device_augment or cfg.data.device_guidance:
-            from ..ops.augment import make_device_augment
-            guidance_fn = None
-            if cfg.data.device_guidance:  # validated above: instance task
-                from ..ops.guidance_device import make_device_guidance
-                guidance_fn = make_device_guidance(
-                    family=cfg.data.guidance, alpha=cfg.data.guidance_alpha)
-            augment = make_device_augment(  # host flip (+geom) disabled
-                hflip=cfg.data.device_augment,
-                scale_rotate=(cfg.data.device_augment
-                              and cfg.data.device_augment_geom),
-                rots=cfg.data.rots, scales=cfg.data.scales,
-                semantic=cfg.task == "semantic",
-                guidance_fn=guidance_fn)
+        augment = self._build_device_stage(cfg.data.device_augment,
+                                           cfg.data.device_guidance)
         # --- self-healing sentinel (train/sentinel.py; see fit()): built
         # before the steps because monitor_grads changes their outputs
         sc = cfg.sentinel
@@ -622,6 +687,22 @@ class Trainer:
         self._trace = TraceCapture(
             os.path.join(self.run_dir, "trace_on_demand")) \
             if (cfg.telemetry and self.is_main) else None
+        # --- input-feed governor (data/governor.py): closes the loop
+        # from the measured input_wait fraction to the pipeline knobs.
+        # Built on the main process only (auto mode is single-process by
+        # validation above; observe on secondary hosts would just write
+        # nothing).  Needs telemetry: the goodput snapshot deltas ARE its
+        # signal.  _feed_last holds the previous tick's snapshot.
+        from ..telemetry.goodput import FeedWindow
+        self._governor = FeedGovernor(
+            cfg.data.governor, cfg.data.governor_target,
+            _TrainerFeedActuators(self), max_echo=cfg.data.max_echo,
+            window=FeedWindow(cfg.data.governor_window),
+            jsonl_path=os.path.join(self.run_dir, "governor.jsonl"),
+            telemetry=True) \
+            if (cfg.data.governor != "off" and cfg.telemetry
+                and self.is_main) else None
+        self._feed_last: dict | None = None
         eval_preprocess = None
         if self._val_device_guidance:
             # prepared val ships bare image channels; append the guidance
@@ -992,6 +1073,147 @@ class Trainer:
         if self.is_main:
             self.writer.scalars(scalars, int(self.state.step))
 
+    # ------------------------------------------------------- feed governor
+    def _feed_tick(self, epoch: int, step: int) -> None:
+        """Log-cadence governor observation: difference the goodput
+        snapshot against the previous tick's and push the delta into the
+        stall window.  Only step/compile/input_wait move between ticks of
+        the train loop (eval/checkpoint book their own buckets), so the
+        fraction is a pure feed signal.  Pure perf_counter bookkeeping —
+        no host sync enters the loop."""
+        snap = get_accountant().snapshot()
+        last = self._feed_last
+        self._feed_last = snap
+        if last is None:
+            return
+        busy = (snap["step"] - last["step"]) \
+            + (snap["compile"] - last["compile"])
+        wait = snap["input_wait"] - last["input_wait"]
+        if busy + wait <= 0:
+            return
+        self._governor.tick(busy, wait, step=step, epoch=epoch)
+
+    def _feed_flip_available(self) -> tuple[bool, str]:
+        """Eligibility of the governor's rung-2 flip: move augmentation
+        (and, instance task, guidance synthesis — the expensive host
+        stage) on device at an epoch boundary.  Ineligible configs get
+        the reason as a RECOMMENDATION naming the config keys — the
+        governor logs it instead of acting."""
+        cfg = self.cfg
+        already = cfg.data.device_augment and (
+            cfg.task == "semantic" or cfg.data.device_guidance
+            or cfg.data.guidance == "none")
+        if already or self._feed_flipped:
+            return False, "on-device augmentation + guidance already active"
+        if cfg.data.coalesce_wire:
+            # unreachable today (coalesce_wire validation requires the
+            # prepared cache below) but load-bearing if that chain ever
+            # loosens: the dispatch loop runs the wire-built steps, and
+            # a flip-changed batch layout is refused mid-training
+            return False, (
+                "coalesce_wire packed the wire layout from the current "
+                "host pipeline — set data.device_augment/"
+                "data.device_guidance in the config instead")
+        if cfg.data.prepared_cache:
+            return False, (
+                "prepared cache owns the pipeline front — set "
+                "data.device_augment/data.device_guidance (and consider "
+                "data.uint8_transfer) in the config instead")
+        if cfg.data.loader != "threads":
+            return False, (
+                "grain loader builds its pipeline up front — set "
+                "data.device_augment/data.device_guidance in the config")
+        if cfg.task == "instance" and cfg.data.guidance != "none" \
+                and not cfg.data.device_guidance:
+            from ..ops.guidance_device import FAMILIES as _DEV_FAM
+            if cfg.data.guidance not in _DEV_FAM:
+                return False, (
+                    f"guidance family {cfg.data.guidance!r} has no device "
+                    f"implementation (supported: {_DEV_FAM}) — "
+                    "data.prepared_cache is the remaining lever")
+        what = "flip augmentation"
+        if cfg.task == "instance" and cfg.data.guidance != "none":
+            what += " + guidance synthesis"
+        return True, (f"move {what} on device "
+                      "(data.device_augment=true"
+                      + (", data.device_guidance=true"
+                         if cfg.task == "instance"
+                         and cfg.data.guidance != "none" else "") + ")")
+
+    def _build_device_stage(self, device_augment: bool,
+                            device_guidance: bool):
+        """The fused on-device augmentation (+ guidance synthesis) stage
+        for the compiled step, or None when both are off.  The ONE
+        constructor shared by the config path (build time) and the
+        governor's rung-2 flip — a config-enabled run and a
+        governor-flipped run must train through the identical stage."""
+        if not (device_augment or device_guidance):
+            return None
+        cfg = self.cfg
+        from ..ops.augment import make_device_augment
+
+        guidance_fn = None
+        if device_guidance:  # instance task only (validated at build)
+            from ..ops.guidance_device import make_device_guidance
+            guidance_fn = make_device_guidance(
+                family=cfg.data.guidance, alpha=cfg.data.guidance_alpha)
+        return make_device_augment(  # host flip (+geom) disabled
+            hflip=device_augment,
+            scale_rotate=device_augment and cfg.data.device_augment_geom,
+            rots=cfg.data.rots, scales=cfg.data.scales,
+            semantic=cfg.task == "semantic",
+            guidance_fn=guidance_fn)
+
+    def _flip_device_path(self) -> None:
+        """Apply the rung-2 flip (epoch boundary — the recompile-safe
+        seam): rebuild the host transform stacks with the flip/guidance
+        stages dropped, install the fused on-device stage, and rebuild
+        the compiled steps.  The next dispatch re-traces and books under
+        'compile' (the program keys are cleared below).  Val is
+        untouched: it keeps the deterministic host path it was built
+        with."""
+        ok, reason = self._feed_flip_available()
+        if not ok:
+            raise RuntimeError(f"device-path flip not available: {reason}")
+        cfg = self.cfg
+        dev_guidance = (cfg.task == "instance"
+                        and cfg.data.guidance != "none")
+        if cfg.task == "instance":
+            new_tf = build_train_transform(
+                crop_size=cfg.data.crop_size, relax=cfg.data.relax,
+                zero_pad=cfg.data.zero_pad, rots=cfg.data.rots,
+                scales=cfg.data.scales, alpha=cfg.data.guidance_alpha,
+                guidance="none" if dev_guidance else cfg.data.guidance,
+                flip=False, geom=not cfg.data.device_augment_geom,
+                fused_crop_resize=cfg.data.fused_crop_resize)
+        else:
+            new_tf = build_semantic_train_transform(
+                crop_size=cfg.data.crop_size, rots=cfg.data.rots,
+                scales=cfg.data.scales, flip=False,
+                geom=not cfg.data.device_augment_geom)
+
+        def set_transform(ds):
+            subs = getattr(ds, "datasets", None)
+            if subs is not None:  # CombinedDataset: per-constituent
+                for s in subs:
+                    set_transform(s)
+            elif hasattr(ds, "transform"):
+                ds.transform = new_tf
+
+        set_transform(self.train_set)
+        self._step_kwargs["augment"] = self._build_device_stage(
+            True, dev_guidance)
+        self.train_step, self.multi_train_step = self._build_steps()
+        # the rebuilt programs' first dispatch is a fresh trace+XLA —
+        # re-book it as 'compile', not a mysteriously slow 'step'
+        self._programs_seen.discard("plain1")
+        self._programs_seen.discard("plainK")
+        self._feed_flipped = True
+        if self.is_main:
+            print(f"governor: flipped augmentation"
+                  f"{' + guidance' if dev_guidance else ''} on device "
+                  "(host stages dropped; steps rebuilt)", flush=True)
+
     # ------------------------------------------------------------ IR audit
     def audit_programs(self, train_batch=None, val_batch=None) -> dict:
         """``{name: (fn, example_args)}`` for the EXACT jitted programs
@@ -1139,13 +1361,18 @@ class Trainer:
                 self._epoch_batch_order.append(idx)
                 yield batch
 
+        # the echo factor in effect for THIS epoch: the config's base, or
+        # the governor's armed factor (changed at epoch boundaries only,
+        # so it is stable across the epoch's accounting below)
+        echo = self._echo
+
         def echoed(it):
             # Data echoing (config.py: data.echo): repeat each already-placed
             # device batch — zero extra host decode or H2D traffic per echo;
             # the step's advancing RNG gives each echo fresh on-device
             # augmentation when enabled.
             for b in it:
-                for _ in range(cfg.data.echo):
+                for _ in range(echo):
                     yield b
 
         def waited(it):
@@ -1238,14 +1465,15 @@ class Trainer:
                 host_batches(), self.mesh,
                 # a multi-step dispatch consumes K placed batches at once;
                 # a window smaller than K would stall the chip on placement
-                # at every chunk boundary
-                size=max(cfg.data.device_prefetch,
-                         cfg.data.steps_per_dispatch),
+                # at every chunk boundary.  Read live (callable) so the
+                # governor's hot resize applies mid-epoch.
+                size=lambda: max(self._device_prefetch,
+                                 cfg.data.steps_per_dispatch),
                 keys=(WIRE_KEY,) if cfg.data.coalesce_wire
                 else DEVICE_KEYS,
                 transform=(self._pack_wire_transform
                            if cfg.data.coalesce_wire else None))
-            if cfg.data.echo > 1:
+            if echo > 1:
                 batches = echoed(batches)
             batches = waited(batches)
             # cadence comes from the guard itself (a caller-provided guard
@@ -1283,7 +1511,14 @@ class Trainer:
                     # together (loss is replicated, so they all see the
                     # same value) — a main-only raise would leave the other
                     # processes blocked forever at their next collective.
-                    loss_vec = np.atleast_1d(jax.device_get(loss))
+                    # Goodput: this sync pays the deferred device compute
+                    # of the steps dispatched since the last crossing —
+                    # productive step time (the epoch-end bulk-readback
+                    # convention), not idle.  The feed window's busy
+                    # delta depends on it: unbooked, a fully-overlapped
+                    # feed would read as a ~1.0 stall fraction.
+                    with acct.account("step"):
+                        loss_vec = np.atleast_1d(jax.device_get(loss))
                     if self._sentinel is not None:
                         # sentinel absorbs the isfinite watchdog: judge
                         # the latest dispatch against the current EMA
@@ -1301,7 +1536,14 @@ class Trainer:
                         if rep.diverged:
                             raise self._divergence(
                                 epoch, step0, rep, step, loss_vec)
-                    elif cfg.debug_asserts and \
+                    if self._governor is not None:
+                        # feed-governor tick (data/governor.py): one
+                        # goodput-snapshot delta into the stall window,
+                        # rung-1 prefetch resize may hot-apply.  Rides
+                        # the cadence the loop already pays — no extra
+                        # host sync.
+                        self._feed_tick(epoch, step)
+                    if self._sentinel is None and cfg.debug_asserts and \
                             not np.all(np.isfinite(loss_vec)):
                         # bf16 watchdog: surface divergence at the log
                         # cadence instead of training garbage for the rest
@@ -1390,7 +1632,8 @@ class Trainer:
             return float("nan")
         # Distinct images ingested — echoed repeats of a batch are not fresh
         # data; reporting them would make any echo setting look like a win.
-        n_imgs = steps_done * cfg.data.train_batch / cfg.data.echo
+        # `echo` is this epoch's LIVE factor (governor-armed included).
+        n_imgs = steps_done * cfg.data.train_batch / echo
         # An interrupted epoch logs no completed-epoch summary: its partial
         # mean would skew per-epoch curves, and the replayed epoch will log
         # the real one.
@@ -1417,7 +1660,12 @@ class Trainer:
         first = end_step - len(observed) + 1
         w0 = int(report.step)
         window = [float(x) for x in observed[w0 - first:]]
-        echo = max(1, self.cfg.data.echo)
+        # the LIVE echo factor (governor-armed included): each loader
+        # batch produced that many steps this epoch, so the step->batch
+        # index mapping must divide by it — and the quarantine skip then
+        # drops ALL echoes of a poisoned batch on replay (host_batches
+        # skips the index before the echo stage re-expands it)
+        echo = max(1, self._echo)
         order = self._epoch_batch_order
         idxs = sorted({
             order[j] for s in range(w0, end_step + 1)
@@ -1753,6 +2001,10 @@ class Trainer:
         # fit; with the env unset and nothing armed this is one getenv.
         chaos_sites.maybe_arm_from_env()
         self._prod_steps = 0
+        # the accountant's books were just zeroed: a snapshot from a
+        # previous fit would difference negative (FeedWindow drops
+        # negatives, but a fresh fit starts a fresh window)
+        self._feed_last = None
         with contextlib.ExitStack() as stack:
             if self._trace is not None:
                 stack.callback(self._trace.close)
@@ -1827,14 +2079,17 @@ class Trainer:
                                        # what an earlier partial run of this
                                        # same epoch already consumed
                                        "epoch_steps_done":
-                                           sb * cfg.data.echo
+                                           sb * self._echo
                                            + (step - estep0),
                                        # the batch order's identity; a
                                        # change in any of these makes the
                                        # offset stale -> _resume falls back
-                                       # to replay
+                                       # to replay.  The LIVE echo: a
+                                       # governor-armed factor differs from
+                                       # the resumed config's base, so the
+                                       # resume safely replays the epoch.
                                        "num_shards": jax.process_count(),
-                                       "echo": cfg.data.echo,
+                                       "echo": self._echo,
                                        "train_batch": cfg.data.train_batch,
                                        "seed": cfg.seed,
                                        "preempted": True})
@@ -1844,6 +2099,12 @@ class Trainer:
                             {"preempted_at_epoch": epoch}, step)
                     break
                 history["train_loss"].append(epoch_loss)
+                if self._governor is not None:
+                    # the recompile-safe seam: device-path flip / echo
+                    # arm / hysteresis disarm land BETWEEN epochs, before
+                    # validation (val books its own goodput bucket, so it
+                    # never pollutes the stall window either way)
+                    self._governor.epoch_boundary(epoch=epoch, step=step)
                 if self._rollback_breaker is not None:
                     # a cleanly completed epoch closes the rollback
                     # breaker: the budget bounds CONSECUTIVE rollbacks,
@@ -1897,6 +2158,12 @@ class Trainer:
                         if self._rollback_seconds else None))
             else:
                 history["recovery"] = None
+            # feed block (data/governor.py): the governor's summary —
+            # windowed stall fraction, effective echo, the action tally.
+            # Key always present; None when the governor is off (the
+            # recovery-block convention).
+            history["feed"] = (self._governor.summary_block()
+                               if self._governor is not None else None)
             if self.is_main:
                 # fit_summary.json: the one file a SUPERVISOR (or operator)
                 # can classify an exited run by without Orbax — written
@@ -1911,6 +2178,7 @@ class Trainer:
                      "epochs": cfg.epochs,
                      "epochs_recorded": len(history["train_loss"]),
                      "recovery": history["recovery"],
+                     "feed": history["feed"],
                      # the resolved plan this run actually trained under
                      # (under strategy=auto, the ladder's pick)
                      "plan": self.plan.block()})

@@ -22,7 +22,11 @@
 #      parallel/plan.py included — the sharding-strategy planner
 #      resolves every run's mesh + composed state layout, and its
 #      memory-model arithmetic must stay pure host code: no device
-#      touches, no traces at plan time) plus bench.py, the official
+#      touches, no traces at plan time; data/governor.py included —
+#      the feed governor's tick rides INSIDE the step loop at the log
+#      cadence, so it must stay pure perf-counter bookkeeping: no
+#      device touches, no host syncs, and its actuations must land
+#      only at the epoch-boundary seam) plus bench.py, the official
 #      record.
 #   2. jaxaudit check — IR-level compile contracts: the canonical
 #      train/eval/serve programs (incl. the session split's

@@ -401,6 +401,65 @@ class TestCheckRegression:
             assert "metric" in rec and "value" in rec
 
 
+class TestFeedBlock:
+    """The `feed` record block (data/governor.py) + the
+    --check-regression feed gate: ROADMAP item 2's "input_wait ≈ 0 on
+    the bench config" acceptance, made mechanical."""
+
+    def _record(self, feed):
+        return {"metric": "m", "value": 1.0, "platform": "cpu",
+                "feed": feed}
+
+    def test_feed_block_schema_stability(self):
+        from distributedpytorch_tpu.data.governor import feed_block
+
+        # keys ALWAYS present, null-valued when off (the PR 4 convention)
+        assert feed_block(None) == {"input_wait_fraction": None,
+                                    "governor": None,
+                                    "echo_effective": None}
+        blk = feed_block(
+            {"buckets": {"step": 7.0, "compile": 1.0, "input_wait": 2.0,
+                         "checkpoint": 99.0, "eval": 99.0}},
+            governor="observe", echo_effective=3)
+        # checkpoint/eval are not feed time: 2 / (7 + 1 + 2)
+        assert blk == {"input_wait_fraction": 0.2, "governor": "observe",
+                       "echo_effective": 3}
+        json.dumps(blk)
+
+    def test_ungoverned_record_passes_feed_gate(self):
+        ok, msg = bench.check_feed(self._record(
+            {"input_wait_fraction": 0.9, "governor": None,
+             "echo_effective": None}))
+        assert ok and "ungoverned" in msg
+        ok, _ = bench.check_feed(self._record(None))
+        assert ok  # serve records carry feed=null — never gated
+
+    def test_governed_record_gates_against_target(self):
+        ok, _ = bench.check_feed(self._record(
+            {"input_wait_fraction": 0.05, "governor": "observe",
+             "echo_effective": None}), target=0.1)
+        assert ok
+        ok, msg = bench.check_feed(self._record(
+            {"input_wait_fraction": 0.3, "governor": "observe",
+             "echo_effective": None}), target=0.1)
+        assert not ok and "above the" in msg
+
+    def test_governed_without_measurement_fails(self):
+        ok, msg = bench.check_feed(self._record(
+            {"input_wait_fraction": None, "governor": "auto",
+             "echo_effective": None}), target=0.1)
+        assert not ok and "no measured" in msg
+
+    def test_default_target_is_the_config_default(self):
+        from distributedpytorch_tpu.train.config import DataConfig
+
+        assert bench._governor_target() == DataConfig().governor_target
+
+    def test_env_overrides_target(self, monkeypatch):
+        monkeypatch.setenv("DPTPU_BENCH_GOVERNOR_TARGET", "0.03")
+        assert bench._governor_target() == 0.03
+
+
 class TestPrecisionBlock:
     def test_bench_precision_block_schema(self):
         # the bench stamps `precision` into every record: null when f32,

@@ -216,6 +216,10 @@ def guidance_cfg(work: str, **data_kw):
 
 
 class TestTrainerIntegration:
+    @pytest.mark.slow  # tier-1 budget (PR 10): the guidance-only fit
+    # (~8s); the composed fit below (test_e2e_device_guidance_with_
+    # device_augment) stays as the fast trainer gate, and the stage's
+    # bit-exactness keeps its unit pins above
     def test_e2e_device_guidance(self, tmp_path):
         from distributedpytorch_tpu.train import Trainer
 

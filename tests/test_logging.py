@@ -148,6 +148,9 @@ class TestJsonlWriter:
 
 
 class TestTrainerWiring:
+    @pytest.mark.slow  # tier-1 budget (PR 10): full trainer build just
+    # to check writer wiring (~7s); writer selection keeps its fast
+    # gate (TestMakeWriter.test_selects_each_backend)
     def test_log_writers_knob_builds_comet(self, tmp_path, fake_comet):
         import dataclasses
 

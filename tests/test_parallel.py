@@ -362,6 +362,10 @@ class TestMultiStepDispatch:
                         ).astype(np.float32)}) for _ in range(3)]
         return mesh, state, step, batches
 
+    @pytest.mark.slow  # tier-1 budget (PR 10): K-step scan-vs-
+    # sequential parity (~16s); the dispatch path keeps fast gates in
+    # test_prepared (test_steps_per_dispatch_smoke +
+    # test_fit_with_steps_per_dispatch + the boundary-logging pin)
     def test_k_steps_in_one_call_match_sequential(self):
         """THE semantics contract: K batches through the multi-step program
         == the same K batches through K single-step calls."""

@@ -367,6 +367,9 @@ class TestExactResumeFallbacks:
         assert tr2.start_epoch == 0
         tr2.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 10): one of three stale-
+    # offset fallback variants (~10s); test_changed_echo_falls_back_to
+    # _replay pins the same fallback decision path fast
     def test_changed_batch_falls_back_to_replay(self, tmp_path):
         cfg = tiny_cfg(tmp_path, **{"data.root": big_fake_root(tmp_path),
                                     "epochs": 2,

@@ -203,6 +203,9 @@ class TestTrainerSBDMerge:
         assert all(np.isfinite(l) for l in hist["train_loss"])
         tr.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 10): semantic merge fit
+    # (~7s); the instance merge fit above stays as the trainer gate and
+    # the exclusion logic keeps its dataset-level units
     def test_semantic_sbd_merge_trains_with_exclusion(self, tmp_path):
         """The semantic 'train_aug' recipe: VOC semantic train + SBD
         semantic (GTcls masks), VOC-val overlap excluded — through the

@@ -191,6 +191,10 @@ class TestFullResEval:
         tr_a.close()
         tr_b.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 10): fullres trainer fit
+    # (~9s); protocol correctness keeps its fast gate
+    # (test_fullres_matches_crop_when_sizes_equal + the ragged-gt
+    # batch contract above)
     def test_fullres_trainer_e2e(self, tmp_path):
         import dataclasses
         cfg = apply_overrides(Config(), [
@@ -218,6 +222,9 @@ class TestFullResEval:
 
 
 class TestFCNSemantic:
+    @pytest.mark.slow  # tier-1 budget (PR 10): per-model fit (~6s),
+    # the encnet/ccnet rationale (PR 7); the semantic fit gate is
+    # test_fit_deeplab_semantic
     def test_fit_fcn_semantic(self, tmp_path):
         cfg = apply_overrides(Config(), [
             "task=semantic", "data.fake=true", "data.train_batch=4",
@@ -237,6 +244,11 @@ class TestFCNSemantic:
 
 
 class TestSemanticDeviceAugment:
+    @pytest.mark.slow  # tier-1 budget (PR 10): semantic device-augment
+    # fit (~7s); the composed grain+device-geom semantic fit
+    # (test_grain_augment.test_semantic_trainer_fit_with_device_geom)
+    # and the instance device-augment fit (test_train.TestDeviceAugment)
+    # stay as the fast gates
     def test_fit_semantic_with_device_augment(self, tmp_path):
         import dataclasses
         from distributedpytorch_tpu.data import make_fake_voc
@@ -339,6 +351,9 @@ class TestSemanticTTA:
         np.testing.assert_array_equal(base["per_class_iou"],
                                       flip["per_class_iou"])
 
+    @pytest.mark.slow  # tier-1 budget (PR 10): TTA trainer e2e (~9s);
+    # fast gates: test_trivial_tta_matches_base_exactly + the
+    # TestTTAPassStructure units
     def test_e2e_trainer_with_tta(self, tmp_path):
         tr = self._trained(tmp_path, overrides=(
             "eval_tta_scales=[0.5,1.0]", "eval_tta_flip=true",
@@ -404,6 +419,9 @@ class TestTTAPassStructure:
 
 
 class TestAuxHead:
+    @pytest.mark.slow  # tier-1 budget (PR 10): aux-head fit (~7s);
+    # fast gates: test_danet_rejects_aux_head + the multi-output loss
+    # weighting units (test_ops)
     def test_fit_deeplab_with_aux_head(self, tmp_path):
         cfg = apply_overrides(Config(), [
             "task=semantic", "data.fake=true", "data.train_batch=4",
@@ -446,6 +464,9 @@ class TestBf16ProbsWire:
         cfg = dataclasses.replace(cfg, work_dir=str(tmp_path / "runs"))
         return Trainer(cfg)
 
+    @pytest.mark.slow  # tier-1 budget (PR 10): bf16-vs-f32 val sweep
+    # (~8s); the wire dtype keeps its fast gates
+    # (test_config_knob_reaches_eval + test_bf16_wire_actually_ships_bf16)
     def test_bf16_tracks_f32_fullres_and_tta(self, tmp_path):
         from distributedpytorch_tpu.train.evaluate import evaluate_semantic
 

@@ -562,6 +562,47 @@ class TestTrainerRollback:
             tr.close()
 
 
+class TestEchoQuarantine:
+    """echo x sentinel interaction (the feed-governor PR's audit): a
+    divergence inside an echoed window must quarantine the LOADER batch
+    index, the replay must skip ALL of that batch's echoes (the skip
+    happens in host_batches, upstream of the echo expansion), and the
+    rollback step accounting must divide by the live echo factor."""
+
+    def test_quarantine_of_echoed_window_skips_all_echoes(self, tmp_path,
+                                                          rollback_voc):
+        from distributedpytorch_tpu.chaos import sites
+        from distributedpytorch_tpu.chaos.faults import FaultPlan
+        from distributedpytorch_tpu.chaos.runner import RecordingWriter
+        from distributedpytorch_tpu.train import Trainer
+
+        # echo=2: steps 1,2 echo batch 0; steps 3,4 echo batch 1; the
+        # nan at step 4 is batch 1's SECOND echo — the quarantine must
+        # still map it to loader index 1 (echo-aware division), and the
+        # replay must run neither of batch 1's echoes
+        plan = FaultPlan.from_dict({"seed": 0, "faults": [
+            {"site": "trainer/train_step", "kind": "nan", "at": [4]}]})
+        cfg = _rollback_cfg(tmp_path, rollback_voc,
+                            **{"data.echo": 2,
+                               "data.device_augment": True})
+        with sites.armed_plan(plan):
+            tr = Trainer(cfg, writers=RecordingWriter())
+            nb = len(tr.train_loader)
+            assert nb >= 2
+            history = tr.fit()
+            assert tr._quarantine == {0: {1}}  # loader index, not step
+            # replay trained every batch except index 1, each echoed
+            # twice: (nb - 1) * 2 optimizer steps in the final state
+            assert int(tr.state.step) == (nb - 1) * 2
+            assert history["recovery"]["rollbacks"] == 1
+            q = json.loads(open(os.path.join(
+                tr.run_dir, "quarantine.jsonl")).read().strip())
+            assert q["batch_indices"] == [1]
+            # the poisoned window covers the step the verdict tripped at
+            assert q["step_start"] == 4 and q["step_end"] == 4
+            tr.close()
+
+
 class TestScenariosEndToEnd:
     """The full self-healing acceptance scenarios through the real
     dptpu-chaos runner path."""

@@ -178,6 +178,9 @@ class TestTrainerWarmStart:
         tr.close()
         assert all(np.isfinite(l) for l in hist["train_loss"])
 
+    @pytest.mark.slow  # tier-1 budget (PR 10): error-path trainer
+    # build (~8s); the happy-path auto-detect trainer gate stays
+    # (test_trainer_auto_detects_torchvision_pth)
     def test_wrong_backbone_name_raises(self, tmp_path):
         torch = pytest.importorskip("torch")
         from distributedpytorch_tpu.train import (

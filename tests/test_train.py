@@ -546,6 +546,10 @@ class TestCompileWatchdogIntegration:
 
 
 class TestAutoResume:
+    @pytest.mark.slow  # tier-1 budget (PR 10): two-fit auto-resume
+    # e2e (~12s); explicit-path resume keeps the fast gate
+    # (test_resume_restores_exact_state) and resume=auto is exercised
+    # by every fit_resume/supervise chaos scenario
     def test_resume_auto_finds_latest_run(self, tiny_cfg):
         work = tiny_cfg.work_dir
         tr = Trainer(dataclasses.replace(tiny_cfg, epochs=1))

@@ -313,6 +313,10 @@ class TestTrainerIntegration:
         tr_plain.close()
         tr_fast.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 10): fullres x prepared-val
+    # composition (~7s); the fullres cache contract keeps its unit gate
+    # (TestSemanticEvalCache.test_fullres_gt_cached_exactly) and the
+    # crop-res prepared-val parity stays (test_semantic_val_parity)
     def test_semantic_fullres_val_parity(self, tmp_path):
         from distributedpytorch_tpu.data import make_fake_voc
         from distributedpytorch_tpu.train import Trainer
