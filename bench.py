@@ -119,6 +119,9 @@ from distributedpytorch_tpu.train.precision import (  # noqa: E402
     precision_block,
     precision_policy,
 )
+from distributedpytorch_tpu.train.elastic import (  # noqa: E402
+    elastic_block,
+)
 from distributedpytorch_tpu.train.sentinel import (  # noqa: E402
     recovery_block,
 )
@@ -429,6 +432,12 @@ def check_regression(record: dict, history: list | None = None,
              # trajectories — neither may baseline the other.  Null ==
              # the trivial dp default, so pre-planner history compares.
              and r.get("plan") == record.get("plan")
+             # ...and so does the elastic block: a record whose measured
+             # window absorbed supervisor re-plans (topology changes,
+             # plan-crossing restores) is a different regime than a
+             # static run — never a baseline for one.  Null == static
+             # (the default), so pre-elastic history still compares.
+             and r.get("elastic") == record.get("elastic")
              and not r.get("replayed_from_session_capture")]
     if not prior:
         return True, (f"no prior {record.get('metric')} record on "
@@ -652,6 +661,9 @@ def serve_bench():
     # present, all null — the bench's burst loop never runs Trainer.fit,
     # so there is no sentinel to roll anything back
     record["recovery"] = recovery_block()
+    # elastic block: a train-supervision concept, null on serve records
+    # — key always present (schema stability)
+    record["elastic"] = elastic_block()
     # precision block (train/precision.py): the compute regime the
     # served model actually runs (bf16 on TPU); null when f32 — key
     # always present (schema stability)
@@ -793,6 +805,7 @@ def serve_sessions_bench():
     record["feed"] = None  # train-side concept, null on serve records
     record["chaos"] = chaos_sites.active_scenario()
     record["recovery"] = recovery_block()  # null block; key stability
+    record["elastic"] = elastic_block()  # train-side concept; key present
     # precision block: the served model's compute regime; null when f32
     record["precision"] = precision_block(precision_policy(DTYPE))
     # plan block: train-side concept, null on serve records; key present
@@ -1023,6 +1036,15 @@ def main() -> None:
     # supervisor_restarts / recovery_p50_s — keys always present, null
     # when the sentinel is off (this synthetic step loop never arms it)
     record["recovery"] = recovery_block()
+    # elastic block (train/elastic.py): {topology_changes, replans,
+    # recovery_p50_s} when an elastic supervisor re-planned the run
+    # this record measures, null otherwise — key ALWAYS present (the
+    # recovery-block convention).  The bench's synthetic loop is never
+    # supervised, so this is null here; --check-regression's
+    # same-config filter keys on it, so an elastic-exercised record
+    # (its wall-clock carries re-plan recoveries) can never baseline
+    # the static trajectory.
+    record["elastic"] = elastic_block()
     # precision block (train/precision.py): the mixed-precision regime
     # the measured step ran under; null when f32 — key always present
     record["precision"] = precision_block(policy)

@@ -12,6 +12,9 @@ invariants::
                                              # to a COMMITTED checkpoint
     dptpu-chaos crash_loop                   # SIGKILL x3 -> supervisor
     dptpu-chaos preemption_storm             # SIGTERM storm -> exact chain
+    dptpu-chaos elastic_membership           # pod reshaped x3 -> re-plan
+                                             # + restore through the plan
+                                             # crossing, zero lost steps
     dptpu-chaos input_stall_recovery         # slow feed -> governor arms
                                              # echo -> recovers -> disarms
     dptpu-chaos my_scenario.json

@@ -263,6 +263,36 @@ SCENARIOS: dict[str, dict] = {
                        "restored_digest_matches_committed",
                        "completed_schedule"],
     },
+    # ELASTIC membership: the pod is reshaped under the run, three
+    # times — 8 devices -> preempted down to 4 -> down to 2 -> hosts
+    # re-added back to 8 — with a SIGTERM (the preempted-slice shape)
+    # killing each generation mid-epoch.  The elastic supervisor
+    # (train/elastic.py) must classify every exit topology_changed
+    # (NEVER crashed/crash_loop: a shrink must not count toward
+    # give-up), each restarted child re-resolves parallel.strategy=auto
+    # against ITS device set, restores THROUGH the plan crossing with
+    # the crossing announced, and across all four process generations
+    # the digest chain is unbroken and not one optimizer step is lost
+    # or duplicated — self-healing become self-scaling, no human in
+    # the loop.
+    "elastic_membership": {
+        "name": "elastic_membership",
+        "mode": "supervise",
+        "plan": {"seed": 0, "faults": [
+            {"site": "trainer/train_step", "kind": "sigterm",
+             "at": [4]}]},
+        "overrides": {"epochs": 2, "checkpoint.preempt_check_every": 1,
+                      "checkpoint.digest": True,
+                      "parallel.strategy": "auto"},
+        "params": {"big_dataset": True, "expected_topology_changes": 3,
+                   "device_schedule": [8, 4, 2, 8], "max_restarts": 8},
+        "invariants": ["topology_changed_each_exit",
+                       "replanned_each_change",
+                       "plan_crossings_announced",
+                       "exact_resume_chain",
+                       "restored_digest_matches_committed",
+                       "zero_lost_or_duplicated_steps_storm"],
+    },
     # Repeated SIGTERM across epochs: every wave stops gracefully
     # (consensus stop -> exact-resume checkpoint), the supervisor
     # restarts without backoff, and across the whole storm not one
@@ -478,6 +508,12 @@ def child_fit(spec_path: str) -> int:
         # fine (sharding-aware restore resharded), but only KNOWINGLY
         "plan": tr.plan.block(),
         "restored_meta_plan": tr.resume_meta.get("plan"),
+        # elastic evidence: did the trainer ANNOUNCE a plan/topology
+        # crossing at restore, and how many devices did this process
+        # actually see (elastic_membership asserts both; in the
+        # PREFLIGHT sidecar, because later generations get killed)
+        "plan_crossing": bool(tr.resume_plan_crossing),
+        "n_devices": int(tr.mesh.devices.size),
     }
     # Preflight sidecar, BEFORE fit: a supervised child that dies
     # mid-fit (sigkill faults) still leaves its restore evidence for
@@ -839,14 +875,24 @@ def _run_serve_swap(sc: dict, work_dir: str) -> dict:
 
 
 def _run_supervise(sc: dict, work_dir: str) -> dict:
-    """crash_loop / preemption_storm: a REAL supervisor
-    (train/supervise.Supervisor) drives chaos child processes.  Every
-    attempt is ``dptpu-chaos --child`` with its own spec/report pair and
-    ``resume=auto``; the armed plan rides in each spec, so per-process
-    visit schedules decide which attempts get struck (an attempt whose
-    remaining steps stay below the fault's visit index completes
-    cleanly — the storm ends by construction, not by disarming)."""
+    """crash_loop / preemption_storm / elastic_membership: a REAL
+    supervisor (train/supervise.Supervisor) drives chaos child
+    processes.  Every attempt is ``dptpu-chaos --child`` with its own
+    spec/report pair and ``resume=auto``; the armed plan rides in each
+    spec, so per-process visit schedules decide which attempts get
+    struck (an attempt whose remaining steps stay below the fault's
+    visit index completes cleanly — the storm ends by construction, not
+    by disarming).
+
+    Elastic knobs (``params``): ``device_schedule`` gives attempt k its
+    own forced device count (the membership-change simulation — attempt
+    k+1 seeing a different count IS the preempted/re-added slice) and
+    arms the supervisor's topology probe, so exits classify
+    ``topology_changed``; ``attempt_overrides`` merges per-attempt
+    config overrides (e.g. an explicit grow-into strategy) into that
+    attempt's spec."""
     from ..backend_health import pin_cpu8_topology
+    from ..train import elastic as elastic_lib
     from ..train.supervise import CrashLoopError, Supervisor
     from .policies import Retry
 
@@ -854,10 +900,15 @@ def _run_supervise(sc: dict, work_dir: str) -> dict:
     overrides = _maybe_big_dataset(params, dict(sc.get("overrides") or {}),
                                    work_dir)
     overrides["resume"] = "auto"  # harmless on attempt 0 (no prior run)
+    schedule = [int(n) for n in (params.get("device_schedule") or [])]
+    attempt_overrides = {int(k): v for k, v in
+                         (params.get("attempt_overrides") or {}).items()}
 
     def make_argv(attempt: int) -> list[str]:
+        ov = dict(overrides)
+        ov.update(attempt_overrides.get(attempt) or {})
         spec = {"phase": f"attempt{attempt}", "plan": sc.get("plan"),
-                "overrides": overrides, "work_dir": work_dir,
+                "overrides": ov, "work_dir": work_dir,
                 "report": os.path.join(work_dir,
                                        f"report_attempt{attempt}.json")}
         path = os.path.join(work_dir, f"spec_attempt{attempt}.json")
@@ -871,6 +922,16 @@ def _run_supervise(sc: dict, work_dir: str) -> dict:
     env = pin_cpu8_topology(dict(os.environ))
     env.pop(sites.PLAN_ENV, None)  # the plan rides in the specs
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def attempt_env(attempt: int) -> dict | None:
+        if not schedule:
+            return None
+        n = schedule[min(attempt, len(schedule) - 1)]
+        # the flag grammar lives beside the probe's parser (one owner:
+        # train/elastic.py), so the knob we write is the knob it reads
+        return {"XLA_FLAGS": elastic_lib.force_device_count_flags(
+            env.get("XLA_FLAGS", ""), n)}
+
     sup = Supervisor(
         make_argv, work_dir=work_dir,
         max_restarts=int(params.get("max_restarts", 8)),
@@ -878,7 +939,13 @@ def _run_supervise(sc: dict, work_dir: str) -> dict:
         # test-scale naps: the schedule shape is Retry's, the constants
         # are not what the scenario asserts
         backoff=Retry(base_s=0.05, cap_s=0.2),
-        env=env, capture_output=True)
+        env=env, child_env=attempt_env if schedule else None,
+        # the topology probe reads the pinned-CPU env directly (no
+        # subprocess) — the same fast path a real elastic deployment
+        # skips, because its device set is the runtime's to report
+        topology_probe=(elastic_lib.probe_topology if schedule
+                        else None),
+        capture_output=True)
     try:
         sreport = sup.run()
     except CrashLoopError as e:
@@ -910,6 +977,12 @@ def _run_supervise(sc: dict, work_dir: str) -> dict:
     return {"phases": {"supervise": {
         "supervisor": sreport,
         "attempts": attempts,
+        # the supervisor's own classification ledger — what the
+        # elastic invariants read ("every exit topology_changed,
+        # never crash_loop" must hold in the DURABLE record, not just
+        # the in-memory report)
+        "events": _read_jsonl(work_dir, "supervisor.jsonl"),
+        "device_schedule": schedule,
     }}, "recovery_s": round(recovery_s, 3)}
 
 
@@ -1181,6 +1254,63 @@ def _check_one(name, sc, result, phases, verdict):
                     f"final attempt {last.get('attempt')}: "
                     f"final_step={last.get('final_step')} "
                     f"(want {expected}), preempted={last.get('preempted')}")
+        elif name == "topology_changed_each_exit":
+            s = phases["supervise"]
+            sup = s["supervisor"]
+            expected = int((sc.get("params") or {}).get(
+                "expected_topology_changes", 1))
+            ledger = [e for e in s.get("events") or []
+                      if e.get("event") == "topology_changed"]
+            bad = [e for e in s.get("events") or []
+                   if e.get("event") in ("crash", "gave_up")]
+            verdict(name,
+                    sup["outcome"] == "clean"
+                    and sup["restarts"]["topology_changed"] == expected
+                    and sup["restarts"]["crashed"] == 0
+                    and sup["restarts"]["preempted"] == 0
+                    and len(ledger) == expected and not bad,
+                    f"outcome={sup['outcome']} restarts={sup['restarts']} "
+                    f"ledger topology_changed={len(ledger)} "
+                    f"crash/gave_up events={len(bad)} (want {expected} "
+                    "topology_changed, zero crash classifications)")
+        elif name == "replanned_each_change":
+            s = phases["supervise"]
+            sup = s["supervisor"]
+            schedule = s.get("device_schedule") or []
+            changes = sup.get("topology_changes") or []
+            # every resumed generation's RESOLVED plan must name the
+            # device count its slot in the schedule gave it — the
+            # re-plan really happened against the new topology
+            mismatched = []
+            for a in s["attempts"]:
+                k = a.get("attempt", 0)
+                if k == 0 or "n_devices" not in a:
+                    continue
+                want = schedule[min(k, len(schedule) - 1)] \
+                    if schedule else None
+                if want is not None and a["n_devices"] != want:
+                    mismatched.append((k, a["n_devices"], want))
+            verdict(name,
+                    bool(changes) and all(c.get("replan") for c in changes)
+                    and not mismatched,
+                    f"topology_changes={changes} plan-vs-schedule "
+                    f"mismatches={mismatched}")
+        elif name == "plan_crossings_announced":
+            s = phases["supervise"]
+            # every resumed attempt whose plan differs from the plan
+            # the restored meta names must have ANNOUNCED the crossing
+            # (trainer.resume_plan_crossing, in the preflight sidecar —
+            # later generations get killed)
+            resumed = [a for a in s["attempts"]
+                       if a.get("restored_step", 0) > 0
+                       and a.get("restored_meta_plan") is not None]
+            silent = [a["attempt"] for a in resumed
+                      if a.get("plan") != a.get("restored_meta_plan")
+                      and not a.get("plan_crossing")]
+            verdict(name, bool(resumed) and not silent,
+                    f"{len(resumed)} resumed attempts, silent plan "
+                    f"crossings at attempts {silent} (every crossing "
+                    "must be announced at restore)")
         elif name == "preempted_each_wave":
             s = phases["supervise"]
             sup = s["supervisor"]

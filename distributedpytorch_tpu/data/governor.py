@@ -39,9 +39,18 @@ Modes (``data.governor``): ``off`` | ``observe`` (default — every
 decision is logged to ``run_dir/governor.jsonl`` and the registry, but
 nothing is actuated; the ladder advances *virtually* so the log shows
 the full would-be sequence) | ``auto`` (decisions applied).  ``auto``
-is single-process only: decisions derive from host wall-clock, which is
-not replicated, and hosts disagreeing about the echo factor would
-desynchronize collective step counts.
+decisions derive from host wall-clock, which is not replicated — so on
+multi-host runs every decision input routes through
+:func:`~..parallel.consensus.replicated_decision` (``consensus=True``,
+armed by the trainer): the stall fraction reduces by **max** across
+hosts (the most-starved host governs — it is the one gating the
+collective), the escalation request by **any**, and the hysteresis
+counters then advance identically everywhere, so hosts can never
+disagree about the echo factor and desynchronize collective step
+counts.  The consensus calls are collectives: every host must tick the
+governor at the same step cadence (the trainer's log-cadence crossing
+already guarantees it).  ``observe`` stays main-process-local — it
+actuates nothing, so there is nothing to agree on.
 
 FFCV's thesis (arXiv:2306.12517) is that data-bottleneck removal must
 be *measured*, not assumed — hence ``observe`` as the default, and the
@@ -65,6 +74,16 @@ MAX_DEVICE_PREFETCH = 8
 #: ladder actions, as they appear in governor.jsonl / the actions counter
 ACTIONS = ("raise_prefetch", "flip_device_path", "recommend",
            "arm_echo", "raise_echo", "disarm_echo", "shortfall")
+
+
+def governor_consensus(value, reduce: str, label: str):
+    """The governor's one door to :func:`replicated_decision`
+    (parallel/consensus.py) — a module seam so tests can simulate
+    divergent per-host inputs without processes.  Lazy import keeps
+    this module importable pre-jax."""
+    from ..parallel.consensus import replicated_decision
+
+    return replicated_decision(value, reduce=reduce, label=label)
 
 
 def echo_factor(stall: float, max_echo: int, current: int = 1,
@@ -152,6 +171,7 @@ class FeedGovernor:
                  disarm_factor: float = 0.5,
                  disarm_patience: int = 4,
                  telemetry: bool = True,
+                 consensus: bool = False,
                  clock=time.time):
         from ..telemetry.goodput import FeedWindow
 
@@ -174,6 +194,11 @@ class FeedGovernor:
         self.disarm_factor = float(disarm_factor)
         self.disarm_patience = int(disarm_patience)
         self._telemetry = telemetry
+        #: multi-host auto mode: decision inputs route through
+        #: replicated_decision so the ladder state is identical on every
+        #: host (see the module docstring).  Each tick/boundary then IS
+        #: a collective — the caller owes a replicated call cadence.
+        self.consensus = bool(consensus)
         self._clock = clock
         # hysteresis counters: consecutive ticks above target / below the
         # disarm threshold; the band between them holds both at zero
@@ -197,6 +222,21 @@ class FeedGovernor:
 
     def stall_fraction(self) -> float | None:
         return self.window.stall_fraction()
+
+    def _decided_stall(self, stall: float | None) -> float | None:
+        """The stall fraction the ladder acts on: the local window's
+        under single-host, the MAX across hosts under consensus (the
+        most-starved host is the one gating every collective — its
+        stall is the job's stall).  "No reading yet" encodes as -1 so a
+        host below min_samples still joins the allgather (every host
+        must make the same number of consensus calls) without vetoing
+        hosts that have one."""
+        if not self.consensus:
+            return stall
+        decided = float(governor_consensus(
+            -1.0 if stall is None else float(stall), "max",
+            "governor/stall"))
+        return None if decided < 0.0 else decided
 
     def _get_prefetch(self) -> tuple[int, int]:
         if not self.applies and self._virtual_prefetch is not None:
@@ -256,11 +296,19 @@ class FeedGovernor:
     def tick(self, busy_s: float, wait_s: float, *, step: int,
              epoch: int) -> None:
         """One log-cadence observation: push the goodput delta, update
-        the hysteresis counters, and (rung 1) hot-resize prefetch."""
-        self.window.push(busy_s, wait_s)
-        stall = self.window.stall_fraction()
-        self._publish_gauges(stall)
-        if stall is None or len(self.window) < self.min_samples:
+        the hysteresis counters, and (rung 1) hot-resize prefetch.
+
+        Under ``consensus`` a zero delta still ticks (the trainer calls
+        at the replicated cadence regardless) — the sample is dropped
+        but the host joins the stall allgather, so consensus calls stay
+        congruent across hosts."""
+        if busy_s + wait_s > 0:
+            self.window.push(busy_s, wait_s)
+        local = self.window.stall_fraction()
+        ready = local is not None and len(self.window) >= self.min_samples
+        stall = self._decided_stall(local if ready else None)
+        self._publish_gauges(stall if stall is not None else local)
+        if stall is None:
             return
         if stall > self.target:
             self._above += 1
@@ -298,7 +346,7 @@ class FeedGovernor:
         """The recompile-safe seam: flip / arm / raise / disarm echo.
         Returns the decisions made at this boundary."""
         made: list[dict] = []
-        stall = self.window.stall_fraction()
+        stall = self._decided_stall(self.window.stall_fraction())
 
         def decide(action, applied, detail):
             made.append(self._decide(action, step=step, epoch=epoch,
@@ -307,9 +355,15 @@ class FeedGovernor:
 
         # a mid-epoch escalation request whose stall has since cleared
         # (fault ended late in the epoch, window drained) is dropped —
-        # it must not shadow the disarm check below
-        wants = self._wants_escalation and stall is not None \
-            and stall > self.target
+        # it must not shadow the disarm check below.  Consensus: ANY
+        # host's escalation request escalates everywhere — the echo
+        # factor the rung sets must land identically on every host, or
+        # optimizer step counts desynchronize at the next epoch.
+        wants_esc = self._wants_escalation
+        if self.consensus:
+            wants_esc = bool(governor_consensus(
+                bool(wants_esc), "any", "governor/escalate"))
+        wants = wants_esc and stall is not None and stall > self.target
         self._wants_escalation = False
         if wants:
             escalated = False

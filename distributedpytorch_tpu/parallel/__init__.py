@@ -42,6 +42,11 @@ from .ring import (
     make_ring_attention_inline,
     ring_attention_local,
 )
+from .consensus import (
+    ConsensusError,
+    reduce_decision,
+    replicated_decision,
+)
 from .plan import (
     BUCKET_COMPATIBLE,
     STRATEGIES,
@@ -72,6 +77,9 @@ from .step import (
 __all__ = [
     "BUCKET_COMPATIBLE",
     "STRATEGIES",
+    "ConsensusError",
+    "reduce_decision",
+    "replicated_decision",
     "Plan",
     "PlanError",
     "auto_plan",

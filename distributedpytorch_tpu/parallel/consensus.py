@@ -1,0 +1,156 @@
+"""Replicated decisions: one allgather, one deterministic reduce, ONE answer.
+
+Several subsystems make host-local judgements that every process of a
+multi-host job must nonetheless AGREE on before the next collective:
+``parallel.strategy=auto`` resolves a plan from a locally-detected HBM
+budget (heterogeneous detection would compile different programs per
+host — a silent distributed deadlock), and the feed governor's ladder
+acts on a host-local stall fraction (hosts disagreeing about the echo
+factor desynchronize optimizer step counts).  The preemption guard
+solved the same problem for its stop flag with a tiny consensus
+allgather; this module is that idiom promoted to a primitive:
+
+    decided = replicated_decision(local_value, reduce="max")
+
+Every process contributes its local value, every process receives the
+full per-process list **in process-index order**, and every process
+applies the same deterministic reduce to it — so the decision is
+identical everywhere *by construction*, with no coordinator to elect,
+time out on, or partition away from (the reason this is an allgather
+and not a leader: the job's collectives already require every process
+to be live and in lockstep, so a leaderless symmetric decision adds no
+new failure mode).
+
+``reduce="same"`` is the verification form: it demands the inputs
+already agree and raises a loud :class:`ConsensusError` naming every
+process's value when they do not — for decisions that must never be
+papered over by averaging (e.g. two hosts resolving different plans).
+
+Contract (the ``PreemptionGuard.should_stop`` contract, restated):
+every process must call ``replicated_decision`` at the same program
+point with the same ``reduce`` — it is a collective.  Values must be
+JSON-encodable (the wire format; tuples come back as lists).  On a
+single-process job the gather degenerates to ``[value]`` and the reduce
+is applied unchanged, so callers route through the primitive
+unconditionally and the multi-host semantics are exercised everywhere.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Sequence
+
+
+class ConsensusError(RuntimeError):
+    """Per-process inputs diverged and the reduce cannot reconcile them
+    (``reduce="same"``) — the loud form of "these hosts are about to
+    desynchronize"."""
+
+    def __init__(self, label: str, values: Sequence[Any]):
+        self.label = label
+        self.values = list(values)
+        shown = ", ".join(f"p{i}={v!r}" for i, v in enumerate(values))
+        super().__init__(
+            f"replicated_decision({label!r}): per-process values diverged "
+            f"and reduce='same' cannot reconcile them: {shown[:800]}")
+
+
+def _same(label: str, values: list) -> Any:
+    # canonical JSON form: dict key order / int-vs-float spelling must
+    # not fake a divergence between genuinely-equal values
+    keys = [json.dumps(v, sort_keys=True) for v in values]
+    if any(k != keys[0] for k in keys[1:]):
+        raise ConsensusError(label, values)
+    return values[0]
+
+
+#: named reduces — each deterministic over the process-index-ordered
+#: gather, so every process computes the identical decision
+REDUCERS: dict[str, Callable[[list], Any]] = {
+    "max": max,
+    "min": min,
+    "sum": sum,
+    "mean": lambda vs: sum(vs) / len(vs),
+    "any": lambda vs: bool(any(vs)),
+    "all": lambda vs: bool(all(vs)),
+}
+
+
+def reduce_decision(values: Sequence[Any], reduce: str | Callable = "same",
+                    label: str = "decision") -> Any:
+    """The pure core: one decision from the gathered per-process values.
+
+    ``reduce`` is a name from :data:`REDUCERS`, ``"same"`` (verify the
+    values already agree; :class:`ConsensusError` otherwise), or a
+    deterministic callable ``list -> decision``.  Deterministic matters:
+    the gathered list is identical (same order) on every process, so a
+    deterministic reduce IS the consensus — a randomized one would
+    un-replicate the decision it exists to replicate."""
+    values = list(values)
+    if not values:
+        raise ValueError(f"replicated_decision({label!r}): empty gather")
+    if callable(reduce):
+        return reduce(values)
+    if reduce == "same":
+        return _same(label, values)
+    try:
+        fn = REDUCERS[reduce]
+    except KeyError:
+        raise ValueError(
+            f"unknown reduce {reduce!r} — one of "
+            f"{['same', *REDUCERS]} or a deterministic callable") from None
+    return fn(values)
+
+
+def gather_values(value: Any) -> list:
+    """Every process's ``value``, in process-index order, on every
+    process.  Single-process: ``[value]`` with no communication.
+
+    Multi-host wire: the JSON encoding rides two ``process_allgather``
+    calls — fixed-shape lengths first, then the byte payloads padded to
+    the global max (allgather needs congruent shapes; the length vector
+    is what makes the padding decodable)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return [value]
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(
+        json.dumps(value, sort_keys=True).encode(), np.uint8)
+    lengths = np.asarray(multihost_utils.process_allgather(
+        np.int32(payload.size))).reshape(-1)
+    buf = np.zeros(int(lengths.max()), np.uint8)
+    buf[:payload.size] = payload
+    rows = np.asarray(multihost_utils.process_allgather(buf))
+    rows = rows.reshape(lengths.size, -1)
+    return [json.loads(rows[p, :int(lengths[p])].tobytes().decode())
+            for p in range(lengths.size)]
+
+
+def replicated_decision(value: Any, reduce: str | Callable = "same", *,
+                        label: str = "decision",
+                        _gather: Callable[[Any], list] | None = None) -> Any:
+    """One decision, identical on every process: allgather ``value``
+    from all processes, apply the deterministic ``reduce``, return the
+    result (see module docstring for the contract).
+
+    ``_gather`` is the test seam: inject a fake per-process gather to
+    pin multi-host semantics without multiple processes."""
+    import contextlib
+
+    values = (_gather or gather_values)(value)
+    ctx = contextlib.nullcontext()
+    if len(values) > 1:
+        try:  # the allgather is a host sync: named in the trace like
+            # the preemption consensus, so its cost stays attributable
+            from ..telemetry import span
+            from ..telemetry.registry import is_enabled
+
+            if is_enabled():
+                ctx = span(f"consensus/{label}")
+        except Exception:
+            pass  # telemetry must never decide the decision's fate
+    with ctx:
+        return reduce_decision(values, reduce, label)

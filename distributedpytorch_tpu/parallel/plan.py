@@ -57,6 +57,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .consensus import replicated_decision
 from .mesh import DATA_AXIS, MODEL_AXIS, make_hybrid_mesh, make_mesh
 from .tp import tp_param_specs
 from .zero import zero_opt_specs
@@ -101,6 +102,35 @@ class PlanError(ValueError):
     not a wall."""
 
 
+def topology_fingerprint(n_devices: int | None = None) -> str:
+    """The live topology's identity, ``"<platform>:<n_devices>/p<procs>"``
+    (e.g. ``cpu:8/p1``) — what elastic membership change means: a plan
+    stamped with one fingerprint restored under another IS a topology
+    crossing, even when the *layout* normalizes equal (a legacy
+    ``data=None`` dp plan resolves to "all devices" on any topology, so
+    the layout alone cannot see a shrink).  Stamped into every
+    :meth:`Plan.block` the trainer resolves, and thereby into every
+    checkpoint meta and fit summary — the supervisor-side re-plan
+    trigger reads it without Orbax."""
+    import jax
+
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    return f"{devs[0].platform}:{int(n_devices)}/p{jax.process_count()}"
+
+
+def fingerprint_devices(fp) -> int | None:
+    """The device count a :func:`topology_fingerprint` names (None for
+    malformed/absent fingerprints) — lets cross-plan detection resolve a
+    saved ``data=None`` layout against the topology it was SAVED under,
+    not the one it is restoring onto."""
+    try:
+        return int(str(fp).split(":", 1)[1].split("/", 1)[0])
+    except (IndexError, ValueError):
+        return None
+
+
 @dataclasses.dataclass(frozen=True)
 class _AxisMesh:
     """Duck-typed stand-in for :class:`jax.sharding.Mesh` where only the
@@ -133,6 +163,11 @@ class Plan:
     model: int = 1
     slices: int = 1
     process_is_granule: bool | None = None
+    #: the topology this plan was resolved AGAINST
+    #: (:func:`topology_fingerprint`) — None for hand-built plans and
+    #: planning-only resolutions; ``plan_from_config`` (the trainer
+    #: entry) always stamps it, so live runs' metas carry it
+    topology: str | None = None
 
     @property
     def shard_params(self) -> bool:
@@ -157,6 +192,7 @@ class Plan:
             "slices": self.slices,
             "shard_params": self.shard_params,
             "shard_opt_state": self.shard_opt_state,
+            "topology": self.topology,
         }
 
     def describe(self) -> str:
@@ -358,6 +394,13 @@ def plan_from_config(cfg, n_devices: int | None = None,
     m = cfg.mesh
     if n_devices is None:
         n_devices = len(jax.devices())
+    # every trainer-resolved plan is stamped with the topology it was
+    # resolved against — the elastic restore path's crossing detector
+    # (see topology_fingerprint; planning-only resolve_plan/auto_plan
+    # calls stay unstamped, a CPU box planning a TPU pod has no live
+    # fingerprint to claim)
+    stamp = lambda plan: dataclasses.replace(  # noqa: E731
+        plan, topology=topology_fingerprint(n_devices))
     if not p.strategy:
         strategy = {(False, False): "dp", (True, False): "dp_tp",
                     (False, True): "dp_zero1", (True, True): "dp_tp_zero1"
@@ -370,9 +413,9 @@ def plan_from_config(cfg, n_devices: int | None = None,
         # legacy meshes may carry a model axis the params don't shard
         # over (ring PAM's sequence parallelism) — the plan records the
         # axis; the strategy names only the STATE layout
-        return Plan(strategy=strategy, data=m.data, model=m.model,
-                    slices=m.slices,
-                    process_is_granule=m.process_is_granule)
+        return stamp(Plan(strategy=strategy, data=m.data, model=m.model,
+                          slices=m.slices,
+                          process_is_granule=m.process_is_granule))
     if m.shard_params or m.shard_opt_state or m.model != 1 \
             or m.data is not None:
         raise PlanError(
@@ -396,15 +439,16 @@ def plan_from_config(cfg, n_devices: int | None = None,
                 "(state struct + batch bytes) — construct the plan via "
                 "Trainer, or call auto_plan() directly")
         state_struct, batch_bytes = memory_inputs()
-        return auto_plan(
+        return stamp(auto_plan(
             n_devices=n_devices, state_struct=state_struct,
             batch_bytes=batch_bytes, slices=m.slices,
             hbm_bytes=(int(p.hbm_budget_gb * 2**30)
                        if p.hbm_budget_gb else None),
-            process_is_granule=m.process_is_granule)
-    return resolve_plan(p.strategy, n_devices=n_devices, data=p.data,
-                   model=p.model, slices=m.slices,
-                   process_is_granule=m.process_is_granule)
+            process_is_granule=m.process_is_granule))
+    return stamp(resolve_plan(
+        p.strategy, n_devices=n_devices, data=p.data,
+        model=p.model, slices=m.slices,
+        process_is_granule=m.process_is_granule))
 
 
 def _divisors(n: int) -> list[int]:
@@ -424,6 +468,32 @@ def normalized_block(block: Mapping, n_devices: int) -> dict:
         if n_devices % (model * slices) == 0:
             out["data"] = n_devices // (model * slices)
     return out
+
+
+def plans_differ(saved: Mapping | None, live: Mapping | None,
+                 n_devices: int) -> bool:
+    """Does a restore from a checkpoint saved under ``saved`` into a run
+    planned as ``live`` cross plans?  The restore-announcement
+    discriminator (trainer + chaos invariants key on it).
+
+    Layouts compare in :func:`normalized_block` form — each side's
+    implicit ``data=None`` resolved against the topology IT names
+    (``saved`` against its own stamped fingerprint when present, so a
+    dp8 checkpoint restored on 4 devices never normalizes into a false
+    match), falling back to the live count.  The ``topology``
+    fingerprint joins the comparison only when BOTH sides carry one:
+    metas written before the fingerprint existed must not read as a
+    crossing on every resume."""
+    if not saved or not live:
+        return False
+    a = normalized_block(saved,
+                         fingerprint_devices(saved.get("topology"))
+                         or n_devices)
+    b = normalized_block(live, n_devices)
+    if a.get("topology") is None or b.get("topology") is None:
+        a.pop("topology", None)
+        b.pop("topology", None)
+    return a != b
 
 
 # --------------------------------------------------------- memory model
@@ -550,6 +620,16 @@ def auto_plan(n_devices: int, state_struct, batch_bytes: int,
     """
     if hbm_bytes is None:
         hbm_bytes = detect_hbm_bytes() or DEFAULT_HBM_BYTES
+    # CONSENSUS (parallel/consensus.py): the budget is DETECTED per
+    # host, and hosts walking the ladder against different budgets would
+    # resolve different plans — i.e. compile different collectives and
+    # deadlock at the first one.  The min across hosts is the binding
+    # constraint (a plan must fit the smallest chip), and the pure
+    # ladder walk below is then identical everywhere by construction.
+    # Single-process the gather is [hbm_bytes] and min is the identity —
+    # auto ALWAYS routes through the primitive.
+    hbm_bytes = int(replicated_decision(int(hbm_bytes), reduce="min",
+                                        label="plan/hbm_budget"))
     per_slice = n_devices // slices
     walked = []
     for model in _divisors(per_slice):
@@ -563,6 +643,12 @@ def auto_plan(n_devices: int, state_struct, batch_bytes: int,
                 activation_bytes=activation_bytes)
             walked.append((plan, mem["total"]))
             if mem["total"] <= hbm_bytes:
+                # the verification half: every host must have picked
+                # THIS rung — divergence here (a non-budget input
+                # differing per host) is a loud ConsensusError, never
+                # a silent per-host plan
+                replicated_decision(plan.block(), reduce="same",
+                                    label="plan/auto_rung")
                 return plan
     best_plan, best_bytes = min(walked, key=lambda x: x[1])
     raise PlanError(

@@ -282,7 +282,29 @@ class CheckpointManager:
             fresh = jax.tree.map(
                 lambda x: jnp.copy(x) if isinstance(x, jax.Array)
                 else x, restored["state"])
-            return fresh, restored["meta"]
+            meta = restored["meta"]
+            self._announce_topology_crossing(meta)
+            return fresh, meta
+
+    @staticmethod
+    def _announce_topology_crossing(meta) -> None:
+        """A checkpoint whose saved plan names a DIFFERENT topology than
+        the one restoring it is crossing a membership change — say so
+        loudly at the restore itself, so every consumer (Trainer resume,
+        Predictor.from_run, ad-hoc tooling) gets the announcement even
+        when it never compares plans.  The arrays are safe either way
+        (StandardRestore adopts the target layout); the loudness is the
+        contract — an elastic restore must never be silent."""
+        from ..parallel.plan import topology_fingerprint
+
+        saved = ((meta or {}).get("plan") or {}).get("topology")
+        if not saved:
+            return  # pre-fingerprint meta: nothing to compare
+        live = topology_fingerprint()
+        if saved != live and jax.process_index() == 0:
+            print(f"checkpoint: restoring across a topology change "
+                  f"({saved} -> {live}) — arrays reshard into the "
+                  "target state's layout", flush=True)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

@@ -173,10 +173,12 @@ class DataConfig:
                                         # prefetch resize, epoch-boundary
                                         # device-path flip, auto-armed
                                         # echo with hysteresis disarm).
-                                        # auto is single-process only —
-                                        # decisions derive from host
-                                        # wall-clock, which is not
-                                        # replicated across hosts.
+                                        # Multi-host auto routes every
+                                        # ladder input through the
+                                        # consensus primitive (stall =
+                                        # max across hosts, parallel/
+                                        # consensus.py), so all hosts
+                                        # take identical decisions.
     governor_target: float = 0.1        # windowed input-stall fraction
                                         # the governor keeps the feed
                                         # under (and the bench feed

@@ -1,0 +1,171 @@
+"""Elastic pod training: detect the topology change, re-plan, restore,
+continue.
+
+Every primitive this composes is individually chaos-proven — byte-
+identical cross-plan restore (``plan_mismatch_restore``: a dp8
+checkpoint reshards into dp4xtp2), supervisor restarts with
+``resume=auto`` (``crash_loop`` / ``preemption_storm``), zero-lost-step
+preemption resume — but until this module nothing *reacted to the
+topology itself changing*.  A preempted slice or a grown reservation
+killed the child and the supervisor restarted it into the same (now
+wrong, or gone) device set; surviving that took a human editing the
+mesh config.  Elastic supervision closes the loop:
+
+1. **Detect** — before every spawn the supervisor probes the topology
+   the NEXT child will see (:func:`probe_topology`, a stdlib
+   subprocess so the supervisor itself never imports jax).  A child
+   exit whose post-exit probe fingerprint differs from the one it was
+   launched under is classified ``topology_changed`` — a new exit
+   class in the restart ledger, distinct from ``crashed``: a shrink is
+   the scheduler reshaping the pod, not the run failing, so it resets
+   the crash-loop fingerprint count and never naps the backoff curve
+   (the give-up math must not starve a run to death for being
+   preempted off a slice three times).
+2. **Re-plan** — the restart carries ``parallel.strategy=auto`` (the
+   supervisor's ``replan_arg``, riding ``resume_overrides`` exactly as
+   the ``plan_mismatch_restore`` scenario proved end-to-end): the child
+   re-resolves the mesh-shape ladder against the devices it actually
+   has.  Multi-host, the resolution routes through
+   :func:`~..parallel.consensus.replicated_decision` — the detected
+   HBM budget reduces by min across hosts and the chosen rung is
+   verified identical everywhere — so every host compiles the SAME
+   plan or fails loudly, never a silent per-host mesh.
+3. **Restore** — ``resume=auto`` restores the newest committed
+   checkpoint THROUGH the plan crossing (Orbax adopts the target
+   layout; the saved meta's plan block — now stamped with a
+   :func:`~..parallel.plan.topology_fingerprint` — makes the crossing
+   detectable and loudly announced even when the *layout* normalizes
+   equal, e.g. dp-on-8 -> dp-on-4 with ``data=None``).
+4. **Continue** — exact-resume arithmetic is device-count-independent
+   (the loader shards per *process*, the global batch is config), so
+   not one optimizer step is lost or duplicated across the crossing.
+
+``dptpu-supervise --elastic`` arms all of it; the ``elastic_membership``
+chaos scenario is the acceptance gate (three unattended topology
+changes in one run, digest chain unbroken, every exit classified
+``topology_changed``).
+
+Deliberately importable before jax, like :mod:`supervise` — the
+supervisor must outlive anything that can take a device runtime down.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import subprocess
+import sys
+
+#: the bench/fit-summary ``elastic`` block's keys (schema-stable)
+ELASTIC_KEYS = ("topology_changes", "replans", "recovery_p50_s")
+
+#: the override an elastic restart appends so the child re-resolves its
+#: plan against the live topology (CLI ``--replan-arg`` overrides)
+DEFAULT_REPLAN_ARG = "parallel.strategy=auto"
+
+_FORCED_COUNT_RE = re.compile(
+    r"--xla_force_host_platform_device_count=(\d+)")
+
+#: the jax-importing probe child (stdlib parent, heavyweight child —
+#: the supervisor's process must never initialize a device runtime)
+_PROBE_SRC = (
+    "import json, jax\n"
+    "d = jax.devices()\n"
+    "print(json.dumps({'platform': d[0].platform,"
+    " 'n_devices': len(d),"
+    " 'process_count': jax.process_count()}))\n")
+
+
+def parse_forced_device_count(env: dict) -> int | None:
+    """The ``--xla_force_host_platform_device_count`` a child env pins
+    (the tests'/chaos' topology knob); None when unpinned."""
+    m = _FORCED_COUNT_RE.search(env.get("XLA_FLAGS", "") or "")
+    return int(m.group(1)) if m else None
+
+
+def force_device_count_flags(flags: str, n: int) -> str:
+    """``XLA_FLAGS`` with the forced-host-device count rewritten to
+    ``n`` (other flags preserved) — the write half of the flag grammar
+    :func:`parse_forced_device_count` reads, kept beside it so the
+    chaos runner's topology knob and the probe's fast path can never
+    drift apart."""
+    if _FORCED_COUNT_RE.search(flags or ""):
+        return _FORCED_COUNT_RE.sub(
+            f"--xla_force_host_platform_device_count={int(n)}", flags)
+    return ((flags or "")
+            + f" --xla_force_host_platform_device_count={int(n)}").strip()
+
+
+def fingerprint(info: dict) -> str:
+    """``"<platform>:<n_devices>/p<procs>"`` — the same identity
+    :func:`~..parallel.plan.topology_fingerprint` stamps into plan
+    blocks, computed from a probe report so the two surfaces compare."""
+    return (f"{info['platform']}:{int(info['n_devices'])}"
+            f"/p{int(info.get('process_count', 1))}")
+
+
+def probe_topology(env: dict | None = None,
+                   timeout_s: float = 180.0) -> dict:
+    """What topology would a child launched with ``env`` see?  Returns
+    ``{"platform", "n_devices", "process_count", "fingerprint"}``.
+
+    Pinned CPU topologies (``JAX_PLATFORMS=cpu`` + the forced-device-
+    count flag — the conftest/chaos idiom) are read straight from the
+    env: deterministic and free.  Anything else pays one throwaway
+    ``python -c "import jax; ..."`` subprocess (~seconds — amortized
+    against a child generation's lifetime), because the device set is
+    the runtime's to report, not the env's."""
+    env = dict(os.environ if env is None else env)
+    forced = parse_forced_device_count(env)
+    if forced and env.get("JAX_PLATFORMS") == "cpu" \
+            and "JAX_COORDINATOR_ADDRESS" not in env:
+        info = {"platform": "cpu", "n_devices": forced,
+                "process_count": 1}
+        info["fingerprint"] = fingerprint(info)
+        return info
+    out = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                         capture_output=True, text=True,
+                         timeout=timeout_s, env=env)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"topology probe exited {out.returncode}: "
+            f"{out.stderr[-500:]}")
+    info = json.loads(out.stdout.strip().splitlines()[-1])
+    info["fingerprint"] = fingerprint(info)
+    return info
+
+
+def _p50(xs) -> float | None:
+    """Nearest-rank median, stdlib (the supervisor may not import
+    numpy)."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    return round(float(s[max(0, math.ceil(0.5 * len(s)) - 1)]), 3)
+
+
+def elastic_block(report: dict | None = None) -> dict | None:
+    """The ``elastic`` record block for bench records / supervisor
+    reports: ``None`` when the supervisor never re-planned (the plan/
+    precision-block null convention — null means "the static default
+    regime", so elastic-exercised records never compare against static
+    history), else ``{topology_changes, replans, recovery_p50_s}``
+    with every key present.
+
+    ``report`` is a :meth:`~.supervise.Supervisor.run` report dict (or
+    None for the common static case)."""
+    if not report:
+        return None
+    changes = int((report.get("restarts") or {}).get(
+        "topology_changed", 0) or 0)
+    if not changes:
+        return None
+    events = report.get("topology_changes") or []
+    return {
+        "topology_changes": changes,
+        "replans": sum(1 for e in events if e.get("replan")),
+        "recovery_p50_s": _p50(report.get(
+            "topology_recovery_seconds") or []),
+    }

@@ -21,6 +21,17 @@ command as a CHILD, watches how it exits, and restarts it:
   give up loudly (:class:`CrashLoopError`).  Progress resets the count —
   a run that dies every hour but advances its committed step is limping,
   not looping, and restarts are exactly what it needs.
+* **topology_changed** — (elastic supervision, :mod:`train.elastic`:
+  ``topology_probe`` set) the child died AND the topology the next
+  child would see differs from the one it launched under: a preempted
+  slice, a shrunken or grown visible-device set.  Restarted
+  immediately with the ``replan_arg`` override appended
+  (``parallel.strategy=auto`` — the child re-resolves its plan against
+  the new devices and restores through the plan crossing).  A
+  topology change is the SCHEDULER reshaping the pod, not the run
+  failing, so it is distinct from ``crashed`` in the restart ledger
+  and resets the crash-loop fingerprint count — a shrink must never
+  count toward give-up.
 
 Progress is read from the checkpoint commit ledger
 (``run_*/checkpoints/COMMITTED.json``, plain JSON — no Orbax, no jax),
@@ -49,11 +60,13 @@ import time
 from typing import Callable, Sequence
 
 from ..chaos.policies import Retry
+from . import elastic as elastic_lib
 
 #: classification outcomes (the ``outcome`` field of run() reports)
 CLEAN = "clean"
 PREEMPTED = "preempted"
 CRASHED = "crashed"
+TOPOLOGY_CHANGED = "topology_changed"
 CRASH_LOOP = "crash_loop"
 GAVE_UP = "gave_up"
 
@@ -134,6 +147,15 @@ class Supervisor:
     commands on every RESTART — the knob that makes a plain
     ``dptpu-train`` command continue instead of starting over; callables
     own their resume handling and never get it.
+
+    ``topology_probe`` (``env -> info dict``, see
+    :func:`elastic.probe_topology`) arms ELASTIC supervision: exits
+    whose probed fingerprint moved are classified
+    ``topology_changed`` and restarted with ``replan_arg`` appended
+    (list-style commands; callables own their overrides, and the
+    report marks their re-plans as theirs).  Probe failures degrade to
+    the legacy classification, loudly — never a crash of the
+    supervisor itself.
     """
 
     def __init__(self, argv: Sequence[str] | Callable[[int], Sequence[str]],
@@ -147,7 +169,9 @@ class Supervisor:
                  env: dict | None = None,
                  child_env: Callable[[int], dict | None] | None = None,
                  capture_output: bool = True,
-                 telemetry: bool = True):
+                 telemetry: bool = True,
+                 topology_probe: Callable[[dict], dict] | None = None,
+                 replan_arg: str | None = None):
         if crash_loop_threshold < 1:
             raise ValueError(f"crash_loop_threshold must be >= 1, got "
                              f"{crash_loop_threshold}")
@@ -165,6 +189,12 @@ class Supervisor:
         self.child_env = child_env
         self.capture_output = capture_output
         self._telemetry = telemetry
+        self.topology_probe = topology_probe
+        self.replan_arg = replan_arg
+        #: set once a topology change has been observed: every later
+        #: restart keeps the re-plan override (the new topology is the
+        #: topology until it changes again)
+        self._replan = False
         self.events: list[dict] = []
 
     # --------------------------------------------------------------- pieces
@@ -174,7 +204,32 @@ class Supervisor:
         argv = list(self._argv)
         if attempt > 0 and self.resume_arg:
             argv.append(self.resume_arg)
+        if attempt > 0 and self._replan and self.replan_arg:
+            argv.append(self.replan_arg)
         return argv
+
+    def _child_env(self, attempt: int) -> dict:
+        """The exact env attempt ``attempt`` would run under — one
+        builder shared by :meth:`_spawn` and the topology probe, so the
+        probe can never see a different device set than the child."""
+        env = dict(self.env if self.env is not None else os.environ)
+        if self.child_env is not None:
+            extra = self.child_env(attempt)
+            if extra:
+                env.update(extra)
+        return env
+
+    def _probe(self, attempt: int) -> dict | None:
+        """Topology info for the env of ``attempt`` (None: probing off
+        or failed — failure is an event, never a supervisor death)."""
+        if self.topology_probe is None:
+            return None
+        try:
+            return self.topology_probe(self._child_env(attempt))
+        except Exception as e:
+            self._event("topology_probe_failed", attempt=attempt,
+                        error=f"{type(e).__name__}: {e}")
+            return None
 
     def _spawn(self, attempt: int) -> tuple[int, str]:
         """Run one child; returns ``(returncode, stderr_tail)``.
@@ -190,15 +245,11 @@ class Supervisor:
         import collections
         import threading
 
-        env = dict(self.env if self.env is not None else os.environ)
-        if self.child_env is not None:
-            extra = self.child_env(attempt)
-            if extra:
-                env.update(extra)
         proc = subprocess.Popen(
             self._argv_for(attempt),
             stdout=subprocess.DEVNULL if self.capture_output else None,
-            stderr=subprocess.PIPE, text=True, env=env)
+            stderr=subprocess.PIPE, text=True,
+            env=self._child_env(attempt))
         tail: collections.deque = collections.deque(maxlen=40)
 
         def drain() -> None:
@@ -260,11 +311,19 @@ class Supervisor:
         except Exception:
             pass
 
+    @staticmethod
+    def _finish(report: dict) -> dict:
+        """Stamp the schema-stable ``elastic`` block (null when no
+        membership change conditioned this supervision) on every way
+        out of :meth:`run` — return or give-up alike."""
+        report["elastic"] = elastic_lib.elastic_block(report)
+        return report
+
     # ------------------------------------------------------------------ run
     def run(self) -> dict:
         """Supervise to completion; returns the report dict.  Raises
         :class:`CrashLoopError` on give-up (report attached)."""
-        restarts = {PREEMPTED: 0, CRASHED: 0}
+        restarts = {PREEMPTED: 0, CRASHED: 0, TOPOLOGY_CHANGED: 0}
         loop_count = 0
         loop_t0: float | None = None
         last_fp: str | None = None
@@ -274,7 +333,17 @@ class Supervisor:
         report: dict = {"outcome": None, "attempts": 0,
                         "restarts": restarts, "crash_loop_count": 0,
                         "last_fingerprint": None,
-                        "recovery_seconds": []}
+                        "recovery_seconds": [],
+                        #: elastic supervision's ledger halves: one
+                        #: entry per membership change, and the
+                        #: downtime of exactly those restarts (the
+                        #: elastic block's recovery_p50_s source)
+                        "topology_changes": [],
+                        "topology_recovery_seconds": []}
+        # the topology attempt 0 will launch under — the baseline every
+        # exit's probe compares against (None: elastic detection off)
+        topo = self._probe(0)
+        topo_fp = topo.get("fingerprint") if topo else None
         while True:
             self._event("spawn", attempt=attempt,
                         argv=self._argv_for(attempt))
@@ -292,7 +361,7 @@ class Supervisor:
                         self._event("preempted_final", attempt=attempt - 1,
                                     summary=summary)
                         report["outcome"] = PREEMPTED
-                        return report
+                        return self._finish(report)
                     outcome = PREEMPTED
                 else:
                     if summary is None:
@@ -314,16 +383,54 @@ class Supervisor:
                     self._event("clean_exit", attempt=attempt - 1,
                                 summary=summary)
                     report["outcome"] = CLEAN
-                    return report
+                    return self._finish(report)
             else:
                 outcome = CRASHED
 
-            # ---- give-up checks before any restart
-            if attempt > self.max_restarts:
+            # ---- elastic: did the topology move underneath the child?
+            # The probe sees what the NEXT attempt would see; a moved
+            # fingerprint re-classifies this exit — whatever the rc —
+            # as topology_changed: restart immediately (no backoff),
+            # with the re-plan override, and WITHOUT advancing the
+            # crash-loop math (a shrink is the scheduler's act, and
+            # counting it toward give-up would starve a run off
+            # preemptible capacity — the economics this exists for).
+            new_topo = self._probe(attempt)
+            new_fp = new_topo.get("fingerprint") if new_topo else None
+            if topo_fp is None:
+                # the baseline probe failed at launch (transient): adopt
+                # the first fingerprint we DO get as the baseline — a
+                # permanently-None baseline would silently disable
+                # elastic detection for the whole run
+                topo_fp = new_fp
+            elif new_fp is not None and new_fp != topo_fp:
+                outcome = TOPOLOGY_CHANGED
+                # callable commands own their overrides (the chaos
+                # runner bakes strategy=auto into each attempt's spec);
+                # list commands get replan_arg appended from now on
+                replan = bool(self.replan_arg) or callable(self._argv)
+                self._replan = True
+                report["topology_changes"].append(
+                    {"attempt": attempt - 1, "old": topo_fp,
+                     "new": new_fp, "rc": rc, "replan": replan})
+                self._event("topology_changed", attempt=attempt - 1,
+                            rc=rc, old=topo_fp, new=new_fp,
+                            replan=replan)
+                topo_fp = new_fp
+
+            # ---- give-up checks before any restart.  topology_changed
+            # restarts are excluded from the budget on BOTH sides: the
+            # current exit never trips the cap, and past reshapes don't
+            # consume it — a long run on preemptible capacity may be
+            # reshaped arbitrarily often, and each reshape is the
+            # scheduler's act, not the run burning its restart budget.
+            if outcome != TOPOLOGY_CHANGED and \
+                    attempt - restarts[TOPOLOGY_CHANGED] \
+                    > self.max_restarts:
                 self._event("gave_up", reason="max_restarts",
                             attempts=attempt)
                 report["outcome"] = GAVE_UP
-                raise CrashLoopError(report)
+                raise CrashLoopError(self._finish(report))
             if outcome == CRASHED:
                 consecutive_crashes += 1
                 fp = self._fingerprint(rc, stderr_tail)
@@ -350,8 +457,19 @@ class Supervisor:
                     self._event("gave_up", reason="crash_loop",
                                 fingerprint=fp, count=loop_count)
                     report["outcome"] = CRASH_LOOP
-                    raise CrashLoopError(report)
+                    raise CrashLoopError(self._finish(report))
                 nap = self.backoff.backoff_s(consecutive_crashes)
+            elif outcome == TOPOLOGY_CHANGED:
+                # the pod was reshaped, not the run broken: restart at
+                # once, and RESET the crash-loop bookkeeping — the old
+                # fingerprint described a topology that no longer
+                # exists, so identical-crash counting across the change
+                # would conflate two different worlds
+                consecutive_crashes = 0
+                loop_count = 0
+                loop_t0 = None
+                last_fp = None
+                nap = 0.0
             else:  # preempted: graceful, restart without backoff
                 consecutive_crashes = 0
                 loop_count = 0
@@ -362,6 +480,9 @@ class Supervisor:
             self.backoff.sleep(nap)
             downtime = time.monotonic() - exit_t
             report["recovery_seconds"].append(round(downtime, 3))
+            if outcome == TOPOLOGY_CHANGED:
+                report["topology_recovery_seconds"].append(
+                    round(downtime, 3))
             self._book(outcome, downtime)
             self._event("restart", attempt=attempt, reason=outcome,
                         downtime_s=round(downtime, 3))
@@ -399,6 +520,20 @@ def main(argv: list[str] | None = None) -> int:
                              "restart ('' disables); the default makes "
                              "dptpu-train continue from the newest "
                              "checkpoint")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic supervision (train/elastic.py): "
+                             "probe the topology around every child "
+                             "exit; a membership change is classified "
+                             "topology_changed (never a crash), "
+                             "restarted immediately with --replan-arg "
+                             "appended so the run re-resolves its "
+                             "parallel plan and restores through the "
+                             "plan crossing")
+    parser.add_argument("--replan-arg",
+                        default=elastic_lib.DEFAULT_REPLAN_ARG,
+                        help="override appended (with --elastic) to "
+                             "restarts after a topology change "
+                             "(default: parallel.strategy=auto)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the child command (prefix with -- )")
     args = parser.parse_args(argv)
@@ -415,6 +550,9 @@ def main(argv: list[str] | None = None) -> int:
         restart_on_preempt=not args.no_restart_on_preempt,
         backoff=Retry(base_s=args.backoff_base, cap_s=args.backoff_cap),
         resume_arg=args.resume_arg or None,
+        topology_probe=(elastic_lib.probe_topology if args.elastic
+                        else None),
+        replan_arg=(args.replan_arg or None) if args.elastic else None,
         capture_output=False)  # interactive: child logs stream through
     try:
         report = sup.run()

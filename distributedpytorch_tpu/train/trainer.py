@@ -202,19 +202,16 @@ class Trainer:
         if cfg.data.max_echo < 1:
             raise ValueError(
                 f"data.max_echo must be >= 1, got {cfg.data.max_echo}")
-        if cfg.data.governor == "auto":
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "data.governor=auto is single-process only: decisions "
-                    "derive from host wall-clock (not replicated), and "
-                    "hosts disagreeing about the echo factor would "
-                    "desynchronize collective step counts — use "
-                    "data.governor=observe on multi-host runs")
-            if not cfg.telemetry:
-                raise ValueError(
-                    "data.governor=auto needs telemetry=true: the goodput "
-                    "accountant's input_wait attribution IS the stall "
-                    "signal the governor acts on")
+        if cfg.data.governor == "auto" and not cfg.telemetry:
+            # auto is multi-host safe since the consensus primitive
+            # (parallel/consensus.py): every ladder input routes through
+            # replicated_decision, so hosts can never disagree about the
+            # echo factor — the old single-process-only restriction is
+            # lifted
+            raise ValueError(
+                "data.governor=auto needs telemetry=true: the goodput "
+                "accountant's input_wait attribution IS the stall "
+                "signal the governor acts on")
         if cfg.data.steps_per_dispatch < 1:
             raise ValueError(f"data.steps_per_dispatch must be >= 1, got "
                              f"{cfg.data.steps_per_dispatch}")
@@ -689,19 +686,31 @@ class Trainer:
             if (cfg.telemetry and self.is_main) else None
         # --- input-feed governor (data/governor.py): closes the loop
         # from the measured input_wait fraction to the pipeline knobs.
-        # Built on the main process only (auto mode is single-process by
-        # validation above; observe on secondary hosts would just write
-        # nothing).  Needs telemetry: the goodput snapshot deltas ARE its
-        # signal.  _feed_last holds the previous tick's snapshot.
+        # `observe` builds on the main process only (secondary hosts
+        # would just write nothing); multi-host `auto` builds on EVERY
+        # process — its actuations (the echo factor above all) must land
+        # identically everywhere, which is exactly what routing the
+        # ladder inputs through replicated_decision (consensus=True)
+        # guarantees.  The JSONL ledger stays main-only either way.
+        # Needs telemetry: the goodput snapshot deltas ARE its signal.
+        # _feed_last holds the previous tick's snapshot.
         from ..telemetry.goodput import FeedWindow
+        gov_auto = cfg.data.governor == "auto"
+        gov_multi = gov_auto and jax.process_count() > 1
         self._governor = FeedGovernor(
             cfg.data.governor, cfg.data.governor_target,
             _TrainerFeedActuators(self), max_echo=cfg.data.max_echo,
             window=FeedWindow(cfg.data.governor_window),
-            jsonl_path=os.path.join(self.run_dir, "governor.jsonl"),
+            jsonl_path=(os.path.join(self.run_dir, "governor.jsonl")
+                        if self.is_main else None),
+            # auto ALWAYS routes through the consensus primitive —
+            # single-process the gather is [value] and the reduce is an
+            # identity (no communication), so the multi-host semantics
+            # are the only semantics and never rot untested
+            consensus=gov_auto,
             telemetry=True) \
             if (cfg.data.governor != "off" and cfg.telemetry
-                and self.is_main) else None
+                and (self.is_main or gov_multi)) else None
         self._feed_last: dict | None = None
         eval_preprocess = None
         if self._val_device_guidance:
@@ -742,6 +751,10 @@ class Trainer:
         #: the restored checkpoint's meta dict (empty when not resumed) —
         #: the chaos runner's digest-continuity invariants read it
         self.resume_meta: dict = {}
+        #: True when the resume restored ACROSS a plan (or topology)
+        #: crossing — the elastic chaos scenario's "every restore
+        #: announced the crossing" evidence bit
+        self.resume_plan_crossing = False
         if cfg.checkpoint.warm_start:
             self._warm_start(cfg.checkpoint.warm_start,
                              cfg.checkpoint.warm_start_partial)
@@ -929,20 +942,27 @@ class Trainer:
         self.resume_meta = dict(meta)
         saved_plan = meta.get("plan")
         n_dev = self.mesh.devices.size
-        if saved_plan and (plan_lib.normalized_block(saved_plan, n_dev)
-                           != plan_lib.normalized_block(
-                               self.plan.block(), n_dev)):
+        if plan_lib.plans_differ(saved_plan, self.plan.block(), n_dev):
             # Cross-plan restore: StandardRestore adopts the TARGET
             # state's shardings, so the arrays land resharded into this
             # plan's layout (and restore's re-buffer pass keeps them
             # donation-safe) — announce it loudly; a silent layout
             # change under a resumed run is how garbage gets loaded.
+            # plans_differ also sees TOPOLOGY crossings the layout
+            # can't (a data=None dp plan normalizes equal on any
+            # device count) — the elastic shrink/grow path.
+            self.resume_plan_crossing = True
             if self.is_main:
+                saved_topo = (saved_plan or {}).get("topology")
+                topo = (f" across a topology change ({saved_topo} -> "
+                        f"{self.plan.topology})"
+                        if saved_topo and saved_topo != self.plan.topology
+                        else "")
                 print("cross-plan restore: checkpoint was saved under "
                       f"plan {saved_plan} and is resharding into "
                       f"{self.plan.block()} (strategy "
                       f"{saved_plan.get('strategy')} -> "
-                      f"{self.plan.strategy})", flush=True)
+                      f"{self.plan.strategy}){topo}", flush=True)
         self.resume_fallback_steps = list(mgr.last_restore_fallback)
         self.start_epoch = int(meta.get("epoch", 0)) + 1
         self.ckpt.best_metric = float(
@@ -1089,7 +1109,12 @@ class Trainer:
         busy = (snap["step"] - last["step"]) \
             + (snap["compile"] - last["compile"])
         wait = snap["input_wait"] - last["input_wait"]
-        if busy + wait <= 0:
+        if busy + wait <= 0 and not self._governor.consensus:
+            # zero-delta local tick: nothing to learn — but under
+            # consensus the tick still runs (its allgather is a
+            # collective every host must join at this cadence; the
+            # governor drops the empty sample itself, and FeedWindow
+            # still drops negative deltas from accountant resets)
             return
         self._governor.tick(busy, wait, step=step, epoch=epoch)
 

@@ -25,9 +25,16 @@
 #      touches, no traces at plan time; data/governor.py included —
 #      the feed governor's tick rides INSIDE the step loop at the log
 #      cadence, so it must stay pure perf-counter bookkeeping: no
-#      device touches, no host syncs, and its actuations must land
-#      only at the epoch-boundary seam) plus bench.py, the official
-#      record.
+#      device touches, no host syncs (consensus mode's allgather is
+#      the one sanctioned, cadence-bounded exception — the preemption
+#      guard's own contract), and its actuations must land only at
+#      the epoch-boundary seam; parallel/consensus.py +
+#      train/elastic.py included — replicated_decision is a host-sync
+#      collective whose call sites must stay OUTSIDE the canonical
+#      step programs (the checked-in cpu8 contracts pin exactly that:
+#      consensus allgathers never appear in a compiled step), and the
+#      elastic supervisor must stay a stdlib process that never
+#      imports jax) plus bench.py, the official record.
 #   2. jaxaudit check — IR-level compile contracts: the canonical
 #      train/eval/serve programs (incl. the session split's
 #      encode_step/decode_step, train_step_bf16 — the mixed-

@@ -376,6 +376,55 @@ class TestCheckRegression:
         ok, msg = bench.check_regression(fresh, hist)
         assert not ok and "BENCH_r02" in msg
 
+    def test_elastic_records_never_baseline_static_ones(self, tmp_path):
+        # an elastic-exercised record (its measured window absorbed
+        # supervisor re-plans) and a static record are different
+        # regimes — the filter keys on the elastic block; null == the
+        # static default, so pre-elastic history still compares
+        el = self._rec(30.0)
+        el["elastic"] = {"topology_changes": 3, "replans": 3,
+                         "recovery_p50_s": 2.1}
+        with open(tmp_path / "BENCH_r01.json", "w") as f:
+            json.dump({"parsed": el}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        # static record (elastic null): never gated by the elastic one
+        ok, msg = bench.check_regression(self._rec(10.0), hist)
+        assert ok and "nothing to compare" in msg
+        # the matching elastic record DOES gate
+        probe = self._rec(20.0)
+        probe["elastic"] = dict(el["elastic"])
+        ok, msg = bench.check_regression(probe, hist)
+        assert not ok and "regression" in msg
+        # and a pre-elastic record (no key at all) still gates a fresh
+        # static record whose elastic block is null
+        old = self._rec(67.5)
+        with open(tmp_path / "BENCH_r02.json", "w") as f:
+            json.dump({"parsed": old}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        fresh = self._rec(50.0)
+        fresh["elastic"] = None
+        ok, msg = bench.check_regression(fresh, hist)
+        assert not ok and "BENCH_r02" in msg
+
+    def test_elastic_block_schema(self):
+        # the block builder (train/elastic.py): null when no supervisor
+        # re-planned, the three schema keys when one did
+        from distributedpytorch_tpu.train.elastic import (
+            ELASTIC_KEYS,
+            elastic_block,
+        )
+
+        assert elastic_block() is None
+        assert elastic_block({"restarts": {"crashed": 2}}) is None
+        blk = elastic_block({
+            "restarts": {"topology_changed": 3},
+            "topology_changes": [{"replan": True}, {"replan": True},
+                                 {"replan": False}],
+            "topology_recovery_seconds": [1.5, 0.5, 2.5]})
+        assert set(blk) == set(ELASTIC_KEYS)
+        assert blk["topology_changes"] == 3 and blk["replans"] == 2
+        assert blk["recovery_p50_s"] == 1.5
+
     def test_strategy_env_is_a_non_default_config(self, monkeypatch):
         # DPTPU_BENCH_STRATEGY is an A/B knob: the regression gate must
         # skip it (a dp_tp run is a measurement, not a trajectory point)

@@ -1,23 +1,45 @@
-"""Elastic resume: a checkpoint written under one mesh layout restores under
-another.
+"""The elastic gate: topology change -> re-plan -> restore -> continue.
 
 The reference could not survive any topology change (its checkpoint was a raw
-``state_dict`` whose consumer hardcoded 4 GPUs, train_pascal.py:92,103).  Here
-the checkpoint stores abstract arrays and ``CheckpointManager.restore`` adopts
-the *target* state's shardings (checkpoint.py:112-129), so the same run can
-continue on a different device count or a different parallelism layout — the
-TPU-native equivalent of elastic recovery (SURVEY §5.3: absent in the
-reference).
+``state_dict`` whose consumer hardcoded 4 GPUs, train_pascal.py:92,103).  The
+seed half of this module pins the restore mechanics — a checkpoint written
+under one mesh layout restores under another (``CheckpointManager.restore``
+adopts the *target* state's shardings).  The elastic half (ISSUE 12) pins the
+whole composition around it:
+
+* :func:`replicated_decision` (parallel/consensus.py) — divergent per-host
+  inputs yield ONE identical decision on every host, and a reduce that
+  cannot reconcile fails loudly;
+* the plan's topology fingerprint + ``plans_differ`` — a shrink is a
+  crossing even when the *layout* normalizes equal;
+* the supervisor's ``topology_changed`` exit class — a reshaped pod is
+  never a crash, never counts toward give-up, and restarts with the
+  re-plan override;
+* the governor's consensus ladder — multi-host ``data.governor=auto``
+  takes identical actions everywhere;
+* the supervisor-driven shrink / grow / round-trip e2es — byte-identical
+  restored digests at every crossing and zero lost/duplicated optimizer
+  steps (slow; their fast gates are the classes above plus test_plan's
+  manager-level cross-plan restore test).
 """
 
 import dataclasses
+import json
 import os
+import sys
 
 import jax
 import numpy as np
 import pytest
 
+from distributedpytorch_tpu.parallel import plan as plan_lib
+from distributedpytorch_tpu.parallel.consensus import (
+    ConsensusError,
+    reduce_decision,
+    replicated_decision,
+)
 from distributedpytorch_tpu.train import Trainer
+from distributedpytorch_tpu.train import elastic as elastic_lib
 
 from test_train import make_tiny_cfg
 
@@ -105,3 +127,642 @@ class TestElasticResume:
         hist = tr2.fit()
         assert all(np.isfinite(l) for l in hist["train_loss"])
         tr2.close()
+
+
+# ------------------------------------------------- consensus primitive
+
+class TestReplicatedDecision:
+    """parallel/consensus.py: the acceptance pin — divergent per-host
+    inputs yield ONE identical decision on every host, and a reduce
+    that cannot reconcile errors loudly."""
+
+    def test_divergent_inputs_one_identical_decision(self):
+        # the same gathered list arrives (in process-index order) on
+        # every host; each host's local value is a different element —
+        # the decision must not depend on WHICH element is "mine"
+        gathered = [0.05, 0.6, 0.3]
+        decisions = [
+            replicated_decision(local, reduce="max",
+                                _gather=lambda _v: list(gathered))
+            for local in gathered]
+        assert decisions == [0.6, 0.6, 0.6]
+        assert replicated_decision(
+            2, reduce="min", _gather=lambda _v: [7, 2, 9]) == 2
+        assert replicated_decision(
+            False, reduce="any", _gather=lambda _v: [False, True]) is True
+
+    def test_same_reduce_raises_loudly_on_divergence(self):
+        with pytest.raises(ConsensusError) as e:
+            replicated_decision(
+                {"strategy": "dp"}, reduce="same", label="plan/auto_rung",
+                _gather=lambda _v: [{"strategy": "dp"},
+                                    {"strategy": "dp_zero1"}])
+        # the error names the label and every process's value
+        msg = str(e.value)
+        assert "plan/auto_rung" in msg and "dp_zero1" in msg \
+            and "p0=" in msg and "p1=" in msg
+
+    def test_same_reduce_canonicalizes_equal_values(self):
+        # dict key order / tuple-vs-list spelling must not fake a split
+        a = {"x": 1, "y": [2, 3]}
+        b = {"y": [2, 3], "x": 1}
+        assert replicated_decision(
+            a, reduce="same", _gather=lambda _v: [a, b]) == a
+
+    def test_single_process_is_identity(self):
+        # no fake gather: the REAL single-process path — callers route
+        # through the primitive unconditionally
+        assert replicated_decision(5, reduce="max") == 5
+        assert replicated_decision({"k": 1}, reduce="same") == {"k": 1}
+        assert replicated_decision(0.25, reduce="min") == 0.25
+
+    def test_reduce_table_and_errors(self):
+        assert reduce_decision([1, 2, 3], "sum") == 6
+        assert reduce_decision([1.0, 3.0], "mean") == 2.0
+        assert reduce_decision([True, True], "all") is True
+        assert reduce_decision([4], "same") == 4
+        assert reduce_decision([3, 1], lambda vs: sorted(vs)[0]) == 1
+        with pytest.raises(ValueError, match="unknown reduce"):
+            reduce_decision([1], "median")
+        with pytest.raises(ValueError, match="empty gather"):
+            reduce_decision([], "max")
+
+
+# -------------------------------------- topology fingerprint + crossing
+
+class TestTopologyFingerprint:
+    def test_trainer_entry_stamps_live_fingerprint(self):
+        from distributedpytorch_tpu.train import Config
+
+        blk = plan_lib.plan_from_config(Config()).block()
+        assert blk["topology"] == f"cpu:{len(jax.devices())}/p1"
+        # and the probe-side spelling (train/elastic.py) agrees — the
+        # two surfaces must compare
+        info = {"platform": "cpu", "n_devices": len(jax.devices()),
+                "process_count": 1}
+        assert elastic_lib.fingerprint(info) == blk["topology"]
+
+    def test_fingerprint_devices_parse(self):
+        assert plan_lib.fingerprint_devices("cpu:8/p1") == 8
+        assert plan_lib.fingerprint_devices("tpu:256/p32") == 256
+        assert plan_lib.fingerprint_devices(None) is None
+        assert plan_lib.fingerprint_devices("garbage") is None
+
+    def test_shrink_is_a_crossing_even_when_layout_normalizes_equal(self):
+        # the hole the fingerprint closes: a data=None dp plan resolves
+        # to "all devices" on ANY topology, so dp-on-8 -> dp-on-4 has
+        # equal normalized layouts — only the topology says it moved
+        base = {"strategy": "dp", "data": None, "model": 1, "slices": 1,
+                "shard_params": False, "shard_opt_state": False}
+        saved = dict(base, topology="cpu:8/p1")
+        live = dict(base, topology="cpu:4/p1")
+        assert plan_lib.normalized_block(dict(saved, topology=None), 4) \
+            == plan_lib.normalized_block(dict(live, topology=None), 4)
+        assert plan_lib.plans_differ(saved, live, n_devices=4)
+
+    def test_pre_fingerprint_meta_never_false_crosses(self):
+        # metas written before the fingerprint existed carry no
+        # topology — resuming one on the same layout must stay silent
+        old = {"strategy": "dp", "data": None, "model": 1, "slices": 1,
+               "shard_params": False, "shard_opt_state": False}
+        live = dict(old, topology="cpu:8/p1")
+        assert not plan_lib.plans_differ(old, live, n_devices=8)
+
+    def test_layout_crossings_still_detected(self):
+        dp = plan_lib.resolve_plan("dp", 8).block()
+        tp = plan_lib.resolve_plan("dp_tp", 8, model=2).block()
+        assert plan_lib.plans_differ(dp, tp, n_devices=8)
+        assert not plan_lib.plans_differ(dp, dict(dp), n_devices=8)
+
+    def test_saved_data_resolves_against_saved_topology(self):
+        # a dp8 checkpoint with data=None restoring onto 4 devices:
+        # the saved side must normalize against ITS 8, not the live 4
+        saved = {"strategy": "dp", "data": None, "model": 1, "slices": 1,
+                 "shard_params": False, "shard_opt_state": False,
+                 "topology": "cpu:8/p1"}
+        live = {"strategy": "dp", "data": 4, "model": 1, "slices": 1,
+                "shard_params": False, "shard_opt_state": False,
+                "topology": "cpu:4/p1"}
+        assert plan_lib.plans_differ(saved, live, n_devices=4)
+
+
+class TestAutoPlanConsensus:
+    """strategy=auto routes its decisions through replicated_decision
+    (the multi-host-shaped acceptance pin, no processes needed — the
+    consensus seam is monkeypatched to simulate the other hosts)."""
+
+    @pytest.fixture(scope="class")
+    def struct(self):
+        import optax
+
+        from distributedpytorch_tpu.models import build_model
+        from distributedpytorch_tpu.parallel import create_train_state
+
+        model = build_model("danet", nclass=1, backbone="resnet18",
+                            output_stride=8)
+        tx = optax.sgd(1e-3, momentum=0.9)
+        return jax.eval_shape(lambda: create_train_state(
+            jax.random.PRNGKey(0), model, tx, (1, 64, 64, 4)))
+
+    def test_remote_hosts_smaller_budget_binds(self, struct, monkeypatch):
+        bb = 8 * 64 * 64 * 6 * 4
+        est_dp = plan_lib.estimate_plan_memory(
+            plan_lib.resolve_plan("dp", 8), struct, bb)["total"]
+        est_z1 = plan_lib.estimate_plan_memory(
+            plan_lib.resolve_plan("dp_zero1", 8), struct, bb)["total"]
+        assert est_z1 < est_dp
+        remote_budget = (est_z1 + est_dp) // 2  # fits zero1, not dp
+        seen = []
+
+        def fake(value, reduce="same", *, label="", _gather=None):
+            seen.append((label, reduce))
+            if label == "plan/hbm_budget":
+                # another host detected a smaller chip: min binds
+                return min(float(value), float(remote_budget))
+            return value
+
+        monkeypatch.setattr(plan_lib, "replicated_decision", fake)
+        # locally everything fits dp — the REMOTE budget must govern
+        p = plan_lib.auto_plan(8, struct, batch_bytes=bb,
+                               hbm_bytes=2 * est_dp)
+        assert p.strategy == "dp_zero1"
+        assert ("plan/hbm_budget", "min") in seen
+        assert ("plan/auto_rung", "same") in seen
+
+    def test_rung_divergence_is_loud(self, struct, monkeypatch):
+        def fake(value, reduce="same", *, label="", _gather=None):
+            if label == "plan/auto_rung":
+                raise ConsensusError(label, [value, {"strategy": "???"}])
+            return value
+
+        monkeypatch.setattr(plan_lib, "replicated_decision", fake)
+        with pytest.raises(ConsensusError, match="plan/auto_rung"):
+            plan_lib.auto_plan(8, struct,
+                               batch_bytes=8 * 64 * 64 * 6 * 4,
+                               hbm_bytes=2**40)
+
+
+# ------------------------------------------------- governor consensus
+
+class TestGovernorConsensus:
+    """data.governor=auto routes its ladder inputs through the
+    consensus seam: divergent per-host stalls -> identical actuation on
+    every host (the restriction ISSUE 12 lifts)."""
+
+    class _Stub:
+        """FeedActuators already at the rung-1 cap, flip-ineligible —
+        the first escalation lands on the echo rung."""
+
+        def __init__(self):
+            self.echo = 1
+            self.sets = []
+
+        def get_prefetch(self):
+            return (8, 8)
+
+        def set_prefetch(self, host, device):
+            self.sets.append(("prefetch", host, device))
+
+        def flip_available(self):
+            return False, "stub: no flip"
+
+        def flip_device_path(self):
+            self.sets.append(("flip",))
+
+        def get_echo(self):
+            return self.echo
+
+        def base_echo(self):
+            return 1
+
+        def can_set_echo(self):
+            return True, ""
+
+        def set_echo(self, f):
+            self.echo = int(f)
+            self.sets.append(("echo", int(f)))
+
+    def _gov(self, stub, fake):
+        from distributedpytorch_tpu.data import governor as governor_mod
+        from distributedpytorch_tpu.data.governor import FeedGovernor
+
+        gov = FeedGovernor("auto", 0.2, stub, max_echo=4,
+                           min_samples=1, patience=1,
+                           consensus=True, telemetry=False)
+        return gov, governor_mod
+
+    def test_divergent_host_stalls_one_identical_actuation(
+            self, monkeypatch):
+        from distributedpytorch_tpu.data import governor as governor_mod
+
+        # host A barely stalls locally (0.05) but the OTHER host is at
+        # 0.6; host B is the mirror image.  Both must act on max=0.6
+        # and arm the SAME echo factor — disagreeing factors would
+        # desynchronize optimizer step counts.
+        def fake_for(other_stall, other_wants):
+            def fake(value, reduce, label):
+                if label == "governor/stall":
+                    return max(float(value), other_stall)
+                if label == "governor/escalate":
+                    return bool(value) or other_wants
+                return value
+            return fake
+
+        results = []
+        for local, other in (((0.95, 0.05), 0.6), ((0.4, 0.6), 0.05)):
+            stub = self._Stub()
+            gov, _mod = self._gov(stub, None)
+            monkeypatch.setattr(governor_mod, "governor_consensus",
+                                fake_for(other, other_wants=True))
+            busy, wait = local
+            for k in range(2):
+                gov.tick(busy, wait, step=k, epoch=0)
+            gov.epoch_boundary(epoch=0, step=2)
+            results.append((stub.echo,
+                            [d["action"] for d in gov.decisions],
+                            [d["stall"] for d in gov.decisions]))
+        (echo_a, acts_a, stalls_a), (echo_b, acts_b, stalls_b) = results
+        # one identical decision on every host: same actions, same
+        # decided stall, same armed factor — ceil(1/(1-0.6)) = 3
+        assert echo_a == echo_b == 3
+        assert acts_a == acts_b
+        assert stalls_a == stalls_b
+        assert "arm_echo" in acts_a
+
+    def test_tick_routes_through_the_consensus_seam(self, monkeypatch):
+        from distributedpytorch_tpu.data import governor as governor_mod
+
+        calls = []
+        monkeypatch.setattr(
+            governor_mod, "governor_consensus",
+            lambda v, reduce, label: (calls.append((reduce, label)), v)[1]
+            and v)
+        stub = self._Stub()
+        gov, _ = self._gov(stub, None)
+        gov.tick(0.5, 0.5, step=0, epoch=0)
+        assert ("max", "governor/stall") in calls
+        gov.epoch_boundary(epoch=0, step=1)
+        assert ("any", "governor/escalate") in calls
+
+    def test_seam_delegates_to_replicated_decision(self):
+        from distributedpytorch_tpu.data.governor import governor_consensus
+
+        # single-process: identity through the REAL primitive
+        assert governor_consensus(0.4, "max", "governor/stall") == 0.4
+
+    def test_non_consensus_governor_never_calls_the_seam(
+            self, monkeypatch):
+        from distributedpytorch_tpu.data import governor as governor_mod
+        from distributedpytorch_tpu.data.governor import FeedGovernor
+
+        def boom(*a, **k):
+            raise AssertionError("consensus called on a local governor")
+
+        monkeypatch.setattr(governor_mod, "governor_consensus", boom)
+        gov = FeedGovernor("observe", 0.2, self._Stub(),
+                           min_samples=1, telemetry=False)
+        gov.tick(0.5, 0.5, step=0, epoch=0)
+        gov.epoch_boundary(epoch=0, step=1)
+
+    def test_trainer_lifts_the_single_process_restriction(self):
+        # the stale validation is GONE: data.governor=auto no longer
+        # raises on the multi-host shape (the consensus primitive is
+        # the fix); telemetry=false still refuses, as before
+        import inspect
+
+        from distributedpytorch_tpu.train import trainer as trainer_mod
+
+        src = inspect.getsource(trainer_mod)
+        assert "single-process only: decisions" not in src
+
+
+# ---------------------------------------- supervisor: topology_changed
+
+def _script(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text(body)
+    return [sys.executable, str(path)]
+
+
+class TestSupervisorTopologyChange:
+    """The topology_changed exit class, fast (stub children + env-read
+    probes) — the named fast gate of the slow supervised e2es below."""
+
+    def _sup(self, argv, work_dir, schedule, **kw):
+        from distributedpytorch_tpu.chaos.policies import Retry
+        from distributedpytorch_tpu.train.supervise import Supervisor
+
+        def child_env(attempt):
+            n = schedule[min(attempt, len(schedule) - 1)]
+            return {"JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS":
+                        f"--xla_force_host_platform_device_count={n}"}
+
+        kw.setdefault("backoff", Retry(base_s=0.0, cap_s=0.0))
+        kw.setdefault("telemetry", False)
+        # the pairing the --elastic CLI wires: probe + re-plan override
+        kw.setdefault("replan_arg", elastic_lib.DEFAULT_REPLAN_ARG)
+        return Supervisor(argv, work_dir=str(work_dir),
+                          child_env=child_env,
+                          topology_probe=elastic_lib.probe_topology,
+                          **kw)
+
+    @staticmethod
+    def _preempt_once_child(tmp_path):
+        """Writes a preempted summary on run 1, a completed one on
+        run 2 — the graceful-preemption shape."""
+        flag = tmp_path / "second_run"
+        return _script(tmp_path, "preempt.py", f"""
+import json, os
+flag = {str(flag)!r}
+d = os.path.join({str(tmp_path)!r}, 'run_0')
+os.makedirs(d, exist_ok=True)
+preempted = not os.path.exists(flag)
+open(flag, 'w').close()
+with open(os.path.join(d, 'fit_summary.json'), 'w') as f:
+    json.dump({{"preempted": preempted, "completed": not preempted}}, f)
+""")
+
+    def test_preempt_plus_shrink_classifies_topology_changed(
+            self, tmp_path):
+        sup = self._sup(self._preempt_once_child(tmp_path), tmp_path,
+                        schedule=[8, 4])
+        report = sup.run()
+        assert report["outcome"] == "clean"
+        assert report["restarts"] == {"preempted": 0, "crashed": 0,
+                                      "topology_changed": 1}
+        [change] = report["topology_changes"]
+        assert change["old"] == "cpu:8/p1" and change["new"] == "cpu:4/p1"
+        assert report["topology_recovery_seconds"]
+        assert report["elastic"] == {
+            "topology_changes": 1, "replans": 1,
+            "recovery_p50_s": report["topology_recovery_seconds"][0]}
+        assert any(e["event"] == "topology_changed" for e in sup.events)
+
+    def test_static_topology_keeps_legacy_classification(self, tmp_path):
+        sup = self._sup(self._preempt_once_child(tmp_path), tmp_path,
+                        schedule=[8, 8])
+        report = sup.run()
+        assert report["outcome"] == "clean"
+        assert report["restarts"]["preempted"] == 1
+        assert report["restarts"]["topology_changed"] == 0
+        assert report["elastic"] is None
+
+    def test_shrink_never_counts_toward_give_up(self, tmp_path):
+        """Three identical-fingerprint crashes would trip the crash
+        loop (threshold 2) AND blow the restart budget (max_restarts 2)
+        — but each exit rides a membership change, so neither give-up
+        fires and the supervisor finishes clean: a reshape is the
+        scheduler's act, never the run burning its budget."""
+        counter = tmp_path / "n"
+        argv = _script(tmp_path, "reshaped.py", f"""
+import json, os, sys
+n_path = {str(counter)!r}
+n = int(open(n_path).read()) if os.path.exists(n_path) else 0
+open(n_path, 'w').write(str(n + 1))
+if n < 3:
+    sys.stderr.write('boom: same wall\\n')
+    sys.exit(3)
+d = os.path.join({str(tmp_path)!r}, 'run_0')
+os.makedirs(d, exist_ok=True)
+with open(os.path.join(d, 'fit_summary.json'), 'w') as f:
+    json.dump({{"preempted": False, "completed": True}}, f)
+""")
+        sup = self._sup(argv, tmp_path, schedule=[8, 4, 2, 8],
+                        crash_loop_threshold=2, max_restarts=2)
+        report = sup.run()  # must NOT raise CrashLoopError
+        assert report["outcome"] == "clean"
+        assert report["restarts"]["topology_changed"] == 3
+        assert report["restarts"]["crashed"] == 0
+        assert report["crash_loop_count"] == 0
+        ledger = [json.loads(x) for x in
+                  (tmp_path / "supervisor.jsonl").read_text()
+                  .splitlines()]
+        assert [e["event"] for e in ledger
+                if e["event"] == "topology_changed"] \
+            == ["topology_changed"] * 3
+        assert not any(e["event"] == "gave_up" for e in ledger)
+
+    def test_replan_arg_appended_after_change_only(self, tmp_path):
+        from distributedpytorch_tpu.train.supervise import Supervisor
+
+        sup = Supervisor(["cmd"], work_dir=str(tmp_path),
+                         resume_arg="resume=auto",
+                         replan_arg="parallel.strategy=auto")
+        assert sup._argv_for(1) == ["cmd", "resume=auto"]
+        sup._replan = True  # a topology change was observed
+        assert sup._argv_for(1) == ["cmd", "resume=auto",
+                                    "parallel.strategy=auto"]
+        assert sup._argv_for(0) == ["cmd"]  # never on the first attempt
+
+    def test_transient_baseline_probe_failure_backfills(self, tmp_path):
+        """A probe that fails ONCE at launch must not disable elastic
+        detection for the whole run: the first successful post-exit
+        probe backfills the baseline, and the NEXT membership change
+        still classifies topology_changed."""
+        from distributedpytorch_tpu.chaos.policies import Retry
+        from distributedpytorch_tpu.train.supervise import Supervisor
+
+        fails = {"n": 0}
+
+        def flaky_probe(env):
+            fails["n"] += 1
+            if fails["n"] == 1:  # the attempt-0 baseline probe
+                raise RuntimeError("transient: runtime busy")
+            return elastic_lib.probe_topology(env)
+
+        schedule = [8, 8, 4]  # exit 0 backfills cpu:8; exit 1 shrinks
+
+        def child_env(attempt):
+            n = schedule[min(attempt, len(schedule) - 1)]
+            return {"JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": elastic_lib.force_device_count_flags(
+                        "", n)}
+
+        counter = tmp_path / "n"
+        argv = _script(tmp_path, "twice.py", f"""
+import json, os, sys
+n_path = {str(counter)!r}
+n = int(open(n_path).read()) if os.path.exists(n_path) else 0
+open(n_path, 'w').write(str(n + 1))
+if n < 2:
+    sys.stderr.write('boom %d\\n' % n)
+    sys.exit(3)
+d = os.path.join({str(tmp_path)!r}, 'run_0')
+os.makedirs(d, exist_ok=True)
+with open(os.path.join(d, 'fit_summary.json'), 'w') as f:
+    json.dump({{"preempted": False, "completed": True}}, f)
+""")
+        sup = Supervisor(argv, work_dir=str(tmp_path),
+                         child_env=child_env,
+                         topology_probe=flaky_probe,
+                         backoff=Retry(base_s=0.0, cap_s=0.0),
+                         telemetry=False)
+        report = sup.run()
+        assert report["outcome"] == "clean"
+        assert any(e["event"] == "topology_probe_failed"
+                   for e in sup.events)
+        # exit 0: baseline was None -> backfilled (classified crashed);
+        # exit 1: cpu:8 -> cpu:4 -> topology_changed
+        assert report["restarts"]["crashed"] == 1
+        assert report["restarts"]["topology_changed"] == 1
+        [change] = report["topology_changes"]
+        assert change["old"] == "cpu:8/p1" and change["new"] == "cpu:4/p1"
+
+    def test_probe_failure_degrades_to_legacy_loudly(self, tmp_path):
+        from distributedpytorch_tpu.chaos.policies import Retry
+        from distributedpytorch_tpu.train.supervise import Supervisor
+
+        def broken_probe(env):
+            raise RuntimeError("no runtime")
+
+        (tmp_path / "run_0").mkdir()
+        (tmp_path / "run_0" / "fit_summary.json").write_text(
+            json.dumps({"preempted": False, "completed": True}))
+        marker = tmp_path / "crashed_once"
+        argv = _script(tmp_path, "flaky.py", f"""
+import os, sys
+m = {str(marker)!r}
+if not os.path.exists(m):
+    open(m, 'w').close()
+    sys.stderr.write('boom: transient\\n')
+    sys.exit(3)
+""")
+        sup = Supervisor(argv, work_dir=str(tmp_path),
+                         topology_probe=broken_probe,
+                         backoff=Retry(base_s=0.0, cap_s=0.0),
+                         telemetry=False)
+        report = sup.run()
+        assert report["outcome"] == "clean"
+        assert report["restarts"]["crashed"] == 1  # legacy class kept
+        assert report["restarts"]["topology_changed"] == 0
+        assert any(e["event"] == "topology_probe_failed"
+                   for e in sup.events)
+
+
+class TestTopologyProbe:
+    def test_pinned_cpu_env_fast_path(self):
+        info = elastic_lib.probe_topology(
+            {"JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--foo --xla_force_host_platform_device_count"
+                          "=4 --bar"})
+        assert info == {"platform": "cpu", "n_devices": 4,
+                        "process_count": 1, "fingerprint": "cpu:4/p1"}
+
+    def test_parse_forced_device_count(self):
+        assert elastic_lib.parse_forced_device_count(
+            {"XLA_FLAGS": "--xla_force_host_platform_device_count=16"}) \
+            == 16
+        assert elastic_lib.parse_forced_device_count({}) is None
+
+    def test_subprocess_probe_agrees_with_live_runtime(self):
+        # the real (jax-importing) probe path: pin the same topology
+        # this test process runs under and compare fingerprints
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # defeat the fast path...
+        env["JAX_PLATFORMS"] = "cpu"    # ...is platform+flags keyed,
+        env.pop("XLA_FLAGS", None)      # so drop the forced count
+        info = elastic_lib.probe_topology(env)
+        assert info["platform"] == "cpu" and info["n_devices"] >= 1
+        assert info["fingerprint"] == \
+            f"cpu:{info['n_devices']}/p{info['process_count']}"
+
+
+# ------------------------------------ supervisor-driven elastic e2es
+
+def _elastic_scenario(name, schedule, *, strategy="auto", epochs=1,
+                      at=4, changes=1, attempt_overrides=None,
+                      extra_invariants=()):
+    """An inline elastic supervise scenario (the chaos runner's
+    machinery, test-shaped): SIGTERM kills each generation at its
+    per-process step ``at``; ``schedule`` reshapes the pod between
+    generations."""
+    overrides = {"epochs": epochs, "checkpoint.preempt_check_every": 1,
+                 "checkpoint.digest": True}
+    if strategy:
+        overrides["parallel.strategy"] = strategy
+    return {
+        "name": name,
+        "mode": "supervise",
+        "plan": {"seed": 0, "faults": [
+            {"site": "trainer/train_step", "kind": "sigterm",
+             "at": [at]}]},
+        "overrides": overrides,
+        "params": {"big_dataset": True,
+                   "expected_topology_changes": changes,
+                   "device_schedule": list(schedule),
+                   "attempt_overrides": attempt_overrides or {},
+                   "max_restarts": 8},
+        "invariants": ["topology_changed_each_exit",
+                       "replanned_each_change",
+                       "plan_crossings_announced",
+                       "exact_resume_chain",
+                       "restored_digest_matches_committed",
+                       "zero_lost_or_duplicated_steps_storm",
+                       *extra_invariants],
+    }
+
+
+def _attempt_plans(report):
+    return [(a["attempt"], a.get("plan") or {})
+            for a in report["phases"]["supervise"]["attempts"]]
+
+
+class TestElasticGate:
+    """The supervisor-driven shrink / grow / round-trip e2es — each a
+    real multi-process run through the chaos runner, each asserting the
+    restored param digest matches the save-side meta digest and zero
+    lost/duplicated optimizer steps.  Slow: 2-3 child trainer
+    processes apiece; the fast gates are TestSupervisorTopologyChange
+    (classification), TestTopologyFingerprint (crossing detection) and
+    test_plan's manager-level cross-plan restore test (mechanics)."""
+
+    @pytest.mark.slow  # two child trainer processes (~40s); fast gate:
+    # TestSupervisorTopologyChange.test_preempt_plus_shrink_classifies_topology_changed
+    def test_supervised_shrink_dp8_to_dp4(self, tmp_path):
+        from distributedpytorch_tpu.chaos import runner
+
+        report = runner.run_scenario(
+            _elastic_scenario("elastic_shrink", [8, 4]),
+            work_dir=str(tmp_path / "w"), strict=True)
+        plans = dict(_attempt_plans(report))
+        assert plans[0]["data"] == 8 and plans[1]["data"] == 4
+        assert plans[0]["strategy"] == plans[1]["strategy"] == "dp"
+        assert plans[1]["topology"] == "cpu:4/p1"
+
+    @pytest.mark.slow  # two child trainer processes (~40s); fast gates:
+    # TestSupervisorTopologyChange + test_plan's dp -> dp_tp manager
+    # restore test (the identical crossing, in-process)
+    def test_supervised_grow_dp4_to_dp4_tp2(self, tmp_path):
+        from distributedpytorch_tpu.chaos import runner
+
+        report = runner.run_scenario(
+            _elastic_scenario(
+                "elastic_grow", [4, 8], strategy="dp",
+                # the grown generation claims the re-added devices as a
+                # model axis: dp4 -> dp4 x tp2 (riding resume_overrides,
+                # the plan_mismatch_restore-proven path)
+                attempt_overrides={"1": {"parallel.strategy": "dp_tp"}}),
+            work_dir=str(tmp_path / "w"), strict=True)
+        plans = dict(_attempt_plans(report))
+        assert (plans[0]["data"], plans[0]["model"]) == (4, 1)
+        assert (plans[1]["data"], plans[1]["model"]) == (4, 2)
+        assert plans[1]["shard_params"] is True
+        assert plans[1]["topology"] == "cpu:8/p1"
+
+    @pytest.mark.slow  # three child trainer processes (~60s); fast
+    # gate: TestSupervisorTopologyChange.test_shrink_never_counts_toward_give_up
+    def test_supervised_shrink_then_grow_round_trip(self, tmp_path):
+        from distributedpytorch_tpu.chaos import runner
+
+        report = runner.run_scenario(
+            _elastic_scenario("elastic_round_trip", [8, 4, 8],
+                              at=3, changes=2),
+            work_dir=str(tmp_path / "w"), strict=True)
+        plans = dict(_attempt_plans(report))
+        assert [plans[k]["data"] for k in (0, 1, 2)] == [8, 4, 8]
+        # the round trip ends byte-identical to where generation 1
+        # left off: the digest chain invariant covered every hop
+        sup = report["phases"]["supervise"]["supervisor"]
+        assert sup["restarts"]["topology_changed"] == 2
+        assert sup["elastic"]["topology_changes"] == 2

@@ -522,6 +522,29 @@ class TestConfigKnobs:
         with pytest.raises(ValueError, match="telemetry"):
             Trainer(cfg)
 
+    def test_auto_routes_through_consensus_observe_stays_local(
+            self, tmp_path):
+        """ISSUE 12: every data.governor=auto run routes its ladder
+        decisions through replicated_decision (single-process the
+        gather degenerates to [value] — an identity, but the multi-host
+        semantics are the only semantics); observe never does — it
+        actuates nothing, so there is nothing to agree on."""
+        from distributedpytorch_tpu.chaos.runner import _build_cfg
+        from distributedpytorch_tpu.train import Trainer
+
+        tr = Trainer(_build_cfg({"data.governor": "auto"},
+                                str(tmp_path)))
+        try:
+            assert tr._governor is not None and tr._governor.consensus
+        finally:
+            tr.close()
+        tr = Trainer(_build_cfg({}, str(tmp_path)))  # observe default
+        try:
+            assert tr._governor is not None \
+                and not tr._governor.consensus
+        finally:
+            tr.close()
+
 
 class TestTrainerObserveFit:
     """The default contract: governor=observe rides every fit, logging

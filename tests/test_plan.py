@@ -81,7 +81,10 @@ class TestResolve:
         blk = plan_lib.resolve_plan("dp_tp_zero1", n_devices=8).block()
         assert json.loads(json.dumps(blk)) == blk
         assert set(blk) == {"strategy", "data", "model", "slices",
-                            "shard_params", "shard_opt_state"}
+                            "shard_params", "shard_opt_state", "topology"}
+        # planning-only resolutions carry no topology claim; the trainer
+        # entry (plan_from_config) stamps the live fingerprint
+        assert blk["topology"] is None
 
     def test_explicit_axes_and_errors(self):
         p = plan_lib.resolve_plan("dp_tp", n_devices=8, model=4)

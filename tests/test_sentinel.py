@@ -296,7 +296,10 @@ class TestSupervisor:
         sup = self._sup([sys.executable, "-c", "pass"], tmp_path)
         report = sup.run()
         assert report["outcome"] == "clean" and report["attempts"] == 1
-        assert report["restarts"] == {"preempted": 0, "crashed": 0}
+        assert report["restarts"] == {"preempted": 0, "crashed": 0,
+                                      "topology_changed": 0}
+        # elastic detection off -> the elastic block is null (key present)
+        assert report["elastic"] is None
 
     def test_crash_then_clean_is_one_restart(self, tmp_path):
         self._summary(tmp_path)
