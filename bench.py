@@ -262,6 +262,18 @@ REDUCE_BUCKETS = int(os.environ.get(
 #: nothing gates.  Observation-only either way: the bench's timed loop
 #: is never actuated.
 BENCH_GOVERNOR = os.environ.get("DPTPU_BENCH_GOVERNOR") or None
+#: DPTPU_BENCH_SOURCE=packed stamps the record's feed.source (fs =
+#: per-sample decode off the tree, packed = dptpu-pack mmap records,
+#: data/packed.py).  The bench's timed loop steps PRE-PLACED synthetic
+#: batches — it exercises no input plane, so the stamp is a LABEL for
+#: history hygiene, not a measured difference: it keys
+#: --check-regression's same-config filter (a packed-labeled record
+#: never baselines an fs one — the contract any future feed-bound bench
+#: mode and trainer-derived records rely on) and counts as a non-default
+#: A/B in _is_default_config.  The behavioral acceptance lives in the
+#: FEED gate: a governed source=packed record must measure stall <=
+#: data.governor_target.  Default: fs.
+BENCH_SOURCE = os.environ.get("DPTPU_BENCH_SOURCE") or "fs"
 
 
 def _governor_target() -> float:
@@ -291,7 +303,8 @@ def _is_default_config() -> bool:
             and not os.environ.get("DPTPU_BENCH_BATCH")
             and not os.environ.get("DPTPU_BENCH_PRECISION")
             and not os.environ.get("DPTPU_BENCH_REDUCE_BUCKETS")
-            and not os.environ.get("DPTPU_BENCH_STRATEGY"))
+            and not os.environ.get("DPTPU_BENCH_STRATEGY")
+            and not os.environ.get("DPTPU_BENCH_SOURCE"))
 
 
 def save_latest_tpu_capture(record: dict) -> None:
@@ -405,6 +418,14 @@ def load_bench_history(history_dir: str | None = None) -> list:
     return out
 
 
+def _feed_source(record: dict) -> str:
+    """The record's feed.source, normalized: records predating the
+    packed data plane (and serve records, whose ``feed`` is null) read
+    as the ``fs`` default."""
+    feed = record.get("feed") or {}
+    return feed.get("source") or "fs"
+
+
 def check_regression(record: dict, history: list | None = None,
                      threshold: float = REGRESSION_THRESHOLD
                      ) -> tuple[bool, str]:
@@ -427,6 +448,11 @@ def check_regression(record: dict, history: list | None = None,
              and r.get("platform") == record.get("platform")
              and r.get("precision") == record.get("precision")
              and r.get("reduce_buckets") == record.get("reduce_buckets")
+             # the feed source joins the config key: a packed-plane
+             # record and an fs one measure different input regimes —
+             # neither may baseline the other.  Missing key == fs (the
+             # default), so pre-pack committed history still compares.
+             and _feed_source(r) == _feed_source(record)
              # the plan block joins the config key: a dp_tp (or any
              # sharded-plan) record and a pure-dp record are different
              # trajectories — neither may baseline the other.  Null ==
@@ -838,6 +864,9 @@ def main() -> None:
     # capture replay) must never arm a fault plan as an import side
     # effect (the same rule as the __main__-gated argv read above).
     chaos_sites.maybe_arm_from_env()
+    if BENCH_SOURCE not in ("fs", "packed"):
+        raise SystemExit(
+            f"DPTPU_BENCH_SOURCE must be fs|packed, got {BENCH_SOURCE!r}")
     if _CLI_ARGS.serve:
         record = (serve_sessions_bench() if _CLI_ARGS.sessions
                   else serve_bench())
@@ -1025,7 +1054,8 @@ def main() -> None:
     # (null = ungoverned), the echo factor (null: the bench loop never
     # echoes).  Keys always present; --check-regression gates the
     # fraction against the governor target when governed.
-    record["feed"] = feed_block(goodput_rep, governor=BENCH_GOVERNOR)
+    record["feed"] = feed_block(goodput_rep, governor=BENCH_GOVERNOR,
+                                source=BENCH_SOURCE)
     # chaos field: armed fault-plan name or null; key always present
     # (the PR 4 schema-stability convention)
     record["chaos"] = chaos_sites.active_scenario()

@@ -17,6 +17,10 @@ invariants::
                                              # crossing, zero lost steps
     dptpu-chaos input_stall_recovery         # slow feed -> governor arms
                                              # echo -> recovers -> disarms
+    dptpu-chaos torn_pack                    # bit-rotted packed record ->
+                                             # typed checksum error ->
+                                             # --verify + quarantine-by-
+                                             # index run completes
     dptpu-chaos my_scenario.json
     dptpu-chaos --list
     dptpu-chaos --plan preempt_mid_epoch     # print the plan JSON (for

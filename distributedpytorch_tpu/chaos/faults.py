@@ -24,7 +24,11 @@ Fault kinds:
   only be booked by a SURVIVING observer (the supervisor's restart
   counters); this process's registry dies with it;
 * ``truncate``  — cut the tail off a file under the site's ``path``
-  context (torn checkpoint write / post-commit corruption).
+  context (torn checkpoint write / post-commit corruption);
+* ``bitflip``   — XOR one byte of an array payload (``offset`` into the
+  buffer, default 0): bit rot / a torn read of checksummed bytes — the
+  packed data plane's ``data/packed_read`` seam driver (the record crc
+  must catch it, typed, never silent).
 
 Every actual firing increments ``chaos_injected_total{site,kind}`` in
 the process-wide telemetry registry and is appended to ``plan.firings``
@@ -41,7 +45,8 @@ import sys
 import threading
 import time
 
-KINDS = ("latency", "error", "nan", "sigterm", "sigkill", "truncate")
+KINDS = ("latency", "error", "nan", "sigterm", "sigkill", "truncate",
+         "bitflip")
 
 
 class InjectedFaultError(RuntimeError):
@@ -84,6 +89,21 @@ def poison_payload(payload):
     if isinstance(payload, (list, tuple)):
         return type(payload)(poison_payload(v) for v in payload)
     return _poison_leaf(payload)
+
+
+def flip_payload_byte(payload, offset: int = 0):
+    """XOR one byte of an array payload (the deterministic bit-rot /
+    torn-read model); non-array or empty payloads pass through.  Always
+    flips a PRIVATE copy — the caller's buffer (e.g. an mmap view) is
+    never mutated."""
+    import numpy as np
+
+    if not isinstance(payload, np.ndarray) or payload.size == 0:
+        return payload
+    out = np.array(payload)  # private contiguous copy
+    flat = out.reshape(-1).view(np.uint8)
+    flat[int(offset) % flat.size] ^= 0xFF
+    return out
 
 
 def truncate_file(path: str, fraction: float = 0.5) -> str:
@@ -130,7 +150,8 @@ class FaultSpec:
 
     def __init__(self, site: str, kind: str, *, at=None, every=None,
                  after: int = 0, times=None, p=None, delay_s: float = 0.05,
-                 message: str = "", fraction: float = 0.5):
+                 message: str = "", fraction: float = 0.5,
+                 offset: int = 0):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} "
                              f"({' | '.join(KINDS)})")
@@ -154,6 +175,7 @@ class FaultSpec:
         self.delay_s = float(delay_s)
         self.message = message
         self.fraction = float(fraction)
+        self.offset = int(offset)
         self._fired = 0
         self._rng = None  # seeded by the owning plan
 
@@ -175,6 +197,8 @@ class FaultSpec:
             out["message"] = self.message
         if self.kind == "truncate":
             out["fraction"] = self.fraction
+        if self.kind == "bitflip" and self.offset:
+            out["offset"] = self.offset
         return out
 
     def should_fire(self, visit: int) -> bool:
@@ -307,6 +331,8 @@ class FaultPlan:
                 truncate_file(path, spec.fraction)
             elif spec.kind == "nan":
                 payload = poison_payload(payload)
+            elif spec.kind == "bitflip":
+                payload = flip_payload_byte(payload, spec.offset)
         return payload
 
     @staticmethod

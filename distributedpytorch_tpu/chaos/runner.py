@@ -293,6 +293,26 @@ SCENARIOS: dict[str, dict] = {
                        "restored_digest_matches_committed",
                        "zero_lost_or_duplicated_steps_storm"],
     },
+    # Torn pack: the packed data plane (data/packed.py) under bit rot.
+    # Phase 1 — a bitflip fault at the data/packed_read seam corrupts
+    # one record's bytes in flight: the per-record crc32 must trip and
+    # the reader must raise the TYPED PackedRecordError naming the
+    # record index — never serve a silent wrong sample.  Phase 2 — the
+    # SAME record is then torn ON DISK, `dptpu-pack --verify` must flag
+    # exactly the records sharing the torn blob, and a
+    # data.pack_quarantine=[...] run must complete the schedule without
+    # them.  Recovery = tear -> finished quarantined fit.
+    "torn_pack": {
+        "name": "torn_pack",
+        "mode": "packed_fit",
+        "plan": {"seed": 0, "faults": [
+            {"site": "data/packed_read", "kind": "bitflip", "at": [3]}]},
+        "overrides": {"epochs": 1, "eval_every": 0,
+                      "log_every_steps": 1000},
+        "params": {"n_images": 12},
+        "invariants": ["packed_read_error_typed", "torn_record_detected",
+                       "quarantined_run_completes"],
+    },
     # Repeated SIGTERM across epochs: every wave stops gracefully
     # (consensus stop -> exact-resume checkpoint), the supervisor
     # restarts without backoff, and across the whole storm not one
@@ -643,6 +663,83 @@ def _run_fit(sc: dict, work_dir: str) -> dict:
         "feed": history.get("feed"),
         "governor": governor_records,
     }}, "recovery_s": round(fit_s if recovery_s is None else recovery_s, 3),
+        "firings": plan.injected_total()}
+
+
+def _run_packed_fit(sc: dict, work_dir: str) -> dict:
+    """``torn_pack``: fake VOC is packed; a bitflip at the
+    ``data/packed_read`` seam must surface as the typed
+    ``PackedRecordError``; the record is then torn on disk, ``--verify``
+    flags it, and a quarantine-by-index run completes (see the scenario
+    comment)."""
+    from ..data import VOCInstanceSegmentation, make_fake_voc
+    from ..data import packed as packed_lib
+    from ..train import Trainer
+
+    params = sc.get("params") or {}
+    root = make_fake_voc(os.path.join(work_dir, "voc"),
+                         n_images=int(params.get("n_images", 12)),
+                         size=(96, 128), n_val=2, seed=0)
+    pack_root = os.path.join(work_dir, "packs")
+    for split in ("train", "val"):
+        src = VOCInstanceSegmentation(root, split=split, preprocess=True,
+                                      area_thres=0)
+        packed_lib.pack_dataset(
+            src,
+            packed_lib.pack_dir_path(pack_root, "voc", "instance",
+                                     [split]),
+            dataset_name="voc", splits=[split], area_thres=0)
+    overrides = dict(sc.get("overrides") or {})
+    overrides.update({"data.root": root, "data.source": "packed",
+                      "data.pack_path": pack_root})
+    plan = FaultPlan.from_dict(dict(sc.get("plan") or {},
+                                    name=sc["name"]))
+    typed_error = bad_index = None
+    error_msg = ""
+    cfg = _build_cfg(overrides, work_dir)
+    with sites.armed_plan(plan):
+        tr = Trainer(cfg, writers=RecordingWriter())
+        nb_full = len(tr.train_loader)
+        try:
+            tr.fit()
+        except packed_lib.PackedRecordError as e:
+            typed_error = type(e).__name__
+            bad_index = int(e.index)
+            error_msg = str(e)
+        finally:
+            tr.close()
+
+    # tear the SAME record on disk and recover by quarantine-by-index
+    train_pack = packed_lib.pack_dir_path(pack_root, "voc", "instance",
+                                          ["train"])
+    verify_bad: list[int] = []
+    phase2: dict = {}
+    t0 = time.perf_counter()
+    if bad_index is not None:
+        packed_lib.corrupt_record(train_pack, bad_index)
+        verify_bad = packed_lib.verify_pack(train_pack)
+        cfg2 = _build_cfg(
+            dict(overrides, **{"data.pack_quarantine": verify_bad}),
+            work_dir)
+        tr2 = Trainer(cfg2, writers=RecordingWriter())
+        hist2 = tr2.fit()
+        tr2.close()
+        phase2 = {
+            "nb_quarantined": len(tr2.train_loader),
+            "epochs_recorded": len(hist2["train_loss"]),
+            "preempted": bool(hist2.get("preempted")),
+            "final_step": int(tr2.state.step),
+        }
+    recovery_s = time.perf_counter() - t0
+    _observe_recovery(sc["name"], recovery_s)
+    return {"phases": {"packed_fit": dict({
+        "typed_error": typed_error,
+        "bad_index": bad_index,
+        "error_names_index": (bad_index is not None
+                              and f"record {bad_index} " in error_msg),
+        "verify_bad": verify_bad,
+        "nb_full": nb_full,
+    }, **phase2)}, "recovery_s": round(recovery_s, 3),
         "firings": plan.injected_total()}
 
 
@@ -1124,6 +1221,36 @@ def _check_one(name, sc, result, phases, verdict):
                     f"canary={st['canary']} bad={bad} "
                     f"recovered={s['recovered_after_rollback']} in "
                     f"{result['recovery_s']}s")
+        elif name == "packed_read_error_typed":
+            f = phases["packed_fit"]
+            verdict(name,
+                    f["typed_error"] == "PackedRecordError"
+                    and f["bad_index"] is not None
+                    and f["error_names_index"],
+                    f"typed_error={f['typed_error']} "
+                    f"bad_index={f['bad_index']} "
+                    f"names_index={f['error_names_index']} (want the "
+                    "typed PackedRecordError naming the record — never "
+                    "a silent wrong sample)")
+        elif name == "torn_record_detected":
+            f = phases["packed_fit"]
+            verdict(name,
+                    f["bad_index"] is not None
+                    and f["bad_index"] in (f["verify_bad"] or []),
+                    f"dptpu-pack --verify flagged {f['verify_bad']} "
+                    f"(must include the torn record {f['bad_index']}; "
+                    "siblings sharing its image blob are legitimately "
+                    "flagged too)")
+        elif name == "quarantined_run_completes":
+            f = phases["packed_fit"]
+            verdict(name,
+                    not f.get("preempted", True)
+                    and f.get("epochs_recorded") == _scenario_epochs(sc)
+                    and 0 < f.get("nb_quarantined", 0) <= f["nb_full"],
+                    f"quarantined run: epochs_recorded="
+                    f"{f.get('epochs_recorded')} "
+                    f"nb={f.get('nb_quarantined')}/{f['nb_full']} "
+                    f"preempted={f.get('preempted')}")
         elif name == "nonfinite_steps_logged":
             f = phases["fit"]
             # expected count = what the plan ACTUALLY fired (schedule
@@ -1387,10 +1514,13 @@ def run_scenario(scenario: str | dict, work_dir: str | None = None,
             result = _run_serve_swap(sc, work_dir)
         elif mode == "supervise":
             result = _run_supervise(sc, work_dir)
+        elif mode == "packed_fit":
+            result = _run_packed_fit(sc, work_dir)
         else:
             raise ValueError(
                 f"unknown scenario mode {mode!r} "
-                "(fit | fit_resume | serve | serve_swap | supervise)")
+                "(fit | fit_resume | serve | serve_swap | supervise | "
+                "packed_fit)")
     finally:
         if cleanup:
             import shutil

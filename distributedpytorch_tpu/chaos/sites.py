@@ -15,7 +15,12 @@ The seams are woven into the REAL code paths (not shadow copies):
   (serve/swap.load_swap_predictor; payload = the restored param tree, so
   a ``nan`` fault models a poisoned/torn checkpoint arriving via swap —
   the canary-rollback scenario's trigger);
-* ``device/put``             — host->device placement in the prefetcher.
+* ``device/put``             — host->device placement in the prefetcher;
+* ``data/packed_read``       — the packed data plane's verified record
+  read (data/packed.py), BEFORE the crc gate: a ``bitflip`` fault here
+  models bit rot / a torn read and must surface as the typed
+  ``PackedRecordError`` naming the record, never a silent wrong sample
+  (the ``torn_pack`` scenario's driver).
 
 Disabled is the default and it is ~free: ``fire`` loads one module
 attribute, sees ``None`` and returns — no registry, no telemetry, no
@@ -50,6 +55,7 @@ SITES = (
     "serve/drain",
     "serve/swap_params",
     "device/put",
+    "data/packed_read",
 )
 
 

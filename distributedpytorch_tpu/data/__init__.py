@@ -17,6 +17,14 @@ from .pipeline import (
     build_train_transform,
     collate,
 )
+from .packed import (
+    PackedDataset,
+    PackedRecordError,
+    PackFormatError,
+    pack_dataset,
+    pack_name,
+    verify_pack,
+)
 from .prepared import (
     PreparedInstanceDataset,
     PreparedSemanticDataset,
@@ -41,6 +49,12 @@ __all__ = [
     "ensure_voc",
     "VOCSemanticSegmentation",
     "HAVE_GRAIN",
+    "PackedDataset",
+    "PackedRecordError",
+    "PackFormatError",
+    "pack_dataset",
+    "pack_name",
+    "verify_pack",
     "build_eval_transform",
     "build_prepared_post_transform",
     "build_prepared_semantic_post_transform",

@@ -10,6 +10,12 @@ the loop: it watches the windowed stall fraction (a
 the trainer already takes at the log cadence — no new host syncs) and
 works the knobs through an **escalation ladder with hysteresis**:
 
+0. **Pack recommendation** (first escalation, once per run): when the
+   stalled source is not already packed (``data.source=fs``), log the
+   exact ``dptpu-pack`` invocation — pre-decoding into mmap records
+   (data/packed.py) deletes the decode+walk cost every rung above this
+   one merely tunes around.  Operator-actuated, like the flip
+   recommendation; a packed source starts the ladder at rung 1.
 1. **Hot prefetch resize** (any tick): double host + device prefetch
    depth, bounded.  Cheap (host RAM / HBM for a few more in-flight
    batches), reversible, and applies immediately — both prefetchers read
@@ -71,9 +77,15 @@ GOVERNOR_MODES = ("off", "observe", "auto")
 MAX_HOST_PREFETCH = 8
 MAX_DEVICE_PREFETCH = 8
 
-#: ladder actions, as they appear in governor.jsonl / the actions counter
-ACTIONS = ("raise_prefetch", "flip_device_path", "recommend",
-           "arm_echo", "raise_echo", "disarm_echo", "shortfall")
+#: ladder actions, as they appear in governor.jsonl / the actions counter.
+#: ``pack_recommendation`` is rung 0 (data/packed.py): when the stalled
+#: source is NOT already packed, the first escalation names the exact
+#: ``dptpu-pack`` invocation that deletes the stall at its source —
+#: cheaper than every actuation above it.  A packed source skips
+#: straight to rung 1 (prefetch).
+ACTIONS = ("pack_recommendation", "raise_prefetch", "flip_device_path",
+           "recommend", "arm_echo", "raise_echo", "disarm_echo",
+           "shortfall")
 
 
 def governor_consensus(value, reduce: str, label: str):
@@ -148,6 +160,14 @@ class FeedActuators:
     def set_echo(self, factor: int) -> None:
         raise NotImplementedError
 
+    def pack_status(self) -> tuple[bool, str | None]:
+        """Rung 0 (data/packed.py): ``(already_packed,
+        recommendation)``.  When the source is not packed, the
+        recommendation names the exact ``dptpu-pack`` invocation(s).
+        Default says "packed" so duck-typed actuators that predate the
+        rung keep their ladder unchanged."""
+        return True, None
+
 
 class FeedGovernor:
     """Escalation-ladder controller over the windowed input-stall signal.
@@ -209,6 +229,7 @@ class FeedGovernor:
         self._virtual_prefetch: tuple[int, int] | None = None
         self._virtual_echo: int | None = None
         self._flip_attempted = False
+        self._pack_noted = False
         self._echo_armed = False
         self._wants_escalation = False
         self._shortfall = False
@@ -321,6 +342,7 @@ class FeedGovernor:
             self._below = 0
         if self._above >= self.patience:
             self._above = 0
+            self._rung0_pack(step=step, epoch=epoch, stall=stall)
             host, dev = self._get_prefetch()
             if host < MAX_HOST_PREFETCH or dev < MAX_DEVICE_PREFETCH:
                 # never below current: an operator-configured depth
@@ -340,6 +362,27 @@ class FeedGovernor:
                 # rung 1 exhausted: the recompile-unsafe rungs wait for
                 # the epoch boundary
                 self._wants_escalation = True
+
+    def _rung0_pack(self, *, step: int, epoch: int,
+                    stall: float | None) -> None:
+        """Rung 0, emitted once per run at the FIRST escalation: when
+        the stalled source is not already packed, log the exact
+        ``dptpu-pack`` invocation that removes the stall at its source
+        (pre-decoded mmap records — data/packed.py).  Never actuated
+        (packing is the operator's move, like the flip recommendation);
+        packed sources skip straight to rung 1.  Config-derived on
+        every host, so no consensus is needed for a log-only line."""
+        if self._pack_noted:
+            return
+        self._pack_noted = True
+        status = getattr(self.actuators, "pack_status", None)
+        if status is None:
+            return
+        packed, recommendation = status()
+        if packed or not recommendation:
+            return
+        self._decide("pack_recommendation", step=step, epoch=epoch,
+                     stall=stall, applied=False, detail=recommendation)
 
     # ---------------------------------------------------------- boundary
     def epoch_boundary(self, *, epoch: int, step: int) -> list[dict]:
@@ -453,14 +496,20 @@ class FeedGovernor:
 
 
 def feed_block(goodput_report: dict | None, governor: str | None = None,
-               echo_effective: int | None = None) -> dict:
+               echo_effective: int | None = None,
+               source: str = "fs") -> dict:
     """The bench record's ``feed`` block — keys ALWAYS present (the PR 4
     schema-stability convention), null-valued when off/unknowable.
 
     ``input_wait_fraction`` is derived from a goodput report's buckets
     (wait / (wait + step + compile)); ``governor`` names the governing
     mode conditioning the record (null = ungoverned); ``echo_effective``
-    is the echo factor in effect (null when echoing is off/NA).
+    is the echo factor in effect (null when echoing is off/NA);
+    ``source`` names the data plane feeding the record (``fs`` |
+    ``packed`` — data/packed.py): --check-regression's same-config
+    filter keys on it, so a packed record never baselines an fs one.
+    Pre-pack committed history carries no ``source`` key; the filter
+    normalizes that to ``fs``.
     """
     frac = None
     buckets = (goodput_report or {}).get("buckets") or {}
@@ -473,4 +522,5 @@ def feed_block(goodput_report: dict | None, governor: str | None = None,
         "input_wait_fraction": frac,
         "governor": governor,
         "echo_effective": echo_effective,
+        "source": source,
     }

@@ -1,0 +1,686 @@
+"""Packed pre-decoded dataset: decode the filesystem tree ONCE, mmap forever.
+
+The profiling literature keeps re-finding the same per-sample host bill:
+JPEG/PNG decode plus a filesystem walk dominate input time (Mohan et al.,
+arXiv 2005.02130), and FFCV's answer (arXiv 2306.12517) is to pay it once
+— pre-decode into fixed-layout records behind an index and memory-map them
+ever after.  This module is that answer for the VOC/SBD sources:
+
+* ``dptpu-pack`` (:func:`main`) walks a dataset once and writes a pack
+  directory::
+
+      <pack>/voc-instance-train/
+          records.bin   # concatenated per-image blobs: decoded uint8 RGB
+                        # + the raw instance/class mask, one blob per
+                        # image (instance records of the same image SHARE
+                        # the blob — no duplicated pixels)
+          records.idx   # one fixed-size row per record: blob extent,
+                        # shape, mask dtype, image id ref, object index,
+                        # category, the 4 deterministic extreme points,
+                        # and the blob's crc32 — O(1) random access
+          meta.json     # identity (dataset/kind/splits/area_thres),
+                        # im_ids, the index crc32 and bin byte count.
+                        # Written LAST, atomically: no meta = no pack,
+                        # so a crashed packer can never be half-trusted.
+
+* :class:`PackedDataset` reads it back as a drop-in source for the
+  existing ``DataLoader``/transform stack: ``__getitem__(i, rng)`` re-runs
+  the EXACT arithmetic of the filesystem classes (``voc.py``/``sbd.py``)
+  on the stored bytes, so samples are bit-identical to the fs pipeline by
+  construction — pinned in ``tests/test_packed.py``.  Every read verifies
+  the record's crc32; a torn or bit-flipped record raises a typed
+  :class:`PackedRecordError` naming the record index — never a silent
+  wrong sample.  ``quarantine=(i, ...)`` drops named records from the
+  epoch (the ops move after ``dptpu-pack --verify`` flags them).
+
+* ``seek(i)`` is the O(1) record accessor the governor's echo/skip/replay
+  arithmetic and the sentinel's quarantine-by-batch-index resolve
+  through: record identity (image id, object, category, extreme points)
+  straight from the index row, the verified pixel payload on demand
+  (``read=True``).
+
+Host sharding rides the existing loader contract: the epoch permutation
+is ``default_rng((seed, epoch))`` over the GLOBAL index — identical on
+every host by construction, the consensus-free determinism idiom — and
+each process walks only its contiguous slice of it
+(``DataLoader._epoch_indices``).  The mmap makes that sharding physical:
+a host only page-faults the records its slice touches, so a pod never
+duplicates I/O.
+
+This module is importable pre-jax (numpy + stdlib only) and is the ONE
+prepared format going forward: ``data/prepared.py``'s cache is the
+legacy form (``data.prepared_cache`` configs get a loud migration
+pointer), and the prepared wrappers compose over a packed source when a
+crop-stage cache is still wanted on top.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import zlib
+
+import numpy as np
+
+from ..chaos import sites as chaos_sites
+
+#: bump when the record layout / reconstruction semantics change
+FORMAT_VERSION = 1
+
+META_NAME = "meta.json"
+INDEX_NAME = "records.idx"
+BIN_NAME = "records.bin"
+
+KINDS = ("instance", "semantic")
+
+#: one fixed-size row per record — the O(1)-seek surface.  ``mask_dtype``
+#: is the numpy dtype str of the stored raw mask (VOC PNG masks are
+#: uint8; SBD .mat structs vary), so reconstruction is exact whatever the
+#: source stored.  ``extreme_points`` are the deterministic (pert=0)
+#: extreme points of the record's object mask, in the (x, y) order of
+#: ``guidance.extreme_points_fixed`` — instance metadata rides the
+#: record, O(1)-reachable without touching the pixel payload.
+INDEX_DTYPE = np.dtype([
+    ("blob_offset", np.int64),
+    ("blob_len", np.int64),
+    ("height", np.int32),
+    ("width", np.int32),
+    ("mask_dtype", "S8"),
+    ("image_idx", np.int32),     # -> meta["im_ids"]
+    ("object_idx", np.int32),    # instance object ordinal; -1 semantic
+    ("category", np.int32),      # instance category id; -1 semantic
+    ("extreme_points", np.int32, (4, 2)),
+    ("blob_crc32", np.uint32),
+])
+
+
+class PackFormatError(RuntimeError):
+    """The pack directory is missing, torn at the pack level (index crc,
+    truncated bin) or describes a different layout than requested."""
+
+
+class PackedRecordError(RuntimeError):
+    """One record's bytes failed verification (checksum mismatch or a
+    blob extent past the bin file) — the typed never-a-silent-wrong-
+    sample error.  ``index`` is the RAW record index (the id
+    ``dptpu-pack --verify`` reports and ``data.pack_quarantine``
+    takes)."""
+
+    def __init__(self, index: int, path: str, reason: str):
+        self.index = int(index)
+        self.path = path
+        super().__init__(
+            f"packed record {int(index)} of {path} is unreadable "
+            f"({reason}) — the pack is torn/bit-rotted at this record; "
+            f"re-pack with dptpu-pack (or, for the TRAIN pack only, "
+            f"quarantine it: data.pack_quarantine=[{int(index)}]) after "
+            f"`dptpu-pack --verify {path}`")
+
+
+def pack_name(dataset: str, kind: str, splits) -> str:
+    """Canonical pack-directory name for (dataset, kind, splits) — the
+    resolution contract between ``dptpu-pack`` and the trainer."""
+    parts = sorted([splits] if isinstance(splits, str) else list(splits))
+    return f"{dataset}-{kind}-{'_'.join(parts)}"
+
+
+def pack_dir_path(pack_root: str, dataset: str, kind: str, splits) -> str:
+    return os.path.join(pack_root, pack_name(dataset, kind, splits))
+
+
+def pack_command(root: str, out: str, dataset: str, kind: str, splits,
+                 area_thres: int | None = None) -> str:
+    """The exact ``dptpu-pack`` invocation that builds one pack — the one
+    source of truth for the governor's rung-0 recommendation and every
+    missing-pack error message."""
+    parts = sorted([splits] if isinstance(splits, str) else list(splits))
+    cmd = (f"dptpu-pack --root {root or '<data-root>'} --dataset {dataset} "
+           f"--task {kind} --splits {','.join(parts)}")
+    if kind == "instance" and area_thres is not None:
+        cmd += f" --area-thres {int(area_thres)}"
+    return cmd + f" --out {out or '<pack-dir>'}"
+
+
+def pack_commands_for_config(cfg, root: str | None = None) -> list[str]:
+    """Every pack the trainer would open under ``data.source=packed`` for
+    this config (duck-typed: any object with ``.task``/``.data``).  The
+    governor's ``pack_recommendation`` and the trainer's missing-pack
+    errors both name exactly these."""
+    d = cfg.data
+    root = root if root is not None else d.root
+    out = d.pack_path
+    area = d.area_thres if cfg.task == "instance" else None
+    cmds = [pack_command(root, out, "voc", cfg.task, [d.train_split], area),
+            pack_command(root, out, "voc", cfg.task, [d.val_split], area)]
+    if d.sbd_root:
+        cmds.append(pack_command(d.sbd_root, out, "sbd", cfg.task,
+                                 ["train", "val"], area))
+    return cmds
+
+
+# --------------------------------------------------------------- writing
+
+def _dataset_kind(dataset) -> str:
+    return "instance" if hasattr(dataset, "obj_list") else "semantic"
+
+
+def _extreme_points_of(mask: np.ndarray) -> np.ndarray:
+    """Deterministic (pert=0) extreme points of one object mask, (4, 2)
+    int32 in the (x, y) order of ``guidance.extreme_points_fixed``."""
+    from . import guidance
+
+    if not mask.any():
+        return np.zeros((4, 2), np.int32)
+    return np.asarray(guidance.extreme_points_fixed(mask, pert=0),
+                      np.int32)
+
+
+def pack_dataset(dataset, out_dir: str, *, dataset_name: str,
+                 splits, area_thres: int | None = None,
+                 progress: bool = False) -> dict:
+    """Walk ``dataset`` once and write the pack at ``out_dir``; returns
+    the pack meta.  ``dataset`` must be one of the raw filesystem
+    sources (``voc.py``/``sbd.py`` classes — anything exposing
+    ``decode_raw``/``im_ids`` and, for the instance kind,
+    ``obj_list``/``obj_dict``) constructed with ``transform=None``: the
+    pack stores the PRE-transform decoded bytes, so any transform stack
+    runs downstream of the reader exactly as it does off the
+    filesystem."""
+    if getattr(dataset, "transform", None) is not None:
+        raise ValueError(
+            "pack_dataset walks the *untransformed* dataset (construct it "
+            "with transform=None); transforms run downstream of the "
+            "PackedDataset reader, never inside the pack")
+    if getattr(dataset, "default", False):
+        # VOCInstanceSegmentation(default=True) yields the full instance
+        # map as gt; the packed reader always reconstructs the binary
+        # per-object mask — packing that source would silently break the
+        # bit-identical contract, so refuse loudly instead
+        raise ValueError(
+            "pack_dataset supports the standard per-object sample "
+            "contract only; construct the dataset with default=False")
+    if not hasattr(dataset, "decode_raw"):
+        raise TypeError(
+            f"{type(dataset).__name__} exposes no decode_raw(...) — only "
+            "the raw voc.py/sbd.py sources can be packed (wrappers like "
+            "CombinedDataset are combined at READ time from per-source "
+            "packs)")
+    kind = _dataset_kind(dataset)
+    im_ids = list(dataset.im_ids)
+    if kind == "instance":
+        records = [(int(im_ii), int(obj_ii))
+                   for im_ii, obj_ii in dataset.obj_list]
+    else:
+        records = [(i, -1) for i in range(len(dataset))]
+    # records grouped by owning image: decode each image EXACTLY once —
+    # blob write and the per-object extreme points come off one pass
+    recs_by_image: dict[int, list[tuple[int, int]]] = {}
+    for i, (im_ii, obj_ii) in enumerate(records):
+        recs_by_image.setdefault(im_ii, []).append((i, obj_ii))
+    image_indices = sorted(recs_by_image)
+
+    os.makedirs(out_dir, exist_ok=True)
+    meta_path = os.path.join(out_dir, META_NAME)
+    # stale meta is removed FIRST: a pack is only trusted once meta.json
+    # lands (atomically, last) — a crash mid-rewrite leaves no pack, not
+    # an old meta over new bytes
+    if os.path.exists(meta_path):
+        os.remove(meta_path)
+
+    index = np.zeros(len(records), INDEX_DTYPE)
+    with open(os.path.join(out_dir, BIN_NAME), "wb") as f:
+        offset = 0
+        for k, im_ii in enumerate(image_indices):
+            img8, mask = dataset.decode_raw(im_ii)
+            img8 = np.ascontiguousarray(img8, np.uint8)
+            mask = np.ascontiguousarray(mask)
+            if img8.ndim != 3 or img8.shape[2] != 3 \
+                    or mask.shape != img8.shape[:2]:
+                raise ValueError(
+                    f"decode_raw({im_ii}) returned image {img8.shape} / "
+                    f"mask {mask.shape}; want (H, W, 3) uint8 + (H, W)")
+            payload = img8.tobytes() + mask.tobytes()
+            crc = zlib.crc32(payload) & 0xFFFFFFFF
+            f.write(payload)
+            for i, obj_ii in recs_by_image[im_ii]:
+                row = index[i]
+                row["blob_offset"], row["blob_len"] = offset, len(payload)
+                row["height"], row["width"] = img8.shape[:2]
+                row["mask_dtype"] = mask.dtype.str.encode()
+                row["image_idx"] = im_ii
+                row["object_idx"] = obj_ii
+                row["blob_crc32"] = crc
+                if kind == "instance":
+                    row["category"] = int(
+                        dataset.obj_dict[im_ids[im_ii]][obj_ii])
+                    row["extreme_points"] = _extreme_points_of(
+                        mask == obj_ii + 1)
+                else:
+                    row["category"] = -1
+            offset += len(payload)
+            if progress and (k + 1) % 200 == 0:
+                print(f"packed {k + 1}/{len(image_indices)} images",
+                      file=sys.stderr, flush=True)
+        bin_bytes = offset
+    index_bytes = index.tobytes()
+    with open(os.path.join(out_dir, INDEX_NAME), "wb") as f:
+        f.write(index_bytes)
+
+    meta = {
+        "format": FORMAT_VERSION,
+        "kind": kind,
+        "dataset": dataset_name,
+        "splits": sorted([splits] if isinstance(splits, str)
+                         else list(splits)),
+        "source": str(dataset),
+        "n_records": len(records),
+        "n_images": len(image_indices),
+        "area_thres": (int(area_thres) if area_thres is not None
+                       else getattr(dataset, "area_thres", None)),
+        "im_ids": im_ids,
+        "index_crc32": zlib.crc32(index_bytes) & 0xFFFFFFFF,
+        "bin_bytes": bin_bytes,
+    }
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, meta_path)
+    return meta
+
+
+def corrupt_record(path: str, record: int, offset: int = 0) -> int:
+    """Flip one byte of ``record``'s blob ON DISK — the deterministic
+    stand-in for bit rot / a torn pack write (the chaos ``torn_pack``
+    scenario's tear; ``--verify`` must then flag every record sharing
+    the blob).  Returns the absolute file offset flipped."""
+    with open(os.path.join(path, META_NAME)) as f:
+        meta = json.load(f)
+    with open(os.path.join(path, INDEX_NAME), "rb") as f:
+        index = np.frombuffer(f.read(), INDEX_DTYPE)
+    if not 0 <= record < meta["n_records"]:
+        raise IndexError(f"record {record} out of range "
+                         f"[0, {meta['n_records']})")
+    row = index[record]
+    at = int(row["blob_offset"]) + (int(offset) % int(row["blob_len"]))
+    with open(os.path.join(path, BIN_NAME), "r+b") as f:
+        f.seek(at)
+        b = f.read(1)
+        f.seek(at)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return at
+
+
+# --------------------------------------------------------------- reading
+
+class PackedDataset:
+    """Memory-mapped reader over a ``dptpu-pack`` directory — a drop-in
+    random-access source for the ``DataLoader``/transform stack with the
+    exact sample contract of the filesystem classes it replaces
+    (``{'image', 'gt', 'void_pixels'?, 'meta'}``), bit-identical by
+    construction (the reconstruction re-runs ``voc.py``/``sbd.py``'s
+    arithmetic on the stored bytes).
+
+    * every record read is crc32-verified; failure is a typed
+      :class:`PackedRecordError` naming the record index;
+    * ``quarantine``: RAW record indices dropped from the epoch (the
+      recovery move for records ``--verify`` flagged);
+    * ``seek(i)``: O(1) record identity off the index row —
+      ``read=True`` adds the verified pixel payload;
+    * pickles by path (grain process workers reopen the mmap).
+    """
+
+    def __init__(self, path: str, transform=None, quarantine=(),
+                 retname: bool = True, suppress_void_pixels: bool = True,
+                 expect_kind: str | None = None):
+        self.path = path
+        self.transform = transform
+        self.retname = retname
+        self.suppress_void_pixels = suppress_void_pixels
+        meta_path = os.path.join(path, META_NAME)
+        if not os.path.isfile(meta_path):
+            raise PackFormatError(
+                f"no pack at {path} ({META_NAME} missing) — build one "
+                "with dptpu-pack")
+        try:
+            with open(meta_path) as f:
+                self.meta = json.load(f)
+        # ValueError covers JSONDecodeError AND UnicodeDecodeError: a
+        # torn/partially-copied meta.json must surface as the typed
+        # pack-level error (so --verify sweeps and the trainer's
+        # build-it-once guidance keep working), never a raw traceback
+        except ValueError as e:
+            raise PackFormatError(
+                f"{path}/{META_NAME} is unreadable ({e}) — torn or "
+                "partially copied pack; re-pack with dptpu-pack") from e
+        if self.meta.get("format") != FORMAT_VERSION:
+            raise PackFormatError(
+                f"{path} has pack format {self.meta.get('format')}; this "
+                f"reader speaks {FORMAT_VERSION} — re-pack with the "
+                "current dptpu-pack")
+        self.kind = self.meta.get("kind")
+        if self.kind not in KINDS:
+            raise PackFormatError(f"{path} has unknown kind {self.kind!r}")
+        if expect_kind is not None and self.kind != expect_kind:
+            raise PackFormatError(
+                f"{path} is a {self.kind!r} pack but this run needs "
+                f"{expect_kind!r} — pack the matching task")
+        with open(os.path.join(path, INDEX_NAME), "rb") as f:
+            raw = f.read()
+        if (zlib.crc32(raw) & 0xFFFFFFFF) != int(self.meta["index_crc32"]):
+            raise PackFormatError(
+                f"{path}/{INDEX_NAME} fails its checksum — the index is "
+                "torn; re-pack with dptpu-pack")
+        self._index = np.frombuffer(raw, INDEX_DTYPE)
+        if len(self._index) != int(self.meta["n_records"]):
+            raise PackFormatError(
+                f"{path} index holds {len(self._index)} rows but meta "
+                f"says {self.meta['n_records']}")
+        bin_path = os.path.join(path, BIN_NAME)
+        actual = os.path.getsize(bin_path)
+        if actual != int(self.meta["bin_bytes"]):
+            raise PackFormatError(
+                f"{path}/{BIN_NAME} is {actual} bytes but meta says "
+                f"{self.meta['bin_bytes']} — truncated/overgrown pack; "
+                "re-pack with dptpu-pack")
+        self._im_ids = list(self.meta["im_ids"])
+        n = len(self._index)
+        q = sorted({int(i) for i in quarantine})
+        bad = [i for i in q if not 0 <= i < n]
+        if bad:
+            raise ValueError(
+                f"pack_quarantine indices {bad} out of range [0, {n}) "
+                f"for {path}")
+        self.quarantine = tuple(q)
+        self._live = (np.setdiff1d(np.arange(n), np.asarray(q, np.int64))
+                      if q else np.arange(n))
+        self._open_bin()
+
+    def _open_bin(self) -> None:
+        self._bin = np.memmap(os.path.join(self.path, BIN_NAME),
+                              mode="r", dtype=np.uint8)
+
+    # mmap handles don't pickle; the files are the shared state (the
+    # prepared-cache idiom — grain process workers reopen)
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_bin")
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._open_bin()
+
+    # ------------------------------------------------------- protocol
+    def __len__(self) -> int:
+        return len(self._live)
+
+    def record_index(self, index: int) -> int:
+        """RAW record id behind dataset position ``index`` (positions
+        shift when a quarantine drops records; record ids never do)."""
+        return int(self._live[index])
+
+    def sample_image_id(self, index: int) -> str:
+        """Image id owning sample ``index`` — the CombinedDataset
+        exclusion/dedup key, straight off the index row (no blob
+        read)."""
+        row = self._index[self.record_index(index)]
+        return self._im_ids[int(row["image_idx"])]
+
+    def seek(self, index: int, read: bool = False) -> dict:
+        """O(1) record lookup for dataset position ``index``: identity
+        fields (``record``, ``image_id``, ``object``, ``category``,
+        ``im_size``, ``extreme_points``) from the index row alone; with
+        ``read=True`` the verified pixel payload joins as ``image``
+        (uint8 RGB) and ``mask`` (the raw stored mask).  This is the
+        accessor the sentinel's quarantine ledger and the governor's
+        replay arithmetic resolve batch indices through — no sequential
+        re-iteration, no decode."""
+        rec = self.record_index(index)
+        row = self._index[rec]
+        out = {
+            "record": rec,
+            "image_id": self._im_ids[int(row["image_idx"])],
+            "object": (str(int(row["object_idx"]))
+                       if self.kind == "instance" else None),
+            "category": (int(row["category"])
+                         if self.kind == "instance" else None),
+            "im_size": (int(row["height"]), int(row["width"])),
+            "extreme_points": np.array(row["extreme_points"]),
+        }
+        if read:
+            img8, mask = self._read_blob(rec)
+            # copies: seek hands records to introspection/ledger code
+            # that must never hold (or try to write) mmap views
+            out["image"] = img8.copy()
+            out["mask"] = mask.copy()
+        return out
+
+    def _read_blob(self, rec: int) -> tuple[np.ndarray, np.ndarray]:
+        """The verified read of record ``rec``'s pixel payload: one copy
+        out of the mmap, the chaos ``data/packed_read`` seam, the crc32
+        gate, then zero-copy views into the private buffer."""
+        row = self._index[rec]
+        off, ln = int(row["blob_offset"]), int(row["blob_len"])
+        if off < 0 or off + ln > self._bin.size:
+            raise PackedRecordError(
+                rec, self.path,
+                f"blob extent [{off}, {off + ln}) past the "
+                f"{self._bin.size}-byte bin file")
+        # ZERO-COPY view of the mmap (read-only: mode="r"): the crc
+        # below runs over the page cache directly, and every consumer
+        # of the returned views copies before mutating (__getitem__'s
+        # astype, seek's explicit copies) — the decode this read
+        # replaces costs ~8x the checksum, and an extra memcpy here
+        # would hand a third of that win back
+        buf = self._bin[off:off + ln]
+        # chaos seam: a bitflip fault here models bit rot / a torn read
+        # — the crc gate below must catch it, typed, never silent (the
+        # fault flips a PRIVATE copy; the pack bytes are never touched)
+        buf = chaos_sites.fire("data/packed_read", payload=buf,
+                               index=rec, path=self.path)
+        if (zlib.crc32(buf) & 0xFFFFFFFF) != int(row["blob_crc32"]):
+            raise PackedRecordError(rec, self.path, "checksum mismatch")
+        h, w = int(row["height"]), int(row["width"])
+        img8 = buf[:h * w * 3].reshape(h, w, 3)
+        mask = buf[h * w * 3:].view(
+            np.dtype(row["mask_dtype"].decode())).reshape(h, w)
+        return img8, mask
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        rec = self.record_index(int(index))
+        row = self._index[rec]
+        img8, mask = self._read_blob(rec)
+        # the EXACT sample arithmetic of the filesystem classes, re-run
+        # on the stored bytes (voc.py:_load_instance / sbd.py sample
+        # math) — bitwise parity is by construction, pinned by test
+        img = img8.astype(np.float32)
+        if self.kind == "instance":
+            inst = mask.astype(np.float32)
+            void = inst == 255
+            if self.suppress_void_pixels:
+                inst[void] = 0
+            obj_ii = int(row["object_idx"])
+            sample = {"image": img,
+                      "gt": (inst == obj_ii + 1).astype(np.float32),
+                      "void_pixels": void.astype(np.float32)}
+            if self.retname:
+                sample["meta"] = {
+                    "image": self._im_ids[int(row["image_idx"])],
+                    "object": str(obj_ii),
+                    "category": int(row["category"]),
+                    "im_size": (img.shape[0], img.shape[1]),
+                }
+        else:
+            sample = {"image": img, "gt": mask.astype(np.float32)}
+            if self.retname:
+                sample["meta"] = {
+                    "image": self._im_ids[int(row["image_idx"])],
+                    "im_size": (img.shape[0], img.shape[1]),
+                }
+        if self.transform is not None:
+            sample = self.transform(sample, rng)
+        return sample
+
+    def verify(self) -> list[int]:
+        """Re-checksum EVERY record (quarantined included); returns the
+        raw indices that fail — the ``dptpu-pack --verify`` engine."""
+        bad = []
+        for rec in range(len(self._index)):
+            try:
+                self._read_blob(rec)
+            except PackedRecordError:
+                bad.append(rec)
+        return bad
+
+    def __str__(self) -> str:
+        m = self.meta
+        return (f"Packed({m['dataset']}-{m['kind']}-"
+                f"{'_'.join(m['splits'])},n={m['n_records']},"
+                f"idx={int(m['index_crc32']):08x})")
+
+
+def verify_pack(path: str) -> list[int]:
+    """Raw record indices of ``path`` that fail verification."""
+    return PackedDataset(path).verify()
+
+
+def resolve_packed(dataset, index: int):
+    """Unwrap the loader-facing wrappers (CombinedDataset, the prepared
+    caches) around ``dataset`` to the :class:`PackedDataset` owning
+    sample ``index``; returns ``(packed, local_index)`` or ``None`` when
+    the chain bottoms out on a non-packed source.  The trainer resolves
+    quarantined batch indices through this + ``seek`` so the ledger
+    names the exact records."""
+    ds, local = dataset, int(index)
+    for _ in range(16):  # wrappers never nest deeper; bounds a cycle
+        if isinstance(ds, PackedDataset):
+            return ds, local
+        if hasattr(ds, "datasets") and hasattr(ds, "index"):
+            di, local = ds.index[local]
+            ds = ds.datasets[di]
+            continue
+        inner = getattr(ds, "dataset", None)
+        if inner is not None:
+            ds = inner
+            continue
+        return None
+    return None
+
+
+# ------------------------------------------------------------------ CLI
+
+def _build_source(args):
+    """The raw dataset the CLI packs (imports deferred: sbd needs scipy,
+    neither path needs jax)."""
+    splits = [s for s in args.splits.split(",") if s]
+    if args.dataset == "voc":
+        from .voc import VOCInstanceSegmentation, VOCSemanticSegmentation
+
+        if args.task == "instance":
+            ds = VOCInstanceSegmentation(
+                args.root, split=splits, preprocess=True,
+                area_thres=args.area_thres)
+        else:
+            ds = VOCSemanticSegmentation(args.root, split=splits)
+    else:
+        from .sbd import SBDInstanceSegmentation, SBDSemanticSegmentation
+
+        if args.task == "instance":
+            ds = SBDInstanceSegmentation(
+                args.root, split=splits, preprocess=True,
+                area_thres=args.area_thres)
+        else:
+            ds = SBDSemanticSegmentation(args.root, split=splits)
+    return ds, splits
+
+
+def _verify_cli(path: str) -> int:
+    """``--verify``: re-checksum one pack dir, or every pack under a
+    root; non-zero on ANY mismatch, naming the bad record indices."""
+    if os.path.isfile(os.path.join(path, META_NAME)):
+        targets = [path]
+    else:
+        if not os.path.isdir(path):
+            # the mistyped-path case is the common one (every torn-pack
+            # error message points here) — a clean verdict, no traceback
+            print(f"dptpu-pack --verify: no such path {path}",
+                  file=sys.stderr)
+            return 2
+        targets = sorted(
+            os.path.join(path, d) for d in os.listdir(path)
+            if os.path.isfile(os.path.join(path, d, META_NAME)))
+        if not targets:
+            print(f"dptpu-pack --verify: no pack ({META_NAME}) under "
+                  f"{path}", file=sys.stderr)
+            return 2
+    rc = 0
+    for t in targets:
+        try:
+            ds = PackedDataset(t)
+            bad = ds.verify()
+        except (PackFormatError, OSError) as e:
+            print(f"{t}: UNREADABLE ({e})", file=sys.stderr)
+            rc = 1
+            continue
+        if bad:
+            print(f"{t}: {len(bad)} bad record(s): {bad} — re-pack (or, "
+                  f"for the TRAIN pack only, quarantine them: "
+                  f"data.pack_quarantine={bad})",
+                  file=sys.stderr)
+            rc = 1
+        else:
+            print(f"{t}: ok ({ds.meta['n_records']} records)")
+    return rc
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dptpu-pack",
+        description="pack a VOC/SBD dataset into pre-decoded, "
+                    "checksummed, memory-mapped records (the "
+                    "data.source=packed input plane; see docs/DESIGN.md "
+                    "'Packed data plane')")
+    parser.add_argument("--root", help="dataset root (the VOCdevkit / "
+                                       "benchmark_RELEASE parent)")
+    parser.add_argument("--out", help="pack root; the pack lands at "
+                                      "<out>/<dataset>-<task>-<splits>")
+    parser.add_argument("--dataset", choices=("voc", "sbd"),
+                        default="voc")
+    parser.add_argument("--task", choices=KINDS, default="instance")
+    parser.add_argument("--splits", default="train",
+                        help="comma-separated; ONE pack over their "
+                             "union (sbd merge packs train,val)")
+    parser.add_argument("--area-thres", type=int, default=500,
+                        help="instance area filter — MUST match the "
+                             "run's data.area_thres (default mirrors "
+                             "the config default)")
+    parser.add_argument("--verify", metavar="PATH",
+                        help="re-checksum every record of a pack (or "
+                             "every pack under a root) and exit "
+                             "non-zero on any mismatch")
+    args = parser.parse_args(argv)
+    if args.verify:
+        return _verify_cli(args.verify)
+    if not args.root or not args.out:
+        parser.error("--root and --out are required (or use --verify)")
+    ds, splits = _build_source(args)
+    out_dir = pack_dir_path(args.out, args.dataset, args.task, splits)
+    meta = pack_dataset(ds, out_dir, dataset_name=args.dataset,
+                        splits=splits,
+                        area_thres=(args.area_thres
+                                    if args.task == "instance" else None),
+                        progress=True)
+    print(json.dumps({
+        "pack": out_dir, "records": meta["n_records"],
+        "images": meta["n_images"],
+        "bytes": meta["bin_bytes"],
+        "train_with": (f"data.source=packed data.pack_path={args.out}"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

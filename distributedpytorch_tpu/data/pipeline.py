@@ -417,11 +417,12 @@ class DataLoader:
         self.epoch = epoch
         self.start_batch = start_batch
 
-    def _epoch_indices(self) -> np.ndarray:
+    def _epoch_indices(self, epoch: int | None = None) -> np.ndarray:
+        epoch = self.epoch if epoch is None else int(epoch)
         n = len(self.dataset)
         order = np.arange(n)
         if self.shuffle:
-            np.random.default_rng((self.seed, self.epoch)).shuffle(order)
+            np.random.default_rng((self.seed, epoch)).shuffle(order)
         if self.num_shards > 1:
             # Pad the permutation (wrap-around) to a multiple of num_shards so
             # every sample lands in some shard and all shards are equal-length
@@ -438,6 +439,20 @@ class DataLoader:
         if self.drop_last:
             return n_indices // self.batch_size
         return (n_indices + self.batch_size - 1) // self.batch_size
+
+    def batch_sample_indices(self, batch_index: int,
+                             epoch: int | None = None) -> np.ndarray:
+        """Dataset indices of batch ``batch_index`` in ``epoch``'s
+        deterministic order (the current epoch when None) — the O(1)
+        batch -> samples resolution the sentinel's quarantine ledger and
+        the packed-source ``seek`` integration use.  Indexes the FULL
+        epoch order: ``start_batch`` offsets never shift it, so a batch
+        index quarantined mid-run names the same samples on replay.
+        Pure function of ``epoch`` — never mutates loader state, so it
+        is safe while a prefetch producer is mid-epoch."""
+        order = self._epoch_indices(epoch)
+        lo = int(batch_index) * self.batch_size
+        return order[lo:lo + self.batch_size]
 
     def __len__(self) -> int:
         return self._num_batches(len(self._epoch_indices()))

@@ -1,5 +1,14 @@
 """Prepared-sample disk cache: decode→crop→resize stored once, mmap-read after.
 
+LEGACY prepared format: the packed data plane (``data/packed.py``,
+``dptpu-pack``) is the ONE prepared format going forward — it
+pre-decodes the whole source (not just the crop front), checksums every
+record, shards reads by host and gives the governor/sentinel O(1) seek.
+Configs setting ``data.prepared_cache`` get a loud migration pointer at
+trainer build.  These wrappers still work — and compose OVER a packed
+source (``data.source=packed`` + ``prepared_cache``) when caching the
+deterministic crop stage on top is still wanted.
+
 The end-to-end bound on a weak host is the deterministic front of the train
 pipeline — JPEG/PNG decode, mask-bbox crop, fixed resize (BASELINE.md: ~19
 fresh imgs/s e2e vs a ~65 imgs/s chip).  That front is *identical every

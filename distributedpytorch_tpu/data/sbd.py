@@ -135,9 +135,10 @@ class SBDInstanceSegmentation:
         exclusion matches directly)."""
         return self.im_ids[self.obj_list[index][0]]
 
-    def __getitem__(self, index: int,
-                    rng: np.random.Generator | None = None) -> dict:
-        im_ii, obj_ii = self.obj_list[index]
+    def decode_raw(self, im_ii: int) -> tuple[np.ndarray, np.ndarray]:
+        """Decoded (uint8 RGB, raw GTinst instance mask) for image
+        ``im_ii`` — the packer's source bytes (data/packed.py re-runs
+        this class's sample arithmetic on them, bit-identically)."""
         im_id = self.im_ids[im_ii]
 
         def decode():
@@ -147,8 +148,14 @@ class SBDInstanceSegmentation:
                 os.path.join(self._inst_dir, im_id + ".mat"), "GTinst")
             return img8, np.asarray(gt.Segmentation)
 
-        img8, inst_raw = (self._cache.get(im_ii, decode)
-                          if self._cache is not None else decode())
+        return (self._cache.get(im_ii, decode)
+                if self._cache is not None else decode())
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        im_ii, obj_ii = self.obj_list[index]
+        im_id = self.im_ids[im_ii]
+        img8, inst_raw = self.decode_raw(im_ii)
         # astype COPIES — cached arrays are never mutated downstream
         img = img8.astype(np.float32)
         inst = inst_raw.astype(np.float32)
@@ -229,8 +236,9 @@ class SBDSemanticSegmentation:
     def sample_image_id(self, index: int) -> str:
         return self.im_ids[index]
 
-    def __getitem__(self, index: int,
-                    rng: np.random.Generator | None = None) -> dict:
+    def decode_raw(self, index: int) -> tuple[np.ndarray, np.ndarray]:
+        """Decoded (uint8 RGB, raw GTcls class-id mask) for image
+        ``index`` — the packer's source bytes."""
         im_id = self.im_ids[index]
 
         def decode():
@@ -240,8 +248,13 @@ class SBDSemanticSegmentation:
                 os.path.join(self._cls_dir, im_id + ".mat"), "GTcls")
             return img8, np.asarray(gt.Segmentation)
 
-        img8, gt_raw = (self._cache.get(index, decode)
-                        if self._cache is not None else decode())
+        return (self._cache.get(index, decode)
+                if self._cache is not None else decode())
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        im_id = self.im_ids[index]
+        img8, gt_raw = self.decode_raw(index)
         img = img8.astype(np.float32)  # astype copies; cache never mutated
         sample = {"image": img, "gt": gt_raw.astype(np.float32)}
         if self.retname:

@@ -319,16 +319,24 @@ class VOCInstanceSegmentation:
             sample = self.transform(sample, rng)
         return sample
 
-    def _load_instance(self, im_ii: int, obj_ii: int):
-        """Decode one (image, object) pair (reference pascal.py:232-263;
-        the computed-but-discarded other-class masks are not reproduced)."""
+    def decode_raw(self, im_ii: int) -> tuple[np.ndarray, np.ndarray]:
+        """The decoded pair for image ``im_ii`` — (uint8 RGB, raw
+        instance mask), exactly the arrays the sample math consumes.
+        Public because the packer (data/packed.py) stores these bytes
+        and re-runs ``__getitem__``'s arithmetic on them, which is what
+        makes packed samples bit-identical to this class's."""
         def decode():
             return (np.array(Image.open(self.images[im_ii]).convert("RGB"),
                              np.uint8),
                     np.array(Image.open(self.masks[im_ii])))
 
-        img8, inst_raw = (self._cache.get(im_ii, decode)
-                          if self._cache is not None else decode())
+        return (self._cache.get(im_ii, decode)
+                if self._cache is not None else decode())
+
+    def _load_instance(self, im_ii: int, obj_ii: int):
+        """Decode one (image, object) pair (reference pascal.py:232-263;
+        the computed-but-discarded other-class masks are not reproduced)."""
+        img8, inst_raw = self.decode_raw(im_ii)
         # astype COPIES, so the cached uint8 arrays are never mutated by the
         # void-suppression below or by downstream transforms.
         img = img8.astype(np.float32)
@@ -403,15 +411,21 @@ class VOCSemanticSegmentation:
         """Image id of sample ``index`` (CombinedDataset exclusion key)."""
         return self.im_ids[index]
 
-    def __getitem__(self, index: int,
-                    rng: np.random.Generator | None = None) -> dict:
+    def decode_raw(self, index: int) -> tuple[np.ndarray, np.ndarray]:
+        """Decoded (uint8 RGB, raw class-id mask) for image ``index`` —
+        the packer's source bytes (see the instance class's
+        ``decode_raw``)."""
         def decode():
             return (np.array(Image.open(self.images[index]).convert("RGB"),
                              np.uint8),
                     np.array(Image.open(self.categories[index])))
 
-        img8, gt_raw = (self._cache.get(index, decode)
-                        if self._cache is not None else decode())
+        return (self._cache.get(index, decode)
+                if self._cache is not None else decode())
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        img8, gt_raw = self.decode_raw(index)
         img = img8.astype(np.float32)  # astype copies; cache never mutated
         gt = gt_raw.astype(np.float32)
         sample = {"image": img, "gt": gt}

@@ -26,6 +26,27 @@ from typing import Any
 
 @dataclass
 class DataConfig:
+    source: str = "fs"                  # fs | packed: where samples come
+                                        # from.  'fs' decodes JPEG/PNG
+                                        # per sample off the dataset
+                                        # tree; 'packed' memory-maps the
+                                        # pre-decoded, checksummed
+                                        # records dptpu-pack wrote
+                                        # (data/packed.py — no per-
+                                        # sample decode, O(1) seek, the
+                                        # governor's rung 0).  Samples
+                                        # are bit-identical either way.
+    pack_path: str = ""                 # source=packed: the pack ROOT
+                                        # dptpu-pack --out wrote; the
+                                        # trainer opens
+                                        # <pack_path>/<dataset>-<task>-
+                                        # <splits> per source
+    pack_quarantine: tuple[int, ...] = ()
+                                        # source=packed: RAW record
+                                        # indices dropped from the TRAIN
+                                        # pack's epoch (the recovery
+                                        # move for records `dptpu-pack
+                                        # --verify` flagged as torn)
     root: str = ""                      # dataset root (was: the mypath module)
     sbd_root: str = ""                  # set: merge SBD into training via
                                         # CombinedDataset, excluding the
@@ -564,7 +585,8 @@ def _from_dict(cls, d: dict):
             v = _from_dict(ftype, v)
         elif f.name in ("crop_size", "rots", "scales", "loss_weights",
                         "eval_thresholds", "eval_tta_scales",
-                        "freeze", "val_max_im_size") and isinstance(v, list):
+                        "freeze", "val_max_im_size",
+                        "pack_quarantine") and isinstance(v, list):
             v = tuple(v)
         kwargs[f.name] = v
     return cls(**kwargs)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import sys
 import time
 
 import jax
@@ -157,6 +158,9 @@ class _TrainerFeedActuators(FeedActuators):
         # it — constant LR (the default) is unaffected
         self._t._echo = max(1, int(factor))
 
+    def pack_status(self) -> tuple[bool, str | None]:
+        return self._t._pack_status()
+
 
 class Trainer:
     """Build once, ``fit()`` to train, ``validate()`` to eval.
@@ -195,6 +199,35 @@ class Trainer:
                 "multi-class")
         if cfg.data.echo < 1:
             raise ValueError(f"data.echo must be >= 1, got {cfg.data.echo}")
+        if cfg.data.source not in ("fs", "packed"):
+            raise ValueError(
+                f"data.source must be 'fs' or 'packed', got "
+                f"{cfg.data.source!r}")
+        if cfg.data.source == "packed" and not cfg.data.pack_path:
+            raise ValueError(
+                "data.source=packed needs data.pack_path — the pack root "
+                "dptpu-pack --out wrote (pack once, mmap forever; see "
+                "docs/QUICKSTART.md 'Packing a dataset')")
+        if cfg.data.pack_quarantine and cfg.data.source != "packed":
+            raise ValueError(
+                "data.pack_quarantine names records of a pack — it needs "
+                "data.source=packed")
+        if cfg.data.prepared_cache and cfg.data.source != "packed" \
+                and self.is_main:
+            # migration pointer (loud, once): the packed data plane is
+            # the ONE prepared format going forward — it pre-decodes the
+            # whole source, shards reads by host and gives the governor/
+            # sentinel O(1) seek; the prepared crop cache still works
+            # but is legacy.  prepared OVER a packed source is the
+            # blessed composition — no note for runs already packed.
+            from ..data.packed import pack_commands_for_config
+            print(
+                "note: data.prepared_cache is the LEGACY prepared format "
+                "— the packed data plane (data/packed.py) supersedes it: "
+                "pack once with `"
+                + " && ".join(pack_commands_for_config(cfg))
+                + "` and set data.source=packed data.pack_path=<out>",
+                file=sys.stderr, flush=True)
         if cfg.data.governor not in GOVERNOR_MODES:
             raise ValueError(
                 f"data.governor must be one of {GOVERNOR_MODES}, got "
@@ -290,6 +323,9 @@ class Trainer:
             if err:
                 raise RuntimeError(f"VOC download failed on process 0 "
                                    f"({err})")
+        #: the resolved dataset root (fake fixtures land under the run
+        #: dir) — the governor's pack_recommendation names it
+        self._data_root = root
         if cfg.data.packbits_masks and not (
                 cfg.data.uint8_transfer and cfg.task == "instance"):
             raise ValueError(
@@ -372,15 +408,26 @@ class Trainer:
                 crop_size=cfg.data.crop_size, relax=cfg.data.relax,
                 zero_pad=cfg.data.zero_pad, alpha=cfg.data.guidance_alpha,
                 guidance=cfg.data.guidance)
-            # download (if requested) already happened above, gated+barriered
-            self.train_set = VOCInstanceSegmentation(
-                root, split=cfg.data.train_split, transform=train_tf,
-                preprocess=True, area_thres=cfg.data.area_thres,
-                decode_cache=cfg.data.decode_cache)
-            self.val_set = VOCInstanceSegmentation(
-                root, split=cfg.data.val_split, transform=val_tf,
-                preprocess=True, area_thres=cfg.data.area_thres,
-                decode_cache=cfg.data.decode_cache)
+            if cfg.data.source == "packed":
+                # pre-decoded mmap records (data/packed.py): no dataset
+                # walk, no per-sample decode — samples bit-identical to
+                # the fs classes by construction
+                self.train_set = self._open_pack(
+                    "voc", [cfg.data.train_split], train_tf,
+                    quarantine=cfg.data.pack_quarantine)
+                self.val_set = self._open_pack(
+                    "voc", [cfg.data.val_split], val_tf)
+            else:
+                # download (if requested) already happened above,
+                # gated+barriered
+                self.train_set = VOCInstanceSegmentation(
+                    root, split=cfg.data.train_split, transform=train_tf,
+                    preprocess=True, area_thres=cfg.data.area_thres,
+                    decode_cache=cfg.data.decode_cache)
+                self.val_set = VOCInstanceSegmentation(
+                    root, split=cfg.data.val_split, transform=val_tf,
+                    preprocess=True, area_thres=cfg.data.area_thres,
+                    decode_cache=cfg.data.decode_cache)
             if val_prep:
                 from ..data import PreparedInstanceDataset
                 from ..data.pipeline import build_prepared_eval_post_transform
@@ -402,12 +449,16 @@ class Trainer:
                 # the reference's use_sbd recipe (train_pascal.py:150-154),
                 # live: merge SBD train+val, drop its VOC-val overlap
                 from ..data import CombinedDataset, SBDInstanceSegmentation
-                sbd = SBDInstanceSegmentation(
-                    cfg.data.sbd_root, split=["train", "val"],
-                    transform=train_tf,
-                    preprocess=True,  # same always-rebuild policy as VOC
-                    area_thres=cfg.data.area_thres,
-                    decode_cache=cfg.data.decode_cache)
+                if cfg.data.source == "packed":
+                    sbd = self._open_pack("sbd", ["train", "val"],
+                                          train_tf)
+                else:
+                    sbd = SBDInstanceSegmentation(
+                        cfg.data.sbd_root, split=["train", "val"],
+                        transform=train_tf,
+                        preprocess=True,  # same always-rebuild as VOC
+                        area_thres=cfg.data.area_thres,
+                        decode_cache=cfg.data.decode_cache)
                 self.train_set = CombinedDataset(
                     [self.train_set, sbd], excluded=[self.val_set])
             if prepared:
@@ -440,9 +491,15 @@ class Trainer:
                     flip=not cfg.data.device_augment,
                     geom=not (cfg.data.device_augment
                               and cfg.data.device_augment_geom))
-            self.train_set = VOCSemanticSegmentation(
-                root, split=cfg.data.train_split, transform=sem_train_tf,
-                decode_cache=cfg.data.decode_cache)
+            if cfg.data.source == "packed":
+                self.train_set = self._open_pack(
+                    "voc", [cfg.data.train_split], sem_train_tf,
+                    quarantine=cfg.data.pack_quarantine)
+            else:
+                self.train_set = VOCSemanticSegmentation(
+                    root, split=cfg.data.train_split,
+                    transform=sem_train_tf,
+                    decode_cache=cfg.data.decode_cache)
             # Val has no decode cache (one sample per image, scanned
             # sequentially — an LRU smaller than the split gets zero hits).
             # Built before the SBD merge so the merge can exclude its
@@ -458,12 +515,17 @@ class Trainer:
             # native-resolution gt caches as padded uint8 id rows,
             # emitted ragged as ``gt_full``.
             sem_val_prep = prepared and cfg.data.val_prepared
-            self.val_set = VOCSemanticSegmentation(
-                root, split=cfg.data.val_split,
-                transform=None if sem_val_prep else
+            sem_val_tf = None if sem_val_prep else \
                 build_semantic_eval_transform(
                     crop_size=cfg.data.crop_size,
-                    keep_fullres=cfg.eval_full_res))
+                    keep_fullres=cfg.eval_full_res)
+            if cfg.data.source == "packed":
+                self.val_set = self._open_pack(
+                    "voc", [cfg.data.val_split], sem_val_tf)
+            else:
+                self.val_set = VOCSemanticSegmentation(
+                    root, split=cfg.data.val_split,
+                    transform=sem_val_tf)
             if sem_val_prep:
                 from ..data.pipeline import (
                     build_prepared_semantic_eval_post_transform,
@@ -481,10 +543,14 @@ class Trainer:
             if cfg.data.sbd_root:
                 from ..data import CombinedDataset
                 from ..data.sbd import SBDSemanticSegmentation
-                sbd = SBDSemanticSegmentation(
-                    cfg.data.sbd_root, split=["train", "val"],
-                    transform=sem_train_tf,
-                    decode_cache=cfg.data.decode_cache)
+                if cfg.data.source == "packed":
+                    sbd = self._open_pack("sbd", ["train", "val"],
+                                          sem_train_tf)
+                else:
+                    sbd = SBDSemanticSegmentation(
+                        cfg.data.sbd_root, split=["train", "val"],
+                        transform=sem_train_tf,
+                        decode_cache=cfg.data.decode_cache)
                 self.train_set = CombinedDataset(
                     [self.train_set, sbd], excluded=[self.val_set])
             if prepared:
@@ -793,6 +859,63 @@ class Trainer:
         train_pascal.py:105)."""
         return sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(self.state.params))
+
+    # ---------------------------------------------------- packed source
+    def _open_pack(self, dataset_name: str, splits, transform,
+                   quarantine=()):
+        """Open one ``dptpu-pack`` directory under ``data.pack_path`` as
+        this run's source for (dataset, task, splits).  A missing or
+        mismatched pack fails LOUDLY with the exact ``dptpu-pack``
+        invocation that builds it — the operator's move, named."""
+        from ..data.packed import (
+            PackedDataset,
+            PackFormatError,
+            pack_command,
+            pack_dir_path,
+        )
+
+        cfg = self.cfg
+        path = pack_dir_path(cfg.data.pack_path, dataset_name, cfg.task,
+                             splits)
+        root = (cfg.data.sbd_root if dataset_name == "sbd"
+                else self._data_root)
+        cmd = pack_command(root, cfg.data.pack_path, dataset_name,
+                           cfg.task, splits,
+                           cfg.data.area_thres if cfg.task == "instance"
+                           else None)
+        try:
+            ds = PackedDataset(path, transform=transform,
+                               quarantine=quarantine,
+                               expect_kind=cfg.task)
+        except (OSError, PackFormatError) as e:
+            raise ValueError(
+                f"data.source=packed but no readable "
+                f"{dataset_name}/{cfg.task} pack at {path} "
+                f"({type(e).__name__}: {e}) — build it once: `{cmd}`"
+            ) from e
+        if cfg.task == "instance" \
+                and ds.meta.get("area_thres") != cfg.data.area_thres:
+            raise ValueError(
+                f"pack {path} was built with area_thres="
+                f"{ds.meta.get('area_thres')} but this run wants "
+                f"data.area_thres={cfg.data.area_thres} — its instance "
+                f"list differs; re-pack: `{cmd}`")
+        return ds
+
+    def _pack_status(self) -> tuple[bool, str | None]:
+        """The governor's rung-0 input (data/governor.py): is this run
+        already feeding from a pack, and if not, the exact CLI that
+        removes the stall at its source."""
+        cfg = self.cfg
+        if cfg.data.source == "packed":
+            return True, None
+        from ..data.packed import pack_commands_for_config
+        cmds = pack_commands_for_config(cfg, root=self._data_root)
+        return False, (
+            "rung 0 — cheaper than tuning around the stall is deleting "
+            "it: pre-decode the dataset once and train from the mmap "
+            "(data.source=packed data.pack_path=<out>): `"
+            + " && ".join(cmds) + "`")
 
     def _plan_memory_inputs(self) -> tuple:
         """``strategy=auto``'s memory-model inputs: a shape-only
@@ -1772,6 +1895,34 @@ class Trainer:
                   f"{cfg.sentinel.max_rollbacks})", flush=True)
         return resume_epoch
 
+    def _quarantine_records(self, d: _DivergenceDetected) -> list | None:
+        """Resolve the quarantined loader batch indices to the exact
+        packed records through ``PackedDataset.seek`` — O(1) per sample
+        off the pack's index rows.  The batch -> sample mapping is the
+        epoch's deterministic order (``DataLoader.batch_sample_indices``);
+        None when the train source is not packed (or the loader can't
+        map), in which case batch indices remain the ledger's only
+        name."""
+        from ..data.packed import resolve_packed
+
+        mapper = getattr(self.train_loader, "batch_sample_indices", None)
+        if mapper is None or resolve_packed(self.train_set, 0) is None:
+            return None
+        out = []
+        for bi in sorted(d.batch_indices):
+            entries = []
+            for si in mapper(int(bi), epoch=d.epoch):
+                hit = resolve_packed(self.train_set, int(si))
+                if hit is None:  # mixed sources: stay honest, omit all
+                    return None
+                ds, local = hit
+                m = ds.seek(local)
+                entries.append({"record": m["record"],
+                                "image": m["image_id"],
+                                "object": m["object"]})
+            out.append({"batch_index": int(bi), "records": entries})
+        return out
+
     def _book_rollback(self, d: _DivergenceDetected, target: int,
                        seconds: float) -> None:
         """Durable + telemetry record of one rollback: a quarantine.jsonl
@@ -1781,6 +1932,12 @@ class Trainer:
             rec = {"epoch": d.epoch, "step_start": d.step_start,
                    "step_end": d.step_end,
                    "batch_indices": list(d.batch_indices),
+                   # packed source: the quarantined batches resolved to
+                   # the EXACT records via PackedDataset.seek (O(1) off
+                   # the index rows — no re-iteration, no decode); null
+                   # on fs sources, where batch indices are the only
+                   # stable name
+                   "records": self._quarantine_records(d),
                    # JSON has no NaN/Inf: non-finite observed losses are
                    # null (the same rule JsonlWriter applies)
                    "losses": [x if np.isfinite(x) else None

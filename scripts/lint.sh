@@ -34,7 +34,12 @@
 #      step programs (the checked-in cpu8 contracts pin exactly that:
 #      consensus allgathers never appear in a compiled step), and the
 #      elastic supervisor must stay a stdlib process that never
-#      imports jax) plus bench.py, the official record.
+#      imports jax; data/packed.py included — the packed data plane's
+#      reader sits on the loader hot path (one crc32 + one memcpy per
+#      record, numpy + stdlib ONLY: it must stay importable pre-jax,
+#      and its chaos seam must cost one attribute check disabled) and
+#      the dptpu-pack CLI never touches a device) plus bench.py, the
+#      official record.
 #   2. jaxaudit check — IR-level compile contracts: the canonical
 #      train/eval/serve programs (incl. the session split's
 #      encode_step/decode_step, train_step_bf16 — the mixed-

@@ -425,6 +425,46 @@ class TestCheckRegression:
         assert blk["topology_changes"] == 3 and blk["replans"] == 2
         assert blk["recovery_p50_s"] == 1.5
 
+    def test_feed_source_variants_never_cross_compare(self, tmp_path):
+        # a packed-plane record (DPTPU_BENCH_SOURCE=packed) and an fs
+        # one measure different input regimes — the filter keys on
+        # feed.source; a missing source key (pre-pack history, serve
+        # records' feed=null) normalizes to the fs default
+        packed = self._rec(30.0)
+        packed["feed"] = {"input_wait_fraction": 0.0, "governor": None,
+                          "echo_effective": None, "source": "packed"}
+        with open(tmp_path / "BENCH_r01.json", "w") as f:
+            json.dump({"parsed": packed}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        # fs record: different trajectory, never gated by the packed one
+        fs = self._rec(10.0)
+        fs["feed"] = {"input_wait_fraction": 0.0, "governor": None,
+                      "echo_effective": None, "source": "fs"}
+        ok, msg = bench.check_regression(fs, hist)
+        assert ok and "nothing to compare" in msg
+        # the matching packed record DOES gate
+        probe = self._rec(20.0)
+        probe["feed"] = dict(packed["feed"])
+        ok, msg = bench.check_regression(probe, hist)
+        assert not ok and "regression" in msg
+        # pre-pack history (feed block without a source key) still
+        # gates a fresh fs record — missing == "fs"
+        old = self._rec(67.5)
+        old["feed"] = {"input_wait_fraction": 0.0, "governor": None,
+                       "echo_effective": None}
+        with open(tmp_path / "BENCH_r02.json", "w") as f:
+            json.dump({"parsed": old}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        ok, msg = bench.check_regression(fs, hist)
+        assert not ok and "BENCH_r02" in msg
+
+    def test_source_env_is_a_non_default_config(self, monkeypatch):
+        # DPTPU_BENCH_SOURCE is an A/B knob like strategy/precision:
+        # a source variant never gates the default-config trajectory
+        monkeypatch.setenv("DPTPU_BENCH_SOURCE", "packed")
+        assert not bench._is_default_config()
+        monkeypatch.delenv("DPTPU_BENCH_SOURCE")
+
     def test_strategy_env_is_a_non_default_config(self, monkeypatch):
         # DPTPU_BENCH_STRATEGY is an A/B knob: the regression gate must
         # skip it (a dp_tp run is a measurement, not a trajectory point)
@@ -465,14 +505,15 @@ class TestFeedBlock:
         # keys ALWAYS present, null-valued when off (the PR 4 convention)
         assert feed_block(None) == {"input_wait_fraction": None,
                                     "governor": None,
-                                    "echo_effective": None}
+                                    "echo_effective": None,
+                                    "source": "fs"}
         blk = feed_block(
             {"buckets": {"step": 7.0, "compile": 1.0, "input_wait": 2.0,
                          "checkpoint": 99.0, "eval": 99.0}},
-            governor="observe", echo_effective=3)
+            governor="observe", echo_effective=3, source="packed")
         # checkpoint/eval are not feed time: 2 / (7 + 1 + 2)
         assert blk == {"input_wait_fraction": 0.2, "governor": "observe",
-                       "echo_effective": 3}
+                       "echo_effective": 3, "source": "packed"}
         json.dumps(blk)
 
     def test_ungoverned_record_passes_feed_gate(self):

@@ -521,7 +521,8 @@ class TestCLI:
                      "plan_mismatch_restore", "serve_latency_shed",
                      "nan_loss", "nan_loss_legacy",
                      "divergence_rollback", "crash_loop",
-                     "preemption_storm", "input_stall_recovery"):
+                     "preemption_storm", "input_stall_recovery",
+                     "torn_pack"):
             assert name in r.stdout
         r = subprocess.run(
             [sys.executable, "-m", "distributedpytorch_tpu.chaos",

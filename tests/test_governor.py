@@ -201,6 +201,35 @@ class TestLadder:
         stalled_ticks(gov, 2, start_step=5)
         assert gov._wants_escalation  # capped: boundary's turn
 
+    def test_rung0_pack_recommendation_fires_once_for_fs_sources(self):
+        # rung 0 (data/packed.py): an fs-sourced stall's FIRST
+        # escalation logs the exact dptpu-pack invocation, once per
+        # run, applied=false (packing is the operator's move) — and
+        # the prefetch rung still fires at the same tick
+        class FsStub(StubActuators):
+            def pack_status(self):
+                return False, ("dptpu-pack --root /data --dataset voc "
+                               "--task instance --splits train "
+                               "--area-thres 500 --out /packs")
+
+        gov, acts = make_gov(acts=FsStub())
+        stalled_ticks(gov, 4)
+        recs = [d["action"] for d in gov.decisions]
+        assert recs[0] == "pack_recommendation"
+        assert recs.count("pack_recommendation") == 1  # once per run
+        assert recs.count("raise_prefetch") == 2
+        first = gov.decisions[0]
+        assert not first["applied"] and "dptpu-pack" in first["detail"]
+
+    def test_rung0_skipped_when_source_already_packed(self):
+        # a packed source starts the ladder at prefetch: the default
+        # pack_status (True, None) — and legacy duck-typed actuators
+        # without the method — emit no recommendation at all
+        gov, acts = make_gov()  # StubActuators inherits the default
+        stalled_ticks(gov, 4)
+        assert [d["action"] for d in gov.decisions] == \
+            ["raise_prefetch", "raise_prefetch"]
+
     def test_rung1_never_shrinks_an_operator_depth_above_cap(self):
         # data.prefetch=16 (operator) + device at 2: the raise rung must
         # lift ONLY the low side — clamping the high side down to the
@@ -348,15 +377,16 @@ class TestFeedBlock:
     def test_keys_always_present_nulls_when_off(self):
         blk = feed_block(None)
         assert blk == {"input_wait_fraction": None, "governor": None,
-                       "echo_effective": None}
+                       "echo_effective": None, "source": "fs"}
 
     def test_fraction_from_goodput_buckets(self):
         rep = {"buckets": {"step": 6.0, "compile": 2.0, "input_wait": 2.0,
                            "checkpoint": 50.0, "eval": 50.0, "idle": 9.0}}
-        blk = feed_block(rep, governor="observe", echo_effective=2)
+        blk = feed_block(rep, governor="observe", echo_effective=2,
+                         source="packed")
         # checkpoint/eval/idle are NOT feed time: 2 / (6 + 2 + 2)
         assert blk == {"input_wait_fraction": 0.2, "governor": "observe",
-                       "echo_effective": 2}
+                       "echo_effective": 2, "source": "packed"}
 
     def test_json_clean(self):
         json.dumps(feed_block({"buckets": {"step": 1.0}}))

@@ -1,0 +1,643 @@
+"""Packed data plane (data/packed.py): pack-once mmap-forever records.
+
+The acceptance surface of the pod-scale data-plane PR:
+
+* bit-identical samples vs the filesystem pipeline — raw AND through the
+  transform stacks (extreme-points guidance included), for VOC and SBD,
+  with identical epoch order under the same seed;
+* host sharding: 2-process-shaped loaders walk disjoint contiguous
+  slices of ONE global seeded permutation, covering the dataset exactly
+  once per epoch;
+* the measured win: packed per-batch fetch >= 3x faster than the fs
+  decode path on the same data;
+* integrity: every read crc32-verified — bit rot surfaces as the typed
+  PackedRecordError naming the record (chaos seam ``data/packed_read``),
+  ``dptpu-pack --verify`` flags torn records, quarantine-by-index drops
+  them;
+* O(1) ``seek`` + trainer wiring (data.source=packed) incl. the
+  governor's rung-0 pack recommendation and the prepared-cache
+  migration pointer.
+
+Heavy trainer fits are ``slow``-marked; their named fast gates are the
+wiring/validation tests here (TestTrainerPackedWiring) plus the
+sentinel's packed-quarantine pin (test_sentinel.TestPackedQuarantineSeek).
+"""
+
+import json
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.data import packed as packed_lib
+from distributedpytorch_tpu.data.packed import (
+    PackedDataset,
+    PackedRecordError,
+    PackFormatError,
+    pack_dataset,
+    pack_dir_path,
+)
+from distributedpytorch_tpu.data.pipeline import (
+    DataLoader,
+    build_train_transform,
+    collate,
+    sample_rng,
+)
+from distributedpytorch_tpu.data.voc import (
+    VOCInstanceSegmentation,
+    VOCSemanticSegmentation,
+)
+
+
+def _pack(root, pack_root, split="train", area_thres=0):
+    src = VOCInstanceSegmentation(root, split=split, preprocess=True,
+                                  area_thres=area_thres)
+    out = pack_dir_path(pack_root, "voc", "instance", [split])
+    pack_dataset(src, out, dataset_name="voc", splits=[split],
+                 area_thres=area_thres)
+    return src, out
+
+
+@pytest.fixture(scope="module")
+def voc_pack(fake_voc_root, tmp_path_factory):
+    """(fs train dataset, pack root with voc-instance-{train,val})."""
+    pack_root = str(tmp_path_factory.mktemp("packs"))
+    src, _ = _pack(fake_voc_root, pack_root, "train")
+    _pack(fake_voc_root, pack_root, "val")
+    return src, pack_root
+
+
+def _assert_sample_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        if k == "meta":
+            assert a[k] == b[k]
+            continue
+        va, vb = np.asarray(a[k]), np.asarray(b[k])
+        assert va.dtype == vb.dtype and va.shape == vb.shape, k
+        assert np.array_equal(va, vb), k
+
+
+# ------------------------------------------------------------ parity
+
+class TestParity:
+    def test_voc_instance_bitwise_parity(self, voc_pack):
+        src, pack_root = voc_pack
+        pds = PackedDataset(pack_dir_path(pack_root, "voc", "instance",
+                                          ["train"]))
+        assert len(pds) == len(src)
+        for i in range(len(src)):
+            _assert_sample_equal(src[i], pds[i])
+            assert pds.sample_image_id(i) == src.sample_image_id(i)
+
+    def test_voc_semantic_bitwise_parity(self, fake_voc_root, tmp_path):
+        src = VOCSemanticSegmentation(fake_voc_root, split="train")
+        out = pack_dir_path(str(tmp_path), "voc", "semantic", ["train"])
+        pack_dataset(src, out, dataset_name="voc", splits=["train"])
+        pds = PackedDataset(out)
+        assert pds.kind == "semantic" and len(pds) == len(src)
+        for i in range(len(src)):
+            _assert_sample_equal(src[i], pds[i])
+
+    def test_sbd_instance_bitwise_parity(self, tmp_path):
+        pytest.importorskip("scipy")
+        from distributedpytorch_tpu.data import make_fake_sbd
+        from distributedpytorch_tpu.data.sbd import SBDInstanceSegmentation
+
+        root = make_fake_sbd(str(tmp_path / "sbd"), n_images=4,
+                             size=(96, 128), n_val=1, seed=3)
+        src = SBDInstanceSegmentation(root, split=["train", "val"],
+                                      preprocess=True, area_thres=0)
+        out = pack_dir_path(str(tmp_path), "sbd", "instance",
+                            ["train", "val"])
+        pack_dataset(src, out, dataset_name="sbd",
+                     splits=["train", "val"], area_thres=0)
+        pds = PackedDataset(out)
+        assert len(pds) == len(src)
+        for i in range(len(src)):
+            _assert_sample_equal(src[i], pds[i])
+
+    def test_transformed_epoch_is_bitwise_identical(self, fake_voc_root,
+                                                    voc_pack):
+        # the drop-in contract: same transform stack, same loader seed
+        # -> identical epoch ORDER and bitwise-identical batches.  Two
+        # epochs, so the per-epoch permutation reshuffle is covered.
+        _, pack_root = voc_pack
+
+        def loader(source):
+            tf = build_train_transform(crop_size=(64, 64), relax=10)
+            if source == "fs":
+                ds = VOCInstanceSegmentation(
+                    fake_voc_root, split="train", transform=tf,
+                    preprocess=True, area_thres=0)
+            else:
+                ds = PackedDataset(
+                    pack_dir_path(pack_root, "voc", "instance",
+                                  ["train"]), transform=tf)
+            return DataLoader(ds, batch_size=2, shuffle=True, seed=7,
+                              num_workers=0)
+
+        fs, pk = loader("fs"), loader("packed")
+        assert len(fs) == len(pk)
+        for epoch in (0, 1):
+            fs.set_epoch(epoch)
+            pk.set_epoch(epoch)
+            for a, b in zip(fs, pk, strict=True):
+                assert set(a) == set(b)
+                for k in a:
+                    if k == "meta":
+                        assert a[k] == b[k]
+                    else:
+                        assert np.asarray(a[k]).dtype == \
+                            np.asarray(b[k]).dtype
+                        assert np.array_equal(a[k], b[k]), k
+
+    def test_extreme_points_guidance_parity(self, fake_voc_root,
+                                            voc_pack):
+        # the perturbed extreme-points family draws from the per-sample
+        # rng — identical inputs + identical rng -> bitwise-identical
+        # guidance maps through the packed source
+        src, pack_root = voc_pack
+        tf = build_train_transform(crop_size=(64, 64), relax=10,
+                                   guidance="extreme_points")
+        pds = PackedDataset(pack_dir_path(pack_root, "voc", "instance",
+                                          ["train"]), transform=tf)
+        fs = VOCInstanceSegmentation(fake_voc_root, split="train",
+                                     transform=tf, preprocess=True,
+                                     area_thres=0)
+        for i in range(len(fs)):
+            a = fs.__getitem__(i, rng=sample_rng(0, 0, i))
+            b = pds.__getitem__(i, rng=sample_rng(0, 0, i))
+            assert np.array_equal(a["concat"], b["concat"])
+            assert np.array_equal(a["crop_gt"], b["crop_gt"])
+
+
+# ------------------------------------------------------ format / seek
+
+class TestFormatAndSeek:
+    def test_seek_is_index_row_metadata_plus_verified_read(self,
+                                                           voc_pack):
+        from distributedpytorch_tpu.data.guidance import (
+            extreme_points_fixed,
+        )
+
+        src, pack_root = voc_pack
+        pds = PackedDataset(pack_dir_path(pack_root, "voc", "instance",
+                                          ["train"]))
+        for i in (0, len(pds) - 1):
+            m = pds.seek(i)
+            im_ii, obj_ii = src.obj_list[i]
+            assert m["record"] == i  # no quarantine: position == record
+            assert m["image_id"] == src.im_ids[im_ii]
+            assert m["object"] == str(obj_ii)
+            assert m["category"] == src.obj_dict[src.im_ids[im_ii]][obj_ii]
+            img8, mask = src.decode_raw(im_ii)
+            assert m["im_size"] == img8.shape[:2]
+            # the packed extreme points ARE the deterministic (pert=0)
+            # extreme points of the record's object mask
+            assert np.array_equal(
+                m["extreme_points"],
+                np.asarray(extreme_points_fixed(mask == obj_ii + 1,
+                                                pert=0), np.int32))
+            full = pds.seek(i, read=True)
+            assert np.array_equal(full["image"], img8)
+            assert np.array_equal(full["mask"], mask)
+
+    def test_pickle_reopens_the_mmap(self, voc_pack):
+        _, pack_root = voc_pack
+        pds = PackedDataset(pack_dir_path(pack_root, "voc", "instance",
+                                          ["train"]))
+        clone = pickle.loads(pickle.dumps(pds))
+        _assert_sample_equal(pds[0], clone[0])
+
+    def test_quarantine_drops_named_records(self, voc_pack):
+        _, pack_root = voc_pack
+        path = pack_dir_path(pack_root, "voc", "instance", ["train"])
+        full = PackedDataset(path)
+        q = PackedDataset(path, quarantine=(1,))
+        assert len(q) == len(full) - 1
+        assert [q.record_index(i) for i in range(len(q))] == \
+            [r for r in range(len(full)) if r != 1]
+        _assert_sample_equal(q[1], full[2])  # positions shift past it
+        with pytest.raises(ValueError, match="out of range"):
+            PackedDataset(path, quarantine=(len(full),))
+
+    def test_open_errors_are_typed_and_name_dptpu_pack(self, voc_pack,
+                                                       tmp_path):
+        _, pack_root = voc_pack
+        with pytest.raises(PackFormatError, match="dptpu-pack"):
+            PackedDataset(str(tmp_path / "nope"))
+        with pytest.raises(PackFormatError, match="instance"):
+            PackedDataset(pack_dir_path(pack_root, "voc", "instance",
+                                        ["train"]),
+                          expect_kind="semantic")
+        # a truncated bin fails LOUDLY at open (pack-level tear)
+        import shutil
+        broken = str(tmp_path / "broken")
+        shutil.copytree(pack_dir_path(pack_root, "voc", "instance",
+                                      ["train"]), broken)
+        with open(os.path.join(broken, packed_lib.BIN_NAME), "r+b") as f:
+            f.truncate(100)
+        with pytest.raises(PackFormatError, match="re-pack"):
+            PackedDataset(broken)
+        # a torn meta.json (partial copy) is the TYPED pack error too —
+        # never a raw JSONDecodeError past --verify sweeps
+        torn = str(tmp_path / "torn_meta")
+        shutil.copytree(pack_dir_path(pack_root, "voc", "instance",
+                                      ["train"]), torn)
+        mp = os.path.join(torn, packed_lib.META_NAME)
+        with open(mp, "r+b") as f:
+            f.truncate(os.path.getsize(mp) // 2)
+        with pytest.raises(PackFormatError, match="unreadable"):
+            PackedDataset(torn)
+        assert packed_lib.main(["--verify", torn]) != 0  # sweep survives
+
+    def test_combined_dataset_composes_and_resolves(self, voc_pack):
+        from distributedpytorch_tpu.data import CombinedDataset
+
+        _, pack_root = voc_pack
+        tr = PackedDataset(pack_dir_path(pack_root, "voc", "instance",
+                                         ["train"]))
+        va = PackedDataset(pack_dir_path(pack_root, "voc", "instance",
+                                         ["val"]))
+        both = CombinedDataset([tr, va])
+        assert len(both) == len(tr) + len(va)
+        ds, local = packed_lib.resolve_packed(both, len(tr))
+        assert ds is va and local == 0
+
+    def test_prepared_cache_composes_over_a_packed_source(self, voc_pack,
+                                                          tmp_path):
+        # the one-prepared-format story: the legacy crop cache still
+        # WORKS, layered over the packed source when wanted
+        from distributedpytorch_tpu.data import PreparedInstanceDataset
+
+        _, pack_root = voc_pack
+        pds = PackedDataset(pack_dir_path(pack_root, "voc", "instance",
+                                          ["train"]))
+        prep = PreparedInstanceDataset(pds, str(tmp_path / "cache"),
+                                       crop_size=(48, 48), relax=10)
+        s = prep[0]
+        assert s["crop_image"].shape == (48, 48, 3)
+        assert prep.n_prepared >= 1
+        ds, local = packed_lib.resolve_packed(prep, 3)
+        assert ds is pds and local == 3
+
+
+# --------------------------------------------------------- sharding
+
+class TestHostSharding:
+    def test_two_process_shards_disjoint_cover_once_same_permutation(
+            self, voc_pack):
+        # the 2-process-shaped acceptance: every "host" computes the
+        # SAME seeded global permutation (consensus-free determinism)
+        # and walks only its contiguous slice — disjoint modulo the
+        # equal-length wrap pad, covering the dataset exactly once per
+        # epoch
+        _, pack_root = voc_pack
+        path = pack_dir_path(pack_root, "voc", "instance", ["train"])
+        n = len(PackedDataset(path))
+        shards = [
+            DataLoader(PackedDataset(path), batch_size=2, shuffle=True,
+                       seed=11, num_workers=0, shard_index=k,
+                       num_shards=2)
+            for k in range(2)
+        ]
+        for epoch in (0, 1):
+            want = np.arange(n)
+            np.random.default_rng((11, epoch)).shuffle(want)
+            per = -(-n // 2)
+            padded = np.concatenate([want, want[: per * 2 - n]])
+            orders = []
+            for k, ld in enumerate(shards):
+                ld.set_epoch(epoch)
+                orders.append(ld._epoch_indices())
+                # each host's slice is CONTIGUOUS in the global
+                # permutation it computed identically
+                assert np.array_equal(orders[k],
+                                      padded[k * per:(k + 1) * per])
+            # disjoint + full cover: every record exactly once per
+            # epoch (the wrap pad re-issues total-n of them, by
+            # construction equal-length shards)
+            union = np.concatenate(orders)
+            counts = np.bincount(union, minlength=n)
+            assert counts.min() >= 1 and counts.sum() == per * 2
+            assert (counts > 1).sum() == per * 2 - n
+
+    def test_loader_batches_match_permutation_samples(self, voc_pack):
+        # the shard's loader really SERVES the records its permutation
+        # slice names, in order (identity read back from batch metas)
+        src, pack_root = voc_pack
+        path = pack_dir_path(pack_root, "voc", "instance", ["train"])
+        ld = DataLoader(PackedDataset(path), batch_size=2, shuffle=True,
+                        seed=11, num_workers=0, shard_index=1,
+                        num_shards=2)
+        ld.set_epoch(0)
+        order = ld._epoch_indices()
+        metas = [m for b in ld for m in b["meta"]]
+        for idx, m in zip(order, metas, strict=True):
+            im_ii, obj_ii = src.obj_list[int(idx)]
+            assert m["image"] == src.im_ids[im_ii]
+            assert m["object"] == str(obj_ii)
+
+
+# ------------------------------------------------------ integrity
+
+class TestChecksum:
+    def test_bitflip_chaos_seam_raises_typed_error(self, voc_pack):
+        from distributedpytorch_tpu.chaos import sites
+        from distributedpytorch_tpu.chaos.faults import FaultPlan
+
+        _, pack_root = voc_pack
+        pds = PackedDataset(pack_dir_path(pack_root, "voc", "instance",
+                                          ["train"]))
+        plan = FaultPlan.from_dict({"seed": 0, "faults": [
+            {"site": "data/packed_read", "kind": "bitflip", "at": [1]}]})
+        with sites.armed_plan(plan):
+            with pytest.raises(PackedRecordError, match="record 2"):
+                pds[2]
+        # the flip poisoned a PRIVATE buffer, never the pack: clean read
+        pds[2]
+
+    def test_on_disk_tear_verify_and_quarantine(self, fake_voc_root,
+                                                tmp_path):
+        src, out = _pack(fake_voc_root, str(tmp_path), "train")
+        assert packed_lib.verify_pack(out) == []
+        packed_lib.corrupt_record(out, 2, offset=17)
+        bad = packed_lib.verify_pack(out)
+        assert 2 in bad  # siblings sharing the image blob flag too
+        pds = PackedDataset(out)
+        with pytest.raises(PackedRecordError) as ei:
+            pds[2]
+        assert ei.value.index == 2 and "quarantine" in str(ei.value)
+        # quarantine-by-index: the torn records drop, the rest read
+        # clean and stay bit-identical to the fs source
+        q = PackedDataset(out, quarantine=bad)
+        assert len(q) == len(src) - len(bad)
+        for i in range(len(q)):
+            _assert_sample_equal(q[i], src[q.record_index(i)])
+        # re-packing heals
+        _pack(fake_voc_root, str(tmp_path), "train")
+        assert packed_lib.verify_pack(out) == []
+
+    def test_bitflip_fault_kind_contract(self):
+        from distributedpytorch_tpu.chaos.faults import (
+            KINDS,
+            FaultPlan,
+            FaultSpec,
+            flip_payload_byte,
+        )
+
+        assert "bitflip" in KINDS
+        spec = FaultSpec("data/packed_read", "bitflip", at=[1], offset=5)
+        plan = FaultPlan.from_dict(
+            {"seed": 0, "faults": [spec.to_dict()]})
+        assert plan.faults[0].offset == 5
+        buf = np.arange(16, dtype=np.uint8)
+        out = flip_payload_byte(buf, 5)
+        assert out[5] == buf[5] ^ 0xFF
+        assert (out != buf).sum() == 1 and buf[5] == 5  # source intact
+        assert flip_payload_byte("not-an-array") == "not-an-array"
+
+
+# ------------------------------------------------------------- CLI
+
+class TestCLI:
+    def test_pack_and_verify_cli(self, fake_voc_root, tmp_path, capsys):
+        out = str(tmp_path / "packs")
+        rc = packed_lib.main(["--root", fake_voc_root, "--out", out,
+                              "--dataset", "voc", "--task", "instance",
+                              "--splits", "train", "--area-thres", "0"])
+        assert rc == 0
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        path = pack_dir_path(out, "voc", "instance", ["train"])
+        assert rec["pack"] == path and rec["records"] > 0
+        assert packed_lib.main(["--verify", out]) == 0  # root form
+        packed_lib.corrupt_record(path, 0)
+        assert packed_lib.main(["--verify", path]) != 0
+        err = capsys.readouterr().err
+        assert "bad record" in err and "pack_quarantine" in err
+
+    def test_pack_command_builder_names_everything(self):
+        cmd = packed_lib.pack_command("/data", "/packs", "voc",
+                                      "instance", ["train"],
+                                      area_thres=500)
+        assert cmd == ("dptpu-pack --root /data --dataset voc --task "
+                       "instance --splits train --area-thres 500 "
+                       "--out /packs")
+
+
+# --------------------------------------------------------- measured win
+
+class TestMeasuredWin:
+    def test_packed_fetch_at_least_3x_faster_than_fs(self, tmp_path):
+        # the acceptance number: fetching a batch's records off the
+        # packed source >= 3x faster than off the filesystem path on
+        # the SAME data.  What's timed is the per-record acquisition —
+        # fs decode (jpg + mask png + the open/walk) vs the pack's
+        # verified mmap read — because that is EXACTLY the work the
+        # pack removes; everything downstream (the float sample
+        # arithmetic, transforms, collate) is bit-identical shared code
+        # on both paths by the parity contract above.  VOC-sized
+        # images (the 120px test fixture makes decode artificially
+        # cheap); measurements interleave fs/packed per record and keep
+        # per-record minima over repeats, so a noisy-neighbor window
+        # inflates both sides instead of flaking the ratio.  Measured
+        # ~8-12x here; 3x is the pinned floor.
+        from distributedpytorch_tpu.data import make_fake_voc
+
+        root = make_fake_voc(str(tmp_path / "voc"), n_images=6,
+                             size=(375, 500), n_val=2, seed=1)
+        src = VOCInstanceSegmentation(root, split="train",
+                                      preprocess=True, area_thres=0)
+        out = pack_dir_path(str(tmp_path), "voc", "instance", ["train"])
+        pack_dataset(src, out, dataset_name="voc", splits=["train"],
+                     area_thres=0)
+        pds = PackedDataset(out)
+        batch = list(range(len(src)))
+        best_fs = [float("inf")] * len(batch)
+        best_pk = [float("inf")] * len(batch)
+        for i in batch:  # warm page/file caches for both sides
+            src.decode_raw(src.obj_list[i][0])
+            pds._read_blob(pds.record_index(i))
+        for _rep in range(4):
+            for i in batch:
+                im_ii = src.obj_list[i][0]
+                t0 = time.perf_counter()
+                src.decode_raw(im_ii)
+                best_fs[i] = min(best_fs[i], time.perf_counter() - t0)
+                rec = pds.record_index(i)
+                t0 = time.perf_counter()
+                pds._read_blob(rec)
+                best_pk[i] = min(best_pk[i], time.perf_counter() - t0)
+        t_fs, t_packed = sum(best_fs), sum(best_pk)
+        assert t_fs >= 3.0 * t_packed, (
+            f"packed record fetch only {t_fs / t_packed:.2f}x faster "
+            f"(fs decode {t_fs * 1e3:.1f}ms vs verified mmap read "
+            f"{t_packed * 1e3:.1f}ms per epoch) — want >= 3x")
+        # and the full sample path (shared arithmetic included) must
+        # still come out ahead — sanity, not the headline pin (the
+        # shared float math bounds it, identically on both sides)
+        t0 = time.perf_counter()
+        for i in batch:
+            src[i]
+        full_fs = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in batch:
+            pds[i]
+        full_pk = time.perf_counter() - t0
+        assert full_pk < full_fs, (
+            f"full packed sample path slower than fs "
+            f"({full_pk * 1e3:.1f}ms vs {full_fs * 1e3:.1f}ms)")
+
+
+# ------------------------------------------------------ trainer wiring
+
+def _cfg(work_dir, **over):
+    from distributedpytorch_tpu.chaos.runner import _build_cfg
+
+    return _build_cfg(over, str(work_dir))
+
+
+class TestTrainerPackedWiring:
+    """Fast gates of the slow packed-fit e2es below: config validation,
+    pack resolution, rung-0 status, the migration pointer."""
+
+    def test_config_round_trip(self):
+        from distributedpytorch_tpu.train.config import (
+            Config,
+            apply_overrides,
+            from_json,
+            to_json,
+        )
+
+        cfg = apply_overrides(Config(), {
+            "data.source": "packed", "data.pack_path": "/p",
+            "data.pack_quarantine": [3, 5]})
+        cfg2 = from_json(to_json(cfg))
+        assert cfg2.data.source == "packed"
+        assert cfg2.data.pack_path == "/p"
+        assert cfg2.data.pack_quarantine == (3, 5)
+        assert Config().data.source == "fs"  # back-compat default
+
+    def test_config_validation(self, tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+
+        with pytest.raises(ValueError, match="data.source"):
+            Trainer(_cfg(tmp_path, **{"data.source": "tape"}))
+        with pytest.raises(ValueError, match="pack_path"):
+            Trainer(_cfg(tmp_path, **{"data.source": "packed"}))
+        with pytest.raises(ValueError, match="pack_quarantine"):
+            Trainer(_cfg(tmp_path, **{"data.pack_quarantine": [1]}))
+
+    def test_missing_pack_names_the_exact_cli(self, tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+
+        with pytest.raises(ValueError, match="dptpu-pack .*--splits "
+                                             "train.*--area-thres 0"):
+            Trainer(_cfg(tmp_path, **{
+                "data.source": "packed",
+                "data.pack_path": str(tmp_path / "nowhere")}))
+
+    def test_area_thres_mismatch_is_loud(self, tmp_path, fake_voc_root):
+        from distributedpytorch_tpu.train import Trainer
+
+        pack_root = str(tmp_path / "packs")
+        _pack(fake_voc_root, pack_root, "train", area_thres=0)
+        _pack(fake_voc_root, pack_root, "val", area_thres=0)
+        with pytest.raises(ValueError, match="area_thres"):
+            Trainer(_cfg(tmp_path, **{
+                "data.source": "packed", "data.pack_path": pack_root,
+                "data.area_thres": 500, "data.fake": False,
+                "data.root": fake_voc_root}))
+
+    def test_packed_trainer_wires_and_reports_rung0_packed(
+            self, tmp_path, fake_voc_root):
+        from distributedpytorch_tpu.chaos.runner import RecordingWriter
+        from distributedpytorch_tpu.train import Trainer
+
+        pack_root = str(tmp_path / "packs")
+        _pack(fake_voc_root, pack_root, "train")
+        _pack(fake_voc_root, pack_root, "val")
+        tr = Trainer(_cfg(tmp_path, **{
+            "data.source": "packed", "data.pack_path": pack_root,
+            "data.fake": False, "data.root": fake_voc_root}),
+            writers=RecordingWriter())
+        try:
+            assert isinstance(tr.train_set, PackedDataset)
+            assert isinstance(tr.val_set, PackedDataset)
+            assert len(tr.train_loader) >= 1
+            # rung 0: already packed -> the ladder starts at prefetch
+            assert tr._pack_status() == (True, None)
+        finally:
+            tr.close()
+
+    def test_fs_trainer_recommends_pack_and_prepared_points_migration(
+            self, tmp_path, fake_voc_root, capsys):
+        from distributedpytorch_tpu.chaos.runner import RecordingWriter
+        from distributedpytorch_tpu.train import Trainer
+
+        tr = Trainer(_cfg(tmp_path, **{
+            "data.fake": False, "data.root": fake_voc_root,
+            "data.prepared_cache": str(tmp_path / "prep")}),
+            writers=RecordingWriter())
+        try:
+            packed, rec = tr._pack_status()
+            assert not packed
+            # rung 0 names the EXACT invocation, resolved root included
+            assert "dptpu-pack" in rec and fake_voc_root in rec
+            assert "--area-thres 0" in rec
+            # legacy prepared cache: loud migration pointer at build
+            err = capsys.readouterr().err
+            assert "LEGACY prepared format" in err and "dptpu-pack" in err
+        finally:
+            tr.close()
+
+
+class TestPackedFitE2E:
+    """Slow packed-source end-to-ends.  Fast gates kept in tier-1:
+    TestTrainerPackedWiring (wiring/validation/rung-0),
+    TestParity.test_transformed_epoch_is_bitwise_identical (the sample
+    stream the fit consumes), TestChecksum (the torn-record unit path),
+    and test_sentinel.TestPackedQuarantineSeek (packed quarantine
+    replay)."""
+
+    @pytest.mark.slow  # two small fits (~1 min)
+    def test_packed_fit_matches_fs_fit_exactly(self, tmp_path,
+                                               fake_voc_root):
+        from distributedpytorch_tpu.chaos.runner import RecordingWriter
+        from distributedpytorch_tpu.train import Trainer
+
+        pack_root = str(tmp_path / "packs")
+        _pack(fake_voc_root, pack_root, "train")
+        _pack(fake_voc_root, pack_root, "val")
+        base = {"data.fake": False, "data.root": fake_voc_root,
+                "epochs": 1, "eval_every": 1}
+        hist = {}
+        for source in ("fs", "packed"):
+            over = dict(base, **{"data.source": source})
+            if source == "packed":
+                over["data.pack_path"] = pack_root
+            tr = Trainer(_cfg(tmp_path / source, **over),
+                         writers=RecordingWriter())
+            hist[source] = tr.fit()
+            tr.close()
+        # bit-identical samples + identical order + same init seed ->
+        # the two trajectories are the SAME computation
+        assert hist["fs"]["train_loss"] == hist["packed"]["train_loss"]
+        assert hist["fs"]["val"][0]["jaccard"] == \
+            hist["packed"]["val"][0]["jaccard"]
+
+    @pytest.mark.slow  # two fits through the real chaos runner (~1 min)
+    def test_torn_pack_scenario(self, tmp_path):
+        from distributedpytorch_tpu.chaos import runner
+
+        report = runner.run_scenario("torn_pack",
+                                     work_dir=str(tmp_path / "w"),
+                                     strict=True)
+        f = report["phases"]["packed_fit"]
+        assert f["typed_error"] == "PackedRecordError"
+        assert f["bad_index"] in f["verify_bad"]
+        assert report["chaos_injected_total"] == {
+            "{kind=bitflip,site=data/packed_read}": 1}

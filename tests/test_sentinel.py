@@ -606,6 +606,91 @@ class TestEchoQuarantine:
             tr.close()
 
 
+class TestPackedQuarantineSeek:
+    """packed source x sentinel (the pod-scale data-plane PR's audit):
+    quarantine resolves batch indices to the EXACT records through
+    PackedDataset.seek — O(1) off the pack's index rows, named in the
+    ledger — and the echo-aware skip still drops ALL echoes of the
+    poisoned batch on replay."""
+
+    def test_packed_quarantine_names_exact_records_and_skips_echoes(
+            self, tmp_path, rollback_voc):
+        from distributedpytorch_tpu.chaos import sites
+        from distributedpytorch_tpu.chaos.faults import FaultPlan
+        from distributedpytorch_tpu.chaos.runner import RecordingWriter
+        from distributedpytorch_tpu.data import packed as packed_lib
+        from distributedpytorch_tpu.data.voc import (
+            VOCInstanceSegmentation,
+        )
+        from distributedpytorch_tpu.train import Trainer
+
+        pack_root = str(tmp_path / "packs")
+        for split in ("train", "val"):
+            src = VOCInstanceSegmentation(rollback_voc, split=split,
+                                          preprocess=True, area_thres=0)
+            packed_lib.pack_dataset(
+                src, packed_lib.pack_dir_path(pack_root, "voc",
+                                              "instance", [split]),
+                dataset_name="voc", splits=[split], area_thres=0)
+        # echo=2: steps 1,2 echo batch 0; steps 3,4 echo batch 1; the
+        # nan at step 4 is batch 1's SECOND echo — quarantine must map
+        # it to loader index 1 and the replay must skip both echoes
+        # (the TestEchoQuarantine contract, now over the packed plane)
+        plan = FaultPlan.from_dict({"seed": 0, "faults": [
+            {"site": "trainer/train_step", "kind": "nan", "at": [4]}]})
+        cfg = _rollback_cfg(tmp_path, rollback_voc,
+                            **{"data.echo": 2,
+                               "data.device_augment": True,
+                               "data.source": "packed",
+                               "data.pack_path": pack_root})
+        with sites.armed_plan(plan):
+            tr = Trainer(cfg, writers=RecordingWriter())
+            nb = len(tr.train_loader)
+            assert nb >= 2
+            history = tr.fit()
+            assert tr._quarantine == {0: {1}}
+            # both echoes of the quarantined batch skipped on replay
+            assert int(tr.state.step) == (nb - 1) * 2
+            assert history["recovery"]["rollbacks"] == 1
+            q = json.loads(open(os.path.join(
+                tr.run_dir, "quarantine.jsonl")).read().strip())
+            assert q["batch_indices"] == [1]
+            # the seek integration: the ledger names the exact records
+            # of loader batch 1 — epoch 0's deterministic order,
+            # resolved O(1) through PackedDataset.seek, no re-iteration
+            [blk] = q["records"]
+            assert blk["batch_index"] == 1
+            idxs = tr.train_loader.batch_sample_indices(1, epoch=0)
+            pds, _ = packed_lib.resolve_packed(tr.train_set, 0)
+            want = []
+            for i in idxs:
+                m = pds.seek(int(i))
+                want.append({"record": m["record"],
+                             "image": m["image_id"],
+                             "object": m["object"]})
+            assert blk["records"] == want
+            tr.close()
+
+    def test_fs_source_ledger_records_null(self, tmp_path, rollback_voc):
+        # fs sources have no O(1) record identity: the ledger keeps
+        # batch indices as the only name, records stays null
+        from distributedpytorch_tpu.chaos import sites
+        from distributedpytorch_tpu.chaos.faults import FaultPlan
+        from distributedpytorch_tpu.chaos.runner import RecordingWriter
+        from distributedpytorch_tpu.train import Trainer
+
+        plan = FaultPlan.from_dict({"seed": 0, "faults": [
+            {"site": "trainer/train_step", "kind": "nan", "at": [2]}]})
+        cfg = _rollback_cfg(tmp_path, rollback_voc)
+        with sites.armed_plan(plan):
+            tr = Trainer(cfg, writers=RecordingWriter())
+            tr.fit()
+            q = json.loads(open(os.path.join(
+                tr.run_dir, "quarantine.jsonl")).read().strip())
+            assert q["records"] is None
+            tr.close()
+
+
 class TestScenariosEndToEnd:
     """The full self-healing acceptance scenarios through the real
     dptpu-chaos runner path."""
