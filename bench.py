@@ -274,6 +274,22 @@ BENCH_GOVERNOR = os.environ.get("DPTPU_BENCH_GOVERNOR") or None
 #: FEED gate: a governed source=packed record must measure stall <=
 #: data.governor_target.  Default: fs.
 BENCH_SOURCE = os.environ.get("DPTPU_BENCH_SOURCE") or "fs"
+#: DPTPU_BENCH_QUANTIZE=int8 serves the --serve benches through the
+#: int8-quantized forward (serve/quantize; per-channel symmetric
+#: weights, dequant-at-use).  The record's `quantization` block carries
+#: the regime (null when unquantized — the `precision` convention) and
+#: keys --check-regression's same-config filter: an int8 record never
+#: baselines the f32 serving trajectory.
+BENCH_QUANTIZE = os.environ.get("DPTPU_BENCH_QUANTIZE") or None
+#: DPTPU_BENCH_AOT_CACHE=DIR threads the --serve benches' warmup
+#: through the AOT executable cache (serve/aot): a warm cache boots
+#: with zero XLA compiles and the record's `cold_start` block shows the
+#: measured warmup-seconds win (aot_cache=hit) vs the cold-compile
+#: baseline (off/miss).  A cold dir is BUILT after the bench so the
+#: next run measures the warm boot — the A/B is two consecutive runs.
+#: The cold_start.aot_cache value keys the same-config filter: an
+#: AOT-warm record never baselines a cold-compile one.
+BENCH_AOT_CACHE = os.environ.get("DPTPU_BENCH_AOT_CACHE") or None
 
 
 def _governor_target() -> float:
@@ -304,7 +320,9 @@ def _is_default_config() -> bool:
             and not os.environ.get("DPTPU_BENCH_PRECISION")
             and not os.environ.get("DPTPU_BENCH_REDUCE_BUCKETS")
             and not os.environ.get("DPTPU_BENCH_STRATEGY")
-            and not os.environ.get("DPTPU_BENCH_SOURCE"))
+            and not os.environ.get("DPTPU_BENCH_SOURCE")
+            and not os.environ.get("DPTPU_BENCH_QUANTIZE")
+            and not os.environ.get("DPTPU_BENCH_AOT_CACHE"))
 
 
 def save_latest_tpu_capture(record: dict) -> None:
@@ -426,6 +444,14 @@ def _feed_source(record: dict) -> str:
     return feed.get("source") or "fs"
 
 
+def _cold_start_aot(record: dict) -> str:
+    """The record's cold_start.aot_cache, normalized: records predating
+    the AOT cache (and train records, whose ``cold_start`` is null)
+    read as the ``off`` default."""
+    cold = record.get("cold_start") or {}
+    return cold.get("aot_cache") or "off"
+
+
 def check_regression(record: dict, history: list | None = None,
                      threshold: float = REGRESSION_THRESHOLD
                      ) -> tuple[bool, str]:
@@ -453,6 +479,16 @@ def check_regression(record: dict, history: list | None = None,
              # neither may baseline the other.  Missing key == fs (the
              # default), so pre-pack committed history still compares.
              and _feed_source(r) == _feed_source(record)
+             # the quantization block joins the config key: an int8
+             # serve record and an f32 one run different programs —
+             # neither may baseline the other.  Null == unquantized
+             # (the default), so pre-quantization history compares.
+             and r.get("quantization") == record.get("quantization")
+             # ...and so does the cold-start AOT mode: an AOT-warm
+             # record's warmup rode pre-compiled executables — its
+             # number never baselines a cold-compile boot (or vice
+             # versa).  Missing key == "off", the pre-AOT default.
+             and _cold_start_aot(r) == _cold_start_aot(record)
              # the plan block joins the config key: a dp_tp (or any
              # sharded-plan) record and a pure-dp record are different
              # trajectories — neither may baseline the other.  Null ==
@@ -549,6 +585,71 @@ SESSIONS_N = 16 if ON_TPU else 8
 SESSION_WARM_CLICKS = 8 if ON_TPU else 6
 
 
+def _serve_env_extras(predictor):
+    """Apply the serve-side A/B env knobs to a freshly built predictor:
+    DPTPU_BENCH_QUANTIZE swaps in the int8-quantized forward.  Returns
+    ``(predictor, quant_policy)`` (policy None when unquantized)."""
+    qpolicy = None
+    if BENCH_QUANTIZE:
+        from distributedpytorch_tpu.serve.quantize import (
+            quant_policy,
+            quantize_predictor,
+        )
+
+        qpolicy = quant_policy(BENCH_QUANTIZE)
+        if qpolicy is not None:
+            predictor = quantize_predictor(predictor, qpolicy)
+    return predictor, qpolicy
+
+
+def _cold_start_block(warm: dict | None) -> dict | None:
+    """The record's ``cold_start`` block from a service's last warmup —
+    keys ALWAYS present on serve records (warmup_seconds,
+    programs_compiled, aot_cache), the whole block null on train
+    records (the sessions-block convention)."""
+    if warm is None:
+        return None
+    return {"warmup_seconds": warm["warmup_seconds"],
+            "programs_compiled": warm["programs_compiled"],
+            "aot_cache": warm["aot_cache"]}
+
+
+def _stamp_serve_fast_path(record: dict, svc, qpolicy):
+    """One owner for the serve-record fast-path stamping shared by
+    serve_bench and serve_sessions_bench: the ``cold_start`` +
+    ``quantization`` blocks, and the quantized audit options — returns
+    ``(audit_kw, program_suffix)`` so a quantized record audits against
+    the QuantPolicy's declared dequant points under its own ``_int8``
+    config name (the config-naming rule)."""
+    from distributedpytorch_tpu.serve.quantize import quantization_block
+
+    record["cold_start"] = _cold_start_block(svc.last_warmup)
+    record["quantization"] = quantization_block(qpolicy)
+    if qpolicy is None:
+        return {}, ""
+    return {"f32_allow": qpolicy.ja002_allow()}, "_int8"
+
+
+def _maybe_build_aot_cache(svc, predictor) -> None:
+    """DPTPU_BENCH_AOT_CACHE tail: a bench that booted cold against a
+    configured cache dir BUILDS the cache afterward, so the NEXT run
+    measures the warm boot — the cold-vs-warm A/B is two consecutive
+    runs of the same command."""
+    if not BENCH_AOT_CACHE:
+        return
+    if svc.last_warmup and svc.last_warmup["aot_cache"] == "hit":
+        return
+    from distributedpytorch_tpu.serve.aot import AotCache
+
+    try:
+        AotCache(BENCH_AOT_CACHE).build(predictor, svc.buckets)
+        print(f"bench: built AOT cache at {BENCH_AOT_CACHE} — re-run "
+              "to measure the warm boot", file=sys.stderr)
+    except Exception as e:  # a failed build must never kill the record
+        print(f"bench: AOT cache build failed "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+
+
 def _sessions_block(store_snapshot: dict | None,
                     swaps: dict | None,
                     warm_ms: list | None = None,
@@ -595,6 +696,7 @@ def serve_bench():
                                optax.sgd(1e-3), (1, SIZE, SIZE, 4))
     predictor = Predictor(model, state.params, state.batch_stats,
                           resolution=(SIZE, SIZE), relax=50)
+    predictor, qpolicy = _serve_env_extras(predictor)
     r = np.random.RandomState(0)
     image = r.randint(0, 256, (SIZE, SIZE, 3)).astype(np.uint8)
     quarter, mid = SIZE // 4, SIZE // 2
@@ -604,7 +706,7 @@ def serve_bench():
 
     svc = InferenceService(predictor, max_batch=SERVE_MAX_BATCH,
                            queue_depth=2 * SERVE_REQUESTS,
-                           max_wait_s=0.002)
+                           max_wait_s=0.002, aot_cache=BENCH_AOT_CACHE)
     acct = get_accountant()
     acct.reset()
     with acct.account("compile"):
@@ -697,6 +799,13 @@ def serve_bench():
     # plan block: a TRAIN-side concept (serve replicates the predictor),
     # null on serve records — key always present (schema stability)
     record["plan"] = None
+    # cold_start block (serve/aot): the measured boot tax — warmup
+    # seconds, programs compiled (0 on an AOT-warm boot) and the cache
+    # outcome; keys always present on serve records, block null on
+    # train ones.  quantization block (serve/quantize): the weight
+    # regime the burst served; null when unquantized — the precision
+    # convention.  Both key --check-regression's same-config filter.
+    audit_kw, suffix = _stamp_serve_fast_path(record, svc, qpolicy)
     # IR-audit fields: the top bucket's forward (the program serving the
     # measured burst), same schema as the train record.  Config-named —
     # never the canonical serve_forward_b<N> names, whose contracts pin
@@ -705,11 +814,15 @@ def serve_bench():
         predictor.forward_jitted,
         (jax.ShapeDtypeStruct((SERVE_MAX_BATCH, SIZE, SIZE, 4),
                               np.float32),),
-        f"bench_serve_{BACKBONE}_{SIZE}px_b{SERVE_MAX_BATCH}"))
+        f"bench_serve_{BACKBONE}_{SIZE}px_b{SERVE_MAX_BATCH}{suffix}",
+        **audit_kw))
     from distributedpytorch_tpu.utils.profiling import device_memory_stats
 
     record["peak_bytes_in_use"] = \
         device_memory_stats()["peak_bytes_in_use"]
+    # AFTER the memory read: the build's full-ladder recompile must not
+    # inflate the record's high-water mark
+    _maybe_build_aot_cache(svc, predictor)
     if not ON_TPU:
         record["note"] = ("CPU fallback (downsized config), not a TPU "
                           "number")
@@ -742,6 +855,7 @@ def serve_sessions_bench():
                                optax.sgd(1e-3), (1, SIZE, SIZE, 4))
     predictor = Predictor(model, state.params, state.batch_stats,
                           resolution=(SIZE, SIZE), relax=50)
+    predictor, qpolicy = _serve_env_extras(predictor)
     r = np.random.RandomState(0)
     image = r.randint(0, 256, (SIZE, SIZE, 3)).astype(np.uint8)
     quarter, mid = SIZE // 4, SIZE // 2
@@ -750,7 +864,8 @@ def serve_sessions_bench():
                         np.float64)
 
     svc = InferenceService(predictor, max_batch=SERVE_MAX_BATCH,
-                           queue_depth=4 * SESSIONS_N, max_wait_s=0.002)
+                           queue_depth=4 * SESSIONS_N, max_wait_s=0.002,
+                           aot_cache=BENCH_AOT_CACHE)
     acct = get_accountant()
     acct.reset()
     with acct.account("compile"):
@@ -836,6 +951,9 @@ def serve_sessions_bench():
     record["precision"] = precision_block(precision_policy(DTYPE))
     # plan block: train-side concept, null on serve records; key present
     record["plan"] = None
+    # cold_start + quantization blocks — the serve-record pair (see
+    # serve_bench); keys always present
+    audit_kw, suffix = _stamp_serve_fast_path(record, svc, qpolicy)
     # IR audit of the warm hot path (the decode program at the top
     # bucket) — config-named, same convention as the burst bench
     feats = predictor.feature_struct(1)
@@ -845,11 +963,14 @@ def serve_sessions_bench():
                               feats.dtype),
          jax.ShapeDtypeStruct((SERVE_MAX_BATCH, SIZE, SIZE, 1),
                               np.float32)),
-        f"bench_serve_decode_{BACKBONE}_{SIZE}px_b{SERVE_MAX_BATCH}"))
+        f"bench_serve_decode_{BACKBONE}_{SIZE}px_b{SERVE_MAX_BATCH}"
+        f"{suffix}", **audit_kw))
     from distributedpytorch_tpu.utils.profiling import device_memory_stats
 
     record["peak_bytes_in_use"] = \
         device_memory_stats()["peak_bytes_in_use"]
+    # AFTER the memory read (see serve_bench)
+    _maybe_build_aot_cache(svc, predictor)
     if not ON_TPU:
         record["note"] = ("CPU fallback (downsized config), not a TPU "
                           "number")
@@ -867,6 +988,9 @@ def main() -> None:
     if BENCH_SOURCE not in ("fs", "packed"):
         raise SystemExit(
             f"DPTPU_BENCH_SOURCE must be fs|packed, got {BENCH_SOURCE!r}")
+    if BENCH_QUANTIZE not in (None, "int8"):
+        raise SystemExit(
+            f"DPTPU_BENCH_QUANTIZE must be int8, got {BENCH_QUANTIZE!r}")
     if _CLI_ARGS.serve:
         record = (serve_sessions_bench() if _CLI_ARGS.sessions
                   else serve_bench())
@@ -1085,6 +1209,11 @@ def main() -> None:
     # always present; --check-regression keys its same-config filter on
     # it so a dp_tp record can never baseline the dp trajectory.
     record["plan"] = plan_lib.plan_record_block(plan)
+    # cold_start + quantization: serve-side concepts (the train loop
+    # has no bucket ladder to warm and trains full-precision), null on
+    # train records — keys always present (schema stability)
+    record["cold_start"] = None
+    record["quantization"] = None
     if REDUCE_BUCKETS:
         record["reduce_buckets"] = REDUCE_BUCKETS
     # IR-audit fields (jaxaudit): collective inventory of the exact

@@ -45,7 +45,8 @@ PROGRAM_NAMES = ("train_step", "train_step_bf16",
                  "train_step_dp_tp", "train_step_dp_zero1",
                  "train_step_dp_tp_zero1", "eval_step",
                  "serve_forward_b1", "serve_forward_b8",
-                 "encode_step", "decode_step")
+                 "serve_forward_int8_b1", "serve_forward_int8_b8",
+                 "encode_step", "decode_step", "decode_int8")
 
 #: the plan-built canonical programs: ``train_step_<strategy>`` for each
 #: resolvable non-trivial rung of parallel/plan.py's ladder (plain dp IS
@@ -71,6 +72,13 @@ _PROGRAM_HELP = {
     "eval_step": "jitted mesh eval step (fwd+loss)",
     "serve_forward_b1": "serve bucket forward, batch 1",
     "serve_forward_b8": "serve bucket forward, batch 8",
+    "serve_forward_int8_b1": "int8-quantized serve forward, batch 1 — "
+                             "JA002 audited against the QuantPolicy "
+                             "dequant allowlist; const bytes pin the "
+                             "~4x int8 shrink",
+    "serve_forward_int8_b8": "int8-quantized serve forward, batch 8",
+    "decode_int8": "int8-quantized session decode (features + guidance "
+                   "-> mask probabilities, b1)",
     "encode_step": "session serving: RGB crop -> backbone features "
                    "(guidance_inject='head', b1)",
     "decode_step": "session serving: features + guidance -> mask "
@@ -308,10 +316,12 @@ def build_default_programs(names: tuple | list | None = None) -> dict:
     names = tuple(names) if names else PROGRAM_NAMES
     unknown = [n for n in names
                if n not in ("train_step", "train_step_bf16", "eval_step",
-                            "encode_step", "decode_step")
+                            "encode_step", "decode_step", "decode_int8")
                and n not in PLAN_PROGRAM_NAMES
                and not (n.startswith("serve_forward_b")
-                        and n[len("serve_forward_b"):].isdigit())]
+                        and n[len("serve_forward_b"):].isdigit())
+               and not (n.startswith("serve_forward_int8_b")
+                        and n[len("serve_forward_int8_b"):].isdigit())]
     if unknown:
         raise ValueError(f"unknown program(s): {unknown} "
                          f"(known: {list(PROGRAM_NAMES)} and "
@@ -409,7 +419,8 @@ def build_default_programs(names: tuple | list | None = None) -> dict:
                            {"mesh_axes": plan.axis_sizes(b)})
 
     serve = [n for n in names if n.startswith("serve_forward_b")]
-    if serve:
+    quant_serve = [n for n in names if n.startswith("serve_forward_int8_b")]
+    if serve or quant_serve:
         state = create_train_state(jax.random.PRNGKey(0), model, tx,
                                    (1, h, w, ch))
         pred = Predictor(model, state.params, state.batch_stats,
@@ -418,8 +429,26 @@ def build_default_programs(names: tuple | list | None = None) -> dict:
             bucket = int(n[len("serve_forward_b"):])
             programs[n] = (pred.forward_jitted,
                            (sds((bucket, h, w, ch), jnp.float32),))
+        if quant_serve:
+            # the int8-quantized twin of the SAME weights: per-channel
+            # symmetric int8 kernels dequantized inside the trace
+            # (serve/quantize.py).  Audited against the QuantPolicy's
+            # JA002 allowlist — dtype_upcast=0 pinned means every
+            # int8→f32 convert in the program is a declared dequant
+            # point (the same program audits DIRTY under the strict
+            # default: the declaration is load-bearing) — and the
+            # contract's const bytes pin the ~4x int8 shrink.
+            from ..serve import quantize as quantize_lib
 
-    if {"encode_step", "decode_step"} & set(names):
+            qpolicy = quantize_lib.QuantPolicy()
+            qpred = quantize_lib.quantize_predictor(pred, qpolicy)
+            for n in quant_serve:
+                bucket = int(n[len("serve_forward_int8_b"):])
+                programs[n] = (qpred.forward_jitted,
+                               (sds((bucket, h, w, ch), jnp.float32),),
+                               {"f32_allow": qpolicy.ja002_allow()})
+
+    if {"encode_step", "decode_step", "decode_int8"} & set(names):
         # the session-serving split at the same canonical config, with
         # the guidance channel re-entering at the head; b1 is the
         # interactive single-click shape.  The FLOPs fields of these two
@@ -443,6 +472,20 @@ def build_default_programs(names: tuple | list | None = None) -> dict:
             programs["decode_step"] = (
                 split_pred.decode_jitted,
                 (feats, sds((1, h, w, 1), jnp.float32)))
+        if "decode_int8" in names:
+            # the warm-click hot path, quantized: sessions and int8
+            # compose (the split predictor's staged composition is the
+            # SAME two programs, so warm/cold parity stays bitwise even
+            # quantized — pinned in tests/test_quantize.py)
+            from ..serve import quantize as quantize_lib
+
+            qpolicy = quantize_lib.QuantPolicy()
+            qsplit = quantize_lib.quantize_predictor(split_pred, qpolicy)
+            programs["decode_int8"] = (
+                qsplit.decode_jitted,
+                (qsplit.feature_struct(1),
+                 sds((1, h, w, 1), jnp.float32)),
+                {"f32_allow": qpolicy.ja002_allow()})
     # preserve the caller's order
     return {n: programs[n] for n in names if n in programs}
 

@@ -15,7 +15,10 @@ steps, the serve buckets' forwards) through the process-wide
   collectives GSPMD inserts — the structure arxiv's distributed-CNN
   scaling work shows dominates efficiency);
 * **dtype flow** (JA002) — f32 equations fed by a bf16→f32 upcast whose
-  consumer is not in the allowlisted accumulation set;
+  consumer is not in the allowlisted accumulation set, and (the
+  quantized-serving twin) int8→f32 dequantization converts whose
+  consumer is undeclared — a quantized kernel's float form must only
+  ever feed its declared dequant point (serve/quantize.QuantPolicy);
 * **dead / duplicate outputs** (JA003/JA004) — outputs with no input
   dependence (baked constants the caller re-fetches every step) and the
   same value returned twice;
@@ -345,17 +348,39 @@ def _has_subjaxpr(eqn) -> bool:
     return any(True for v in eqn.params.values() for _ in _jaxprs_in(v))
 
 
+#: convert_element_type (src, dst) pairs JA002 polices.  bf16→f32 is
+#: the mixed-precision accumulation flow (train/precision.py); int8→f32
+#: is the weight-dequantization flow of the quantized serve forwards
+#: (serve/quantize.py) — an int8 constant's float form must only ever
+#: feed the declared dequant multiply.  Deliberately NOT here: the
+#: wider integer/index zoo (int32 iota/gather indices convert to float
+#: in ordinary host-free arithmetic all the time and flagging them
+#: would make every pre-existing contract pin noise).
+_JA002_FLOWS = {
+    ("bfloat16", "float32"):
+        ("bf16{shape} upcast to f32 consumed by non-accumulation "
+         "op(s) {bad} — f32 math on the bf16 path pays 2x bytes; keep "
+         "it bf16 or allowlist a real accumulation"),
+    ("int8", "float32"):
+        ("int8{shape} dequantized to f32 consumed by undeclared op(s) "
+         "{bad} — a quantized kernel's float form must only feed its "
+         "declared dequant point (QuantPolicy.ja002_allow), or the "
+         "4x-bytes win silently leaks"),
+}
+
+
 def dtype_upcast_findings(closed_jaxpr,
                           allow: frozenset = DEFAULT_F32_ACCUM_ALLOW
                           ) -> list[AuditFinding]:
-    """bf16→f32 ``convert_element_type`` equations whose result feeds a
-    primitive outside the accumulation allowlist.  Walked per nesting
-    level: each nested jaxpr runs its own pass over its own converts.
-    Call-like consumers (pjit/scan/cond/custom_jvp_call/... — anything
-    carrying a subjaxpr) are transparent, not findings: the value merely
-    crosses a call boundary there, and what happens to it inside is not
-    an upcast hazard by itself (flagging 'consumed by scan' would make
-    every bf16 contract pin noise)."""
+    """Policed ``convert_element_type`` equations (:data:`_JA002_FLOWS`:
+    bf16→f32 upcasts, int8→f32 dequants) whose result feeds a primitive
+    outside the accumulation allowlist.  Walked per nesting level: each
+    nested jaxpr runs its own pass over its own converts.  Call-like
+    consumers (pjit/scan/cond/custom_jvp_call/... — anything carrying a
+    subjaxpr) are transparent, not findings: the value merely crosses a
+    call boundary there, and what happens to it inside is not an upcast
+    hazard by itself (flagging 'consumed by scan' would make every bf16
+    contract pin noise)."""
     findings = []
     for jaxpr in iter_jaxprs(closed_jaxpr.jaxpr):
         # non-transparent consumers of each var at THIS level
@@ -376,7 +401,8 @@ def dtype_upcast_findings(closed_jaxpr,
             src_dtype = str(getattr(src.aval, "dtype", ""))
             out = eqn.outvars[0]
             out_dtype = str(getattr(out.aval, "dtype", ""))
-            if src_dtype != "bfloat16" or out_dtype != "float32":
+            message = _JA002_FLOWS.get((src_dtype, out_dtype))
+            if message is None:
                 continue
             bad = sorted({p for p in consumers.get(id(out), ())
                           if p not in allow})
@@ -384,10 +410,8 @@ def dtype_upcast_findings(closed_jaxpr,
                 shape = tuple(getattr(src.aval, "shape", ()))
                 findings.append(AuditFinding(
                     "JA002", "dtype_upcast",
-                    f"bf16{list(shape)} upcast to f32 consumed by "
-                    f"non-accumulation op(s) {', '.join(bad)} — f32 math "
-                    "on the bf16 path pays 2x bytes; keep it bf16 or "
-                    "allowlist a real accumulation"))
+                    message.format(shape=list(shape),
+                                   bad=", ".join(bad))))
     return findings
 
 

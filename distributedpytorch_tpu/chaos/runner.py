@@ -313,6 +313,30 @@ SCENARIOS: dict[str, dict] = {
         "invariants": ["packed_read_error_typed", "torn_record_detected",
                        "quarantined_run_completes"],
     },
+    # Stale AOT executable cache (serve/aot.py): a replica boots warm
+    # against a cache that rotted under it.  Three corruptions, each
+    # the same contract — fall back LOUDLY to a fresh compile, serve
+    # anyway, and never execute untrusted bytes: (1) a bitflip fault at
+    # the serve/aot_load seam corrupts one entry's bytes in flight —
+    # the per-entry crc32 must trip (typed AotCacheError) and that
+    # program compiles fresh; (2) the same entry is then truncated ON
+    # DISK — same refusal, and `dptpu-aot --verify` flags exactly the
+    # bad entry; (3) the manifest's topology fingerprint is rewritten
+    # to a foreign pod shape — every load is a typed miss NAMING the
+    # mismatched key, the boot degrades to a full cold compile.  In
+    # all three phases the serving masks stay bitwise identical to the
+    # jit forward's (no silently-wrong executable, ever).
+    "stale_aot_cache": {
+        "name": "stale_aot_cache",
+        "mode": "serve_aot",
+        "plan": {"seed": 0, "faults": [
+            {"site": "serve/aot_load", "kind": "bitflip", "at": [1]}]},
+        "params": {"size": 64, "max_batch": 2},
+        "invariants": ["corrupt_entry_falls_back",
+                       "truncated_entry_falls_back",
+                       "topology_mismatch_falls_back",
+                       "serves_after_fallback"],
+    },
     # Repeated SIGTERM across epochs: every wave stops gracefully
     # (consensus stop -> exact-resume checkpoint), the supervisor
     # restarts without backoff, and across the whole storm not one
@@ -833,6 +857,109 @@ def _run_serve(sc: dict, work_dir: str) -> dict:
         "firings": plan.injected_total()}
 
 
+def _run_serve_aot(sc: dict, work_dir: str) -> dict:
+    """stale_aot_cache: a warm-boot cache rots three ways — in-flight
+    bitflip, on-disk truncation, topology-mismatched manifest — and
+    every boot falls back loudly, serves, and stays bitwise-correct
+    (see SCENARIOS)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from ..models import build_model
+    from ..parallel import create_train_state
+    from ..predict import Predictor
+    from ..serve import InferenceService
+    from ..serve import aot as aot_lib
+    from ..train.checkpoint import atomic_write_json
+
+    p = dict(sc.get("params") or {})
+    size = int(p.get("size", 64))
+    max_batch = int(p.get("max_batch", 2))
+    plan = FaultPlan.from_dict(dict(sc.get("plan") or {},
+                                    name=sc["name"]))
+    model = build_model("danet", nclass=1, backbone="resnet18",
+                        output_stride=8)
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(1e-3), (1, size, size, 4))
+
+    def make_predictor():
+        # one predictor per boot: each service's AOT install table and
+        # jit ladder are its own, like separate replica processes
+        return Predictor(model, state.params, state.batch_stats,
+                         resolution=(size, size), relax=20)
+
+    cache_dir = os.path.join(work_dir, "aot")
+    cache = aot_lib.AotCache(cache_dir)
+    built = cache.build(make_predictor(), tuple(
+        b for b in (1, 2, 4, 8) if b <= max_batch))
+    r = np.random.RandomState(0)
+    image = r.randint(0, 256, (size, size, 3)).astype(np.uint8)
+    q, m = size // 4, size // 2
+    points = np.array([[q, m], [size - q, m], [m, q], [m, size - q]],
+                      np.float64)
+    # ground truth from the ordinary jit forward — serialization
+    # round-trips AND compile fallbacks must both reproduce it bitwise
+    expected = make_predictor().predict(image, points)
+
+    def boot_and_serve(tag: str) -> dict:
+        svc = InferenceService(make_predictor(), max_batch=max_batch,
+                               queue_depth=16, max_wait_s=0.0,
+                               aot_cache=aot_lib.AotCache(cache_dir))
+        warm = svc.warmup()
+        with svc:
+            try:
+                mask = svc.predict(image, points, timeout=120)
+                served = bool(np.isfinite(mask).all())
+                bitwise = bool(np.array_equal(mask, expected))
+            except Exception as e:  # noqa: BLE001 — reported, asserted
+                served = bitwise = False
+                mask = None
+                warm = dict(warm, error=f"{type(e).__name__}: {e}")
+        return {"tag": tag, "warmup": warm, "served_ok": served,
+                "bitwise_equal": bitwise,
+                "fallbacks": sorted({e["fallback"]
+                                     for e in warm["programs"]
+                                     if e.get("fallback")})}
+
+    t0 = time.perf_counter()
+    # phase 1: in-flight bitflip (the armed plan fires on the FIRST
+    # serve/aot_load visit) — crc refuses, that program compiles fresh
+    with sites.armed_plan(plan):
+        flipped = boot_and_serve("bitflip_in_flight")
+
+    # phase 2: the first entry torn ON DISK — same refusal from a clean
+    # read path, and --verify's sweep must name exactly the bad entry
+    man = cache.manifest()
+    victim = sorted(man["entries"])[0]
+    victim_path = os.path.join(cache_dir, man["entries"][victim]["file"])
+    from .faults import truncate_file
+
+    truncate_file(victim_path, fraction=0.5)
+    verify_report = cache.verify()
+    truncated = boot_and_serve("truncated_on_disk")
+
+    # phase 3: topology-mismatched manifest — a cache built for a
+    # different pod shape misses loudly on EVERY entry (the message
+    # names the key), and the boot degrades to a full cold compile
+    man2 = cache.manifest()
+    man2["fingerprint"]["topology"] = "tpu:256/p32"
+    atomic_write_json(cache.manifest_path(), man2)
+    mismatched = boot_and_serve("topology_mismatch")
+    recovery_s = time.perf_counter() - t0
+    _observe_recovery(sc["name"], recovery_s)
+    return {"phases": {"serve_aot": {
+        "built": built["programs"],
+        "bitflip": flipped,
+        "verify_report": {k: verify_report[k]
+                          for k in ("entries", "bad", "missing")},
+        "victim": victim,
+        "truncated": truncated,
+        "mismatch": mismatched,
+    }}, "recovery_s": round(recovery_s, 3),
+        "firings": plan.injected_total()}
+
+
 def _run_serve_swap(sc: dict, work_dir: str) -> dict:
     """hot_swap_under_load: promote a good checkpoint and roll back a
     poisoned one, under live session traffic (see SCENARIOS)."""
@@ -1221,6 +1348,58 @@ def _check_one(name, sc, result, phases, verdict):
                     f"canary={st['canary']} bad={bad} "
                     f"recovered={s['recovered_after_rollback']} in "
                     f"{result['recovery_s']}s")
+        elif name == "corrupt_entry_falls_back":
+            s = phases["serve_aot"]
+            f = s["bitflip"]
+            # the bitflipped entry must be REFUSED via the checksum
+            # gate (fallback 'error', never 'miss' — a miss would mean
+            # the rot was invisible) and that program compiled fresh
+            compiled = [e for e in f["warmup"]["programs"]
+                        if e["outcome"] == "compile"
+                        and e.get("fallback") == "error"]
+            verdict(name,
+                    bool(compiled) and f["served_ok"],
+                    f"bitflip boot: fallbacks={f['fallbacks']} "
+                    f"programs={f['warmup']['programs']} "
+                    f"served_ok={f['served_ok']} (want >=1 checksum "
+                    "refusal -> fresh compile, service up)")
+        elif name == "truncated_entry_falls_back":
+            s = phases["serve_aot"]
+            f = s["truncated"]
+            compiled = [e for e in f["warmup"]["programs"]
+                        if e["outcome"] == "compile"
+                        and e.get("fallback") == "error"]
+            flagged = s["victim"] in (s["verify_report"]["bad"]
+                                      + s["verify_report"]["missing"])
+            verdict(name,
+                    bool(compiled) and f["served_ok"] and flagged,
+                    f"torn-entry boot: fallbacks={f['fallbacks']} "
+                    f"served_ok={f['served_ok']}; --verify flagged "
+                    f"{s['verify_report']['bad']} (want the torn "
+                    f"{s['victim']!r} refused, flagged, served around)")
+        elif name == "topology_mismatch_falls_back":
+            s = phases["serve_aot"]
+            f = s["mismatch"]
+            # EVERY program must miss (the foreign-topology manifest
+            # invalidates the whole cache) and the boot still serves —
+            # a degraded cold start, not a crash
+            verdict(name,
+                    f["warmup"]["aot_cache"] == "miss"
+                    and f["warmup"]["programs_loaded"] == 0
+                    and f["fallbacks"] == ["miss"] and f["served_ok"],
+                    f"mismatch boot: aot={f['warmup']['aot_cache']} "
+                    f"loaded={f['warmup']['programs_loaded']} "
+                    f"fallbacks={f['fallbacks']} "
+                    f"served_ok={f['served_ok']}")
+        elif name == "serves_after_fallback":
+            s = phases["serve_aot"]
+            boots = [s["bitflip"], s["truncated"], s["mismatch"]]
+            bad = [b["tag"] for b in boots
+                   if not (b["served_ok"] and b["bitwise_equal"])]
+            verdict(name, not bad,
+                    f"boots failing serve-or-parity: {bad} (every "
+                    "degraded boot must serve masks bitwise identical "
+                    "to the jit forward — no silently-wrong executable)")
         elif name == "packed_read_error_typed":
             f = phases["packed_fit"]
             verdict(name,
@@ -1512,6 +1691,8 @@ def run_scenario(scenario: str | dict, work_dir: str | None = None,
             result = _run_serve(sc, work_dir)
         elif mode == "serve_swap":
             result = _run_serve_swap(sc, work_dir)
+        elif mode == "serve_aot":
+            result = _run_serve_aot(sc, work_dir)
         elif mode == "supervise":
             result = _run_supervise(sc, work_dir)
         elif mode == "packed_fit":
@@ -1519,8 +1700,8 @@ def run_scenario(scenario: str | dict, work_dir: str | None = None,
         else:
             raise ValueError(
                 f"unknown scenario mode {mode!r} "
-                "(fit | fit_resume | serve | serve_swap | supervise | "
-                "packed_fit)")
+                "(fit | fit_resume | serve | serve_swap | serve_aot | "
+                "supervise | packed_fit)")
     finally:
         if cleanup:
             import shutil

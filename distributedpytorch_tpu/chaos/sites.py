@@ -15,6 +15,12 @@ The seams are woven into the REAL code paths (not shadow copies):
   (serve/swap.load_swap_predictor; payload = the restored param tree, so
   a ``nan`` fault models a poisoned/torn checkpoint arriving via swap —
   the canary-rollback scenario's trigger);
+* ``serve/aot_load``         — the AOT executable cache's entry read
+  (serve/aot.py), BEFORE the per-entry crc gate: a ``bitflip`` fault
+  here models bit rot / a torn cache entry and must surface as the
+  typed ``AotCacheError`` -> loud fresh-compile fallback, never a
+  corrupt executable taking traffic (the ``stale_aot_cache``
+  scenario's driver);
 * ``device/put``             — host->device placement in the prefetcher;
 * ``data/packed_read``       — the packed data plane's verified record
   read (data/packed.py), BEFORE the crc gate: a ``bitflip`` fault here
@@ -54,6 +60,7 @@ SITES = (
     "serve/enqueue",
     "serve/drain",
     "serve/swap_params",
+    "serve/aot_load",
     "device/put",
     "data/packed_read",
 )

@@ -269,6 +269,43 @@ def _click_kwargs_from_cfg(cfg, kwargs: dict) -> dict:
     return kwargs
 
 
+class _AotDispatch:
+    """Route concrete-batch calls to an installed AOT executable
+    (serve/aot.py), everything else to the underlying jitted callable.
+
+    The pre-compiled executables a warm-cache serve boot deserializes
+    (``jax.experimental.serialize_executable``) are ``jax.stages
+    .Compiled`` objects outside the jit dispatch cache, so the predictor
+    needs its own per-shape table.  The wrapper is transparent to every
+    other consumer: tracing callers (``jax.eval_shape``, the jaxaudit
+    lowering cache's ``fn.trace``/``fn.lower``) see the jit function —
+    a Tracer argument, or any attribute access, falls straight through —
+    and with an empty table the call overhead is one truthiness check.
+    """
+
+    # __weakref__: jax.eval_shape (feature_struct) weak-caches the callable
+    __slots__ = ("_fn", "_table", "_key_of", "__weakref__")
+
+    def __init__(self, fn, table: dict, key_of):
+        self._fn = fn
+        self._table = table
+        self._key_of = key_of
+
+    def __call__(self, *args):
+        if self._table:
+            x = args[0]
+            shape = getattr(x, "shape", None)
+            if shape is not None and not isinstance(x, jax.core.Tracer):
+                exe = self._table.get(self._key_of(tuple(shape)))
+                if exe is not None:
+                    return exe(*args)
+        return self._fn(*args)
+
+    def __getattr__(self, name):
+        # .trace / .lower / .__name__ / ... — the jit fn's own surface
+        return getattr(object.__getattribute__(self, "_fn"), name)
+
+
 class Predictor:
     """Reusable click-to-mask inference on one model + checkpoint.
 
@@ -305,7 +342,16 @@ class Predictor:
         #: the compiled forwards close over this exact tree
         self.params = params
         self.batch_stats = batch_stats
+        # NOTE: params may hold serve/quantize.QTensor leaves (int8
+        # kernels + scales).  Nothing here special-cases them: flax's
+        # dtype promotion calls ``jnp.asarray`` on every kernel at use,
+        # which triggers QTensor.__jax_array__ — the dequantization is
+        # traced INSIDE whichever jitted forward consumes the kernel,
+        # and only the kernels a program actually uses enter its trace.
         variables = {"params": params, "batch_stats": batch_stats}
+        #: per-shape AOT executables (serve/aot.py) — empty unless a
+        #: warm-cache serve boot installed pre-compiled programs
+        self._aot_execs: dict = {}
 
         def forward(x):
             outputs = _apply_with_normalize(model, variables, mean, std, x)
@@ -347,8 +393,12 @@ class Predictor:
                     out_size=self.resolution)
                 return jax.nn.sigmoid(outs[0].astype(jnp.float32))
 
-            self.encode_jitted = jax.jit(encode_forward)
-            self.decode_jitted = jax.jit(decode_forward)
+            self.encode_jitted = _AotDispatch(
+                jax.jit(encode_forward), self._aot_execs,
+                lambda s: ("encode", s[0]))
+            self.decode_jitted = _AotDispatch(
+                jax.jit(decode_forward), self._aot_execs,
+                lambda s: ("decode", s[0]))
 
             def staged_forward(x):
                 # THE forward of a split predictor IS the composition, so
@@ -360,7 +410,8 @@ class Predictor:
 
             self._forward = staged_forward
         elif mesh is None:
-            self._forward = jax.jit(forward)
+            self._forward = _AotDispatch(jax.jit(forward), self._aot_execs,
+                                         lambda s: ("forward", s))
         else:
             # Distributed inference: crops shard over the mesh's data axis
             # (GSPMD partitions the forward, same as the train step); the
@@ -387,6 +438,36 @@ class Predictor:
         (plain Python, not itself traceable) — audit the stages via
         ``encode_jitted``/``decode_jitted`` instead."""
         return self._forward
+
+    def install_aot(self, key: tuple, executable) -> None:
+        """Install a pre-compiled executable for one program shape.
+
+        ``key``: ``("forward", (B, H, W, C))`` for a whole-forward
+        predictor, ``("encode", bucket)`` / ``("decode", bucket)`` for a
+        split one — the keys ``serve.aot.AotCache`` hands the warm-boot
+        loader.  Dispatches at that exact shape then run the installed
+        executable instead of the jit cache (zero compiles on a
+        warm-cache boot); every other shape, and every tracing consumer,
+        keeps the ordinary jitted path.
+        """
+        if self.mesh is not None:
+            raise ValueError(
+                "install_aot: mesh predictors compile GSPMD programs "
+                "bound to this process's device assignment — the AOT "
+                "cache serves single-device replicas")
+        kind = key[0]
+        valid = ({"encode", "decode"} if self.supports_sessions
+                 else {"forward"})
+        if kind not in valid:
+            raise ValueError(
+                f"install_aot: key kind {kind!r} does not match this "
+                f"predictor's programs ({sorted(valid)})")
+        self._aot_execs[key] = executable
+
+    @property
+    def aot_programs(self) -> list:
+        """Keys of the installed AOT executables (ops surface)."""
+        return sorted(self._aot_execs, key=str)
 
     def feature_struct(self, batch: int = 1):
         """ShapeDtypeStruct of one encoded-feature batch — the session

@@ -16,6 +16,12 @@ docs/DESIGN.md "Serving"):
   encoder cache (TTL + LRU under an HBM byte budget) behind warm clicks
 * :mod:`swap` — :class:`PredictorPool`: zero-downtime checkpoint
   hot-swap with canary routing, promote/rollback, generation draining
+* :mod:`quantize` — :class:`QuantizedPredictor`: post-training
+  per-channel int8 weight quantization of the serve forward, declared
+  via :class:`QuantPolicy` and policed by jaxaudit JA002
+* :mod:`aot` — :class:`AotCache`: pre-compiled, serialized bucket-ladder
+  executables (``dptpu-aot``) for near-zero cold start, crc-verified
+  with loud fresh-compile fallback
 * :mod:`metrics` — counters + p50/p99 request latency (ops surface)
 * :mod:`client` — :class:`ServeClient` over in-process or HTTP targets
 * :mod:`__main__` — ``python -m distributedpytorch_tpu.serve`` HTTP shell
@@ -25,9 +31,18 @@ docs/DESIGN.md "Serving"):
 ...     mask = svc.predict(image, points)       # == Predictor.predict's
 """
 
+from .aot import AotCache, AotCacheError, AotCacheMiss
 from .batching import bucket_for, bucket_sizes, pad_to_bucket, unpad
 from .client import HealthCache, ServeClient, decode_array, encode_array
 from .metrics import ServeMetrics
+from .quantize import (
+    QTensor,
+    QuantizedPredictor,
+    QuantPolicy,
+    quant_policy,
+    quantization_block,
+    quantize_predictor,
+)
 from .service import (
     DeadlineExceededError,
     InferenceService,
@@ -40,10 +55,16 @@ from .sessions import Session, SessionStore
 from .swap import PredictorPool, SwapInProgressError
 
 __all__ = [
+    "AotCache",
+    "AotCacheError",
+    "AotCacheMiss",
     "DeadlineExceededError",
     "HealthCache",
     "InferenceService",
     "PredictorPool",
+    "QTensor",
+    "QuantPolicy",
+    "QuantizedPredictor",
     "QueueFullError",
     "ServeClient",
     "ServeMetrics",
@@ -57,6 +78,9 @@ __all__ = [
     "decode_array",
     "encode_array",
     "pad_to_bucket",
+    "quant_policy",
+    "quantization_block",
+    "quantize_predictor",
     "unpad",
     "warmup_buckets",
 ]

@@ -210,12 +210,30 @@ def make_handler(service: InferenceService, health_cache: _HealthCache,
 
 def build_predictor(args):
     """Predictor from a run dir or a torch checkpoint — the same two
-    sources the --predict CLI serves, minus the per-call restore cost."""
-    from ..predict import Predictor
+    sources the --predict CLI serves, minus the per-call restore cost.
 
+    Quantization (``serve/quantize``): ``--quantize int8`` — or, when
+    the flag is absent, the run config's ``model.quantization`` knob —
+    rebuilds the restored weights as per-channel int8 + scales before
+    any program compiles (``--quantize none`` overrides a config knob
+    off).  Shared with ``dptpu-aot`` so the pre-compiled ladder is the
+    exact ladder this boot serves."""
+    from ..predict import Predictor, load_run_config
+
+    quantize = getattr(args, "quantize", None)
     if args.run_dir:
-        return Predictor.from_run(args.run_dir)
-    return Predictor.from_torch(args.torch)
+        cfg = load_run_config(args.run_dir)
+        if quantize is None:
+            quantize = getattr(cfg.model, "quantization", "") or None
+        predictor = Predictor.from_run(args.run_dir, cfg=cfg)
+    else:
+        predictor = Predictor.from_torch(args.torch)
+    from .quantize import quant_policy, quantize_predictor
+
+    policy = quant_policy(quantize)
+    if policy is not None:
+        predictor = quantize_predictor(predictor, policy)
+    return predictor
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -245,8 +263,21 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--deadline-ms", type=float, default=None,
                         help="default per-request deadline (none = wait)")
     parser.add_argument("--warmup", action="store_true",
-                        help="compile every bucket before accepting "
-                             "traffic (first clicks pay no compile)")
+                        help="ready every bucket before accepting "
+                             "traffic (first clicks pay no compile); "
+                             "with --aot-cache, loads pre-compiled "
+                             "executables instead of compiling")
+    parser.add_argument("--aot-cache", default=None, metavar="DIR",
+                        help="AOT executable cache built by dptpu-aot: "
+                             "--warmup loads instead of compiling "
+                             "(near-zero cold start), falling back "
+                             "loudly to fresh compiles on any "
+                             "mismatch/corruption")
+    parser.add_argument("--quantize", choices=("int8", "none"),
+                        default=None,
+                        help="post-training weight quantization of the "
+                             "serve forward (serve/quantize); default: "
+                             "the run config's model.quantization")
     parser.add_argument("--session-budget-mb", type=float, default=256.0,
                         help="HBM byte budget for the per-session encoder "
                              "cache (split predictors only); LRU evicts "
@@ -276,10 +307,13 @@ def main(argv: list[str] | None = None) -> int:
         session_budget_bytes=int(args.session_budget_mb * 2**20),
         session_ttl_s=args.session_ttl_s,
         session_lane_depth=args.session_lane_depth,
+        aot_cache=args.aot_cache,
         trace=trace)
     if args.warmup:
         # service.warmup (not bare warmup_buckets): it also registers the
-        # warmed shapes with the retrace tripwire, keeping its budget exact
+        # warmed shapes with the retrace tripwire, keeping its budget
+        # exact — and threads through the AOT cache when one is
+        # configured (per-bucket compile-vs-load millis land on stderr)
         service.warmup()
     service.start()
     httpd = _Server((args.host, args.port),
@@ -293,11 +327,21 @@ def main(argv: list[str] | None = None) -> int:
     signal.signal(signal.SIGINT, on_signal)
     # SIGUSR2 arms the same bounded capture POST /debug/trace does
     uninstall_trace_signal = trace.install_signal()
+    from .quantize import quantization_block
+
+    warm = service.last_warmup
     print(json.dumps({"serving": f"http://{args.host}:{args.port}",
                       "buckets": list(service.buckets),
                       "queue_depth": args.queue_depth,
                       "resolution": list(predictor.resolution),
-                      "sessions": service.sessions_enabled}),
+                      "sessions": service.sessions_enabled,
+                      "quantization": quantization_block(
+                          getattr(predictor, "quant_policy", None)),
+                      "cold_start": None if warm is None else {
+                          "warmup_seconds": warm["warmup_seconds"],
+                          "programs_compiled": warm["programs_compiled"],
+                          "programs_loaded": warm["programs_loaded"],
+                          "aot_cache": warm["aot_cache"]}}),
           flush=True)
     try:
         httpd.serve_forever()

@@ -1,0 +1,313 @@
+"""Post-training int8 weight quantization for the serve forward.
+
+The serve fleet's cost is dominated by two terms: HBM residency (one
+~200 MB f32 param set per resident generation bounds how many replicas
+a chip oversubscribes) and HBM bandwidth (the forward re-reads every
+kernel per dispatch).  Weight-only int8 quantization attacks both at
+once — the integer-only-inference playbook (arXiv 1712.05877), taken
+only as far as measurement justifies:
+
+* **per-channel symmetric int8 weights** for conv/dense kernels: each
+  output channel gets one f32 scale (``scale = max|w| / 127``), the
+  kernel stores as int8 — 4x smaller in HBM, and the compiled forward
+  reads int8 bytes;
+* **dequant-at-use inside the jitted forward**: the int8 kernels are
+  closure constants of the SAME jitted forward the f32 predictor
+  compiles; materialization (``q.astype(f32) * scale``) happens inside
+  the trace, so XLA fuses the int8 read + convert + scale into the
+  consuming conv/matmul — the weights never exist as f32 in HBM;
+* **everything else stays f32**: biases, BN scale/bias/batch-stats,
+  activations, the loss-side sigmoid.  BatchNorm in this architecture
+  is a separate (unfused) layer, so there is no conv+BN product to fold
+  — the BN arithmetic stays exactly the f32 predictor's.  Weight-only
+  is deliberately the first rung: it needs no calibration data, its
+  error is bounded per-channel, and it keeps activation dtype flow
+  identical to the audited f32 forward.
+
+The regime is **declared, not vibes**: :class:`QuantPolicy` names the
+one new dtype-flow pattern quantization introduces — an int8→f32
+dequantization convert consumed by the scale ``mul``
+(:data:`QUANT_DEQUANT_PRIMS`) — and jaxaudit's JA002 audits the
+quantized programs against ``QuantPolicy.ja002_allow()``.  Zero
+findings under the policy means every int8 upcast in the program is a
+declared dequantization point; the same program audited under the
+strict default allowlist FAILS (the ``mul`` is not a default
+accumulation prim), which is what proves the declaration load-bearing.
+The canonical ``serve_forward_int8_b1/b8`` + ``decode_int8`` programs
+pin this (and the ~4x const-byte shrink) as checked-in cpu8 compile
+contracts.
+
+Parity is banded, not assumed: tests/test_quantize.py pins the int8
+probability maps within a small absolute band of the f32 forward across
+every ladder bucket, and mask IoU >= 0.99 on the serve fixtures — the
+acceptance gate a quantized deploy must clear before it canaries
+(sessions, hot-swap and the bucket ladder all compose: a quantized
+canary rolls back like any other generation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from ..predict import Predictor
+
+#: f32 primitives the dequantization introduces on upcast int8 data,
+#: beyond the strict default allowlist (analysis/ir.py
+#: DEFAULT_F32_ACCUM_ALLOW): the per-channel scale multiply
+#: ``q.astype(f32) * scale`` — the ONE arithmetic op between an int8
+#: kernel constant and the conv/matmul that consumes it.  Deliberately
+#: nothing else: any other f32 math appearing on an int8 upcast (a
+#: dequantized kernel leaking into elementwise chains, a second
+#: dequantization site) still fails JA002 under the policy.
+QUANT_DEQUANT_PRIMS = frozenset({"mul"})
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """One quantized kernel: int8 values + per-channel f32 scales.
+
+    Two protocols make it a drop-in kernel leaf:
+
+    * **pytree node** — a params tree holding QTensor leaves flattens
+      into its int8/f32 arrays, so checkpoint digests, tree
+      serialization and jit argument passing all see the raw (4x
+      smaller) buffers;
+    * **``__jax_array__``** — the dequant-at-USE seam.  flax's dtype
+      promotion calls ``jnp.asarray`` on every kernel the moment a
+      layer consumes it, which dispatches here: the dequantization
+      (``convert_element_type`` + ``mul``) is traced INSIDE the jitted
+      forward at the exact use site, the int8 array rides the program
+      as its baked constant, and XLA fuses the int8 read + scale into
+      the consuming conv/matmul.  Laziness matters: a program that
+      never touches a kernel (the session DECODE stage vs the backbone)
+      never bakes it — each stage's const bytes stay exactly its own
+      kernels, quantized.
+
+    The float form must never materialize host-side: ``dequantize`` is
+    jnp on purpose (numpy arithmetic on closure constants executes
+    EAGERLY inside a trace and would bake the folded f32 kernel back in,
+    silently undoing the whole quantization).
+    """
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        """The DEQUANTIZED dtype — what dtype-promotion logic (flax's
+        ``promote_dtype``) must see, so a quantized kernel promotes
+        exactly like the float kernel it replaces."""
+        return np.dtype(self.scale.dtype)
+
+    def dequantize(self):
+        """``q * scale`` in the scale's dtype, as jnp ops (see class
+        docstring for why never numpy)."""
+        import jax.numpy as jnp
+
+        scale = jnp.asarray(self.scale)
+        return jnp.asarray(self.q).astype(scale.dtype) * scale
+
+    # jnp.asarray(qtensor) -> the traced dequantized form.  This is the
+    # one seam flax (and any jnp consumer) reaches a kernel through.
+    __jax_array__ = dequantize
+
+    def __repr__(self):
+        return (f"QTensor(int8{list(self.q.shape)}, "
+                f"scale{list(self.scale.shape)})")
+
+
+def _is_qtensor(x) -> bool:
+    return isinstance(x, QTensor)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantPolicy:
+    """One weight-quantization regime, immutable and JSON-able (the
+    ``train.precision.Policy`` convention, serve-side).
+
+    ``weight_dtype`` is what quantized kernels store as; ``granularity``
+    names the scale sharing (per output channel); ``symmetric`` pins the
+    zero-point-free form (q = round(w/scale), no offset — the form whose
+    dequant is one multiply, which is exactly what ``ja002_allow``
+    declares)."""
+
+    weight_dtype: str = "int8"
+    granularity: str = "per_channel"
+    symmetric: bool = True
+
+    #: int8 range bound: symmetric [-127, 127] (never -128 — a symmetric
+    #: scale must map +max and -max to the same magnitude)
+    QMAX = 127
+
+    def ja002_allow(self) -> frozenset:
+        """The JA002 allowlist for programs built under this policy:
+        the strict default set plus :data:`QUANT_DEQUANT_PRIMS`."""
+        from ..analysis.ir import DEFAULT_F32_ACCUM_ALLOW
+
+        return DEFAULT_F32_ACCUM_ALLOW | QUANT_DEQUANT_PRIMS
+
+    def block(self) -> dict:
+        """The bench-record ``quantization`` block (keys stable)."""
+        return {
+            "weight_dtype": self.weight_dtype,
+            "granularity": self.granularity,
+            "symmetric": self.symmetric,
+        }
+
+
+def quant_policy(name: str | None) -> QuantPolicy | None:
+    """``model.quantization`` -> policy.  ``''``/``None``/``'none'`` is
+    the unquantized regime (no policy object: every consumer's
+    ``policy is None`` branch is the exact pre-quantization code path);
+    ``'int8'`` is per-channel symmetric weight-only int8."""
+    if not name or name == "none":
+        return None
+    if name == "int8":
+        return QuantPolicy()
+    raise ValueError(f"unknown model.quantization: {name!r} (int8 | none)")
+
+
+def quantization_block(policy: QuantPolicy | None) -> dict | None:
+    """The record block for bench consumers: the policy's declared
+    regime, or ``None`` when unquantized (key always present in the
+    record — the ``precision`` block convention)."""
+    return None if policy is None else policy.block()
+
+
+# ----------------------------------------------------------- quantization
+
+def _quantize_leaf(w: np.ndarray, policy: QuantPolicy) -> QTensor:
+    """Per-output-channel symmetric int8: scale over every axis but the
+    last (flax kernels are ``(..., cin, cout)`` / ``(cin, cout)``), so
+    each output channel's dynamic range is its own."""
+    w = np.asarray(w)
+    axes = tuple(range(w.ndim - 1))
+    amax = np.abs(w).max(axis=axes, keepdims=True).astype(np.float32)
+    # an all-zero channel (e.g. the head-inject projection's zero init)
+    # quantizes to q=0 under ANY scale; 1.0 keeps the math finite
+    scale = np.where(amax > 0, amax / policy.QMAX, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -policy.QMAX, policy.QMAX) \
+        .astype(np.int8)
+    return QTensor(q, scale)
+
+
+def _leaf_name(path) -> str:
+    last = path[-1]
+    return str(getattr(last, "key", getattr(last, "name", last)))
+
+
+def quantize_params(params, policy: QuantPolicy | None = None):
+    """Param tree -> the same tree with conv/dense kernels replaced by
+    :class:`QTensor` leaves (everything else untouched, f32).
+
+    Quantized: leaves named ``kernel`` with >= 2 dims — flax Conv and
+    Dense weights, the HBM-dominant tensors.  Left alone: biases, BN
+    scale/bias (1-D ``scale`` is BatchNorm's, never a QTensor's), and
+    anything exotic a model might register.
+    """
+    policy = policy or QuantPolicy()
+
+    def maybe_quantize(path, leaf):
+        if _leaf_name(path) == "kernel" and getattr(leaf, "ndim", 0) >= 2:
+            return _quantize_leaf(leaf, policy)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(maybe_quantize, params)
+
+
+def dequantize_tree(tree):
+    """Materialize every :class:`QTensor` in ``tree`` back to its float
+    form (``q * scale``).  Called INSIDE the jitted forwards — the f32
+    kernels exist only as fused intermediates, never in HBM."""
+    return jax.tree.map(
+        lambda x: x.dequantize() if _is_qtensor(x) else x,
+        tree, is_leaf=_is_qtensor)
+
+
+def quantize_report(params) -> dict:
+    """Byte accounting of a (possibly quantized) param tree: how much
+    HBM the quantization actually saved (the ~4x the contracts pin)."""
+    q_bytes = f_bytes = 0
+    n_q = n_f = 0
+
+    def visit(x):
+        nonlocal q_bytes, f_bytes, n_q, n_f
+        if _is_qtensor(x):
+            q_bytes += x.q.size * 1 + np.asarray(x.scale).nbytes
+            n_q += 1
+        else:
+            f_bytes += int(np.prod(getattr(x, "shape", ()),
+                                   dtype=np.int64)
+                           * np.dtype(x.dtype).itemsize) \
+                if hasattr(x, "dtype") else 0
+            n_f += 1
+        return x
+
+    jax.tree.map(visit, params, is_leaf=_is_qtensor)
+    return {"quantized_leaves": n_q, "float_leaves": n_f,
+            "quantized_bytes": int(q_bytes), "float_bytes": int(f_bytes)}
+
+
+# -------------------------------------------------------------- predictor
+
+class QuantizedPredictor(Predictor):
+    """A :class:`predict.Predictor` whose kernels live as int8 + scales.
+
+    Identical API and identical program structure — the encode/decode
+    split, the bucket ladder, sessions, hot-swap and the AOT cache all
+    compose, because the predictor itself changes NOTHING: the
+    :class:`QTensor` leaves in ``params`` dequantize at use via
+    ``__jax_array__`` inside whichever forward consumes them.
+    ``quant_policy`` rides along for the audit/bench surfaces
+    (``ja002_allow``, the ``quantization`` record block, the AOT cache
+    fingerprint)."""
+
+    def __init__(self, model, params, batch_stats, *,
+                 quant_policy: QuantPolicy | None = None, **kwargs):
+        self.quant_policy = quant_policy or QuantPolicy()
+        super().__init__(model, params, batch_stats, **kwargs)
+
+
+def quantize_predictor(predictor: Predictor,
+                       policy: QuantPolicy | None = None
+                       ) -> QuantizedPredictor:
+    """Quantize a live predictor's weights into a drop-in replacement.
+
+    The serving configuration (resolution, relax, guidance family, ...)
+    carries over — the same inheritance seam as
+    ``serve.swap.load_swap_predictor`` — so the quantized predictor's
+    compiled ladder is shape-compatible with the service it replaces
+    (a quantized generation can canary into a live f32 fleet and roll
+    back).  The f32 kernels are not retained: the returned predictor's
+    ``params`` hold the int8/scales tree.
+    """
+    policy = policy or QuantPolicy()
+    kwargs = {attr: getattr(predictor, attr)
+              for attr in ("resolution", "relax", "zero_pad", "alpha",
+                           "guidance", "in_channels")}
+    kwargs["mesh"] = getattr(predictor, "mesh", None)
+    return QuantizedPredictor(
+        predictor.model,
+        quantize_params(predictor.params, policy),
+        predictor.batch_stats, quant_policy=policy, **kwargs)

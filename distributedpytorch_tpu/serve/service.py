@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import dataclasses
 import queue
+import sys
 import threading
 import time
 from concurrent.futures import Future
@@ -169,7 +170,8 @@ class InferenceService:
                  trace: TraceCapture | None = None,
                  session_budget_bytes: int = 256 << 20,
                  session_ttl_s: float = 600.0,
-                 session_lane_depth: int = 4):
+                 session_lane_depth: int = 4,
+                 aot_cache=None):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         if max_wait_s < 0:
@@ -178,6 +180,19 @@ class InferenceService:
             raise ValueError(f"session_lane_depth must be >= 1, got "
                              f"{session_lane_depth}")
         self.predictor = predictor
+        #: AOT executable cache (serve/aot.py): a path or AotCache, or
+        #: None — when set, :meth:`warmup` LOADS pre-compiled
+        #: executables instead of compiling (near-zero cold start),
+        #: with loud per-program fallback to fresh compile on any
+        #: miss/corruption
+        if isinstance(aot_cache, str):
+            from .aot import AotCache
+
+            aot_cache = AotCache(aot_cache)
+        self._aot_cache = aot_cache
+        #: the last :meth:`warmup`'s summary (bench.py's `cold_start`
+        #: record block reads it); None until a warmup ran
+        self.last_warmup: dict | None = None
         self.buckets = batching.bucket_sizes(max_batch)
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
@@ -460,24 +475,132 @@ class InferenceService:
         return self.submit(image, points, deadline_s,
                            session_id=session_id).result(timeout)
 
-    def warmup(self) -> None:
-        """Compile every bucket's program before taking traffic: a cold
+    def warmup(self) -> dict:
+        """Ready every bucket's program before taking traffic: a cold
         service otherwise charges its first unlucky clients the XLA
         compile — exactly the latency cliff the bucket ladder prevents.
         A split predictor warms TWO programs per bucket (encode at the
         crop shape, decode at the feature shape).
+
+        With an ``aot_cache`` configured, each program LOADS its
+        pre-compiled executable (``dptpu-aot``) instead of compiling —
+        a warm-cache boot performs ZERO XLA compiles (watchdog-
+        verified in tests/test_aot.py).  A missing/mismatched entry
+        compiles fresh with a loud stderr line naming why; a corrupt
+        entry (checksum) is REFUSED the same way — degraded cold
+        start, never a silently-wrong executable.  Per-program
+        compile-vs-load millis are logged either way and returned (and
+        kept as :attr:`last_warmup` — the bench `cold_start` block).
 
         The warmed shapes are registered with the retrace tripwire: these
         compiles happen on the CALLING thread (invisible to the worker's
         thread-local watchdog), so without registration the budget would
         silently allow that many real steady-state retraces before
         tripping."""
-        if self.sessions_enabled:
-            self._warm_split_predictor(self.predictor)
-            return
-        for shape in warmup_buckets(self.predictor, self.buckets):
-            self._warm_shapes.add((*self._compiled_shape(shape),
-                                   self._pred_key(self.predictor)))
+        from .aot import AotCacheError, AotCacheMiss, ladder_programs
+
+        t0 = time.perf_counter()
+        cache = self._aot_cache
+        fingerprint = None
+        if cache is not None and getattr(self.predictor, "mesh",
+                                         None) is not None:
+            print("serve/aot: cache disabled for this boot — mesh "
+                  "predictors compile process-local GSPMD programs",
+                  file=sys.stderr)
+            cache = None
+        if cache is not None:
+            from .aot import cache_fingerprint
+
+            try:
+                fingerprint = cache_fingerprint(self.predictor)
+            except Exception as e:  # fingerprinting never kills a boot
+                print(f"serve/aot: cache disabled for this boot — "
+                      f"fingerprinting failed ({type(e).__name__}: {e})",
+                      file=sys.stderr)
+                cache = None
+        programs = []
+        pred = self.predictor
+        h, w = pred.resolution
+        ch = getattr(pred, "in_channels", 4)
+        for name, _fn, _args, key in ladder_programs(pred, self.buckets):
+            kind = key[0]
+            b = key[1] if kind != "forward" else key[1][0]
+            if kind == "forward":
+                def compile_fn(b=b):
+                    pred.forward_prepared(np.zeros((b, h, w, ch),
+                                                   np.float32))
+                warm_key = (*self._compiled_shape((b, h, w, ch)),
+                            self._pred_key(pred))
+            elif kind == "encode":
+                def compile_fn(b=b):
+                    pred.encode_jitted(np.zeros((b, h, w, ch - 1),
+                                                np.float32))
+                warm_key = ("enc", b, self._pred_key(pred))
+            else:
+                def compile_fn(b=b):
+                    feats = pred.encode_jitted(
+                        np.zeros((b, h, w, ch - 1), np.float32))
+                    pred.decode_jitted(feats, np.zeros((b, h, w, 1),
+                                                       np.float32))
+                warm_key = ("dec", b, self._pred_key(pred))
+            programs.append((name, key, warm_key, compile_fn))
+
+        log: list[dict] = []
+        for name, key, warm_key, compile_fn in programs:
+            p0 = time.perf_counter()
+            outcome, fallback = "compile", None
+            if cache is not None:
+                try:
+                    exe = cache.load(name, fingerprint)
+                    pred.install_aot(key, exe)
+                    outcome = "load"
+                except AotCacheMiss as e:
+                    fallback = "miss"
+                    print(f"serve/aot: miss for {name!r}: {e} — "
+                          "compiling fresh", file=sys.stderr)
+                except AotCacheError as e:
+                    fallback = "error"
+                    print(f"serve/aot: REFUSING cache entry {name!r}: "
+                          f"{e} — falling back to fresh compile",
+                          file=sys.stderr)
+                except Exception as e:  # noqa: BLE001 — the backstop:
+                    # a corrupt cache is a degraded cold start, NEVER a
+                    # dead boot; anything the typed paths missed still
+                    # falls back to a fresh compile, loudly
+                    fallback = "error"
+                    print(f"serve/aot: unexpected failure loading "
+                          f"{name!r} ({type(e).__name__}: {e}) — "
+                          "falling back to fresh compile",
+                          file=sys.stderr)
+            if outcome == "compile":
+                compile_fn()
+            self._warm_shapes.add(warm_key)
+            ms = (time.perf_counter() - p0) * 1e3
+            log.append({"program": name, "outcome": outcome,
+                        "fallback": fallback, "ms": round(ms, 3)})
+            # the operator's per-bucket compile-vs-load ledger — the
+            # cold-start tax made visible whether or not a cache is on
+            print(f"serve/warmup: {name}: {outcome} {ms:.1f} ms"
+                  + (f" (cache {fallback})" if fallback else ""),
+                  file=sys.stderr)
+        loaded = sum(1 for e in log if e["outcome"] == "load")
+        compiled = len(log) - loaded
+        if self._aot_cache is None:
+            aot = "off"
+        elif compiled == 0 and loaded:
+            aot = "hit"
+        elif loaded:
+            aot = "partial"
+        else:
+            aot = "miss"
+        self.last_warmup = {
+            "warmup_seconds": round(time.perf_counter() - t0, 4),
+            "programs_compiled": compiled,
+            "programs_loaded": loaded,
+            "aot_cache": aot,
+            "programs": log,
+        }
+        return self.last_warmup
 
     def _warm_split_predictor(self, pred) -> None:
         """Compile a split predictor's encode+decode ladder on the

@@ -272,6 +272,19 @@ class ModelConfig:
                                         # parity with the reference.
                                         # None = f32 (exact reference-like
                                         # scores)
+    quantization: str = ""              # SERVE-side post-training weight
+                                        # quantization (serve/quantize):
+                                        # 'int8' = per-channel symmetric
+                                        # int8 kernels, dequant-at-use in
+                                        # the jitted forward, JA002-
+                                        # audited against QuantPolicy's
+                                        # declared dequant points.
+                                        # Training always runs
+                                        # full-precision; dptpu-serve and
+                                        # dptpu-aot read this knob (their
+                                        # --quantize flag overrides).
+                                        # "" = serve the checkpoint as
+                                        # trained
     remat: bool = False                 # rematerialize backbone blocks
     moe_experts: int = 0                # >0: MoE FFN in the DANet head
     moe_hidden: int | None = None       # expert MLP width (default: channels)

@@ -38,13 +38,22 @@
 #      reader sits on the loader hot path (one crc32 + one memcpy per
 #      record, numpy + stdlib ONLY: it must stay importable pre-jax,
 #      and its chaos seam must cost one attribute check disabled) and
-#      the dptpu-pack CLI never touches a device) plus bench.py, the
-#      official record.
+#      the dptpu-pack CLI never touches a device; serve/quantize.py +
+#      serve/aot.py included — the quantized forward's QTensor
+#      dequant-at-use MUST stay jnp (numpy arithmetic on closure
+#      constants folds eagerly at trace time and would silently bake
+#      the f32 kernels back in), and the AOT cache's load path sits on
+#      the replica boot path: crc + fallback logic only, no device
+#      touches beyond deserialization, and `dptpu-aot --verify` stays
+#      a pure-host sweep) plus bench.py, the official record.
 #   2. jaxaudit check — IR-level compile contracts: the canonical
 #      train/eval/serve programs (incl. the session split's
 #      encode_step/decode_step, train_step_bf16 — the mixed-
 #      precision bucketed-reduce fast path, JA002-audited against the
 #      policy's declared accumulation points, its psum buckets pinned —
+#      the int8-quantized serve programs serve_forward_int8_b1/b8 +
+#      decode_int8, JA002-audited against QuantPolicy's declared
+#      dequant points with the ~4x const-byte shrink pinned,
 #      AND the per-strategy plan programs train_step_dp_tp /
 #      train_step_dp_zero1 / train_step_dp_tp_zero1, whose contracts
 #      pin the PER-MESH-AXIS collective inventory so a 2-D-mesh step

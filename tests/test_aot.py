@@ -1,0 +1,419 @@
+"""serve/aot: the AOT executable cache — near-zero cold start, policed.
+
+The acceptance surface of the cold-start leg: build/load round-trip
+(bitwise-equal outputs, including across a FRESH process), the
+watchdog-verified zero-compile warm boot, the mismatch-key fallback
+matrix (every fingerprint key misses loudly, naming itself), checksum
+refusal of torn/bit-rotted entries, the atomic manifest, the
+``dptpu-aot --verify`` sweep, and the ``stale_aot_cache`` chaos
+scenario through the real runner.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.serve import InferenceService
+from distributedpytorch_tpu.serve import aot as aot_lib
+from distributedpytorch_tpu.serve.aot import (
+    AotCache,
+    AotCacheError,
+    AotCacheMiss,
+    cache_fingerprint,
+    fingerprint_mismatch,
+)
+from distributedpytorch_tpu.utils.compile_watchdog import CompileWatchdog
+
+
+def _image(h=90, w=120, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (h, w, 3)).astype(np.uint8)
+
+
+def _points(d=0.0):
+    return np.array([[30.0, 45.0], [95.0, 40.0],
+                     [60.0, 20.0], [55.0, 75.0]]) + d
+
+
+@pytest.fixture(scope="module")
+def stem_cache(serve_stem_predictor, tmp_path_factory):
+    """One built cache for the module (building compiles the ladder —
+    share it like the predictor fixture shares its compiled programs)."""
+    d = str(tmp_path_factory.mktemp("aot_stem"))
+    cache = AotCache(d)
+    summary = cache.build(serve_stem_predictor, (1, 2))
+    return cache, summary
+
+
+class TestBuildAndVerify:
+    def test_build_writes_entries_and_manifest(self, stem_cache):
+        cache, summary = stem_cache
+        assert summary["programs"] == ["forward_b1", "forward_b2"]
+        man = cache.manifest()
+        assert set(man["entries"]) == {"forward_b1", "forward_b2"}
+        for ent in man["entries"].values():
+            path = os.path.join(cache.cache_dir, ent["file"])
+            assert os.path.getsize(path) == ent["bytes"]
+        assert man["fingerprint"]["params_digest"]
+
+    def test_verify_clean(self, stem_cache):
+        cache, _ = stem_cache
+        rep = cache.verify()
+        assert rep["entries"] == 2 and not rep["bad"] \
+            and not rep["missing"]
+
+    def test_mesh_predictor_refused(self, stem_cache,
+                                    serve_stem_predictor, tmp_path):
+        class FakeMesh:
+            pass
+
+        pred = serve_stem_predictor
+        try:
+            pred.mesh = FakeMesh()
+            with pytest.raises(ValueError, match="mesh"):
+                AotCache(str(tmp_path)).build(pred, (1,))
+        finally:
+            pred.mesh = None
+
+    def test_split_ladder_programs(self, serve_split_predictor):
+        progs = aot_lib.ladder_programs(serve_split_predictor, (1, 2))
+        assert [p[0] for p in progs] == ["encode_b1", "decode_b1",
+                                         "encode_b2", "decode_b2"]
+        assert [p[3] for p in progs] == [("encode", 1), ("decode", 1),
+                                         ("encode", 2), ("decode", 2)]
+
+
+class TestRoundTrip:
+    def test_loaded_executable_is_bitwise_equal(self, stem_cache,
+                                                serve_stem_predictor):
+        cache, _ = stem_cache
+        fp = cache_fingerprint(serve_stem_predictor)
+        exe = cache.load("forward_b1", fp)
+        x = serve_stem_predictor.prepare(_image(), _points())[0][None]
+        want = serve_stem_predictor.forward_prepared(x)
+        got = np.asarray(exe(x))[..., 0]
+        np.testing.assert_array_equal(got, want)
+
+    def test_fresh_process_round_trip(self, stem_cache, tmp_path):
+        """THE serialization acceptance: a process that never compiled
+        the program deserializes the cache entry and produces bitwise
+        the same probabilities this process's jit forward does."""
+        cache, _ = stem_cache
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = str(tmp_path / "probs.npy")
+        inp = str(tmp_path / "x.npy")
+        from conftest import _make_serve_predictor
+
+        pred = _make_serve_predictor("stem")
+        # same weights by construction (PRNGKey(0) init) as the fixture
+        x = pred.prepare(_image(), _points())[0][None].astype(np.float32)
+        np.save(inp, x)
+        want = pred.forward_prepared(x)
+        code = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import numpy as np
+from distributedpytorch_tpu.serve.aot import AotCache
+cache = AotCache({cache.cache_dir!r})
+man = cache.manifest()
+exe = cache.load("forward_b1", man["fingerprint"])
+x = np.load({inp!r})
+np.save({out!r}, np.asarray(exe(x)))
+print("fresh-ok")
+"""
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=300,
+                           cwd=repo, env=dict(os.environ, PYTHONPATH=repo))
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "fresh-ok" in r.stdout
+        np.testing.assert_array_equal(np.load(out)[..., 0], want)
+
+
+class TestWarmBoot:
+    def test_zero_compile_warm_boot_watchdog_verified(self, stem_cache):
+        """THE cold-start acceptance: an AOT-warm boot performs ZERO
+        XLA compiles through warmup AND the traffic that follows —
+        verified by a CompileWatchdog around the whole boot."""
+        cache, _ = stem_cache
+        from conftest import _make_serve_predictor
+
+        pred = _make_serve_predictor("stem")  # fresh jit cache
+        svc = InferenceService(pred, max_batch=2, max_wait_s=0.0,
+                               aot_cache=cache)
+        img = _image()
+        with CompileWatchdog(match="forward") as wd:
+            warm = svc.warmup()
+            with svc:
+                m1 = svc.predict(img, _points(), timeout=120)
+                m2 = svc.predict(img, _points(1), timeout=120)
+        assert warm["aot_cache"] == "hit"
+        assert warm["programs_compiled"] == 0
+        assert warm["programs_loaded"] == 2
+        assert sum(wd.counts.values()) == 0, dict(wd.counts)
+        # and the served masks are the jit forward's, bitwise
+        np.testing.assert_array_equal(m1, pred.predict(img, _points()))
+        assert np.isfinite(m2).all()
+        assert svc.metrics.retrace_failures == 0
+
+    def test_warmup_measures_and_logs_either_way(self,
+                                                 serve_stem_predictor,
+                                                 capsys):
+        """No cache configured: warmup still returns (and logs) the
+        per-program compile millis — the cold-start tax is visible
+        whether or not a cache exists."""
+        svc = InferenceService(serve_stem_predictor, max_batch=2,
+                               max_wait_s=0.0)
+        warm = svc.warmup()
+        assert warm["aot_cache"] == "off"
+        assert warm["programs_compiled"] == 2
+        assert warm["warmup_seconds"] > 0
+        assert [e["program"] for e in warm["programs"]] \
+            == ["forward_b1", "forward_b2"]
+        assert all(e["ms"] >= 0 for e in warm["programs"])
+        err = capsys.readouterr().err
+        assert "serve/warmup: forward_b1: compile" in err
+
+    def test_split_warm_boot(self, serve_split_predictor,
+                             tmp_path_factory):
+        d = str(tmp_path_factory.mktemp("aot_split"))
+        AotCache(d).build(serve_split_predictor, (1, 2))
+        from conftest import _make_serve_predictor
+
+        pred = _make_serve_predictor("head")
+        svc = InferenceService(pred, max_batch=2, max_wait_s=0.0,
+                               aot_cache=d)
+        img = _image()
+        with CompileWatchdog(match="forward") as wd:
+            warm = svc.warmup()
+            with svc:
+                cold = svc.predict(img, _points(), timeout=120,
+                                   session_id="s")
+                hot = svc.predict(img, _points(1), timeout=120,
+                                  session_id="s")
+        assert warm["aot_cache"] == "hit" and warm["programs_loaded"] == 4
+        assert sum(wd.counts.values()) == 0, dict(wd.counts)
+        assert np.isfinite(cold).all() and np.isfinite(hot).all()
+        assert svc.health()["sessions"]["hits"] >= 1
+
+
+class TestFallbackMatrix:
+    """Every way a cache can lie, and the typed refusal each earns."""
+
+    def _fp(self, pred):
+        return cache_fingerprint(pred)
+
+    def test_missing_manifest_is_miss(self, tmp_path,
+                                      serve_stem_predictor):
+        with pytest.raises(AotCacheMiss, match="no AOT manifest"):
+            AotCache(str(tmp_path)).load(
+                "forward_b1", self._fp(serve_stem_predictor))
+
+    def test_each_fingerprint_key_misses_naming_itself(
+            self, stem_cache, serve_stem_predictor):
+        cache, _ = stem_cache
+        good = self._fp(serve_stem_predictor)
+        for key, bogus in (("jaxlib", "9.9.9"),
+                           ("platform", "tpu"),
+                           ("topology", "tpu:256/p32"),
+                           ("resolution", [512, 512]),
+                           ("params_digest", "deadbeef"),
+                           ("quantization", {"weight_dtype": "int8"})):
+            probe = dict(good, **{key: bogus})
+            with pytest.raises(AotCacheMiss, match=key):
+                cache.load("forward_b1", probe)
+
+    def test_fingerprint_mismatch_names_all_differing_keys(self):
+        saved = {"a": 1, "b": 2}
+        live = {"a": 1, "b": 3, "c": 4}
+        names = " ".join(fingerprint_mismatch(saved, live))
+        assert "b:" in names and "c:" in names and "a:" not in names
+
+    def test_absent_program_is_miss(self, stem_cache,
+                                    serve_stem_predictor):
+        cache, _ = stem_cache
+        with pytest.raises(AotCacheMiss, match="forward_b8"):
+            cache.load("forward_b8", self._fp(serve_stem_predictor))
+
+    def test_bitflipped_entry_is_checksum_error(self, stem_cache,
+                                                serve_stem_predictor,
+                                                tmp_path):
+        import shutil
+
+        cache, _ = stem_cache
+        d = str(tmp_path / "flip")
+        shutil.copytree(cache.cache_dir, d)
+        flipped = AotCache(d)
+        ent = flipped.manifest()["entries"]["forward_b1"]
+        path = os.path.join(d, ent["file"])
+        with open(path, "r+b") as f:
+            f.seek(ent["bytes"] // 2)
+            byte = f.read(1)
+            f.seek(ent["bytes"] // 2)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        with pytest.raises(AotCacheError, match="checksum"):
+            flipped.load("forward_b1", self._fp(serve_stem_predictor))
+        rep = flipped.verify()
+        assert rep["bad"] == ["forward_b1"]
+
+    def test_truncated_entry_is_checksum_error(self, stem_cache,
+                                               serve_stem_predictor,
+                                               tmp_path):
+        import shutil
+
+        cache, _ = stem_cache
+        d = str(tmp_path / "trunc")
+        shutil.copytree(cache.cache_dir, d)
+        torn = AotCache(d)
+        ent = torn.manifest()["entries"]["forward_b2"]
+        with open(os.path.join(d, ent["file"]), "r+b") as f:
+            f.truncate(ent["bytes"] // 2)
+        with pytest.raises(AotCacheError, match="checksum"):
+            torn.load("forward_b2", self._fp(serve_stem_predictor))
+
+    def test_schema_corrupt_manifest_is_typed_error(
+            self, stem_cache, tmp_path, serve_stem_predictor):
+        """Valid JSON, mangled entry records: must stay INSIDE the
+        typed-fallback contract (a boot degrades to fresh compile),
+        never a TypeError escaping warmup."""
+        import shutil
+
+        cache, _ = stem_cache
+        d = str(tmp_path / "schema")
+        shutil.copytree(cache.cache_dir, d)
+        bad = AotCache(d)
+        man = bad.manifest()
+        man["entries"]["forward_b1"] = "not-a-record"
+        with open(os.path.join(d, aot_lib.MANIFEST), "w") as f:
+            json.dump(man, f)
+        with pytest.raises(AotCacheError, match="malformed"):
+            bad.load("forward_b1", self._fp(serve_stem_predictor))
+        # and a service pointed at it boots anyway (full fresh compile)
+        svc = InferenceService(serve_stem_predictor, max_batch=1,
+                               max_wait_s=0.0, aot_cache=d)
+        warm = svc.warmup()
+        assert warm["programs"][0]["fallback"] == "error"
+        with svc:
+            assert np.isfinite(
+                svc.predict(_image(), _points(), timeout=120)).all()
+
+    def test_torn_manifest_is_typed_error(self, stem_cache, tmp_path,
+                                          serve_stem_predictor):
+        import shutil
+
+        cache, _ = stem_cache
+        d = str(tmp_path / "tornman")
+        shutil.copytree(cache.cache_dir, d)
+        man_path = os.path.join(d, aot_lib.MANIFEST)
+        with open(man_path, "r+b") as f:
+            f.truncate(os.path.getsize(man_path) // 2)
+        with pytest.raises(AotCacheError, match="manifest"):
+            AotCache(d).load("forward_b1",
+                             self._fp(serve_stem_predictor))
+
+    def test_service_boot_survives_every_fallback(self, stem_cache,
+                                                  serve_stem_predictor,
+                                                  tmp_path, capsys):
+        """A service pointed at a rotten cache boots ANYWAY: the bad
+        entry compiles fresh with a loud line, the good one loads."""
+        import shutil
+
+        cache, _ = stem_cache
+        d = str(tmp_path / "partial")
+        shutil.copytree(cache.cache_dir, d)
+        ent = AotCache(d).manifest()["entries"]["forward_b1"]
+        with open(os.path.join(d, ent["file"]), "r+b") as f:
+            f.truncate(1)
+        svc = InferenceService(serve_stem_predictor, max_batch=2,
+                               max_wait_s=0.0, aot_cache=d)
+        warm = svc.warmup()
+        with svc:
+            mask = svc.predict(_image(), _points(), timeout=120)
+        assert warm["aot_cache"] == "partial"
+        outcomes = {e["program"]: (e["outcome"], e["fallback"])
+                    for e in warm["programs"]}
+        assert outcomes["forward_b1"] == ("compile", "error")
+        assert outcomes["forward_b2"] == ("load", None)
+        assert np.isfinite(mask).all()
+        assert "REFUSING cache entry 'forward_b1'" \
+            in capsys.readouterr().err
+
+    def test_quantized_and_f32_caches_never_cross(self, stem_cache,
+                                                  serve_stem_predictor):
+        """An f32-built cache must miss for the quantized twin of the
+        same checkpoint (different params digest AND quantization
+        block) — an int8 boot can never execute f32-baked programs."""
+        from distributedpytorch_tpu.serve.quantize import (
+            quantize_predictor,
+        )
+
+        cache, _ = stem_cache
+        qfp = cache_fingerprint(quantize_predictor(serve_stem_predictor))
+        with pytest.raises(AotCacheMiss) as e:
+            cache.load("forward_b1", qfp)
+        assert "quantization" in str(e.value)
+        assert "params_digest" in str(e.value)
+
+
+class TestVerifyCli:
+    def test_verify_clean_exits_zero(self, stem_cache):
+        rc = aot_lib.main(["--cache-dir", stem_cache[0].cache_dir,
+                           "--verify"])
+        assert rc == 0
+
+    def test_verify_names_bad_entries_nonzero(self, stem_cache,
+                                              tmp_path, capsys):
+        import shutil
+
+        d = str(tmp_path / "bad")
+        shutil.copytree(stem_cache[0].cache_dir, d)
+        ent = AotCache(d).manifest()["entries"]["forward_b1"]
+        with open(os.path.join(d, ent["file"]), "r+b") as f:
+            f.truncate(3)
+        rc = aot_lib.main(["--cache-dir", d, "--verify"])
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert "forward_b1" in captured.err
+        assert json.loads(captured.out)["bad"] == ["forward_b1"]
+
+    def test_verify_missing_cache_exits_two(self, tmp_path, capsys):
+        rc = aot_lib.main(["--cache-dir", str(tmp_path / "nope"),
+                           "--verify"])
+        assert rc == 2
+        assert "manifest" in capsys.readouterr().err
+
+    def test_build_with_injected_predictor(self, serve_stem_predictor,
+                                           tmp_path, capsys):
+        rc = aot_lib.main(["--cache-dir", str(tmp_path / "cli"),
+                           "--max-batch", "1"],
+                          predictor=serve_stem_predictor)
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["programs"] \
+            == ["forward_b1"]
+
+    def test_build_without_source_errors(self, tmp_path, capsys):
+        with pytest.raises(SystemExit):
+            aot_lib.main(["--cache-dir", str(tmp_path)])
+
+
+class TestStaleAotScenario:
+    def test_chaos_scenario_green_through_real_runner(self, tmp_path):
+        """stale_aot_cache end to end: bitflip in flight, torn entry on
+        disk, topology-mismatched manifest — every boot falls back
+        loudly and serves bitwise-correct masks."""
+        from distributedpytorch_tpu.chaos.runner import run_scenario
+
+        report = run_scenario("stale_aot_cache", work_dir=str(tmp_path))
+        assert report["ok"], report["invariants"]
+        assert report["chaos_injected_total"] == {
+            "{kind=bitflip,site=serve/aot_load}": 1}
+        phase = report["phases"]["serve_aot"]
+        assert phase["bitflip"]["bitwise_equal"]
+        assert phase["mismatch"]["warmup"]["aot_cache"] == "miss"

@@ -425,6 +425,94 @@ class TestCheckRegression:
         assert blk["topology_changes"] == 3 and blk["replans"] == 2
         assert blk["recovery_p50_s"] == 1.5
 
+    def test_quantization_variants_never_cross_compare(self, tmp_path):
+        # an int8-quantized serve record and an f32 one run different
+        # compiled programs — the filter keys on the quantization
+        # block; null == unquantized, so pre-quantization history
+        # still gates unquantized records
+        int8 = self._rec(60.0, metric="danet_resnet18_64px_serve_b8_x")
+        int8["quantization"] = {"weight_dtype": "int8",
+                                "granularity": "per_channel",
+                                "symmetric": True}
+        with open(tmp_path / "BENCH_r01.json", "w") as f:
+            json.dump({"parsed": int8}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        # unquantized record: different trajectory
+        f32 = self._rec(10.0, metric="danet_resnet18_64px_serve_b8_x")
+        f32["quantization"] = None
+        ok, msg = bench.check_regression(f32, hist)
+        assert ok and "nothing to compare" in msg
+        # the matching int8 record DOES gate
+        probe = self._rec(40.0, metric="danet_resnet18_64px_serve_b8_x")
+        probe["quantization"] = dict(int8["quantization"])
+        ok, msg = bench.check_regression(probe, hist)
+        assert not ok and "regression" in msg
+        # pre-quantization history (no key) still gates a fresh
+        # unquantized record whose block is null
+        old = self._rec(67.5, metric="danet_resnet18_64px_serve_b8_x")
+        with open(tmp_path / "BENCH_r02.json", "w") as f:
+            json.dump({"parsed": old}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        ok, msg = bench.check_regression(f32, hist)
+        assert not ok and "BENCH_r02" in msg
+
+    def test_aot_warm_records_never_baseline_cold_ones(self, tmp_path):
+        # a warm-cache boot (aot_cache=hit) and a cold-compile one are
+        # different cold-start regimes — the filter keys on the
+        # cold_start.aot_cache value; a missing cold_start (train
+        # records, pre-AOT history) normalizes to "off"
+        warm = self._rec(60.0, metric="serve_m")
+        warm["cold_start"] = {"warmup_seconds": 0.4,
+                              "programs_compiled": 0,
+                              "aot_cache": "hit"}
+        with open(tmp_path / "BENCH_r01.json", "w") as f:
+            json.dump({"parsed": warm}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        cold = self._rec(10.0, metric="serve_m")
+        cold["cold_start"] = {"warmup_seconds": 8.2,
+                              "programs_compiled": 4,
+                              "aot_cache": "off"}
+        ok, msg = bench.check_regression(cold, hist)
+        assert ok and "nothing to compare" in msg
+        # matching warm record gates
+        probe = self._rec(40.0, metric="serve_m")
+        probe["cold_start"] = dict(warm["cold_start"],
+                                   warmup_seconds=0.5)
+        ok, msg = bench.check_regression(probe, hist)
+        assert not ok and "regression" in msg
+        # pre-AOT history (no cold_start key) == "off": still gates a
+        # fresh cold record
+        old = self._rec(67.5, metric="serve_m")
+        with open(tmp_path / "BENCH_r02.json", "w") as f:
+            json.dump({"parsed": old}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        ok, msg = bench.check_regression(cold, hist)
+        assert not ok and "BENCH_r02" in msg
+
+    def test_quantize_and_aot_envs_are_non_default_configs(
+            self, monkeypatch):
+        monkeypatch.setenv("DPTPU_BENCH_QUANTIZE", "int8")
+        assert not bench._is_default_config()
+        monkeypatch.delenv("DPTPU_BENCH_QUANTIZE")
+        monkeypatch.setenv("DPTPU_BENCH_AOT_CACHE", "/tmp/aot")
+        assert not bench._is_default_config()
+        monkeypatch.delenv("DPTPU_BENCH_AOT_CACHE")
+
+    def test_cold_start_block_schema(self):
+        # train records: block null, key present (stamped in main());
+        # serve records: the three keys from the service's last warmup
+        assert bench._cold_start_block(None) is None
+        blk = bench._cold_start_block(
+            {"warmup_seconds": 1.25, "programs_compiled": 2,
+             "programs_loaded": 0, "aot_cache": "off",
+             "programs": []})
+        assert blk == {"warmup_seconds": 1.25, "programs_compiled": 2,
+                       "aot_cache": "off"}
+        assert bench._cold_start_aot({"cold_start": None}) == "off"
+        assert bench._cold_start_aot({}) == "off"
+        assert bench._cold_start_aot(
+            {"cold_start": {"aot_cache": "hit"}}) == "hit"
+
     def test_feed_source_variants_never_cross_compare(self, tmp_path):
         # a packed-plane record (DPTPU_BENCH_SOURCE=packed) and an fs
         # one measure different input regimes — the filter keys on

@@ -1,0 +1,295 @@
+"""serve/quantize: int8 weight quantization of the serve forward.
+
+The acceptance surface of the quantization leg: per-channel symmetric
+int8 mechanics, the banded parity + mask-IoU gate vs the f32 forward
+across every ladder bucket, the session split's bitwise warm/cold
+self-consistency, hot-swap composition (a quantized canary rolls
+back), and the JA002 contract — zero findings under the declared
+QuantPolicy allowlist, a DIRTY audit under the strict default (the
+declaration is load-bearing).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from distributedpytorch_tpu.serve import quantize as quantize_lib
+from distributedpytorch_tpu.serve.quantize import (
+    QTensor,
+    QuantizedPredictor,
+    QuantPolicy,
+    quant_policy,
+    quantization_block,
+    quantize_params,
+    quantize_predictor,
+)
+
+#: the pinned parity band vs the f32 forward (random-init weights are
+#: the WORST case — an untrained net amplifies weight perturbations):
+#: per-pixel probabilities within this absolute band...
+PARITY_MAX_ABS = 0.25
+#: ...with the bulk far tighter (mean abs), and the thresholded masks
+#: agreeing at IoU >= 0.99 — the acceptance gate of the ISSUE
+PARITY_MEAN_ABS = 0.02
+PARITY_MIN_IOU = 0.99
+
+
+def _image(h=90, w=120, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (h, w, 3)).astype(np.uint8)
+
+
+def _points(d=0.0):
+    return np.array([[30.0, 45.0], [95.0, 40.0],
+                     [60.0, 20.0], [55.0, 75.0]]) + d
+
+
+class TestQuantizeParams:
+    def test_kernels_become_qtensors_everything_else_untouched(
+            self, serve_stem_predictor):
+        params = serve_stem_predictor.params
+        qparams = quantize_params(params)
+        flat = jax.tree_util.tree_flatten_with_path(
+            qparams, is_leaf=lambda x: isinstance(x, QTensor))[0]
+        n_q = n_plain = 0
+        for path, leaf in flat:
+            name = str(getattr(path[-1], "key", path[-1]))
+            if isinstance(leaf, QTensor):
+                n_q += 1
+                assert name == "kernel"
+                assert leaf.q.dtype == np.int8
+                assert leaf.scale.dtype == np.float32
+                # per-OUTPUT-channel scales: one per last-axis slot
+                assert leaf.scale.shape == \
+                    (1,) * (leaf.q.ndim - 1) + (leaf.q.shape[-1],)
+            else:
+                n_plain += 1
+                assert name != "kernel" or np.ndim(leaf) < 2
+        assert n_q > 0 and n_plain > 0
+
+    def test_symmetric_range_and_reconstruction(self):
+        w = np.random.RandomState(0).normal(
+            0, 0.1, (3, 3, 8, 16)).astype(np.float32)
+        qt = quantize_params({"kernel": w})["kernel"]
+        assert int(np.abs(qt.q).max()) <= QuantPolicy.QMAX
+        recon = np.asarray(qt.dequantize())
+        # per-channel scale bounds the error at half a quantization step
+        step = np.abs(w).max(axis=(0, 1, 2)) / QuantPolicy.QMAX
+        assert (np.abs(recon - w) <= step / 2 + 1e-7).all()
+
+    def test_zero_kernel_is_finite(self):
+        qt = quantize_params({"kernel": np.zeros((1, 1, 4, 4),
+                                                 np.float32)})["kernel"]
+        assert (np.asarray(qt.q) == 0).all()
+        assert np.isfinite(np.asarray(qt.scale)).all()
+        assert (np.asarray(qt.dequantize()) == 0).all()
+
+    def test_report_counts_the_4x_shrink(self, serve_stem_predictor):
+        qparams = quantize_params(serve_stem_predictor.params)
+        rep = quantize_lib.quantize_report(qparams)
+        f32_kernel_bytes = sum(
+            np.prod(l.shape) * 4
+            for l in jax.tree.leaves(serve_stem_predictor.params)
+            if np.ndim(l) >= 2)
+        assert rep["quantized_leaves"] > 0
+        # int8 + scales vs the f32 kernels they replace: ~4x smaller
+        assert rep["quantized_bytes"] < 0.3 * f32_kernel_bytes
+
+    def test_policy_mapping(self):
+        assert quant_policy(None) is None
+        assert quant_policy("") is None
+        assert quant_policy("none") is None
+        assert isinstance(quant_policy("int8"), QuantPolicy)
+        with pytest.raises(ValueError, match="int8"):
+            quant_policy("fp4")
+        assert quantization_block(None) is None
+        blk = quantization_block(QuantPolicy())
+        assert blk == {"weight_dtype": "int8",
+                       "granularity": "per_channel", "symmetric": True}
+
+    def test_config_knob_round_trips(self, tmp_path):
+        import dataclasses
+
+        from distributedpytorch_tpu.train import config as config_lib
+
+        assert config_lib.Config().model.quantization == ""
+        cfg = dataclasses.replace(
+            config_lib.Config(),
+            model=dataclasses.replace(config_lib.Config().model,
+                                      quantization="int8"))
+        path = tmp_path / "config.json"
+        path.write_text(config_lib.to_json(cfg))
+        assert config_lib.from_json(str(path)).model.quantization \
+            == "int8"
+
+
+class TestParity:
+    """int8 vs f32 across every ladder bucket — the banded acceptance."""
+
+    def test_parity_band_and_iou_across_ladder(self,
+                                               serve_stem_predictor):
+        from distributedpytorch_tpu.serve import bucket_sizes
+
+        qpred = quantize_predictor(serve_stem_predictor)
+        img, worst = _image(), 0.0
+        for b in bucket_sizes(8):
+            x = np.stack([serve_stem_predictor.prepare(img, _points(i))[0]
+                          for i in range(b)])
+            p_f32 = serve_stem_predictor.forward_prepared(x)
+            p_int8 = qpred.forward_prepared(x)
+            diff = np.abs(p_f32 - p_int8)
+            assert diff.max() <= PARITY_MAX_ABS, \
+                f"bucket {b}: max {diff.max():.4f}"
+            assert diff.mean() <= PARITY_MEAN_ABS, \
+                f"bucket {b}: mean {diff.mean():.5f}"
+            m_f32, m_int8 = p_f32 > 0.5, p_int8 > 0.5
+            union = (m_f32 | m_int8).sum()
+            iou = (m_f32 & m_int8).sum() / max(union, 1)
+            assert iou >= PARITY_MIN_IOU, f"bucket {b}: IoU {iou:.4f}"
+            worst = max(worst, float(diff.max()))
+        assert worst > 0  # int8 really differs — the band is not vacuous
+
+    def test_full_predict_masks_agree_on_fixture(self,
+                                                 serve_stem_predictor):
+        qpred = quantize_predictor(serve_stem_predictor)
+        img, pts = _image(), _points()
+        prob_f32 = serve_stem_predictor.predict(img, pts)
+        prob_int8 = qpred.predict(img, pts)
+        m0, m1 = prob_f32 > 0.5, prob_int8 > 0.5
+        iou = (m0 & m1).sum() / max((m0 | m1).sum(), 1)
+        assert iou >= PARITY_MIN_IOU
+
+    def test_quantized_forward_is_deterministic(self,
+                                                serve_stem_predictor):
+        qpred = quantize_predictor(serve_stem_predictor)
+        x = serve_stem_predictor.prepare(_image(), _points())[0][None]
+        np.testing.assert_array_equal(qpred.forward_prepared(x),
+                                      qpred.forward_prepared(x))
+
+
+class TestSessionsCompose:
+    def test_warm_cold_stateless_bitwise(self, serve_split_predictor):
+        """The split predictor's staged-composition property survives
+        quantization: the full forward IS encode∘decode, so a cached-
+        features warm click is bitwise the stateless answer."""
+        qpred = quantize_predictor(serve_split_predictor)
+        assert qpred.supports_sessions
+        img = _image()
+        concat, _ = qpred.prepare(img, _points())
+        full = qpred.forward_prepared(concat[None])
+        feats = qpred.encode_jitted(concat[None][..., :-1])
+        warm = np.asarray(qpred.decode_jitted(
+            feats, concat[None][..., -1:]))[..., 0]
+        np.testing.assert_array_equal(full, warm)
+
+    def test_quantized_service_serves_sessions(self,
+                                               serve_split_predictor):
+        from distributedpytorch_tpu.serve import InferenceService
+
+        qpred = quantize_predictor(serve_split_predictor)
+        with InferenceService(qpred, max_batch=2,
+                              max_wait_s=0.0) as svc:
+            img = _image()
+            cold = svc.predict(img, _points(), timeout=120,
+                               session_id="q1")
+            warm = svc.predict(img, _points(1), timeout=120,
+                               session_id="q1")
+        assert np.isfinite(cold).all() and np.isfinite(warm).all()
+        assert svc.health()["sessions"]["hits"] >= 1
+
+
+class TestSwapComposes:
+    def test_quantized_canary_rolls_back(self, serve_stem_predictor):
+        """Hot-swap composition: an int8 generation canaries into an
+        f32 service and rolls back like any other generation."""
+        from distributedpytorch_tpu.serve import InferenceService
+
+        qpred = quantize_predictor(serve_stem_predictor)
+        with InferenceService(serve_stem_predictor, max_batch=2,
+                              max_wait_s=0.0) as svc:
+            gen = svc.swap(qpred, label="int8", canary_fraction=1.0,
+                           warmup=False)
+            assert svc.health()["swap"]["canary"] == gen
+            img = _image()
+            mask = svc.predict(img, _points(), timeout=120)
+            assert np.isfinite(mask).all()
+            svc.rollback()
+            assert svc.health()["swap"]["canary"] is None
+            # the service still serves on the active f32 generation
+            np.testing.assert_array_equal(
+                svc.predict(img, _points(), timeout=120),
+                serve_stem_predictor.predict(img, _points()))
+
+
+class TestAudit:
+    """The JA002 contract: the declaration is load-bearing."""
+
+    @pytest.fixture(scope="class")
+    def qpred(self, serve_stem_predictor):
+        return quantize_predictor(serve_stem_predictor)
+
+    def test_policy_audit_clean_strict_audit_dirty(self, qpred):
+        from distributedpytorch_tpu.analysis import ir
+
+        args = (jax.ShapeDtypeStruct((1, 64, 64, 4), np.float32),)
+        policy = qpred.quant_policy
+        clean = ir.audit(qpred.forward_jitted, args, name="int8_policy",
+                         compile=False, f32_allow=policy.ja002_allow())
+        assert clean["finding_counts"]["dtype_upcast"] == 0
+        strict = ir.audit(qpred.forward_jitted, args, name="int8_strict",
+                          compile=False)
+        assert strict["finding_counts"]["dtype_upcast"] > 0
+        assert any("dequantized" in f["message"]
+                   for f in strict["findings"])
+
+    def test_int8_consts_are_4x_smaller(self, qpred,
+                                        serve_stem_predictor):
+        from distributedpytorch_tpu.analysis import ir
+
+        args = (jax.ShapeDtypeStruct((1, 64, 64, 4), np.float32),)
+        c_int8 = ir.audit(qpred.forward_jitted, args, name="c8",
+                          compile=False)["constants"]["total_bytes"]
+        c_f32 = ir.audit(serve_stem_predictor.forward_jitted, args,
+                         name="c32",
+                         compile=False)["constants"]["total_bytes"]
+        assert c_int8 < 0.3 * c_f32
+
+    def test_bf16_policy_does_not_mask_int8(self):
+        """The precision policy's allowlist and the quant policy's are
+        DIFFERENT declarations: mul is in both, but the finding text
+        (and the flow table) keep int8 dequants distinct — an int8
+        upcast consumed by, say, `tanh` fails under either."""
+        from distributedpytorch_tpu.analysis.ir import (
+            dtype_upcast_findings,
+        )
+
+        q = np.arange(8, dtype=np.int8).reshape(2, 4)
+
+        def leaky(x):
+            import jax.numpy as jnp
+
+            w = jnp.asarray(q).astype(jnp.float32)
+            return x @ jnp.tanh(w)  # undeclared f32 math on the upcast
+
+        closed = jax.jit(leaky).trace(
+            jax.ShapeDtypeStruct((1, 2), np.float32)).jaxpr
+        found = dtype_upcast_findings(
+            closed, allow=QuantPolicy().ja002_allow())
+        assert len(found) == 1 and "tanh" in found[0].message
+
+    def test_canonical_contracts_check_clean(self):
+        """The checked-in serve_forward_int8_b1 + decode_int8 cpu8
+        contracts are the acceptance gate: the registry builds the
+        quantized programs with the policy allowlist riding each entry
+        (3-tuple form), and `jaxaudit check` passes."""
+        from distributedpytorch_tpu.analysis import contracts
+
+        programs = contracts.build_default_programs(
+            ("serve_forward_int8_b1", "decode_int8"))
+        assert set(programs) == {"serve_forward_int8_b1", "decode_int8"}
+        for entry in programs.values():
+            assert len(entry) == 3 and "f32_allow" in entry[2]
+        rc = contracts.run_cli(["check", "--programs",
+                                "serve_forward_int8_b1,decode_int8"],
+                               programs=programs)
+        assert rc == 0
