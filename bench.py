@@ -129,10 +129,12 @@ from distributedpytorch_tpu.train.sentinel import (  # noqa: E402
 
 def ir_audit_fields(fn, args, program: str, **audit_kw) -> dict:
     """The record's IR-audit fields (jaxaudit, analysis/ir.py): the
-    compiled program's collective inventory and its compile-contract
-    status ('pass' | 'drift' | 'no_contract' | 'skipped' | 'error').
-    Both keys are ALWAYS present so record consumers can rely on the
-    schema; DPTPU_BENCH_AUDIT=0 skips the audit, and any audit failure
+    compiled program's collective inventory, its compile-contract
+    status ('pass' | 'drift' | 'no_contract' | 'skipped' | 'error'),
+    and the audit's own wall-clock attribution (audit_ms:
+    lower/compile/walk millis, null when skipped).  All three keys are
+    ALWAYS present so record consumers can rely on the schema;
+    DPTPU_BENCH_AUDIT=0 skips the audit, and any audit failure
     degrades to 'error' rather than killing the record run.  The trace
     is cache-shared with the MFU estimator's lowering (telemetry
     .lowering), so the inventory costs no extra lower on the hot path.
@@ -150,7 +152,8 @@ def ir_audit_fields(fn, args, program: str, **audit_kw) -> dict:
     audits against the precision policy's declared accumulation points
     (f32_allow), and the bucketed step stamps overlap_expected so a
     TPU-pinned bench contract requires async -start collectives."""
-    fields = {"collectives": None, "ir_contract": "skipped"}
+    fields = {"collectives": None, "ir_contract": "skipped",
+              "audit_ms": None}
     if os.environ.get("DPTPU_BENCH_AUDIT", "1") == "0":
         return fields
     try:
@@ -160,6 +163,7 @@ def ir_audit_fields(fn, args, program: str, **audit_kw) -> dict:
         rep = _ir.audit(fn, _ir.struct_of(tuple(args)), name=program,
                         **audit_kw)
         fields["collectives"] = rep["collectives"]
+        fields["audit_ms"] = rep.get("timing_ms")
         if os.environ.get("DPTPU_BENCH_AUDIT_UPDATE") == "1":
             _contracts.save_contract(
                 _contracts.contract_from_report(rep),

@@ -31,6 +31,22 @@ waiver.  Runtime complement: :class:`utils.compile_watchdog.CompileWatchdog`
 counts actual XLA compilations and fails tests that recompile steady-state
 steps.
 
+Between the per-file syntax layer and the per-program IR layer sits
+**jaxguard** (:mod:`spmd` + :mod:`donation` + :mod:`guard`): dataflow
+across statements and comparison across programs — host-divergence
+taint into collective-issuing control flow (JG001), ordered per-axis
+collective schedules cross-checked pairwise over the plan ladder
+(JG002, the static multi-host deadlock detector), and donation aliasing
+across the trace boundary (JG003 use-after-donate, JG004 zero-copy
+donation — the PR 5/PR 6 bug class):
+
+    python -m distributedpytorch_tpu.analysis --guard check
+    jaxaudit --guard check                   # same entry point
+
+Its AST half is import-light like jaxlint (``--no-ir`` for pre-commit);
+suppressions use ``# jaxguard: disable=JG00x`` and are policed for
+staleness by ``jaxlint --stats`` alongside jaxlint's own.
+
 The hazards the AST structurally cannot see — they exist only in the
 traced jaxpr and the compiled HLO — are jaxaudit's job (:mod:`ir` +
 :mod:`contracts`, docs/DESIGN.md "IR auditing & compile contracts"):
@@ -53,7 +69,11 @@ from .core import (
     lint_paths,
     lint_source,
     main,
+    suppression_report,
 )
 from . import rules as _rules  # noqa: F401  populates RULES at import
+from .guard import GUARD_RULES, guard_paths, guard_source
 
-__all__ = ["Finding", "RULES", "lint_paths", "lint_source", "main"]
+__all__ = ["Finding", "RULES", "GUARD_RULES", "lint_paths",
+           "lint_source", "guard_paths", "guard_source",
+           "suppression_report", "main"]

@@ -2,9 +2,11 @@
 
 ``python -m distributedpytorch_tpu.analysis --ir <command> [...]`` routes
 to jaxaudit, the IR-level program auditor (``jaxaudit check`` /
-``update`` / ``audit`` / ``list`` — see :mod:`contracts`).  The split
-keeps the default linter path import-light (no jax): only ``--ir``
-touches a backend.
+``update`` / ``audit`` / ``list`` — see :mod:`contracts`), and
+``--guard <command> [...]`` to jaxguard, the cross-program
+SPMD-divergence + donation-safety layer (:mod:`guard`).  The split keeps
+the default linter path import-light (no jax): only ``--ir`` — and
+``--guard`` without ``--no-ir`` — touches a backend.
 """
 
 import sys
@@ -12,6 +14,11 @@ import sys
 
 def _main() -> int:
     argv = sys.argv[1:]
+    if "--guard" in argv:
+        argv = [a for a in argv if a != "--guard"]
+        from .guard import run_guard_cli
+
+        return run_guard_cli(argv)
     if "--ir" in argv:
         argv = [a for a in argv if a != "--ir"]
         from .contracts import main as ir_main

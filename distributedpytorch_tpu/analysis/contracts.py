@@ -168,10 +168,13 @@ def diff_contract(contract: dict, report: dict) -> list[str]:
 
     # "hlo_axes" is the per-mesh-axis inventory plan-built programs pin
     # (ir.mesh_axis_collective_counts): a 2-D-mesh step regressing to
-    # replicated zeroes its model-axis counts and fails here.  Contracts
-    # that predate it (every pre-plan program) simply don't pin the
-    # level and are skipped.
-    for level in ("jaxpr", "hlo", "hlo_axes"):
+    # replicated zeroes its model-axis counts and fails here.
+    # "hlo_schedule" is its ordered twin (jaxguard's JG002 substrate):
+    # same counts in a different issue order still deadlocks a pod.
+    # Contracts that predate a level simply don't pin it and are
+    # skipped — which is how new levels land additively without
+    # invalidating every checked-in contract.
+    for level in ("jaxpr", "hlo", "hlo_axes", "hlo_schedule"):
         want = (contract.get("collectives") or {}).get(level)
         have = (report.get("collectives") or {}).get(level)
         if want is None:
@@ -251,6 +254,184 @@ def save_contract(contract: dict, contracts_dir: str) -> str:
         json.dump(contract, f, indent=1, sort_keys=True)
         f.write("\n")
     return path
+
+
+# ------------------------------------------------------------- schema police
+
+#: the one declared schema every checked-in contract file must satisfy —
+#: a hand-edited contract should fail HERE (loudly, naming the key),
+#: not silently pass `check` because a typo'd key is never compared
+_PLATFORM_KEY_RE = r"^[a-z]+\d+$"
+_RLE_RE = r"^[a-z-]+(\*\d+)?$"
+_PROGRAM_KEYS_REQUIRED = frozenset({
+    "program", "platform_key", "collectives", "outputs", "donation",
+    "constants", "flops", "finding_counts",
+})
+_PROGRAM_KEYS_OPTIONAL = frozenset({"require_async_starts"})
+_COLLECTIVES_LEVELS = frozenset({"jaxpr", "hlo", "hlo_axes",
+                                 "hlo_schedule"})
+_SCHEDULE_SET_KEYS = frozenset({
+    "kind", "program", "platform_key", "schedules", "divergent_pairs",
+})
+
+
+def _is_count_map(v, depth: int) -> bool:
+    """``{str: int}`` (depth 1) or ``{str: {str: int}}`` (depth 2),
+    counts non-negative."""
+    if not isinstance(v, dict):
+        return False
+    for k, x in v.items():
+        if not isinstance(k, str):
+            return False
+        if depth > 1:
+            if not _is_count_map(x, depth - 1):
+                return False
+        elif not (isinstance(x, int) and not isinstance(x, bool)
+                  and x >= 0):
+            return False
+    return True
+
+
+def _is_schedule_map(v) -> bool:
+    """``{axis: ["op" | "op*N", ...]}`` with the rle grammar."""
+    import re as _re
+
+    if not isinstance(v, dict):
+        return False
+    return all(
+        isinstance(ax, str) and isinstance(seq, list)
+        and all(isinstance(s, str) and _re.match(_RLE_RE, s)
+                for s in seq)
+        for ax, seq in v.items())
+
+
+def validate_contract_file(path: str, doc: dict) -> list[str]:
+    """Schema violations of one checked-in contract JSON — empty when
+    the file is well-formed.  Dispatches on ``kind``: absent means a
+    program contract (the :func:`contract_from_report` shape), and
+    ``"schedule_set"`` the jaxguard cross-program schedule pin."""
+    import re as _re
+
+    errs: list[str] = []
+    base = os.path.basename(path)
+    if not isinstance(doc, dict):
+        return [f"{base}: top level must be a JSON object"]
+    kind = doc.get("kind")
+
+    prog = doc.get("program")
+    key = doc.get("platform_key")
+    if not isinstance(prog, str) or not prog:
+        errs.append(f"{base}: 'program' must be a non-empty string")
+    if not (isinstance(key, str) and _re.match(_PLATFORM_KEY_RE, key)):
+        errs.append(f"{base}: 'platform_key' must match "
+                    f"{_PLATFORM_KEY_RE} (e.g. cpu8, tpu4), got {key!r}")
+    elif isinstance(prog, str) and base != f"{prog}.{key}.json":
+        errs.append(f"{base}: filename must be "
+                    f"'{prog}.{key}.json' (program + platform key)")
+
+    if kind == "schedule_set":
+        unknown = set(doc) - _SCHEDULE_SET_KEYS
+        if unknown:
+            errs.append(f"{base}: unknown key(s) {sorted(unknown)}")
+        scheds = doc.get("schedules")
+        if not isinstance(scheds, dict) or not all(
+                isinstance(nm, str) and _is_schedule_map(sc)
+                for nm, sc in scheds.items()):
+            errs.append(f"{base}: 'schedules' must be "
+                        "{program: {axis: [rle ops...]}}")
+        pairs = doc.get("divergent_pairs")
+        if not isinstance(pairs, list) or not all(
+                isinstance(p, list) and len(p) == 2
+                and all(isinstance(x, str) for x in p) and p[0] != p[1]
+                for p in pairs):
+            errs.append(f"{base}: 'divergent_pairs' must be a list of "
+                        "[program_a, program_b] pairs (distinct names)")
+        return errs
+    if kind is not None:
+        return errs + [f"{base}: unknown contract kind {kind!r}"]
+
+    missing = _PROGRAM_KEYS_REQUIRED - set(doc)
+    if missing:
+        errs.append(f"{base}: missing required key(s) {sorted(missing)}")
+    unknown = set(doc) - _PROGRAM_KEYS_REQUIRED - _PROGRAM_KEYS_OPTIONAL
+    if unknown:
+        errs.append(f"{base}: unknown key(s) {sorted(unknown)} — a "
+                    "typo'd key silently pins nothing")
+    if "require_async_starts" in doc \
+            and doc["require_async_starts"] is not True:
+        errs.append(f"{base}: 'require_async_starts' is pin-presence "
+                    "only: True or absent")
+
+    col = doc.get("collectives")
+    if isinstance(col, dict):
+        bad_levels = set(col) - _COLLECTIVES_LEVELS
+        if bad_levels:
+            errs.append(f"{base}: unknown collectives level(s) "
+                        f"{sorted(bad_levels)}")
+        if not _is_count_map(col.get("jaxpr", {}), 2):
+            errs.append(f"{base}: collectives.jaxpr must be "
+                        "{prim: {axis: count}}")
+        if col.get("hlo") is not None \
+                and not _is_count_map(col["hlo"], 1):
+            errs.append(f"{base}: collectives.hlo must be {{op: count}}")
+        if col.get("hlo_axes") is not None \
+                and not _is_count_map(col["hlo_axes"], 2):
+            errs.append(f"{base}: collectives.hlo_axes must be "
+                        "{op: {axis: count}}")
+        if col.get("hlo_schedule") is not None \
+                and not _is_schedule_map(col["hlo_schedule"]):
+            errs.append(f"{base}: collectives.hlo_schedule must be "
+                        "{axis: [rle ops...]}")
+    elif "collectives" in doc:
+        errs.append(f"{base}: 'collectives' must be an object")
+
+    if "outputs" in doc and not (
+            isinstance(doc["outputs"], list)
+            and all(isinstance(o, str) for o in doc["outputs"])):
+        errs.append(f"{base}: 'outputs' must be a list of aval strings")
+
+    don = doc.get("donation")
+    if isinstance(don, dict):
+        if not isinstance(don.get("declared_args"), int) \
+                or isinstance(don.get("declared_args"), bool) \
+                or don["declared_args"] < 0:
+            errs.append(f"{base}: donation.declared_args must be a "
+                        "non-negative int")
+        if not (don.get("effective") is None
+                or isinstance(don.get("effective"), bool)):
+            errs.append(f"{base}: donation.effective must be "
+                        "true/false/null")
+    elif "donation" in doc:
+        errs.append(f"{base}: 'donation' must be an object")
+
+    con = doc.get("constants")
+    if isinstance(con, dict):
+        for field in ("count", "total_bytes"):
+            v = con.get(field)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{base}: constants.{field} must be a "
+                            "non-negative int")
+    elif "constants" in doc:
+        errs.append(f"{base}: 'constants' must be an object")
+
+    if "flops" in doc and not (
+            doc["flops"] is None
+            or isinstance(doc["flops"], (int, float))):
+        errs.append(f"{base}: 'flops' must be a number or null")
+
+    fc = doc.get("finding_counts")
+    if isinstance(fc, dict):
+        from .ir import FINDING_CLASSES
+
+        if set(fc) != set(FINDING_CLASSES):
+            errs.append(f"{base}: finding_counts keys must be exactly "
+                        f"{sorted(FINDING_CLASSES)}, got {sorted(fc)}")
+        if not _is_count_map(fc, 1):
+            errs.append(f"{base}: finding_counts values must be "
+                        "non-negative ints")
+    elif "finding_counts" in doc:
+        errs.append(f"{base}: 'finding_counts' must be an object")
+    return errs
 
 
 def load_contract(contracts_dir: str, program: str,
@@ -582,13 +763,19 @@ def run_cli(argv: list[str] | None = None, programs: dict | None = None
     failed = 0
     for name, report in reports.items():
         drift = check_report(report, contracts_dir)
+        tm = report.get("timing_ms") or {}
+        fmt = lambda v: "-" if v is None else f"{v:.0f}ms"  # noqa: E731
+        timing = (f" [lower {fmt(tm.get('lower'))} compile "
+                  f"{fmt(tm.get('compile'))} walk {fmt(tm.get('walk'))}]"
+                  if tm else "")
         if drift:
             failed += 1
             for line in drift:
                 print(f"{name}: {line}")
         else:
             print(f"{name}: ok "
-                  f"({platform_key(report['platform'], report['n_devices'])})")
+                  f"({platform_key(report['platform'], report['n_devices'])})"
+                  f"{timing}")
     if failed:
         print(f"jaxaudit: {failed}/{len(reports)} program(s) drifted "
               "from their compile contracts", file=sys.stderr)
@@ -597,8 +784,17 @@ def run_cli(argv: list[str] | None = None, programs: dict | None = None
 
 
 def main(argv: list[str] | None = None) -> int:
-    """Console entry point (``jaxaudit`` in pyproject)."""
-    return run_cli(sys.argv[1:] if argv is None else argv)
+    """Console entry point (``jaxaudit`` in pyproject).  ``--guard``
+    routes to the jaxguard CLI (:mod:`guard`) so one installed entry
+    point fronts both gates: ``jaxaudit check`` for per-program IR
+    contracts, ``jaxaudit --guard check`` for the cross-program
+    SPMD/donation layer."""
+    argv = sys.argv[1:] if argv is None else argv
+    if "--guard" in argv:
+        from .guard import run_guard_cli
+
+        return run_guard_cli([a for a in argv if a != "--guard"])
+    return run_cli(argv)
 
 
 if __name__ == "__main__":

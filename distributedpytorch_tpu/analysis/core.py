@@ -70,6 +70,28 @@ def dotted_name(node: ast.AST) -> str | None:
     return None
 
 
+def target_names(node: ast.AST) -> list[str]:
+    """Every name an assignment target binds — flattening tuple/list
+    unpacking and starred targets; attribute targets yield their dotted
+    form (``self.train_step``); subscript targets yield the base name
+    (mutating ``d[k]`` keeps ``d`` alive for dataflow purposes)."""
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for el in node.elts:
+            out.extend(target_names(el))
+        return out
+    if isinstance(node, ast.Starred):
+        return target_names(node.value)
+    if isinstance(node, ast.Attribute):
+        d = dotted_name(node)
+        return [d] if d is not None else []
+    if isinstance(node, ast.Subscript):
+        return target_names(node.value)
+    return []
+
+
 def walk_with_parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
     parents: dict[ast.AST, ast.AST] = {}
     for node in ast.walk(tree):
@@ -217,49 +239,73 @@ class FileContext:
 
 # --------------------------------------------------------------- suppressions
 
-_DISABLE_RE = re.compile(
-    r"#\s*jaxlint:\s*(disable(?:-file)?)\s*=\s*"
-    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+_DISABLE_RES: dict[str, re.Pattern] = {}
 
 
-def parse_suppressions(src: str, path: str, known_codes: set[str]
+def _disable_re(tool: str) -> re.Pattern:
+    pat = _DISABLE_RES.get(tool)
+    if pat is None:
+        pat = re.compile(
+            rf"#\s*{tool}:\s*(disable(?:-file)?)\s*=\s*"
+            r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+        _DISABLE_RES[tool] = pat
+    return pat
+
+
+def _iter_directives(src: str, tool: str):
+    """Yield ``(lineno, col, kind, codes, text)`` for every well-formed
+    ``# <tool>: disable[-file]=...`` comment, and ``(lineno, col, None,
+    None, text)`` for comments that attempt the grammar but fail it.
+    Both jaxlint and jaxguard share this grammar — only the tool prefix
+    differs."""
+    pat = _disable_re(tool)
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        comments = [(t.start[0], t.start[1], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return
+    for lineno, col, text in comments:
+        m = pat.search(text)
+        if m is None:
+            # only a comment that attempts the directive grammar — the
+            # tool name, a colon, and a waiver keyword — is malformed;
+            # prose merely mentioning the words is not
+            if re.search(rf"{tool}\s*:", text) and "disable" in text:
+                yield lineno, col, None, None, text
+            continue
+        kind = m.group(1)
+        codes = [c.strip() for c in m.group(2).split(",") if c.strip()]
+        yield lineno, col, kind, codes, text
+
+
+def parse_suppressions(src: str, path: str, known_codes: set[str],
+                       tool: str = "jaxlint",
+                       meta_code: str = META_CODE,
                        ) -> tuple[dict[int, set[str]], set[str],
                                   list[Finding]]:
     """Scan comments for the suppression grammar.
 
     Returns ``(line_disables, file_disables, meta_findings)`` where
     ``line_disables[lineno]`` is the set of codes waived on that line,
-    ``file_disables`` the file-wide set, and ``meta_findings`` the JL000
-    reports for unknown codes named in a disable comment (a typo'd code
-    silently suppressing nothing is itself a hazard).
+    ``file_disables`` the file-wide set, and ``meta_findings`` the
+    ``meta_code`` reports for unknown codes named in a disable comment
+    (a typo'd code silently suppressing nothing is itself a hazard).
+    jaxguard reuses this with ``tool="jaxguard", meta_code="JG000"``.
     """
     line_disables: dict[int, set[str]] = {}
     file_disables: set[str] = set()
     meta: list[Finding] = []
-    try:
-        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
-        comments = [(t.start[0], t.start[1], t.string) for t in tokens
-                    if t.type == tokenize.COMMENT]
-    except (tokenize.TokenError, SyntaxError, IndentationError):
-        return line_disables, file_disables, meta
-    for lineno, col, text in comments:
-        m = _DISABLE_RE.search(text)
-        if m is None:
-            # only a comment that attempts the directive grammar — the
-            # tool name, a colon, and a waiver keyword — is malformed;
-            # prose merely mentioning the words is not
-            if re.search(r"jaxlint\s*:", text) and "disable" in text:
-                meta.append(Finding(
-                    META_CODE, f"unparseable jaxlint comment: {text!r}",
-                    path, lineno, col))
+    for lineno, col, kind, codes, text in _iter_directives(src, tool):
+        if kind is None:
+            meta.append(Finding(
+                meta_code, f"unparseable {tool} comment: {text!r}",
+                path, lineno, col))
             continue
-        kind, codes = m.group(1), m.group(2)
-        for code in (c.strip() for c in codes.split(",")):
-            if not code:
-                continue
+        for code in codes:
             if code not in known_codes:
                 meta.append(Finding(
-                    META_CODE,
+                    meta_code,
                     f"unknown rule code {code!r} in {kind}= comment "
                     f"(known: {', '.join(sorted(known_codes))})",
                     path, lineno, col))
@@ -315,13 +361,17 @@ def lint_source(src: str, path: str = "<string>",
                 select: Iterable[str] | None = None,
                 ignore: Iterable[str] | None = None,
                 allowed_axes: Iterable[str] | None = None,
-                tree: ast.AST | None = None) -> list[Finding]:
+                tree: ast.AST | None = None,
+                suppress: bool = True) -> list[Finding]:
     """Lint one source string; returns findings sorted by position.
 
     ``allowed_axes``: the sharding axis names JL005 accepts; defaults to
     the canonical ``{"data", "model"}`` plus any ``*_AXIS`` constants
     defined in ``src`` itself.
     ``tree``: pre-parsed AST of ``src``, to spare a reparse.
+    ``suppress=False`` returns the raw findings with disable comments
+    ignored — :func:`suppression_report` uses it to decide which
+    directives still earn their keep.
     """
     chosen = _select_rules(select, ignore)
     meta_on = _meta_enabled(select, ignore)
@@ -345,6 +395,8 @@ def lint_source(src: str, path: str = "<string>",
         findings.extend(fn(ctx))
     line_dis, file_dis, meta = parse_suppressions(
         src, path, set(RULES) | {META_CODE})
+    if not suppress:
+        line_dis, file_dis = {}, set()
     findings = [
         f for f in findings
         if f.code not in file_dis
@@ -398,6 +450,59 @@ def lint_paths(paths: Iterable[str],
     return sorted(findings, key=lambda x: (x.path, x.line, x.col, x.code))
 
 
+def suppression_report(paths: Iterable[str]) -> list[dict]:
+    """Every ``# jaxlint:``/``# jaxguard:`` disable directive under
+    ``paths``, with whether it still earns its keep.
+
+    A directive is **live** when the raw run (suppressions ignored) of
+    its tool still produces at least one finding it waives — same line
+    for ``disable=``, anywhere in the file for ``disable-file=``.  A
+    dead directive is worse than noise: it documents a hazard that no
+    longer exists and will silently swallow the *next* genuine finding
+    that lands on that line.  ``jaxlint --stats`` fails the gate on
+    them, printing the exact file:line to delete.
+    """
+    from .guard import guard_source  # lazy: guard imports this module
+
+    files = list(iter_python_files(paths))
+    sources: dict[str, str] = {}
+    trees: dict[str, ast.AST] = {}
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            sources[f] = fh.read()
+        try:
+            trees[f] = ast.parse(sources[f])
+        except SyntaxError:
+            pass
+    axes = collect_axis_names(trees.values()) | DEFAULT_AXES
+    entries: list[dict] = []
+    for f in files:
+        src = sources[f]
+        raw_by_tool = {
+            "jaxlint": lint_source(src, path=f, allowed_axes=axes,
+                                   tree=trees.get(f), suppress=False),
+            "jaxguard": guard_source(src, path=f, tree=trees.get(f),
+                                     suppress=False),
+        }
+        for tool, raws in raw_by_tool.items():
+            for lineno, _col, kind, codes, _text in \
+                    _iter_directives(src, tool):
+                if kind is None:
+                    continue  # malformed — the meta rule already fires
+                for code in codes:
+                    if kind == "disable-file":
+                        hits = sum(1 for r in raws if r.code == code)
+                    else:
+                        hits = sum(1 for r in raws
+                                   if r.code == code and r.line == lineno)
+                    entries.append({
+                        "path": f, "line": lineno, "tool": tool,
+                        "code": code, "kind": kind, "hits": hits,
+                        "live": hits > 0,
+                    })
+    return entries
+
+
 def main(argv: list[str] | None = None) -> int:
     """CLI: ``jaxlint [paths...]`` — exit 0 when clean, 1 with findings."""
     import argparse
@@ -414,6 +519,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--ignore", help="comma-separated codes to skip")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
+    parser.add_argument("--stats", action="store_true",
+                        help="list every suppression directive and "
+                             "contract-level allowlist entry; dead "
+                             "directives (rule no longer fires) exit 1")
     args = parser.parse_args(argv)
 
     from . import rules as _rules  # noqa: F401  (registers on import)
@@ -421,6 +530,31 @@ def main(argv: list[str] | None = None) -> int:
         for code in sorted(RULES):
             fn = RULES[code]
             print(f"{code}  {fn.name}: {fn.summary}")
+        return 0
+    if args.stats:
+        import glob as _glob
+        import json as _json
+        entries = suppression_report(args.paths)
+        for e in entries:
+            status = "live" if e["live"] else "DEAD"
+            print(f"{e['path']}:{e['line']}: {e['tool']} "
+                  f"{e['kind']}={e['code']} [{status}, "
+                  f"{e['hits']} hit(s)]")
+        from .contracts import default_contracts_dir
+        for p in sorted(_glob.glob(os.path.join(default_contracts_dir(),
+                                                "*.json"))):
+            with open(p, encoding="utf-8") as fh:
+                doc = _json.load(fh)
+            for pair in doc.get("divergent_pairs") or ():
+                print(f"{p}: allowlist divergent_pair "
+                      f"{pair[0]}|{pair[1]} "
+                      "[staleness policed by --guard check]")
+        dead = [e for e in entries if not e["live"]]
+        if dead:
+            print(f"jaxlint --stats: {len(dead)} dead suppression(s) — "
+                  "delete the directive(s) above marked DEAD",
+                  file=sys.stderr)
+            return 1
         return 0
     split = lambda s: [c.strip() for c in s.split(",") if c.strip()]  # noqa: E731
     try:

@@ -1,0 +1,342 @@
+"""jaxguard donation-aliasing passes: JG003 use-after-donate + JG004
+zero-copy donation hazard.
+
+The two nastiest bugs this codebase ever shipped were the same class:
+
+* PR 5's Orbax-restore **segfault** — restored arrays were donated into
+  the first train step while Orbax still held views of their host
+  buffers; XLA reused the memory and the next host read walked freed
+  pages.  Fixed by re-buffering with ``jnp.copy`` in
+  ``CheckpointManager.restore``.
+* PR 6's warm-start **NaN** — ``jax.device_put(np.asarray(leaf),
+  sharding)`` produced zero-copy host-aliased device buffers on CPU;
+  donating them into the step let XLA scribble over the numpy arrays a
+  later consumer still read.  Same fix, one ``jnp.copy`` earlier.
+
+Both were runtime symptoms (a segfault, a silent NaN) of a statically
+visible pattern: a buffer whose host side is still reachable crosses
+into a ``donate_argnums`` position, or a donated binding is read after
+the dispatch that consumed it.  This module pins the pattern at the
+AST level:
+
+* **JG003** — a binding passed in a donated position and then *read* in
+  the same scope without being rebound.  The sanctioned idiom rebinds
+  through the call (``state, loss = step(state, batch)``), which this
+  pass recognizes and clears.
+* **JG004** — a host-numpy-derived value (``np.*`` constructors,
+  optionally **through** ``jax.device_put`` — device_put is exactly the
+  zero-copy trap, it does NOT launder) flowing into a donated position
+  without an interposed ``jnp.copy``/``jnp.array`` (which allocate a
+  fresh device buffer and do launder; ``jnp.asarray`` does not — it is
+  allowed to alias).
+
+The jaxpr half lives in :func:`declared_donations`: the traced
+program's ``args_info`` is the ground truth for *which* arguments are
+donated — ``--guard audit`` cross-checks the AST-declared donating
+callables against it and the contracts pin the count.
+
+Import-light (stdlib only) at module level, like the rest of the AST
+layer; :func:`declared_donations` lazily imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, dotted_name, target_names
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+#: factory calls whose RESULT donates by convention (position 0 — the
+#: previous state's buffers; parallel/step.py, parallel/plan.py's
+#: ``Plan.make_train_step``, parallel/pipeline.py)
+DONATING_FACTORIES = {
+    "make_train_step": (0,),
+    "make_pipeline_step": (0,),
+}
+
+#: calls that launder host-alias taint: a fresh device allocation
+_COPY_LAUNDER = frozenset({
+    "jnp.copy", "jax.numpy.copy", "jnp.array", "jax.numpy.array",
+})
+
+
+def _donate_positions(keywords: list[ast.keyword]) -> tuple[int, ...]:
+    """The literal ``donate_argnums`` positions of a jit call, if
+    statically readable.  ``(0,) if donate else ()`` (this repo's
+    factory idiom) reads its then-branch — the donating configuration
+    is the one worth policing."""
+    for kw in keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        node = kw.value
+        if isinstance(node, ast.IfExp):
+            node = node.body
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return (node.value,)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for el in node.elts:
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, int):
+                    out.append(el.value)
+            return tuple(out)
+    return ()
+
+
+def _jit_donations(call: ast.Call) -> tuple[int, ...]:
+    f = dotted_name(call.func)
+    if f in _JIT_NAMES:
+        return _donate_positions(call.keywords)
+    return ()
+
+
+def donating_callables(tree: ast.AST) -> dict[str, tuple[int, ...]]:
+    """``{callable name: donated positions}`` for one module — names
+    (incl. dotted ``self.train_step`` attributes) bound to
+    ``jax.jit(..., donate_argnums=...)`` results or to the known
+    donating factories, plus ``@partial(jax.jit, donate_argnums=...)``
+    decorated defs."""
+    out: dict[str, tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            pos = _jit_donations(node.value)
+            if not pos:
+                f = dotted_name(node.value.func)
+                last = f.rsplit(".", 1)[-1] if f else None
+                pos = DONATING_FACTORIES.get(last, ())
+            if pos:
+                for t in node.targets:
+                    for name in target_names(t):
+                        out[name] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if isinstance(deco, ast.Call) \
+                        and dotted_name(deco.func) in _PARTIAL_NAMES \
+                        and deco.args \
+                        and dotted_name(deco.args[0]) in _JIT_NAMES:
+                    pos = _donate_positions(deco.keywords)
+                    if pos:
+                        out[node.name] = pos
+    return out
+
+
+def _host_taint(node: ast.AST, host: dict[str, str]) -> str | None:
+    """The host-memory source aliased by this expression, or None.
+    numpy results live in host memory; ``device_put`` *carries* the
+    alias (zero-copy placement is the bug class); only a fresh device
+    allocation (``jnp.copy``/``jnp.array``) clears it."""
+    if isinstance(node, ast.Call):
+        f = dotted_name(node.func)
+        last = f.rsplit(".", 1)[-1] if f else (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else None)
+        if f in _COPY_LAUNDER:
+            return None
+        if f and (f.startswith("np.") or f.startswith("numpy.")):
+            return f
+        if last == "device_put":
+            return _host_taint(node.args[0], host) if node.args else None
+        if last == "asarray" and node.args:
+            # np.asarray covered above; jnp.asarray may alias — carry
+            return _host_taint(node.args[0], host)
+        if f and (f.startswith("jnp.") or f.startswith("jax.numpy.")):
+            return None  # fresh device result
+        return None  # other calls: unknown provenance, stay quiet
+    if isinstance(node, ast.Name):
+        return host.get(node.id)
+    if isinstance(node, ast.Attribute):
+        d = dotted_name(node)
+        if d is not None and d in host:
+            return host[d]
+        return None
+    if isinstance(node, (ast.BinOp, ast.UnaryOp, ast.Subscript,
+                         ast.Tuple, ast.List, ast.Starred, ast.IfExp)):
+        for child in ast.iter_child_nodes(node):
+            src = _host_taint(child, host)
+            if src is not None:
+                return src
+    return None
+
+
+class _DonationScanner:
+    """Linear walk of one scope: donating calls kill their donated
+    argument names (unless the same statement rebinds them), later
+    loads are JG003; host-aliased values reaching a donated position
+    are JG004."""
+
+    def __init__(self, path: str, don_map: dict[str, tuple[int, ...]]):
+        self.path = path
+        self.don_map = don_map
+        self.findings: list[Finding] = []
+
+    def run_block(self, stmts: list[ast.stmt],
+                  donated: dict[str, tuple], host: dict[str, str]
+                  ) -> None:
+        for s in stmts:
+            self._stmt(s, donated, host)
+
+    # ------------------------------------------------------------------
+    def _loads(self, node: ast.AST):
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(n, ast.Name) \
+                    and isinstance(n.ctx, ast.Load):
+                yield n.id, n
+            elif isinstance(n, ast.Attribute) \
+                    and isinstance(n.ctx, ast.Load):
+                d = dotted_name(n)
+                if d is not None:
+                    yield d, n
+
+    def _donating_calls(self, node: ast.AST):
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not isinstance(n, ast.Call):
+                continue
+            callee = dotted_name(n.func)
+            if callee is None or callee not in self.don_map:
+                continue
+            yield callee, n, self.don_map[callee]
+
+    def _leaf(self, s: ast.stmt, donated: dict, host: dict) -> None:
+        # (a) reads of already-donated bindings — JG003
+        reported: set[str] = set()
+        for name, node in self._loads(s):
+            if name in donated and name not in reported:
+                call_line, callee = donated[name]
+                self.findings.append(Finding(
+                    "JG003",
+                    f"`{name}` was donated to `{callee}` (line "
+                    f"{call_line}) and is read afterwards — its buffer "
+                    "may already be reused by the program "
+                    "(use-after-donate); rebind the result "
+                    f"(`{name} = {callee}(...)`) or pass "
+                    f"ir.struct_of/jnp.copy instead",
+                    self.path, node.lineno, node.col_offset))
+                reported.add(name)
+                donated.pop(name, None)  # one finding per donation
+        # (b) this statement's own donating calls
+        new_dead: dict[str, tuple] = {}
+        for callee, call, positions in self._donating_calls(s):
+            for i in positions:
+                if i >= len(call.args):
+                    continue
+                arg = call.args[i]
+                hsrc = _host_taint(arg, host)
+                if hsrc is not None:
+                    self.findings.append(Finding(
+                        "JG004",
+                        f"host-backed value ({hsrc}) flows into donated "
+                        f"argument {i} of `{callee}` without an "
+                        "interposed jnp.copy — donating a zero-copy "
+                        "host alias lets XLA scribble over memory the "
+                        "host still reads (the Orbax-restore segfault / "
+                        "warm-start NaN class); wrap it in jnp.copy()",
+                        self.path, call.lineno, call.col_offset))
+                name = dotted_name(arg)
+                if name is not None:
+                    new_dead[name] = (call.lineno, callee)
+        # (c) rebinds clear — including the rebind-through-the-call idiom
+        targets: list[str] = []
+        if isinstance(s, ast.Assign):
+            for t in s.targets:
+                targets.extend(target_names(t))
+        elif isinstance(s, (ast.AnnAssign, ast.AugAssign)):
+            targets.extend(target_names(s.target))
+        for name in targets:
+            new_dead.pop(name, None)
+            donated.pop(name, None)
+        donated.update(new_dead)
+        # (d) host-alias taint moves with assignments
+        if isinstance(s, ast.Assign):
+            src = _host_taint(s.value, host)
+            for t in s.targets:
+                for name in target_names(t):
+                    if src is None:
+                        host.pop(name, None)
+                    else:
+                        host[name] = src
+        elif isinstance(s, ast.AnnAssign) and s.value is not None:
+            src = _host_taint(s.value, host)
+            for name in target_names(s.target):
+                if src is None:
+                    host.pop(name, None)
+                else:
+                    host[name] = src
+
+    def _stmt(self, s: ast.stmt, donated: dict, host: dict) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.run_block(s.body, {}, {})  # fresh scope
+            return
+        if isinstance(s, ast.ClassDef):
+            self.run_block(s.body, {}, {})
+            return
+        if isinstance(s, (ast.If, ast.While)):
+            self._leaf_expr_only(s.test, donated, host)
+            self.run_block(s.body, donated, host)
+            self.run_block(s.orelse, donated, host)
+            return
+        if isinstance(s, ast.For):
+            self._leaf_expr_only(s.iter, donated, host)
+            for name in target_names(s.target):
+                donated.pop(name, None)
+                host.pop(name, None)
+            for _ in range(2):  # loop-carried donations surface pass 2
+                self.run_block(s.body, donated, host)
+            self.run_block(s.orelse, donated, host)
+            return
+        if isinstance(s, ast.Try):
+            self.run_block(s.body, donated, host)
+            for h in s.handlers:
+                self.run_block(h.body, donated, host)
+            self.run_block(s.orelse, donated, host)
+            self.run_block(s.finalbody, donated, host)
+            return
+        if isinstance(s, ast.With):
+            for item in s.items:
+                self._leaf_expr_only(item.context_expr, donated, host)
+                if item.optional_vars is not None:
+                    for name in target_names(item.optional_vars):
+                        donated.pop(name, None)
+                        host.pop(name, None)
+            self.run_block(s.body, donated, host)
+            return
+        self._leaf(s, donated, host)
+
+    def _leaf_expr_only(self, expr: ast.AST, donated: dict,
+                        host: dict) -> None:
+        """Header expressions (if/while tests, for iters): reads and
+        donating calls count, but there are no assignment targets."""
+        holder = ast.Expr(value=expr)
+        ast.copy_location(holder, expr)
+        self._leaf(holder, donated, host)
+
+
+def find_donation_hazards(tree: ast.AST, path: str) -> list[Finding]:
+    """JG003 + JG004 over one parsed module."""
+    don_map = donating_callables(tree)
+    scanner = _DonationScanner(path, don_map)
+    # module body, then every function scope (its own linear story)
+    scanner.run_block(tree.body, {}, {})
+    return scanner.findings
+
+
+def declared_donations(fn, args: tuple) -> int:
+    """The jaxpr-side ground truth: how many arguments the traced
+    program actually declares donated (``args_info``) — what the AST
+    passes *infer*, the trace *knows*.  Shares the process-wide lowering
+    cache; raises whatever trace raises."""
+    import jax
+
+    from ..telemetry.lowering import lower_cached
+
+    traced = lower_cached(fn, *args).traced
+    if traced is None:
+        return 0
+    return sum(1 for leaf in jax.tree.leaves(traced.args_info)
+               if getattr(leaf, "donated", False))

@@ -1,0 +1,376 @@
+"""jaxguard driver: the middle static-analysis layer, between jaxlint
+(per-file AST) and jaxaudit (per-program IR).
+
+What each layer can and cannot see:
+
+* **jaxlint** reads one file's syntax — it catches a ``time.time()``
+  inside a jit body, but not a host-divergent *decision* three
+  statements away from the collective it gates;
+* **jaxguard** (this module) reads dataflow across statements and
+  *compares programs against each other* — host-divergence taint into
+  collective-issuing control flow (JG001, :mod:`spmd`), ordered
+  per-mesh-axis collective schedules cross-checked pairwise over the
+  plan ladder's programs (JG002, the static deadlock detector), and
+  donation aliasing across the trace boundary (JG003/JG004,
+  :mod:`donation`);
+* **jaxaudit** pins what one program compiled to.
+
+Rules:
+
+====== ========================== =========================================
+JG000  meta                       syntax error / malformed or typo'd
+                                  ``# jaxguard:`` suppression comment
+JG001  host-divergent collective  collective-issuing call under control
+                                  flow tainted by a host-divergent source
+JG002  schedule divergence        two programs sharing a mesh axis issue
+                                  different ordered collective sequences
+JG003  use-after-donate           a binding read after being passed in a
+                                  donated position
+JG004  zero-copy donation         host-numpy-backed value donated without
+                                  an interposed ``jnp.copy``
+====== ========================== =========================================
+
+Suppressions use the jaxlint grammar with the jaxguard prefix
+(``# jaxguard: disable=JG003``); ``jaxlint --stats`` polices both tools'
+directives for staleness.
+
+The AST half (``guard_paths``) is import-light — stdlib only, safe for
+pre-commit.  The IR half (``--guard check`` without ``--no-ir``)
+compiles the plan ladder's programs on the canonical pinned topology and
+cross-checks their schedules against the checked-in
+``tests/contracts/guard_schedules.<key>.json`` pin.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+import json
+import os
+import sys
+
+from .core import Finding, iter_python_files, parse_suppressions
+from .donation import find_donation_hazards
+from .spmd import (
+    _first_mismatch,
+    find_host_divergence,
+    schedule_divergence,
+    stale_divergence_declarations,
+)
+
+META_CODE = "JG000"
+
+#: code -> (name, summary); JG002 is IR-side (it needs compiled
+#: programs), the rest are AST-side
+GUARD_RULES = {
+    "JG001": ("host-divergent-collective",
+              "collective-issuing call gated by host-divergent control "
+              "flow (time/env/random/process_index/fs/HBM probes) — "
+              "silent multi-host deadlock; launder the decision through "
+              "parallel/consensus.replicated_decision"),
+    "JG002": ("schedule-divergence",
+              "programs sharing a mesh axis issue different ordered "
+              "collective sequences (IR-side: `--guard check`) — "
+              "alternates of one dispatch point must be lockstep or "
+              "declared divergent in the guard schedule contract"),
+    "JG003": ("use-after-donate",
+              "binding read after being passed in a donate_argnums "
+              "position — the buffer may already be reused; rebind "
+              "through the call or pass a copy"),
+    "JG004": ("zero-copy-donation",
+              "host-numpy-backed value (np.* / device_put of it) flows "
+              "into a donated argument without an interposed jnp.copy — "
+              "the PR 5 Orbax-restore segfault / PR 6 warm-start NaN "
+              "class"),
+}
+
+GUARD_CODES = frozenset(GUARD_RULES) | {META_CODE}
+
+#: the checked-in cross-program schedule pin (kind "schedule_set")
+SCHEDULE_SET_NAME = "guard_schedules"
+
+
+# ------------------------------------------------------------- the AST half
+
+def guard_source(src: str, path: str = "<string>",
+                 tree: ast.AST | None = None,
+                 suppress: bool = True) -> list[Finding]:
+    """Run the AST-side jaxguard passes (JG001, JG003, JG004) over one
+    source string.  ``suppress=False`` ignores ``# jaxguard:`` disable
+    comments (the raw view :func:`core.suppression_report` audits)."""
+    if tree is None:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            return [Finding(META_CODE, f"syntax error: {e.msg}", path,
+                            e.lineno or 1, e.offset or 0)]
+    findings = find_host_divergence(tree, path)
+    findings += find_donation_hazards(tree, path)
+    line_dis, file_dis, meta = parse_suppressions(
+        src, path, set(GUARD_CODES), tool="jaxguard",
+        meta_code=META_CODE)
+    if not suppress:
+        line_dis, file_dis = {}, set()
+    findings = [
+        f for f in findings
+        if f.code not in file_dis
+        and f.code not in line_dis.get(f.line, ())
+    ]
+    findings.extend(m for m in meta
+                    if m.code not in file_dis
+                    and m.code not in line_dis.get(m.line, ()))
+    return sorted(findings, key=lambda f: (f.line, f.col, f.code))
+
+
+def guard_paths(paths) -> list[Finding]:
+    """AST-side jaxguard over files/trees, sorted by position."""
+    findings: list[Finding] = []
+    for f in iter_python_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        findings.extend(guard_source(src, path=f))
+    return sorted(findings, key=lambda x: (x.path, x.line, x.col, x.code))
+
+
+# -------------------------------------------------------------- the IR half
+
+def extract_schedules(programs: dict) -> dict:
+    """``{name: {axis: [rle ops...]}}`` for every program whose audit
+    kwargs name a mesh (``mesh_axes``) — lowered and compiled through
+    the process-wide cache, but NOT fully audited: the schedule walk is
+    the only thing this gate needs."""
+    from ..telemetry.lowering import lower_cached
+    from .ir import mesh_axis_collective_schedule
+
+    schedules: dict = {}
+    for name, entry in programs.items():
+        fn, args, *rest = entry
+        kw = rest[0] if rest else {}
+        mesh_axes = kw.get("mesh_axes")
+        if not mesh_axes:
+            continue
+        prog = lower_cached(fn, *args)
+        sched = mesh_axis_collective_schedule(prog.compiled, mesh_axes)
+        if sched is not None:
+            schedules[name] = sched
+    return schedules
+
+
+def schedule_pin_path(contracts_dir: str, key: str) -> str:
+    return os.path.join(contracts_dir, f"{SCHEDULE_SET_NAME}.{key}.json")
+
+
+def load_schedule_set(contracts_dir: str, key: str) -> dict | None:
+    path = schedule_pin_path(contracts_dir, key)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def divergent_pairs_of(schedules: dict) -> list:
+    """The pairs that genuinely diverge today — what ``--guard update``
+    auto-declares, so ``check`` then polices that the set neither grows
+    (an undeclared divergence is JG002) nor shrinks (a stale
+    declaration)."""
+    out = []
+    for a, b in itertools.combinations(sorted(schedules), 2):
+        shared = set(schedules[a]) & set(schedules[b])
+        if any(schedules[a][ax] != schedules[b][ax] for ax in shared):
+            out.append([a, b])
+    return out
+
+
+def save_schedule_set(schedules: dict, contracts_dir: str,
+                      key: str) -> str:
+    os.makedirs(contracts_dir, exist_ok=True)
+    doc = {
+        "kind": "schedule_set",
+        "program": SCHEDULE_SET_NAME,
+        "platform_key": key,
+        "schedules": schedules,
+        "divergent_pairs": divergent_pairs_of(schedules),
+    }
+    path = schedule_pin_path(contracts_dir, key)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def diff_schedule_set(pinned: dict, schedules: dict) -> list[str]:
+    """Per-program drift of the live schedules against the pin — a
+    reordering that JG002 alone cannot see when every program moved in
+    lockstep (pairwise comparison stays equal; the pin does not)."""
+    drift: list[str] = []
+    want = pinned.get("schedules") or {}
+    for name in sorted(set(want) | set(schedules)):
+        if name not in schedules:
+            drift.append(f"{name}: pinned but no longer built — run "
+                         "`--guard update`")
+            continue
+        if name not in want:
+            drift.append(f"{name}: live program has no pinned schedule "
+                         "— run `--guard update` and review")
+            continue
+        w, h = want[name], schedules[name]
+        for ax in sorted(set(w) | set(h)):
+            if ax not in h:
+                drift.append(f"{name}: axis {ax!r} vanished from the "
+                             f"live schedule (pinned {w[ax]})")
+            elif ax not in w:
+                drift.append(f"{name}: live schedule gained axis "
+                             f"{ax!r} ({h[ax]}) — not pinned")
+            elif w[ax] != h[ax]:
+                drift.append(
+                    f"{name}: schedule[{ax}] reordered — "
+                    f"{_first_mismatch(w[ax], h[ax])} "
+                    "(pinned vs live)")
+    return drift
+
+
+def check_schedules(schedules: dict, contracts_dir: str,
+                    key: str) -> list[str]:
+    """The full IR-side gate: pin drift + undeclared pairwise
+    divergence (JG002) + stale divergence declarations.  Returns
+    human-readable failure lines; empty == green."""
+    pinned = load_schedule_set(contracts_dir, key)
+    if pinned is None:
+        return [f"no schedule pin "
+                f"{SCHEDULE_SET_NAME}.{key}.json in {contracts_dir} — "
+                "run `--guard update` and review the pins"]
+    declared = pinned.get("divergent_pairs") or []
+    failures = diff_schedule_set(pinned, schedules)
+    failures += [f.format() for f in
+                 schedule_divergence(schedules, declared)]
+    failures += stale_divergence_declarations(schedules, declared)
+    return failures
+
+
+# ------------------------------------------------------------------- the CLI
+
+def run_guard_cli(argv: list[str] | None = None,
+                  programs: dict | None = None) -> int:
+    """``jaxaudit --guard {audit|check|update|list} [paths...]``.
+
+    * ``audit``  — print AST findings and live schedules (informational,
+      exit 0);
+    * ``check``  — the gate: AST findings or schedule drift/divergence
+      exit 1.  ``--no-ir`` skips the compile half (fast pre-commit);
+    * ``update`` — regenerate the schedule pin after a REVIEWED change;
+    * ``list``   — the rule table.
+
+    ``programs`` injects a prebuilt ``{name: (fn, args, kwargs)}``
+    registry (same shape as :func:`contracts.build_default_programs`);
+    tests guard throwaway jits through the same code path the gate runs.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="jaxguard",
+        description="cross-program SPMD-divergence + donation-safety "
+                    "analyzer (see docs/DESIGN.md 'Static analysis').")
+    parser.add_argument("command",
+                        choices=("audit", "check", "update", "list"),
+                        help="audit: print findings+schedules; check: "
+                             "gate (exit 1 on findings/drift); update: "
+                             "regenerate schedule pins; list: rules")
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    parser.add_argument("paths", nargs="*", default=[pkg_dir],
+                        help="files or directories for the AST half "
+                             "(default: the package)")
+    parser.add_argument("--no-ir", action="store_true",
+                        help="skip the IR half (no jax import, no "
+                             "compiles) — pre-commit speed")
+    parser.add_argument("--programs",
+                        help="comma-separated program subset for the IR "
+                             "half (default: the plan ladder)")
+    parser.add_argument("--contracts-dir", default=None,
+                        help="contract directory (default: the repo's "
+                             "tests/contracts)")
+    # intermixed: `check --no-ir path1 path2` — plain parse_args can't
+    # resume a nargs="*" positional after an optional
+    args = parser.parse_intermixed_args(argv)
+
+    if args.command == "list":
+        print(f"{META_CODE}  meta: syntax error or malformed/unknown "
+              "# jaxguard: suppression")
+        for code in sorted(GUARD_RULES):
+            name, summary = GUARD_RULES[code]
+            print(f"{code}  {name}: {summary}")
+        return 0
+
+    findings = guard_paths(args.paths)
+    for f in findings:
+        print(f.format())
+
+    if args.command == "check" and args.no_ir:
+        if findings:
+            print(f"jaxguard: {len(findings)} finding(s)",
+                  file=sys.stderr)
+            return 1
+        return 0
+
+    if args.no_ir:
+        return 0
+
+    # ---- IR half ----
+    from .contracts import (
+        PLAN_PROGRAM_NAMES,
+        _pin_cpu_topology,
+        build_default_programs,
+        default_contracts_dir,
+        platform_key,
+    )
+
+    names = tuple(s.strip() for s in args.programs.split(",")
+                  if s.strip()) if args.programs else None
+    contracts_dir = args.contracts_dir or default_contracts_dir()
+    if programs is None:
+        _pin_cpu_topology()
+        try:
+            from ..backend_health import enable_compile_cache
+
+            enable_compile_cache()
+        except Exception:
+            pass
+        try:
+            programs = build_default_programs(names or PLAN_PROGRAM_NAMES)
+        except ValueError as e:
+            print(f"jaxguard: error: {e}", file=sys.stderr)
+            return 2
+    elif names:
+        unknown = set(names) - set(programs)
+        if unknown:
+            print(f"jaxguard: error: unknown program(s) "
+                  f"{sorted(unknown)}", file=sys.stderr)
+            return 2
+        programs = {n: programs[n] for n in names}
+
+    schedules = extract_schedules(programs)
+    key = platform_key()
+
+    if args.command == "audit":
+        print(json.dumps(schedules, indent=1, sort_keys=True))
+        if findings:
+            print(f"jaxguard: {len(findings)} finding(s)",
+                  file=sys.stderr)
+        return 0
+
+    if args.command == "update":
+        path = save_schedule_set(schedules, contracts_dir, key)
+        print(f"wrote {path}")
+        return 0
+
+    # check
+    failures = check_schedules(schedules, contracts_dir, key)
+    for line in failures:
+        print(line)
+    if not failures:
+        print(f"guard_schedules: ok ({key}, "
+              f"{len(schedules)} program(s))")
+    if findings or failures:
+        print(f"jaxguard: {len(findings)} finding(s), "
+              f"{len(failures)} schedule failure(s)", file=sys.stderr)
+        return 1
+    return 0

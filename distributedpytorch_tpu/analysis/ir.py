@@ -288,20 +288,17 @@ def _classify_pairs(pairs: list[list[int]], axes: "dict[str, int]"
     return list(axes)[moved.pop()]
 
 
-def mesh_axis_collective_counts(compiled, mesh_axes: "dict[str, int]"
-                                ) -> dict | None:
-    """``{op: {axis: count}}`` over the compiled module's collectives,
-    each attributed to the mesh axis its replica groups (or permute
-    pairs) span — the pin that makes "this 2-D step really communicates
-    over ``model``" a checkable contract fact instead of an aggregate op
-    count a replicated regression could imitate.
+def _collective_line_labels(compiled, mesh_axes: "dict[str, int]"
+                            ) -> "list[tuple[str, str]] | None":
+    """``[(op, axis_label), ...]`` for every collective line of the
+    compiled module's HLO text, **in line order** — the one walk both
+    :func:`mesh_axis_collective_counts` (aggregate) and
+    :func:`mesh_axis_collective_schedule` (ordered) are views of.
 
-    ``mesh_axes`` is the ordered ``{axis_name: size}`` of the mesh the
-    program was built on (row-major device order, as ``make_mesh``
-    lays it out).  Handles XLA's explicit (``{{0,1},{2,3}}``) and iota
-    (``[4,2]<=[8]``, ``[2,4]<=[4,2]T(1,0)``) group encodings plus
-    ``source_target_pairs``.  Sync and async ``-start`` forms count
-    under the base op.  ``None`` when the HLO text is unavailable.
+    Line order is the order XLA's scheduler emitted the ops, i.e. issue
+    order; async ``-start`` forms fold in at their issue point (the
+    matching ``-done`` lines never match the op regex).  ``None`` when
+    the HLO text is unavailable.
     """
     import numpy as np
 
@@ -314,7 +311,7 @@ def mesh_axis_collective_counts(compiled, mesh_axes: "dict[str, int]"
     axes = dict(mesh_axes)
     n = int(np.prod(list(axes.values())))
     expected = _axis_groups(axes)
-    counts: dict[str, dict[str, int]] = {}
+    labels: list[tuple[str, str]] = []
     op_re = re.compile(
         rf" ({'|'.join(_HLO_COLLECTIVES)})(?:-start)?\(")
     for line in text.splitlines():
@@ -337,9 +334,63 @@ def mesh_axis_collective_counts(compiled, mesh_axes: "dict[str, int]"
             label = _classify_pairs(_parse_group_list(pm.group(1)), axes)
         else:
             label = "other"
+        labels.append((op, label))
+    return labels
+
+
+def _counts_from_labels(labels: "list[tuple[str, str]]") -> dict:
+    counts: dict[str, dict[str, int]] = {}
+    for op, label in labels:
         per = counts.setdefault(op, {})
         per[label] = per.get(label, 0) + 1
     return counts
+
+
+def _schedule_from_labels(labels: "list[tuple[str, str]]") -> dict:
+    from .spmd import rle
+
+    seqs: dict[str, list[str]] = {}
+    for op, label in labels:
+        seqs.setdefault(label, []).append(op)
+    return {label: rle(seq) for label, seq in sorted(seqs.items())}
+
+
+def mesh_axis_collective_counts(compiled, mesh_axes: "dict[str, int]"
+                                ) -> dict | None:
+    """``{op: {axis: count}}`` over the compiled module's collectives,
+    each attributed to the mesh axis its replica groups (or permute
+    pairs) span — the pin that makes "this 2-D step really communicates
+    over ``model``" a checkable contract fact instead of an aggregate op
+    count a replicated regression could imitate.
+
+    ``mesh_axes`` is the ordered ``{axis_name: size}`` of the mesh the
+    program was built on (row-major device order, as ``make_mesh``
+    lays it out).  Handles XLA's explicit (``{{0,1},{2,3}}``) and iota
+    (``[4,2]<=[8]``, ``[2,4]<=[4,2]T(1,0)``) group encodings plus
+    ``source_target_pairs``.  Sync and async ``-start`` forms count
+    under the base op.  ``None`` when the HLO text is unavailable.
+    """
+    labels = _collective_line_labels(compiled, mesh_axes)
+    return None if labels is None else _counts_from_labels(labels)
+
+
+def mesh_axis_collective_schedule(compiled, mesh_axes: "dict[str, int]"
+                                  ) -> dict | None:
+    """``{axis: [op, "op*N", ...]}`` — the **ordered** per-mesh-axis
+    collective sequence of the compiled program, run-length encoded
+    (:func:`spmd.rle`) so train-step-scale pins stay reviewable.
+
+    This is the jaxguard JG002 substrate: under the lockstep-collective
+    model, two programs that hosts could run as alternates of the same
+    dispatch point must issue the identical op sequence on every mesh
+    axis they share — the aggregate counts can match while a reordering
+    still deadlocks the pod at the first mismatched op.  Labels beyond
+    the named axes (``global``, ``other``) get schedules too: a
+    global-group all-reduce is a sync point every host must reach in the
+    same position.  ``None`` when the HLO text is unavailable.
+    """
+    labels = _collective_line_labels(compiled, mesh_axes)
+    return None if labels is None else _schedule_from_labels(labels)
 
 
 # ------------------------------------------------------------ dtype findings
@@ -609,12 +660,19 @@ def audit(fn, args: tuple = (), *, name: str = "program",
     Reports without it keep the pre-existing two-level collectives dict,
     so older contracts stay byte-stable.
 
-    Returns the JSON-able report :mod:`contracts` pins.
+    Returns the JSON-able report :mod:`contracts` pins.  Its
+    ``timing_ms`` field (``{"lower", "compile", "walk"}`` wall-clock
+    millis; ``compile`` is None under ``compile=False``) attributes
+    where contract-gate time goes — it rides into bench.py's
+    ``ir_audit_fields`` but is never pinned by a contract.
     """
+    import time
+
     import jax
 
     from ..telemetry.lowering import lower_cached
 
+    t0 = time.perf_counter()
     prog = lower_cached(fn, *args)
     traced = prog.traced
     if traced is None:
@@ -622,6 +680,12 @@ def audit(fn, args: tuple = (), *, name: str = "program",
             "this jax version has no AOT fn.trace(); jaxaudit needs the "
             "ClosedJaxpr of the exact jitted callable")
     closed = traced.jaxpr
+    t_lower = time.perf_counter()
+
+    # force the (lazy, cached) executable before any walking so the
+    # compile cost is attributed to itself, not to the first walk
+    compiled = prog.compiled if compile else None
+    t_compile = time.perf_counter()
 
     findings: list[AuditFinding] = []
     findings += dtype_upcast_findings(closed, allow=f32_allow)
@@ -630,24 +694,31 @@ def audit(fn, args: tuple = (), *, name: str = "program",
         closed, large_const_bytes=large_const_bytes)
     findings += const_findings
 
-    compiled = prog.compiled if compile else None
     donation, donation_findings = donation_report(traced, compiled)
     findings += donation_findings
+
+    # one line walk feeds both the aggregate per-axis counts and the
+    # ordered per-axis schedule (jaxguard's JG002 substrate)
+    axis_labels = None
+    if mesh_axes is not None and compile:
+        axis_labels = _collective_line_labels(compiled, mesh_axes)
 
     report = {
         "program": name,
         "platform": jax.devices()[0].platform,
         "n_devices": len(jax.devices()),
         "overlap_expected": overlap_expected,
-        # "hlo_axes" (per-mesh-axis attribution) joins the dict only
-        # when the caller named the mesh (plan-built programs) — absent
-        # otherwise, keeping pre-existing contracts byte-stable
+        # "hlo_axes"/"hlo_schedule" (per-mesh-axis attribution) join the
+        # dict only when the caller named the mesh (plan-built programs)
+        # — absent otherwise, keeping pre-existing contracts byte-stable
         "collectives": {
             "jaxpr": collective_inventory(closed),
             "hlo": hlo_collective_counts(compiled) if compile else None,
             **({} if mesh_axes is None else {
-                "hlo_axes": mesh_axis_collective_counts(
-                    compiled, mesh_axes) if compile else None}),
+                "hlo_axes": None if axis_labels is None
+                else _counts_from_labels(axis_labels),
+                "hlo_schedule": None if axis_labels is None
+                else _schedule_from_labels(axis_labels)}),
         },
         "outputs": [_format_aval(getattr(v, "aval", None))
                     for v in closed.jaxpr.outvars],
@@ -665,6 +736,13 @@ def audit(fn, args: tuple = (), *, name: str = "program",
         cost = prog.cost()
         report["flops"] = cost["flops"]
         report["bytes_accessed"] = cost["bytes"]
+    t_walk = time.perf_counter()
+    report["timing_ms"] = {
+        "lower": round((t_lower - t0) * 1e3, 2),
+        "compile": round((t_compile - t_lower) * 1e3, 2)
+        if compile else None,
+        "walk": round((t_walk - t_compile) * 1e3, 2),
+    }
     return report
 
 

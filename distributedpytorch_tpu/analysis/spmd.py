@@ -1,0 +1,412 @@
+"""jaxguard SPMD passes: host-divergence taint (JG001) + collective
+schedules (JG002).
+
+The multi-host execution model this framework runs under (docs/DESIGN.md
+"Elastic pod training") is lockstep-collective: every host traces the
+same Python, compiles the same program, and issues the same collectives
+in the same order.  Anything that lets two hosts take different paths to
+a collective — a wall-clock comparison, an env var, a per-host HBM probe
+— is a *silent deadlock*: the job hangs at the first mismatched
+collective with no error on any host.  PR 11 built the sanctioned escape
+hatch (``parallel/consensus.replicated_decision``: one allgather + a
+deterministic reduce, so the *decision* is replicated even when its
+inputs are not); this module is the static policeman that everything
+else goes through it.
+
+Two passes:
+
+* **JG001** (AST, this module): flow-sensitive taint from host-divergent
+  sources (``time.*``, ``os.environ``, ``random``, ``process_index``,
+  filesystem stats, psutil/HBM probes) into control flow that gates a
+  collective-issuing call.  Routing a tainted value *through*
+  ``replicated_decision`` clears the taint — the allowlist is
+  load-bearing, exactly like JA002's accumulation allowlist: the
+  framework's own ``auto_plan`` is clean *because* it launders its HBM
+  probe through the consensus primitive, and deleting that call makes
+  this rule fire.
+* **JG002** (IR, pure comparison here — extraction lives in
+  :func:`ir.mesh_axis_collective_schedule`): two programs that hosts
+  could run as alternates of the same dispatch point must issue the
+  identical *ordered* collective sequence on every mesh axis they
+  share, or the first mismatched collective deadlocks the pod.  Pairs
+  that legitimately differ (the plan ladder's rungs — that is WHY the
+  rung vote exists) are declared divergent in the checked-in guard
+  schedule contract; the declaration is itself policed for staleness.
+
+Import-light on purpose (stdlib only), like :mod:`core`: the AST pass
+must run in pre-commit hooks without initializing a backend.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+import re
+
+from .core import Finding, dotted_name, target_names
+
+# --------------------------------------------------------------- JG001 model
+
+#: dotted-name prefixes whose calls/reads produce host-divergent values
+_SOURCE_PREFIXES = (
+    "time.", "os.environ", "random.", "np.random.", "numpy.random.",
+    "psutil.", "glob.",
+)
+
+#: exact dotted names (or bare names, for ``from x import y`` styles)
+_SOURCE_NAMES = frozenset({
+    "os.getenv", "os.stat", "os.lstat", "os.listdir", "os.scandir",
+    "os.statvfs", "os.path.exists", "os.path.isfile", "os.path.isdir",
+    "os.path.getsize", "os.path.getmtime", "os.path.getctime",
+    "os.path.getatime", "shutil.disk_usage", "socket.gethostname",
+    "platform.node", "uuid.uuid1", "uuid.uuid4",
+    "jax.process_index", "jax.host_id", "process_index", "host_id",
+    "detect_hbm_bytes", "perf_counter", "monotonic", "time_ns",
+})
+
+#: method names divergent on ANY receiver: device HBM probes and
+#: pathlib-style filesystem stats
+_SOURCE_ATTR_CALLS = frozenset({
+    "memory_stats", "stat", "iterdir", "is_file", "is_dir", "exists",
+})
+
+#: the sanctioned laundering points: their RESULT is replicated by
+#: construction (one allgather + a deterministic reduce on every host),
+#: so taint does not flow through them.  ``governor_consensus`` is the
+#: governor's documented seam onto the same primitive.
+_LAUNDER = frozenset({
+    "replicated_decision", "reduce_decision", "governor_consensus",
+})
+
+#: calls that issue (or build a program that will issue) collectives —
+#: the sinks JG001 protects.  ``replicated_decision`` is deliberately in
+#: BOTH sets: as a *value* it launders, but *calling* it under divergent
+#: control is itself the deadlock (some hosts join the allgather, some
+#: don't).  ``make_train_step``/``make_eval_step`` cover ``Plan.make_*``
+#: and the parallel/step.py factories: a host-divergent choice of
+#: program is the same hazard one trace later.
+_COLLECTIVE_CALLS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "process_allgather",
+    "gather_values", "replicated_decision", "governor_consensus",
+    "make_train_step", "make_eval_step",
+})
+
+#: ``<receiver>.save(...)`` counts as a sink when the receiver looks
+#: like a checkpoint manager: a host skipping (or doubling) a
+#: checkpoint save desynchronizes the save barrier and the restore set
+_CKPT_RECV_RE = re.compile(r"(ckpt|checkpoint|manager|mgr)",
+                           re.IGNORECASE)
+
+_SHARD_MAP_NAMES = frozenset({"shard_map"})
+
+
+def _last_segment(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name:
+        return name.rsplit(".", 1)[-1]
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _is_source_name(name: str) -> bool:
+    if name in _SOURCE_NAMES:
+        return True
+    return any(name == p.rstrip(".") or name.startswith(p)
+               for p in _SOURCE_PREFIXES)
+
+
+def shard_mapped_names(tree: ast.AST) -> frozenset[str]:
+    """Names bound to ``shard_map(...)``-built callables in this module —
+    calling one issues that program's collectives, so they join the
+    JG001 sink set."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            f = dotted_name(node.value.func)
+            if f and f.rsplit(".", 1)[-1] in _SHARD_MAP_NAMES:
+                for t in node.targets:
+                    names.update(target_names(t))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                d = deco.func if isinstance(deco, ast.Call) else deco
+                nm = dotted_name(d)
+                if nm and nm.rsplit(".", 1)[-1] in _SHARD_MAP_NAMES:
+                    names.add(node.name)
+    return frozenset(names)
+
+
+def _expr_source(node: ast.AST, tainted: set[str]) -> str | None:
+    """The host-divergent source feeding this expression, or None.
+    Descent stops at laundering calls — their result is replicated."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Call):
+            name = dotted_name(n.func)
+            last = _last_segment(n)
+            if last in _LAUNDER:
+                continue  # replicated by contract — clean, don't descend
+            if name and _is_source_name(name):
+                return name
+            if last in _SOURCE_ATTR_CALLS:
+                return f".{last}()"
+            stack.extend(ast.iter_child_nodes(n))
+        elif isinstance(n, ast.Name):
+            if n.id in tainted:
+                return n.id
+        elif isinstance(n, ast.Attribute):
+            d = dotted_name(n)
+            if d is not None:
+                if _is_source_name(d) or d in tainted:
+                    return d
+                # x.attr with x (or a dotted prefix) tainted
+                parts = d.split(".")
+                for k in range(1, len(parts)):
+                    if ".".join(parts[:k]) in tainted:
+                        return d
+            else:
+                stack.append(n.value)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            continue  # defining is not evaluating
+        else:
+            stack.extend(ast.iter_child_nodes(n))
+    return None
+
+
+def _terminates(body: list[ast.stmt]) -> bool:
+    """Does this branch unconditionally leave the enclosing block?  A
+    host-divergent ``if tainted: return`` gates everything AFTER the if
+    just as surely as nesting it would."""
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+class _DivergenceScanner:
+    """One flow-sensitive walk per function scope (and per module body):
+    statements in order, assignments move taint, laundering rebinds
+    clear it, and collective-issuing calls under an active divergent
+    gate are findings."""
+
+    def __init__(self, path: str, shard_names: frozenset[str]):
+        self.path = path
+        self.shard_names = shard_names
+        self.findings: list[Finding] = []
+        self._seen: set[int] = set()  # id(call node) — one finding each
+
+    # -- sinks ---------------------------------------------------------
+    def _collective_callee(self, call: ast.Call) -> str | None:
+        last = _last_segment(call)
+        if last in _COLLECTIVE_CALLS or last in self.shard_names:
+            return dotted_name(call.func) or last
+        if last == "save" and isinstance(call.func, ast.Attribute):
+            recv = dotted_name(call.func.value) or ""
+            if _CKPT_RECV_RE.search(recv):
+                return f"{recv}.save"
+        return None
+
+    def _scan_sinks(self, node: ast.AST, gates: list) -> None:
+        if not gates:
+            return
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # a nested def's body runs at call time
+            if not isinstance(n, ast.Call) or id(n) in self._seen:
+                continue
+            callee = self._collective_callee(n)
+            if callee is None:
+                continue
+            self._seen.add(id(n))
+            gate_node, source = gates[-1]
+            self.findings.append(Finding(
+                "JG001",
+                f"collective-issuing call `{callee}` under "
+                f"host-divergent control (gated at line "
+                f"{gate_node.lineno} by {source}) — hosts taking "
+                "different branches deadlock at the first mismatched "
+                "collective; route the decision through "
+                "parallel/consensus.replicated_decision",
+                self.path, getattr(n, "lineno", gate_node.lineno),
+                getattr(n, "col_offset", 0)))
+
+    # -- statements ----------------------------------------------------
+    def run_block(self, stmts: list[ast.stmt], tainted: set[str],
+                  gates: list) -> None:
+        gates = list(gates)
+        for s in stmts:
+            extra = self._stmt(s, tainted, gates)
+            if extra is not None:
+                # a divergent early exit: the REST of this block only
+                # runs on hosts that didn't take it
+                gates.append(extra)
+
+    def _assign(self, targets, value_src: str | None,
+                tainted: set[str]) -> None:
+        for t in targets:
+            for name in target_names(t):
+                if value_src is None:
+                    tainted.discard(name)
+                else:
+                    tainted.add(name)
+
+    def _stmt(self, s: ast.stmt, tainted: set[str], gates: list):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in s.decorator_list:
+                self._scan_sinks(deco, gates)
+            self.run_block(s.body, set(), [])  # fresh scope, runs later
+            return None
+        if isinstance(s, ast.ClassDef):
+            self.run_block(s.body, set(), gates)
+            return None
+        if isinstance(s, ast.If):
+            self._scan_sinks(s.test, gates)
+            src = _expr_source(s.test, tainted)
+            sub = gates + [(s, src)] if src else gates
+            t_body, t_else = set(tainted), set(tainted)
+            for b, t in ((s.body, t_body), (s.orelse, t_else)):
+                self.run_block(b, t, sub)
+            tainted |= t_body | t_else
+            if src and (_terminates(s.body) or _terminates(s.orelse)):
+                return (s, src)
+            return None
+        if isinstance(s, ast.While):
+            self._scan_sinks(s.test, gates)
+            src = _expr_source(s.test, tainted)
+            sub = gates + [(s, src)] if src else gates
+            for _ in range(2):  # taint fixed point across iterations
+                self.run_block(s.body, tainted, sub)
+            self.run_block(s.orelse, tainted, gates)
+            return None
+        if isinstance(s, ast.For):
+            self._scan_sinks(s.iter, gates)
+            src = _expr_source(s.iter, tainted)
+            sub = gates + [(s, src)] if src else gates
+            if src:  # divergent trip count/order taints the loop var
+                self._assign([s.target], src, tainted)
+            for _ in range(2):
+                self.run_block(s.body, tainted, sub)
+            self.run_block(s.orelse, tainted, gates)
+            return None
+        if isinstance(s, ast.Try):
+            self.run_block(s.body, tainted, gates)
+            for h in s.handlers:
+                self.run_block(h.body, tainted, gates)
+            self.run_block(s.orelse, tainted, gates)
+            self.run_block(s.finalbody, tainted, gates)
+            return None
+        if isinstance(s, ast.With):
+            for item in s.items:
+                self._scan_sinks(item.context_expr, gates)
+                src = _expr_source(item.context_expr, tainted)
+                if item.optional_vars is not None:
+                    self._assign([item.optional_vars], src, tainted)
+            self.run_block(s.body, tainted, gates)
+            return None
+        # leaf statements: scan for gated sinks, then move taint
+        self._scan_sinks(s, gates)
+        if isinstance(s, ast.Assign):
+            self._assign(s.targets, _expr_source(s.value, tainted),
+                         tainted)
+        elif isinstance(s, ast.AnnAssign) and s.value is not None:
+            self._assign([s.target], _expr_source(s.value, tainted),
+                         tainted)
+        elif isinstance(s, ast.AugAssign):
+            src = _expr_source(s.value, tainted)
+            if src is not None:  # += never un-taints
+                self._assign([s.target], src, tainted)
+        return None
+
+
+def find_host_divergence(tree: ast.AST, path: str) -> list[Finding]:
+    """JG001 over one parsed module."""
+    scanner = _DivergenceScanner(path, shard_mapped_names(tree))
+    scanner.run_block(tree.body, set(), [])
+    return scanner.findings
+
+
+# --------------------------------------------------------------- JG002 model
+
+def rle(seq: list[str]) -> list[str]:
+    """Run-length encode an op sequence: ``["psum","psum","ag"] ->
+    ["psum*2","ag"]`` — schedule pins stay reviewable at train-step
+    scale (hundreds of collectives, dozens of runs)."""
+    out: list[str] = []
+    for op, group in itertools.groupby(seq):
+        n = sum(1 for _ in group)
+        out.append(op if n == 1 else f"{op}*{n}")
+    return out
+
+
+def rle_expand(seq: list[str]) -> list[str]:
+    out: list[str] = []
+    for item in seq:
+        if "*" in item:
+            op, n = item.rsplit("*", 1)
+            out.extend([op] * int(n))
+        else:
+            out.append(item)
+    return out
+
+
+def _first_mismatch(a: list[str], b: list[str]) -> str:
+    ea, eb = rle_expand(a), rle_expand(b)
+    for i, (x, y) in enumerate(zip(ea, eb)):
+        if x != y:
+            return f"position {i}: {x} != {y}"
+    return f"length {len(ea)} != {len(eb)}"
+
+
+def schedule_divergence(schedules: dict[str, dict[str, list[str]]],
+                        declared_divergent: list | tuple = ()
+                        ) -> list[Finding]:
+    """JG002: pairwise over programs sharing a mesh axis, the ordered
+    per-axis collective sequences must match — or the pair must be
+    DECLARED divergent (the plan ladder's rungs, whose single-rung-per-
+    job invariant the consensus vote enforces at runtime).
+
+    ``schedules``: ``{program: {axis: [rle ops...]}}`` as
+    :func:`ir.mesh_axis_collective_schedule` extracts them.
+    """
+    declared = {frozenset(p) for p in declared_divergent}
+    findings: list[Finding] = []
+    for a, b in itertools.combinations(sorted(schedules), 2):
+        if frozenset((a, b)) in declared:
+            continue
+        for ax in sorted(set(schedules[a]) & set(schedules[b])):
+            if schedules[a][ax] != schedules[b][ax]:
+                findings.append(Finding(
+                    "JG002",
+                    f"schedule divergence between {a} and {b} on mesh "
+                    f"axis {ax!r} ({_first_mismatch(schedules[a][ax], schedules[b][ax])}) "
+                    "— hosts running these programs as alternates "
+                    "deadlock at that collective; pick one program per "
+                    "job via replicated_decision and declare the pair "
+                    "divergent in the guard schedule contract",
+                    f"<{a}|{b}>", 0, 0))
+                break  # one finding per pair — the rest is detail
+    return findings
+
+
+def stale_divergence_declarations(
+        schedules: dict[str, dict[str, list[str]]],
+        declared_divergent: list | tuple) -> list[str]:
+    """Declared-divergent pairs that no longer diverge (or whose
+    programs vanished) — a stale allowlist entry is itself a failure,
+    same contract as the lint suppressions (``jaxlint --stats``)."""
+    stale: list[str] = []
+    for pair in declared_divergent:
+        a, b = sorted(pair)
+        if a not in schedules or b not in schedules:
+            stale.append(f"declared-divergent pair ({a}, {b}) names "
+                         "unknown program(s) — delete the declaration")
+            continue
+        shared = set(schedules[a]) & set(schedules[b])
+        if all(schedules[a][ax] == schedules[b][ax] for ax in shared):
+            stale.append(
+                f"declared-divergent pair ({a}, {b}) is now "
+                "lockstep-identical on every shared axis — the "
+                "declaration is dead, delete it")
+    return stale

@@ -256,4 +256,11 @@ def enable_compile_cache(root: str | None = None) -> None:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(root, ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    # persist EVERY executable, not just the >2s ones: the test suite
+    # compiles hundreds of small programs that individually cost
+    # 50-500ms of XLA work and repeat identically run-to-run — below
+    # any per-program threshold, but minutes in aggregate.  Disk is
+    # cheap; the wall-clock of the tier-1 gate is not.  (Compile-count
+    # watchdogs are unaffected: jax_log_compiles fires on cache hits
+    # too — the trace/lower happens either way.)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)

@@ -225,6 +225,7 @@ class AotCache:
         of these exact programs happens from the LOWERED form here, not
         from a deserialized executable whose memory stats are zeroed).
         """
+        import jax
         from jax.experimental import serialize_executable
 
         from ..telemetry.lowering import lower_cached
@@ -238,23 +239,66 @@ class AotCache:
         os.makedirs(self.cache_dir, exist_ok=True)
         entries: dict[str, dict] = {}
         total = 0
-        for name, fn, args, _key in ladder_programs(predictor, buckets):
-            compiled = lower_cached(fn, *args).compiled
-            payload, in_tree, out_tree = serialize_executable.serialize(
-                compiled)
-            blob = pickle.dumps((payload, in_tree, out_tree),
-                                protocol=pickle.HIGHEST_PROTOCOL)
-            fname = f"{name}.exec"
-            path = os.path.join(self.cache_dir, fname)
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-            entries[name] = {"file": fname, "bytes": len(blob),
-                             "crc32": zlib.crc32(blob)}
-            total += len(blob)
+        # THIS cache is the persistence layer: an executable that jax's
+        # own persistent compilation cache deserialized re-serializes
+        # into a blob that cannot deserialize again (its backend symbol
+        # table is gone), so the build must compile genuinely fresh.
+        # Flipping jax_enable_compilation_cache alone is NOT enough —
+        # two jax-internal caches defeat it:
+        #   1. compilation_cache.is_cache_used() LATCHES its answer at
+        #      the first compile of the process; reset_cache() drops the
+        #      latch so the disabled flag actually reaches the read path;
+        #   2. Lowered.compile() consults an in-memory executable memo
+        #      which may hold an executable an EARLIER (cache-enabled)
+        #      compile deserialized from disk; clear_caches() drops it.
+        # lower_cached's memo is our own and survives clear_caches(), so
+        # lowering still shares the process-wide cache — only the
+        # compile pays again.
+        from jax._src import compilation_cache as _jax_cc
+
+        cache_flag = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        _jax_cc.reset_cache()
+        jax.clear_caches()
+        try:
+            for name, fn, args, _key in ladder_programs(predictor,
+                                                        buckets):
+                compiled = lower_cached(fn, *args).lowered.compile()
+                payload, in_tree, out_tree = \
+                    serialize_executable.serialize(compiled)
+                try:
+                    # round-trip proof at build time: a blob that cannot
+                    # deserialize HERE would poison every warm boot; any
+                    # residual cache-bypass leak must fail the build
+                    serialize_executable.deserialize_and_load(
+                        payload, in_tree, out_tree)
+                except Exception as e:
+                    raise AotCacheError(
+                        f"freshly built executable {name!r} does not "
+                        f"survive a serialization round-trip "
+                        f"({type(e).__name__}: {e}) — refusing to "
+                        "commit a cache no boot could load") from e
+                blob = pickle.dumps((payload, in_tree, out_tree),
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+                fname = f"{name}.exec"
+                path = os.path.join(self.cache_dir, fname)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                entries[name] = {"file": fname, "bytes": len(blob),
+                                 "crc32": zlib.crc32(blob)}
+                total += len(blob)
+        finally:
+            jax.config.update("jax_enable_compilation_cache",
+                              cache_flag)
+            # drop the latch again so the NEXT compile re-evaluates the
+            # restored flag — without this, the build's disabled answer
+            # would stay latched and the rest of the process would skip
+            # the persistent cache entirely
+            _jax_cc.reset_cache()
         # the manifest commits the cache as a unit, atomically and LAST
         # — a build that dies above leaves entry files but no manifest,
         # and a manifest-less directory is a MISS, never a half-trust

@@ -617,9 +617,17 @@ class Trainer:
             seed=cfg.seed, num_workers=cfg.data.num_workers,
             prefetch=cfg.data.prefetch,
             num_shards=n_proc, shard_index=jax.process_index())
-        if len(self.train_loader) == 0:
-            # drop_last swallows a sub-batch-size dataset whole; training
-            # would silently run zero steps per epoch (NaN epoch loss).
+        # drop_last swallows a sub-batch-size dataset whole; training
+        # would silently run zero steps per epoch (NaN epoch loss).  The
+        # emptiness decision is laundered through the consensus
+        # primitive: shards round unevenly, and one host raising here
+        # alone would leave the rest hanging at the first collective —
+        # if ANY host's shard is empty, every host raises in lockstep.
+        from ..parallel.consensus import replicated_decision
+        min_batches = int(replicated_decision(
+            len(self.train_loader), reduce="min",
+            label="trainer/train_loader_len"))
+        if min_batches == 0:
             raise ValueError(
                 f"train loader is empty: dataset has {len(self.train_set)} "
                 f"samples globally (~{len(self.train_set) // n_proc} on "

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# The static-analysis gate, both layers in one command:
+# The static-analysis gate, all three layers in one command:
 #
 #   1. jaxlint — AST-level TPU hazards over everything device-adjacent:
 #      the package (serve/ included — the batcher feeds a jitted forward
@@ -46,7 +46,22 @@
 #      the replica boot path: crc + fallback logic only, no device
 #      touches beyond deserialization, and `dptpu-aot --verify` stays
 #      a pure-host sweep) plus bench.py, the official record.
-#   2. jaxaudit check — IR-level compile contracts: the canonical
+#      `jaxlint --stats` then polices the suppressions themselves: a
+#      `# jaxlint:`/`# jaxguard:` disable whose rule no longer fires is
+#      a dead waiver waiting to swallow the next real finding — it
+#      fails the gate with the exact file:line to delete.
+#   2. jaxguard check — cross-program SPMD-divergence + donation
+#      safety (analysis/spmd.py + analysis/donation.py): JG001
+#      host-divergent control over collective-issuing calls (the
+#      silent multi-host deadlock; replicated_decision is the one
+#      sanctioned laundering point), JG003/JG004 donation aliasing
+#      across the trace boundary (the Orbax-restore segfault /
+#      warm-start NaN class), and JG002 ordered per-mesh-axis
+#      collective schedules cross-checked pairwise over the plan
+#      ladder against tests/contracts/guard_schedules.<key>.json.
+#      After a REVIEWED schedule change, regenerate with
+#      `python -m distributedpytorch_tpu.analysis --guard update`.
+#   3. jaxaudit check — IR-level compile contracts: the canonical
 #      train/eval/serve programs (incl. the session split's
 #      encode_step/decode_step, train_step_bf16 — the mixed-
 #      precision bucketed-reduce fast path, JA002-audited against the
@@ -61,22 +76,38 @@
 #      model-axis collectives) are re-traced on the pinned 8-device
 #      CPU topology and diffed against tests/contracts/ (collective
 #      counts incl. async -start forms, output shapes, donation
-#      aliasing, baked constants, FLOPs bounds).  After a REVIEWED program change, regenerate with
+#      aliasing, baked constants, FLOPs bounds).  After a REVIEWED
+#      program change, regenerate with
 #      `python -m distributedpytorch_tpu.analysis --ir update`.
 #
 # Mirror of the tier-1 gates (tests/test_lint_clean.py +
-# tests/test_jaxaudit.py); run it before pushing anything that touches
-# device code:
+# tests/test_jaxguard.py + tests/test_jaxaudit.py); run it before
+# pushing anything that touches device code:
 #
-#     scripts/lint.sh                # both layers
-#     scripts/lint.sh --select JL002 # one lint rule (skips the IR gate)
+#     scripts/lint.sh                # all three layers
+#     scripts/lint.sh --guard        # jaxlint + jaxguard AST half only
+#                                    # (no jax import — pre-commit speed)
+#     scripts/lint.sh --select JL002 # one lint rule (skips IR gates)
 #
 # Extra args pass through to the LINTER CLI (--select/--ignore/paths)
-# and skip the jaxaudit half (a scoped lint run shouldn't pay a trace).
+# and skip the compile-backed halves (a scoped lint run shouldn't pay a
+# trace).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [ "$#" -eq 1 ] && [ "$1" = "--guard" ]; then
+    # fast pre-commit path: both AST layers, no backend
+    python -m distributedpytorch_tpu.analysis \
+        distributedpytorch_tpu bench.py
+    python -m distributedpytorch_tpu.analysis --guard check --no-ir \
+        distributedpytorch_tpu bench.py
+    exit 0
+fi
 python -m distributedpytorch_tpu.analysis \
     distributedpytorch_tpu bench.py "$@"
 if [ "$#" -eq 0 ]; then
+    python -m distributedpytorch_tpu.analysis --stats \
+        distributedpytorch_tpu bench.py
+    python -m distributedpytorch_tpu.analysis --guard check \
+        distributedpytorch_tpu bench.py
     python -m distributedpytorch_tpu.analysis --ir check
 fi

@@ -337,12 +337,13 @@ class TestHooks:
 
         monkeypatch.setenv("DPTPU_BENCH_AUDIT", "0")
         fields = bench.ir_audit_fields(None, (), "x")
-        assert fields == {"collectives": None, "ir_contract": "skipped"}
+        assert fields == {"collectives": None, "ir_contract": "skipped",
+                          "audit_ms": None}
         monkeypatch.setenv("DPTPU_BENCH_AUDIT", "1")
         # an unauditable fn must degrade to 'error', never raise
         fields = bench.ir_audit_fields(None, (), "x")
         assert fields["ir_contract"] == "error"
-        assert "collectives" in fields
+        assert "collectives" in fields and "audit_ms" in fields
 
     def test_bench_fields_check_against_contracts(self, canonical_reports):
         import bench
@@ -352,6 +353,11 @@ class TestHooks:
         fields = bench.ir_audit_fields(fn, args, "serve_forward_b1")
         assert fields["ir_contract"] == "pass"
         assert fields["collectives"]["jaxpr"] == {}
+        # the timing attribution rides along (satellite of jaxguard):
+        # always the three keys, all non-negative on a compiled audit
+        assert set(fields["audit_ms"]) == {"lower", "compile", "walk"}
+        assert all(v is not None and v >= 0
+                   for v in fields["audit_ms"].values())
 
     def test_bench_update_knob_pins_then_passes(self, monkeypatch,
                                                 tmp_path):
@@ -426,3 +432,111 @@ class TestCLI:
         with open(path) as f:
             loaded = json.load(f)
         assert contracts.diff_contract(loaded, rep) == []
+
+
+# ------------------------------------------------------------ contract schema
+
+def _contract_files():
+    import glob
+
+    return sorted(glob.glob(os.path.join(CONTRACTS_DIR, "*.json")))
+
+
+class TestContractSchema:
+    """Satellite: every checked-in contract validates against the one
+    declared schema — a hand-edited contract fails HERE, loudly, not by
+    silently never being compared."""
+
+    @pytest.mark.parametrize(
+        "path", _contract_files(),
+        ids=[os.path.basename(p) for p in _contract_files()])
+    def test_checked_in_contract_is_schema_valid(self, path):
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        errs = contracts.validate_contract_file(path, doc)
+        assert not errs, "\n".join(errs)
+
+    def test_schema_catches_hand_edit_hazards(self):
+        with open(contracts.contract_path(CONTRACTS_DIR, "eval_step",
+                                          "cpu8")) as f:
+            good = json.load(f)
+        path = os.path.join(CONTRACTS_DIR, "eval_step.cpu8.json")
+
+        # typo'd top-level key: pins nothing, must be loud
+        doc = dict(good, finding_cnts=good["finding_counts"])
+        del doc["finding_counts"]
+        errs = contracts.validate_contract_file(path, doc)
+        assert any("finding_cnts" in e for e in errs)
+        assert any("missing" in e for e in errs)
+
+        # filename / platform-key naming convention
+        errs = contracts.validate_contract_file(
+            os.path.join(CONTRACTS_DIR, "eval_step.CPU-8.json"),
+            dict(good, platform_key="CPU-8"))
+        assert any("platform_key" in e for e in errs)
+        errs = contracts.validate_contract_file(
+            os.path.join(CONTRACTS_DIR, "other_name.cpu8.json"), good)
+        assert any("filename" in e for e in errs)
+
+        # band/count types
+        doc = json.loads(json.dumps(good))
+        doc["constants"]["total_bytes"] = "lots"
+        assert contracts.validate_contract_file(path, doc)
+        doc = json.loads(json.dumps(good))
+        doc["finding_counts"]["donation"] = -1
+        assert contracts.validate_contract_file(path, doc)
+        doc = json.loads(json.dumps(good))
+        doc["collectives"]["hlo_schedule"] = {"data": ["all-reduce*x"]}
+        assert any("hlo_schedule" in e
+                   for e in contracts.validate_contract_file(path, doc))
+
+        # schedule_set kind: divergent_pairs shape is policed too
+        sched_path = os.path.join(CONTRACTS_DIR,
+                                  "guard_schedules.cpu8.json")
+        with open(sched_path, encoding="utf-8") as f:
+            sched = json.load(f)
+        assert contracts.validate_contract_file(sched_path, sched) == []
+        bad = json.loads(json.dumps(sched))
+        bad["divergent_pairs"] = [["a", "a"]]
+        assert any("divergent_pairs" in e
+                   for e in contracts.validate_contract_file(sched_path,
+                                                             bad))
+
+
+# ---------------------------------------------------- guard schedule pins
+
+class TestGuardSchedulePin:
+    """The cross-program half of jaxguard rides the SAME canonical
+    compiles as the contract gate (module fixture) — zero extra
+    lowering; test_jaxguard.py covers the rule mechanics on toys."""
+
+    def test_plan_reports_carry_ordered_schedules(self, canonical_reports):
+        from distributedpytorch_tpu.analysis.spmd import rle_expand
+
+        for name in contracts.PLAN_PROGRAM_NAMES:
+            col = canonical_reports[name]["collectives"]
+            sched = col["hlo_schedule"]
+            assert sched, f"{name}: no hlo_schedule extracted"
+            # the ordered schedule and the aggregate counts are views of
+            # one walk: totals must agree per axis label
+            for ax, seq in sched.items():
+                want = sum(per.get(ax, 0)
+                           for per in col["hlo_axes"].values())
+                assert len(rle_expand(seq)) == want, (name, ax)
+
+    def test_checked_in_pin_matches_live_schedules(self,
+                                                   canonical_reports):
+        from distributedpytorch_tpu.analysis import guard
+
+        schedules = {
+            name: canonical_reports[name]["collectives"]["hlo_schedule"]
+            for name in contracts.PLAN_PROGRAM_NAMES}
+        failures = guard.check_schedules(schedules, CONTRACTS_DIR,
+                                         contracts.platform_key())
+        assert not failures, "\n".join(failures)
+
+    def test_timing_attribution_always_present(self, canonical_reports):
+        for name, rep in canonical_reports.items():
+            tm = rep["timing_ms"]
+            assert set(tm) == {"lower", "compile", "walk"}, name
+            assert all(v is None or v >= 0 for v in tm.values()), name

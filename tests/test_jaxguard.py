@@ -1,0 +1,536 @@
+"""jaxguard: SPMD-divergence + donation-safety, tier-1.
+
+Mirrors test_jaxaudit's drift-injection idiom, one layer up: every rule
+gets a SEEDED hazard fixture (the injected finding is reported exactly,
+with non-zero exit through the same CLI the gate runs) and a clean
+counterpart using the sanctioned idiom (laundering through
+``replicated_decision``, rebind-through-the-call, ``jnp.copy``).  The
+JG002 half compiles two throwaway shard_map toys and walks them through
+the full pin → check → reorder → fail loop against a tmp contracts dir.
+
+The AST-side tests are pure stdlib; only the JG002 class touches jax
+(tiny 8-device CPU toys, shared process compile cache).
+"""
+
+import ast
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedpytorch_tpu.analysis import guard  # noqa: E402
+from distributedpytorch_tpu.analysis.donation import (  # noqa: E402
+    donating_callables,
+    find_donation_hazards,
+)
+from distributedpytorch_tpu.analysis.guard import (  # noqa: E402
+    GUARD_RULES,
+    guard_paths,
+    guard_source,
+    run_guard_cli,
+)
+from distributedpytorch_tpu.analysis.spmd import (  # noqa: E402
+    find_host_divergence,
+    rle,
+    rle_expand,
+    schedule_divergence,
+    stale_divergence_declarations,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO, "distributedpytorch_tpu")
+
+
+def _findings(src):
+    return guard_source(textwrap.dedent(src))
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def _cli_check(tmp_path, src, name="hazard.py"):
+    """Seed one fixture file and run it through the real gate CLI."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return run_guard_cli(["check", str(p), "--no-ir"])
+
+
+# ------------------------------------------------- JG001 host divergence
+
+class TestHostDivergenceJG001:
+    def test_seeded_time_gated_psum_is_exactly_the_finding(self,
+                                                           tmp_path,
+                                                           capsys):
+        src = """
+            import time
+            import jax
+
+            def maybe_sync(x):
+                if time.time() % 2 > 1:
+                    x = jax.lax.psum(x, "data")
+                return x
+        """
+        found = _findings(src)
+        assert codes(found) == ["JG001"]
+        assert "psum" in found[0].message
+        assert "replicated_decision" in found[0].message
+        rc = _cli_check(tmp_path, src)
+        out = capsys.readouterr()
+        assert rc == 1
+        assert out.out.count("JG001") == 1
+
+    def test_env_gated_checkpoint_save_fires(self):
+        found = _findings("""
+            import os
+
+            def maybe_ckpt(manager, step):
+                if os.environ.get("SAVE"):
+                    manager.save(step)
+        """)
+        assert codes(found) == ["JG001"]
+        assert "manager.save" in found[0].message
+
+    def test_taint_flows_through_assignments(self):
+        found = _findings("""
+            import jax
+
+            def pick(x):
+                me = jax.process_index()
+                lucky = me == 0
+                if lucky:
+                    x = jax.lax.pmean(x, "data")
+                return x
+        """)
+        assert codes(found) == ["JG001"]
+
+    def test_divergent_early_exit_gates_block_remainder(self):
+        found = _findings("""
+            import jax
+
+            def run(loader, x):
+                if len(loader) == 0 and jax.process_index() >= 0:
+                    raise ValueError("empty")
+                return jax.lax.psum(x, "data")
+        """)
+        assert codes(found) == ["JG001"]
+
+    def test_shard_mapped_callable_is_a_sink(self):
+        found = _findings("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            stepfn = shard_map(body, mesh=mesh, in_specs=specs,
+                               out_specs=specs)
+
+            def run(x):
+                if jax.process_index() == 0:
+                    return stepfn(x)
+                return x
+        """)
+        assert codes(found) == ["JG001"]
+        assert "stepfn" in found[0].message
+
+    def test_laundered_decision_is_clean(self):
+        # the sanctioned idiom: the DECISION is replicated even though
+        # its input is not — taint must not survive the launder call
+        assert _findings("""
+            import time
+            import jax
+            from distributedpytorch_tpu.parallel.consensus import (
+                replicated_decision,
+            )
+
+            def maybe_sync(x):
+                slow = replicated_decision(time.time(), reduce="max")
+                if slow > 100.0:
+                    x = jax.lax.psum(x, "data")
+                return x
+        """) == []
+
+    def test_calling_the_launderer_under_taint_still_fires(self):
+        # replicated_decision is in BOTH sets: laundering the value is
+        # fine, but invoking the allgather itself divergently deadlocks
+        found = _findings("""
+            import time
+            from distributedpytorch_tpu.parallel.consensus import (
+                replicated_decision,
+            )
+
+            def bad(x):
+                if time.time() > 0:
+                    return replicated_decision(x, reduce="min")
+                return x
+        """)
+        assert codes(found) == ["JG001"]
+
+    def test_replicated_control_is_clean(self):
+        assert _findings("""
+            import jax
+
+            def sync(x, cfg):
+                if cfg.use_psum:
+                    x = jax.lax.psum(x, "data")
+                return x
+        """) == []
+
+
+# --------------------------------------------- JG003 / JG004 donation
+
+class TestUseAfterDonateJG003:
+    def test_seeded_read_after_donate_is_exactly_the_finding(
+            self, tmp_path, capsys):
+        src = """
+            import jax
+
+            step = jax.jit(train_step, donate_argnums=(0,))
+
+            def run(state, batch):
+                loss = step(state, batch)
+                return loss, state.params
+        """
+        found = _findings(src)
+        assert codes(found) == ["JG003"]
+        assert "`state`" in found[0].message
+        assert "use-after-donate" in found[0].message
+        rc = _cli_check(tmp_path, src)
+        out = capsys.readouterr()
+        assert rc == 1
+        assert out.out.count("JG003") == 1
+
+    def test_rebind_through_the_call_is_clean(self):
+        # the sanctioned idiom; also the factory convention (position 0)
+        assert _findings("""
+            import jax
+
+            step = jax.jit(train_step, donate_argnums=(0,))
+            pstep = plan.make_train_step(model)
+
+            def run(state, batch):
+                state, loss = step(state, batch)
+                state, loss = pstep(state, batch)
+                return state, loss
+        """) == []
+
+    def test_factory_and_partial_jit_declare_donations(self):
+        tree = ast.parse(textwrap.dedent("""
+            import jax
+            from functools import partial
+
+            self.train_step = plan.make_train_step(model)
+            other = make_pipeline_step(stages)
+
+            @partial(jax.jit, donate_argnums=(0, 2))
+            def fused(state, batch, grads):
+                return state
+        """))
+        assert donating_callables(tree) == {
+            "self.train_step": (0,),
+            "other": (0,),
+            "fused": (0, 2),
+        }
+
+    def test_donate_read_in_loop_surfaces_on_second_pass(self):
+        found = _findings("""
+            import jax
+
+            step = jax.jit(train_step, donate_argnums=(0,))
+
+            def run(state, batches):
+                for batch in batches:
+                    loss = step(state, batch)
+                return state
+        """)
+        assert "JG003" in codes(found)
+
+
+class TestZeroCopyDonationJG004:
+    def test_seeded_zero_copy_warm_start_is_exactly_the_finding(
+            self, tmp_path, capsys):
+        # the PR 6 warm-start NaN verbatim: device_put CARRIES the host
+        # alias, donation lets XLA scribble over the numpy buffer
+        src = """
+            import jax
+            import numpy as np
+
+            step = jax.jit(train_step, donate_argnums=(0,))
+
+            def warm_start(batch):
+                state = jax.device_put(np.load("ckpt.npy"))
+                out = step(state, batch)
+                return out
+        """
+        found = _findings(src)
+        assert codes(found) == ["JG004"]
+        assert "np.load" in found[0].message
+        assert "jnp.copy" in found[0].message
+        rc = _cli_check(tmp_path, src)
+        out = capsys.readouterr()
+        assert rc == 1
+        assert out.out.count("JG004") == 1
+
+    def test_jnp_copy_launders(self):
+        assert _findings("""
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            step = jax.jit(train_step, donate_argnums=(0,))
+
+            def warm_start(batch):
+                state = jax.device_put(jnp.copy(np.load("ckpt.npy")))
+                out = step(state, batch)
+                return out
+        """) == []
+
+    def test_asarray_propagates_the_alias(self):
+        found = _findings("""
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            step = jax.jit(train_step, donate_argnums=(0,))
+
+            def warm_start(batch):
+                host = np.ones((4,))
+                state = jnp.asarray(host)
+                out = step(state, batch)
+                return out
+        """)
+        assert codes(found) == ["JG004"]
+
+
+# -------------------------------------------------- suppression grammar
+
+class TestSuppressions:
+    SRC = """
+        import jax
+
+        step = jax.jit(train_step, donate_argnums=(0,))
+
+        def run(state, batch):
+            loss = step(state, batch)
+            return loss, state.params  # jaxguard: disable=JG003
+    """
+
+    def test_disable_comment_suppresses(self):
+        assert _findings(self.SRC) == []
+
+    def test_raw_view_ignores_the_directive(self):
+        found = guard_source(textwrap.dedent(self.SRC), suppress=False)
+        assert codes(found) == ["JG003"]
+
+    def test_unknown_code_is_meta(self):
+        found = _findings("""
+            x = 1  # jaxguard: disable=JG999
+        """)
+        assert codes(found) == ["JG000"]
+
+    def test_jaxlint_directives_are_not_jaxguards(self):
+        # a jaxlint disable must NOT swallow a jaxguard finding
+        found = _findings("""
+            import jax
+
+            step = jax.jit(train_step, donate_argnums=(0,))
+
+            def run(state, batch):
+                loss = step(state, batch)
+                return loss, state.params  # jaxlint: disable=JL001
+        """)
+        assert codes(found) == ["JG003"]
+
+    def test_syntax_error_is_meta(self):
+        assert codes(_findings("def broken(:\n    pass")) == ["JG000"]
+
+
+# ------------------------------------------------- JG002: pure comparison
+
+class TestScheduleDivergencePure:
+    A = {"data": ["all-reduce*3", "all-gather"]}
+    B = {"data": ["all-reduce*2", "all-gather", "all-reduce"]}
+
+    def test_rle_round_trips(self):
+        seq = ["psum", "psum", "ag", "psum", "psum", "psum"]
+        assert rle(seq) == ["psum*2", "ag", "psum*3"]
+        assert rle_expand(rle(seq)) == seq
+
+    def test_lockstep_pair_is_clean(self):
+        assert schedule_divergence({"a": self.A, "b": dict(self.A)}) == []
+
+    def test_divergent_pair_is_one_finding(self):
+        found = schedule_divergence({"a": self.A, "b": self.B})
+        assert codes(found) == ["JG002"]
+        assert "position 2" in found[0].message
+
+    def test_declared_pair_is_allowed(self):
+        assert schedule_divergence(
+            {"a": self.A, "b": self.B},
+            declared_divergent=[["a", "b"]]) == []
+
+    def test_stale_declaration_fails(self):
+        stale = stale_divergence_declarations(
+            {"a": self.A, "b": dict(self.A)}, [["a", "b"]])
+        assert len(stale) == 1 and "lockstep-identical" in stale[0]
+        stale = stale_divergence_declarations(
+            {"a": self.A}, [["a", "gone"]])
+        assert len(stale) == 1 and "unknown program" in stale[0]
+
+    def test_disjoint_axes_never_compare(self):
+        assert schedule_divergence(
+            {"a": {"model": ["all-gather"]},
+             "b": {"data": ["all-reduce"]}}) == []
+
+
+# --------------------------------------------- JG002: end-to-end on toys
+
+def _toy_schedule_programs(reorder_b: bool):
+    """Two single-axis shard_map toys — lockstep when ``reorder_b`` is
+    False, the permute/psum order swapped in b when True (the seeded
+    divergence: hosts running them as alternates deadlock at op 1)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    n = len(jax.devices())
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def make(permute_first):
+        def body(x):
+            if permute_first:
+                x = jax.lax.ppermute(x, "data", perm)
+                x = jax.lax.psum(x, "data")
+            else:
+                x = jax.lax.psum(x, "data")
+                x = jax.lax.ppermute(x, "data", perm)
+            return x
+
+        fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("data"),
+                               out_specs=P("data")))
+        args = (jax.ShapeDtypeStruct((n,), jnp.float32),)
+        return (fn, args, {"mesh_axes": {"data": n}})
+
+    return {"toy_a": make(False), "toy_b": make(reorder_b)}
+
+
+class TestScheduleGateEndToEnd:
+    def test_pin_check_reorder_fail_loop(self, tmp_path, capsys):
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        cdir = str(tmp_path / "contracts")
+
+        # 1. pin the lockstep pair
+        rc = run_guard_cli(["update", str(clean),
+                            "--contracts-dir", cdir],
+                           programs=_toy_schedule_programs(False))
+        assert rc == 0
+        pin_path = guard.schedule_pin_path(cdir, "cpu8")
+        with open(pin_path) as f:
+            pin = json.load(f)
+        assert pin["kind"] == "schedule_set"
+        assert pin["divergent_pairs"] == []
+        assert set(pin["schedules"]) == {"toy_a", "toy_b"}
+        assert pin["schedules"]["toy_a"] == pin["schedules"]["toy_b"]
+
+        # 2. check against the pin: green
+        rc = run_guard_cli(["check", str(clean),
+                            "--contracts-dir", cdir],
+                           programs=_toy_schedule_programs(False))
+        out = capsys.readouterr()
+        assert rc == 0
+        assert "guard_schedules: ok" in out.out
+
+        # 3. seed the reorder: exactly the injected divergence, exit 1
+        rc = run_guard_cli(["check", str(clean),
+                            "--contracts-dir", cdir],
+                           programs=_toy_schedule_programs(True))
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "JG002" in out.out          # undeclared pairwise divergence
+        assert "reordered" in out.out      # per-program pin drift too
+        assert "toy_b" in out.out
+
+        # 4. a stale divergence declaration is itself a failure
+        pin["divergent_pairs"] = [["toy_a", "toy_b"]]
+        with open(pin_path, "w") as f:
+            json.dump(pin, f)
+        rc = run_guard_cli(["check", str(clean),
+                            "--contracts-dir", cdir],
+                           programs=_toy_schedule_programs(False))
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "lockstep-identical" in out.out
+
+    def test_missing_pin_is_loud(self, tmp_path, capsys):
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        rc = run_guard_cli(["check", str(clean),
+                            "--contracts-dir", str(tmp_path / "empty")],
+                           programs=_toy_schedule_programs(False))
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "no schedule pin" in out.out
+
+    def test_unknown_program_subset_exits_2(self, tmp_path, capsys):
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        rc = run_guard_cli(["check", str(clean), "--programs", "nope"],
+                           programs=_toy_schedule_programs(False))
+        assert rc == 2
+        assert "unknown program" in capsys.readouterr().err
+
+
+# ------------------------------------------------------------ CLI + gate
+
+class TestCli:
+    def test_list_prints_every_rule(self, capsys):
+        assert run_guard_cli(["list"]) == 0
+        out = capsys.readouterr().out
+        for code in list(GUARD_RULES) + ["JG000"]:
+            assert code in out
+
+    def test_clean_file_exits_zero(self, tmp_path, capsys):
+        rc = _cli_check(tmp_path, "x = 1\n", name="clean.py")
+        capsys.readouterr()
+        assert rc == 0
+
+
+class TestSelfApplication:
+    """The analyzer's own acceptance bar: the package it polices (and
+    the true positives found while building it — the trainer's
+    empty-loader raise now launders through replicated_decision) audit
+    clean."""
+
+    def test_package_guards_clean(self):
+        assert guard_paths([PKG_DIR]) == []
+
+    def test_bench_guards_clean(self):
+        assert guard_paths([os.path.join(REPO, "bench.py")]) == []
+
+
+# -------------------------------------------------- AST<->jaxpr agreement
+
+class TestDeclaredDonations:
+    def test_trace_ground_truth_matches_ast_inference(self):
+        import jax
+        import jax.numpy as jnp
+
+        from distributedpytorch_tpu.analysis.donation import (
+            declared_donations,
+        )
+
+        def step(state, batch):
+            return state + batch.sum()
+
+        args = (jax.ShapeDtypeStruct((8,), jnp.float32),
+                jax.ShapeDtypeStruct((8,), jnp.float32))
+        donating = jax.jit(step, donate_argnums=(0,))
+        plain = jax.jit(step)
+        assert declared_donations(donating, args) == 1
+        assert declared_donations(plain, args) == 0

@@ -747,3 +747,105 @@ class TestFindingFormat:
         line = found[0].format()
         assert line.startswith("pkg/mod.py:")
         assert ": JL001 " in line
+
+
+class TestStats:
+    """``jaxlint --stats``: a disable directive whose rule no longer
+    fires is a dead waiver — listed with the exact file:line and the
+    gate exits 1 (same contract the guard schedule allowlist gets from
+    ``--guard check``)."""
+
+    LIVE = """
+        import jax
+
+        @jax.jit
+        def step(batch):
+            return batch.item()  # jaxlint: disable=JL001
+    """
+    DEAD = """
+        import jax
+
+        @jax.jit
+        def step(batch):
+            return batch * 2  # jaxlint: disable=JL001
+    """
+
+    def _write(self, tmp_path, name, src):
+        p = tmp_path / name
+        p.write_text(textwrap.dedent(src))
+        return str(p)
+
+    def test_live_directive_passes(self, tmp_path, capsys):
+        path = self._write(tmp_path, "live.py", self.LIVE)
+        assert main([path]) == 0            # suppressed: lints clean
+        assert main(["--stats", path]) == 0  # and the waiver earns it
+        out = capsys.readouterr().out
+        assert "jaxlint disable=JL001 [live, 1 hit(s)]" in out
+
+    def test_dead_directive_fails_with_location(self, tmp_path, capsys):
+        path = self._write(tmp_path, "dead.py", self.DEAD)
+        assert main([path]) == 0             # nothing to report...
+        assert main(["--stats", path]) == 1  # ...which is the problem
+        cap = capsys.readouterr()
+        assert f"{path}:6: jaxlint disable=JL001 [DEAD, 0 hit(s)]" \
+            in cap.out
+        assert "dead suppression" in cap.err
+
+    def test_jaxguard_directives_are_policed_too(self, tmp_path,
+                                                 capsys):
+        live = self._write(tmp_path, "g.py", """
+            import jax
+
+            step = jax.jit(fn, donate_argnums=(0,))
+
+            def run(state, batch):
+                loss = step(state, batch)
+                return loss, state.q  # jaxguard: disable=JG003
+        """)
+        assert main(["--stats", live]) == 0
+        assert "jaxguard disable=JG003 [live" in capsys.readouterr().out
+        dead = self._write(tmp_path, "gdead.py",
+                           "x = 1  # jaxguard: disable=JG004\n")
+        assert main(["--stats", dead]) == 1
+        assert "jaxguard disable=JG004 [DEAD" in capsys.readouterr().out
+
+    def test_file_level_directive_counts_anywhere(self, tmp_path,
+                                                  capsys):
+        path = self._write(tmp_path, "filewide.py", """
+            # jaxlint: disable-file=JL007
+            import jax
+
+            @jax.jit
+            def a(x):
+                print("one")
+                return x
+
+            @jax.jit
+            def b(x):
+                print("two")
+                return x
+        """)
+        assert main(["--stats", path]) == 0
+        assert "disable-file=JL007 [live, 2 hit(s)]" \
+            in capsys.readouterr().out
+
+    def test_report_entries_are_structured(self, tmp_path):
+        from distributedpytorch_tpu.analysis import suppression_report
+
+        path = self._write(tmp_path, "live.py", self.LIVE)
+        entries = suppression_report([path])
+        assert entries == [{
+            "path": path, "line": 6, "tool": "jaxlint",
+            "code": "JL001", "kind": "disable", "hits": 1, "live": True,
+        }]
+
+    def test_checked_in_guard_allowlist_is_surfaced(self, capsys,
+                                                    tmp_path):
+        # the schedule pin's divergent_pairs are waivers too — --stats
+        # lists them next to the directives so one command shows every
+        # active exemption (their staleness is --guard check's job)
+        path = self._write(tmp_path, "clean.py", "x = 1\n")
+        assert main(["--stats", path]) == 0
+        out = capsys.readouterr().out
+        assert "allowlist divergent_pair" in out
+        assert "train_step_dp_tp|train_step_dp_zero1" in out

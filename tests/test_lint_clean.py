@@ -33,6 +33,31 @@ def test_bench_lints_clean():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+def test_package_guards_clean():
+    # the middle layer's self-application: no host-divergent collective
+    # gating, no donation aliasing, anywhere in the package (the
+    # trainer's empty-loader raise launders through replicated_decision
+    # exactly because this gate exists)
+    from distributedpytorch_tpu.analysis import guard_paths
+
+    findings = guard_paths([PKG_DIR,
+                            os.path.join(REPO_DIR, "bench.py")])
+    assert not findings, "jaxguard findings:\n" + "\n".join(
+        f.format() for f in findings)
+
+
+def test_no_dead_suppressions():
+    # every # jaxlint:/# jaxguard: waiver in the package must still be
+    # earning its keep — a dead directive swallows the next real finding
+    from distributedpytorch_tpu.analysis import suppression_report
+
+    dead = [e for e in suppression_report(
+        [PKG_DIR, os.path.join(REPO_DIR, "bench.py")]) if not e["live"]]
+    assert not dead, "\n".join(
+        f"{e['path']}:{e['line']}: dead {e['tool']} "
+        f"{e['kind']}={e['code']}" for e in dead)
+
+
 def test_module_cli_exits_zero_on_package():
     # the exact acceptance command:
     #   python -m distributedpytorch_tpu.analysis distributedpytorch_tpu/
