@@ -122,6 +122,9 @@ from distributedpytorch_tpu.train.precision import (  # noqa: E402
 from distributedpytorch_tpu.train.elastic import (  # noqa: E402
     elastic_block,
 )
+from distributedpytorch_tpu.train.continuous import (  # noqa: E402
+    flywheel_block,
+)
 from distributedpytorch_tpu.train.sentinel import (  # noqa: E402
     recovery_block,
 )
@@ -504,6 +507,11 @@ def check_regression(record: dict, history: list | None = None,
              # static run — never a baseline for one.  Null == static
              # (the default), so pre-elastic history still compares.
              and r.get("elastic") == record.get("elastic")
+             # ...and the flywheel block: a record measured while
+             # continuous mode was fitting/swapping in-process is a
+             # different regime than a static serve/train run.  Null ==
+             # flywheel off (the default), so prior history compares.
+             and r.get("flywheel") == record.get("flywheel")
              and not r.get("replayed_from_session_capture")]
     if not prior:
         return True, (f"no prior {record.get('metric')} record on "
@@ -793,6 +801,11 @@ def serve_bench():
     # present, all null — the bench's burst loop never runs Trainer.fit,
     # so there is no sentinel to roll anything back
     record["recovery"] = recovery_block()
+    # flywheel block (train/continuous.py): continuous-mode tallies —
+    # null here (the burst bench serves without a session sink), keys
+    # always present; --check-regression's same-config filter keys on
+    # it, so a flywheel-exercised record never baselines a static one
+    record["flywheel"] = flywheel_block()
     # elastic block: a train-supervision concept, null on serve records
     # — key always present (schema stability)
     record["elastic"] = elastic_block()
@@ -950,6 +963,8 @@ def serve_sessions_bench():
     record["feed"] = None  # train-side concept, null on serve records
     record["chaos"] = chaos_sites.active_scenario()
     record["recovery"] = recovery_block()  # null block; key stability
+    record["flywheel"] = flywheel_block()  # no sink in this loop; key
+    #                                        always present (see serve_bench)
     record["elastic"] = elastic_block()  # train-side concept; key present
     # precision block: the served model's compute regime; null when f32
     record["precision"] = precision_block(precision_policy(DTYPE))
@@ -1194,6 +1209,12 @@ def main() -> None:
     # supervisor_restarts / recovery_p50_s — keys always present, null
     # when the sentinel is off (this synthetic step loop never arms it)
     record["recovery"] = recovery_block()
+    # flywheel block (train/continuous.py): examples_logged / fits_run /
+    # swap tallies when continuous mode drove this process, all-null
+    # otherwise (this synthetic loop never does) — key ALWAYS present
+    # (the recovery-block convention); --check-regression's same-config
+    # filter keys on it
+    record["flywheel"] = flywheel_block()
     # elastic block (train/elastic.py): {topology_changes, replans,
     # recovery_p50_s} when an elastic supervisor re-planned the run
     # this record measures, null otherwise — key ALWAYS present (the

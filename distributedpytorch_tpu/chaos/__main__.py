@@ -21,6 +21,10 @@ invariants::
                                              # typed checksum error ->
                                              # --verify + quarantine-by-
                                              # index run completes
+    dptpu-chaos poisoned_flywheel            # NaN-poisoned session log ->
+                                             # sentinel quarantines exact
+                                             # records, canary never
+                                             # promotes, fleet serves on
     dptpu-chaos my_scenario.json
     dptpu-chaos --list
     dptpu-chaos --plan preempt_mid_epoch     # print the plan JSON (for

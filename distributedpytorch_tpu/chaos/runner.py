@@ -354,6 +354,31 @@ SCENARIOS: dict[str, dict] = {
         "invariants": ["preempted_each_wave", "exact_resume_chain",
                        "zero_lost_or_duplicated_steps_storm"],
     },
+    # The closed production loop under poison: clicks stream through a
+    # session-logging service while a nan fault at the
+    # serve/session_append seam NaN-poisons two LOGGED examples (the
+    # corrupted-annotation-pipeline failure — what the sink records,
+    # never what the client sees); the flywheel then runs its guarded
+    # incremental fit on the log — the step sentinel diverges, rolls the
+    # fit back, and its quarantine ledger names the EXACT session
+    # records (packed seek), which the flywheel quarantines durably;
+    # the held fit never swaps, so the canary never promotes and the
+    # fleet keeps serving generation 0 with zero session-visible
+    # errors.  Recovery = the poisoned cycle -> clean clicks on the old
+    # generation.
+    "poisoned_flywheel": {
+        "name": "poisoned_flywheel",
+        "mode": "flywheel",
+        "plan": {"seed": 0, "faults": [
+            {"site": "serve/session_append", "kind": "nan",
+             "at": [4, 9]}]},
+        "overrides": {"log_every_steps": 1, "debug_asserts": False,
+                      "sentinel.max_rollbacks": 3},
+        "params": {"size": 48, "clicks": 16, "max_batch": 4},
+        "invariants": ["poisoned_records_quarantined",
+                       "canary_never_promoted",
+                       "serves_old_generation_zero_errors"],
+    },
 }
 
 
@@ -1098,6 +1123,124 @@ def _run_serve_swap(sc: dict, work_dir: str) -> dict:
         "firings": plan.injected_total()}
 
 
+def _run_flywheel(sc: dict, work_dir: str) -> dict:
+    """poisoned_flywheel: serve -> session log -> guarded fit -> held
+    swap (see SCENARIOS).  The nan fault poisons what the sink LOGS,
+    never what the client sees — containment is the flywheel's burden."""
+    import jax
+    import numpy as np
+    import optax
+
+    from ..data.sessions import SessionLogDataset
+    from ..models import build_model
+    from ..parallel import create_train_state
+    from ..predict import Predictor
+    from ..serve import InferenceService
+    from ..serve.session_log import SessionLogSink
+    from ..train.continuous import Flywheel
+
+    p = dict(sc.get("params") or {})
+    size = int(p.get("size", 48))
+    n_clicks = int(p.get("clicks", 16))
+    plan = FaultPlan.from_dict(dict(sc.get("plan") or {},
+                                    name=sc["name"]))
+    model = build_model("danet", nclass=1, backbone="resnet18",
+                        output_stride=8, guidance_inject="head")
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(1e-3), (1, size, size, 4))
+    predictor = Predictor(model, state.params, state.batch_stats,
+                          resolution=(size, size), relax=10)
+    log_dir = os.path.join(work_dir, "session_log")
+    # the sink is built here (not via the service's path shorthand) so
+    # the runner can commit meta at phase boundaries deterministically
+    # instead of racing the worker's 1 Hz housekeeping flush
+    sink = SessionLogSink(log_dir, resolution=predictor.resolution,
+                          guidance=predictor.guidance,
+                          alpha=predictor.alpha, relax=predictor.relax,
+                          zero_pad=predictor.zero_pad)
+    svc = InferenceService(predictor,
+                           max_batch=int(p.get("max_batch", 4)),
+                           queue_depth=64, max_wait_s=0.0,
+                           session_log=sink)
+    svc.warmup()
+    r = np.random.RandomState(0)
+    outcomes = {"completed": 0, "failed": 0}
+
+    def click(session_id, image, pts):
+        try:
+            mask = svc.predict(image, pts, timeout=120,
+                               session_id=session_id)
+            ok = bool(np.isfinite(mask).all())
+        except Exception:  # noqa: BLE001 — any failure is the tally's
+            ok = False
+        outcomes["completed" if ok else "failed"] += 1
+
+    def spread_points(i):
+        q, m = size // 4, size // 2
+        pts = np.array([[q, m], [size - q, m], [m, q], [m, size - q]],
+                       np.float64)
+        return np.clip(pts + (i % 3), 0, size - 1)
+
+    with svc, sites.armed_plan(plan):
+        # phase 1: live traffic — each click a distinct image, so every
+        # accepted example lands in the log (dedup never trips), and
+        # the armed nan faults poison their scheduled appends
+        for i in range(n_clicks):
+            image = r.randint(0, 256, (size, size, 3)).astype(np.uint8)
+            click(f"s{i}", image, spread_points(i))
+        # the worker offers AFTER resolving each future (a sink hiccup
+        # must never fail a request), so the last click's append may
+        # still be in flight when predict() returns — drain the tally
+        # before committing meta, or the fit would train on n-1 records
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            snap = sink.snapshot()
+            if (snap["appended"] + snap["deduped"]
+                    + sum(snap["dropped"].values())) >= n_clicks:
+                break
+            time.sleep(0.02)
+        sink.flush(force=True)  # commit meta: readers trust counts only
+        outcomes_serving = dict(outcomes)
+        sink_snap = sink.snapshot()
+
+        # ground truth for the invariants: which COMMITTED records
+        # actually carry the poison (NaN crop bytes on disk)
+        ds = SessionLogDataset(log_dir)
+        poisoned = [ds.record_index(i) for i in range(len(ds))
+                    if not np.isfinite(
+                        ds.seek(i, read=True)["image"]).all()]
+
+        # phase 2: one flywheel cycle — guarded fit on the poisoned
+        # log; the sentinel must roll back and the cycle must HOLD
+        cfg = _build_cfg(dict(sc.get("overrides") or {}), work_dir)
+        fw = Flywheel(log_dir, cfg, os.path.join(work_dir, "flywheel"),
+                      service=svc, min_new_records=1, fit_epochs=1)
+        cycle = fw.poll()
+
+        # phase 3: the fleet must still be serving generation 0 —
+        # clean clicks, zero errors, no promotion ever attempted
+        t0 = time.perf_counter()
+        for i in range(3):
+            image = r.randint(0, 256, (size, size, 3)).astype(np.uint8)
+            click(f"post{i}", image, spread_points(i))
+        recovery_s = time.perf_counter() - t0
+        swap_state = svc.health()["swap"]
+        final_outcomes = dict(outcomes)
+    _observe_recovery(sc["name"], recovery_s)
+    return {"phases": {"flywheel": {
+        "outcomes_serving": outcomes_serving,
+        "outcomes": final_outcomes,
+        "submitted": n_clicks + 3,
+        "sink": sink_snap,
+        "poisoned_records": poisoned,
+        "cycle": cycle,
+        "flywheel": fw.report(),
+        "quarantine": fw.quarantine,
+        "swap_state": swap_state,
+    }}, "recovery_s": round(recovery_s, 3),
+        "firings": plan.injected_total()}
+
+
 def _run_supervise(sc: dict, work_dir: str) -> dict:
     """crash_loop / preemption_storm / elastic_membership: a REAL
     supervisor (train/supervise.Supervisor) drives chaos child
@@ -1651,6 +1794,41 @@ def _check_one(name, sc, result, phases, verdict):
                     and final == expected,
                     f"trained {trained} steps across {len(done)} waves, "
                     f"final {final} (want {expected} for both)")
+        elif name == "poisoned_records_quarantined":
+            f = phases["flywheel"]
+            poisoned = set(f["poisoned_records"])
+            quarantined = set(f["quarantine"])
+            fired = sum(n for (_s, kind), n in
+                        (result.get("firings") or {}).items()
+                        if kind == "nan")
+            verdict(name,
+                    fired > 0 and len(poisoned) == fired
+                    and poisoned <= quarantined,
+                    f"nan fired {fired}x, poisoned records "
+                    f"{sorted(poisoned)}, flywheel quarantine "
+                    f"{sorted(quarantined)} (every poisoned record must "
+                    "be named in the durable quarantine)")
+        elif name == "canary_never_promoted":
+            f = phases["flywheel"]
+            st = f["swap_state"]
+            cyc = f["cycle"]
+            verdict(name,
+                    cyc.get("action") == "held"
+                    and st["swaps"]["promoted"] == 0
+                    and st["swaps"]["rolled_back"] == 0
+                    and st["active"] == 0 and st["canary"] is None,
+                    f"cycle action={cyc.get('action')} "
+                    f"(reason={cyc.get('reason')}) swaps={st['swaps']} "
+                    f"active={st['active']} (the held fit must never "
+                    "reach the canary at all)")
+        elif name == "serves_old_generation_zero_errors":
+            f = phases["flywheel"]
+            o = f["outcomes"]
+            verdict(name,
+                    o["failed"] == 0 and o["completed"] == f["submitted"],
+                    f"outcomes={o} submitted={f['submitted']} — every "
+                    "click before, during, and after the poisoned cycle "
+                    "must complete finite on generation 0")
         elif name == "final_metrics_finite":
             import math
 
@@ -1697,11 +1875,13 @@ def run_scenario(scenario: str | dict, work_dir: str | None = None,
             result = _run_supervise(sc, work_dir)
         elif mode == "packed_fit":
             result = _run_packed_fit(sc, work_dir)
+        elif mode == "flywheel":
+            result = _run_flywheel(sc, work_dir)
         else:
             raise ValueError(
                 f"unknown scenario mode {mode!r} "
                 "(fit | fit_resume | serve | serve_swap | serve_aot | "
-                "supervise | packed_fit)")
+                "supervise | packed_fit | flywheel)")
     finally:
         if cleanup:
             import shutil

@@ -26,7 +26,13 @@ The seams are woven into the REAL code paths (not shadow copies):
   read (data/packed.py), BEFORE the crc gate: a ``bitflip`` fault here
   models bit rot / a torn read and must surface as the typed
   ``PackedRecordError`` naming the record, never a silent wrong sample
-  (the ``torn_pack`` scenario's driver).
+  (the ``torn_pack`` scenario's driver);
+* ``serve/session_append``   — the session-log sink's example boundary
+  (serve/session_log.py), before the blob is checksummed and written:
+  a ``nan`` fault here poisons the logged example exactly as a corrupt
+  client/annotation pipeline would — the float crop NaN-fills, the
+  crc then seals the poison in as VALID bytes — feeding the
+  ``poisoned_flywheel`` scenario's sentinel/canary containment chain.
 
 Disabled is the default and it is ~free: ``fire`` loads one module
 attribute, sees ``None`` and returns — no registry, no telemetry, no
@@ -63,6 +69,7 @@ SITES = (
     "serve/aot_load",
     "device/put",
     "data/packed_read",
+    "serve/session_append",
 )
 
 

@@ -206,6 +206,86 @@ def nellipse_gaussians_map(
 
 
 # ---------------------------------------------------------------------------
+# click-space guidance: the serve/replay shared seam
+# ---------------------------------------------------------------------------
+
+#: guidance families computable from the 4 clicks alone — the ones
+#: click-based inference (predict.py) and session-log replay
+#: (data/sessions.py) can serve.  Confidence maps need the gt mask and
+#: 'none' has no channel, so neither appears here.  Single source of
+#: truth: predict.py's pre-restore guards and its dispatch both read
+#: this table (re-exported there as ``_POINT_GUIDANCE``).
+POINT_GUIDANCE = {
+    # the live reference path (custom_transforms.py:45-50)
+    "nellipse_gaussians":
+        lambda shape, pts, alpha: nellipse_gaussians_map(
+            shape, pts, alpha=alpha),
+    # n-ellipse indicator scaled to [0, 255] (custom_transforms.py:9-27)
+    "nellipse":
+        lambda shape, pts, alpha: nellipse_map(shape, pts),
+    # DEXTR gaussian heatmap in [0, 1], matching the ExtremePoints
+    # transform's unscaled output (custom_transforms.py:221-251)
+    "extreme_points":
+        lambda shape, pts, alpha: extreme_points_map(shape, pts),
+}
+
+
+def guidance_from_points(
+    shape_hw: tuple[int, int], points: np.ndarray, alpha: float = 0.6,
+    family: str = "nellipse_gaussians"
+) -> np.ndarray:
+    """Crop-space guidance map from extreme points, float32.
+
+    ``family`` selects the same guidance channel a run was trained with
+    (``data.guidance`` in the config; pipeline.py:_guidance_stage),
+    computed from the clicked points instead of gt-derived ones — one of
+    ``POINT_GUIDANCE``.
+    """
+    points = np.asarray(points, np.float64)
+    try:
+        build = POINT_GUIDANCE[family]
+    except KeyError:
+        raise ValueError(
+            f"unknown guidance family: {family!r} "
+            f"({' | '.join(POINT_GUIDANCE)})") from None
+    return build(shape_hw, points, alpha)
+
+
+def scale_points_to_crop(points: np.ndarray,
+                         bbox: tuple[int, int, int, int],
+                         resolution: tuple[int, int]) -> np.ndarray:
+    """Full-image xy points into resized-crop coordinates.
+
+    The FixedResize scaling rule for point coords (reference
+    custom_transforms.py:168-173) — the ONE owner of the rule, called by
+    ``prepare_input``, ``Predictor.prepare_guidance`` (the warm-session
+    decode path) and session-log replay, so serve-time and replay-time
+    guidance can never drift by a rounding rule.
+    """
+    points = np.asarray(points, np.float64)
+    res_h, res_w = resolution
+    scale = np.array([res_w / (bbox[2] - bbox[0] + 1),
+                      res_h / (bbox[3] - bbox[1] + 1)])
+    crop_pts = (points - np.array([bbox[0], bbox[1]])) * scale
+    return np.clip(crop_pts, 0, [res_w - 1, res_h - 1])
+
+
+def crop_point_guidance(points: np.ndarray,
+                        bbox: tuple[int, int, int, int],
+                        resolution: tuple[int, int],
+                        alpha: float = 0.6,
+                        family: str = "nellipse_gaussians") -> np.ndarray:
+    """Full-image clicks + crop bbox -> the crop-space guidance channel,
+    float32 at ``resolution`` — scale + synthesize in one call.  This is
+    the bit-identity seam the flywheel's replay pins itself to: the live
+    serve path and ``SessionLogDataset`` replay both compose exactly
+    ``scale_points_to_crop`` -> ``guidance_from_points``."""
+    crop_pts = scale_points_to_crop(points, bbox, resolution)
+    return guidance_from_points(resolution, crop_pts, alpha=alpha,
+                                family=family)
+
+
+# ---------------------------------------------------------------------------
 # confidence-map family (skewed-axes weight maps)
 # ---------------------------------------------------------------------------
 

@@ -549,14 +549,19 @@ def verify_pack(path: str) -> list[int]:
 
 def resolve_packed(dataset, index: int):
     """Unwrap the loader-facing wrappers (CombinedDataset, the prepared
-    caches) around ``dataset`` to the :class:`PackedDataset` owning
-    sample ``index``; returns ``(packed, local_index)`` or ``None`` when
-    the chain bottoms out on a non-packed source.  The trainer resolves
-    quarantined batch indices through this + ``seek`` so the ledger
-    names the exact records."""
+    caches) around ``dataset`` to the packed-idiom reader owning sample
+    ``index``; returns ``(packed, local_index)`` or ``None`` when the
+    chain bottoms out on a non-packed source.  The terminal test is the
+    ACCESSOR CONTRACT (``seek`` + ``record_index``), not a class: the
+    session-log reader (``data/sessions.py``) speaks it too, so the
+    sentinel's quarantine ledger names exact session records the same
+    way it names pack records.  The trainer resolves quarantined batch
+    indices through this + ``seek``."""
     ds, local = dataset, int(index)
     for _ in range(16):  # wrappers never nest deeper; bounds a cycle
-        if isinstance(ds, PackedDataset):
+        if isinstance(ds, PackedDataset) or (
+                callable(getattr(ds, "seek", None))
+                and callable(getattr(ds, "record_index", None))):
             return ds, local
         if hasattr(ds, "datasets") and hasattr(ds, "index"):
             di, local = ds.index[local]
@@ -599,7 +604,10 @@ def _build_source(args):
 
 def _verify_cli(path: str) -> int:
     """``--verify``: re-checksum one pack dir, or every pack under a
-    root; non-zero on ANY mismatch, naming the bad record indices."""
+    root; non-zero on ANY mismatch, naming the bad record indices.
+    Session-log directories (``serve/session_log.py``; meta kind
+    'sessions') verify through their own reader with the same rc/remedy
+    conventions — one CLI audits both pack flavors."""
     if os.path.isfile(os.path.join(path, META_NAME)):
         targets = [path]
     else:
@@ -618,17 +626,23 @@ def _verify_cli(path: str) -> int:
             return 2
     rc = 0
     for t in targets:
+        from .sessions import SessionLogDataset, is_session_log
+
+        session = is_session_log(t)
         try:
-            ds = PackedDataset(t)
+            ds = SessionLogDataset(t) if session else PackedDataset(t)
             bad = ds.verify()
         except (PackFormatError, OSError) as e:
             print(f"{t}: UNREADABLE ({e})", file=sys.stderr)
             rc = 1
             continue
         if bad:
-            print(f"{t}: {len(bad)} bad record(s): {bad} — re-pack (or, "
-                  f"for the TRAIN pack only, quarantine them: "
-                  f"data.pack_quarantine={bad})",
+            remedy = (f"quarantine them: data.session_quarantine={bad} "
+                      f"(dptpu-flywheel quarantines them itself)"
+                      if session else
+                      f"re-pack (or, for the TRAIN pack only, quarantine "
+                      f"them: data.pack_quarantine={bad})")
+            print(f"{t}: {len(bad)} bad record(s): {bad} — {remedy}",
                   file=sys.stderr)
             rc = 1
         else:

@@ -1,0 +1,410 @@
+"""Session-log data plane: serve clicks read back as training records.
+
+The flywheel's storage leg (ROADMAP item 5).  Every serve session already
+*is* a labeled example — the request path computed the relax-padded crop,
+the click points, and an accepted mask — and the serve-side sink
+(``serve/session_log.py``) appends them in the packed-record idiom of
+``data/packed.py`` (FFCV, arXiv 2306.12517): pre-decoded blobs behind a
+fixed-dtype index with per-record crc32, ``meta.json`` written LAST,
+atomically.  This module owns the FORMAT (the sink imports its constants
+from here) and the read side:
+
+* :class:`SessionLogDataset` replays a log directory into training
+  batches.  ``mode="replay"`` re-synthesizes the guidance channel from
+  the stored clicks through the SAME seam the live serve path uses
+  (``data/guidance.py:crop_point_guidance``), so a replayed batch is
+  bit-identical to what the serving pipeline fed the model — pinned in
+  ``tests/test_flywheel.py``.  ``mode="sample"`` emits the VOC instance
+  sample contract (``{'image','gt','void_pixels','meta'}``) so the log
+  composes with ``CombinedDataset`` + the standard transform stack for
+  mixed VOC+session fine-tunes.
+* ``seek(i)`` / ``record_index(i)`` / ``quarantine=(...)`` speak the
+  packed accessor contract, so ``resolve_packed`` resolves through this
+  dataset and the sentinel's quarantine ledger names the EXACT session
+  records a poisoned fit rolled back over.
+* crash safety is meta-bounded: readers trust ``meta.json``'s counts
+  only, so bin/idx bytes past the last committed flush (a sink crash
+  mid-append) are invisible — no meta, no log.
+
+Importable pre-jax (numpy + stdlib only), like ``data/packed.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+import numpy as np
+
+from ..chaos import sites as chaos_sites
+from .packed import BIN_NAME, INDEX_NAME, META_NAME, PackedRecordError, \
+    PackFormatError
+
+#: bump when the session record layout / replay semantics change
+SESSION_FORMAT_VERSION = 1
+
+#: the meta.json "kind" that marks a directory as a session log — the
+#: dispatch key ``dptpu-pack --verify`` uses to pick this reader over
+#: ``PackedDataset``
+SESSION_KIND = "sessions"
+
+#: one fixed-size row per accepted example — the O(1)-seek surface.
+#: ``points`` are the FULL-IMAGE xy clicks exactly as submitted (float64:
+#: the dtype ``prepare_input`` casts to, so replay feeds the guidance
+#: seam byte-identical inputs); ``bbox`` is the relax-padded crop box
+#: those clicks established; ``digest`` is the submit thread's content
+#: digest (``serve/sessions.py:image_digest``; the sink's crc fallback
+#: for stateless requests); ``dedup`` is the sink's (digest, points)
+#: dedup key; ``warm`` flags refinement clicks that reused a cached
+#: crop.
+SESSION_INDEX_DTYPE = np.dtype([
+    ("blob_offset", np.int64),
+    ("blob_len", np.int64),
+    ("height", np.int32),       # crop rows (== log resolution)
+    ("width", np.int32),        # crop cols
+    ("shape_h", np.int32),      # full-image rows (paste-back shape)
+    ("shape_w", np.int32),
+    ("bbox", np.int64, (4,)),
+    ("points", np.float64, (4, 2)),
+    ("digest", np.uint32),
+    ("dedup", np.uint64),
+    ("gen_id", np.int32),
+    ("warm", np.uint8),
+    ("blob_crc32", np.uint32),
+])
+
+
+def blob_bytes(height: int, width: int) -> int:
+    """Byte length of one record's blob: the float32 (H, W, 3) crop +
+    the uint8 (H, W) mask, concatenated."""
+    return height * width * 3 * 4 + height * width
+
+
+def encode_blob(crop: np.ndarray, mask: np.ndarray) -> bytes:
+    """One record's blob payload.  ``crop`` is the resized float32
+    (H, W, 3) crop exactly as the serve path built it (``concat``'s RGB
+    channels); ``mask`` is the accepted uint8 (H, W) binary mask."""
+    crop = np.ascontiguousarray(crop, np.float32)
+    mask = np.ascontiguousarray(mask, np.uint8)
+    if crop.ndim != 3 or crop.shape[2] != 3 or mask.shape != crop.shape[:2]:
+        raise ValueError(
+            f"session blob wants (H, W, 3) crop + (H, W) mask, got "
+            f"{crop.shape} / {mask.shape}")
+    return crop.tobytes() + mask.tobytes()
+
+
+def dedup_key(digest: int, points: np.ndarray) -> int:
+    """uint64 content key of one (image, clicks) example: the image
+    digest in the high 32 bits, a crc32 of the float64 click bytes in
+    the low — two clicks on the same image dedup iff they are the same
+    clicks."""
+    pts = np.ascontiguousarray(np.asarray(points, np.float64))
+    return ((int(digest) & 0xFFFFFFFF) << 32) | \
+        (zlib.crc32(pts.tobytes()) & 0xFFFFFFFF)
+
+
+def session_meta(*, resolution, guidance: str, alpha: float, relax: int,
+                 zero_pad: bool, n_records: int, bin_bytes: int,
+                 index_crc32: int) -> dict:
+    """The meta.json body — one constructor so the sink and tests cannot
+    drift on the schema.  ``resolution``/``guidance``/``alpha`` pin the
+    synthesis parameters replay must reuse; ``relax``/``zero_pad`` ride
+    along so a fine-tune can mirror the serving crop geometry."""
+    h, w = resolution
+    return {
+        "format": SESSION_FORMAT_VERSION,
+        "kind": SESSION_KIND,
+        "resolution": [int(h), int(w)],
+        "guidance": str(guidance),
+        "alpha": float(alpha),
+        "relax": int(relax),
+        "zero_pad": bool(zero_pad),
+        "n_records": int(n_records),
+        "bin_bytes": int(bin_bytes),
+        "index_crc32": int(index_crc32),
+    }
+
+
+def write_meta(path: str, meta: dict) -> None:
+    """Atomic meta.json commit — tmp + ``os.replace``, the packed-plane
+    rule: a crash mid-write reads as the PREVIOUS meta (or no log),
+    never a torn verdict."""
+    meta_path = os.path.join(path, META_NAME)
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, meta_path)
+
+
+def is_session_log(path: str) -> bool:
+    """True when ``path`` holds a session log (meta kind dispatch; False
+    on missing/torn meta — the caller's format error paths own those)."""
+    try:
+        with open(os.path.join(path, META_NAME)) as f:
+            return json.load(f).get("kind") == SESSION_KIND
+    except (OSError, ValueError):
+        return False
+
+
+def corrupt_record(path: str, record: int, offset: int = 0) -> int:
+    """Flip one byte of session ``record``'s blob ON DISK — the
+    deterministic stand-in for bit rot (same contract as
+    ``packed.corrupt_record``; ``--verify`` must then flag the record).
+    Returns the absolute file offset flipped."""
+    with open(os.path.join(path, META_NAME)) as f:
+        meta = json.load(f)
+    with open(os.path.join(path, INDEX_NAME), "rb") as f:
+        raw = f.read(int(meta["n_records"]) * SESSION_INDEX_DTYPE.itemsize)
+    index = np.frombuffer(raw, SESSION_INDEX_DTYPE)
+    if not 0 <= record < len(index):
+        raise IndexError(f"record {record} out of range [0, {len(index)})")
+    row = index[record]
+    at = int(row["blob_offset"]) + (int(offset) % int(row["blob_len"]))
+    with open(os.path.join(path, BIN_NAME), "r+b") as f:
+        f.seek(at)
+        b = f.read(1)
+        f.seek(at)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return at
+
+
+class SessionLogDataset:
+    """Memory-mapped reader over a ``serve/session_log.py`` directory —
+    a random-access source for the ``DataLoader``/transform stack.
+
+    * ``mode="replay"`` (the flywheel's incremental-fit mode): each item
+      is the EXACT network input the serve path synthesized —
+      ``{'concat': (H, W, 4) f32, 'crop_gt': (H, W, 1) f32, 'meta'}`` —
+      with the guidance channel re-synthesized from the stored clicks
+      through ``data/guidance.py:crop_point_guidance``, the same call
+      ``prepare_input``/``prepare_guidance`` make.  No transform runs
+      (the crop IS the augmentation-free serving view).
+    * ``mode="sample"`` emits the VOC instance sample contract
+      (``{'image','gt','void_pixels','meta'}`` at crop geometry) and
+      runs ``transform`` over it — the mixed VOC+session fine-tune
+      source ``CombinedDataset`` composes.
+    * every record read is crc32-verified (a torn/bit-flipped record is
+      a typed :class:`PackedRecordError`, never a silent wrong sample);
+      ``quarantine=(record, ...)`` drops named records from the epoch;
+      ``seek``/``record_index`` speak the packed accessor contract, so
+      ``resolve_packed`` and the sentinel's ledger resolve through this
+      dataset unchanged.
+    """
+
+    def __init__(self, path: str, transform=None, mode: str = "replay",
+                 quarantine=()):
+        if mode not in ("replay", "sample"):
+            raise ValueError(f"mode must be 'replay' or 'sample', "
+                             f"got {mode!r}")
+        if mode == "replay" and transform is not None:
+            raise ValueError(
+                "replay mode feeds the serving pipeline's exact inputs — "
+                "a transform would break the bit-identity contract; use "
+                "mode='sample' for augmented fine-tunes")
+        self.path = path
+        self.mode = mode
+        self.transform = transform
+        meta_path = os.path.join(path, META_NAME)
+        if not os.path.isfile(meta_path):
+            raise PackFormatError(
+                f"no session log at {path} ({META_NAME} missing) — enable "
+                "the sink with dptpu-serve --session-log")
+        try:
+            with open(meta_path) as f:
+                self.meta = json.load(f)
+        except ValueError as e:
+            raise PackFormatError(
+                f"{path}/{META_NAME} is unreadable ({e}) — torn or "
+                "partially copied session log") from e
+        if self.meta.get("kind") != SESSION_KIND:
+            raise PackFormatError(
+                f"{path} is a {self.meta.get('kind')!r} pack, not a "
+                f"session log — open it with PackedDataset")
+        if self.meta.get("format") != SESSION_FORMAT_VERSION:
+            raise PackFormatError(
+                f"{path} has session-log format {self.meta.get('format')}; "
+                f"this reader speaks {SESSION_FORMAT_VERSION}")
+        self.resolution = tuple(int(x) for x in self.meta["resolution"])
+        self.guidance = str(self.meta["guidance"])
+        self.alpha = float(self.meta["alpha"])
+        n = int(self.meta["n_records"])
+        # meta-bounded reads: the sink appends bin/idx first and commits
+        # meta LAST, so bytes past meta's counts are an uncommitted tail
+        # (crash mid-append) — sliced off here, never trusted
+        with open(os.path.join(path, INDEX_NAME), "rb") as f:
+            raw = f.read(n * SESSION_INDEX_DTYPE.itemsize)
+        if len(raw) != n * SESSION_INDEX_DTYPE.itemsize:
+            raise PackFormatError(
+                f"{path}/{INDEX_NAME} holds fewer rows than meta's "
+                f"{n} — torn log")
+        if (zlib.crc32(raw) & 0xFFFFFFFF) != int(self.meta["index_crc32"]):
+            raise PackFormatError(
+                f"{path}/{INDEX_NAME} fails its checksum — the index is "
+                f"torn")
+        self._index = np.frombuffer(raw, SESSION_INDEX_DTYPE)
+        if os.path.getsize(os.path.join(path, BIN_NAME)) \
+                < int(self.meta["bin_bytes"]):
+            raise PackFormatError(
+                f"{path}/{BIN_NAME} is shorter than meta's "
+                f"{self.meta['bin_bytes']} bytes — truncated log")
+        q = sorted({int(i) for i in quarantine})
+        bad = [i for i in q if not 0 <= i < n]
+        if bad:
+            raise ValueError(
+                f"session_quarantine indices {bad} out of range [0, {n}) "
+                f"for {path}")
+        self.quarantine = tuple(q)
+        self._live = (np.setdiff1d(np.arange(n), np.asarray(q, np.int64))
+                      if q else np.arange(n))
+        self._open_bin()
+
+    def _open_bin(self) -> None:
+        bin_path = os.path.join(self.path, BIN_NAME)
+        # a just-created sink commits an EMPTY log ("sink on, no examples
+        # yet"); mmap refuses zero-byte files, and there is nothing to map
+        if os.path.getsize(bin_path) == 0:
+            self._bin = np.empty(0, np.uint8)
+            return
+        self._bin = np.memmap(bin_path, mode="r", dtype=np.uint8)
+
+    # mmap handles don't pickle; the files are the shared state (the
+    # packed idiom — grain process workers reopen)
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_bin")
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._open_bin()
+
+    # ------------------------------------------------------- protocol
+    def __len__(self) -> int:
+        return len(self._live)
+
+    def record_index(self, index: int) -> int:
+        """RAW record id behind dataset position ``index`` (positions
+        shift when a quarantine drops records; record ids never do)."""
+        return int(self._live[index])
+
+    def sample_image_id(self, index: int) -> str:
+        """Per-record synthetic image id — the CombinedDataset
+        exclusion/dedup key.  The ``session-`` prefix can never collide
+        with a VOC/SBD id, so mixed fine-tunes are exclusion-safe."""
+        rec = self.record_index(index)
+        row = self._index[rec]
+        return f"session-{int(row['digest']):08x}-{rec}"
+
+    def seek(self, index: int, read: bool = False) -> dict:
+        """O(1) record lookup for dataset position ``index`` — the
+        packed accessor contract (``record``/``image_id``/``object``
+        keys the sentinel's quarantine ledger resolves through), plus
+        the session fields (``points``/``bbox``/``shape``/``gen_id``).
+        ``read=True`` adds the verified payload (``image``: the float32
+        crop, ``mask``: the uint8 accepted mask)."""
+        rec = self.record_index(index)
+        row = self._index[rec]
+        out = {
+            "record": rec,
+            "image_id": f"session-{int(row['digest']):08x}-{rec}",
+            "object": "0",
+            "category": None,
+            "im_size": (int(row["height"]), int(row["width"])),
+            "points": np.array(row["points"]),
+            "bbox": tuple(int(x) for x in row["bbox"]),
+            "shape": (int(row["shape_h"]), int(row["shape_w"])),
+            "gen_id": int(row["gen_id"]),
+            "warm": bool(row["warm"]),
+        }
+        if read:
+            crop, mask = self._read_blob(rec)
+            out["image"] = crop.copy()
+            out["mask"] = mask.copy()
+        return out
+
+    def _read_blob(self, rec: int) -> tuple[np.ndarray, np.ndarray]:
+        """The verified read: one mmap view, the chaos seam, the crc32
+        gate, then zero-copy views (consumers copy before mutating —
+        the ``data/packed.py`` reading discipline)."""
+        row = self._index[rec]
+        off, ln = int(row["blob_offset"]), int(row["blob_len"])
+        if off < 0 or off + ln > self._bin.size:
+            raise PackedRecordError(
+                rec, self.path,
+                f"blob extent [{off}, {off + ln}) past the "
+                f"{self._bin.size}-byte bin file")
+        buf = self._bin[off:off + ln]
+        buf = chaos_sites.fire("data/packed_read", payload=buf,
+                               index=rec, path=self.path)
+        if (zlib.crc32(buf) & 0xFFFFFFFF) != int(row["blob_crc32"]):
+            raise PackedRecordError(rec, self.path, "checksum mismatch")
+        h, w = int(row["height"]), int(row["width"])
+        crop = buf[:h * w * 12].view(np.float32).reshape(h, w, 3)
+        mask = buf[h * w * 12:].reshape(h, w)
+        return crop, mask
+
+    def __getitem__(self, index: int,
+                    rng: np.random.Generator | None = None) -> dict:
+        rec = self.record_index(int(index))
+        row = self._index[rec]
+        crop, mask = self._read_blob(rec)
+        h, w = int(row["height"]), int(row["width"])
+        meta = {
+            "image": f"session-{int(row['digest']):08x}-{rec}",
+            "object": "0",
+            "category": 0,
+            "im_size": (h, w),
+        }
+        if self.mode == "replay":
+            # the live serve path's exact arithmetic (predict.py
+            # prepare_input tail), through the shared guidance seam —
+            # bit-identity is by construction, pinned by test
+            heat = _crop_point_guidance(
+                np.array(row["points"]),
+                tuple(int(x) for x in row["bbox"]),
+                (h, w), self.alpha, self.guidance)
+            concat = np.concatenate(
+                [np.clip(crop, 0.0, 255.0), heat[..., None]], axis=-1)
+            return {"concat": concat.astype(np.float32),
+                    "crop_gt": mask.astype(np.float32)[..., None],
+                    "meta": meta}
+        sample = {"image": crop.astype(np.float32),
+                  "gt": mask.astype(np.float32),
+                  "void_pixels": np.zeros((h, w), np.float32),
+                  "meta": meta}
+        if self.transform is not None:
+            sample = self.transform(sample, rng)
+        return sample
+
+    def verify(self) -> list[int]:
+        """Re-checksum EVERY record (quarantined included); returns the
+        raw indices that fail — the ``dptpu-pack --verify`` engine,
+        session flavor."""
+        bad = []
+        for rec in range(len(self._index)):
+            try:
+                self._read_blob(rec)
+            except PackedRecordError:
+                bad.append(rec)
+        return bad
+
+    def __str__(self) -> str:
+        m = self.meta
+        return (f"SessionLog({self.path},n={m['n_records']},"
+                f"res={m['resolution']},idx={int(m['index_crc32']):08x})")
+
+
+def _crop_point_guidance(points, bbox, resolution, alpha, family):
+    """Deferred import of the guidance seam: keeps this module's import
+    cost at numpy + stdlib (the packed-plane rule) while replay still
+    goes through the ONE shared synthesis path."""
+    from . import guidance
+
+    return guidance.crop_point_guidance(points, bbox, resolution,
+                                        alpha=alpha, family=family)
+
+
+def verify_session_log(path: str) -> list[int]:
+    """Raw record indices of ``path`` that fail verification."""
+    return SessionLogDataset(path).verify()

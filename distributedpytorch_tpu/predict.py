@@ -44,46 +44,19 @@ from .utils.helpers import crop2fullmask, crop_from_bbox, get_bbox
 
 #: guidance families computable from the 4 clicks alone — the ones
 #: click-based inference can serve (confidence maps need the gt mask,
-#: 'none' has no channel).  Single source of truth: the pre-restore guards
-#: in ``Predictor.from_run``/``from_torch`` AND ``guidance_from_points``'
-#: dispatch both read this table, so a family cannot be accepted at
-#: construction yet unknown at predict time.
-_POINT_GUIDANCE = {
-    # the live reference path (custom_transforms.py:45-50); owned by
-    # guidance.nellipse_gaussians_map so training and inference share one
-    # implementation
-    "nellipse_gaussians":
-        lambda shape, pts, alpha: guidance_lib.nellipse_gaussians_map(
-            shape, pts, alpha=alpha),
-    # n-ellipse indicator scaled to [0, 255] (custom_transforms.py:9-27)
-    "nellipse":
-        lambda shape, pts, alpha: guidance_lib.nellipse_map(shape, pts),
-    # DEXTR gaussian heatmap in [0, 1], matching the ExtremePoints
-    # transform's unscaled output (custom_transforms.py:221-251)
-    "extreme_points":
-        lambda shape, pts, alpha: guidance_lib.extreme_points_map(shape, pts),
-}
+#: 'none' has no channel).  Single source of truth lives in
+#: data/guidance.py (``POINT_GUIDANCE``), shared with session-log replay
+#: (data/sessions.py) so serve-time and replay-time guidance are one
+#: implementation; the pre-restore guards in ``Predictor.from_run``/
+#: ``from_torch`` AND ``guidance_from_points``' dispatch both read it,
+#: so a family cannot be accepted at construction yet unknown at
+#: predict time.
+_POINT_GUIDANCE = guidance_lib.POINT_GUIDANCE
 
-
-def guidance_from_points(
-    shape_hw: tuple[int, int], points: np.ndarray, alpha: float = 0.6,
-    family: str = "nellipse_gaussians"
-) -> np.ndarray:
-    """Crop-space guidance map from extreme points, float32.
-
-    ``family`` selects the same guidance channel the run was trained with
-    (``data.guidance`` in the config; pipeline.py:_guidance_stage), computed
-    from the clicked points instead of gt-derived ones — one of
-    ``_POINT_GUIDANCE``.
-    """
-    points = np.asarray(points, np.float64)
-    try:
-        build = _POINT_GUIDANCE[family]
-    except KeyError:
-        raise ValueError(
-            f"unknown guidance family: {family!r} "
-            f"({' | '.join(_POINT_GUIDANCE)})") from None
-    return build(shape_hw, points, alpha)
+#: re-export: the dispatch moved to data/guidance.py (numpy-only, so the
+#: flywheel's replay reader can use it without importing jax); the public
+#: name here is unchanged.
+guidance_from_points = guidance_lib.guidance_from_points
 
 
 def prepare_input(
@@ -122,14 +95,11 @@ def prepare_input(
     crop = crop_from_bbox(image, bbox, zero_pad=zero_pad)
     res_h, res_w = resolution
     crop = imaging.resize(crop, (res_h, res_w), imaging.CUBIC)
-    # Points into resized-crop coordinates (the FixedResize scaling rule for
-    # point coords, reference custom_transforms.py:168-173).
-    scale = np.array([res_w / (bbox[2] - bbox[0] + 1),
-                      res_h / (bbox[3] - bbox[1] + 1)])
-    crop_pts = (points - np.array([bbox[0], bbox[1]])) * scale
-    crop_pts = np.clip(crop_pts, 0, [res_w - 1, res_h - 1])
-    heat = guidance_from_points((res_h, res_w), crop_pts, alpha=alpha,
-                                family=guidance)
+    # Points into resized-crop coordinates + guidance synthesis, through
+    # the shared seam (data/guidance.py:crop_point_guidance) — the same
+    # call session-log replay makes, pinning bit-identity.
+    heat = guidance_lib.crop_point_guidance(
+        points, bbox, (res_h, res_w), alpha=alpha, family=guidance)
     concat = np.concatenate(
         [np.clip(crop, 0.0, 255.0), heat[..., None]], axis=-1)
     return concat.astype(np.float32), bbox
@@ -496,13 +466,9 @@ class Predictor:
         if points.shape != (4, 2):
             raise ValueError(f"expected 4 xy extreme points, got "
                              f"{points.shape}")
-        res_h, res_w = self.resolution
-        scale = np.array([res_w / (bbox[2] - bbox[0] + 1),
-                          res_h / (bbox[3] - bbox[1] + 1)])
-        crop_pts = (points - np.array([bbox[0], bbox[1]])) * scale
-        crop_pts = np.clip(crop_pts, 0, [res_w - 1, res_h - 1])
-        heat = guidance_from_points((res_h, res_w), crop_pts,
-                                    alpha=self.alpha, family=self.guidance)
+        heat = guidance_lib.crop_point_guidance(
+            points, bbox, self.resolution, alpha=self.alpha,
+            family=self.guidance)
         return heat.astype(np.float32)[..., None]
 
     @classmethod

@@ -292,6 +292,13 @@ def main(argv: list[str] | None = None) -> int:
                         help="where POST /debug/trace and SIGUSR2 write "
                              "bounded XPlane captures (default: "
                              "<run-dir>/serve_trace, or ./serve_trace)")
+    parser.add_argument("--session-log", default=None, metavar="DIR",
+                        help="opt-in flywheel sink: append accepted "
+                             "(crop, clicks, mask) examples as packed "
+                             "records under DIR (crash-safe, deduped, "
+                             "budgeted) — the log dptpu-flywheel fine-"
+                             "tunes from (docs/DESIGN.md 'The click "
+                             "flywheel')")
     args = parser.parse_args(argv)
 
     from ..telemetry import TraceCapture
@@ -308,6 +315,7 @@ def main(argv: list[str] | None = None) -> int:
         session_ttl_s=args.session_ttl_s,
         session_lane_depth=args.session_lane_depth,
         aot_cache=args.aot_cache,
+        session_log=args.session_log,
         trace=trace)
     if args.warmup:
         # service.warmup (not bare warmup_buckets): it also registers the

@@ -140,7 +140,9 @@ class _Request:
     session_id: str | None = None
     store_session: bool = False           # full: cache features after encode
     gen_id: int = 0                       # params generation (swap routing)
-    digest: int = 0                       # full+store: image fingerprint
+    digest: int = 0                       # session: image fingerprint
+    points: np.ndarray | None = None      # full-image xy clicks (4, 2) —
+                                          # the session-log sink's record
 
 
 class InferenceService:
@@ -171,7 +173,8 @@ class InferenceService:
                  session_budget_bytes: int = 256 << 20,
                  session_ttl_s: float = 600.0,
                  session_lane_depth: int = 4,
-                 aot_cache=None):
+                 aot_cache=None,
+                 session_log=None):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         if max_wait_s < 0:
@@ -216,6 +219,17 @@ class InferenceService:
         from .swap import PredictorPool
 
         self._pool = PredictorPool(predictor)
+        #: opt-in flywheel sink (serve/session_log.py): a log directory
+        #: path or a SessionLogSink; None keeps the request path exactly
+        #: as before (one attribute check per dispatch)
+        if isinstance(session_log, str):
+            from .session_log import SessionLogSink
+
+            session_log = SessionLogSink(
+                session_log, resolution=predictor.resolution,
+                guidance=predictor.guidance, alpha=predictor.alpha,
+                relax=predictor.relax, zero_pad=predictor.zero_pad)
+        self._sink = session_log
         #: per-session queued-request counts (the fairness lane)
         self._lane_lock = threading.Lock()
         self._lanes: dict[str, int] = {}
@@ -264,6 +278,10 @@ class InferenceService:
         if self._worker is not None:
             self._worker.join()
             self._worker = None
+        if self._sink is not None:
+            # the worker is down: commit the log's final meta so every
+            # example it appended is readable
+            self._sink.flush()
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -396,10 +414,13 @@ class InferenceService:
                 # race) degrades to the cold path below.
                 self._store.hit()
                 guidance = pred.prepare_guidance(points, sess.bbox)
+                # digest rides the completed request: the sink dedups off
+                # the submit thread's hash, re-hashing never
                 return _Request(kind="decode", guidance=guidance,
                                 session=sess, session_id=session_id,
                                 bbox=sess.bbox, shape_hw=sess.shape_hw,
-                                gen_id=sess.generation, future=Future(),
+                                gen_id=sess.generation, digest=digest,
+                                points=pts, future=Future(),
                                 submitted=now, deadline=deadline)
             # cold click (new session, TTL-expired, clicks outside the
             # cached crop, or a different image under a reused id):
@@ -410,14 +431,15 @@ class InferenceService:
             return _Request(kind="full", concat=concat, bbox=bbox,
                             shape_hw=shape_hw, session_id=session_id,
                             store_session=True, gen_id=gen_id,
-                            digest=digest,
+                            digest=digest, points=pts,
                             future=Future(), submitted=now,
                             deadline=deadline)
         gen_id, pred = self._pool.route(None)
         concat, bbox = pred.prepare(image, points)
         return _Request(kind="full", concat=concat, bbox=bbox,
-                        shape_hw=shape_hw, gen_id=gen_id, future=Future(),
-                        submitted=now, deadline=deadline)
+                        shape_hw=shape_hw, gen_id=gen_id,
+                        points=np.asarray(points, np.float64),
+                        future=Future(), submitted=now, deadline=deadline)
 
     def _check_session_lane(self, session_id: str) -> None:
         """Per-session fairness fast path: cap how many of the bounded
@@ -709,6 +731,10 @@ class InferenceService:
             "sessions": (self._store.snapshot()
                          if self._store is not None else None),
             "swap": self._pool.snapshot(),
+            # flywheel intake (serve/session_log.py); None when the sink
+            # is off — the always-present-key convention
+            "session_log": (self._sink.snapshot()
+                            if self._sink is not None else None),
         }
         return out
 
@@ -798,6 +824,11 @@ class InferenceService:
                     # that hot-swaps still needs its old generations'
                     # params freed once they drain.
                     last_sweep = now
+                    if self._sink is not None:
+                        # commit the session log's meta at the same 1 Hz
+                        # cadence: appends stay buffered between ticks,
+                        # so the hot path never pays the atomic-replace
+                        self._sink.flush()
                     if self._store is not None:
                         self._store.sweep()
                     freed = self._pool.gc(
@@ -899,6 +930,12 @@ class InferenceService:
             for i, req in enumerate(live):
                 req.future.set_result(self.predictor.paste_back(
                     probs[i], req.bbox, req.shape_hw))
+            if self._sink is not None:
+                # flywheel capture, AFTER the futures resolved: the
+                # example is the (crop, clicks, mask) the client just
+                # accepted, and a sink hiccup must never fail a request
+                for i, req in enumerate(live):
+                    self._sink.offer(req, probs[i])
             self.metrics.observe_batch(bucket, len(live))
             self.metrics.count("completed", len(live))
             done = time.perf_counter()

@@ -1,0 +1,305 @@
+"""Session-log sink: the serve worker's crash-safe example recorder.
+
+The flywheel's capture leg (ROADMAP item 5): the request path already
+computed everything a training example needs — the relax-padded resized
+crop (``concat``'s RGB channels), the click points, the content digest
+(``serve/sessions.py:image_digest``, hashed once on the submit thread),
+and the mask the user accepted — so logging one is a memcpy, not a
+pipeline.  Records land in the packed idiom ``data/sessions.py`` owns
+(blob + fixed-dtype index row + crc32), with ``meta.json`` committed
+atomically LAST on each flush: readers trust meta's counts only, so a
+sink crash mid-append is an invisible tail, never a torn record.
+
+Worker-thread discipline (the reason this module is numpy + stdlib
+only): ``offer`` runs on the serve worker between dispatches, so it must
+never touch a device, block on I/O syncs, or re-hash pixels — appends
+are buffered writes under one lock, dedup is an integer-set lookup off
+the digests the submit thread already paid for, and ``flush`` (the meta
+commit) rides the worker's existing 1 Hz housekeeping tick.
+
+Budget + dedup outcomes book as the
+``serve_session_log_{appended,deduped,dropped}_total`` counter family on
+the process registry (dropped carries ``reason=budget|no_crop``), so
+``/metrics`` and ``health()`` expose the flywheel's intake rate.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import zlib
+
+import numpy as np
+
+from ..chaos import sites as chaos_sites
+from ..data.packed import BIN_NAME, INDEX_NAME, META_NAME
+from ..data.sessions import SESSION_INDEX_DTYPE, dedup_key, encode_blob, \
+    session_meta, write_meta
+from ..telemetry.registry import MetricsRegistry, get_registry
+
+#: dropped-counter reasons: over the byte/record budget, or a warm
+#: (refinement) click whose cold crop already left the LRU
+DROP_REASONS = ("budget", "no_crop")
+
+#: cold crops kept for warm-click appends (session_id -> crop); sized so
+#: a burst of interleaved sessions doesn't thrash, small enough that the
+#: sink's host-memory cost stays invisible next to the batcher's
+_CROP_CACHE = 64
+
+
+class SessionLogSink:
+    """Append-only packed-idiom writer for accepted (crop, clicks, mask)
+    examples.
+
+    * ``offer(req, prob)`` — the worker-path entry: derives the example
+      from a completed request (cold requests carry the crop in
+      ``req.concat``; warm ones resolve it from a small LRU the cold
+      append populated) and appends it.
+    * ``append(...)`` — the direct form tests and tools call.
+    * dedup by ``(image digest, click bytes)`` — the submit thread's
+      digest, re-hashed never; stateless requests (digest 0) fall back
+      to a crc32 of the crop bytes.
+    * ``flush()`` commits meta atomically (tmp + ``os.replace``); until
+      then new records are an uncommitted tail readers ignore.
+    * reopening an existing log resumes it: the committed prefix is
+      kept, its dedup keys reloaded, any uncommitted tail truncated.
+    """
+
+    def __init__(self, path: str, *, resolution, guidance: str,
+                 alpha: float, relax: int, zero_pad: bool,
+                 max_bytes: int = 512 << 20, max_records: int = 100_000,
+                 registry: MetricsRegistry | None = None):
+        self.path = path
+        self.resolution = (int(resolution[0]), int(resolution[1]))
+        self.guidance = str(guidance)
+        self.alpha = float(alpha)
+        self.relax = int(relax)
+        self.zero_pad = bool(zero_pad)
+        self.max_bytes = int(max_bytes)
+        self.max_records = int(max_records)
+        self._lock = threading.Lock()
+        self._crops: collections.OrderedDict[str, np.ndarray] = \
+            collections.OrderedDict()
+        self._dedup: set[int] = set()
+        self._appended = 0
+        self._deduped = 0
+        self._dropped = {r: 0 for r in DROP_REASONS}
+        self._dirty = False
+        reg = registry or get_registry()
+        self._c_appended = reg.counter(
+            "serve_session_log_appended_total",
+            "session examples appended to the flywheel log")
+        self._c_deduped = reg.counter(
+            "serve_session_log_deduped_total",
+            "session examples skipped as content duplicates")
+        self._c_dropped = {
+            reason: reg.counter(
+                "serve_session_log_dropped_total",
+                "session examples dropped un-logged",
+                labels={"reason": reason})
+            for reason in DROP_REASONS}
+        os.makedirs(path, exist_ok=True)
+        self._resume_or_init()
+
+    # ------------------------------------------------------------ lifecycle
+    def _resume_or_init(self) -> None:
+        """Open the bin/idx handles.  An existing meta.json resumes the
+        committed log (parameters must match — a sink writing a
+        different geometry into an old log would poison replay);
+        anything past meta's counts (or with no meta at all) is an
+        uncommitted tail, truncated away."""
+        import json
+
+        meta_path = os.path.join(self.path, META_NAME)
+        n, bin_bytes_committed = 0, 0
+        if os.path.isfile(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            expect = session_meta(
+                resolution=self.resolution, guidance=self.guidance,
+                alpha=self.alpha, relax=self.relax, zero_pad=self.zero_pad,
+                n_records=meta.get("n_records", 0),
+                bin_bytes=meta.get("bin_bytes", 0),
+                index_crc32=meta.get("index_crc32", 0))
+            drift = {k for k in ("format", "kind", "resolution",
+                                 "guidance", "alpha", "relax", "zero_pad")
+                     if meta.get(k) != expect[k]}
+            if drift:
+                raise ValueError(
+                    f"session log at {self.path} was written with "
+                    f"different parameters ({sorted(drift)}) — point "
+                    "--session-log at a fresh directory")
+            n = int(meta["n_records"])
+            bin_bytes_committed = int(meta["bin_bytes"])
+        idx_path = os.path.join(self.path, INDEX_NAME)
+        bin_path = os.path.join(self.path, BIN_NAME)
+        committed = b""
+        if n and os.path.isfile(idx_path):
+            with open(idx_path, "rb") as f:
+                committed = f.read(n * SESSION_INDEX_DTYPE.itemsize)
+            rows = np.frombuffer(committed, SESSION_INDEX_DTYPE)
+            self._dedup = {int(r["dedup"]) for r in rows}
+        # truncate-to-committed, then append from there
+        with open(idx_path, "wb") as f:
+            f.write(committed)
+        with open(bin_path, "ab") as f:
+            f.truncate(bin_bytes_committed)
+        self._idx = open(idx_path, "ab")
+        self._bin = open(bin_path, "ab")
+        self._n_records = n
+        self._bin_bytes = bin_bytes_committed
+        self._index_crc = (zlib.crc32(committed) & 0xFFFFFFFF
+                           if committed else 0)
+        if n == 0:
+            # commit the empty log now: "sink on, no examples yet" must
+            # read as a valid (empty) log, not as no-log
+            self.flush(force=True)
+
+    def flush(self, force: bool = False) -> None:
+        """Commit everything appended so far: flush the data handles,
+        then write meta atomically LAST — the ordering that makes every
+        reader's view a prefix of committed records."""
+        with self._lock:
+            if not self._dirty and not force:
+                return
+            self._bin.flush()
+            self._idx.flush()
+            write_meta(self.path, session_meta(
+                resolution=self.resolution, guidance=self.guidance,
+                alpha=self.alpha, relax=self.relax,
+                zero_pad=self.zero_pad, n_records=self._n_records,
+                bin_bytes=self._bin_bytes, index_crc32=self._index_crc))
+            self._dirty = False
+
+    def close(self) -> None:
+        self.flush()
+        with self._lock:
+            self._bin.close()
+            self._idx.close()
+
+    # ------------------------------------------------------------- appending
+    def offer(self, req, prob: np.ndarray) -> str:
+        """Log one completed request; returns the outcome
+        (``appended`` | ``deduped`` | ``dropped``).  ``req`` is the
+        service's ``_Request`` (duck-typed: ``concat``/``points``/
+        ``bbox``/``shape_hw``/``digest``/``gen_id``/``session_id``/
+        ``store_session``); ``prob`` is the crop-space probability map
+        the dispatch just produced.  Never raises into the worker: any
+        example it cannot derive is a counted drop."""
+        if req.points is None or req.bbox is None:
+            return self._drop("no_crop")
+        warm = req.concat is None
+        if warm:
+            with self._lock:
+                crop = self._crops.get(req.session_id)
+            if crop is None:
+                # the cold crop aged out of the LRU — a warm click
+                # alone cannot reconstruct pixels
+                return self._drop("no_crop")
+        else:
+            crop = np.ascontiguousarray(req.concat[..., :3], np.float32)
+            if req.store_session and req.session_id:
+                with self._lock:
+                    self._crops[req.session_id] = crop
+                    self._crops.move_to_end(req.session_id)
+                    while len(self._crops) > _CROP_CACHE:
+                        self._crops.popitem(last=False)
+        # chaos seam: a ``nan`` fault here poisons the example exactly as
+        # a corrupted client/annotation pipeline would — float leaves
+        # (the crop) NaN-fill, the uint8 mask passes through — feeding
+        # the poisoned_flywheel scenario's containment chain
+        payload = chaos_sites.fire(
+            "serve/session_append",
+            payload={"crop": crop, "prob": np.asarray(prob)},
+            session_id=req.session_id)
+        crop, prob = payload["crop"], payload["prob"]
+        mask = (np.asarray(prob) >= 0.5).astype(np.uint8)
+        return self.append(
+            crop=crop, mask=mask, points=np.asarray(req.points, np.float64),
+            bbox=req.bbox, shape_hw=req.shape_hw, digest=int(req.digest),
+            gen_id=int(req.gen_id or 0), warm=warm)
+
+    def append(self, *, crop, mask, points, bbox, shape_hw, digest: int = 0,
+               gen_id: int = 0, warm: bool = False) -> str:
+        """The core append: dedup -> budget -> blob + index row.
+        Returns the outcome string (see :meth:`offer`)."""
+        crop = np.ascontiguousarray(crop, np.float32)
+        mask = np.ascontiguousarray(mask, np.uint8)
+        h, w = crop.shape[:2]
+        if (h, w) != self.resolution:
+            # geometry drift (a swap cannot change resolution by
+            # construction, but a direct caller could): never log a
+            # record replay couldn't feed the model
+            return self._drop("no_crop")
+        if digest == 0:
+            # stateless request: no submit-thread digest — fingerprint
+            # the crop bytes themselves (once, here; never per-click on
+            # the session path)
+            digest = zlib.crc32(crop.tobytes()) & 0xFFFFFFFF
+            digest = digest or 1  # 0 is the "absent" sentinel
+        key = dedup_key(digest, points)
+        blob = encode_blob(crop, mask)
+        with self._lock:
+            if key in self._dedup:
+                self._deduped += 1
+                self._c_deduped.inc()
+                return "deduped"
+            if (self._n_records + 1 > self.max_records
+                    or self._bin_bytes + len(blob) > self.max_bytes):
+                self._dropped["budget"] += 1
+                self._c_dropped["budget"].inc()
+                return "dropped"
+            row = np.zeros(1, SESSION_INDEX_DTYPE)[0]
+            row["blob_offset"] = self._bin_bytes
+            row["blob_len"] = len(blob)
+            row["height"], row["width"] = h, w
+            row["shape_h"], row["shape_w"] = int(shape_hw[0]), int(shape_hw[1])
+            row["bbox"] = np.asarray(bbox, np.int64)
+            row["points"] = np.asarray(points, np.float64)
+            row["digest"] = digest
+            row["dedup"] = key
+            row["gen_id"] = gen_id
+            row["warm"] = int(bool(warm))
+            row["blob_crc32"] = zlib.crc32(blob) & 0xFFFFFFFF
+            row_bytes = row.tobytes()
+            self._bin.write(blob)
+            self._idx.write(row_bytes)
+            self._bin_bytes += len(blob)
+            self._n_records += 1
+            # incremental index crc: append-only, so the running crc of
+            # the committed+pending prefix is exact
+            self._index_crc = zlib.crc32(row_bytes, self._index_crc) \
+                & 0xFFFFFFFF
+            self._dedup.add(key)
+            self._appended += 1
+            self._c_appended.inc()
+            self._dirty = True
+            return "appended"
+
+    def _drop(self, reason: str) -> str:
+        with self._lock:
+            self._dropped[reason] += 1
+        self._c_dropped[reason].inc()
+        return "dropped"
+
+    # ------------------------------------------------------------ inspection
+    def snapshot(self) -> dict:
+        """The health()/bench view: committed log size + THIS sink's
+        outcome tallies (instance-local, the ServeMetrics delta
+        convention — the registry keeps process-lifetime totals)."""
+        with self._lock:
+            return {
+                "path": self.path,
+                "records": self._n_records,
+                "bytes": self._bin_bytes,
+                "appended": self._appended,
+                "deduped": self._deduped,
+                "dropped": dict(self._dropped),
+                "max_bytes": self.max_bytes,
+                "max_records": self.max_records,
+            }
+
+    def __str__(self) -> str:
+        return (f"SessionLogSink({self.path},n={self._n_records},"
+                f"bytes={self._bin_bytes})")

@@ -47,6 +47,20 @@ class DataConfig:
                                         # pack's epoch (the recovery
                                         # move for records `dptpu-pack
                                         # --verify` flagged as torn)
+    session_log: str = ""               # flywheel: a serve session-log
+                                        # directory (serve/session_log)
+                                        # mixed into training via
+                                        # data/sessions.SessionLogDataset
+    session_only: bool = False          # flywheel: train on the session
+                                        # log ALONE in replay mode (the
+                                        # exact serving inputs, no
+                                        # augmentation) — the continuous
+                                        # mode's incremental fits
+    session_quarantine: tuple[int, ...] = ()
+                                        # RAW session record ids dropped
+                                        # from the log's epoch (poisoned
+                                        # examples the sentinel ledger /
+                                        # dptpu-pack --verify named)
     root: str = ""                      # dataset root (was: the mypath module)
     sbd_root: str = ""                  # set: merge SBD into training via
                                         # CombinedDataset, excluding the
@@ -598,8 +612,8 @@ def _from_dict(cls, d: dict):
             v = _from_dict(ftype, v)
         elif f.name in ("crop_size", "rots", "scales", "loss_weights",
                         "eval_thresholds", "eval_tta_scales",
-                        "freeze", "val_max_im_size",
-                        "pack_quarantine") and isinstance(v, list):
+                        "freeze", "val_max_im_size", "pack_quarantine",
+                        "session_quarantine") and isinstance(v, list):
             v = tuple(v)
         kwargs[f.name] = v
     return cls(**kwargs)

@@ -1,0 +1,416 @@
+"""Continuous (flywheel) training: session logs -> short fits -> canaried swap.
+
+The production loop's last edge.  ``dptpu-serve --session-log`` appends
+every accepted interaction to a crash-safe packed log (serve/session_log);
+this module watches that log and closes the loop:
+
+1. **Watch.**  ``poll()`` reads the log's committed ``meta.json`` (stdlib
+   json — the supervisor never touches jax before deciding there is work)
+   and does nothing until ``min_new_records`` NEW examples have landed
+   since the last consumed high-water mark.
+2. **Verify + quarantine.**  A ``verify_session_log`` sweep runs first;
+   torn records go straight into the persistent quarantine
+   (``flywheel_state.json``) and are excluded from every future fit.
+3. **Fit, guarded.**  A short incremental fit replays the log through the
+   training pipeline (``data.session_log`` + ``data.session_only``, so
+   replayed batches are bit-identical to what was served) with the step
+   sentinel armed.  Any record the sentinel quarantines
+   (``quarantine.jsonl`` names exact session record ids — packed seek,
+   no archaeology) joins the persistent quarantine.
+4. **Hold or commit.**  A fit that ROLLED BACK never swaps — whatever
+   poisoned it is now quarantined, and the next cycle refits clean.  A
+   clean fit must beat the last committed val metric by
+   ``min_improvement``; otherwise it is held.
+5. **Canary, then promote.**  On commit with a live service, the new
+   params enter :meth:`InferenceService.swap` as a canary
+   (``promote_after=promote_probes``); the flywheel drives probe clicks
+   replayed from the log's own crops.  Clean probes auto-promote; a
+   single non-finite output rolls back instantly and the fleet keeps
+   serving the old generation — the session never sees the bad params.
+
+``dptpu-flywheel`` runs this loop standalone (committing checkpoints for
+an out-of-process serving fleet to pick up); compose it with the crash
+supervisor as ``dptpu-supervise -- dptpu-flywheel ...`` for the
+production posture.  ``FLYWHEEL_KEYS`` / :func:`flywheel_block` mirror
+sentinel's recovery-block convention so bench records always carry the
+block (null when the flywheel is off).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+#: the flywheel block's schema — bench records carry exactly these keys
+#: (all-null when continuous mode is off), mirroring sentinel.RECOVERY_KEYS
+FLYWHEEL_KEYS = ("examples_logged", "fits_run", "swaps_promoted",
+                 "swaps_rolled_back", "fits_held", "quarantined_records")
+
+
+def make_flywheel_block(*, examples_logged: int, fits_run: int,
+                        swaps_promoted: int, swaps_rolled_back: int,
+                        fits_held: int, quarantined_records: int) -> dict:
+    """Construct a populated flywheel block — the ONE place the schema's
+    keys are written (:meth:`Flywheel.report` builds through this;
+    :func:`flywheel_block` re-projects it for bench records, so the two
+    surfaces cannot drift)."""
+    out = dict.fromkeys(FLYWHEEL_KEYS)
+    out.update(examples_logged=examples_logged, fits_run=fits_run,
+               swaps_promoted=swaps_promoted,
+               swaps_rolled_back=swaps_rolled_back, fits_held=fits_held,
+               quarantined_records=quarantined_records)
+    return out
+
+
+def flywheel_block(report: dict | None = None) -> dict:
+    """The ``flywheel`` block for bench records: populated from a
+    :meth:`Flywheel.report` when one exists, all-null otherwise (the
+    keys are ALWAYS present — regression tooling filters on them)."""
+    out = {k: None for k in FLYWHEEL_KEYS}
+    if report:
+        out.update({k: report.get(k) for k in FLYWHEEL_KEYS})
+    return out
+
+
+def _read_meta(log_dir: str) -> dict | None:
+    """The log's committed meta (None when absent/unreadable) — readers
+    trust ONLY meta counts, so an in-progress append is invisible here."""
+    try:
+        with open(os.path.join(log_dir, "meta.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _read_fit_quarantine(run_dir: str) -> list[int]:
+    """Session record ids the sentinel quarantined during a fit: the
+    trainer's ``quarantine.jsonl`` names each batch's packed records as
+    ``{"record": <raw index>, ...}`` — exactly the ids
+    ``data.session_quarantine`` takes."""
+    ids: set[int] = set()
+    try:
+        with open(os.path.join(run_dir, "quarantine.jsonl")) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                for batch in rec.get("records") or []:
+                    for r in batch.get("records") or []:
+                        if r.get("record") is not None:
+                            ids.add(int(r["record"]))
+    except (OSError, ValueError):
+        pass
+    return sorted(ids)
+
+
+def _default_fit_runner(cfg) -> dict:
+    """One in-process guarded fit; returns the evidence poll() decides
+    on.  Injectable (``fit_runner=``) so tests drive the policy without
+    paying for real training."""
+    from .trainer import Trainer
+
+    tr = Trainer(cfg)
+    try:
+        history = tr.fit()
+    finally:
+        tr.close()
+    vals = [v.get("jaccard") for v in history.get("val") or []
+            if v.get("jaccard") is not None]
+    rec = history.get("recovery") or {}
+    return {"run_dir": tr.run_dir,
+            "metric": max(vals) if vals else None,
+            "rollbacks": int(rec.get("rollbacks") or 0),
+            "quarantined": _read_fit_quarantine(tr.run_dir)}
+
+
+class Flywheel:
+    """The supervisor driving continuous mode (see the module docstring
+    for the loop).  ``service=None`` is the standalone posture: commits
+    are checkpoints on disk, not hot swaps."""
+
+    def __init__(self, log_dir: str, base_cfg, work_dir: str,
+                 service=None, *, min_new_records: int = 8,
+                 fit_epochs: int = 1, min_improvement: float = 0.0,
+                 canary_fraction: float = 1.0, promote_probes: int = 3,
+                 fit_runner=None):
+        self.log_dir = log_dir
+        self.base_cfg = base_cfg
+        self.work_dir = work_dir
+        self.service = service
+        self.min_new_records = int(min_new_records)
+        self.fit_epochs = int(fit_epochs)
+        self.min_improvement = float(min_improvement)
+        self.canary_fraction = float(canary_fraction)
+        self.promote_probes = int(promote_probes)
+        self._fit_runner = fit_runner or _default_fit_runner
+        os.makedirs(work_dir, exist_ok=True)
+        self._state_path = os.path.join(work_dir, "flywheel_state.json")
+        self._ledger_path = os.path.join(work_dir, "flywheel.jsonl")
+        # durable state: survives supervisor restarts (dptpu-supervise
+        # respawning dptpu-flywheel resumes the same high-water mark)
+        self._state = {"consumed_records": 0, "quarantine": [],
+                       "best_metric": None, "committed_run": None,
+                       "cycles": 0, "fits_run": 0, "fits_held": 0,
+                       "swaps_promoted": 0, "swaps_rolled_back": 0,
+                       "examples_logged": 0}
+        try:
+            with open(self._state_path) as f:
+                self._state.update(json.load(f))
+        except (OSError, ValueError):
+            pass
+
+    # ------------------------------------------------------------ state
+
+    def _save_state(self) -> None:
+        from .checkpoint import atomic_write_json
+
+        atomic_write_json(self._state_path, self._state)
+
+    def _record(self, entry: dict) -> None:
+        self._state["cycles"] += 1
+        self._save_state()
+        with open(self._ledger_path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+
+    @property
+    def quarantine(self) -> list[int]:
+        return list(self._state["quarantine"])
+
+    def _quarantine_add(self, ids) -> list[int]:
+        fresh = sorted(set(int(i) for i in ids)
+                       - set(self._state["quarantine"]))
+        if fresh:
+            self._state["quarantine"] = sorted(
+                set(self._state["quarantine"]) | set(fresh))
+        return fresh
+
+    def report(self) -> dict:
+        """The populated flywheel block (bench's ``flywheel`` schema)."""
+        s = self._state
+        return make_flywheel_block(
+            examples_logged=int(s["examples_logged"]),
+            fits_run=int(s["fits_run"]),
+            swaps_promoted=int(s["swaps_promoted"]),
+            swaps_rolled_back=int(s["swaps_rolled_back"]),
+            fits_held=int(s["fits_held"]),
+            quarantined_records=len(s["quarantine"]))
+
+    # ------------------------------------------------------------- cycle
+
+    def poll(self) -> dict:
+        """One cycle: watch -> verify -> fit -> hold/commit -> canary.
+        Returns the cycle record (also appended to ``flywheel.jsonl``)."""
+        meta = _read_meta(self.log_dir)
+        if meta is None:
+            entry = {"action": "idle", "reason": "no_log"}
+            self._record(entry)
+            return entry
+        n = int(meta.get("n_records", 0))
+        self._state["examples_logged"] = n
+        new = n - int(self._state["consumed_records"])
+        if new < self.min_new_records:
+            entry = {"action": "idle", "reason": "insufficient_new_records",
+                     "new_records": new, "need": self.min_new_records}
+            self._record(entry)
+            return entry
+
+        # verify sweep: torn records quarantine BEFORE the fit ever
+        # touches them (same packed-idiom crc gate dptpu-pack --verify runs)
+        from ..data.sessions import verify_session_log
+
+        torn = self._quarantine_add(verify_session_log(self.log_dir))
+
+        entry: dict = {"new_records": new, "torn_quarantined": torn}
+        fit = self._run_fit()
+        # the data is consumed either way: a held fit's poison is now
+        # quarantined, so refitting the SAME window again cannot help
+        self._state["consumed_records"] = n
+        entry["fit"] = {k: fit.get(k) for k in
+                        ("run_dir", "metric", "rollbacks", "error")}
+        if fit.get("error"):
+            self._state["fits_held"] += 1
+            entry.update(action="held", reason="fit_failed")
+            self._record(entry)
+            return entry
+        self._state["fits_run"] += 1
+        fresh = self._quarantine_add(fit.get("quarantined") or [])
+        entry["sentinel_quarantined"] = fresh
+
+        # POLICY: a fit the sentinel rolled back NEVER swaps — committed
+        # val metrics from a poisoned run are not evidence
+        if int(fit.get("rollbacks") or 0) > 0:
+            self._state["fits_held"] += 1
+            entry.update(action="held", reason="sentinel_rollback",
+                         rollbacks=int(fit["rollbacks"]))
+            self._record(entry)
+            return entry
+
+        metric, best = fit.get("metric"), self._state["best_metric"]
+        if metric is None:
+            self._state["fits_held"] += 1
+            entry.update(action="held", reason="no_val_metric")
+            self._record(entry)
+            return entry
+        if best is not None and metric < best + self.min_improvement:
+            self._state["fits_held"] += 1
+            entry.update(action="held", reason="no_improvement",
+                         metric=metric, best_metric=best)
+            self._record(entry)
+            return entry
+
+        outcome = "committed"
+        if self.service is not None:
+            outcome = self._canary_swap(fit["run_dir"])
+        if outcome == "rolled_back":
+            # the canary refuted the val metric — do not commit it
+            self._state["swaps_rolled_back"] += 1
+            entry.update(action="rolled_back", metric=metric,
+                         run_dir=fit["run_dir"])
+            self._record(entry)
+            return entry
+        self._state["best_metric"] = metric
+        self._state["committed_run"] = fit["run_dir"]
+        if outcome == "promoted":
+            self._state["swaps_promoted"] += 1
+        entry.update(action=outcome, metric=metric,
+                     run_dir=fit["run_dir"])
+        self._record(entry)
+        return entry
+
+    # -------------------------------------------------------------- fit
+
+    def _fit_cfg(self, tag: str):
+        from .config import apply_overrides
+
+        return apply_overrides(self.base_cfg, {
+            "data.session_log": self.log_dir,
+            "data.session_only": True,
+            "data.session_quarantine": list(self._state["quarantine"]),
+            # guard training: the sentinel is what makes a poisoned log
+            # a quarantine event instead of a poisoned checkpoint
+            "sentinel.enabled": True,
+            "epochs": self.fit_epochs,
+            # the improvement gate needs the last epoch's val metric
+            "eval_every": self.fit_epochs,
+            "work_dir": os.path.join(self.work_dir, "fits", tag),
+        })
+
+    def _run_fit(self) -> dict:
+        tag = f"fit_{self._state['cycles']:04d}"
+        try:
+            return self._fit_runner(self._fit_cfg(tag))
+        except Exception as e:  # noqa: BLE001 — held, never a crashed loop
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    # ------------------------------------------------------------ canary
+
+    def _probe_inputs(self, k: int):
+        """Probe click k, replayed from the log's own crops: the crop is
+        the image, the clicks are the logged points in crop space — real
+        traffic's distribution, no synthetic fixtures."""
+        import numpy as np
+
+        from ..data.guidance import scale_points_to_crop
+        from ..data.sessions import SessionLogDataset
+
+        ds = SessionLogDataset(self.log_dir,
+                               quarantine=self._state["quarantine"])
+        if len(ds) == 0:
+            return None
+        rec = ds.seek(k % len(ds), read=True)
+        image = np.clip(rec["image"], 0.0, 255.0).astype(np.uint8)
+        pts = scale_points_to_crop(rec["points"], rec["bbox"],
+                                   image.shape[:2])
+        return image, pts
+
+    def _canary_swap(self, run_dir: str) -> str:
+        """Swap ``run_dir``'s best checkpoint in as a canary, drive the
+        probes, and report ``promoted`` | ``rolled_back``."""
+        import numpy as np
+
+        from ..predict import load_run
+        from ..serve.swap import load_swap_predictor
+
+        svc = self.service
+        _cfg, _model, state = load_run(run_dir)
+        pred = load_swap_predictor(svc.predictor, state.params,
+                                   state.batch_stats)
+        before = svc.health()["swap"]["swaps"]
+        gen = svc.swap(pred, label=os.path.basename(run_dir.rstrip("/")),
+                       canary_fraction=self.canary_fraction,
+                       promote_after=self.promote_probes)
+        for k in range(self.promote_probes):
+            probe = self._probe_inputs(k)
+            if probe is None:
+                break
+            image, pts = probe
+            try:
+                svc.predict(image, pts, timeout=120,
+                            session_id=f"flywheel-probe-{gen}-{k}")
+            except Exception:  # noqa: BLE001 — the pool's observe decides
+                pass
+            if svc.health()["swap"]["canary"] is None:
+                break  # decided early (rollback, or auto-promote)
+        after = svc.health()["swap"]
+        if after["swaps"]["rolled_back"] > before["rolled_back"]:
+            return "rolled_back"
+        if after["canary"] is not None:
+            # probes ran clean but fell short of promote_after (short
+            # log) — the evidence is all ok, finish the promotion
+            svc.promote()
+        return "promoted"
+
+
+# ---------------------------------------------------------------- CLI
+
+def main(argv=None) -> int:
+    """``dptpu-flywheel``: watch a session log, run guarded incremental
+    fits, commit improvements.  Standalone it commits checkpoints (the
+    serving fleet swaps them in on its own cadence); under
+    ``dptpu-supervise -- dptpu-flywheel ...`` it is crash-restartable
+    (state resumes from ``flywheel_state.json``)."""
+    ap = argparse.ArgumentParser(
+        prog="dptpu-flywheel",
+        description="continuous training from serve session logs")
+    ap.add_argument("--log", required=True,
+                    help="session log directory (dptpu-serve --session-log)")
+    ap.add_argument("--work-dir", required=True,
+                    help="flywheel state + fit run dirs")
+    ap.add_argument("--config", default=None,
+                    help="base training config JSON (default: defaults)")
+    ap.add_argument("--override", action="append", default=[],
+                    metavar="KEY=VALUE", help="dotted config overrides")
+    ap.add_argument("--interval", type=float, default=30.0,
+                    help="seconds between polls")
+    ap.add_argument("--max-cycles", type=int, default=0,
+                    help="stop after N polls (0 = forever)")
+    ap.add_argument("--min-new-records", type=int, default=8)
+    ap.add_argument("--fit-epochs", type=int, default=1)
+    ap.add_argument("--min-improvement", type=float, default=0.0)
+    args = ap.parse_args(argv)
+
+    from .config import Config, apply_overrides, from_json
+
+    cfg = from_json(args.config) if args.config else Config()
+    if args.override:
+        cfg = apply_overrides(cfg, list(args.override))
+    fw = Flywheel(args.log, cfg, args.work_dir,
+                  min_new_records=args.min_new_records,
+                  fit_epochs=args.fit_epochs,
+                  min_improvement=args.min_improvement)
+    cycle = 0
+    while True:
+        entry = fw.poll()
+        print(json.dumps({"cycle": cycle, **entry}), flush=True)
+        cycle += 1
+        if args.max_cycles and cycle >= args.max_cycles:
+            break
+        time.sleep(args.interval)
+    print(json.dumps({"flywheel": fw.report()}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

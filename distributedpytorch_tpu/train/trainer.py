@@ -461,6 +461,45 @@ class Trainer:
                         decode_cache=cfg.data.decode_cache)
                 self.train_set = CombinedDataset(
                     [self.train_set, sbd], excluded=[self.val_set])
+            if cfg.data.session_log:
+                # flywheel: serve session logs as training data
+                # (data/sessions.py).  session_only replays the EXACT
+                # serving inputs (the continuous mode's incremental
+                # fits); otherwise the log joins the VOC(+SBD) mix as a
+                # sampled source under the standard transform stack.
+                if prepared:
+                    raise ValueError(
+                        "data.session_log does not compose with "
+                        "data.prepared_cache — the session log already "
+                        "IS a pre-decoded, pre-cropped source; drop one "
+                        "of the two")
+                from ..data import CombinedDataset
+                from ..data.sessions import SessionLogDataset
+                if cfg.data.session_only:
+                    sessions = SessionLogDataset(
+                        cfg.data.session_log, mode="replay",
+                        quarantine=cfg.data.session_quarantine)
+                    if tuple(sessions.resolution) != \
+                            tuple(cfg.data.crop_size):
+                        raise ValueError(
+                            f"session log {cfg.data.session_log} was "
+                            f"captured at resolution "
+                            f"{sessions.resolution} but this run trains "
+                            f"at data.crop_size={cfg.data.crop_size} — "
+                            "replay feeds the serving inputs verbatim, "
+                            "so the two must match")
+                    self.train_set = sessions
+                else:
+                    sessions = SessionLogDataset(
+                        cfg.data.session_log, mode="sample",
+                        transform=train_tf,
+                        quarantine=cfg.data.session_quarantine)
+                    self.train_set = CombinedDataset(
+                        [self.train_set, sessions],
+                        excluded=[self.val_set])
+            elif cfg.data.session_only:
+                raise ValueError(
+                    "data.session_only requires data.session_log")
             if prepared:
                 from ..data import (
                     PreparedInstanceDataset,

@@ -45,7 +45,17 @@
 #      the f32 kernels back in), and the AOT cache's load path sits on
 #      the replica boot path: crc + fallback logic only, no device
 #      touches beyond deserialization, and `dptpu-aot --verify` stays
-#      a pure-host sweep) plus bench.py, the official record.
+#      a pure-host sweep; serve/session_log.py + data/sessions.py +
+#      train/continuous.py included — the flywheel's three legs: the
+#      sink's offer() runs ON the serve worker between dispatches
+#      (numpy + stdlib appends under one lock, no device touches, no
+#      re-hashing), the session-log reader sits on the loader hot path
+#      like data/packed.py (crc32 + memcpy per record, importable
+#      pre-jax), and the continuous-mode supervisor is a host-side
+#      polling loop that must never smuggle a sync into the fits it
+#      launches — and the flywheel adds NO new jitted programs, so the
+#      jaxaudit contract set below is unchanged by it) plus bench.py,
+#      the official record.
 #      `jaxlint --stats` then polices the suppressions themselves: a
 #      `# jaxlint:`/`# jaxguard:` disable whose rule no longer fires is
 #      a dead waiver waiting to swallow the next real finding — it

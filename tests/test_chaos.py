@@ -522,7 +522,8 @@ class TestCLI:
                      "nan_loss", "nan_loss_legacy",
                      "divergence_rollback", "crash_loop",
                      "preemption_storm", "input_stall_recovery",
-                     "torn_pack", "stale_aot_cache"):
+                     "torn_pack", "stale_aot_cache",
+                     "poisoned_flywheel"):
             assert name in r.stdout
         r = subprocess.run(
             [sys.executable, "-m", "distributedpytorch_tpu.chaos",

@@ -1,0 +1,608 @@
+"""The flywheel: session logs -> training batches -> canaried hot-swap.
+
+The acceptance surface of the production loop's last edge:
+
+* the sink (``serve/session_log.py``) — packed-idiom appends with
+  content dedup, byte/record budgets, meta committed atomically LAST
+  (an uncommitted tail is invisible to readers; reopening truncates it);
+* replay bit-identity — a ``SessionLogDataset`` replay batch is bitwise
+  equal to the ``concat`` the live serve path synthesized, because both
+  go through the ONE guidance seam (``data/guidance.py``);
+* the read side — quarantine-by-record-id, typed checksum errors,
+  ``dptpu-pack --verify`` over session dirs, ``CombinedDataset``
+  composition in sample mode;
+* the supervisor (``train/continuous.py``) — watch/verify/fit/hold/
+  commit policy (stub fit runners pin every branch without paying for
+  training), durable restart, the bench ``flywheel`` block convention;
+* end to end (slow-marked) — a real guarded fit from a real service's
+  log, and the ``poisoned_flywheel`` chaos scenario's containment chain.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.data.packed import (
+    PackedRecordError,
+    PackFormatError,
+)
+from distributedpytorch_tpu.data.sessions import (
+    SessionLogDataset,
+    corrupt_record,
+    is_session_log,
+    verify_session_log,
+)
+from distributedpytorch_tpu.serve.session_log import SessionLogSink
+from distributedpytorch_tpu.train.continuous import (
+    FLYWHEEL_KEYS,
+    Flywheel,
+    flywheel_block,
+    make_flywheel_block,
+)
+
+RES = 32  # sink/replay geometry for the pure-host tests (no model)
+
+
+def _image(size=64, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (size, size, 3)).astype(np.uint8)
+
+
+def _points(size=64, dx=0.0, dy=0.0):
+    q, m = size // 4, size // 2
+    return np.array([[q, m], [size - q, m], [m, q], [m, size - q]],
+                    np.float64) + np.array([dx, dy])
+
+
+def _make_sink(path, res=RES, **kw):
+    return SessionLogSink(str(path), resolution=(res, res),
+                          guidance="nellipse_gaussians", alpha=0.6,
+                          relax=10, zero_pad=True, **kw)
+
+
+def _append(sink, seed, res=RES, points=None, digest=0):
+    """One direct append with a distinct random crop per seed."""
+    r = np.random.RandomState(seed)
+    crop = r.uniform(0, 255, (res, res, 3)).astype(np.float32)
+    mask = (r.uniform(size=(res, res)) > 0.5).astype(np.uint8)
+    pts = _points(res) if points is None else points
+    return sink.append(crop=crop, mask=mask, points=pts,
+                       bbox=(0, 0, res - 1, res - 1),
+                       shape_hw=(res, res), digest=digest)
+
+
+class TestSink:
+    def test_append_then_dedup(self, tmp_path):
+        sink = _make_sink(tmp_path / "log")
+        assert _append(sink, seed=0, digest=7) == "appended"
+        assert _append(sink, seed=1, digest=8) == "appended"
+        # same digest + same clicks = the same example, whatever the
+        # pixels claim — dedup is the submit thread's digest, re-hashed
+        # never
+        assert _append(sink, seed=2, digest=7) == "deduped"
+        snap = sink.snapshot()
+        assert (snap["appended"], snap["deduped"]) == (2, 1)
+        sink.close()
+
+    def test_stateless_crc_fallback_dedup(self, tmp_path):
+        # digest=0 (stateless request): the sink fingerprints the crop
+        # bytes itself, so replaying identical bytes still dedups
+        sink = _make_sink(tmp_path / "log")
+        assert _append(sink, seed=0) == "appended"
+        assert _append(sink, seed=0) == "deduped"
+        assert _append(sink, seed=1) == "appended"
+        sink.close()
+
+    def test_record_budget_drops(self, tmp_path):
+        sink = _make_sink(tmp_path / "log", max_records=2)
+        assert _append(sink, seed=0, digest=1) == "appended"
+        assert _append(sink, seed=1, digest=2) == "appended"
+        assert _append(sink, seed=2, digest=3) == "dropped"
+        assert sink.snapshot()["dropped"]["budget"] == 1
+        sink.close()
+
+    def test_byte_budget_drops(self, tmp_path):
+        blob = RES * RES * 3 * 4 + RES * RES
+        sink = _make_sink(tmp_path / "log", max_bytes=blob)
+        assert _append(sink, seed=0, digest=1) == "appended"
+        assert _append(sink, seed=1, digest=2) == "dropped"
+        assert sink.snapshot()["dropped"]["budget"] == 1
+        sink.close()
+
+    def test_geometry_mismatch_never_logged(self, tmp_path):
+        sink = _make_sink(tmp_path / "log", res=RES)
+        assert _append(sink, seed=0, res=16,
+                       points=_points(16)) == "dropped"
+        assert sink.snapshot()["dropped"]["no_crop"] == 1
+        sink.close()
+
+    def test_meta_committed_last_tail_invisible(self, tmp_path):
+        """THE crash-safety contract: bin/idx bytes past meta's counts
+        are an uncommitted tail readers never see."""
+        path = tmp_path / "log"
+        sink = _make_sink(path)
+        _append(sink, seed=0, digest=1)
+        _append(sink, seed=1, digest=2)
+        sink.flush()
+        # a third append lands in bin/idx but meta is NOT re-committed
+        # (the crash window between data write and meta flush)
+        _append(sink, seed=2, digest=3)
+        sink._bin.flush()
+        sink._idx.flush()
+        assert len(SessionLogDataset(str(path))) == 2
+        sink.flush()
+        assert len(SessionLogDataset(str(path))) == 3
+        sink.close()
+
+    def test_reopen_truncates_tail_and_reloads_dedup(self, tmp_path):
+        path = tmp_path / "log"
+        sink = _make_sink(path)
+        _append(sink, seed=0, digest=1)
+        _append(sink, seed=1, digest=2)
+        sink.flush()
+        # crash tail: raw garbage past the committed byte counts
+        with open(os.path.join(str(path), "records.bin"), "ab") as f:
+            f.write(b"\xde\xad\xbe\xef" * 8)
+        with open(os.path.join(str(path), "records.idx"), "ab") as f:
+            f.write(b"\x00" * 13)
+        sink._bin.close()
+        sink._idx.close()
+        resumed = _make_sink(path)
+        snap = resumed.snapshot()
+        assert snap["records"] == 2
+        # the committed prefix's dedup keys survived the restart
+        assert _append(resumed, seed=9, digest=1) == "deduped"
+        assert _append(resumed, seed=3, digest=3) == "appended"
+        resumed.flush()
+        ds = SessionLogDataset(str(path))
+        assert len(ds) == 3 and ds.verify() == []
+        resumed.close()
+
+    def test_reopen_with_different_geometry_rejected(self, tmp_path):
+        path = tmp_path / "log"
+        sink = _make_sink(path, res=RES)
+        _append(sink, seed=0)
+        sink.close()
+        with pytest.raises(ValueError, match="different parameters"):
+            _make_sink(path, res=16)
+
+    def test_empty_log_is_a_committed_log(self, tmp_path):
+        # sink on + zero examples must read as a valid empty log (the
+        # flywheel's no-log / no-new-records distinction depends on it)
+        path = tmp_path / "log"
+        sink = _make_sink(path)
+        assert is_session_log(str(path))
+        assert len(SessionLogDataset(str(path))) == 0
+        sink.close()
+
+
+class TestReplayBitIdentity:
+    def test_replay_concat_bitwise_equals_prepare_input(self, tmp_path):
+        """THE pin: a replayed batch is bit-identical to the live
+        pipeline's ``concat`` — the sink stores the crop the serve path
+        built, and replay re-synthesizes the guidance channel through
+        the SAME seam ``prepare_input`` uses."""
+        from distributedpytorch_tpu.predict import prepare_input
+
+        size = 64
+        image, pts = _image(size), _points(size)
+        concat, bbox = prepare_input(image, pts, relax=10, zero_pad=True,
+                                     resolution=(RES, RES))
+        path = tmp_path / "log"
+        sink = _make_sink(path)
+        out = sink.append(crop=concat[..., :3],
+                          mask=(concat[..., 3] > 0).astype(np.uint8),
+                          points=pts, bbox=bbox, shape_hw=(size, size),
+                          digest=123)
+        assert out == "appended"
+        sink.close()
+        replayed = SessionLogDataset(str(path))[0]["concat"]
+        assert replayed.dtype == np.float32
+        assert replayed.shape == concat.shape
+        assert replayed.tobytes() == concat.tobytes()
+
+    def test_replay_mode_rejects_transform(self, tmp_path):
+        sink = _make_sink(tmp_path / "log")
+        _append(sink, seed=0)
+        sink.close()
+        with pytest.raises(ValueError, match="bit-identity"):
+            SessionLogDataset(str(tmp_path / "log"),
+                              transform=lambda s, rng: s)
+
+
+class TestDataset:
+    def _log(self, tmp_path, n=4, digest0=1):
+        path = tmp_path / "log"
+        sink = _make_sink(path)
+        for i in range(n):
+            assert _append(sink, seed=i, digest=digest0 + i) == "appended"
+        sink.close()
+        return str(path)
+
+    def test_seek_contract_and_quarantine(self, tmp_path):
+        path = self._log(tmp_path)
+        ds = SessionLogDataset(path, quarantine=[1])
+        assert len(ds) == 3
+        # positions shift, record ids never do
+        assert [ds.record_index(i) for i in range(3)] == [0, 2, 3]
+        rec = ds.seek(1, read=True)
+        assert rec["record"] == 2
+        assert rec["image_id"].startswith("session-")
+        assert rec["object"] == "0"
+        assert rec["image"].shape == (RES, RES, 3)
+        assert rec["mask"].shape == (RES, RES)
+        with pytest.raises(ValueError, match="out of range"):
+            SessionLogDataset(path, quarantine=[9])
+
+    def test_corrupt_record_typed_error_and_verify(self, tmp_path):
+        path = self._log(tmp_path)
+        corrupt_record(path, 2)
+        ds = SessionLogDataset(path)
+        with pytest.raises(PackedRecordError, match="checksum"):
+            ds[2]
+        assert ds.verify() == [2]
+        assert verify_session_log(path) == [2]
+        # the quarantined log reads clean again
+        clean = SessionLogDataset(path, quarantine=[2])
+        assert [clean.record_index(i) for i in range(len(clean))] \
+            == [0, 1, 3]
+        for i in range(len(clean)):
+            clean[i]
+
+    def test_sample_mode_composes_with_combined(self, tmp_path):
+        from distributedpytorch_tpu.data.combine import CombinedDataset
+
+        a = SessionLogDataset(self._log(tmp_path / "a", n=3),
+                              mode="sample")
+        b = SessionLogDataset(self._log(tmp_path / "b", n=2, digest0=10),
+                              mode="sample")
+        sample = a.__getitem__(0, np.random.default_rng(0))
+        assert set(sample) == {"image", "gt", "void_pixels", "meta"}
+        combined = CombinedDataset([a, b])
+        assert len(combined) == 5
+        ids = {combined.sample_image_id(i) for i in range(len(combined))}
+        assert len(ids) == 5
+        assert all(i.startswith("session-") for i in ids)
+
+    def test_wrong_kind_and_missing_meta_are_typed(self, tmp_path):
+        with pytest.raises(PackFormatError, match="missing"):
+            SessionLogDataset(str(tmp_path / "nope"))
+        path = self._log(tmp_path)
+        meta = json.load(open(os.path.join(path, "meta.json")))
+        meta["kind"] = "instance"
+        json.dump(meta, open(os.path.join(path, "meta.json"), "w"))
+        with pytest.raises(PackFormatError, match="not a"):
+            SessionLogDataset(path)
+
+
+class TestVerifyCLI:
+    def test_verify_session_dir_rc(self, tmp_path, capsys):
+        from distributedpytorch_tpu.data import packed
+
+        path = tmp_path / "log"
+        sink = _make_sink(path)
+        for i in range(3):
+            _append(sink, seed=i, digest=i + 1)
+        sink.close()
+        assert packed.main(["--verify", str(path)]) == 0
+        assert "ok (3 records)" in capsys.readouterr().out
+        corrupt_record(str(path), 1)
+        assert packed.main(["--verify", str(path)]) == 1
+        err = capsys.readouterr().err
+        # same remedy convention as pack verification, session flavor
+        assert "data.session_quarantine=[1]" in err
+        assert "dptpu-flywheel" in err
+
+    def test_verify_empty_dir_rc2(self, tmp_path, capsys):
+        from distributedpytorch_tpu.data import packed
+
+        assert packed.main(["--verify", str(tmp_path)]) == 2
+
+
+def _base_cfg():
+    from distributedpytorch_tpu.train.config import Config
+
+    return Config()
+
+
+def _stub_runner(results):
+    """A fit runner yielding scripted evidence — the policy tests never
+    pay for training.  Each call pops the next result (dicts are copied;
+    an Exception instance raises)."""
+    queue = list(results)
+    calls = []
+
+    def run(cfg):
+        calls.append(cfg)
+        item = queue.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return dict(item)
+
+    run.calls = calls
+    return run
+
+
+class TestFlywheelPolicy:
+    def _log(self, tmp_path, n=4):
+        path = tmp_path / "log"
+        sink = _make_sink(path)
+        for i in range(n):
+            _append(sink, seed=i, digest=i + 1)
+        sink.close()
+        return str(path)
+
+    def test_idle_paths(self, tmp_path):
+        fw = Flywheel(str(tmp_path / "missing"), _base_cfg(),
+                      str(tmp_path / "wd"), min_new_records=2,
+                      fit_runner=_stub_runner([]))
+        assert fw.poll() == {"action": "idle", "reason": "no_log"}
+        log = self._log(tmp_path, n=1)
+        fw2 = Flywheel(log, _base_cfg(), str(tmp_path / "wd2"),
+                       min_new_records=2, fit_runner=_stub_runner([]))
+        entry = fw2.poll()
+        assert (entry["action"], entry["reason"]) \
+            == ("idle", "insufficient_new_records")
+        assert fw2.report()["examples_logged"] == 1
+
+    def test_commit_then_hold_then_improve(self, tmp_path):
+        log = self._log(tmp_path)
+        runner = _stub_runner([
+            {"run_dir": "r0", "metric": 0.5, "rollbacks": 0,
+             "quarantined": []},
+            {"run_dir": "r1", "metric": 0.4, "rollbacks": 0,
+             "quarantined": []},
+            {"run_dir": "r2", "metric": 0.6, "rollbacks": 0,
+             "quarantined": []},
+        ])
+        fw = Flywheel(log, _base_cfg(), str(tmp_path / "wd"),
+                      min_new_records=1, fit_runner=runner)
+        assert fw.poll()["action"] == "committed"
+        # the fit config is the guarded session-only replay posture
+        cfg = runner.calls[0]
+        assert cfg.data.session_log == log
+        assert cfg.data.session_only is True
+        assert cfg.sentinel.enabled is True
+        assert cfg.eval_every == cfg.epochs == 1
+        # the window is consumed: refitting needs NEW records
+        assert fw.poll()["reason"] == "insufficient_new_records"
+        _append_more(log, start=10, n=1)
+        held = fw.poll()
+        assert (held["action"], held["reason"]) \
+            == ("held", "no_improvement")
+        _append_more(log, start=20, n=1)
+        assert fw.poll()["action"] == "committed"
+        rep = fw.report()
+        assert rep["fits_run"] == 3 and rep["fits_held"] == 1
+
+    def test_sentinel_rollback_holds_and_quarantines(self, tmp_path):
+        log = self._log(tmp_path)
+        fw = Flywheel(log, _base_cfg(), str(tmp_path / "wd"),
+                      min_new_records=1, fit_runner=_stub_runner([
+                          {"run_dir": "r0", "metric": 0.9, "rollbacks": 1,
+                           "quarantined": [1, 3]}]))
+        entry = fw.poll()
+        # POLICY: a rolled-back fit NEVER swaps, whatever its val metric
+        assert (entry["action"], entry["reason"]) \
+            == ("held", "sentinel_rollback")
+        assert entry["sentinel_quarantined"] == [1, 3]
+        assert fw.quarantine == [1, 3]
+        # the NEXT fit excludes them
+        assert tuple(fw._fit_cfg("t").data.session_quarantine) == (1, 3)
+
+    def test_fit_failure_is_held_never_raised(self, tmp_path):
+        log = self._log(tmp_path)
+        fw = Flywheel(log, _base_cfg(), str(tmp_path / "wd"),
+                      min_new_records=1,
+                      fit_runner=_stub_runner([RuntimeError("boom")]))
+        entry = fw.poll()
+        assert (entry["action"], entry["reason"]) == ("held", "fit_failed")
+        assert "RuntimeError: boom" in entry["fit"]["error"]
+
+    def test_torn_records_quarantined_before_fit(self, tmp_path):
+        log = self._log(tmp_path)
+        corrupt_record(log, 2)
+        fw = Flywheel(log, _base_cfg(), str(tmp_path / "wd"),
+                      min_new_records=1, fit_runner=_stub_runner([
+                          {"run_dir": "r0", "metric": 0.5, "rollbacks": 0,
+                           "quarantined": []}]))
+        entry = fw.poll()
+        assert entry["torn_quarantined"] == [2]
+        assert fw.quarantine == [2]
+
+    def test_durable_restart_resumes_state(self, tmp_path):
+        log = self._log(tmp_path)
+        wd = str(tmp_path / "wd")
+        fw = Flywheel(log, _base_cfg(), wd, min_new_records=1,
+                      fit_runner=_stub_runner([
+                          {"run_dir": "r0", "metric": 0.5, "rollbacks": 1,
+                           "quarantined": [0]}]))
+        fw.poll()
+        # a fresh supervisor over the same work_dir (dptpu-supervise
+        # respawn) resumes the high-water mark, quarantine and tallies
+        fw2 = Flywheel(log, _base_cfg(), wd, min_new_records=1,
+                       fit_runner=_stub_runner([]))
+        assert fw2.quarantine == [0]
+        assert fw2.poll()["reason"] == "insufficient_new_records"
+        assert fw2.report()["fits_held"] == 1
+        ledger = [json.loads(ln) for ln in
+                  open(os.path.join(wd, "flywheel.jsonl"))]
+        assert [e["action"] for e in ledger] \
+            == ["held", "idle"]
+
+    def test_flywheel_block_convention(self, tmp_path):
+        # the bench-record schema: keys ALWAYS present, null when off
+        null = flywheel_block()
+        assert tuple(null) == FLYWHEEL_KEYS
+        assert all(v is None for v in null.values())
+        made = make_flywheel_block(
+            examples_logged=4, fits_run=1, swaps_promoted=1,
+            swaps_rolled_back=0, fits_held=0, quarantined_records=2)
+        assert flywheel_block(made) == made
+        json.dumps(flywheel_block(made))  # bench records must serialize
+        fw = Flywheel(self._log(tmp_path), _base_cfg(),
+                      str(tmp_path / "wd"), fit_runner=_stub_runner([]))
+        assert tuple(fw.report()) == FLYWHEEL_KEYS
+
+
+def _append_more(log, start, n, res=RES):
+    """Grow an existing committed log by n fresh records."""
+    sink = _make_sink(log)
+    for i in range(n):
+        assert _append(sink, seed=start + i, digest=start + i + 1) \
+            == "appended"
+    sink.close()
+
+
+class TestServiceIntegration:
+    def test_cold_warm_stateless_clicks_logged(
+            self, tmp_path, serve_split_predictor):
+        """The service leg, fast: one cold + one warm + one stateless
+        click land as three records (warm flagged, digest shared with
+        its cold crop), the health block reports the sink, and the
+        cold record replays bitwise equal to the live ``concat``."""
+        from distributedpytorch_tpu.serve import InferenceService
+
+        pred = serve_split_predictor
+        size = int(pred.resolution[0])
+        log = str(tmp_path / "log")
+        sink = SessionLogSink(log, resolution=pred.resolution,
+                              guidance=pred.guidance, alpha=pred.alpha,
+                              relax=pred.relax, zero_pad=pred.zero_pad)
+        svc = InferenceService(pred, max_batch=2, max_wait_s=0.0,
+                               session_log=sink)
+        image = _image(size)
+        with svc:
+            svc.predict(image, _points(size), timeout=60,
+                        session_id="a")
+            svc.predict(image, _points(size, dx=1, dy=1), timeout=60,
+                        session_id="a")
+            svc.predict(_image(size, seed=1), _points(size), timeout=60)
+            deadline = 50  # worker offers after resolving the future
+            while sink.snapshot()["appended"] < 3 and deadline:
+                import time
+                time.sleep(0.05)
+                deadline -= 1
+            sink.flush(force=True)
+            health = svc.health()["session_log"]
+        assert health["records"] == 3
+        ds = SessionLogDataset(log)
+        recs = [ds.seek(i) for i in range(3)]
+        assert [r["warm"] for r in recs] == [False, True, False]
+        # the warm click logged the SAME content digest its cold crop
+        # carried (no re-hash, ever)
+        digests = [int(ds._index[i]["digest"]) for i in range(3)]
+        assert digests[0] == digests[1] != digests[2]
+        live_concat, live_bbox = pred.prepare(image, _points(size))
+        assert recs[0]["bbox"] == tuple(live_bbox)
+        assert ds[0]["concat"].tobytes() == live_concat.tobytes()
+        sink.close()
+
+
+@pytest.mark.slow
+class TestEndToEnd:
+    def test_real_fit_from_session_log_and_canary_promote(
+            self, tmp_path, serve_split_predictor):
+        """The full promote path with a REAL guarded fit: serve clicks
+        into the log, one flywheel cycle trains on the replayed batches
+        and hot-swaps the result in as a canary, probe clicks promote
+        it, and the service ends on the new generation."""
+        from distributedpytorch_tpu.serve import InferenceService
+        from distributedpytorch_tpu.train.config import apply_overrides
+
+        pred = serve_split_predictor
+        size = int(pred.resolution[0])
+        log = str(tmp_path / "log")
+        sink = SessionLogSink(log, resolution=pred.resolution,
+                              guidance=pred.guidance, alpha=pred.alpha,
+                              relax=pred.relax, zero_pad=pred.zero_pad)
+        svc = InferenceService(pred, max_batch=4, max_wait_s=0.0,
+                               session_log=sink)
+        cfg = apply_overrides(_base_cfg(), {
+            "data.fake": True, "data.train_batch": 8, "data.val_batch": 2,
+            "data.crop_size": [size, size], "data.relax": 10,
+            "data.area_thres": 0, "data.num_workers": 0,
+            "model.backbone": "resnet18", "model.output_stride": 8,
+            "model.guidance_inject": "head", "optim.lr": 1e-4,
+            "checkpoint.async_save": False, "eval_every": 0,
+            "checkpoint.snapshot_every": 0, "log_every_steps": 1000,
+            "debug_asserts": False,
+        })
+        with svc:
+            r = np.random.RandomState(0)
+            for i in range(8):
+                image = r.randint(0, 256, (size, size, 3)) \
+                    .astype(np.uint8)
+                svc.predict(image, _points(size, dx=i % 3), timeout=120,
+                            session_id=f"s{i}")
+            import time
+            deadline = 100
+            while sink.snapshot()["appended"] < 8 and deadline:
+                time.sleep(0.05)
+                deadline -= 1
+            sink.flush(force=True)
+            fw = Flywheel(log, cfg, str(tmp_path / "wd"), service=svc,
+                          min_new_records=1, fit_epochs=1,
+                          promote_probes=2)
+            entry = fw.poll()
+            swap = svc.health()["swap"]
+        assert entry["action"] == "promoted", entry
+        assert swap["swaps"]["promoted"] == 1
+        assert swap["active"] == 1 and swap["canary"] is None
+        rep = fw.report()
+        assert rep["swaps_promoted"] == 1 and rep["fits_run"] == 1
+        sink.close()
+
+    def test_mixed_session_plus_fake_voc_finetune(self, tmp_path):
+        """Sample mode end to end: session records compose with the
+        fake VOC source through the standard transform stack and a
+        short mixed fine-tune completes with a finite metric."""
+        from distributedpytorch_tpu.train.config import apply_overrides
+        from distributedpytorch_tpu.train.trainer import Trainer
+
+        res = 48
+        log = tmp_path / "log"
+        sink = SessionLogSink(str(log), resolution=(res, res),
+                              guidance="nellipse_gaussians", alpha=0.6,
+                              relax=10, zero_pad=True)
+        for i in range(6):
+            _append(sink, seed=i, res=res, points=_points(res),
+                    digest=i + 1)
+        sink.close()
+        cfg = apply_overrides(_base_cfg(), {
+            "data.fake": True, "data.train_batch": 8, "data.val_batch": 2,
+            "data.crop_size": [res, res], "data.relax": 10,
+            "data.area_thres": 0, "data.num_workers": 0,
+            "data.session_log": str(log),
+            "model.backbone": "resnet18", "model.output_stride": 8,
+            "optim.lr": 1e-4, "checkpoint.async_save": False,
+            "epochs": 1, "eval_every": 1, "checkpoint.snapshot_every": 0,
+            "log_every_steps": 1000, "debug_asserts": False,
+            "work_dir": str(tmp_path / "wd"),
+        })
+        tr = Trainer(cfg)
+        try:
+            history = tr.fit()
+        finally:
+            tr.close()
+        vals = [v["jaccard"] for v in history["val"]]
+        assert vals and np.isfinite(vals[-1])
+
+    def test_poisoned_flywheel_scenario(self, tmp_path):
+        """The chaos acceptance chain in-process: NaN-poisoned session
+        appends -> sentinel quarantines the exact records -> the cycle
+        holds (no promotion) -> the fleet serves generation 0 with zero
+        session-visible errors."""
+        from distributedpytorch_tpu.chaos import runner
+
+        sc = runner.load_scenario("poisoned_flywheel")
+        report = runner.run_scenario(sc, work_dir=str(tmp_path / "sc"))
+        assert report["ok"], json.dumps(report.get("invariants"),
+                                        indent=2)
+        ph = report["phases"]["flywheel"]
+        assert ph["cycle"]["action"] == "held"
+        assert set(ph["poisoned_records"]) <= set(ph["quarantine"])
+        assert ph["swap_state"]["swaps"] == {"promoted": 0,
+                                             "rolled_back": 0}
