@@ -115,6 +115,7 @@ from distributedpytorch_tpu.telemetry.goodput import (  # noqa: E402
 from distributedpytorch_tpu.chaos import sites as chaos_sites  # noqa: E402
 from distributedpytorch_tpu.data.governor import feed_block  # noqa: E402
 from distributedpytorch_tpu.telemetry import get_accountant  # noqa: E402
+from distributedpytorch_tpu.telemetry.events import events_block  # noqa: E402
 from distributedpytorch_tpu.train.precision import (  # noqa: E402
     precision_block,
     precision_policy,
@@ -459,6 +460,14 @@ def _cold_start_aot(record: dict) -> str:
     return cold.get("aot_cache") or "off"
 
 
+def _events_enabled(record: dict) -> bool:
+    """Whether the measured window ran with the flight recorder armed:
+    records predating the events block (and telemetry-off runs, whose
+    ``events`` block is all-null) read as off — the default."""
+    ev = record.get("events") or {}
+    return ev.get("path") is not None
+
+
 def check_regression(record: dict, history: list | None = None,
                      threshold: float = REGRESSION_THRESHOLD
                      ) -> tuple[bool, str]:
@@ -512,6 +521,12 @@ def check_regression(record: dict, history: list | None = None,
              # different regime than a static serve/train run.  Null ==
              # flywheel off (the default), so prior history compares.
              and r.get("flywheel") == record.get("flywheel")
+             # ...and whether the flight recorder was armed: event
+             # emission is pinned <=2% of step, but pinned is not zero —
+             # a recorder-armed record and a recorder-off one are
+             # different regimes.  Null block == off (the default), so
+             # pre-recorder committed history still compares.
+             and _events_enabled(r) == _events_enabled(record)
              and not r.get("replayed_from_session_capture")]
     if not prior:
         return True, (f"no prior {record.get('metric')} record on "
@@ -816,6 +831,11 @@ def serve_bench():
     # plan block: a TRAIN-side concept (serve replicates the predictor),
     # null on serve records — key always present (schema stability)
     record["plan"] = None
+    # events block (telemetry/events.py): flight-recorder tallies for
+    # the measured window — keys ALWAYS present, all null when the
+    # recorder is off (the bench default).  --check-regression keys its
+    # same-config filter on it (recorder-armed vs off are regimes).
+    record["events"] = events_block()
     # cold_start block (serve/aot): the measured boot tax — warmup
     # seconds, programs compiled (0 on an AOT-warm boot) and the cache
     # outcome; keys always present on serve records, block null on
@@ -970,6 +990,9 @@ def serve_sessions_bench():
     record["precision"] = precision_block(precision_policy(DTYPE))
     # plan block: train-side concept, null on serve records; key present
     record["plan"] = None
+    # events block: flight-recorder tallies, all null when the recorder
+    # is off (see serve_bench); keys always present
+    record["events"] = events_block()
     # cold_start + quantization blocks — the serve-record pair (see
     # serve_bench); keys always present
     audit_kw, suffix = _stamp_serve_fast_path(record, svc, qpolicy)
@@ -1239,6 +1262,11 @@ def main() -> None:
     # train records — keys always present (schema stability)
     record["cold_start"] = None
     record["quantization"] = None
+    # events block (telemetry/events.py): flight-recorder tallies for
+    # the measured loop — keys ALWAYS present, all null when the
+    # recorder is off (the bench runs un-recorded by default).
+    # --check-regression's same-config filter keys on it.
+    record["events"] = events_block()
     if REDUCE_BUCKETS:
         record["reduce_buckets"] = REDUCE_BUCKETS
     # IR-audit fields (jaxaudit): collective inventory of the exact

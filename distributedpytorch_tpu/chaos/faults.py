@@ -340,9 +340,13 @@ class FaultPlan:
         # armed-path only; deferred so the chaos package imports without
         # pulling the telemetry stack (backend_health imports policies
         # before jax is configured)
+        from ..telemetry import events as events_lib
         from ..telemetry import get_registry
 
         get_registry().counter(
             "chaos_injected_total",
             "Deterministic fault-injection firings (chaos/)",
             labels={"site": site, "kind": kind}).inc()
+        # flight recorder: the fault firing is every chaos episode's
+        # ground-truth opening anchor
+        events_lib.emit("chaos", kind, payload={"site": site})

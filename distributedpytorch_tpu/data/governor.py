@@ -294,6 +294,16 @@ class FeedGovernor:
                     "train_governor_actions_total",
                     "Feed-governor ladder decisions (data/governor.py)",
                     labels={"action": action}).inc()
+        # flight recorder (telemetry/events.py): the decision, mirrored —
+        # governor.jsonl stays the authoritative ledger
+        from ..telemetry import events as events_lib
+
+        events_lib.emit("governor", action, step=int(step),
+                        epoch=int(epoch),
+                        payload={"stall": rec["stall"],
+                                 "target": self.target,
+                                 "applied": bool(applied),
+                                 "detail": detail})
         return rec
 
     def _publish_gauges(self, stall: float | None) -> None:

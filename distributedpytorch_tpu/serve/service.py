@@ -57,6 +57,7 @@ from typing import Any
 import numpy as np
 
 from ..chaos import sites as chaos_sites
+from ..telemetry import events as events_lib
 from ..telemetry.trace import TraceCapture
 from ..utils.compile_watchdog import CompileWatchdog
 from . import batching
@@ -735,6 +736,9 @@ class InferenceService:
             # is off — the always-present-key convention
             "session_log": (self._sink.snapshot()
                             if self._sink is not None else None),
+            # flight recorder (telemetry/events.py): emitted/dropped/path
+            # of this process's event log; all-None when none configured
+            "events": events_lib.events_block(),
         }
         return out
 

@@ -40,6 +40,8 @@ from __future__ import annotations
 import threading
 import zlib
 
+from ..telemetry import events as events_lib
+
 #: generation lifecycle states
 STATES = ("active", "canary", "draining", "retired")
 
@@ -206,6 +208,12 @@ class PredictorPool:
             if canary_fraction is not None:
                 self.canary_fraction = float(canary_fraction)
             self._publish()
+            # flight recorder: the canary episode's opening anchor
+            events_lib.emit("serve", "swap_admit",
+                            payload={"gen_id": gen_id,
+                                     "label": self._gens[gen_id].label,
+                                     "canary_fraction":
+                                         self.canary_fraction})
             return gen_id
 
     def observe(self, gen_id: int, ok: bool,
@@ -302,12 +310,16 @@ class PredictorPool:
 
     def _promote_locked(self) -> str:
         old_active = self._gens[self._active]
-        self._gens[self._canary].state = "active"
+        gen = self._gens[self._canary]
+        gen.state = "active"
         self._active = self._canary
         self._canary = None
         old_active.state = "draining"
         self._c_swap["promoted"].inc()
         self._publish()
+        events_lib.emit("serve", "swap_promote",
+                        payload={"gen_id": gen.gen_id, "label": gen.label,
+                                 "ok": gen.ok, "errors": gen.errors})
         return "promoted"
 
     def _rollback_locked(self) -> str:
@@ -316,6 +328,10 @@ class PredictorPool:
         self._canary = None
         self._c_swap["rolled_back"].inc()
         self._publish()
+        events_lib.emit("serve", "swap_rollback",
+                        payload={"gen_id": g.gen_id, "label": g.label,
+                                 "ok": g.ok, "errors": g.errors,
+                                 "nonfinite": g.nonfinite})
         return "rolled_back"
 
     def _publish(self) -> None:

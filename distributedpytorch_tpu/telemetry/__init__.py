@@ -14,13 +14,24 @@ One process-wide surface for "what is this process doing":
   (SIGUSR2 / ``POST /debug/trace``) without restarting the process;
 * :mod:`lowering`   — process-wide trace/lower/compile cache shared by
   the MFU estimator and the IR auditor (``analysis.ir``), so each hot
-  program is lowered exactly once.
+  program is lowered exactly once;
+* :mod:`events`     — the flight recorder: one crash-safe, append-only
+  run-event log (``run_dir/events/<host>.<pid>.jsonl``) every subsystem
+  publishes into without changing its own ledger;
+* :mod:`timeline`   — merges a run dir's event files across process
+  generations and hosts into one causally-ordered timeline with typed
+  episodes (divergence→rollback→replay, preempt→resume, …);
+* :mod:`doctor`     — ``dptpu-doctor``: the diagnosis CLI over the
+  timeline (goodput breakdown, episode recovery times, anomaly findings
+  with the exact config-knob remedy).
 
 Every future perf PR reports into this layer; the train loop, the
 checkpoint manager, the evaluator and the serve front are already wired.
 """
 
-from . import goodput, lowering, prometheus, registry, spans, trace
+from . import events, goodput, lowering, prometheus, registry, spans, timeline, trace
+from .events import EventLog, events_block
+from .timeline import Timeline, load_timeline
 from .goodput import (
     BUCKETS,
     FeedWindow,
@@ -36,10 +47,12 @@ from .spans import current_span, span
 from .trace import TraceCapture
 
 __all__ = [
-    "BUCKETS", "FeedWindow", "GoodputAccountant", "LoweredProgram",
-    "MetricsRegistry",
-    "TraceCapture", "current_span", "get_accountant", "get_registry",
-    "goodput", "is_enabled", "lower_cached", "lowering", "mfu_estimate",
+    "BUCKETS", "EventLog", "FeedWindow", "GoodputAccountant",
+    "LoweredProgram", "MetricsRegistry", "Timeline",
+    "TraceCapture", "current_span", "events", "events_block",
+    "get_accountant", "get_registry",
+    "goodput", "is_enabled", "load_timeline", "lower_cached", "lowering",
+    "mfu_estimate",
     "peak_flops_for", "prometheus", "registry", "render_text",
-    "set_enabled", "span", "spans", "trace",
+    "set_enabled", "span", "spans", "timeline", "trace",
 ]

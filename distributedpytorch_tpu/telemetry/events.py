@@ -1,0 +1,253 @@
+"""Flight recorder: the process-wide, crash-safe run-event log.
+
+The stack's evidence was fragmented: governor.jsonl, quarantine.jsonl,
+supervisor.jsonl, the flywheel ledger, COMMITTED.json and the swap
+pool's counters each record one subsystem in one file with one schema.
+A real incident (preempt -> topology change -> replan -> rollback ->
+canary rollback) spans several process generations and hosts, and no
+single file tells the story.  This module is the unifying sink: ONE
+versioned line schema, appended to ``run_dir/events/<host>.<pid>.jsonl``
+by every subsystem through tiny adapters at their existing choke points
+— the existing ledgers are untouched (they remain each subsystem's
+authoritative record; the event log is the cross-cutting index the
+timeline merger and ``dptpu-doctor`` read).
+
+Schema (version 1), one JSON object per line::
+
+    {"v": 1, "ts_wall": <time.time()>, "ts_mono": <perf_counter()>,
+     "host": str, "pid": int, "generation": int|null,
+     "source": str, "kind": str, "step": int|null, "epoch": int|null,
+     "payload": {...}}
+
+``ts_mono`` orders events WITHIN a process (immune to NTP steps);
+``ts_wall`` aligns processes and hosts.  The merger
+(:mod:`telemetry.timeline`) reconciles the two so host clock skew can
+never reorder cause and effect inside one process.  ``generation`` is
+the process generation under supervision (the ``run_<N>`` index for a
+trainer, the attempt number for supervisor events) — the stitching key
+across restarts.
+
+Idioms (the JsonlWriter contract, train/logging.py): the stream is
+line-buffered so a crashed process keeps its tail; non-finite floats
+serialize as ``null`` (strict JSON — a diverging run is exactly when
+the log must stay machine-readable); a recorder failure must NEVER
+kill the run it records — I/O and serialization errors are swallowed
+and counted (``dropped``), and the count surfaces in bench's ``events``
+block and the doctor.
+
+Emission is host-side only and sits off the per-step path: emitters
+fire at decision/boundary cadence (governor decisions, rollbacks,
+checkpoint saves, restarts), never per step, and the disabled path is
+one module-attribute check — the same <=2%-of-step overhead contract
+every other telemetry hook carries.
+
+Deliberately stdlib + numpy-free and importable before jax: the
+supervisor (train/supervise.py) emits into it, and the supervisor must
+stay a process the failure it supervises cannot take down.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import socket
+import threading
+import time
+
+#: schema version stamped on every line; bump on any key change
+SCHEMA_VERSION = 1
+
+#: the one line schema, in emission order (payload last)
+EVENT_KEYS = ("v", "ts_wall", "ts_mono", "host", "pid", "generation",
+              "source", "kind", "step", "epoch", "payload")
+
+#: the emitting subsystems (the ``source`` field's closed set — the
+#: timeline's episode detectors key on these)
+SOURCES = ("trainer", "governor", "sentinel", "checkpoint", "preemption",
+           "supervisor", "serve", "flywheel", "chaos")
+
+_RUN_RE = re.compile(r"run_(\d+)$")
+
+
+def run_generation(run_dir: str) -> int | None:
+    """The ``run_<N>`` index of a run dir (the trainer's process
+    generation under supervision); None for non-run_<N> paths."""
+    m = _RUN_RE.search(os.path.normpath(run_dir))
+    return int(m.group(1)) if m else None
+
+
+def _jsonable(v):
+    """Non-finite -> null, recursively (the JsonlWriter rule)."""
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(v, (int, str)):
+        return v
+    if isinstance(v, float):
+        return v if math.isfinite(v) else None
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return [_jsonable(x) for x in v]
+    # numpy scalars (and anything float()-able) without importing numpy:
+    # the supervisor path must stay stdlib-importable
+    try:
+        f = float(v)
+        return f if math.isfinite(f) else None
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+class EventLog:
+    """Append-only event stream for one process at one run dir.
+
+    One file per (host, pid): concurrent processes (multi-host, the
+    supervisor beside its child) never interleave writes, and the merger
+    gets per-process monotonic order for free.
+    """
+
+    def __init__(self, run_dir: str, generation: int | None = None):
+        self.run_dir = run_dir
+        self.generation = (run_generation(run_dir)
+                           if generation is None else int(generation))
+        self.host = socket.gethostname()
+        self.pid = os.getpid()
+        self.emitted = 0
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self.path: str | None = None
+        self._f = None
+        try:
+            events_dir = os.path.join(run_dir, "events")
+            os.makedirs(events_dir, exist_ok=True)
+            self.path = os.path.join(events_dir,
+                                     f"{self.host}.{self.pid}.jsonl")
+            # line-buffered: a crashed run keeps its tail (the last
+            # lines before the crash are the diagnosis)
+            self._f = open(self.path, "a", buffering=1)
+        except OSError:
+            # a read-only run dir must not kill the process it records;
+            # every emit() becomes a counted drop
+            self.path = None
+
+    def emit(self, source: str, kind: str, *, step: int | None = None,
+             epoch: int | None = None, generation: int | None = None,
+             payload: dict | None = None) -> None:
+        """Append one event.  Never raises; failures count as drops."""
+        rec = {
+            "v": SCHEMA_VERSION,
+            "ts_wall": time.time(),
+            "ts_mono": time.perf_counter(),
+            "host": self.host,
+            "pid": self.pid,
+            "generation": (self.generation if generation is None
+                           else int(generation)),
+            "source": source,
+            "kind": kind,
+            "step": None if step is None else int(step),
+            "epoch": None if epoch is None else int(epoch),
+            "payload": _jsonable(payload or {}),
+        }
+        try:
+            line = json.dumps(rec, allow_nan=False)
+        except (TypeError, ValueError):
+            self.dropped += 1
+            return
+        with self._lock:
+            if self._f is None:
+                self.dropped += 1
+                return
+            try:
+                self._f.write(line + "\n")
+                self.emitted += 1
+            except (OSError, ValueError):
+                self.dropped += 1
+
+    def block(self) -> dict:
+        """The bench ``events`` block: keys always present."""
+        return {"emitted": int(self.emitted), "dropped": int(self.dropped),
+                "path": self.path}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+
+# --------------------------------------------------------- process state
+#
+# A stack, not a bare singleton: a flywheel process configures its work
+# dir, then each in-process fit configures its own run_<N> — the fit's
+# events land under the fit's run dir, and release() restores the
+# flywheel's log when the trainer closes.
+
+_STACK: list[EventLog] = []
+_STACK_LOCK = threading.Lock()
+
+
+def configure(run_dir: str, generation: int | None = None) -> EventLog:
+    """Open (and make current) an event log under ``run_dir``."""
+    log = EventLog(run_dir, generation=generation)
+    with _STACK_LOCK:
+        _STACK.append(log)
+    return log
+
+
+def release(log: EventLog | None) -> None:
+    """Close ``log`` and restore the previously configured one."""
+    if log is None:
+        return
+    log.close()
+    with _STACK_LOCK:
+        if log in _STACK:
+            _STACK.remove(log)
+
+
+def current() -> EventLog | None:
+    return _STACK[-1] if _STACK else None
+
+
+def emit(source: str, kind: str, *, step: int | None = None,
+         epoch: int | None = None, generation: int | None = None,
+         payload: dict | None = None) -> None:
+    """Module-level adapter every subsystem calls: a no-op (one list
+    check) when no log is configured — the disabled path's whole cost."""
+    if not _STACK:
+        return
+    log = _STACK[-1]
+    log.emit(source, kind, step=step, epoch=epoch,
+             generation=generation, payload=payload)
+
+
+def events_block() -> dict:
+    """The bench record's ``events`` block from the current log — keys
+    ALWAYS present, all None when no log is configured (telemetry off:
+    the recovery/plan null convention)."""
+    log = current()
+    if log is None:
+        return {"emitted": None, "dropped": None, "path": None}
+    return log.block()
+
+
+def read_events_file(path: str) -> list[dict]:
+    """Parse one event file, tolerating a torn last line (the crash-safe
+    read half: a SIGKILLed process's final partial write is dropped, not
+    fatal)."""
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail / partial write
+                if isinstance(rec, dict) and rec.get("v") == SCHEMA_VERSION:
+                    out.append(rec)
+    except OSError:
+        pass
+    return out

@@ -289,6 +289,7 @@ class FeedWindow:
         if size < 1:
             raise ValueError(f"window size must be >= 1, got {size}")
         self._ring: collections.deque = collections.deque(maxlen=int(size))
+        self.dropped = 0
 
     def __len__(self) -> int:
         return len(self._ring)
@@ -299,7 +300,15 @@ class FeedWindow:
 
     def push(self, busy_s: float, input_wait_s: float) -> None:
         if busy_s < 0 or input_wait_s < 0:
-            # clock skew / reset between snapshots: drop, never poison
+            # clock skew / accountant reset between snapshots: drop, never
+            # poison the window — but COUNT the drop (a silently shrinking
+            # sample base looked exactly like a healthy feed), so /metrics
+            # and the doctor can tell "no stalls" from "no samples"
+            self.dropped += 1
+            get_registry().counter(
+                "telemetry_dropped_deltas_total",
+                "goodput deltas dropped for being negative "
+                "(accountant reset raced the feed window)").inc()
             return
         self._ring.append((float(busy_s), float(input_wait_s)))
 

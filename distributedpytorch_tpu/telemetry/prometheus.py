@@ -36,7 +36,17 @@ def _fmt(v: float) -> str:
 
 
 def _escape(v: str) -> str:
+    """Label-value escaping (0.0.4 spec): backslash, double-quote, and
+    line feed — exactly these three, in this order (backslash first or
+    the later escapes get double-escaped)."""
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: only backslash and line feed — the spec does
+    NOT escape double-quote outside label values, and scrapers take a
+    literal ``\\"`` in HELP at face value (two characters, wrong text)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _labels(pairs, extra: tuple = ()) -> str:
@@ -48,7 +58,7 @@ def _labels(pairs, extra: tuple = ()) -> str:
 
 def _render_family(fam: Family, lines: list[str]) -> None:
     if fam.help:
-        lines.append(f"# HELP {fam.name} {_escape(fam.help)}")
+        lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
     kind = "summary" if fam.kind == "histogram" else fam.kind
     lines.append(f"# TYPE {fam.name} {kind}")
     for child in fam.children():

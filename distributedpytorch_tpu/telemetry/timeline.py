@@ -1,0 +1,351 @@
+"""Timeline merger: one causally-ordered story from a run's event logs.
+
+A supervised run leaves N process generations (``run_<N>`` dirs plus the
+supervisor's own process), each with per-(host, pid) event files under
+``events/`` (:mod:`telemetry.events`).  This module stitches them into a
+single ordered timeline and detects typed **episodes** — the recurring
+incident shapes the doctor reports on:
+
+* ``divergence_rollback``  — chaos/NaN strike -> sentinel rollback ->
+  replay (recovery = the measured restore seconds)
+* ``stall_ladder``         — governor arms an actuation -> stall drains
+  -> hysteresis disarm (recovery = the arm->disarm span)
+* ``preempt_resume``       — preemption signal / supervisor ``preempted``
+  -> next generation spawned and fitting (recovery = the downtime span)
+* ``crash_restart``        — supervisor ``crash`` -> next spawn
+* ``topology_replan``      — supervisor ``topology_changed`` -> restore
+  through the plan crossing in the next generation
+* ``canary``               — swap admitted -> promoted / rolled back
+* ``flywheel_cycle``       — one flywheel poll's verdict (committed /
+  promoted / rolled_back / held)
+
+Clock reconciliation: every event carries BOTH ``ts_wall`` and
+``ts_mono``.  Within one process file, ``ts_mono`` is the truth — an
+NTP step can never reorder a process against itself.  Across files, a
+per-file offset (median of ``ts_wall - ts_mono`` over the file) maps
+monotonic stamps onto one wall axis, so the merged order preserves each
+process's internal order exactly and aligns processes by their median
+wall clock — bounded host skew shifts a whole process, never shuffles
+its cause and effect.  The generation chain (supervisor ledger +
+``COMMITTED.json``) is the cross-check: process generations are serial
+by construction.
+
+Stdlib only (json/os/glob/statistics): the doctor must run on a dead
+run dir from any machine, jax-free.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import statistics
+
+from .events import read_events_file, run_generation
+
+#: governor actions that open a stall episode when applied (data/governor
+#: ladder rungs that actuate; ``recommend``/``shortfall`` only advise)
+_STALL_ARM = ("raise_prefetch", "flip_device_path", "arm_echo",
+              "raise_echo")
+
+#: episode types, closed set (doc + doctor rendering order)
+EPISODE_TYPES = ("divergence_rollback", "stall_ladder", "preempt_resume",
+                 "crash_restart", "topology_replan", "canary",
+                 "flywheel_cycle")
+
+
+def _read_jsonl(path: str) -> list[dict]:
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def discover_event_files(path: str) -> list[str]:
+    """Every event file under ``path``: its own ``events/`` plus every
+    ``run_<N>/events/`` (a work_dir spanning generations, or one run)."""
+    files = sorted(glob.glob(os.path.join(path, "events", "*.jsonl")))
+    for run in sorted(glob.glob(os.path.join(path, "run_*"))):
+        if run_generation(run) is not None:
+            files.extend(sorted(glob.glob(
+                os.path.join(run, "events", "*.jsonl"))))
+    return files
+
+
+def merge_events(files: list[str]) -> list[dict]:
+    """Read, reconcile and merge event files into one ordered list.
+
+    Each event gains ``t`` (reconciled wall time) and ``seq`` (its index
+    in the merged order).  Per-file order is the file's append order
+    (process-monotonic); the merge key is ``(t, file, line)`` so equal
+    stamps stay deterministic."""
+    streams: list[list[dict]] = []
+    for path in files:
+        evs = read_events_file(path)
+        if not evs:
+            continue
+        # per-file monotonic->wall offset: the median survives a wall
+        # step mid-run (half the samples would have to move to drag it)
+        offset = statistics.median(
+            e["ts_wall"] - e["ts_mono"] for e in evs)
+        for i, e in enumerate(evs):
+            e["t"] = e["ts_mono"] + offset
+            e["_file"] = os.path.basename(path)
+            e["_line"] = i
+        streams.append(evs)
+    merged = sorted((e for s in streams for e in s),
+                    key=lambda e: (e["t"], e["_file"], e["_line"]))
+    for seq, e in enumerate(merged):
+        e["seq"] = seq
+    return merged
+
+
+def _close(ep: dict, ev: dict, recovery_s: float | None = None) -> None:
+    ep["end"] = ev["t"]
+    ep["events"].append(ev["seq"])
+    ep["resolved"] = True
+    ep["duration_s"] = round(ev["t"] - ep["start"], 3)
+    if recovery_s is not None:
+        ep["recovery_s"] = round(float(recovery_s), 3)
+    elif ep.get("recovery_s") is None:
+        ep["recovery_s"] = ep["duration_s"]
+
+
+def _open(etype: str, ev: dict, **detail) -> dict:
+    return {"type": etype, "start": ev["t"], "end": None,
+            "duration_s": None, "recovery_s": None, "resolved": False,
+            "generation": ev.get("generation"),
+            "events": [ev["seq"]], "detail": detail}
+
+
+def detect_episodes(events: list[dict]) -> tuple[list[dict], list[dict]]:
+    """Typed episodes over a merged timeline; returns
+    ``(episodes, orphans)`` where orphans are the opening events whose
+    episode never closed (plus closers that matched nothing)."""
+    episodes: list[dict] = []
+    orphans: list[dict] = []
+
+    # --- divergence -> rollback -> replay (sentinel) -------------------
+    last_nan: dict | None = None
+    open_rb: dict | None = None
+    for ev in events:
+        src, kind = ev["source"], ev["kind"]
+        if src == "chaos" and kind == "nan":
+            last_nan = ev
+        elif src == "sentinel" and kind == "rollback":
+            ep = _open("divergence_rollback", ev,
+                       reason=ev["payload"].get("reason"),
+                       rollback_to_step=ev["payload"].get(
+                           "rollback_to_step"))
+            if last_nan is not None and last_nan["t"] <= ev["t"]:
+                ep["start"] = last_nan["t"]
+                ep["events"].insert(0, last_nan["seq"])
+                ep["detail"]["injected"] = True
+                last_nan = None
+            ep["recovery_s"] = ev["payload"].get("restore_seconds")
+            episodes.append(ep)
+            open_rb = ep
+        elif src == "sentinel" and kind == "replay":
+            if open_rb is not None and not open_rb["resolved"]:
+                _close(open_rb, ev,
+                       recovery_s=open_rb.get("recovery_s"))
+                open_rb = None
+            else:
+                orphans.append(ev)
+
+    # --- governor stall ladder ----------------------------------------
+    open_stall: dict | None = None
+    for ev in events:
+        if ev["source"] != "governor":
+            continue
+        applied = bool(ev["payload"].get("applied"))
+        if ev["kind"] in _STALL_ARM and applied:
+            if open_stall is None:
+                open_stall = _open("stall_ladder", ev,
+                                   stall=ev["payload"].get("stall"),
+                                   target=ev["payload"].get("target"))
+                episodes.append(open_stall)
+            else:
+                open_stall["events"].append(ev["seq"])
+        elif ev["kind"] == "disarm_echo" and applied:
+            if open_stall is not None:
+                _close(open_stall, ev)
+                open_stall = None
+            else:
+                orphans.append(ev)
+
+    # --- supervisor chains: preempt / crash / topology -----------------
+    # a preemption signal inside generation g and the supervisor's own
+    # classification of g's exit open the same episode — keep one
+    open_chain: dict | None = None
+    for ev in events:
+        src, kind = ev["source"], ev["kind"]
+        if src == "preemption" and kind == "preempt":
+            if open_chain is None:
+                open_chain = _open("preempt_resume", ev)
+                episodes.append(open_chain)
+        elif src == "supervisor" and kind in ("preempted", "crash",
+                                              "topology_changed"):
+            etype = {"preempted": "preempt_resume",
+                     "crash": "crash_restart",
+                     "topology_changed": "topology_replan"}[kind]
+            if open_chain is not None and not open_chain["resolved"]:
+                # reclassify: the supervisor's verdict on the same death
+                # outranks the in-process signal sighting
+                open_chain["type"] = etype
+                open_chain["events"].append(ev["seq"])
+                open_chain["detail"].update(ev["payload"])
+            else:
+                open_chain = _open(etype, ev, **ev["payload"])
+                episodes.append(open_chain)
+        elif src == "supervisor" and kind == "restart":
+            if open_chain is not None and not open_chain["resolved"]:
+                open_chain["events"].append(ev["seq"])
+                # the supervisor's measured downtime is the episode's
+                # recovery (the same number chaos_recovery_seconds
+                # observes) — the episode SPAN additionally includes the
+                # dying child's graceful drain, which is not downtime
+                d = ev["payload"].get("downtime_s")
+                if d is not None:
+                    open_chain["recovery_s"] = round(float(d), 3)
+        elif src == "supervisor" and kind == "spawn":
+            if open_chain is not None and not open_chain["resolved"]:
+                # downtime half: death classified -> next child spawned
+                _close(open_chain, ev, recovery_s=None)
+        elif src == "trainer" and kind == "fit_start":
+            if (open_chain is not None and open_chain["resolved"]
+                    and ev["payload"].get("resumed")):
+                # extend through the resume: the episode's full recovery
+                # is death -> restored-and-fitting again
+                open_chain["events"].append(ev["seq"])
+                open_chain["end"] = ev["t"]
+                open_chain["duration_s"] = round(
+                    ev["t"] - open_chain["start"], 3)
+                if ev["payload"].get("plan_crossing"):
+                    open_chain["detail"]["plan_crossing"] = True
+                open_chain = None
+        elif src == "checkpoint" and kind == "topology_crossing":
+            if (open_chain is not None
+                    and open_chain["type"] == "topology_replan"):
+                open_chain["events"].append(ev["seq"])
+                open_chain["detail"]["crossing"] = ev["payload"]
+        elif src == "supervisor" and kind in ("clean_exit", "gave_up",
+                                              "preempted_final"):
+            open_chain = None
+
+    # --- serve canary ---------------------------------------------------
+    open_canary: dict[int, dict] = {}
+    for ev in events:
+        if ev["source"] != "serve":
+            continue
+        gen_id = ev["payload"].get("gen_id")
+        if ev["kind"] == "swap_admit":
+            ep = _open("canary", ev, gen_id=gen_id,
+                       label=ev["payload"].get("label"))
+            episodes.append(ep)
+            open_canary[gen_id] = ep
+        elif ev["kind"] in ("swap_promote", "swap_rollback"):
+            ep = open_canary.pop(gen_id, None)
+            if ep is None:
+                orphans.append(ev)
+                continue
+            ep["detail"]["outcome"] = ("promoted"
+                                       if ev["kind"] == "swap_promote"
+                                       else "rolled_back")
+            _close(ep, ev)
+
+    # --- flywheel cycles ------------------------------------------------
+    for ev in events:
+        if ev["source"] != "flywheel" or ev["kind"] == "idle":
+            continue
+        ep = _open("flywheel_cycle", ev, action=ev["kind"],
+                   reason=ev["payload"].get("reason"))
+        _close(ep, ev)
+        episodes.append(ep)
+
+    orphans.extend(ev for ep in episodes if not ep["resolved"]
+                   for ev in [events[ep["events"][0]]])
+    episodes.sort(key=lambda ep: ep["start"])
+    return episodes, orphans
+
+
+class Timeline:
+    """The merged, episode-annotated record of one (possibly
+    multi-generation) run."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.files = discover_event_files(path)
+        self.events = merge_events(self.files)
+        self.episodes, self.orphans = detect_episodes(self.events)
+        #: the supervisor's authoritative ledger (empty for unsupervised
+        #: runs) — the generation chain's anchor
+        self.supervisor = _read_jsonl(
+            os.path.join(path, "supervisor.jsonl"))
+        #: per-run committed steps (COMMITTED.json), the durable
+        #: progress chain: {run_dir_basename: [steps...]}
+        self.committed: dict[str, list[int]] = {}
+        run_dirs = [path] + sorted(glob.glob(os.path.join(path, "run_*")))
+        for rd in run_dirs:
+            ledger = _read_json(
+                os.path.join(rd, "checkpoints", "COMMITTED.json"))
+            if ledger:
+                self.committed[os.path.basename(rd) or rd] = \
+                    [int(s) for s in ledger.get("latest", [])]
+
+    @property
+    def generations(self) -> list[int]:
+        """Distinct process generations seen in the event stream."""
+        return sorted({e["generation"] for e in self.events
+                       if e.get("generation") is not None})
+
+    def span_s(self) -> float:
+        if not self.events:
+            return 0.0
+        return self.events[-1]["t"] - self.events[0]["t"]
+
+    def by_source(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for e in self.events:
+            out[e["source"]] = out.get(e["source"], 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "files": [os.path.relpath(f, self.path) for f in self.files],
+            "events_total": len(self.events),
+            "generations": self.generations,
+            "span_s": round(self.span_s(), 3),
+            "by_source": self.by_source(),
+            "episodes": self.episodes,
+            "orphans": [{k: e.get(k) for k in
+                         ("seq", "source", "kind", "generation", "t")}
+                        for e in self.orphans],
+            "supervisor_events": len(self.supervisor),
+            "committed": self.committed,
+        }
+
+
+def load_timeline(path: str) -> Timeline:
+    """Stitch the timeline of ``path`` (a work_dir spanning run_<N>
+    generations, or a single run dir)."""
+    return Timeline(path)

@@ -31,6 +31,7 @@ import orbax.checkpoint as ocp
 
 from ..chaos import sites as chaos_sites
 from ..parallel import TrainState
+from ..telemetry import events as events_lib
 from ..telemetry import get_accountant, span
 
 
@@ -154,10 +155,16 @@ class CheckpointManager:
         ledger that exists to diagnose torn writes."""
         if jax.process_index() != 0:
             return
+        latest = sorted(int(s) for s in self._mgr.all_steps())
         atomic_write_json(
             os.path.join(self.directory, self._LEDGER),
-            {"latest": sorted(int(s) for s in self._mgr.all_steps()),
+            {"latest": latest,
              "best": sorted(int(s) for s in self._best.all_steps())})
+        # flight recorder: the commit anchor — the rollback target set /
+        # supervisor progress signal the timeline stitches generations on
+        events_lib.emit("checkpoint", "commit",
+                        step=(latest[-1] if latest else None),
+                        payload={"committed_steps": len(latest)})
 
     def committed_steps(self, best: bool = False) -> set[int]:
         """Steps the ledger records as fully landed in the requested
@@ -207,6 +214,11 @@ class CheckpointManager:
             self._mgr.save(step, args=ocp.args.Composite(**payload))
             if is_best:
                 self._best.save(step, args=ocp.args.Composite(**payload))
+            events_lib.emit(
+                "checkpoint", "save", step=int(step),
+                epoch=(int(meta["epoch"]) if "epoch" in meta else None),
+                payload={"best": is_best, "async": self._async_save,
+                         "preempted": bool(meta.get("preempted"))})
             if not self._async_save:
                 # sync saves have landed; async ones commit at wait()
                 self._write_ledger()
@@ -283,6 +295,12 @@ class CheckpointManager:
                 lambda x: jnp.copy(x) if isinstance(x, jax.Array)
                 else x, restored["state"])
             meta = restored["meta"]
+            events_lib.emit(
+                "checkpoint", "restore",
+                step=(int(meta.get("step"))
+                      if meta.get("step") is not None else None),
+                payload={"best": best,
+                         "fallback_steps": list(self.last_restore_fallback)})
             self._announce_topology_crossing(meta)
             return fresh, meta
 
@@ -301,10 +319,15 @@ class CheckpointManager:
         if not saved:
             return  # pre-fingerprint meta: nothing to compare
         live = topology_fingerprint()
-        if saved != live and jax.process_index() == 0:
-            print(f"checkpoint: restoring across a topology change "
-                  f"({saved} -> {live}) — arrays reshard into the "
-                  "target state's layout", flush=True)
+        if saved != live:
+            # flight recorder: the topology crossing (every host — each
+            # process's restore crossed it)
+            events_lib.emit("checkpoint", "topology_crossing",
+                            payload={"saved": saved, "live": live})
+            if jax.process_index() == 0:
+                print(f"checkpoint: restoring across a topology change "
+                      f"({saved} -> {live}) — arrays reshard into the "
+                      "target state's layout", flush=True)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

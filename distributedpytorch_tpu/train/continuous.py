@@ -173,6 +173,13 @@ class Flywheel:
         self._save_state()
         with open(self._ledger_path, "a") as f:
             f.write(json.dumps(entry) + "\n")
+        # flight recorder mirror (telemetry/events.py): flywheel.jsonl
+        # above stays the authoritative cycle ledger
+        from ..telemetry import events as events_lib
+
+        events_lib.emit("flywheel", entry.get("action") or "cycle",
+                        payload=dict(entry,
+                                     cycle=int(self._state["cycles"])))
 
     @property
     def quarantine(self) -> list[int]:
@@ -396,6 +403,12 @@ def main(argv=None) -> int:
     cfg = from_json(args.config) if args.config else Config()
     if args.override:
         cfg = apply_overrides(cfg, list(args.override))
+    # flight recorder: the flywheel's cycle events (and the pool's swap
+    # events it drives) land under the work dir; each in-process fit
+    # pushes its own run_<N> log for the fit's duration
+    from ..telemetry import events as events_lib
+
+    events_lib.configure(args.work_dir)
     fw = Flywheel(args.log, cfg, args.work_dir,
                   min_new_records=args.min_new_records,
                   fit_epochs=args.fit_epochs,

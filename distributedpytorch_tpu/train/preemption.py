@@ -165,6 +165,13 @@ class PreemptionGuard:
                 "termination signals delivered to this process"
             ).inc(seen - self._signals_reported)
             self._signals_reported = seen
+            # flight recorder: first sight of the signal(s), from normal
+            # thread context (the handler itself stays lock-free) — the
+            # preempt -> resume episode's opening anchor
+            from ..telemetry import events as events_lib
+
+            events_lib.emit("preemption", "preempt",
+                            payload={"signals_received": seen})
         get_registry().gauge(
             "preemption_stop_pending",
             "1 while a graceful stop is requested but not yet taken"

@@ -60,6 +60,7 @@ import time
 from typing import Callable, Sequence
 
 from ..chaos.policies import Retry
+from ..telemetry import events as events_lib
 from . import elastic as elastic_lib
 
 #: classification outcomes (the ``outcome`` field of run() reports)
@@ -289,6 +290,14 @@ class Supervisor:
                 f.write(json.dumps(ev) + "\n")
         except OSError:
             pass  # a read-only work dir must not kill supervision
+        # flight recorder mirror (telemetry/events.py, stdlib — keeps
+        # the supervisor pre-jax): supervisor.jsonl above stays the
+        # authoritative classification ledger; the event copy is what
+        # the timeline merger anchors the generation chain on.  The
+        # attempt number IS the process generation.
+        events_lib.emit("supervisor", kind,
+                        generation=fields.get("attempt"),
+                        payload=fields)
 
     def _book(self, reason: str, downtime_s: float | None) -> None:
         if not self._telemetry:
@@ -323,6 +332,18 @@ class Supervisor:
     def run(self) -> dict:
         """Supervise to completion; returns the report dict.  Raises
         :class:`CrashLoopError` on give-up (report attached)."""
+        # flight recorder for the supervisor's own process: its events
+        # land under <work_dir>/events/ and stitch the per-run_<N>
+        # generations into one chain.  Best-effort (a read-only work dir
+        # degrades to counted drops), released on every way out.
+        evlog = events_lib.configure(self.work_dir) \
+            if self._telemetry else None
+        try:
+            return self._run_supervised()
+        finally:
+            events_lib.release(evlog)
+
+    def _run_supervised(self) -> dict:
         restarts = {PREEMPTED: 0, CRASHED: 0, TOPOLOGY_CHANGED: 0}
         loop_count = 0
         loop_t0: float | None = None

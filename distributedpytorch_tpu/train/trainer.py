@@ -57,6 +57,7 @@ from ..parallel import (
 )
 from ..parallel import plan as plan_lib
 from ..telemetry import TraceCapture, get_accountant, mfu_estimate
+from ..telemetry import events as events_lib
 from ..telemetry import set_enabled as telemetry_set_enabled
 from ..utils.helpers import generate_param_report
 from ..utils.profiling import device_memory_stats
@@ -176,6 +177,12 @@ class Trainer:
 
         # --- run dir (reference run_<N> scheme, train_pascal.py:73-82)
         self.run_dir = next_run_dir(cfg.work_dir)
+        # --- flight recorder (telemetry/events.py): every host opens its
+        # own run_dir/events/<host>.<pid>.jsonl; the run_<N> index is the
+        # process generation the timeline merger stitches on.  cfg.telemetry
+        # off = never configured = every emit() is one list check.
+        self._events = (events_lib.configure(self.run_dir)
+                        if cfg.telemetry else None)
         if writers is not None:
             self.writer = writers
         elif self.is_main:
@@ -1923,6 +1930,11 @@ class Trainer:
         self._sentinel.reset()  # spike verdicts re-warm on the replay
         self._book_rollback(d, target, dt)
         resume_epoch = int(meta.get("epoch", -1)) + 1
+        # flight recorder: the replay anchor closing the
+        # divergence -> rollback -> replay episode
+        events_lib.emit("sentinel", "replay", step=int(self.state.step),
+                        epoch=resume_epoch,
+                        payload={"rolled_back_to_step": int(target)})
         # completed-epoch history about to be replayed is dropped — the
         # replay logs the real entries (same rule as preempt resume).
         # val entries carry their epoch stamp, so a rollback past a
@@ -2001,6 +2013,15 @@ class Trainer:
                      self.sentinel_quarantined_steps,
                  "train/sentinel_rollback_to_step": int(target)},
                 d.step_end)
+        # flight recorder: the rollback itself (every host; quarantine.jsonl
+        # above stays the main-only authoritative ledger)
+        events_lib.emit(
+            "sentinel", "rollback", step=d.step_end, epoch=d.epoch,
+            payload={"reason": d.report.reason,
+                     "rollback_to_step": int(target),
+                     "restore_seconds": round(seconds, 3),
+                     "batch_indices": sorted(int(b)
+                                             for b in d.batch_indices)})
         if self.cfg.telemetry:
             from ..telemetry import get_registry
             from ..telemetry.registry import is_enabled
@@ -2226,6 +2247,15 @@ class Trainer:
         # so telemetry=false is the true zero-instrumentation baseline.
         telemetry_set_enabled(cfg.telemetry)
         get_accountant().reset(enabled=cfg.telemetry)
+        # flight recorder: the generation's opening anchor — the timeline
+        # merger bounds every generation by this fit_start/fit_end pair
+        # (an unpaired fit_start IS the crash evidence)
+        events_lib.emit(
+            "trainer", "fit_start", step=int(self.state.step),
+            epoch=self.start_epoch,
+            payload={"epochs": cfg.epochs,
+                     "resumed": bool(self.resume_meta),
+                     "plan_crossing": bool(self.resume_plan_crossing)})
         # chaos: arm an env-named fault plan (DPTPU_CHAOS_PLAN) for this
         # fit; with the env unset and nothing armed this is one getenv.
         chaos_sites.maybe_arm_from_env()
@@ -2411,6 +2441,19 @@ class Trainer:
                      # the resolved plan this run actually trained under
                      # (under strategy=auto, the ladder's pick)
                      "plan": self.plan.block()})
+            gp = history.get("goodput") or {}
+            events_lib.emit(
+                "trainer", "fit_end", step=int(self.state.step),
+                payload={"preempted": bool(history.get("preempted")),
+                         "epochs_recorded": len(history["train_loss"]),
+                         "rollbacks": self.sentinel_rollbacks,
+                         # the goodput breakdown rides the closing anchor
+                         # so the doctor's wall-clock sinks need no
+                         # writer-specific metrics file
+                         "goodput": {
+                             "total_s": gp.get("total_s"),
+                             "buckets": gp.get("buckets"),
+                             "productive_frac": gp.get("goodput")}})
             self.writer.flush()
         return history
 
@@ -2419,3 +2462,7 @@ class Trainer:
             self._trace.close()
         self.ckpt.close()
         self.writer.close()
+        # restores any outer event log (a flywheel's, when the fit ran
+        # in-process) as the current sink
+        events_lib.release(self._events)
+        self._events = None

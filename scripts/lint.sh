@@ -54,8 +54,17 @@
 #      pre-jax), and the continuous-mode supervisor is a host-side
 #      polling loop that must never smuggle a sync into the fits it
 #      launches — and the flywheel adds NO new jitted programs, so the
-#      jaxaudit contract set below is unchanged by it) plus bench.py,
-#      the official record.
+#      jaxaudit contract set below is unchanged by it;
+#      telemetry/events.py + telemetry/doctor.py included — the flight
+#      recorder's emit() rides every instrumented seam (its armed cost
+#      is pinned <=2% of step and the unconfigured path is ONE list
+#      check, no host syncs, no device touches) and the recorder +
+#      timeline + doctor triple must stay stdlib+numpy importable
+#      pre-jax: the supervisor publishes into the same log, and a dead
+#      run dir must be diagnosable from any machine with no
+#      accelerator stack — and the recorder adds NO new jitted
+#      programs, so the jaxaudit contract set below is unchanged by it
+#      too) plus bench.py, the official record.
 #      `jaxlint --stats` then polices the suppressions themselves: a
 #      `# jaxlint:`/`# jaxguard:` disable whose rule no longer fires is
 #      a dead waiver waiting to swallow the next real finding — it

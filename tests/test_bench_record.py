@@ -425,6 +425,55 @@ class TestCheckRegression:
         assert blk["topology_changes"] == 3 and blk["replans"] == 2
         assert blk["recovery_p50_s"] == 1.5
 
+    def test_recorder_armed_records_never_baseline_off_ones(
+            self, tmp_path):
+        # a record measured with the flight recorder armed (events block
+        # populated) and a recorder-off one are different regimes — the
+        # filter keys on the block's path; null/missing == off (the
+        # default), so pre-recorder committed history still compares
+        armed = self._rec(60.0)
+        armed["events"] = {"emitted": 12, "dropped": 0,
+                           "path": "runs/run_0001/events/h.1.jsonl"}
+        with open(tmp_path / "BENCH_r01.json", "w") as f:
+            json.dump({"parsed": armed}, f)
+        hist = bench.load_bench_history(str(tmp_path))
+        # recorder-off candidate: the armed record is not its baseline
+        ok, msg = bench.check_regression(self._rec(40.0), hist)
+        assert ok and "nothing to compare" in msg
+        # recorder-armed candidate gates against the armed record
+        cand = self._rec(40.0)
+        cand["events"] = {"emitted": 3, "dropped": 0,
+                          "path": "runs/run_0002/events/h.2.jsonl"}
+        ok, msg = bench.check_regression(cand, hist)
+        assert not ok and "regression" in msg
+        # an all-null events block is the off regime, same as missing
+        nulled = self._rec(58.0)
+        nulled["events"] = {"emitted": None, "dropped": None,
+                            "path": None}
+        prior = self._rec(60.0)
+        with open(tmp_path / "BENCH_r02.json", "w") as f:
+            json.dump({"parsed": prior}, f)
+        ok, _ = bench.check_regression(
+            nulled, bench.load_bench_history(str(tmp_path)))
+        assert ok
+
+    def test_events_block_schema(self):
+        # the block builder (telemetry/events.py): keys ALWAYS present,
+        # all null when no log is configured
+        from distributedpytorch_tpu.telemetry import events as events_lib
+
+        saved = events_lib._STACK[:]
+        events_lib._STACK.clear()
+        try:
+            blk = events_lib.events_block()
+        finally:
+            events_lib._STACK.extend(saved)
+        assert blk == {"emitted": None, "dropped": None, "path": None}
+        assert not bench._events_enabled({"events": blk})
+        assert not bench._events_enabled({})
+        assert bench._events_enabled(
+            {"events": {"emitted": 1, "dropped": 0, "path": "x.jsonl"}})
+
     def test_quantization_variants_never_cross_compare(self, tmp_path):
         # an int8-quantized serve record and an f32 one run different
         # compiled programs — the filter keys on the quantization

@@ -407,6 +407,11 @@ class TestCheckpointTornFiles:
 class TestScenarioSmoke:
     """The fast tier-1 chaos smokes: full runner path, in-process."""
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): full sentinel-armed fit
+    # (~21s); the runner path keeps its fast gates
+    # (test_nan_loss_legacy_scenario, test_serve_latency_shed_scenario)
+    # and recovered-run artifacts stay covered by the committed
+    # flight-recorder fixture replays in test_doctor.py
     def test_nan_loss_scenario_recovers(self, tmp_path):
         """PR 7 upgrade: with the sentinel armed, nan_loss asserts the
         run RECOVERS (rollback + quarantine + finite finish), not merely

@@ -1,0 +1,419 @@
+"""Flight recorder -> timeline -> doctor: the diagnosis chain.
+
+Unit level: the event log's schema/crash-safety contracts, the merger's
+clock reconciliation and episode detectors over synthetic streams, the
+doctor's findings and exit codes.  Acceptance level: the committed
+chaos artifacts (tests/fixtures/flight_recorder/ — real
+``dptpu-chaos divergence_rollback`` / ``preemption_storm`` /
+``elastic_membership`` run dirs, text files only) replay through the
+merger and must reconstruct their full multi-generation episode chains
+with ZERO orphan events, recovery seconds matching what
+``chaos_recovery_seconds`` observed.
+
+All jax-free by design: recorder, timeline and doctor must diagnose a
+dead run dir from any machine.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributedpytorch_tpu.telemetry import events as events_lib
+from distributedpytorch_tpu.telemetry import timeline as timeline_lib
+from distributedpytorch_tpu.telemetry.doctor import (
+    THRESHOLDS,
+    detect_findings,
+    diagnose,
+    main,
+    parse_metrics_text,
+    render,
+)
+from distributedpytorch_tpu.telemetry.events import (
+    EVENT_KEYS,
+    SCHEMA_VERSION,
+    EventLog,
+    read_events_file,
+    run_generation,
+)
+from distributedpytorch_tpu.telemetry.timeline import (
+    detect_episodes,
+    load_timeline,
+    merge_events,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "flight_recorder")
+
+
+# ------------------------------------------------------------- event log
+
+class TestEventLog:
+    def test_one_versioned_schema_per_line(self, tmp_path):
+        log = EventLog(str(tmp_path / "run_0003"))
+        log.emit("governor", "arm_echo", step=40, epoch=1,
+                 payload={"stall": 0.3})
+        log.close()
+        (rec,) = read_events_file(log.path)
+        assert tuple(rec) == EVENT_KEYS  # exact keys, exact order
+        assert rec["v"] == SCHEMA_VERSION
+        assert rec["generation"] == 3  # parsed from run_0003
+        assert (rec["source"], rec["kind"]) == ("governor", "arm_echo")
+        assert (rec["step"], rec["epoch"]) == (40, 1)
+        assert rec["payload"] == {"stall": 0.3}
+
+    def test_non_finite_payload_serializes_null(self, tmp_path):
+        log = EventLog(str(tmp_path))
+        log.emit("sentinel", "rollback",
+                 payload={"loss": float("nan"),
+                          "scales": [1.0, float("inf")]})
+        log.close()
+        (rec,) = read_events_file(log.path)
+        assert rec["payload"] == {"loss": None, "scales": [1.0, None]}
+
+    def test_torn_last_line_tolerated(self, tmp_path):
+        log = EventLog(str(tmp_path))
+        log.emit("trainer", "fit_start")
+        log.emit("trainer", "fit_end")
+        log.close()
+        with open(log.path, "a") as f:
+            f.write('{"v": 1, "truncated mid-wri')  # SIGKILL tail
+        recs = read_events_file(log.path)
+        assert [r["kind"] for r in recs] == ["fit_start", "fit_end"]
+
+    def test_unwritable_dir_counts_drops_never_raises(self, tmp_path):
+        # a file squatting on events/ makes the log unopenable (the
+        # root-proof stand-in for a read-only run dir): every emit must
+        # become a counted drop, never an exception
+        (tmp_path / "events").write_text("not a directory")
+        log = EventLog(str(tmp_path))
+        log.emit("trainer", "fit_start")
+        assert log.path is None
+        assert log.block() == {"emitted": 0, "dropped": 1, "path": None}
+
+    def test_unjsonable_payload_is_a_drop_not_a_crash(self, tmp_path):
+        log = EventLog(str(tmp_path))
+        log.emit("serve", "swap_admit", payload={"fn": object()})
+        log.emit("serve", "swap_promote")
+        log.close()
+        # the object() repr-serializes (never raises); both lines land
+        assert log.emitted == 2
+        recs = read_events_file(log.path)
+        assert "object object" in recs[0]["payload"]["fn"]
+
+    def test_configure_release_stack_nests(self, tmp_path):
+        # the flywheel shape: the outer work_dir log is restored when an
+        # inner fit's run_<N> log releases
+        outer = events_lib.configure(str(tmp_path / "work"))
+        inner = events_lib.configure(str(tmp_path / "work" / "run_0001"))
+        try:
+            assert events_lib.current() is inner
+            events_lib.emit("trainer", "fit_start")
+            events_lib.release(inner)
+            assert events_lib.current() is outer
+            events_lib.emit("supervisor", "spawn")
+        finally:
+            events_lib.release(inner)
+            events_lib.release(outer)
+        assert inner.emitted == 1 and outer.emitted == 1
+
+    def test_events_block_null_convention_when_unconfigured(self):
+        saved = events_lib._STACK[:]
+        events_lib._STACK.clear()
+        try:
+            blk = events_lib.events_block()
+        finally:
+            events_lib._STACK.extend(saved)
+        assert blk == {"emitted": None, "dropped": None, "path": None}
+        assert set(blk) == {"emitted", "dropped", "path"}
+
+    def test_run_generation_parses_run_dirs(self):
+        assert run_generation("/w/run_0002") == 2
+        assert run_generation("/w/run_17") == 17
+        assert run_generation("/w/whatever") is None
+
+
+# ------------------------------------------------------- timeline merge
+
+def _line(path, ts_wall, ts_mono, source, kind, gen=0, step=None,
+          payload=None, host="h", pid=1):
+    rec = {"v": SCHEMA_VERSION, "ts_wall": ts_wall, "ts_mono": ts_mono,
+           "host": host, "pid": pid, "generation": gen, "source": source,
+           "kind": kind, "step": step, "epoch": None,
+           "payload": payload or {}}
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+class TestTimelineMerge:
+    def test_monotonic_order_beats_wall_step_within_a_file(self, tmp_path):
+        # an NTP step drags ts_wall BACKWARD mid-file; the reconciled
+        # merge must keep the file's append (monotonic) order
+        p = tmp_path / "h.1.jsonl"
+        _line(p, 1000.0, 10.0, "trainer", "fit_start")
+        _line(p, 995.0, 11.0, "chaos", "nan")       # wall stepped back
+        _line(p, 996.0, 12.0, "sentinel", "rollback")
+        merged = merge_events([str(p)])
+        assert [e["kind"] for e in merged] == ["fit_start", "nan",
+                                               "rollback"]
+        assert merged[0]["t"] < merged[1]["t"] < merged[2]["t"]
+
+    def test_cross_file_alignment_uses_median_offset(self, tmp_path):
+        # two processes, second starts later on the shared wall clock;
+        # each file's mono clock starts near zero
+        a, b = tmp_path / "h.1.jsonl", tmp_path / "h.2.jsonl"
+        _line(a, 100.0, 1.0, "supervisor", "spawn")
+        _line(a, 104.0, 5.0, "supervisor", "preempted")
+        _line(b, 102.0, 1.0, "trainer", "fit_start", pid=2)
+        merged = merge_events([str(a), str(b)])
+        assert [e["kind"] for e in merged] == ["spawn", "fit_start",
+                                               "preempted"]
+
+    def test_wrong_schema_version_filtered(self, tmp_path):
+        p = tmp_path / "h.1.jsonl"
+        _line(p, 1.0, 1.0, "trainer", "fit_start")
+        with open(p, "a") as f:
+            f.write(json.dumps({"v": 99, "ts_wall": 2.0, "ts_mono": 2.0,
+                                "source": "x", "kind": "y"}) + "\n")
+        assert len(merge_events([str(p)])) == 1
+
+
+class TestEpisodeDetection:
+    def _events(self, specs):
+        # specs: (source, kind, payload) at 1s spacing on both clocks
+        evs = []
+        for i, (src, kind, payload) in enumerate(specs):
+            evs.append({"v": 1, "ts_wall": 100.0 + i, "ts_mono": float(i),
+                        "host": "h", "pid": 1, "generation": 0,
+                        "source": src, "kind": kind, "step": i,
+                        "epoch": None, "payload": payload, "t": 100.0 + i,
+                        "seq": i})
+        return evs
+
+    def test_stall_ladder_arm_to_disarm(self):
+        eps, orphans = detect_episodes(self._events([
+            ("governor", "arm_echo", {"applied": True, "stall": 0.4,
+                                      "target": 0.1}),
+            ("governor", "raise_echo", {"applied": True}),
+            ("governor", "disarm_echo", {"applied": True}),
+        ]))
+        (ep,) = eps
+        assert ep["type"] == "stall_ladder" and ep["resolved"]
+        assert ep["events"] == [0, 1, 2] and not orphans
+        assert ep["recovery_s"] == pytest.approx(2.0)
+
+    def test_recommend_only_never_opens_an_episode(self):
+        eps, orphans = detect_episodes(self._events([
+            ("governor", "recommend", {"applied": False}),
+            ("governor", "shortfall", {"applied": False}),
+        ]))
+        assert not eps and not orphans
+
+    def test_unresolved_rollback_is_an_orphan(self):
+        eps, orphans = detect_episodes(self._events([
+            ("sentinel", "rollback", {"restore_seconds": 1.0}),
+        ]))
+        (ep,) = eps
+        assert not ep["resolved"]
+        assert [o["seq"] for o in orphans] == [0]
+
+    def test_canary_promote_and_rollback_keyed_by_gen_id(self):
+        eps, orphans = detect_episodes(self._events([
+            ("serve", "swap_admit", {"gen_id": 1, "label": "a"}),
+            ("serve", "swap_admit", {"gen_id": 2, "label": "b"}),
+            ("serve", "swap_rollback", {"gen_id": 1}),
+            ("serve", "swap_promote", {"gen_id": 2}),
+        ]))
+        assert not orphans
+        outcomes = {ep["detail"]["gen_id"]: ep["detail"]["outcome"]
+                    for ep in eps}
+        assert outcomes == {1: "rolled_back", 2: "promoted"}
+
+    def test_preempt_without_spawn_stays_unresolved(self):
+        eps, orphans = detect_episodes(self._events([
+            ("preemption", "preempt", {"signals_received": 1}),
+            ("supervisor", "preempted_final", {"attempt": 0}),
+        ]))
+        (ep,) = eps
+        assert ep["type"] == "preempt_resume" and not ep["resolved"]
+        assert orphans
+
+
+# ------------------------------------------- committed chaos artifacts
+
+class TestChaosArtifactReplay:
+    """Satellite acceptance: the committed chaos run dirs replay through
+    the merger into their complete episode chains, zero orphans."""
+
+    def test_divergence_rollback_chain(self):
+        tl = load_timeline(os.path.join(FIXTURES, "divergence_rollback"))
+        assert tl.orphans == []
+        (ep,) = [e for e in tl.episodes
+                 if e["type"] == "divergence_rollback"]
+        assert ep["resolved"] and ep["detail"]["injected"]
+        # recovery = the sentinel's measured restore_seconds — the same
+        # number _observe_recovery fed chaos_recovery_seconds
+        (rb,) = [e for e in tl.events if e["kind"] == "rollback"]
+        assert ep["recovery_s"] == pytest.approx(
+            rb["payload"]["restore_seconds"])
+        # the chain joins chaos strike -> rollback -> replay
+        kinds = [tl.events[s]["kind"] for s in ep["events"]]
+        assert kinds == ["nan", "rollback", "replay"]
+
+    def test_preemption_storm_multi_generation_chain(self):
+        tl = load_timeline(os.path.join(FIXTURES, "preemption_storm"))
+        assert tl.orphans == []
+        assert tl.generations == [0, 1, 2, 3]
+        eps = [e for e in tl.episodes if e["type"] == "preempt_resume"]
+        assert len(eps) == 3 and all(e["resolved"] for e in eps)
+        # recovery = the supervisor's measured downtime (what
+        # chaos_recovery_seconds observed), per episode
+        downtimes = [e["payload"]["downtime_s"] for e in tl.events
+                     if e["kind"] == "restart"]
+        assert [e["recovery_s"] for e in eps] == \
+            [pytest.approx(d, abs=5e-4) for d in downtimes]
+        # each episode spans the preempt signal through the resumed fit
+        for ep in eps:
+            kinds = [tl.events[s]["kind"] for s in ep["events"]]
+            assert kinds[0] == "preempt" and kinds[-1] == "fit_start"
+            assert tl.events[ep["events"][-1]]["payload"]["resumed"]
+
+    def test_elastic_membership_replan_chain(self):
+        tl = load_timeline(os.path.join(FIXTURES, "elastic_membership"))
+        assert tl.orphans == []
+        eps = [e for e in tl.episodes if e["type"] == "topology_replan"]
+        assert len(eps) == 3 and all(e["resolved"] for e in eps)
+        # the chain carries the topology crossing AND the plan-crossing
+        # restore: the full story, not just the exit classification
+        shapes = [(e["detail"]["old"], e["detail"]["new"]) for e in eps]
+        assert shapes == [("cpu:8/p1", "cpu:4/p1"),
+                          ("cpu:4/p1", "cpu:2/p1"),
+                          ("cpu:2/p1", "cpu:8/p1")]
+        for ep in eps:
+            assert ep["detail"]["crossing"]["saved"] == ep["detail"]["old"]
+            assert ep["detail"]["plan_crossing"] is True
+        # the committed-step chain is strictly increasing across gens
+        steps = [s for rd in sorted(tl.committed)
+                 for s in tl.committed[rd]]
+        assert steps == sorted(steps)
+
+    def test_supervisor_ledger_anchors_generations(self):
+        tl = load_timeline(os.path.join(FIXTURES, "preemption_storm"))
+        spawns = [s for s in tl.supervisor if s.get("event") == "spawn"]
+        assert [s["attempt"] for s in spawns] == [0, 1, 2, 3]
+
+
+# --------------------------------------------------------------- doctor
+
+class TestDoctor:
+    def test_healthy_chaos_run_verdict_and_goodput(self):
+        rep = diagnose(os.path.join(FIXTURES, "divergence_rollback"))
+        assert rep["verdict"] == "healthy"
+        assert rep["goodput"]["fits"] == 1
+        assert 0.0 < rep["goodput"]["productive_frac"] < 1.0
+        # top sinks name real buckets, largest first
+        sinks = rep["goodput"]["top_sinks"]
+        assert sinks == sorted(sinks, key=lambda s: -s["seconds"])
+        text = render(rep)
+        assert "verdict: HEALTHY" in text
+        assert "divergence_rollback" in text
+
+    def test_unresolved_episode_is_critical_and_exits_nonzero(
+            self, tmp_path, capsys):
+        # truncate the storm: drop the final generation entirely, so the
+        # last preempt classification never sees its spawn -> the
+        # injected unresolved anomaly the doctor must flag
+        import shutil
+        src = os.path.join(FIXTURES, "preemption_storm")
+        dst = tmp_path / "truncated"
+        shutil.copytree(src, dst)
+        shutil.rmtree(dst / "run_3")
+        ev = next((dst / "events").glob("*.jsonl"))
+        lines = ev.read_text().splitlines()
+        kept = [ln for ln in lines
+                if json.loads(ln)["payload"].get("attempt") != 3]
+        ev.write_text("\n".join(kept) + "\n")
+        rep = diagnose(str(dst))
+        assert rep["verdict"] == "critical"
+        codes = [f["code"] for f in rep["findings"]]
+        assert "unresolved_preempt_resume" in codes
+        # every finding names its remedy — the recommendation idiom
+        assert all(f["remedy"] for f in rep["findings"])
+        assert main([str(dst)]) == 1
+        out = capsys.readouterr().out
+        assert "UNRESOLVED" in out and "CRITICAL" in out
+
+    def test_main_json_output_parses_and_exits_zero_when_healthy(
+            self, capsys):
+        rc = main([os.path.join(FIXTURES, "elastic_membership"),
+                   "--json"])
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["verdict"] == "healthy"
+        assert len(rep["timeline"]["episodes"]) == 3
+
+    def test_rollback_budget_burn_warns_with_remedy(self, tmp_path):
+        run = tmp_path / "run_0001"
+        log = events_lib.configure(str(run))
+        for k in range(THRESHOLDS["rollbacks"]):
+            events_lib.emit("sentinel", "rollback", step=10 * k,
+                            payload={"restore_seconds": 0.5,
+                                     "rollback_to_step": 10 * k - 5})
+            events_lib.emit("sentinel", "replay", step=10 * k)
+        events_lib.release(log)
+        tl = load_timeline(str(tmp_path))
+        findings = detect_findings(tl, str(tmp_path))
+        (f,) = [f for f in findings if f["code"] == "rollback_budget_burn"]
+        assert f["severity"] == "warning"
+        assert "quarantine.jsonl" in f["remedy"]
+
+    def test_stall_above_target_names_the_knobs(self, tmp_path):
+        run = tmp_path / "run_0001"
+        log = events_lib.configure(str(run))
+        events_lib.emit("governor", "arm_echo",
+                        payload={"applied": True, "stall": 0.42,
+                                 "target": 0.1})
+        events_lib.emit("governor", "raise_echo",
+                        payload={"applied": True, "stall": 0.38,
+                                 "target": 0.1})
+        events_lib.release(log)
+        findings = detect_findings(load_timeline(str(tmp_path)),
+                                   str(tmp_path))
+        codes = {f["code"] for f in findings}
+        # armed and never disarmed: both the unresolved ladder (critical)
+        # and the end-of-run stall warning fire, each naming remedies
+        assert "unresolved_stall_ladder" in codes
+        (f,) = [f for f in findings if f["code"] == "stall_above_target"]
+        assert "data.max_echo" in f["remedy"]
+
+    def test_metrics_text_folds_dropped_deltas_into_verdict(self,
+                                                           tmp_path):
+        run = tmp_path / "run_0001"
+        log = events_lib.configure(str(run))
+        events_lib.emit("trainer", "fit_start", payload={})
+        events_lib.release(log)
+        metrics = parse_metrics_text(
+            "# HELP telemetry_dropped_deltas_total x\n"
+            "# TYPE telemetry_dropped_deltas_total counter\n"
+            "telemetry_dropped_deltas_total 7\n")
+        findings = detect_findings(load_timeline(str(tmp_path)),
+                                   str(tmp_path), metrics=metrics)
+        (f,) = [f for f in findings
+                if f["code"] == "dropped_telemetry_deltas"]
+        assert f["detail"]["dropped"] == 7
+
+    def test_no_events_warns_not_crashes(self, tmp_path):
+        rep = diagnose(str(tmp_path))
+        assert rep["verdict"] == "warning"
+        assert rep["findings"][0]["code"] == "no_events"
+
+    def test_unknown_threshold_rejected(self, capsys):
+        with pytest.raises(SystemExit):
+            main([".", "--threshold", "vibes=3"])
+
+    def test_console_script_registered(self):
+        with open(os.path.join(os.path.dirname(__file__), os.pardir,
+                               "pyproject.toml")) as f:
+            assert ('dptpu-doctor = '
+                    '"distributedpytorch_tpu.telemetry.doctor:main"'
+                    ) in f.read()

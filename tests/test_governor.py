@@ -67,6 +67,22 @@ class TestFeedWindow:
         w.push(0.5, -1.0)
         assert len(w) == 0
 
+    def test_negative_deltas_are_counted_not_silent(self):
+        # the drop must be visible: a window fed only negative deltas
+        # (accountant reset racing the tick) looked exactly like a
+        # healthy feed — the counter tells "no stalls" from "no samples"
+        from distributedpytorch_tpu.telemetry import get_registry
+
+        counter = get_registry().counter(
+            "telemetry_dropped_deltas_total")
+        before = counter.value
+        w = FeedWindow(size=4)
+        w.push(-0.5, 0.1)
+        w.push(0.1, -0.5)
+        w.push(0.1, 0.1)    # healthy sample: not a drop
+        assert w.dropped == 2
+        assert counter.value == before + 2
+
     def test_reset_and_size_validation(self):
         w = FeedWindow(size=2)
         w.push(1.0, 1.0)

@@ -315,6 +315,11 @@ class TestTrainerPreemption:
         assert "preempted" not in hist
         assert len(hist["train_loss"]) == 1
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): full fit + forced resave
+    # window (~20s); the drain path keeps its fast gates
+    # (test_signal_during_fit_stops_cleanly,
+    # test_no_preempt_leaves_history_unmarked) and exact-resume stays
+    # slow-gated above
     def test_preempt_at_already_checkpointed_step_skips_save(self, tmp_path):
         # Stop consensus landing on a step that already has a checkpoint
         # (the interrupted epoch contributed zero steps) must not re-save —

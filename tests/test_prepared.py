@@ -610,6 +610,10 @@ class TestPackBitsWire:
         out = _unpack_mask_bits(batch)
         np.testing.assert_array_equal(np.asarray(out["crop_gt"]), mask)
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): full packbits fit (~44s);
+    # the wire keeps its fast gates (test_pack_unpack_roundtrip_exact,
+    # test_unpack_nonmultiple_of_8, test_packbits_requires_uint8_instance)
+    # and loss parity stays slow-gated (test_packed_loss_matches_unpacked)
     def test_trainer_packbits_e2e(self, tmp_path):
         from distributedpytorch_tpu.train import Trainer
         from tests.test_train import make_tiny_cfg
@@ -701,8 +705,8 @@ class TestCoalesceWire:
     @pytest.mark.parametrize("packbits", [
         False,
         # tier-1 budget (PR 7): the packbits-riding variant is slow-gated
-        # (~19s); the packed row keeps its own fast gate
-        # (test_trainer_packbits_e2e) and the plain coalesce parity stays
+        # (~19s); the packed row keeps its unit gates (the roundtrip
+        # tests above, PR 18) and the plain coalesce parity stays
         pytest.param(True, marks=pytest.mark.slow),
     ])
     def test_coalesced_loss_matches_plain(self, tmp_path, packbits):

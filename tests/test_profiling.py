@@ -98,6 +98,10 @@ class TestTrace:
             x = jnp.ones((4,)) * 2
         assert float(x.sum()) == 8.0
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): a real XPlane capture
+    # start/stop costs ~30s on the CPU mesh; the annotate path keeps its
+    # fast gate (test_annotate_context) and the captured-trace contents
+    # stay covered by test_telemetry's slow XPlane lowering test
     def test_trace_writes_files(self, tmp_path):
         d = str(tmp_path / "prof")
         with trace(d):

@@ -520,6 +520,10 @@ class TestTrainerRollback:
     the happy path): budget exhaustion fails loudly, quarantined batches
     are skipped on replay."""
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): full fit driven to
+    # budget exhaustion (~17s); the rollback machinery keeps its fast
+    # gate (test_quarantined_batches_skipped_on_replay below) and the
+    # budget arithmetic its unit gates (TestVerdicts/TestConfigKnobs)
     def test_budget_exhaustion_fails_loudly(self, tmp_path, rollback_voc):
         from distributedpytorch_tpu.chaos import sites
         from distributedpytorch_tpu.chaos.faults import FaultPlan
@@ -572,6 +576,10 @@ class TestEchoQuarantine:
     happens in host_batches, upstream of the echo expansion), and the
     rollback step accounting must divide by the live echo factor."""
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): echoed fit + rollback
+    # (~23s); base quarantine-skip keeps its fast gate
+    # (test_quarantined_batches_skipped_on_replay) and the echo-offset
+    # fallbacks stay slow-gated in test_preemption
     def test_quarantine_of_echoed_window_skips_all_echoes(self, tmp_path,
                                                           rollback_voc):
         from distributedpytorch_tpu.chaos import sites
@@ -613,6 +621,10 @@ class TestPackedQuarantineSeek:
     ledger — and the echo-aware skip still drops ALL echoes of the
     poisoned batch on replay."""
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): packed fit + echoed
+    # rollback (~22s); seek identity keeps its fast gates in
+    # test_packed.py (O(1) seek, pack_quarantine) and the base
+    # quarantine-skip e2e stays in tier-1
     def test_packed_quarantine_names_exact_records_and_skips_echoes(
             self, tmp_path, rollback_voc):
         from distributedpytorch_tpu.chaos import sites
@@ -671,6 +683,9 @@ class TestPackedQuarantineSeek:
             assert blk["records"] == want
             tr.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): full fs-source fit
+    # (~20s); the null-records ledger convention is also pinned by the
+    # fast recovery-block schema gates (TestRecoveryBlock)
     def test_fs_source_ledger_records_null(self, tmp_path, rollback_voc):
         # fs sources have no O(1) record identity: the ledger keeps
         # batch indices as the only name, records stays null

@@ -240,6 +240,39 @@ class TestPrometheusExposition:
             assert name in text, f"{name} missing from exposition"
         self._assert_parseable(text)
 
+    def test_hostile_label_values_escape_and_parse_back(self):
+        # the 0.0.4 label contract: backslash, double-quote and line
+        # feed must escape — a path label with any of them must round
+        # trip through the exposition, not corrupt the line shape
+        reg = MetricsRegistry()
+        hostile = 'C:\\runs\\"prod"\nnext'
+        reg.counter("paths_total", labels={"path": hostile}).inc(3)
+        text = render_text(reg)
+        (line,) = [ln for ln in text.splitlines()
+                   if ln.startswith("paths_total{")]
+        # one physical line (the newline escaped, not emitted)
+        assert "\n" not in line
+        # parse back per spec: value after the closing brace, label
+        # value unescaped in reverse order of the escape
+        m = re.match(r'^paths_total\{path="((?:\\.|[^"\\])*)"\} (\S+)$',
+                     line)
+        assert m, f"unparseable hostile-label line: {line!r}"
+        unescaped = (m.group(1).replace("\\n", "\n")
+                     .replace('\\"', '"').replace("\\\\", "\\"))
+        assert unescaped == hostile
+        assert float(m.group(2)) == 3
+
+    def test_help_text_escapes_backslash_newline_only(self):
+        # HELP escaping differs from label escaping: \\ and \n only —
+        # a double-quote in HELP must pass through literally
+        reg = MetricsRegistry()
+        reg.counter("h_total", 'reads "raw" lines\nfrom C:\\logs').inc()
+        text = render_text(reg)
+        (help_line,) = [ln for ln in text.splitlines()
+                        if ln.startswith("# HELP h_total ")]
+        assert help_line == ('# HELP h_total reads "raw" '
+                             'lines\\nfrom C:\\\\logs')
+
     def test_serve_metrics_view_is_per_service(self):
         # two services sharing one process/registry must each report
         # "monotonic since service start", not each other's traffic
@@ -399,3 +432,58 @@ class TestInstrumentationOverhead:
         assert per_step_overhead <= 0.02 * step_s, (
             f"instrumentation {per_step_overhead * 1e6:.1f}us/step vs "
             f"step {step_s * 1e6:.1f}us")
+
+    def test_event_emission_at_most_two_percent_of_step(self, tmp_path):
+        """The flight recorder's armed emit() — a full event line,
+        serialized and written — pinned to the same <=2%-of-step
+        contract as the span/account primitives, against the same
+        representative tiny step."""
+        import jax
+        import jax.numpy as jnp
+
+        from distributedpytorch_tpu.telemetry import events as events_lib
+
+        @jax.jit
+        def step(x):
+            return (x @ x @ x).sum()
+
+        x = jnp.ones((256, 256))
+        float(step(x))  # compile outside the clock
+        t0 = time.perf_counter()
+        n_steps = 30
+        for _ in range(n_steps):
+            float(step(x))
+        step_s = (time.perf_counter() - t0) / n_steps
+
+        log = events_lib.configure(str(tmp_path))
+        try:
+            reps = 2000
+            t0 = time.perf_counter()
+            for i in range(reps):
+                events_lib.emit("trainer", "tick", step=i,
+                                payload={"loss": 0.5, "stall": 0.01})
+            per_step_overhead = (time.perf_counter() - t0) / reps
+        finally:
+            events_lib.release(log)
+        assert log.block()["emitted"] == reps
+        assert per_step_overhead <= 0.02 * step_s, (
+            f"event emission {per_step_overhead * 1e6:.1f}us/step vs "
+            f"step {step_s * 1e6:.1f}us")
+
+    def test_unconfigured_emit_is_nanoseconds(self):
+        """The recorder-off path (no configure) must cost one list
+        check — the chaos-seam discipline applied to observability."""
+        from distributedpytorch_tpu.telemetry import events as events_lib
+
+        saved = events_lib._STACK[:]
+        events_lib._STACK.clear()  # force the unconfigured path
+        try:
+            assert events_lib.current() is None
+            reps = 20000
+            t0 = time.perf_counter()
+            for i in range(reps):
+                events_lib.emit("trainer", "tick", step=i)
+            per_call = (time.perf_counter() - t0) / reps
+        finally:
+            events_lib._STACK.extend(saved)
+        assert per_call < 5e-6, f"no-op emit {per_call * 1e9:.0f}ns"

@@ -253,6 +253,10 @@ class TestBf16Config:
 class TestDataEchoing:
     """data.echo (Choi et al. 2019): each loaded batch is stepped E times."""
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): full echoed fit
+    # (~16s); the knob keeps its validation gate (test_echo_validated
+    # below) and echo expansion stays covered by the governor
+    # actuation tests and the slow sentinel/preemption echo suites
     def test_echo_multiplies_steps_per_epoch(self, tmp_path):
         base = make_tiny_cfg(str(tmp_path / "a"))
         cfg = dataclasses.replace(

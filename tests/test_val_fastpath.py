@@ -265,6 +265,10 @@ class TestTrainerIntegration:
         tr_plain.close()
         tr_fast.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): two semantic fits
+    # (~15s); the semantic eval cache keeps its fast contract gate
+    # (TestSemanticEvalCache.test_contract_vs_plain_pipeline) and the
+    # instance-task parity e2e stays in tier-1 above
     def test_semantic_val_parity(self, tmp_path):
         from distributedpytorch_tpu.data import make_fake_voc
         from distributedpytorch_tpu.train import Trainer
@@ -339,6 +343,10 @@ class TestTrainerIntegration:
         tr_plain.close()
         tr_fast.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 18): two full-res fits
+    # (~15s); the device-warp wire keeps its fast gates
+    # (TestSemanticEvalCache full-res contracts) and fullres parity
+    # stays slow-gated (test_semantic_fullres_val_parity)
     def test_semantic_fullres_device_vs_host_path(self, tmp_path):
         """eval_device_fullres=true (device warp + uint8 class-map wire)
         must reproduce the host resize path's full-res mIoU through the
