@@ -47,6 +47,26 @@ Its AST half is import-light like jaxlint (``--no-ir`` for pre-commit);
 suppressions use ``# jaxguard: disable=JG00x`` and are policed for
 staleness by ``jaxlint --stats`` alongside jaxlint's own.
 
+The fourth layer, **jaxrace** (:mod:`race` + :mod:`threadsan`), leaves
+the device entirely: the serve stack is a multi-threaded HOST program
+(submit threads, a worker, a swap admitting new generations, signal
+handlers), and its hazards — unguarded shared state, lock-order
+inversions, blocking calls in signal handlers or under locks — are
+invisible to all jax-level layers.  jaxrace builds a thread model per
+class (locks, guarded attributes via ``# jaxrace: guarded-by=...``
+declarations or majority inference, lock acquisition order) and judges
+it flow-sensitively (JR001–JR004), pinning the guard map and blessed
+lock order in ``tests/contracts/threads.json``:
+
+    python -m distributedpytorch_tpu.analysis --race check
+    jaxrace check                            # console entry point
+
+Its runtime witness, :mod:`threadsan` (``DPTPU_THREADSAN=1``), wraps
+the declared locks and instruments attribute writes so the existing
+under-load serve/swap tests validate the static guard map against real
+thread schedules.  Suppressions use ``# jaxrace: disable=JR00x`` and
+are policed for staleness by ``jaxlint --stats`` like the others.
+
 The hazards the AST structurally cannot see — they exist only in the
 traced jaxpr and the compiled HLO — are jaxaudit's job (:mod:`ir` +
 :mod:`contracts`, docs/DESIGN.md "IR auditing & compile contracts"):
@@ -73,7 +93,8 @@ from .core import (
 )
 from . import rules as _rules  # noqa: F401  populates RULES at import
 from .guard import GUARD_RULES, guard_paths, guard_source
+from .race import RACE_RULES, race_paths, race_source
 
-__all__ = ["Finding", "RULES", "GUARD_RULES", "lint_paths",
-           "lint_source", "guard_paths", "guard_source",
-           "suppression_report", "main"]
+__all__ = ["Finding", "RULES", "GUARD_RULES", "RACE_RULES", "lint_paths",
+           "lint_source", "guard_paths", "guard_source", "race_paths",
+           "race_source", "suppression_report", "main"]

@@ -4,9 +4,12 @@
 to jaxaudit, the IR-level program auditor (``jaxaudit check`` /
 ``update`` / ``audit`` / ``list`` — see :mod:`contracts`), and
 ``--guard <command> [...]`` to jaxguard, the cross-program
-SPMD-divergence + donation-safety layer (:mod:`guard`).  The split keeps
-the default linter path import-light (no jax): only ``--ir`` — and
-``--guard`` without ``--no-ir`` — touches a backend.
+SPMD-divergence + donation-safety layer (:mod:`guard`), and
+``--race <command> [...]`` to jaxrace, the host-concurrency layer
+(:mod:`race` — guarded-by discipline, lock ordering, signal safety).
+The split keeps the default linter path import-light (no jax): only
+``--ir`` — and ``--guard`` without ``--no-ir`` — touches a backend;
+``--race`` never does (host threads are topology-independent).
 """
 
 import sys
@@ -14,6 +17,11 @@ import sys
 
 def _main() -> int:
     argv = sys.argv[1:]
+    if "--race" in argv:
+        argv = [a for a in argv if a != "--race"]
+        from .race import run_race_cli
+
+        return run_race_cli(argv)
     if "--guard" in argv:
         argv = [a for a in argv if a != "--guard"]
         from .guard import run_guard_cli

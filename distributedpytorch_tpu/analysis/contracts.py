@@ -318,6 +318,34 @@ def validate_contract_file(path: str, doc: dict) -> list[str]:
         return [f"{base}: top level must be a JSON object"]
     kind = doc.get("kind")
 
+    if kind == "threads":
+        # jaxrace host-thread pin: guard map + blessed lock order.  No
+        # platform_key — host concurrency is topology-independent, so
+        # one pin covers every accelerator configuration.
+        unknown = set(doc) - {"kind", "program", "guards", "lock_order"}
+        if unknown:
+            errs.append(f"{base}: unknown key(s) {sorted(unknown)}")
+        if doc.get("program") != "threads":
+            errs.append(f"{base}: 'program' must be 'threads'")
+        elif base != "threads.json":
+            errs.append(f"{base}: filename must be 'threads.json'")
+        guards = doc.get("guards")
+        if not isinstance(guards, dict) or not all(
+                isinstance(ck, str) and isinstance(gm, dict)
+                and all(isinstance(a, str) and isinstance(lk, str)
+                        for a, lk in gm.items())
+                for ck, gm in guards.items()):
+            errs.append(f"{base}: 'guards' must be "
+                        "{class_key: {attr: lock_attr}}")
+        order = doc.get("lock_order")
+        if not isinstance(order, list) or not all(
+                isinstance(p, list) and len(p) == 2
+                and all(isinstance(x, str) for x in p) and p[0] != p[1]
+                for p in order):
+            errs.append(f"{base}: 'lock_order' must be a list of "
+                        "[first, second] distinct lock-ident pairs")
+        return errs
+
     prog = doc.get("program")
     key = doc.get("platform_key")
     if not isinstance(prog, str) or not prog:

@@ -463,6 +463,7 @@ def suppression_report(paths: Iterable[str]) -> list[dict]:
     them, printing the exact file:line to delete.
     """
     from .guard import guard_source  # lazy: guard imports this module
+    from .race import race_source  # lazy: race imports this module
 
     files = list(iter_python_files(paths))
     sources: dict[str, str] = {}
@@ -483,6 +484,8 @@ def suppression_report(paths: Iterable[str]) -> list[dict]:
                                    tree=trees.get(f), suppress=False),
             "jaxguard": guard_source(src, path=f, tree=trees.get(f),
                                      suppress=False),
+            "jaxrace": race_source(src, path=f, tree=trees.get(f),
+                                   suppress=False),
         }
         for tool, raws in raw_by_tool.items():
             for lineno, _col, kind, codes, _text in \

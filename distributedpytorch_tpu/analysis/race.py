@@ -1,0 +1,1118 @@
+"""jaxrace driver: the fourth static-analysis layer — host concurrency.
+
+The first three layers watch the *device* program (jaxlint per-file AST,
+jaxguard cross-statement dataflow + cross-program schedules, jaxaudit
+compiled IR).  None of them see the 20+ modules of host-side threading
+that feed those programs: serve worker/swap/session threads, bounded
+prefetch queues, supervisor children, signal handlers.  Every
+concurrency bug shipped so far (the PR 6 lane-reservation and
+gc-vs-queued races, the PR 10 poll-vs-notify prefetch latency) was
+found by hand review.  jaxrace is that review, mechanized, in the same
+idiom as the other layers: rules + a checked-in contract + seeded-hazard
+tests + one lint gate.
+
+Rules:
+
+====== ========================= ==========================================
+JR000  meta                      syntax error / malformed ``# jaxrace:``
+                                 directive / dangling ``guarded-by``
+JR001  guarded-by discipline     a mutable attribute reachable from more
+                                 than one thread root accessed without
+                                 its declared (or majority-inferred) lock
+JR002  lock-order inversion      the with-lock acquisition graph has a
+                                 cycle (potential deadlock), or a
+                                 non-reentrant lock is re-acquired
+JR003  signal-handler safety     code reachable from a registered signal
+                                 handler takes a lock, blocks, or calls
+                                 into the (lock-taking) metrics registry
+JR004  blocking-call-under-lock  unbounded ``queue.get/put``, ``join()``,
+                                 ``sleep``, ``device_get`` or file/network
+                                 I/O while holding a lock
+====== ========================= ==========================================
+
+Guard declarations ride the suppression comment grammar::
+
+    self._active = 0  # jaxrace: guarded-by=self._lock
+
+Declared guards are authoritative — EVERY access outside ``__init__``
+without the lock held is JR001.  Without a declaration, a guard is
+inferred by majority use (>= 2 locked accesses, strictly more locked
+than bare) — the analyzer learns the discipline a class already follows
+and flags the stragglers.  Suppressions use the shared grammar
+(``# jaxrace: disable=JR004  -- rationale``); ``jaxlint --stats``
+polices them for staleness alongside the other tools'.
+
+The effective guard map + the lock-order edge list are pinned in
+``tests/contracts/threads.json`` (contract kind ``"threads"`` — host
+analysis is topology-independent, so unlike jaxaudit pins it carries no
+platform key).  ``jaxrace check`` fails on findings OR pin drift;
+``jaxrace update`` regenerates after a reviewed change.  The runtime
+complement is :mod:`threadsan` (``DPTPU_THREADSAN=1``): it wraps the
+pinned locks and instruments writes to the pinned attributes so the
+existing under-load serve/swap tests dynamically witness the static map.
+
+Everything here is stdlib-only and import-light: the gate runs pre-jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+
+from .core import (
+    Finding,
+    dotted_name,
+    iter_python_files,
+    parse_suppressions,
+    walk_with_parents,
+)
+
+META_CODE = "JR000"
+
+#: code -> (name, summary) — all four are AST-side (no compile half)
+RACE_RULES = {
+    "JR001": ("guarded-by-discipline",
+              "mutable attribute reachable from >1 thread root accessed "
+              "without its declared/inferred lock held — hold the lock, "
+              "or waive with a rationale if the access is provably "
+              "single-threaded or GIL-atomic by design"),
+    "JR002": ("lock-order-inversion",
+              "the with-lock acquisition graph has a cycle (two threads "
+              "taking the same locks in opposite orders deadlock), or a "
+              "non-reentrant Lock is re-acquired on one path"),
+    "JR003": ("signal-handler-safety",
+              "code reachable from a registered signal handler takes a "
+              "lock, blocks, or calls the metrics registry — a handler "
+              "interrupts arbitrary bytecode, possibly while that very "
+              "lock is held; mirror state from normal context instead "
+              "(the PreemptionGuard idiom)"),
+    "JR004": ("blocking-call-under-lock",
+              "unbounded blocking call (queue get/put or wait/join/"
+              "result without timeout, sleep, device_get, file or "
+              "network I/O) while holding a lock — every other user of "
+              "that lock inherits the stall; pass timeout= or move the "
+              "call outside the critical section"),
+}
+
+RACE_CODES = frozenset(RACE_RULES) | {META_CODE}
+
+#: the checked-in concurrency contract (kind "threads", no platform key)
+THREADS_CONTRACT_FILE = "threads.json"
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "Lock", "RLock", "Condition",
+}
+#: ctors whose lock may be re-acquired by the owning thread (Condition's
+#: default underlying lock is an RLock)
+_REENTRANT_CTORS = {
+    "threading.RLock", "RLock", "threading.Condition", "Condition",
+}
+
+#: matched against comment TOKENS only (tokenize), so no ``#`` anchor —
+#: the directive may follow prose in the same comment
+_GUARDED_RE = re.compile(
+    r"jaxrace:\s*guarded-by\s*=\s*(?:self\.)?([A-Za-z_]\w*)")
+
+#: receiver-name hint for queue-shaped .put targets (out_q, self._q,
+#: work_queue, ...) — keeps dict/cache .put lookalikes out of JR004
+_QUEUEISH_RE = re.compile(r"(?:^|_)q(?:ueue)?s?$|queue", re.IGNORECASE)
+
+#: thread roots every class gets for free: context-manager / iterator /
+#: callable protocol entries are called by foreign code like any public
+#: method
+_PROTOCOL_ROOTS = {"__enter__", "__exit__", "__call__", "__iter__",
+                   "__next__"}
+
+
+# ---------------------------------------------------------------- the model
+
+class _Lock:
+    """One mutual-exclusion primitive: stable identity + short label."""
+
+    __slots__ = ("ident", "label", "reentrant")
+
+    def __init__(self, ident: str, label: str, reentrant: bool):
+        self.ident, self.label, self.reentrant = ident, label, reentrant
+
+
+class _Method:
+    """Flow facts for one function body (methods and module functions).
+
+    ``held`` sets recorded here are the LOCAL half only — locks taken
+    inside this body.  Entry locks (what callers already hold, the
+    ``*_locked`` convention) are solved by fixpoint afterwards and
+    unioned in at judgement time.
+    """
+
+    __slots__ = ("node", "accesses", "calls", "acquires", "blocking")
+
+    def __init__(self, node):
+        self.node = node
+        #: (attr, node, is_write, frozenset(local held))
+        self.accesses: list = []
+        #: (callee name, frozenset(local held), node) — self.m() only
+        self.calls: list = []
+        #: (lock ident, frozenset(local held before), node)
+        self.acquires: list = []
+        #: (reason, node, frozenset(local held)) — judged after fixpoint
+        self.blocking: list = []
+
+
+class _Class:
+    __slots__ = ("name", "key", "node", "locks", "methods", "spawns",
+                 "declared", "declared_nodes", "concurrent")
+
+    def __init__(self, name: str, key: str, node):
+        self.name, self.key, self.node = name, key, node
+        self.locks: dict[str, _Lock] = {}   # attr -> lock
+        self.methods: dict[str, _Method] = {}
+        self.spawns: set[str] = set()       # method names used as targets
+        self.declared: dict[str, str] = {}  # attr -> guarding lock attr
+        self.declared_nodes: dict[str, ast.AST] = {}
+        self.concurrent = False             # any Thread/executor spawn seen
+
+
+def _comment_lines(src: str) -> dict[int, str]:
+    """lineno -> comment text, via the tokenizer — a ``guarded-by``
+    inside a docstring or string literal is prose, not a declaration."""
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        return {t.start[0]: t.string for t in tokens
+                if t.type == tokenize.COMMENT}
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return {}
+
+
+def _rel_path(path: str) -> str:
+    """Package-relative form for stable contract keys: everything from
+    the ``distributedpytorch_tpu/`` component on; bare basename for
+    sources outside the package (test fixtures)."""
+    p = path.replace(os.sep, "/")
+    idx = p.rfind("distributedpytorch_tpu/")
+    return p[idx:] if idx >= 0 else os.path.basename(p)
+
+
+def _class_key(path: str, cls: str) -> str:
+    return f"{_rel_path(path)}:{cls}"
+
+
+def _ctor_of(node) -> str | None:
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func)
+    return None
+
+
+def _spawn_target(call: ast.Call) -> ast.AST | None:
+    """The callable handed to a thread-spawning API: ``threading.Thread
+    (target=...)``, ``threading.Timer(t, fn)``, ``executor.submit(fn)``.
+    """
+    fn = dotted_name(call.func)
+    if fn in ("threading.Thread", "Thread"):
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+    elif fn in ("threading.Timer", "Timer") and len(call.args) >= 2:
+        return call.args[1]
+    elif isinstance(call.func, ast.Attribute) \
+            and call.func.attr == "submit" and call.args:
+        return call.args[0]
+    return None
+
+
+# ----------------------------------------------------------- the flow walk
+
+class _FlowWalker:
+    """One function body: sequential held-lock tracking.
+
+    ``with lock:`` scopes exactly; ``lock.acquire()``/``.release()``
+    expression statements toggle for the remainder of the block (the
+    acquire-then-try/finally-release idiom); branch bodies get copies so
+    a conditional acquire never leaks past its branch.
+    """
+
+    def __init__(self, resolve, sink: _Method, class_methods: set[str]):
+        self._resolve = resolve          # expr -> _Lock | None
+        self._sink = sink
+        self._class_methods = class_methods
+
+    def run(self, body: list) -> None:
+        self._stmts(body, set())
+
+    # ---- statements
+    def _stmts(self, stmts, held: set) -> None:
+        for st in stmts:
+            self._stmt(st, held)
+
+    def _stmt(self, st, held: set) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs in its own (possibly other-thread) context
+            self._stmts(st.body, set())
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            entered = []
+            for item in st.items:
+                self._expr(item.context_expr, held)
+                lock = self._resolve(item.context_expr)
+                if lock is not None:
+                    self._sink.acquires.append(
+                        (lock.ident, frozenset(held), item.context_expr))
+                    held.add(lock.ident)
+                    entered.append(lock.ident)
+            self._stmts(st.body, held)
+            for ident in entered:
+                held.discard(ident)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body, held)
+            for h in st.handlers:
+                self._stmts(h.body, set(held))
+            self._stmts(st.orelse, set(held))
+            self._stmts(st.finalbody, held)
+        elif isinstance(st, ast.If):
+            self._expr(st.test, held)
+            self._stmts(st.body, set(held))
+            self._stmts(st.orelse, set(held))
+        elif isinstance(st, ast.While):
+            self._expr(st.test, held)
+            self._stmts(st.body, set(held))
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._expr(st.iter, held)
+            self._expr(st.target, held)
+            self._stmts(st.body, set(held))
+            self._stmts(st.orelse, set(held))
+        elif isinstance(st, ast.Expr):
+            call = st.value
+            if isinstance(call, ast.Call) \
+                    and isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in ("acquire", "release"):
+                lock = self._resolve(call.func.value)
+                if lock is not None:
+                    self._expr(call, held)
+                    if call.func.attr == "acquire":
+                        self._sink.acquires.append(
+                            (lock.ident, frozenset(held), call))
+                        held.add(lock.ident)
+                    else:
+                        held.discard(lock.ident)
+                    return
+            self._expr(st.value, held)
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.stmt):
+                    self._stmt(child, held)
+                elif isinstance(child, ast.expr):
+                    self._expr(child, held)
+
+    # ---- expressions
+    def _expr(self, e, held: set) -> None:
+        if e is None:
+            return
+        snap = frozenset(held)
+        for node in ast.walk(e):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                self._sink.accesses.append(
+                    (node.attr, node,
+                     isinstance(node.ctx, (ast.Store, ast.Del)), snap))
+            elif isinstance(node, ast.Call):
+                fn = dotted_name(node.func)
+                if fn and fn.startswith("self.") and "." not in fn[5:] \
+                        and fn[5:] in self._class_methods:
+                    self._sink.calls.append((fn[5:], snap, node))
+                target = _spawn_target(node)
+                if target is not None:
+                    d = dotted_name(target)
+                    if d and d.startswith("self."):
+                        self._sink.calls.append((d[5:], snap, node))
+                reason = _blocking_reason(node)
+                if reason is not None:
+                    recv_lock = None
+                    if isinstance(node.func, ast.Attribute):
+                        recv_lock = self._resolve(node.func.value)
+                    # Condition.wait releases the lock it IS — holding
+                    # only that one lock while waiting on it is the
+                    # sanctioned idiom, not a stall
+                    if not (recv_lock is not None
+                            and node.func.attr in ("wait", "acquire")
+                            and snap <= {recv_lock.ident}):
+                        self._sink.blocking.append((reason, node, snap))
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    """Why this call can block unboundedly, or None."""
+    fn = dotted_name(call.func)
+    if fn in ("time.sleep",):
+        return "time.sleep"
+    if fn in ("jax.device_get", "device_get"):
+        return "device readback (device_get)"
+    if fn == "open":
+        return "file I/O (open)"
+    if fn and (fn.startswith("requests.") or fn.startswith("urllib.")
+               or fn.startswith("socket.")):
+        return f"network I/O ({fn})"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    meth = call.func.attr
+    kwnames = {k.arg for k in call.keywords}
+    has_timeout = "timeout" in kwnames or "block" in kwnames \
+        or "blocking" in kwnames
+    recv = dotted_name(call.func.value) or ""
+    rname = recv.split(".")[-1]
+    if meth == "get" and not call.args and not call.keywords:
+        return "queue .get() without timeout"
+    if meth == "put" and not has_timeout and call.args \
+            and _QUEUEISH_RE.search(rname):
+        return "queue .put() without timeout"
+    if meth in ("join", "result", "wait") and not call.args \
+            and not has_timeout:
+        return f".{meth}() without timeout"
+    if meth == "acquire" and not has_timeout \
+            and not (call.args
+                     and isinstance(call.args[0], ast.Constant)
+                     and call.args[0].value is False):
+        return ".acquire() without timeout"
+    return None
+
+
+# --------------------------------------------------------- model extraction
+
+def _collect_local_locks(fn_node, owner: str) -> dict[str, _Lock]:
+    """``room = threading.Condition()``-style locals anywhere in the
+    function subtree (closures share the enclosing function's locals)."""
+    out: dict[str, _Lock] = {}
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            ctor = _ctor_of(node.value)
+            if ctor in _LOCK_CTORS:
+                name = node.targets[0].id
+                out[name] = _Lock(f"{owner}.{name}", name,
+                                  ctor in _REENTRANT_CTORS)
+    return out
+
+
+def _make_resolver(cls: _Class | None, local_locks: dict,
+                   module_locks: dict):
+    """expr -> _Lock for ``self.X`` (class locks), bare names (function
+    locals first, then module-level locks)."""
+
+    def resolve(expr) -> _Lock | None:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls is not None:
+            return cls.locks.get(expr.attr)
+        if isinstance(expr, ast.Name):
+            return local_locks.get(expr.id) or module_locks.get(expr.id)
+        return None
+
+    return resolve
+
+
+def _extract_class(node: ast.ClassDef, path: str,
+                   comments: dict[int, str],
+                   module_locks: dict, meta: list[Finding]) -> _Class:
+    cls = _Class(node.name, _class_key(path, node.name), node)
+    # pass 1: lock attrs + spawn targets (anywhere in the class body)
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Assign):
+            ctor = _ctor_of(sub.value)
+            if ctor in _LOCK_CTORS:
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        cls.locks[t.attr] = _Lock(
+                            f"{cls.key}.{t.attr}", t.attr,
+                            ctor in _REENTRANT_CTORS)
+        elif isinstance(sub, ast.Call):
+            target = _spawn_target(sub)
+            if target is not None:
+                cls.concurrent = True
+                d = dotted_name(target)
+                if d and d.startswith("self.") and "." not in d[5:]:
+                    cls.spawns.add(d[5:])
+    # pass 2: guarded-by declarations (on self.X assignment lines)
+    for sub in ast.walk(node):
+        if not (isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and isinstance(sub.ctx, ast.Store)):
+            continue
+        m = _GUARDED_RE.search(comments.get(sub.lineno, ""))
+        if m is None:
+            continue
+        lock_attr = m.group(1)
+        if lock_attr not in cls.locks:
+            meta.append(Finding(
+                META_CODE,
+                f"guarded-by names '{lock_attr}', which is not a lock "
+                f"attribute of {cls.name} (locks: "
+                f"{', '.join(sorted(cls.locks)) or 'none'})",
+                path, sub.lineno, sub.col_offset))
+            continue
+        cls.declared[sub.attr] = lock_attr
+        cls.declared_nodes[sub.attr] = sub
+    # pass 3: flow walk per method (direct children only)
+    method_names = {b.name for b in node.body
+                    if isinstance(b, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+    for b in node.body:
+        if not isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        mi = _Method(b)
+        locals_ = _collect_local_locks(b, f"{cls.key}.{b.name}")
+        walker = _FlowWalker(_make_resolver(cls, locals_, module_locks),
+                             mi, method_names)
+        walker.run(b.body)
+        cls.methods[b.name] = mi
+    return cls
+
+
+def _dangling_guarded_by(comments: dict[int, str], path: str,
+                         claimed: set[int]) -> list[Finding]:
+    """A ``guarded-by`` comment on a line with no ``self.X = ...`` store
+    declares nothing — loud, like an unknown code in a disable."""
+    out: list[Finding] = []
+    for i in sorted(comments):
+        if _GUARDED_RE.search(comments[i]) and i not in claimed:
+            out.append(Finding(
+                META_CODE,
+                "dangling guarded-by: no attribute assignment on this "
+                "line to attach the declaration to",
+                path, i, 0))
+    return out
+
+
+# --------------------------------------------------- per-class judgements
+
+def _roots_of(cls: _Class) -> set[str]:
+    # *_locked methods are the repo's caller-holds-the-lock convention —
+    # public spelling or not, they are helpers, never thread entries
+    roots = {m for m in cls.methods
+             if (not m.startswith("_") or m in _PROTOCOL_ROOTS)
+             and not m.endswith("_locked")}
+    roots |= cls.spawns & set(cls.methods)
+    roots.discard("__init__")
+    return roots
+
+
+def _entry_locks(cls: _Class, roots: set[str]) -> dict[str, frozenset]:
+    """Must-hold lock set at entry of each method: roots enter bare;
+    private helpers (the ``*_locked`` convention) inherit the
+    intersection over every intra-class call site.  Descending fixpoint;
+    a never-called helper ends bare."""
+    entry: dict[str, frozenset | None] = {
+        m: (frozenset() if m in roots else None) for m in cls.methods}
+    for _ in range(len(cls.methods) + 2):
+        changed = False
+        for m in cls.methods:
+            if m in roots:
+                continue
+            sites = []
+            for caller, mi in cls.methods.items():
+                if entry[caller] is None:
+                    continue
+                for callee, held, _node in mi.calls:
+                    if callee == m:
+                        sites.append(entry[caller] | held)
+            new = frozenset.intersection(*sites) if sites else None
+            if new != entry[m]:
+                entry[m] = new
+                changed = True
+        if not changed:
+            break
+    return {m: (e if e is not None else frozenset())
+            for m, e in entry.items()}
+
+
+def _reachable_roots(cls: _Class, roots: set[str]) -> dict[str, set]:
+    reach: dict[str, set] = {m: set() for m in cls.methods}
+    for root in roots:
+        seen: set[str] = set()
+        stack = [root]
+        while stack:
+            m = stack.pop()
+            if m in seen or m not in cls.methods:
+                continue
+            seen.add(m)
+            reach[m].add(root)
+            stack.extend(c for c, _h, _n in cls.methods[m].calls)
+    return reach
+
+
+def _judge_class(cls: _Class, path: str
+                 ) -> tuple[list[Finding], dict[str, str], list]:
+    """JR001 findings + the class's effective guard map + its
+    acquisition edges ``(from_ident, to_ident, node)``."""
+    findings: list[Finding] = []
+    roots = _roots_of(cls)
+    entry = _entry_locks(cls, roots)
+    reach = _reachable_roots(cls, roots)
+
+    # lock-order edges: direct nesting, entry-lock nesting, and one
+    # level of call-site propagation (holding A, call m that takes B)
+    edges: list = []
+    for m, mi in cls.methods.items():
+        for ident, held_before, node in mi.acquires:
+            for h in (entry[m] | held_before):
+                edges.append((h, ident, node))
+            if not (entry[m] | held_before) and ident in held_before:
+                pass  # unreachable; kept for clarity
+        for callee, held, node in mi.calls:
+            full = entry[m] | held
+            if not full or callee not in cls.methods:
+                continue
+            for ident, _hb, _n in cls.methods[callee].acquires:
+                for h in full:
+                    edges.append((h, ident, node))
+
+    # JR001
+    by_attr: dict[str, list] = {}
+    for m, mi in cls.methods.items():
+        if m == "__init__":
+            continue
+        for attr, node, write, held in mi.accesses:
+            by_attr.setdefault(attr, []).append((m, node, write, held))
+
+    guards: dict[str, str] = dict(cls.declared)
+    own_lock_idents = {lk.ident: a for a, lk in cls.locks.items()}
+
+    for attr, accs in sorted(by_attr.items()):
+        if attr in cls.locks:
+            continue
+        declared = cls.declared.get(attr)
+        if declared is not None:
+            guard = cls.locks[declared]
+            judged = accs
+        else:
+            if not (cls.locks and (cls.concurrent or cls.spawns
+                                   or len(cls.locks) > 0)):
+                continue
+            live = [(m, n, w, h) for m, n, w, h in accs if reach[m]]
+            if not live or not any(w for _m, _n, w, _h in live):
+                continue
+            roots_union = set()
+            for m, _n, _w, _h in live:
+                roots_union |= reach[m]
+            if len(roots_union) < 2:
+                continue
+            counts: dict[str, int] = {}
+            for m, _n, _w, h in live:
+                for ident in (entry[m] | h) & set(own_lock_idents):
+                    counts[ident] = counts.get(ident, 0) + 1
+            if not counts:
+                continue
+            best = max(sorted(counts), key=lambda k: counts[k])
+            locked = counts[best]
+            bare = sum(1 for m, _n, _w, h in live
+                       if best not in (entry[m] | h))
+            if locked < 2 or locked <= bare:
+                continue
+            guard = cls.locks[own_lock_idents[best]]
+            guards[attr] = own_lock_idents[best]
+            judged = live
+        for m, node, write, held in judged:
+            if guard.ident in (entry[m] | held):
+                continue
+            mode = "declared" if declared else "majority-inferred"
+            rooted = sorted(reach[m]) or [m]
+            findings.append(Finding(
+                "JR001",
+                f"'{attr}' ({cls.name}) "
+                f"{'written' if write else 'read'} without "
+                f"'{guard.label}' held ({mode} guard) — reachable from "
+                f"thread root(s): {', '.join(rooted[:4])}",
+                path, node.lineno, node.col_offset))
+
+    # JR004 (held = entry | local at the recorded site)
+    for m, mi in cls.methods.items():
+        for reason, node, held in mi.blocking:
+            full = entry[m] | held
+            if full:
+                labels = sorted(own_lock_idents.get(i, i.split(".")[-1])
+                                for i in full)
+                findings.append(Finding(
+                    "JR004",
+                    f"blocking {reason} while holding "
+                    f"{', '.join(repr(x) for x in labels)} "
+                    f"(in {cls.name}.{m})",
+                    path, node.lineno, node.col_offset))
+    return findings, guards, edges
+
+
+def _judge_function(fn_name: str, mi: _Method, path: str
+                    ) -> tuple[list[Finding], list]:
+    """Module-level functions: JR004 + lock-order edges only (no
+    attributes to guard)."""
+    findings: list[Finding] = []
+    edges = [(h, ident, node) for ident, held, node in mi.acquires
+             for h in held]
+    for reason, node, held in mi.blocking:
+        if held:
+            labels = sorted(i.split(".")[-1] for i in held)
+            findings.append(Finding(
+                "JR004",
+                f"blocking {reason} while holding "
+                f"{', '.join(repr(x) for x in labels)} (in {fn_name})",
+                path, node.lineno, node.col_offset))
+    return findings, edges
+
+
+# ------------------------------------------------------------ lock ordering
+
+def _order_findings(edges: list, path: str, locks_by_ident: dict
+                    ) -> list[Finding]:
+    """JR002: cycles in the acquisition graph; self-edges on
+    non-reentrant locks are the degenerate (self-deadlock) case."""
+    findings: list[Finding] = []
+    graph: dict[str, dict[str, ast.AST]] = {}
+    for a, b, node in edges:
+        if a == b:
+            lock = locks_by_ident.get(a)
+            if lock is not None and not lock.reentrant:
+                findings.append(Finding(
+                    "JR002",
+                    f"non-reentrant lock '{lock.label}' re-acquired "
+                    "while already held — self-deadlock (use RLock or "
+                    "restructure)",
+                    path, node.lineno, node.col_offset))
+            continue
+        graph.setdefault(a, {}).setdefault(b, node)
+
+    # DFS cycle detection with canonicalized reporting (one per cycle)
+    seen_cycles: set[tuple] = set()
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in
+             set(graph) | {b for m in graph.values() for b in m}}
+    stack: list[str] = []
+
+    def visit(n: str) -> None:
+        color[n] = GRAY
+        stack.append(n)
+        for nxt, node in sorted(graph.get(n, {}).items()):
+            if color[nxt] == GRAY:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                lo = min(range(len(cyc) - 1), key=lambda i: cyc[i])
+                canon = tuple(cyc[lo:-1] + cyc[:lo])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    labels = [c.split(".")[-1] for c in cyc]
+                    findings.append(Finding(
+                        "JR002",
+                        "lock-order inversion: "
+                        + " -> ".join(labels)
+                        + " — two threads traversing this cycle from "
+                        "different entry points deadlock; pick one "
+                        "order and pin it",
+                        path, node.lineno, node.col_offset))
+            elif color[nxt] == WHITE:
+                visit(nxt)
+        stack.pop()
+        color[n] = BLACK
+
+    for n in sorted(color):
+        if color[n] == WHITE:
+            visit(n)
+    return findings
+
+
+# --------------------------------------------------------- signal handlers
+
+_HANDLER_UNSAFE_CALLS = {
+    "time.sleep": "sleeps",
+    "open": "performs file I/O",
+    "get_registry": "calls the metrics registry (its counters take "
+                    "locks — mirror from normal context, the "
+                    "PreemptionGuard idiom)",
+}
+
+
+def _signal_findings(tree, path: str, classes: dict[str, _Class],
+                     module_defs: dict, module_locks: dict
+                     ) -> list[Finding]:
+    parents = walk_with_parents(tree)
+    findings: list[Finding] = []
+    # handlers registered by bare name may be nested defs (serve
+    # __main__'s on_signal lives inside main()) — resolve any def that
+    # is not a method
+    module_defs = dict(module_defs)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and not isinstance(parents.get(node), ast.ClassDef) \
+                and node.name not in module_defs:
+            module_defs[node.name] = node
+
+    def enclosing_class(node) -> _Class | None:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return classes.get(cur.name)
+            cur = parents.get(cur)
+        return None
+
+    def check_body(nodes, handler: str, owner: _Class | None,
+                   depth: int, visited: set) -> None:
+        resolver = _make_resolver(owner, {}, module_locks)
+        callees: list[tuple] = []
+        for top in nodes:
+            for node in ast.walk(top):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        lock = resolver(item.context_expr)
+                        d = dotted_name(item.context_expr) or ""
+                        if lock is not None or "lock" in d.lower():
+                            findings.append(Finding(
+                                "JR003",
+                                f"signal path '{handler}' acquires lock "
+                                f"'{d or lock.label}' — a handler can "
+                                "interrupt the holder and deadlock",
+                                path, item.context_expr.lineno,
+                                item.context_expr.col_offset))
+                elif isinstance(node, ast.Call):
+                    fn = dotted_name(node.func) or ""
+                    if isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "acquire":
+                        nonblocking = any(
+                            k.arg == "blocking"
+                            and isinstance(k.value, ast.Constant)
+                            and k.value.value is False
+                            for k in node.keywords) or (
+                            node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and node.args[0].value is False)
+                        if not nonblocking:
+                            findings.append(Finding(
+                                "JR003",
+                                f"signal path '{handler}' calls blocking "
+                                ".acquire() — use acquire(blocking="
+                                "False) (the TraceCapture idiom) or a "
+                                "plain attribute flag",
+                                path, node.lineno, node.col_offset))
+                    for pat, verb in _HANDLER_UNSAFE_CALLS.items():
+                        if fn == pat or fn.endswith("." + pat):
+                            findings.append(Finding(
+                                "JR003",
+                                f"signal path '{handler}' {verb}",
+                                path, node.lineno, node.col_offset))
+                    if _blocking_reason(node) is not None \
+                            and (not isinstance(node.func, ast.Attribute)
+                                 or node.func.attr not in ("acquire",)):
+                        reason = _blocking_reason(node)
+                        if reason not in ("time.sleep",):  # reported above
+                            findings.append(Finding(
+                                "JR003",
+                                f"signal path '{handler}' may block: "
+                                f"{reason}",
+                                path, node.lineno, node.col_offset))
+                    if depth == 0:
+                        if fn.startswith("self.") and owner is not None \
+                                and fn[5:] in owner.methods:
+                            callees.append((fn[5:], owner))
+                        elif fn in module_defs:
+                            callees.append((fn, None))
+        for name, ocls in callees:
+            if name in visited:
+                continue
+            visited.add(name)
+            body = (ocls.methods[name].node.body if ocls is not None
+                    else module_defs[name].body)
+            check_body(body, f"{handler} -> {name}", ocls, 1, visited)
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func) in ("signal.signal",)
+                and len(node.args) >= 2):
+            continue
+        h = node.args[1]
+        d = dotted_name(h)
+        if isinstance(h, ast.Lambda):
+            owner = enclosing_class(node)
+            check_body([h.body], "<lambda>", owner, 0, set())
+        elif d and d.startswith("self."):
+            owner = enclosing_class(node)
+            name = d[5:]
+            if owner is not None and name in owner.methods:
+                check_body(owner.methods[name].node.body, name, owner,
+                           0, {name})
+        elif d and d in module_defs:
+            check_body(module_defs[d].body, d, None, 0, {d})
+    return findings
+
+
+# -------------------------------------------------------------- file driver
+
+def _analyze_file(src: str, path: str, tree=None
+                  ) -> tuple[list[Finding], dict, list]:
+    """Raw findings + ``{class_key: {attr: lock_attr}}`` guard map +
+    lock-order edges ``(a, b)`` for one file."""
+    if tree is None:
+        tree = ast.parse(src)
+    comments = _comment_lines(src)
+    meta: list[Finding] = []
+
+    # module-level locks + defs
+    module_locks: dict[str, _Lock] = {}
+    module_defs: dict[str, ast.FunctionDef] = {}
+    rel = _rel_path(path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            ctor = _ctor_of(node.value)
+            if ctor in _LOCK_CTORS:
+                name = node.targets[0].id
+                module_locks[name] = _Lock(
+                    f"{rel}:{name}", name, ctor in _REENTRANT_CTORS)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module_defs[node.name] = node
+
+    classes: dict[str, _Class] = {}
+    claimed_lines: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            cls = _extract_class(node, path, comments, module_locks,
+                                 meta)
+            classes[cls.name] = cls
+            claimed_lines |= {n.lineno
+                              for n in cls.declared_nodes.values()}
+    meta.extend(_dangling_guarded_by(comments, path, claimed_lines))
+
+    findings: list[Finding] = list(meta)
+    guards: dict[str, dict[str, str]] = {}
+    all_edges: list = []
+    locks_by_ident: dict[str, _Lock] = dict(module_locks and {
+        lk.ident: lk for lk in module_locks.values()} or {})
+
+    for cls in classes.values():
+        for lk in cls.locks.values():
+            locks_by_ident[lk.ident] = lk
+        f, g, e = _judge_class(cls, path)
+        findings.extend(f)
+        if g:
+            guards[cls.key] = g
+        all_edges.extend(e)
+
+    for name, fn_node in module_defs.items():
+        mi = _Method(fn_node)
+        locals_ = _collect_local_locks(fn_node, f"{rel}:{name}")
+        for lk in locals_.values():
+            locks_by_ident[lk.ident] = lk
+        walker = _FlowWalker(_make_resolver(None, locals_, module_locks),
+                             mi, set())
+        walker.run(fn_node.body)
+        f, e = _judge_function(name, mi, path)
+        findings.extend(f)
+        all_edges.extend(e)
+
+    findings.extend(_order_findings(all_edges, path, locks_by_ident))
+    findings.extend(_signal_findings(tree, path, classes, module_defs,
+                                     module_locks))
+    edge_pairs = sorted({(a, b) for a, b, _n in all_edges if a != b})
+    return findings, guards, edge_pairs
+
+
+def race_source(src: str, path: str = "<string>", tree=None,
+                suppress: bool = True) -> list[Finding]:
+    """All four JR rules over one source string.  ``suppress=False``
+    ignores ``# jaxrace:`` disables (the raw view
+    :func:`core.suppression_report` audits for staleness)."""
+    if tree is None:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            return [Finding(META_CODE, f"syntax error: {e.msg}", path,
+                            e.lineno or 1, e.offset or 0)]
+    findings, _guards, _edges = _analyze_file(src, path, tree)
+    line_dis, file_dis, meta = parse_suppressions(
+        src, path, set(RACE_CODES), tool="jaxrace", meta_code=META_CODE)
+    findings.extend(meta)
+    if not suppress:
+        line_dis, file_dis = {}, set()
+    findings = [
+        f for f in findings
+        if f.code not in file_dis
+        and f.code not in line_dis.get(f.line, ())
+    ]
+    return sorted(findings, key=lambda f: (f.line, f.col, f.code))
+
+
+def race_paths(paths) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in iter_python_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        findings.extend(race_source(src, path=f))
+    return sorted(findings, key=lambda x: (x.path, x.line, x.col, x.code))
+
+
+# ------------------------------------------------------------- the contract
+
+def build_thread_model(paths) -> dict:
+    """The pinnable model: effective guard map (declared + inferred) per
+    class and the package-wide lock-order edge list."""
+    guards: dict[str, dict[str, str]] = {}
+    edges: set = set()
+    for f in iter_python_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        _findings, g, e = _analyze_file(src, f, tree)
+        guards.update(g)
+        edges.update(e)
+    return {"guards": {k: dict(sorted(v.items()))
+                       for k, v in sorted(guards.items())},
+            "lock_order": [list(p) for p in sorted(edges)]}
+
+
+def threads_contract_path(contracts_dir: str) -> str:
+    return os.path.join(contracts_dir, THREADS_CONTRACT_FILE)
+
+
+def load_thread_pin(contracts_dir: str) -> dict | None:
+    path = threads_contract_path(contracts_dir)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def save_thread_model(model: dict, contracts_dir: str) -> str:
+    os.makedirs(contracts_dir, exist_ok=True)
+    doc = {"kind": "threads", "program": "threads",
+           "guards": model["guards"], "lock_order": model["lock_order"]}
+    path = threads_contract_path(contracts_dir)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def diff_thread_model(pinned: dict, model: dict) -> list[str]:
+    """Pin drift — a guard map or acquisition order changing without a
+    reviewed ``jaxrace update`` fails the gate like a stale jaxaudit
+    contract."""
+    drift: list[str] = []
+    want_g = pinned.get("guards") or {}
+    have_g = model["guards"]
+    for key in sorted(set(want_g) | set(have_g)):
+        if key not in have_g:
+            drift.append(f"{key}: pinned guard map vanished — run "
+                         "`jaxrace update` after review")
+        elif key not in want_g:
+            drift.append(f"{key}: new guard map "
+                         f"{have_g[key]} — not pinned; run "
+                         "`jaxrace update` and review")
+        elif want_g[key] != have_g[key]:
+            drift.append(f"{key}: guard map changed "
+                         f"(pinned {want_g[key]}, live {have_g[key]})")
+    want_e = {tuple(p) for p in (pinned.get("lock_order") or [])}
+    have_e = {tuple(p) for p in model["lock_order"]}
+    for a, b in sorted(want_e - have_e):
+        drift.append(f"lock-order edge {a} -> {b}: pinned but no longer "
+                     "taken")
+    for a, b in sorted(have_e - want_e):
+        drift.append(f"lock-order edge {a} -> {b}: new nested "
+                     "acquisition — not pinned; review for inversions "
+                     "against the existing order, then `jaxrace update`")
+    return drift
+
+
+# ------------------------------------------------------------------- the CLI
+
+def run_race_cli(argv: list[str] | None = None) -> int:
+    """``jaxrace {audit|check|update|list} [paths...]``.
+
+    * ``audit``  — findings + the live model (informational, exit 0);
+    * ``check``  — the gate: findings or ``threads.json`` drift exit 1;
+    * ``update`` — regenerate the pin after a REVIEWED change;
+    * ``list``   — the rule table.
+
+    AST-only: no jax import, no compile — safe for pre-commit, runs in
+    both halves of ``scripts/lint.sh``.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="jaxrace",
+        description="static host-concurrency analyzer: guarded-by "
+                    "discipline, lock ordering, signal safety, blocking-"
+                    "under-lock (see docs/DESIGN.md 'Static analysis').")
+    parser.add_argument("command",
+                        choices=("audit", "check", "update", "list"),
+                        help="audit: print findings+model; check: gate "
+                             "(exit 1 on findings/drift); update: "
+                             "regenerate threads.json; list: rules")
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    parser.add_argument("paths", nargs="*", default=[pkg_dir],
+                        help="files or directories (default: the "
+                             "package)")
+    parser.add_argument("--contracts-dir", default=None,
+                        help="contract directory (default: the repo's "
+                             "tests/contracts)")
+    args = parser.parse_intermixed_args(argv)
+
+    if args.command == "list":
+        print(f"{META_CODE}  meta: syntax error, malformed/unknown "
+              "# jaxrace: directive, dangling guarded-by")
+        for code in sorted(RACE_RULES):
+            name, summary = RACE_RULES[code]
+            print(f"{code}  {name}: {summary}")
+        return 0
+
+    from .contracts import default_contracts_dir  # import-light (stdlib)
+
+    contracts_dir = args.contracts_dir or default_contracts_dir()
+    try:
+        findings = race_paths(args.paths)
+        model = build_thread_model(args.paths)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"jaxrace: error: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.format())
+
+    if args.command == "audit":
+        print(json.dumps(model, indent=1, sort_keys=True))
+        if findings:
+            print(f"jaxrace: {len(findings)} finding(s)",
+                  file=sys.stderr)
+        return 0
+
+    if args.command == "update":
+        path = save_thread_model(model, contracts_dir)
+        print(f"wrote {path}")
+        return 0
+
+    # check
+    pinned = load_thread_pin(contracts_dir)
+    if pinned is None:
+        drift = [f"no thread pin {THREADS_CONTRACT_FILE} in "
+                 f"{contracts_dir} — run `jaxrace update` and review"]
+    else:
+        drift = diff_thread_model(pinned, model)
+    for line in drift:
+        print(line)
+    if not drift:
+        print(f"threads: ok ({len(model['guards'])} guarded class(es), "
+              f"{len(model['lock_order'])} lock-order edge(s))")
+    if findings or drift:
+        print(f"jaxrace: {len(findings)} finding(s), "
+              f"{len(drift)} contract failure(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    return run_race_cli(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

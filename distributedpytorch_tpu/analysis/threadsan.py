@@ -1,0 +1,189 @@
+"""Runtime lock-sanitizer witness for the jaxrace guard map.
+
+jaxrace's JR001 verdicts are static claims: "every write to
+``PredictorPool._active`` happens under ``_lock``".  This module makes
+the existing under-load tests *witness* those claims at runtime — the
+jaxaudit pattern of a runtime check vouching for a static one, applied
+to host threads instead of compiled programs.
+
+Opt-in via ``DPTPU_THREADSAN=1`` (the tests' conftest installs the
+checked-in ``tests/contracts/threads.json`` for the whole session and
+asserts zero violations at teardown), or programmatically::
+
+    from distributedpytorch_tpu.analysis import threadsan
+    threadsan.install(json.load(open("tests/contracts/threads.json")))
+    ... run threaded workload ...
+    assert threadsan.violations() == []
+    threadsan.uninstall()
+
+Mechanism, per pinned class:
+
+* after ``__init__`` returns, every lock attribute named by the guard
+  map is replaced with a :class:`_LockWitness` proxy that tracks a
+  thread-local held set (``with``/``acquire``/``release``; everything
+  else — ``wait``, ``notify``, ... — passes through);
+* ``__setattr__`` is replaced with a checker: writing a guarded
+  attribute while the pinned lock's witness is not held by the current
+  thread records a violation.  Writes are the instrumented half by
+  design — every data race needs a mutating side, and write-side-only
+  keeps the hot-path read cost at zero.  During ``__init__`` the lock
+  attribute is still a raw lock (the witness wraps it only afterwards),
+  so single-threaded construction is exempt, mirroring JR001's
+  ``__init__`` carve-out.
+
+In-place container mutation (``self._gens[k] = ...``) never reaches
+``__setattr__`` — the static layer covers those through the reads that
+surround them; the witness covers rebinding.  Stdlib-only, no jax.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+import traceback
+
+_tls = threading.local()
+_vlock = threading.Lock()
+_violations: list[dict] = []
+#: (cls, {"__init__": orig, "__setattr__": orig}) restore records
+_installed: list[tuple] = []
+
+
+def _held() -> dict:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = {}
+    return held
+
+
+class _LockWitness:
+    """Wraps a Lock/RLock/Condition; tracks per-thread holds."""
+
+    __slots__ = ("_tsan_lock",)
+
+    def __init__(self, lock):
+        object.__setattr__(self, "_tsan_lock", lock)
+
+    # ---- the mutual-exclusion surface
+    def acquire(self, *args, **kwargs):
+        got = self._tsan_lock.acquire(*args, **kwargs)
+        if got:
+            held = _held()
+            held[id(self)] = held.get(id(self), 0) + 1
+        return got
+
+    def release(self):
+        held = _held()
+        n = held.get(id(self), 0)
+        if n <= 1:
+            held.pop(id(self), None)
+        else:
+            held[id(self)] = n - 1
+        self._tsan_lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def held_by_me(self) -> bool:
+        return _held().get(id(self), 0) > 0
+
+    # ---- everything else (Condition.wait/notify, locked(), ...)
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_tsan_lock"), name)
+
+
+def _record(cls_name: str, attr: str, lock_attr: str) -> None:
+    with _vlock:
+        _violations.append({
+            "class": cls_name,
+            "attr": attr,
+            "lock": lock_attr,
+            "thread": threading.current_thread().name,
+            "stack": "".join(traceback.format_stack(limit=8)[:-2]),
+        })
+
+
+def _instrument(cls, guards: dict[str, str]) -> None:
+    lock_attrs = sorted(set(guards.values()))
+    orig_init = cls.__init__
+    orig_setattr = cls.__setattr__
+
+    def checked_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        for la in lock_attrs:
+            # getattr, not __dict__: guarded classes may use __slots__
+            # (the registry primitives do)
+            lk = getattr(self, la, None)
+            if lk is not None and not isinstance(lk, _LockWitness):
+                object.__setattr__(self, la, _LockWitness(lk))
+
+    def checked_setattr(self, name, value):
+        la = guards.get(name)
+        if la is not None:
+            w = getattr(self, la, None)
+            # raw lock (mid-__init__) or absent: construction carve-out
+            if isinstance(w, _LockWitness) and not w.held_by_me():
+                _record(cls.__name__, name, la)
+        orig_setattr(self, name, value)
+
+    cls.__init__ = checked_init
+    cls.__setattr__ = checked_setattr
+    _installed.append((cls, {"__init__": orig_init,
+                             "__setattr__": orig_setattr}))
+
+
+def _resolve(class_key: str):
+    """``distributedpytorch_tpu/serve/swap.py:PredictorPool`` -> class.
+    Returns None for keys whose module lives outside the package
+    (contract entries for test fixtures)."""
+    path, _, cls_name = class_key.rpartition(":")
+    if not path.endswith(".py") \
+            or not path.startswith("distributedpytorch_tpu/"):
+        return None
+    mod_name = path[:-3].replace("/", ".")
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, cls_name, None)
+
+
+def install(contract: dict) -> list[str]:
+    """Instrument every package class in the contract's guard map;
+    returns the class keys actually instrumented.  Idempotent per
+    session — call :func:`uninstall` before re-installing."""
+    if _installed:
+        raise RuntimeError("threadsan already installed — uninstall() "
+                           "first")
+    done: list[str] = []
+    for class_key, guards in sorted((contract.get("guards")
+                                     or {}).items()):
+        cls = _resolve(class_key)
+        if cls is None:
+            continue
+        _instrument(cls, dict(guards))
+        done.append(class_key)
+    return done
+
+
+def uninstall() -> None:
+    while _installed:
+        cls, originals = _installed.pop()
+        for name, fn in originals.items():
+            setattr(cls, name, fn)
+
+
+def violations() -> list[dict]:
+    with _vlock:
+        return list(_violations)
+
+
+def reset() -> None:
+    with _vlock:
+        _violations.clear()
+
+
+def is_installed() -> bool:
+    return bool(_installed)

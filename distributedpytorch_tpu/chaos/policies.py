@@ -161,14 +161,57 @@ class Timeout:
     will restart it anyway (the contract ``device_op_alive`` always had).
     This is NOT cancellation: the wedged work keeps its thread.  Use for
     liveness probes, never around state mutations.
+
+    Abandoned workers are RECORDED, not forgotten: each leak bumps the
+    ``chaos_timeout_threads_leaked`` counter, and :meth:`reap` (run at
+    the top of every call) joins any that have since finished — a
+    recovering dependency frees its threads instead of accumulating one
+    zombie per timeout for the process lifetime.
     """
 
     def __init__(self, timeout_s: float):
         if timeout_s <= 0:
             raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
         self.timeout_s = timeout_s
+        #: workers abandoned past their deadline, reaped opportunistically
+        self._leaked: list[threading.Thread] = []
+
+    def reap(self) -> int:
+        """Join leaked workers that have since finished; returns how many
+        are STILL wedged.  Runs at the top of every :meth:`call` so a
+        policy whose probe recovers late frees its thread on the next
+        use, not at process exit — the jaxrace JR-flagged blocking call,
+        made observable and bounded."""
+        still = []
+        for t in self._leaked:
+            t.join(0)
+            if t.is_alive():
+                still.append(t)
+        self._leaked = still
+        return len(still)
+
+    @property
+    def leaked_threads(self) -> int:
+        """Currently-abandoned (still running) workers."""
+        return len(self._leaked)
+
+    @staticmethod
+    def _count_leak() -> None:
+        # lazy: this module stays stdlib-only and importable pre-jax
+        # (backend_health imports it before choosing a platform)
+        try:
+            from ..telemetry.registry import get_registry, is_enabled
+
+            if is_enabled():
+                get_registry().counter(
+                    "chaos_timeout_threads_leaked",
+                    "Timeout workers abandoned past their deadline"
+                ).inc()
+        except Exception:  # noqa: BLE001 — accounting must never raise
+            pass
 
     def call(self, fn: Callable[[], Any]) -> Any:
+        self.reap()
         box: dict = {}
 
         def run() -> None:
@@ -181,8 +224,11 @@ class Timeout:
         t.start()
         t.join(self.timeout_s)
         if t.is_alive():
+            self._leaked.append(t)
+            self._count_leak()
             raise PolicyTimeoutError(
-                f"call exceeded {self.timeout_s}s (worker abandoned)")
+                f"call exceeded {self.timeout_s}s (worker abandoned; "
+                f"{len(self._leaked)} leaked, reaped on next call)")
         if "error" in box:
             raise box["error"]
         return box["value"]
@@ -211,17 +257,19 @@ class CircuitBreaker:
         self.reset_after_s = reset_after_s
         self._clock = clock
         self._lock = threading.Lock()
-        self._failures = 0
-        self._opened_at: float | None = None
+        self._failures = 0  # jaxrace: guarded-by=self._lock
+        self._opened_at: float | None = None  # jaxrace: guarded-by=self._lock
 
     @property
     def failures(self) -> int:
         """Consecutive failures so far (0 after any success)."""
-        return self._failures
+        with self._lock:
+            return self._failures
 
     @property
     def is_open(self) -> bool:
-        return self._opened_at is not None
+        with self._lock:
+            return self._opened_at is not None
 
     def _half_open_ready(self) -> bool:
         if self._opened_at is None or self.reset_after_s is None:

@@ -113,12 +113,12 @@ class PredictorPool:
         from ..telemetry.registry import get_registry
 
         self._lock = threading.Lock()
-        self._gens: dict[int, Generation] = {
+        self._gens: dict[int, Generation] = {  # jaxrace: guarded-by=self._lock
             0: Generation(0, predictor, "initial", "active")}
-        self._next_id = 1
-        self._active = 0
-        self._canary: int | None = None
-        self._rr = 0  # stateless round-robin counter
+        self._next_id = 1        # jaxrace: guarded-by=self._lock
+        self._active = 0         # jaxrace: guarded-by=self._lock
+        self._canary: int | None = None  # jaxrace: guarded-by=self._lock
+        self._rr = 0  # stateless round-robin counter; jaxrace: guarded-by=self._lock
         self.canary_fraction = float(canary_fraction)
         self.min_observations = int(min_observations)
         self.max_error_rate = float(max_error_rate)
@@ -138,18 +138,22 @@ class PredictorPool:
 
     @property
     def active_generation(self) -> int:
-        return self._active
+        with self._lock:
+            return self._active
 
     @property
     def canary_generation(self) -> int | None:
-        return self._canary
+        with self._lock:
+            return self._canary
 
     @property
     def active_predictor(self):
-        return self._gens[self._active].predictor
+        with self._lock:
+            return self._gens[self._active].predictor
 
     def predictor_for(self, gen_id: int):
-        return self._gens[gen_id].predictor
+        with self._lock:
+            return self._gens[gen_id].predictor
 
     def route(self, session_id: str | None) -> tuple[int, object]:
         """(generation id, predictor) for a NEW session or a stateless

@@ -58,7 +58,7 @@ class Counter:
     def __init__(self, labels: tuple = _NO_LABELS):
         self.labels = labels
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # jaxrace: guarded-by=self._lock
 
     def inc(self, n: float = 1.0) -> None:
         if n < 0:
@@ -68,7 +68,8 @@ class Counter:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Gauge:
@@ -79,7 +80,7 @@ class Gauge:
     def __init__(self, labels: tuple = _NO_LABELS):
         self.labels = labels
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # jaxrace: guarded-by=self._lock
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -94,7 +95,8 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Histogram:
@@ -113,8 +115,8 @@ class Histogram:
         self.labels = labels
         self._lock = threading.Lock()
         self._samples: collections.deque = collections.deque(maxlen=reservoir)
-        self._count = 0
-        self._sum = 0.0
+        self._count = 0    # jaxrace: guarded-by=self._lock
+        self._sum = 0.0    # jaxrace: guarded-by=self._lock
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -124,11 +126,13 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def percentile(self, q: float) -> float | None:
         with self._lock:

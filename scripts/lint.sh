@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# The static-analysis gate, all three layers in one command:
+# The static-analysis gate, all four layers in one command:
 #
 #   1. jaxlint — AST-level TPU hazards over everything device-adjacent:
 #      the package (serve/ included — the batcher feeds a jitted forward
@@ -98,14 +98,32 @@
 #      aliasing, baked constants, FLOPs bounds).  After a REVIEWED
 #      program change, regenerate with
 #      `python -m distributedpytorch_tpu.analysis --ir update`.
+#   4. jaxrace check — host-concurrency layer (analysis/race.py): the
+#      serve stack is a multi-threaded HOST program (submit threads +
+#      worker + hot-swap + signal handlers) and none of the jax-level
+#      layers can see its hazards.  JR001 guarded-by discipline
+#      (declared via `# jaxrace: guarded-by=self._lock` or
+#      majority-inferred), JR002 lock-order inversion against the
+#      blessed order, JR003 blocking/lock-taking signal handlers,
+#      JR004 blocking calls under a held lock.  The guard map + lock
+#      order are pinned in tests/contracts/threads.json (no platform
+#      key — host threads are topology-independent); after a REVIEWED
+#      threading change, regenerate with
+#      `python -m distributedpytorch_tpu.analysis --race update`.
+#      Runtime witness: DPTPU_THREADSAN=1 makes the under-load serve
+#      tests validate the pinned guard map against real schedules.
+#      `jaxlint --stats` polices `# jaxrace:` disables for staleness
+#      alongside the other grammars.
 #
 # Mirror of the tier-1 gates (tests/test_lint_clean.py +
-# tests/test_jaxguard.py + tests/test_jaxaudit.py); run it before
-# pushing anything that touches device code:
+# tests/test_jaxguard.py + tests/test_jaxaudit.py +
+# tests/test_jaxrace.py); run it before pushing anything that touches
+# device code:
 #
-#     scripts/lint.sh                # all three layers
-#     scripts/lint.sh --guard        # jaxlint + jaxguard AST half only
-#                                    # (no jax import — pre-commit speed)
+#     scripts/lint.sh                # all four layers
+#     scripts/lint.sh --guard        # the AST-only layers (jaxlint +
+#                                    # jaxguard AST half + jaxrace) —
+#                                    # no jax import, pre-commit speed
 #     scripts/lint.sh --select JL002 # one lint rule (skips IR gates)
 #
 # Extra args pass through to the LINTER CLI (--select/--ignore/paths)
@@ -119,6 +137,8 @@ if [ "$#" -eq 1 ] && [ "$1" = "--guard" ]; then
         distributedpytorch_tpu bench.py
     python -m distributedpytorch_tpu.analysis --guard check --no-ir \
         distributedpytorch_tpu bench.py
+    python -m distributedpytorch_tpu.analysis --race check \
+        distributedpytorch_tpu bench.py
     exit 0
 fi
 python -m distributedpytorch_tpu.analysis \
@@ -127,6 +147,8 @@ if [ "$#" -eq 0 ]; then
     python -m distributedpytorch_tpu.analysis --stats \
         distributedpytorch_tpu bench.py
     python -m distributedpytorch_tpu.analysis --guard check \
+        distributedpytorch_tpu bench.py
+    python -m distributedpytorch_tpu.analysis --race check \
         distributedpytorch_tpu bench.py
     python -m distributedpytorch_tpu.analysis --ir check
 fi

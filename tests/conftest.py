@@ -109,3 +109,39 @@ def serve_split_predictor():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _threadsan_witness():
+    """DPTPU_THREADSAN=1 arms the jaxrace runtime witness for the whole
+    session: the pinned guard map (tests/contracts/threads.json) is
+    installed over the live classes, declared locks become witnesses,
+    and every guarded attribute write is checked against the writing
+    thread's held set.  The under-load serve/swap tests then validate
+    the STATIC guard map against real schedules — teardown fails the
+    session on any recorded violation.  Off by default: instrumented
+    ``__setattr__`` costs a dict probe per write."""
+    if os.environ.get("DPTPU_THREADSAN") != "1":
+        yield
+        return
+    import json
+
+    from distributedpytorch_tpu.analysis import threadsan
+    from distributedpytorch_tpu.analysis.race import threads_contract_path
+
+    pin = threads_contract_path(
+        os.path.join(os.path.dirname(__file__), "contracts"))
+    with open(pin, encoding="utf-8") as fh:
+        contract = json.load(fh)
+    installed = threadsan.install(contract)
+    try:
+        yield
+    finally:
+        violations = threadsan.violations()
+        threadsan.uninstall()
+        assert not violations, (
+            f"threadsan: {len(violations)} unguarded write(s) to "
+            f"declared-guarded attributes (instrumented: {installed}):\n"
+            + "\n".join(
+                f"  {v['class']}.{v['attr']} (guard {v['lock']}) "
+                f"on thread {v['thread']}" for v in violations[:10]))

@@ -1,0 +1,556 @@
+"""jaxrace: host-concurrency analyzer + threadsan witness, tier-1.
+
+Mirrors test_jaxguard's drift-injection idiom one layer further from the
+device: every rule gets a SEEDED hazard fixture (the injected finding is
+reported exactly, with non-zero exit, through the same CLI the gate
+runs) and a clean counterpart using the sanctioned idiom (access under
+the declared lock, consistent nesting order, ``acquire(blocking=False)``
+in a handler, sleep outside the critical section).  The contract half
+walks a toy class through the full pin -> drift -> fail -> re-pin loop
+against a tmp contracts dir, and the package self-check pins the real
+tree clean against the checked-in ``tests/contracts/threads.json``.
+
+The runtime half exercises :mod:`analysis.threadsan` against the REAL
+``PredictorPool`` guard map with a dummy predictor object — no jax, no
+compile: a bare write to a declared-guarded attribute is a recorded
+violation, the same write under the lock is not.
+
+Everything here is pure stdlib (the analyzer never imports jax — host
+threads are topology-independent).
+"""
+
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedpytorch_tpu.analysis.race import (  # noqa: E402
+    META_CODE,
+    RACE_RULES,
+    build_thread_model,
+    diff_thread_model,
+    race_paths,
+    race_source,
+    run_race_cli,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO, "distributedpytorch_tpu")
+BENCH = os.path.join(REPO, "bench.py")
+CONTRACTS_DIR = os.path.join(REPO, "tests", "contracts")
+
+
+def _findings(src):
+    return race_source(textwrap.dedent(src), path="fixture.py")
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def _cli(tmp_path, src, capsys=None, name="hazard.py"):
+    """Seed one fixture file, pin its model, then run ``check`` — so the
+    check exercises FINDINGS, not the missing-pin drift line.  ``capsys``
+    is drained between the two runs so callers count only the check's
+    output."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    cdir = str(tmp_path / "contracts")
+    assert run_race_cli(["update", str(p), "--contracts-dir", cdir]) == 0
+    if capsys is not None:
+        capsys.readouterr()
+    return run_race_cli(["check", str(p), "--contracts-dir", cdir])
+
+
+# ------------------------------------------------ JR001 guarded-by
+
+class TestGuardedByJR001:
+    SEEDED = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # jaxrace: guarded-by=self._lock
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def peek(self):
+                return self._n
+    """
+
+    def test_seeded_bare_read_of_declared_attr_fires(self, tmp_path,
+                                                     capsys):
+        found = _findings(self.SEEDED)
+        assert codes(found) == ["JR001"]
+        assert "_n" in found[0].message
+        assert "_lock" in found[0].message
+        rc = _cli(tmp_path, self.SEEDED, capsys)
+        out = capsys.readouterr()
+        assert rc == 1
+        assert out.out.count("JR001") == 1
+
+    def test_clean_counterpart_access_under_lock(self, tmp_path):
+        clean = self.SEEDED.replace(
+            "                return self._n",
+            "                with self._lock:\n"
+            "                    return self._n")
+        assert _findings(clean) == []
+        assert _cli(tmp_path, clean) == 0
+
+    def test_majority_inference_flags_the_odd_one_out(self):
+        src = """
+            import threading
+
+            class Tally:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def add(self):
+                    with self._lock:
+                        self._n += 1
+
+                def sub(self):
+                    with self._lock:
+                        self._n -= 1
+
+                def peek(self):
+                    return self._n
+        """
+        found = _findings(src)
+        assert codes(found) == ["JR001"]
+        assert "inferred" in found[0].message
+
+    def test_line_disable_waives_and_unknown_code_is_meta(self):
+        waived = self.SEEDED.replace(
+            "return self._n",
+            "return self._n  # jaxrace: disable=JR001")
+        assert _findings(waived) == []
+        assert codes(_findings(
+            "x = 1  # jaxrace: disable=JR999\n")) == [META_CODE]
+
+    def test_dangling_guarded_by_is_meta(self):
+        src = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    # jaxrace: guarded-by=self._lock
+                    self._n = 0
+        """
+        found = _findings(src)
+        assert codes(found) == [META_CODE]
+        assert "guarded-by" in found[0].message
+
+
+# ------------------------------------------- JR002 lock-order inversion
+
+class TestLockOrderJR002:
+    SEEDED = """
+        import threading
+
+        class Two:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+
+    def test_seeded_inversion_cycle_fires(self, tmp_path, capsys):
+        found = _findings(self.SEEDED)
+        assert codes(found) == ["JR002"]
+        assert "_a" in found[0].message and "_b" in found[0].message
+        rc = _cli(tmp_path, self.SEEDED, capsys)
+        out = capsys.readouterr()
+        assert rc == 1
+        assert out.out.count("JR002") == 1
+
+    def test_clean_counterpart_consistent_order(self, tmp_path):
+        clean = self.SEEDED.replace(
+            "                with self._b:\n"
+            "                    with self._a:",
+            "                with self._a:\n"
+            "                    with self._b:")
+        assert _findings(clean) == []
+        assert _cli(tmp_path, clean) == 0
+
+    def test_non_reentrant_self_acquire_is_self_deadlock(self):
+        src = """
+            import threading
+
+            class Re:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+        """
+        found = _findings(src)
+        assert "JR002" in codes(found)
+        # the same nesting through an RLock is the sanctioned idiom
+        assert _findings(src.replace("threading.Lock()",
+                                     "threading.RLock()")) == []
+
+
+# ------------------------------------------- JR003 signal-handler safety
+
+class TestSignalSafetyJR003:
+    SEEDED = """
+        import signal
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def on_term(signum, frame):
+            with _LOCK:
+                pass
+
+        signal.signal(signal.SIGTERM, on_term)
+    """
+
+    def test_seeded_lock_taking_handler_fires(self, tmp_path, capsys):
+        found = _findings(self.SEEDED)
+        assert codes(found) == ["JR003"]
+        rc = _cli(tmp_path, self.SEEDED, capsys)
+        out = capsys.readouterr()
+        assert rc == 1
+        assert out.out.count("JR003") == 1
+
+    def test_clean_counterpart_nonblocking_probe(self, tmp_path):
+        # the TraceCapture idiom: a handler may TRY the lock, never wait
+        clean = self.SEEDED.replace(
+            "            with _LOCK:\n                pass",
+            "            if _LOCK.acquire(blocking=False):\n"
+            "                _LOCK.release()")
+        assert clean != self.SEEDED
+        assert _findings(clean) == []
+        assert _cli(tmp_path, clean) == 0
+
+    def test_blocking_sleep_in_handler_fires(self):
+        src = """
+            import signal
+            import time
+
+            def on_term(signum, frame):
+                time.sleep(0.1)
+
+            signal.signal(signal.SIGTERM, on_term)
+        """
+        assert codes(_findings(src)) == ["JR003"]
+
+
+# ------------------------------------------- JR004 blocking-under-lock
+
+class TestBlockingUnderLockJR004:
+    SEEDED = """
+        import threading
+        import time
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def nap(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """
+
+    def test_seeded_sleep_under_lock_fires(self, tmp_path, capsys):
+        found = _findings(self.SEEDED)
+        assert codes(found) == ["JR004"]
+        assert "sleep" in found[0].message
+        rc = _cli(tmp_path, self.SEEDED, capsys)
+        out = capsys.readouterr()
+        assert rc == 1
+        assert out.out.count("JR004") == 1
+
+    def test_clean_counterpart_sleep_outside(self, tmp_path):
+        clean = self.SEEDED.replace(
+            "                with self._lock:\n"
+            "                    time.sleep(1.0)",
+            "                with self._lock:\n"
+            "                    pass\n"
+            "                time.sleep(1.0)")
+        assert _findings(clean) == []
+        assert _cli(tmp_path, clean) == 0
+
+    def test_condition_wait_on_own_lock_is_sanctioned(self):
+        # Condition.wait RELEASES the lock it is waited on — blocking
+        # there is the whole point of a condvar, not a holdup
+        src = """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def take(self):
+                    with self._cv:
+                        self._cv.wait()
+        """
+        assert _findings(src) == []
+
+
+# ------------------------------------------------- the thread contract
+
+class TestThreadContract:
+    CLEAN_V1 = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # jaxrace: guarded-by=self._lock
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+    """
+
+    def test_pin_drift_fail_repin_loop(self, tmp_path, capsys):
+        p = tmp_path / "box.py"
+        p.write_text(textwrap.dedent(self.CLEAN_V1))
+        cdir = str(tmp_path / "contracts")
+
+        assert run_race_cli(["update", str(p),
+                             "--contracts-dir", cdir]) == 0
+        capsys.readouterr()
+        assert run_race_cli(["check", str(p),
+                             "--contracts-dir", cdir]) == 0
+        out = capsys.readouterr()
+        assert "threads: ok" in out.out
+
+        # drift: a second guarded attribute appears without a re-pin
+        p.write_text(textwrap.dedent(self.CLEAN_V1.replace(
+            "self._n = 0  # jaxrace: guarded-by=self._lock",
+            "self._n = 0  # jaxrace: guarded-by=self._lock\n"
+            "                self._m = 0"
+            "  # jaxrace: guarded-by=self._lock")))
+        rc = run_race_cli(["check", str(p), "--contracts-dir", cdir])
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "guard map changed" in out.out
+
+        # reviewed re-pin goes green again
+        assert run_race_cli(["update", str(p),
+                             "--contracts-dir", cdir]) == 0
+        capsys.readouterr()
+        assert run_race_cli(["check", str(p),
+                             "--contracts-dir", cdir]) == 0
+
+    def test_missing_pin_is_loud(self, tmp_path, capsys):
+        p = tmp_path / "box.py"
+        p.write_text(textwrap.dedent(self.CLEAN_V1))
+        rc = run_race_cli(["check", str(p),
+                           "--contracts-dir", str(tmp_path / "empty")])
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "no thread pin" in out.out
+
+    def test_new_lock_order_edge_is_drift(self):
+        pinned = {"guards": {}, "lock_order": []}
+        live = {"guards": {}, "lock_order": [["a._x", "a._y"]]}
+        drift = diff_thread_model(pinned, live)
+        assert len(drift) == 1
+        assert "new nested acquisition" in drift[0]
+
+    def test_checked_in_pin_validates_and_schema_rejects_bad(self):
+        import json
+
+        from distributedpytorch_tpu.analysis.contracts import (
+            validate_contract_file,
+        )
+
+        path = os.path.join(CONTRACTS_DIR, "threads.json")
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        assert validate_contract_file(path, doc) == []
+        bad = dict(doc, lock_order=[["a", "a"]])
+        assert validate_contract_file(path, bad)
+        bad = dict(doc, guards={"k": {"a": 3}})
+        assert validate_contract_file(path, bad)
+
+    def test_list_prints_the_rule_table(self, capsys):
+        assert run_race_cli(["list"]) == 0
+        out = capsys.readouterr()
+        for code in RACE_RULES:
+            assert code in out.out
+
+
+# ------------------------------------------------- package self-check
+
+class TestPackageClean:
+    def test_package_has_no_findings(self):
+        assert race_paths([PKG_DIR, BENCH]) == []
+
+    def test_gate_green_against_checked_in_pin(self, capsys):
+        rc = run_race_cli(["check", PKG_DIR, BENCH,
+                           "--contracts-dir", CONTRACTS_DIR])
+        out = capsys.readouterr()
+        assert rc == 0, out.out
+        assert "threads: ok" in out.out
+
+    def test_stats_polices_jaxrace_grammar(self, tmp_path):
+        from distributedpytorch_tpu.analysis import suppression_report
+
+        p = tmp_path / "waived.py"
+        p.write_text(textwrap.dedent("""
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0  # jaxrace: guarded-by=self._lock
+
+                def peek(self):
+                    return self._n  # jaxrace: disable=JR001
+
+                def stale(self):
+                    with self._lock:
+                        return self._n  # jaxrace: disable=JR001
+        """))
+        entries = [e for e in suppression_report([str(p)])
+                   if e["tool"] == "jaxrace"]
+        assert [e["live"] for e in entries] == [True, False]
+
+
+# ------------------------------------------------- the runtime witness
+
+class TestThreadsan:
+    def _pool(self):
+        from distributedpytorch_tpu.serve.swap import PredictorPool
+        from distributedpytorch_tpu.telemetry.registry import (
+            MetricsRegistry,
+        )
+
+        return PredictorPool(object(), registry=MetricsRegistry())
+
+    def test_bare_write_is_a_violation_locked_write_is_not(self):
+        from distributedpytorch_tpu.analysis import threadsan
+
+        if threadsan.is_installed():
+            pytest.skip("session-wide witness already armed "
+                        "(DPTPU_THREADSAN=1)")
+        contract = {"guards": {
+            "distributedpytorch_tpu/serve/swap.py:PredictorPool": {
+                "_active": "_lock", "_canary": "_lock",
+                "_gens": "_lock", "_next_id": "_lock", "_rr": "_lock",
+                "canary_fraction": "_lock"}}}
+        installed = threadsan.install(contract)
+        try:
+            assert installed  # PredictorPool resolved and instrumented
+            pool = self._pool()  # construction carve-out: no violations
+            assert threadsan.violations() == []
+
+            with pool._lock:
+                pool._active = 0
+            assert threadsan.violations() == []
+
+            pool._active = 7  # bare write from this thread
+            got = threadsan.violations()
+            assert len(got) == 1
+            assert got[0]["class"] == "PredictorPool"
+            assert got[0]["attr"] == "_active"
+            assert got[0]["lock"] == "_lock"
+        finally:
+            threadsan.reset()
+            threadsan.uninstall()
+
+    def test_real_pool_api_is_witness_clean_under_threads(self):
+        """The pool's own methods — the code the static guard map was
+        built FROM — produce zero violations under a real multi-thread
+        schedule: the witness agrees with jaxrace."""
+        import json
+
+        from distributedpytorch_tpu.analysis import threadsan
+
+        if threadsan.is_installed():
+            pytest.skip("session-wide witness already armed "
+                        "(DPTPU_THREADSAN=1)")
+        with open(os.path.join(CONTRACTS_DIR, "threads.json"),
+                  encoding="utf-8") as fh:
+            contract = json.load(fh)
+        threadsan.install(contract)
+        try:
+            pool = self._pool()
+
+            def churn():
+                for i in range(50):
+                    pool.begin_swap(object(), label=f"t{i}")
+                    pool.route(None)
+                    pool.route(f"sess-{i}")
+                    pool.track_inflight(pool.canary_generation, +1)
+                    pool.track_inflight(pool.canary_generation, -1)
+                    pool.rollback()
+                    pool.gc({})
+
+            threads = [threading.Thread(target=pool.snapshot)
+                       for _ in range(4)]
+            threads.append(threading.Thread(target=churn))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert threadsan.violations() == []
+        finally:
+            threadsan.reset()
+            threadsan.uninstall()
+
+
+# ------------------------------------- chaos Timeout leak accounting
+
+class TestTimeoutLeakAccounting:
+    def test_leak_is_counted_and_reaped(self):
+        from distributedpytorch_tpu.chaos.policies import (
+            PolicyTimeoutError,
+            Timeout,
+        )
+        from distributedpytorch_tpu.telemetry.registry import get_registry
+
+        counter = get_registry().counter("chaos_timeout_threads_leaked")
+        base = counter.value
+        release = threading.Event()
+        t = Timeout(0.05)
+        with pytest.raises(PolicyTimeoutError) as ei:
+            t.call(release.wait)
+        assert t.leaked_threads == 1
+        assert "1 leaked" in str(ei.value)
+        assert counter.value == base + 1
+
+        release.set()  # the wedged dependency recovers
+        deadline = time.monotonic() + 5.0
+        while t.reap() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert t.leaked_threads == 0
+        # recovery is not a second leak
+        assert counter.value == base + 1
+
+    def test_fast_call_leaks_nothing(self):
+        from distributedpytorch_tpu.chaos.policies import Timeout
+
+        t = Timeout(1.0)
+        assert t.call(lambda: 42) == 42
+        assert t.leaked_threads == 0
