@@ -73,6 +73,13 @@ _parser.add_argument(
          "warm/cold latency and the cache counters in a `sessions` "
          "record block")
 _parser.add_argument(
+    "--fleet", type=int, default=None, metavar="N",
+    help="with --serve: put N in-process replica services behind the "
+         "serve/fleet consistent-hash router (attach mode) and bench "
+         "the ROUTED click loop — aggregate clicks/sec plus the "
+         "proxy-vs-direct p50 overhead in a `fleet` record block "
+         "(null on every off-fleet record)")
+_parser.add_argument(
     "--check-regression", action="store_true",
     help="after the record prints, compare it against the NEWEST "
          "same-config committed BENCH_*.json and exit non-zero on a "
@@ -468,6 +475,14 @@ def _events_enabled(record: dict) -> bool:
     return ev.get("path") is not None
 
 
+def _fleet_replicas(record: dict):
+    """The record's fleet.replicas, normalized: records predating the
+    fleet front (and direct serve/train records, whose ``fleet`` block
+    is null) read as None — off-fleet, the default."""
+    fleet = record.get("fleet") or {}
+    return fleet.get("replicas")
+
+
 def check_regression(record: dict, history: list | None = None,
                      threshold: float = REGRESSION_THRESHOLD
                      ) -> tuple[bool, str]:
@@ -527,6 +542,14 @@ def check_regression(record: dict, history: list | None = None,
              # different regimes.  Null block == off (the default), so
              # pre-recorder committed history still compares.
              and _events_enabled(r) == _events_enabled(record)
+             # ...and the fleet SHAPE: a routed N-replica record and a
+             # direct single-service one measure different paths (the
+             # proxy hop is real work), and fleet sizes are their own
+             # families.  Only the replica count joins the key — the
+             # block's measured values (rps, overhead) are the NUMBER,
+             # not the config.  Null == off-fleet (the default), so
+             # pre-fleet committed history still compares.
+             and _fleet_replicas(r) == _fleet_replicas(record)
              and not r.get("replayed_from_session_capture")]
     if not prior:
         return True, (f"no prior {record.get('metric')} record on "
@@ -831,6 +854,9 @@ def serve_bench():
     # plan block: a TRAIN-side concept (serve replicates the predictor),
     # null on serve records — key always present (schema stability)
     record["plan"] = None
+    # fleet block: this burst hits ONE service directly (no router hop)
+    # — null off-fleet, key always present (see serve_fleet_bench)
+    record["fleet"] = None
     # events block (telemetry/events.py): flight-recorder tallies for
     # the measured window — keys ALWAYS present, all null when the
     # recorder is off (the bench default).  --check-regression keys its
@@ -990,6 +1016,9 @@ def serve_sessions_bench():
     record["precision"] = precision_block(precision_policy(DTYPE))
     # plan block: train-side concept, null on serve records; key present
     record["plan"] = None
+    # fleet block: direct in-process clicks, no router hop — null
+    # off-fleet, key always present (see serve_fleet_bench)
+    record["fleet"] = None
     # events block: flight-recorder tallies, all null when the recorder
     # is off (see serve_bench); keys always present
     record["events"] = events_block()
@@ -1020,6 +1049,227 @@ def serve_sessions_bench():
     return record
 
 
+def serve_fleet_bench():
+    """The click loop ROUTED: N replica services behind the fleet front.
+
+    The same interactive load as ``--sessions`` (SESSIONS_N sessions,
+    1 cold + N warm clicks each) — but through serve/fleet's
+    consistent-hash router over ``--fleet N`` in-process replicas, each
+    a real :class:`InferenceService` behind its own HTTP server (attach
+    mode: the router's own path, none of local mode's process
+    supervision noise in the number).  All replicas share one compiled
+    predictor — the bench isolates the ROUTING tax, not N compiles.
+
+    Two measurements ride in the ``fleet`` block: aggregate routed
+    clicks/sec (the headline), and the proxy-vs-direct warm-click p50 —
+    the same session's warm clicks alternately through the front and
+    straight at the replica that owns it, so both paths hit the same
+    session cache and the difference IS the hop (the <=5% routing-
+    overhead acceptance reads off ``proxy_overhead_pct``)."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    from distributedpytorch_tpu.models import build_model
+    from distributedpytorch_tpu.parallel import create_train_state
+    from distributedpytorch_tpu.predict import Predictor
+    from distributedpytorch_tpu.serve import FleetFront, InferenceService
+    from distributedpytorch_tpu.serve.__main__ import (
+        _HealthCache,
+        make_handler,
+    )
+    from distributedpytorch_tpu.serve.client import ServeClient
+
+    n_replicas = max(1, int(_CLI_ARGS.fleet))
+    model = build_model("danet", nclass=1, backbone=BACKBONE,
+                        output_stride=8, dtype=DTYPE,
+                        guidance_inject="head")
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(1e-3), (1, SIZE, SIZE, 4))
+    predictor = Predictor(model, state.params, state.batch_stats,
+                          resolution=(SIZE, SIZE), relax=50)
+    predictor, qpolicy = _serve_env_extras(predictor)
+    r = np.random.RandomState(0)
+    image = r.randint(0, 256, (SIZE, SIZE, 3)).astype(np.uint8)
+    quarter, mid = SIZE // 4, SIZE // 2
+    base_pts = np.array([[quarter, mid], [SIZE - quarter, mid],
+                         [mid, quarter], [mid, SIZE - quarter]],
+                        np.float64)
+
+    services = [InferenceService(predictor, max_batch=SERVE_MAX_BATCH,
+                                 queue_depth=4 * SESSIONS_N,
+                                 max_wait_s=0.002,
+                                 aot_cache=BENCH_AOT_CACHE)
+                for _ in range(n_replicas)]
+    acct = get_accountant()
+    acct.reset()
+    with acct.account("compile"):
+        # one compile, N registrations: replica 0's warmup compiles the
+        # ladder, the rest hit the in-process jit cache
+        for svc in services:
+            svc.warmup()
+    httpds, urls = [], []
+    lock = threading.Lock()
+    errors: list[Exception] = []
+    served = [0]
+    latencies_ms: list[float] = []
+
+    def session_loop(client: ServeClient, k: int) -> None:
+        sid = f"bench-fleet-{k}"
+        try:
+            for c in range(1 + SESSION_WARM_CLICKS):
+                t0 = time.perf_counter()
+                client.predict(image, base_pts + (k % 8) + (c % 3),
+                               session_id=sid)
+                ms = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    served[0] += 1
+                    latencies_ms.append(ms)
+        except Exception as e:  # noqa: BLE001 — recorded, reported
+            with lock:
+                errors.append(e)
+
+    front = None
+    try:
+        for svc in services:
+            svc.start()
+            httpd = ThreadingHTTPServer(
+                ("127.0.0.1", 0), make_handler(svc, _HealthCache()))
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            httpds.append(httpd)
+            urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+        front = FleetFront(attach=urls, poll_interval_s=0.2)
+        front.start()
+        fleet_url = front.serve_http("127.0.0.1", 0)
+        assert front.wait_live(n_replicas, timeout_s=60.0), \
+            "fleet never saw its attached replicas healthy"
+        # routed burst — the headline number
+        clients = [ServeClient(fleet_url, timeout_s=600.0)
+                   for _ in range(SESSIONS_N)]
+        threads = [threading.Thread(target=session_loop,
+                                    args=(clients[k], k))
+                   for k in range(SESSIONS_N)]
+        t0 = time.perf_counter()
+        with acct.account("step"):
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        dt = time.perf_counter() - t0
+        # overhead probe: one session's warm clicks, alternating routed
+        # vs direct-at-its-owner — same replica, same session cache,
+        # the p50 difference is the hop
+        probe = ServeClient(fleet_url, timeout_s=600.0)
+        probe.predict(image, base_pts, session_id="fleet-probe")
+        owner_rid = probe.last_fleet["replica"]
+        owner_url = front.registry.url(owner_rid)
+        direct = ServeClient(owner_url, timeout_s=600.0)
+        routed_ms, direct_ms = [], []
+        for i in range(40):
+            # paired design: same session, same replica, same points
+            # within a pair, order alternating — the per-click model
+            # variance (~±1ms) cancels in the pairwise delta, which a
+            # difference of independent p50s would inherit whole
+            pair = ((probe, routed_ms), (direct, direct_ms))
+            for client, sink in (pair if i % 2 == 0 else pair[::-1]):
+                t0 = time.perf_counter()
+                client.predict(image, base_pts + (i % 3),
+                               session_id="fleet-probe")
+                sink.append((time.perf_counter() - t0) * 1e3)
+        loads = front.registry.live_loads()
+        p99s = [s["p99_ms"] for s in loads.values()
+                if s.get("p99_ms") is not None]
+        front_health = front.health()
+    finally:
+        if front is not None:
+            front.stop()
+        for httpd in httpds:
+            httpd.shutdown()
+            httpd.server_close()
+        for svc in services:
+            svc.stop()
+    goodput_rep = acct.report()
+
+    def p50(xs):
+        return float(np.percentile(xs, 50)) if xs else None
+
+    proxy_p50 = p50(routed_ms)
+    direct_p50 = p50(direct_ms)
+    # hop cost = median of PAIRED deltas (matched clicks), not the
+    # difference of two independent p50s: the per-click model variance
+    # is several times the hop itself and cancels only pairwise
+    hop_ms = p50([r - d for r, d in zip(routed_ms, direct_ms)])
+    clicks = served[0]
+    record = {
+        "metric": (f"danet_{BACKBONE}_{SIZE}px_fleet{n_replicas}"
+                   f"_s{SESSIONS_N}x{SESSION_WARM_CLICKS}_click_loop"),
+        "value": round(clicks / dt, 3),
+        "unit": "clicks/sec",
+        "vs_baseline": 1.0,     # no published fleet baseline
+        "platform": jax.devices()[0].platform,
+        "sessions_n": SESSIONS_N,
+        "warm_clicks_per_session": SESSION_WARM_CLICKS,
+        "errors": len(errors),
+        "p50_ms": p50(latencies_ms),
+        "p99_ms": (float(np.percentile(latencies_ms, 99))
+                   if latencies_ms else None),
+        # the fleet block — keys ALWAYS present on fleet records, the
+        # whole block null on every off-fleet record.
+        # --check-regression keys its same-config filter on
+        # fleet.replicas only (the sizes are separate families; the
+        # measured values are the number, not the config).
+        "fleet": {
+            "replicas": n_replicas,
+            "mode": front_health["mode"],
+            "live": front_health["live"],
+            "aggregate_rps": round(clicks / dt, 3),
+            "proxy_p50_ms": (None if proxy_p50 is None
+                             else round(proxy_p50, 3)),
+            "direct_p50_ms": (None if direct_p50 is None
+                              else round(direct_p50, 3)),
+            "proxy_overhead_pct": (
+                None if hop_ms is None or not direct_p50 else
+                round(hop_ms / direct_p50 * 100.0, 2)),
+            "p99_spread_ms": (round(max(p99s) - min(p99s), 3)
+                              if len(p99s) >= 2 else None),
+        },
+    }
+    record["goodput"] = round(goodput_rep["goodput"], 4)
+    record["goodput_breakdown"] = {
+        k: round(v, 3) for k, v in goodput_rep["buckets"].items() if v}
+    record["mfu"] = None
+    record["feed"] = None  # train-side concept, null on serve records
+    record["chaos"] = chaos_sites.active_scenario()
+    record["recovery"] = recovery_block()  # null block; key stability
+    record["flywheel"] = flywheel_block()  # key always present
+    record["elastic"] = elastic_block()  # train-side concept
+    record["precision"] = precision_block(precision_policy(DTYPE))
+    record["plan"] = None  # train-side concept, null on serve records
+    record["events"] = events_block()
+    # cold_start + quantization blocks — the serve-record pair (see
+    # serve_bench); replica 0's warmup is the boot that compiled
+    audit_kw, suffix = _stamp_serve_fast_path(record, services[0],
+                                              qpolicy)
+    feats = predictor.feature_struct(1)
+    record.update(ir_audit_fields(
+        predictor.decode_jitted,
+        (jax.ShapeDtypeStruct((SERVE_MAX_BATCH, *feats.shape[1:]),
+                              feats.dtype),
+         jax.ShapeDtypeStruct((SERVE_MAX_BATCH, SIZE, SIZE, 1),
+                              np.float32)),
+        f"bench_fleet_decode_{BACKBONE}_{SIZE}px_b{SERVE_MAX_BATCH}"
+        f"{suffix}", **audit_kw))
+    from distributedpytorch_tpu.utils.profiling import device_memory_stats
+
+    record["peak_bytes_in_use"] = \
+        device_memory_stats()["peak_bytes_in_use"]
+    if not ON_TPU:
+        record["note"] = ("CPU fallback (downsized config), not a TPU "
+                          "number")
+    print(json.dumps(record))
+    return record
+
+
 def main() -> None:
     # chaos: a DPTPU_CHAOS_PLAN env plan arms for the bench too, so the
     # record's `chaos` field names the scenario that conditioned the
@@ -1034,12 +1284,18 @@ def main() -> None:
         raise SystemExit(
             f"DPTPU_BENCH_QUANTIZE must be int8, got {BENCH_QUANTIZE!r}")
     if _CLI_ARGS.serve:
-        record = (serve_sessions_bench() if _CLI_ARGS.sessions
-                  else serve_bench())
+        if _CLI_ARGS.fleet is not None:
+            record = serve_fleet_bench()
+        elif _CLI_ARGS.sessions:
+            record = serve_sessions_bench()
+        else:
+            record = serve_bench()
         _maybe_check_regression(record)
         return
     if _CLI_ARGS.sessions:
         raise SystemExit("--sessions is a serve mode; pass --serve too")
+    if _CLI_ARGS.fleet is not None:
+        raise SystemExit("--fleet is a serve mode; pass --serve too")
     if FELL_BACK_TO_CPU and not ON_TPU and _is_default_config():
         replay = try_replay_tpu_capture()
         if replay is not None:
@@ -1262,6 +1518,9 @@ def main() -> None:
     # train records — keys always present (schema stability)
     record["cold_start"] = None
     record["quantization"] = None
+    # fleet block: a serve-side concept (the router hop); null on train
+    # records — key always present (schema stability)
+    record["fleet"] = None
     # events block (telemetry/events.py): flight-recorder tallies for
     # the measured loop — keys ALWAYS present, all null when the
     # recorder is off (the bench runs un-recorded by default).

@@ -379,6 +379,35 @@ SCENARIOS: dict[str, dict] = {
                        "canary_never_promoted",
                        "serves_old_generation_zero_errors"],
     },
+    # Replica death under interactive load: a 3-replica LOCAL fleet
+    # (serve/fleet.py spawns real dptpu-serve children) takes a warm
+    # click burst from sessions pinned — by the ring's process-
+    # independent blake2b hash — to every replica, while a sigkill
+    # fault at the serve/drain seam (armed via DPTPU_CHAOS_PLAN in
+    # exactly ONE replica's first boot) SIGKILLs that replica mid-
+    # burst.  What must hold: clients see ZERO untyped 5xx (the front's
+    # one-shot failover + the typed shed taxonomy absorb the death);
+    # the dead replica's sessions rehash and complete on their new
+    # replica (one counted re-encode, not an error); the supervisor
+    # respawns the slot and the ring CONVERGES back to full count (the
+    # respawn reuses its slot id, so those sessions come home); and the
+    # kill->rejoin span lands in chaos_recovery_seconds{scenario},
+    # measured from the fleet's own flight-recorder events.
+    "replica_kill_under_load": {
+        "name": "replica_kill_under_load",
+        "mode": "fleet",
+        "plan": {"seed": 0, "faults": [
+            # visit 4 of the victim's serve/drain (one visit per drained
+            # batch): past its 2 pinned cold clicks, inside the burst
+            {"site": "serve/drain", "kind": "sigkill", "at": [4]}]},
+        "params": {"replicas": 3, "sessions_per_replica": 2,
+                   "warm_clicks": 4, "size": 48, "max_batch": 4,
+                   "poll_interval_s": 0.25},
+        "invariants": ["zero_untyped_client_errors",
+                       "rehashed_sessions_reencode",
+                       "ring_converges_full_count",
+                       "recovery_recorded"],
+    },
 }
 
 
@@ -1353,6 +1382,209 @@ def _run_supervise(sc: dict, work_dir: str) -> dict:
     }}, "recovery_s": round(recovery_s, 3)}
 
 
+def _run_fleet(sc: dict, work_dir: str) -> dict:
+    """replica_kill_under_load: a real local fleet (serve/fleet.py) of
+    ``--fresh-init`` dptpu-serve children under a session click burst,
+    with the armed plan riding in ONE replica's env so that replica
+    SIGKILLs itself mid-burst.  The runner process stays clean — it
+    plays the operator: spawn, load, watch the failover/rehash/respawn
+    machinery do its job, and read the verdict off the client outcomes
+    and the fleet's flight-recorder events."""
+    import threading
+
+    import numpy as np
+
+    from ..backend_health import pin_cpu8_topology
+    from ..serve.client import ServeClient
+    from ..serve.fleet import FleetFront, LocalManager
+    from ..serve.router import HashRing
+    from ..serve.service import (
+        DeadlineExceededError,
+        QueueFullError,
+        ServiceUnhealthyError,
+    )
+    from ..telemetry import events as events_lib
+
+    params = dict(sc.get("params") or {})
+    size = int(params.get("size", 48))
+    n_replicas = int(params.get("replicas", 3))
+    per_replica = int(params.get("sessions_per_replica", 2))
+    warm_clicks = int(params.get("warm_clicks", 4))
+
+    # the fleet's events ARE the scenario's clock: replica_down ->
+    # replica_up spans (one process's ts_mono) measure recovery
+    log = events_lib.configure(work_dir)
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pinned = pin_cpu8_topology({})
+    pinned["PYTHONPATH"] = repo + os.pathsep + os.environ.get(
+        "PYTHONPATH", "")
+    plan_json = json.dumps(dict(sc.get("plan") or {}, name=sc["name"]))
+
+    def child_env(rid: str, restarts: int) -> dict:
+        extra = dict(pinned)
+        # the plan rides in ONE slot's FIRST boot only: r0 self-SIGKILLs
+        # on its scheduled serve/drain visit, its respawn (restarts > 0)
+        # and every other replica serve clean.  The empty value also
+        # masks any plan the operator exported to the runner's own env
+        # (maybe_arm_from_env treats "" as unset).
+        extra[sites.PLAN_ENV] = (plan_json if rid == "r0" and restarts == 0
+                                 else "")
+        return extra
+
+    template = [sys.executable, "-m", "distributedpytorch_tpu.serve",
+                "--fresh-init", str(size), "--warmup",
+                "--max-batch", str(int(params.get("max_batch", 4))),
+                "--max-wait-ms", "0",
+                "--queue-depth", str(int(params.get("queue_depth", 32)))]
+    manager = LocalManager(template,
+                           workdir=os.path.join(work_dir, "replicas"),
+                           max_restarts=3, child_env=child_env)
+    front = FleetFront(manager=manager, replicas=n_replicas,
+                       poll_interval_s=float(
+                           params.get("poll_interval_s", 0.25)),
+                       boot_timeout_s=600.0)
+
+    # Session ids chosen so EVERY replica owns sessions: the ring's
+    # blake2b hash is process-independent, so the owner of "s<i>" under
+    # slots r0..rN-1 is computable right here — the victim is guaranteed
+    # resident sessions to rehash, and the at=[4] visit schedule (2 cold
+    # clicks, then the burst) is deterministic rather than hash-lucky.
+    ring = HashRing([f"r{i}" for i in range(n_replicas)])
+    by_owner: dict[str, list[str]] = {f"r{i}": [] for i in range(n_replicas)}
+    i = 0
+    while any(len(v) < per_replica for v in by_owner.values()):
+        sid = f"s{i}"
+        i += 1
+        owner = ring.lookup(sid)
+        if len(by_owner[owner]) < per_replica:
+            by_owner[owner].append(sid)
+    sessions = [sid for sids in by_owner.values() for sid in sids]
+
+    rng = np.random.RandomState(0)
+    image = rng.randint(0, 256, (size, size, 3)).astype(np.uint8)
+    q, m = size // 4, size // 2
+    base_points = np.array([[q, m], [size - q, m], [m, q], [m, size - q]],
+                           np.float64)
+
+    outcomes = {"completed": 0, "typed_shed": 0, "untyped_error": 0}
+    served_by: dict[str, list] = {sid: [] for sid in sessions}
+    rerouted_from: list[str] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def click(client: ServeClient, sid: str, k: int) -> None:
+        try:
+            mask = client.predict(
+                image, np.clip(base_points + (k % 3), 0, size - 1),
+                session_id=sid)
+            finite = bool(np.isfinite(mask).all())
+            with lock:
+                outcomes["completed" if finite else "untyped_error"] += 1
+                served_by[sid].append(client.last_fleet["replica"])
+                if client.last_fleet["rerouted"]:
+                    rerouted_from.append(client.last_fleet["rerouted"])
+        except (QueueFullError, DeadlineExceededError,
+                ServiceUnhealthyError):
+            # the WHOLE typed taxonomy (SessionLaneFull and
+            # ReplicaDraining subclass these) — sheds, not failures
+            with lock:
+                outcomes["typed_shed"] += 1
+        except Exception as e:  # noqa: BLE001 — that's the point
+            with lock:
+                outcomes["untyped_error"] += 1
+                errors.append(f"{sid}: {type(e).__name__}: {e}")
+
+    submitted = 0
+    try:
+        front.start()
+        url = front.serve_http("127.0.0.1", 0)
+        assert front.wait_live(n_replicas, timeout_s=600.0), \
+            f"fleet never reached {n_replicas} live replicas"
+        # one client PER session: last_fleet is per-client state, and
+        # the per-session replica trail is the rehash evidence
+        clients = {sid: ServeClient(url, timeout_s=300.0, shed_retries=3,
+                                    retry_seed=7)
+                   for sid in sessions}
+        # phase 1 — establish every session, serially: one cold click
+        # each, so the pre-kill owner map is unambiguous (and the
+        # victim's serve/drain visit count advances predictably)
+        for sid in sessions:
+            click(clients[sid], sid, 0)
+            submitted += 1
+        owners_pre = {sid: (served_by[sid][0] if served_by[sid] else None)
+                      for sid in sessions}
+        # phase 2 — the warm burst, all sessions concurrent; the
+        # victim's visit schedule fires mid-burst and SIGKILLs it
+        def run_session(sid: str) -> None:
+            for k in range(1, warm_clicks + 1):
+                click(clients[sid], sid, k)
+
+        threads = [threading.Thread(target=run_session, args=(sid,),
+                                    name=f"click-{sid}")
+                   for sid in sessions]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        submitted += warm_clicks * len(sessions)
+        # phase 3 — convergence: the supervisor respawns the dead slot
+        # (same id -> same ring ranges) and the ring returns to full
+        # count; then every session clicks once more — moved sessions
+        # complete via one re-encode, homed-again sessions likewise
+        deadline = time.monotonic() + 600.0
+        while (time.monotonic() < deadline
+               and front.registry.n_live() < n_replicas):
+            time.sleep(0.1)
+        health_final = front.health()
+        for sid in sessions:
+            click(clients[sid], sid, 99)
+            submitted += 1
+    finally:
+        front.stop()
+        events_lib.release(log)
+
+    evs = [e for e in events_lib.read_events_file(log.path or "")
+           if e["source"] == "fleet"]
+    downs = [e for e in evs if e["kind"] == "replica_down"]
+    killed = downs[0]["payload"]["replica"] if downs else None
+    recovery_s = None
+    if killed is not None:
+        t_down = downs[0]["ts_mono"]
+        ups = [e for e in evs if e["kind"] == "replica_up"
+               and e["payload"].get("replica") == killed
+               and e["ts_mono"] > t_down]
+        if ups:
+            recovery_s = ups[0]["ts_mono"] - t_down
+    if recovery_s is not None:
+        _observe_recovery(sc["name"], recovery_s)
+    # the rehash evidence: sessions the dead replica owned that later
+    # completed a click on a DIFFERENT replica (the re-encode path)
+    moved = sorted(sid for sid, owner in owners_pre.items()
+                   if owner == killed
+                   and any(rep not in (None, killed)
+                           for rep in served_by[sid][1:]))
+    return {"phases": {"fleet": {
+        "outcomes": outcomes,
+        "submitted": submitted,
+        "errors": errors[:8],
+        "owners_pre": owners_pre,
+        "served_by": served_by,
+        "killed": killed,
+        "moved_sessions": moved,
+        "rerouted_from": sorted(set(rerouted_from)),
+        "failovers": sum(1 for e in evs if e["kind"] == "failover"),
+        "event_kinds": sorted({e["kind"] for e in evs}),
+        "health_final": {
+            "live": health_final["live"],
+            "ring": health_final["ring"],
+            "states": {rid: r["state"] for rid, r in
+                       health_final["replicas"].items()},
+        },
+    }}, "recovery_s": (round(recovery_s, 3)
+                       if recovery_s is not None else None)}
+
+
 # -------------------------------------------------------------- invariants
 
 def _check(sc: dict, result: dict) -> dict:
@@ -1829,6 +2061,50 @@ def _check_one(name, sc, result, phases, verdict):
                     f"outcomes={o} submitted={f['submitted']} — every "
                     "click before, during, and after the poisoned cycle "
                     "must complete finite on generation 0")
+        elif name == "zero_untyped_client_errors":
+            f = phases["fleet"]
+            o = f["outcomes"]
+            accounted = o["completed"] + o["typed_shed"]
+            verdict(name,
+                    o["untyped_error"] == 0 and accounted == f["submitted"]
+                    and o["completed"] > 0,
+                    f"outcomes={o} submitted={f['submitted']} "
+                    f"errors={f['errors']} — every click through the "
+                    "replica death must complete or shed TYPED "
+                    "(429/504/503), never surface an untyped 5xx")
+        elif name == "rehashed_sessions_reencode":
+            f = phases["fleet"]
+            owned = sorted(sid for sid, o in f["owners_pre"].items()
+                           if o == f["killed"])
+            verdict(name,
+                    f["killed"] is not None and len(owned) > 0
+                    and f["moved_sessions"] == owned,
+                    f"killed={f['killed']} owned sessions {owned}, "
+                    f"moved {f['moved_sessions']} — every session the "
+                    "dead replica owned must complete clicks on its "
+                    "rehashed replica (one re-encode, not an error)")
+        elif name == "ring_converges_full_count":
+            f = phases["fleet"]
+            h = f["health_final"]
+            n = int((sc.get("params") or {}).get("replicas", 3))
+            want_ring = sorted(f"r{i}" for i in range(n))
+            verdict(name,
+                    h["live"] == n and sorted(h["ring"]) == want_ring,
+                    f"final live={h['live']} ring={sorted(h['ring'])} "
+                    f"states={h['states']} (want {n} live, ring "
+                    f"{want_ring}: the respawned slot must REJOIN under "
+                    "its old id so its sessions come home)")
+        elif name == "recovery_recorded":
+            f = phases["fleet"]
+            r = result.get("recovery_s")
+            verdict(name,
+                    r is not None and r > 0
+                    and "replica_down" in f["event_kinds"]
+                    and f["failovers"] >= 0,
+                    f"recovery_s={r} event_kinds={f['event_kinds']} — "
+                    "the kill->rejoin span must be measured off the "
+                    "fleet's replica_down/replica_up events and "
+                    "observed into chaos_recovery_seconds{scenario}")
         elif name == "final_metrics_finite":
             import math
 
@@ -1877,11 +2153,13 @@ def run_scenario(scenario: str | dict, work_dir: str | None = None,
             result = _run_packed_fit(sc, work_dir)
         elif mode == "flywheel":
             result = _run_flywheel(sc, work_dir)
+        elif mode == "fleet":
+            result = _run_fleet(sc, work_dir)
         else:
             raise ValueError(
                 f"unknown scenario mode {mode!r} "
                 "(fit | fit_resume | serve | serve_swap | serve_aot | "
-                "supervise | packed_fit | flywheel)")
+                "supervise | packed_fit | flywheel | fleet)")
     finally:
         if cleanup:
             import shutil

@@ -32,7 +32,20 @@ The seams are woven into the REAL code paths (not shadow copies):
   a ``nan`` fault here poisons the logged example exactly as a corrupt
   client/annotation pipeline would — the float crop NaN-fills, the
   crc then seals the poison in as VALID bytes — feeding the
-  ``poisoned_flywheel`` scenario's sentinel/canary containment chain.
+  ``poisoned_flywheel`` scenario's sentinel/canary containment chain;
+* ``serve/route``            — the fleet front's proxy path
+  (serve/fleet.py), after the body's routing fields are read and
+  before a replica is chosen: an ``error`` fault here is a routing
+  failure the front must turn into a typed 503 shed, never an
+  untyped 500 (note the ``sigkill`` fault kind kills the process that
+  fires the site — armed in a REPLICA via ``DPTPU_CHAOS_PLAN`` on
+  ``serve/drain``, that's the ``replica_kill_under_load`` scenario's
+  mid-burst replica death);
+* ``serve/health_poll``      — the fleet's health loop, before each
+  replica's /healthz GET: latency faults model a slow replica, error
+  faults a poll that never lands — both must flow through the
+  per-replica Retry/CircuitBreaker membership machinery, never crash
+  the poll thread.
 
 Disabled is the default and it is ~free: ``fire`` loads one module
 attribute, sees ``None`` and returns — no registry, no telemetry, no
@@ -70,6 +83,8 @@ SITES = (
     "device/put",
     "data/packed_read",
     "serve/session_append",
+    "serve/route",
+    "serve/health_poll",
 )
 
 

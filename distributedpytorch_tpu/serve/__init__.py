@@ -24,6 +24,13 @@ docs/DESIGN.md "Serving"):
   with loud fresh-compile fallback
 * :mod:`metrics` — counters + p50/p99 request latency (ops surface)
 * :mod:`client` — :class:`ServeClient` over in-process or HTTP targets
+* :mod:`router` — :class:`HashRing` + :func:`least_loaded`: the fleet's
+  pure routing math (consistent hash with virtual nodes for session
+  affinity; queue/p99 ordering for stateless requests)
+* :mod:`fleet` — :class:`FleetFront` (``dptpu-fleet``): the
+  multi-replica front — replica registry/state machine, health-driven
+  ring membership, one-shot failover, process supervision in ``local``
+  mode, and the ``/fleet/plan`` autoscale surface
 * :mod:`__main__` — ``python -m distributedpytorch_tpu.serve`` HTTP shell
 
 >>> from distributedpytorch_tpu.serve import InferenceService
@@ -33,7 +40,14 @@ docs/DESIGN.md "Serving"):
 
 from .aot import AotCache, AotCacheError, AotCacheMiss
 from .batching import bucket_for, bucket_sizes, pad_to_bucket, unpad
-from .client import HealthCache, ServeClient, decode_array, encode_array
+from .client import (
+    HealthCache,
+    ReplicaDrainingError,
+    ServeClient,
+    decode_array,
+    encode_array,
+)
+from .fleet import AutoscaleGovernor, FleetFront, FleetRegistry, scale_plan
 from .metrics import ServeMetrics
 from .quantize import (
     QTensor,
@@ -51,6 +65,7 @@ from .service import (
     SessionLaneFullError,
     warmup_buckets,
 )
+from .router import HashRing, least_loaded
 from .sessions import Session, SessionStore
 from .swap import PredictorPool, SwapInProgressError
 
@@ -58,7 +73,11 @@ __all__ = [
     "AotCache",
     "AotCacheError",
     "AotCacheMiss",
+    "AutoscaleGovernor",
     "DeadlineExceededError",
+    "FleetFront",
+    "FleetRegistry",
+    "HashRing",
     "HealthCache",
     "InferenceService",
     "PredictorPool",
@@ -66,6 +85,7 @@ __all__ = [
     "QuantPolicy",
     "QuantizedPredictor",
     "QueueFullError",
+    "ReplicaDrainingError",
     "ServeClient",
     "ServeMetrics",
     "ServiceUnhealthyError",
@@ -77,8 +97,10 @@ __all__ = [
     "bucket_sizes",
     "decode_array",
     "encode_array",
+    "least_loaded",
     "pad_to_bucket",
     "quant_policy",
+    "scale_plan",
     "quantization_block",
     "quantize_predictor",
     "unpad",

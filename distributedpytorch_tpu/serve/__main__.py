@@ -82,6 +82,15 @@ def make_handler(service: InferenceService, health_cache: _HealthCache,
     class Handler(BaseHTTPRequestHandler):
         # per-request threads come from ThreadingHTTPServer
         protocol_version = "HTTP/1.1"
+        # headers and body flush as two unbuffered writes; on a
+        # keep-alive connection (the fleet proxy pools these) Nagle
+        # holds the body segment behind the peer's delayed ACK —
+        # a flat ~40ms tax on every proxied reply
+        disable_nagle_algorithm = True
+        # buffer the reply so headers + body leave as ONE segment —
+        # handle_one_request() flushes after every request, so this
+        # only coalesces writes, it never delays them
+        wbufsize = 64 * 1024
         # idle keep-alive bound: handler threads are NON-daemon (_Server),
         # so a connection-reusing client parked between requests would
         # otherwise block server_close()'s join forever at shutdown —
@@ -226,6 +235,8 @@ def build_predictor(args):
         if quantize is None:
             quantize = getattr(cfg.model, "quantization", "") or None
         predictor = Predictor.from_run(args.run_dir, cfg=cfg)
+    elif getattr(args, "fresh_init", None):
+        predictor = build_fresh_predictor(args.fresh_init)
     else:
         predictor = Predictor.from_torch(args.torch)
     from .quantize import quant_policy, quantize_predictor
@@ -234,6 +245,36 @@ def build_predictor(args):
     if policy is not None:
         predictor = quantize_predictor(predictor, policy)
     return predictor
+
+
+def build_fresh_predictor(spec: str):
+    """Fresh-init predictor from a ``SIZE[:BACKBONE[:INJECT]]`` spec
+    (default ``64:resnet18:head``) — a replica with no checkpoint at
+    all, for the fleet's chaos scenarios and dev loops where the test
+    is the SERVING MACHINERY (routing, membership, failover), not the
+    weights.  Rides the persistent compile cache so a scenario spawning
+    the same fresh replicas run after run pays the compile ladder
+    once."""
+    from ..backend_health import enable_compile_cache
+
+    enable_compile_cache()
+    import jax
+    import optax
+
+    from ..models import build_model
+    from ..parallel import create_train_state
+    from ..predict import Predictor
+
+    parts = (spec or "64").split(":")
+    size = int(parts[0] or 64)
+    backbone = parts[1] if len(parts) > 1 and parts[1] else "resnet18"
+    inject = parts[2] if len(parts) > 2 and parts[2] else "head"
+    model = build_model("danet", nclass=1, backbone=backbone,
+                        output_stride=8, guidance_inject=inject)
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(1e-3), (1, size, size, 4))
+    return Predictor(model, state.params, state.batch_stats,
+                     resolution=(size, size), relax=10)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -250,6 +291,14 @@ def main(argv: list[str] | None = None) -> int:
     src.add_argument("--torch", metavar="PTH",
                      help="torch state_dict checkpoint (reference "
                           "architecture) instead of a run dir")
+    src.add_argument("--fresh-init", metavar="SPEC", nargs="?",
+                     const="64",
+                     help="serve FRESH-INIT weights (no checkpoint): "
+                          "SIZE[:BACKBONE[:INJECT]], default "
+                          "64:resnet18:head — dev/chaos only (the "
+                          "fleet's replica_kill_under_load scenario "
+                          "boots its replicas this way; the masks are "
+                          "noise, the serving machinery is real)")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8801)
     parser.add_argument("--max-batch", type=int, default=8,

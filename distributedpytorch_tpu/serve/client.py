@@ -96,6 +96,20 @@ def _policies():
     return policies
 
 
+class ReplicaDrainingError(ServiceUnhealthyError):
+    """A 503 that NAMED its retry horizon (``Retry-After``): a draining
+    or booting replica behind the fleet front, or the front itself with
+    no live replicas yet.  Subclasses :class:`ServiceUnhealthyError` so
+    existing 503 handlers keep matching; the refinement is that this
+    refusal is advertised-transient — ``retry_after_s`` says when to
+    come back, and :meth:`ServeClient.predict`'s shed-retry policy
+    honors it."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 #: HTTP status -> the in-process exception it round-trips to
 _STATUS_ERRORS = {
     429: QueueFullError,
@@ -107,8 +121,11 @@ _STATUS_ERRORS = {
 #: error-body ``code`` -> exception, refining the status mapping: both
 #: shed flavors are 429 (same retry advice), but a session-lane shed
 #: means only THIS session should back off — the type must round-trip
+#: (through the fleet front too: the proxy passes replica error bodies
+#: through byte-for-byte, so this mapping never sees a difference)
 _CODE_ERRORS = {
     "session_lane": SessionLaneFullError,
+    "fleet_unavailable": ReplicaDrainingError,
 }
 
 
@@ -131,6 +148,12 @@ class ServeClient:
             self._service = target
         self.timeout_s = timeout_s
         self._health_cache = HealthCache()
+        #: fleet routing facts from the LAST HTTP reply: which replica
+        #: answered (``X-Fleet-Replica``) and, when the request survived
+        #: a mid-flight replica death, which dead replica it was rerouted
+        #: away from (``X-Fleet-Rerouted``) — always-present keys, None
+        #: off-fleet (direct single-replica serving sets no headers)
+        self.last_fleet: dict = {"replica": None, "rerouted": None}
         #: ``shed_retries > 0``: QueueFullError (HTTP 429) is retried
         #: that many extra times with jittered backoff — the
         #: "retry with backoff" the shed message advises, implemented
@@ -153,11 +176,21 @@ class ServeClient:
         — the default, and the whole wire story for existing callers —
         the request is stateless."""
         if self._retry is not None:
+            def honor_retry_after(attempt, outcome, remaining_s):
+                # a draining replica's 503 names its horizon: nap the
+                # advised seconds (capped — advice, not a contract) on
+                # top of the jittered backoff, through the policy's
+                # injectable sleep so tests patching time.sleep see it
+                after = getattr(outcome, "retry_after_s", None)
+                if after:
+                    self._retry.sleep(min(float(after), 5.0))
+
             try:
                 return self._retry.call(
                     lambda: self._predict_once(image, points, deadline_s,
                                                session_id),
-                    retry_on=(QueueFullError,))
+                    retry_on=(QueueFullError, ReplicaDrainingError),
+                    on_attempt=honor_retry_after)
             except _policies().RetryBudgetExceededError as e:
                 # budget spent: surface the ORIGINAL taxonomy (the last
                 # QueueFullError), not the policy wrapper — callers match
@@ -211,21 +244,54 @@ class ServeClient:
 
     # ------------------------------------------------------------ transport
 
+    def _note_fleet(self, headers) -> None:
+        self.last_fleet = {"replica": headers.get("X-Fleet-Replica"),
+                           "rerouted": headers.get("X-Fleet-Rerouted")}
+
     def _request(self, req: urllib.request.Request) -> dict:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                self._note_fleet(r.headers)
                 return json.loads(r.read().decode("utf-8"))
         except urllib.error.HTTPError as e:
-            detail, code = "", None
+            self._note_fleet(e.headers)
+            retry_after = None
+            try:
+                retry_after = float(e.headers.get("Retry-After"))
+            except (TypeError, ValueError):
+                pass
+            detail, code, parsed = "", None, False
             try:
                 payload = json.loads(e.read().decode("utf-8"))
                 detail = payload.get("error", "")
                 code = payload.get("code")
+                parsed = True
             except Exception:
                 pass
+            if not parsed and e.code >= 500:
+                # a 5xx whose body is NOT our taxonomy is an unknown
+                # failure from an unknown layer (a proxy's bare error
+                # page, a half-written reply): the request may or may
+                # not have executed, so it must surface as untyped —
+                # never as a shed the retry policy would happily replay
+                raise RuntimeError(
+                    f"serve endpoint returned HTTP {e.code} with an "
+                    f"unparseable body — not retrying a request whose "
+                    f"server-side fate is unknown") from e
             exc = _CODE_ERRORS.get(code) or _STATUS_ERRORS.get(e.code)
+            if exc is ServiceUnhealthyError and retry_after is not None:
+                # a 503 naming its horizon is a draining/booting replica
+                # (or the fleet front between replicas) — the typed,
+                # advertised-transient refinement
+                exc = ReplicaDrainingError
+            if exc is ReplicaDrainingError:
+                raise exc(detail or f"HTTP {e.code}",
+                          retry_after_s=retry_after) from None
             if exc is not None:
-                raise exc(detail or f"HTTP {e.code}") from None
+                err = exc(detail or f"HTTP {e.code}")
+                if retry_after is not None:
+                    err.retry_after_s = retry_after
+                raise err from None
             raise RuntimeError(
                 f"serve endpoint returned HTTP {e.code}: {detail}") from e
 

@@ -1,0 +1,1296 @@
+"""Fleet front: multi-replica serving behind one consistent-hash router.
+
+One ``dptpu-serve`` process is one failure domain: a wedged backend, a
+hot-swap gone bad, or a SIGKILL is a full outage.  This module is the
+ROADMAP's third serving leg — the multi-replica front the int8 path and
+the AOT cache (near-instant replica boots) made worth building.  It is
+deliberately composed from proven parts rather than new mechanism:
+
+* **Routing** (serve/router.py): session-carrying requests route by
+  consistent hash of ``session_id`` over the ring of live replicas —
+  sessions are generation- and cache-affine (serve/sessions.py), so
+  affinity is the router's job, exactly as the ROADMAP states.  A
+  membership change moves only ~K/N sessions, and a moved session is
+  not an error: its next click misses ``covers()`` on the new replica
+  and degrades to ONE counted re-encode.  Stateless requests route
+  least-loaded on the queue-depth/p99 signals every replica already
+  exposes on ``/healthz``.
+* **Membership**: a replica registry with a per-replica state machine
+  ``starting -> healthy -> degraded -> draining -> dead``, driven by a
+  background health loop polling ``/healthz`` under the chaos
+  :class:`~..chaos.policies.Retry` / :class:`~..chaos.policies
+  .CircuitBreaker` policies per replica.  Ring membership is
+  health-driven: healthy+degraded replicas take traffic, draining and
+  dead ones leave the ring (their key ranges rehash minimally).
+* **Failover**: a request whose replica dies mid-flight (connection
+  error before any HTTP reply) is retried ONCE on the next ring
+  candidate and the reply carries ``X-Fleet-Rerouted: <dead-replica>``.
+  A replica that answered — even with an error — is never retried: the
+  429/504/503 shed taxonomy passes through byte-for-byte, and a reply
+  already received may have had effects (session created, example
+  logged) the front must not duplicate.
+* **Supervision** (``local`` mode): the front spawns N ``dptpu-serve``
+  children (ride ``--warmup --aot-cache`` for boots in seconds, not
+  minutes), respawns dead ones under a restart budget, and — with
+  ``--autoscale`` — actuates the scale plan with the governor's
+  escalate/disarm hysteresis (data/governor.py's idiom).  ``attach``
+  mode is the same front as a pure router over replicas given by URL.
+* **Autoscale surface**: ``GET /fleet/plan`` returns the scale
+  recommendation derived from aggregate queue depth and p99 vs target.
+  Recommendation is deliberately separate from actuation: the plan is
+  pure arithmetic any orchestrator (or a human) can read and apply,
+  while actuation needs process ownership, hysteresis, and a restart
+  budget — ``local --autoscale`` is one actuator, not the only one.
+
+Observability: fleet gauges/counters (``fleet_replicas_live``,
+``fleet_route_total{reason}``, ``fleet_failover_total``, per-replica
+p99 gauges) in the process registry behind ``GET /metrics``, and fleet
+events (``replica_up/down/drain``, ``failover``, ``scale_decision``)
+into the flight recorder (telemetry/events.py) so ``dptpu-doctor`` can
+stitch a replica-kill episode from the same timeline as everything
+else.  Chaos seams: ``serve/route`` on the proxy path and
+``serve/health_poll`` in the poll loop (the ``replica_kill_under_load``
+scenario's wiring).
+
+Stdlib-only (urllib + http.server + subprocess), importable pre-jax:
+the front is a host process that must boot instantly and never touch a
+device — all device work lives in the replicas.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import re
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..chaos import policies
+from ..chaos import sites as chaos_sites
+from ..telemetry import events as events_lib
+from ..telemetry.registry import get_registry
+from .router import HashRing, least_loaded
+
+#: the replica state machine, in lifecycle order
+REPLICA_STATES = ("starting", "healthy", "degraded", "draining", "dead")
+
+#: states whose replicas take traffic (ring + least-loaded membership):
+#: degraded stays IN — its signals are bad but it answered, and evicting
+#: it would rehash its sessions (a re-encode each) on every blip; only
+#: confirmed-dead and deliberately-draining replicas leave the ring
+LIVE_STATES = frozenset(("healthy", "degraded"))
+
+#: consecutive failed polls (or mid-flight proxy failures) before a
+#: replica is declared dead — also each replica's breaker threshold
+DEAD_AFTER = 3
+
+
+# --------------------------------------------------------------- autoscale
+
+def scale_plan(loads: dict, n_live: int, *, target_p99_ms: float = 250.0,
+               queue_high: float = 0.5, min_replicas: int = 1,
+               max_replicas: int = 8) -> dict:
+    """The scale recommendation — pure arithmetic over the last health
+    polls, no actuation (``GET /fleet/plan``'s whole body).
+
+    Pressure is the worse of two normalized signals: aggregate queue
+    fraction vs ``queue_high`` (sustained above it, the bounded queues
+    are absorbing a backlog the fleet can't drain) and mean p99 vs
+    ``target_p99_ms``.  ``>= 1.0`` recommends scaling up proportionally
+    (capped at doubling per decision — a thundering recommendation is
+    how oscillation starts); ``<= 0.35`` with headroom recommends ONE
+    replica down (scale-down is always stepwise: each removal rehashes
+    sessions, so shed capacity slowly).  Between the two thresholds the
+    recommendation is "hold" — the same dead band the governor's
+    escalate/disarm hysteresis then widens in time."""
+    depth = cap = 0
+    p99s = []
+    for sig in loads.values():
+        if sig.get("queue_depth") is not None and sig.get("queue_capacity"):
+            depth += int(sig["queue_depth"])
+            cap += int(sig["queue_capacity"])
+        if sig.get("p99_ms") is not None:
+            p99s.append(float(sig["p99_ms"]))
+    qfrac = (depth / cap) if cap else None
+    p99 = (sum(p99s) / len(p99s)) if p99s else None
+    pressures = {}
+    if qfrac is not None:
+        pressures["queue"] = qfrac / queue_high
+    if p99 is not None:
+        pressures["p99"] = p99 / target_p99_ms
+    pressure = max(pressures.values()) if pressures else None
+    if n_live < 1 or pressure is None:
+        recommended = max(n_live, min_replicas)
+        reason = ("no live replicas" if n_live < 1
+                  else "no load signals yet; hold")
+    elif pressure >= 1.0:
+        import math
+
+        recommended = min(max_replicas,
+                          max(n_live + 1,
+                              math.ceil(n_live * min(pressure, 2.0))))
+        reason = (f"pressure {pressure:.2f} >= 1.0 "
+                  f"({'queue' if pressures.get('queue') == pressure else 'p99'}"
+                  " bound)")
+    elif pressure <= 0.35 and n_live > min_replicas:
+        recommended = n_live - 1
+        reason = f"pressure {pressure:.2f} <= 0.35; shed one replica"
+    else:
+        recommended = n_live
+        reason = f"pressure {pressure:.2f} in the hold band"
+    return {
+        "replicas_live": n_live,
+        "recommended": recommended,
+        "delta": recommended - n_live,
+        "pressure": None if pressure is None else round(pressure, 4),
+        "queue_fraction": None if qfrac is None else round(qfrac, 4),
+        "p99_ms": None if p99 is None else round(p99, 3),
+        "targets": {"p99_ms": target_p99_ms, "queue_high": queue_high,
+                    "min_replicas": min_replicas,
+                    "max_replicas": max_replicas},
+        "reason": reason,
+    }
+
+
+class AutoscaleGovernor:
+    """Escalate/disarm hysteresis between the plan and the actuator —
+    the data/governor.py idiom applied to replica count: a recommendation
+    must HOLD for ``escalate_patience`` consecutive ticks before scaling
+    up (one slow batch must not spawn a replica) and for
+    ``disarm_patience`` ticks before scaling down (scale-down rehashes
+    sessions, so be much slower to shrink than to grow).  Any tick in
+    the hold band zeroes both counters.  Single-threaded by design: only
+    the health-poll loop ticks it."""
+
+    def __init__(self, escalate_patience: int = 3,
+                 disarm_patience: int = 10):
+        self.escalate_patience = int(escalate_patience)
+        self.disarm_patience = int(disarm_patience)
+        self._up_ticks = 0
+        self._down_ticks = 0
+        #: decisions taken, newest last (the ops surface)
+        self.decisions: list[dict] = []
+
+    def tick(self, plan: dict) -> dict | None:
+        """One poll-cadence tick; returns an actionable decision
+        ``{"action": "scale_up"|"scale_down", "to": n, "plan": ...}``
+        or None (holding / still counting)."""
+        if plan["delta"] > 0:
+            self._up_ticks += 1
+            self._down_ticks = 0
+            if self._up_ticks >= self.escalate_patience:
+                self._up_ticks = 0
+                decision = {"action": "scale_up",
+                            "to": plan["recommended"], "plan": plan}
+                self.decisions.append(decision)
+                return decision
+        elif plan["delta"] < 0:
+            self._down_ticks += 1
+            self._up_ticks = 0
+            if self._down_ticks >= self.disarm_patience:
+                self._down_ticks = 0
+                decision = {"action": "scale_down",
+                            "to": plan["recommended"], "plan": plan}
+                self.decisions.append(decision)
+                return decision
+        else:
+            self._up_ticks = 0
+            self._down_ticks = 0
+        return None
+
+    def snapshot(self) -> dict:
+        return {"up_ticks": self._up_ticks, "down_ticks": self._down_ticks,
+                "escalate_patience": self.escalate_patience,
+                "disarm_patience": self.disarm_patience,
+                "decisions": len(self.decisions)}
+
+
+# --------------------------------------------------------------- registry
+
+class FleetRegistry:
+    """Replica membership + state machine + the hash ring, under ONE
+    lock.  All mutation goes through methods that (a) hold the lock only
+    for pure bookkeeping — never network, file, or process I/O — and
+    (b) return the fleet events the transition produced, which the
+    CALLER emits after the lock is released (the flight recorder's
+    writer takes its own lock; nesting it under ours would order-couple
+    two unrelated locks for no benefit)."""
+
+    def __init__(self, vnodes: int | None = None):
+        self._lock = threading.Lock()
+        self._urls: dict[str, str] = {}          # jaxrace: guarded-by=self._lock
+        self._states: dict[str, str] = {}        # jaxrace: guarded-by=self._lock
+        self._since: dict[str, float] = {}       # jaxrace: guarded-by=self._lock
+        self._signals: dict[str, dict] = {}      # jaxrace: guarded-by=self._lock
+        self._failures: dict[str, int] = {}      # jaxrace: guarded-by=self._lock
+        ring = HashRing() if vnodes is None else HashRing(vnodes=vnodes)
+        self._ring = ring                    # jaxrace: guarded-by=self._lock
+        self._vnodes = self._ring.vnodes
+        self._gauge_live = get_registry().gauge(
+            "fleet_replicas_live", "replicas currently taking traffic")
+
+    # -- membership ------------------------------------------------------
+
+    def add(self, rid: str, url: str) -> list[dict]:
+        """Register ``rid`` at ``url`` in state ``starting``; idempotent
+        re-add of a known id re-points its url (a respawned local
+        replica keeps its id — and therefore its ring ranges — so its
+        sessions come home after one re-encode)."""
+        with self._lock:
+            fresh = rid not in self._states
+            self._urls[rid] = url
+            self._states[rid] = "starting"
+            self._since[rid] = time.monotonic()
+            self._signals.setdefault(rid, {})
+            self._failures[rid] = 0
+            self._ring.remove(rid)  # starting replicas take no traffic
+            self._update_live_gauge()
+        return [{"kind": "replica_starting" if fresh else "replica_respawn",
+                 "payload": {"replica": rid, "url": url}}]
+
+    def remove(self, rid: str) -> list[dict]:
+        """Deregister ``rid`` entirely (its ring ranges rehash)."""
+        with self._lock:
+            if rid not in self._states:
+                return []
+            state = self._states.pop(rid)
+            self._urls.pop(rid, None)
+            self._since.pop(rid, None)
+            self._signals.pop(rid, None)
+            self._failures.pop(rid, None)
+            self._ring.remove(rid)
+            self._update_live_gauge()
+        return [{"kind": "replica_removed",
+                 "payload": {"replica": rid, "from_state": state}}]
+
+    def drain(self, rid: str) -> list[dict]:
+        """Take ``rid`` out of the ring without killing it: in-flight
+        work completes, its sessions rehash (a re-encode each) to the
+        survivors, and the operator (or the autoscaler) removes it once
+        its queue runs dry."""
+        return self._transition(rid, "draining", "drain requested")
+
+    # -- health-driven transitions --------------------------------------
+
+    def note_poll(self, rid: str, ok: bool, signals: dict | None = None,
+                  reason: str = "", boot_timeout_s: float = 300.0
+                  ) -> list[dict]:
+        """Apply one health-poll outcome.  ``ok`` means the replica
+        answered /healthz AND reported itself healthy; an answered-but-
+        unhealthy poll passes ``ok=False`` with its reason.  Repeated
+        failures (``DEAD_AFTER``) kill the replica — except while
+        ``starting``, where connection refusals are just a boot in
+        progress until ``boot_timeout_s`` runs out."""
+        with self._lock:
+            if rid not in self._states:
+                return []
+            state = self._states[rid]
+            if signals is not None:
+                self._signals[rid] = dict(signals)
+            if ok:
+                self._failures[rid] = 0
+                if state in ("starting", "degraded"):
+                    return self._set_state_locked(rid, "healthy", reason)
+                return []
+            self._failures[rid] += 1
+            if state == "starting":
+                booting = (time.monotonic() - self._since[rid]
+                           < boot_timeout_s)
+                if booting:
+                    return []
+                return self._set_state_locked(
+                    rid, "dead", f"boot timeout: {reason}")
+            if state == "draining":
+                return []  # a draining replica winding down is not news
+            if self._failures[rid] >= DEAD_AFTER:
+                return self._set_state_locked(
+                    rid, "dead",
+                    f"{self._failures[rid]} consecutive failures: {reason}")
+            if state == "healthy":
+                return self._set_state_locked(rid, "degraded", reason)
+        return []
+
+    def note_proxy_failure(self, rid: str, reason: str) -> list[dict]:
+        """A request to ``rid`` failed at the CONNECTION level mid-flight
+        — stronger evidence than a missed poll (a real client just got
+        hurt), so it counts like a failed poll immediately instead of
+        waiting out the poll interval."""
+        return self.note_poll(rid, ok=False, reason=f"proxy: {reason}",
+                              boot_timeout_s=0.0)
+
+    def _transition(self, rid: str, state: str, reason: str) -> list[dict]:
+        with self._lock:
+            if rid not in self._states:
+                return []
+            return self._set_state_locked(rid, state, reason)
+
+    def _set_state_locked(self, rid: str, state: str,
+                          reason: str) -> list[dict]:
+        """State write + ring membership + gauge, caller holds the lock.
+        Returns the fleet events to emit (outside the lock)."""
+        prev = self._states[rid]
+        if prev == state:
+            return []
+        self._states[rid] = state
+        self._since[rid] = time.monotonic()
+        if state in LIVE_STATES:
+            self._ring.add(rid)
+        else:
+            self._ring.remove(rid)
+        self._update_live_gauge()
+        kind = {"healthy": "replica_up", "dead": "replica_down",
+                "draining": "replica_drain"}.get(state, "replica_state")
+        return [{"kind": kind,
+                 "payload": {"replica": rid, "from": prev, "to": state,
+                             "reason": reason}}]
+
+    def _update_live_gauge(self) -> None:
+        self._gauge_live.set(
+            sum(1 for s in self._states.values() if s in LIVE_STATES))
+
+    # -- read surface ----------------------------------------------------
+
+    def ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._states)
+
+    def url(self, rid: str) -> str | None:
+        with self._lock:
+            return self._urls.get(rid)
+
+    def state(self, rid: str) -> str | None:
+        with self._lock:
+            return self._states.get(rid)
+
+    def candidates(self, session_id: str) -> list[str]:
+        """Failover-ordered live replicas for a session key."""
+        with self._lock:
+            return self._ring.candidates(session_id)
+
+    def live_loads(self) -> dict[str, dict]:
+        """id -> last load signals, live replicas only (the least-loaded
+        router's and the autoscaler's shared input)."""
+        with self._lock:
+            return {rid: dict(self._signals.get(rid) or {})
+                    for rid, s in self._states.items() if s in LIVE_STATES}
+
+    def n_live(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._states.values() if s in LIVE_STATES)
+
+    def snapshot(self) -> dict:
+        """The /healthz replica table."""
+        with self._lock:
+            now = time.monotonic()
+            return {
+                "replicas": {
+                    rid: {"url": self._urls.get(rid),
+                          "state": s,
+                          "state_age_s": round(now - self._since[rid], 3),
+                          "consecutive_failures": self._failures.get(rid, 0),
+                          "signals": dict(self._signals.get(rid) or {})}
+                    for rid, s in sorted(self._states.items())},
+                "ring": sorted(self._ring.nodes),
+                "vnodes": self._vnodes,
+            }
+
+
+# ---------------------------------------------------------- local manager
+
+class LocalManager:
+    """Spawn/respawn ``dptpu-serve`` children for ``local`` mode.
+
+    ``argv_template`` is the replica command WITHOUT host/port (the
+    manager appends ``--host 127.0.0.1 --port <free port>``);
+    ``child_env(slot, restarts)`` may return extra env for one spawn
+    (the chaos runner injects a fault plan into exactly one replica's
+    FIRST boot this way).  Slot ids are stable (``r0..rN-1``): a
+    respawn reuses its slot's id, so the ring's key ranges — and
+    therefore session affinity — survive the restart."""
+
+    def __init__(self, argv_template: list[str], workdir: str,
+                 max_restarts: int = 3, child_env=None):
+        self.argv_template = list(argv_template)
+        self.workdir = workdir
+        self.max_restarts = int(max_restarts)
+        self.child_env = child_env
+        self._lock = threading.Lock()
+        self._procs: dict[str, subprocess.Popen] = {}  # jaxrace: guarded-by=self._lock
+        self._restarts: dict[str, int] = {}            # jaxrace: guarded-by=self._lock
+        self._next_slot = 0                            # jaxrace: guarded-by=self._lock
+        os.makedirs(workdir, exist_ok=True)
+
+    @staticmethod
+    def _free_port() -> int:
+        s = socket.socket()
+        try:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+        finally:
+            s.close()
+
+    def new_slot(self) -> str:
+        with self._lock:
+            rid = f"r{self._next_slot}"
+            self._next_slot += 1
+            self._restarts.setdefault(rid, 0)
+        return rid
+
+    def spawn(self, rid: str) -> str:
+        """Launch one child for slot ``rid``; returns its URL.  All the
+        process I/O happens before the (brief) bookkeeping lock."""
+        port = self._free_port()
+        argv = self.argv_template + ["--host", "127.0.0.1",
+                                     "--port", str(port)]
+        with self._lock:
+            restarts = self._restarts.get(rid, 0)
+        env = dict(os.environ)
+        extra = self.child_env(rid, restarts) if self.child_env else None
+        if extra:
+            env.update(extra)
+        log = open(os.path.join(self.workdir, f"{rid}.log"), "ab")
+        try:
+            proc = subprocess.Popen(argv, env=env, stdout=log, stderr=log)
+        finally:
+            log.close()  # the child holds its own fd
+        with self._lock:
+            self._procs[rid] = proc
+        return f"http://127.0.0.1:{port}"
+
+    def kill(self, rid: str, sig=None) -> None:
+        """Terminate slot ``rid``'s child (SIGTERM default)."""
+        with self._lock:
+            proc = self._procs.get(rid)
+        if proc is None or proc.poll() is not None:
+            return
+        if sig is None:
+            proc.terminate()
+        else:
+            proc.send_signal(sig)
+
+    def pid(self, rid: str) -> int | None:
+        with self._lock:
+            proc = self._procs.get(rid)
+        return None if proc is None or proc.poll() is not None else proc.pid
+
+    def exited(self, rid: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(rid)
+        return proc is not None and proc.poll() is not None
+
+    def can_respawn(self, rid: str) -> bool:
+        with self._lock:
+            return self._restarts.get(rid, 0) < self.max_restarts
+
+    def respawn(self, rid: str) -> str | None:
+        """Respawn a dead slot under the restart budget; returns the new
+        URL or None (budget spent)."""
+        with self._lock:
+            if self._restarts.get(rid, 0) >= self.max_restarts:
+                return None
+            self._restarts[rid] = self._restarts.get(rid, 0) + 1
+        return self.spawn(rid)
+
+    def retire(self, rid: str) -> None:
+        """Drop a slot for good (scale-down): SIGTERM + no respawn."""
+        self.kill(rid)
+        with self._lock:
+            self._restarts[rid] = self.max_restarts
+
+    def stop_all(self, timeout_s: float = 10.0) -> None:
+        with self._lock:
+            procs = dict(self._procs)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + timeout_s
+        for proc in procs.values():
+            remaining = deadline - time.monotonic()
+            try:
+                proc.wait(timeout=max(0.1, remaining))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+# ------------------------------------------------------------- conn pool
+
+class _ReplicaPool:
+    """Keep-alive ``http.client`` connections to replicas, shared
+    across the front's handler threads.
+
+    ThreadingHTTPServer spawns a thread per CLIENT connection, so
+    thread-local reuse would never hit — the pool is one free-list per
+    replica URL under a lock that guards bookkeeping only: connects,
+    closes and all request I/O happen outside it (jaxrace JR004).
+    Reuse is what keeps the hop cheap enough for the bench's
+    proxy-overhead pin: a fresh TCP connect plus a fresh replica-side
+    handler thread per forwarded request costs more than the routing
+    itself."""
+
+    #: idle connections kept per replica; surplus returns just close
+    MAX_IDLE = 8
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._idle: dict[str, list] = {}  # jaxrace: guarded-by=self._lock
+
+    def fresh(self, url: str) -> http.client.HTTPConnection:
+        host, port = url.split("//", 1)[1].rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port),
+                                          timeout=self.timeout_s)
+        conn.connect()
+        try:
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # best-effort: Nagle costs only latency, never bytes
+        return conn
+
+    def take(self, url: str) -> tuple[http.client.HTTPConnection, bool]:
+        """An idle pooled connection or a fresh one; the bool says
+        which (a STALE pooled connection failing is a keep-alive
+        artifact worth one same-replica retry — a fresh one failing is
+        transport evidence)."""
+        with self._lock:
+            conns = self._idle.get(url)
+            conn = conns.pop() if conns else None
+        if conn is not None:
+            return conn, False
+        return self.fresh(url), True
+
+    def give(self, url: str, conn) -> None:
+        surplus = None
+        with self._lock:
+            conns = self._idle.setdefault(url, [])
+            if len(conns) < self.MAX_IDLE:
+                conns.append(conn)
+            else:
+                surplus = conn
+        if surplus is not None:
+            surplus.close()
+
+    def drop(self, url: str) -> None:
+        """Close every idle connection to ``url`` — its replica just
+        failed a forward, so the rest of its pool is as stale."""
+        with self._lock:
+            conns = self._idle.pop(url, [])
+        for c in conns:
+            c.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            conns = [c for cs in self._idle.values() for c in cs]
+            self._idle.clear()
+        for c in conns:
+            c.close()
+
+
+# ------------------------------------------------------------------ front
+
+class FleetFront:
+    """The fleet: registry + health loop + HTTP router (+ supervisor in
+    ``local`` mode).
+
+    >>> front = FleetFront(attach=["http://127.0.0.1:8801"])
+    >>> front.start()
+    >>> url = front.serve_http("127.0.0.1", 0)   # background server
+    >>> ...
+    >>> front.stop()
+    """
+
+    def __init__(self, attach: list[str] | None = None,
+                 manager: LocalManager | None = None,
+                 replicas: int = 0,
+                 poll_interval_s: float = 1.0,
+                 poll_timeout_s: float = 5.0,
+                 boot_timeout_s: float = 300.0,
+                 proxy_timeout_s: float = 120.0,
+                 target_p99_ms: float = 250.0,
+                 min_replicas: int = 1, max_replicas: int = 8,
+                 autoscale: bool = False,
+                 governor: AutoscaleGovernor | None = None,
+                 vnodes: int | None = None):
+        if attach and manager is not None:
+            raise ValueError("attach URLs and a LocalManager are exclusive "
+                             "modes — pass one")
+        if manager is not None and replicas < 1:
+            raise ValueError(f"local mode needs replicas >= 1, "
+                             f"got {replicas}")
+        self.registry = FleetRegistry(vnodes=vnodes)
+        self.manager = manager
+        self.mode = "local" if manager is not None else "attach"
+        self._n_start = replicas
+        self._attach = list(attach or [])
+        self.poll_interval_s = float(poll_interval_s)
+        self.poll_timeout_s = float(poll_timeout_s)
+        self.boot_timeout_s = float(boot_timeout_s)
+        self.proxy_timeout_s = float(proxy_timeout_s)
+        self._pool = _ReplicaPool(self.proxy_timeout_s)
+        self.target_p99_ms = float(target_p99_ms)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.autoscale = bool(autoscale) and self.mode == "local"
+        self.governor = governor or AutoscaleGovernor()
+        #: per-replica poll breakers: a dead replica is refused, not
+        #: hammered; half-open after 2 poll intervals re-probes it
+        self._breakers: dict[str, policies.CircuitBreaker] = {}
+        #: in-poll retry: one quick second chance absorbs a blip without
+        #: waiting a full interval to clear a degraded flap
+        self._poll_retry = policies.Retry(base_s=0.05, cap_s=0.2,
+                                          attempts=2, jitter=0.0)
+        self._autodrain: set[str] = set()   # jaxrace: guarded-by=self._drain_lock
+        self._drain_empty: dict[str, int] = {}  # jaxrace: guarded-by=self._drain_lock
+        self._drain_lock = threading.Lock()
+        reg = get_registry()
+        self._route_total = {
+            reason: reg.counter("fleet_route_total",
+                                "requests routed, by routing reason",
+                                labels={"reason": reason})
+            for reason in ("session", "stateless", "unroutable")}
+        self._failover_total = reg.counter(
+            "fleet_failover_total",
+            "requests retried on the next ring candidate after their "
+            "replica died mid-flight")
+        self._p99_gauges: dict[str, object] = {}
+        self._stop = threading.Event()
+        self._poller: threading.Thread | None = None
+        self._httpd: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self._started = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "FleetFront":
+        if self._started:
+            raise RuntimeError("fleet already started")
+        self._started = True
+        chaos_sites.maybe_arm_from_env()
+        if self.mode == "local":
+            for _ in range(self._n_start):
+                rid = self.manager.new_slot()
+                url = self.manager.spawn(rid)
+                self._emit(self.registry.add(rid, url))
+        else:
+            for i, url in enumerate(self._attach):
+                self._emit(self.registry.add(f"a{i}", url.rstrip("/")))
+        self._poller = threading.Thread(target=self._poll_loop,
+                                        name="fleet-health", daemon=True)
+        self._poller.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pool.close_all()
+        if self._poller is not None:
+            self._poller.join(timeout=10.0)
+            self._poller = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self.manager is not None:
+            self.manager.stop_all()
+
+    def __enter__(self) -> "FleetFront":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Start the HTTP front on a background thread; returns its URL.
+        (The CLI instead runs :meth:`serve_forever` on the main
+        thread.)"""
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          make_fleet_handler(self))
+        self._http_thread = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            name="fleet-http", daemon=True)
+        self._http_thread.start()
+        return f"http://{host}:{self._httpd.server_address[1]}"
+
+    def wait_live(self, n: int, timeout_s: float = 300.0) -> bool:
+        """Block until ``n`` replicas are live (or timeout); the boot
+        barrier for tests, benches, and the CLI's ready line."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.registry.n_live() >= n:
+                return True
+            if self._stop.wait(0.05):
+                return False
+        return self.registry.n_live() >= n
+
+    # -- events / metrics ------------------------------------------------
+
+    def _emit(self, evs: list[dict]) -> None:
+        for ev in evs:
+            events_lib.emit("fleet", ev["kind"], payload=ev["payload"])
+
+    def _observe_p99(self, rid: str, signals: dict) -> None:
+        p99 = signals.get("p99_ms")
+        if p99 is None:
+            return
+        g = self._p99_gauges.get(rid)
+        if g is None:
+            g = self._p99_gauges[rid] = get_registry().gauge(
+                "fleet_replica_p99_ms",
+                "per-replica request p99 from the last health poll",
+                labels={"replica": rid})
+        g.set(float(p99))
+
+    # -- health loop -----------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            self._tick()
+            self._stop.wait(self.poll_interval_s)
+
+    def _tick(self) -> None:
+        """One health round: poll every replica, apply transitions,
+        respawn dead local slots, drive the drain/autoscale machinery."""
+        for rid in self.registry.ids():
+            url = self.registry.url(rid)
+            if url is None:
+                continue
+            ok, signals, reason = self._poll_one(rid, url)
+            if signals:
+                self._observe_p99(rid, signals)
+            self._emit(self.registry.note_poll(
+                rid, ok, signals=signals, reason=reason,
+                boot_timeout_s=self.boot_timeout_s))
+        if self.mode == "local":
+            self._reap_and_respawn()
+            self._finish_drains()
+        plan = self.plan()
+        if self.autoscale:
+            decision = self.governor.tick(plan)
+            if decision is not None:
+                self._actuate(decision)
+
+    def _poll_one(self, rid: str, url: str
+                  ) -> tuple[bool, dict | None, str]:
+        """GET /healthz under the per-replica breaker + in-poll retry.
+        Returns (ok, load signals, reason)."""
+        breaker = self._breakers.get(rid)
+        if breaker is None:
+            breaker = self._breakers[rid] = policies.CircuitBreaker(
+                failure_threshold=DEAD_AFTER,
+                reset_after_s=2.0 * self.poll_interval_s)
+
+        def fetch() -> dict:
+            # chaos seam: a latency fault is a slow replica (poll still
+            # truthful), an error fault is a poll that never lands —
+            # counted toward the replica's failure tally like any
+            # network failure (the membership chaos the scenario drives)
+            chaos_sites.fire("serve/health_poll", replica=rid)
+            with urllib.request.urlopen(url + "/healthz",
+                                        timeout=self.poll_timeout_s) as r:
+                return json.loads(r.read().decode("utf-8"))
+
+        def fetch_allowing_unhealthy() -> dict:
+            # a 503 /healthz carries the SAME body (the probe's answer,
+            # not an error): a replica honest about being unhealthy has
+            # answered — only transport failures count against the
+            # breaker
+            try:
+                return fetch()
+            except urllib.error.HTTPError as e:
+                return json.loads(e.read().decode("utf-8"))
+
+        try:
+            health = self._poll_retry.call(
+                lambda: breaker.call(fetch_allowing_unhealthy),
+                retry_on=(urllib.error.URLError, OSError, ValueError))
+        except policies.CircuitOpenError:
+            return False, None, "breaker open"
+        except policies.RetryBudgetExceededError as e:
+            cause = e.__cause__
+            return False, None, (f"{type(cause).__name__}: {cause}"
+                                 if cause else "poll failed")
+        except Exception as e:  # noqa: BLE001 — a poll must never kill the loop
+            return False, None, f"{type(e).__name__}: {e}"
+        stats = health.get("stats") or {}
+        lat = stats.get("latency_ms") or {}
+        signals = {
+            "queue_depth": health.get("queue_depth"),
+            "queue_capacity": health.get("queue_capacity"),
+            "p99_ms": lat.get("p99"),
+            "p50_ms": lat.get("p50"),
+            "completed": stats.get("completed"),
+            "unhealthy_reason": health.get("unhealthy_reason"),
+        }
+        ok = bool(health.get("ok"))
+        return ok, signals, (signals["unhealthy_reason"] or "")
+
+    def _reap_and_respawn(self) -> None:
+        """Local mode: a slot whose process exited is dead NOW (no need
+        to wait out DEAD_AFTER polls), and dead slots respawn under the
+        restart budget — scale-out in seconds when the replicas boot
+        off the AOT cache."""
+        for rid in self.registry.ids():
+            state = self.registry.state(rid)
+            if state != "dead" and self.manager.exited(rid):
+                self._emit(self.registry.note_poll(
+                    rid, ok=False, reason="process exited",
+                    boot_timeout_s=0.0))
+                self._emit(self.registry.note_poll(
+                    rid, ok=False, reason="process exited",
+                    boot_timeout_s=0.0))
+                self._emit(self.registry.note_poll(
+                    rid, ok=False, reason="process exited",
+                    boot_timeout_s=0.0))
+                state = self.registry.state(rid)
+            if state == "dead" and self.manager.can_respawn(rid):
+                with self._drain_lock:
+                    draining = rid in self._autodrain
+                if draining:
+                    continue  # scale-down took it; let it die
+                url = self.manager.respawn(rid)
+                if url is not None:
+                    self._emit(self.registry.add(rid, url))
+
+    def _finish_drains(self) -> None:
+        """A scale-down drain completes when the replica's queue reads
+        empty for two consecutive polls — then the child is retired and
+        the slot deregistered."""
+        with self._drain_lock:
+            draining = list(self._autodrain)
+        for rid in draining:
+            sig = (self.registry.live_loads().get(rid)
+                   or self.registry.snapshot()["replicas"]
+                   .get(rid, {}).get("signals") or {})
+            empty = (sig.get("queue_depth") == 0)
+            with self._drain_lock:
+                n = self._drain_empty.get(rid, 0) + 1 if empty else 0
+                self._drain_empty[rid] = n
+                done = n >= 2
+                if done:
+                    self._autodrain.discard(rid)
+                    self._drain_empty.pop(rid, None)
+            if done:
+                self.manager.retire(rid)
+                self._emit(self.registry.remove(rid))
+
+    def _actuate(self, decision: dict) -> None:
+        """Apply a governor decision (local mode only): scale-up spawns
+        fresh slots; scale-down DRAINS the newest slot (sessions rehash,
+        queue empties, then the child retires) — never a kill."""
+        events_lib.emit("fleet", "scale_decision", payload=decision)
+        n_live = self.registry.n_live()
+        if decision["action"] == "scale_up":
+            for _ in range(max(0, decision["to"] - n_live)):
+                rid = self.manager.new_slot()
+                url = self.manager.spawn(rid)
+                self._emit(self.registry.add(rid, url))
+        elif decision["action"] == "scale_down" and n_live > decision["to"]:
+            live = [rid for rid in self.registry.ids()
+                    if self.registry.state(rid) in LIVE_STATES]
+            if live:
+                victim = live[-1]  # newest slot: fewest resident sessions
+                with self._drain_lock:
+                    self._autodrain.add(victim)
+                    self._drain_empty[victim] = 0
+                self._emit(self.registry.drain(victim))
+
+    # -- routing ---------------------------------------------------------
+
+    def route_order(self, session_id: str | None) -> tuple[list[str], str]:
+        """The ordered replica candidates for one request and the
+        routing reason.  Session requests: ring order (affinity, then
+        failover); stateless: least-loaded order."""
+        if session_id is not None:
+            return self.registry.candidates(str(session_id)), "session"
+        return least_loaded(self.registry.live_loads()), "stateless"
+
+    def plan(self) -> dict:
+        """``GET /fleet/plan``'s body — recommendation only, see
+        :func:`scale_plan` for why actuation lives elsewhere."""
+        return scale_plan(self.registry.live_loads(),
+                          self.registry.n_live(),
+                          target_p99_ms=self.target_p99_ms,
+                          min_replicas=self.min_replicas,
+                          max_replicas=self.max_replicas)
+
+    def health(self) -> dict:
+        reg = self.registry.snapshot()
+        n_live = sum(1 for r in reg["replicas"].values()
+                     if r["state"] in LIVE_STATES)
+        return {
+            "ok": n_live > 0,
+            "mode": self.mode,
+            "live": n_live,
+            "autoscale": (self.governor.snapshot()
+                          if self.autoscale else None),
+            "events": events_lib.events_block(),
+            **reg,
+        }
+
+    def count_route(self, reason: str) -> None:
+        c = self._route_total.get(reason)
+        if c is not None:
+            c.inc()
+
+    def count_failover(self, dead_rid: str, to_rid: str) -> None:
+        self._failover_total.inc()
+        events_lib.emit("fleet", "failover",
+                        payload={"replica": dead_rid, "to": to_rid})
+
+
+# ---------------------------------------------------------------- handler
+
+#: routing scan: the quoted key, then ONE JSON scalar token — a string
+#: (escapes included) or a bare literal/number.  Structured values do
+#: not match and fall back to stateless routing.
+_SESSION_TOKEN = re.compile(
+    rb'"session_id"\s*:\s*("(?:[^"\\]|\\.)*"|[^,}\]\s]+)')
+
+
+def make_fleet_handler(front: FleetFront) -> type:
+    """The fleet's request-handler class, closed over the front.
+
+    The proxy forwards the RAW request body (one ``json.loads`` for the
+    routing fields only — arrays are never decoded or re-encoded on the
+    hop) and passes replica replies through byte-for-byte, so the whole
+    shed taxonomy (429 ``queue_full``/``session_lane``, 504, 503) and
+    the client's typed round-trip survive the extra hop unchanged."""
+
+    class FleetHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # same Nagle/delayed-ACK interaction as the replica handler:
+        # header + body are two writes, keep-alive keeps the socket
+        disable_nagle_algorithm = True
+        # one segment per reply (see the replica handler's wbufsize)
+        wbufsize = 64 * 1024
+        timeout = 10.0
+
+        def log_message(self, fmt, *args):  # metrics are the log
+            pass
+
+        def _reply(self, code: int, payload: dict,
+                   headers: dict | None = None) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if code in (429, 503) and not (headers or {}).get("Retry-After"):
+                self.send_header("Retry-After", "1")
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802 — http.server's contract
+            if self.path == "/healthz":
+                health = front.health()
+                self._reply(200 if health["ok"] else 503, health)
+            elif self.path == "/fleet/plan":
+                self._reply(200, front.plan())
+            elif self.path == "/metrics":
+                from ..telemetry import prometheus
+
+                text = prometheus.render_text(get_registry())
+                body = text.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", prometheus.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._reply(404, {"error": f"no such path {self.path!r}"})
+
+        def do_POST(self) -> None:  # noqa: N802
+            try:
+                raw = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+            except (TimeoutError, OSError):
+                self.close_connection = True
+                return
+            if self.path == "/v1/predict":
+                self._proxy_predict(raw)
+            elif self.path == "/fleet/drain":
+                self._admin(raw, "drain")
+            elif self.path == "/fleet/remove":
+                self._admin(raw, "remove")
+            elif self.path == "/fleet/add":
+                self._admin(raw, "add")
+            else:
+                self._reply(404, {"error": f"no such path {self.path!r}"})
+
+        # -- admin -------------------------------------------------------
+
+        def _admin(self, raw: bytes, op: str) -> None:
+            try:
+                body = json.loads(raw.decode("utf-8")) if raw else {}
+            except ValueError as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            if op == "add":
+                url = body.get("url")
+                if front.mode != "attach":
+                    self._reply(409, {"error": "add-by-url is an attach-"
+                                               "mode operation; local "
+                                               "replicas are supervised"})
+                    return
+                if not url:
+                    self._reply(400, {"error": "need {'url': ...}"})
+                    return
+                rid = f"a{len(front.registry.ids())}"
+                front._emit(front.registry.add(rid, str(url).rstrip("/")))
+                self._reply(200, {"added": rid})
+                return
+            rid = body.get("replica")
+            if rid is None or front.registry.state(rid) is None:
+                self._reply(404, {"error": f"no replica {rid!r}"})
+                return
+            if op == "drain":
+                front._emit(front.registry.drain(rid))
+            else:
+                if front.manager is not None:
+                    front.manager.retire(rid)
+                front._emit(front.registry.remove(rid))
+            self._reply(200, front.health())
+
+        # -- the proxy ---------------------------------------------------
+
+        def _routing_fields(self, raw: bytes) -> str | None:
+            """session_id from the request body, or None — the ONLY
+            parse the hop does, and it is a token SCAN, not a full
+            ``json.loads``: the body is dominated by the base64 image
+            (whose alphabet cannot contain ``"``, so the quoted key
+            cannot appear inside it) and decoding all of it just to
+            route costs more than the rest of the hop combined.  A
+            malformed body still routes (to any live replica): the
+            replica's 400 is the authoritative answer and must come
+            from the same validation path as a direct request's."""
+            m = _SESSION_TOKEN.search(raw)
+            if m is None:
+                return None
+            try:
+                sid = json.loads(m.group(1).decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                return None
+            return None if sid is None else str(sid)
+
+        def _proxy_predict(self, raw: bytes) -> None:
+            session_id = self._routing_fields(raw)
+            try:
+                chaos_sites.fire("serve/route", session=session_id)
+            except Exception as e:  # noqa: BLE001 — injected route fault
+                front.count_route("unroutable")
+                self._reply(503, {"error": f"routing failed: {e}",
+                                  "code": "fleet_unavailable"})
+                return
+            order, reason = front.route_order(session_id)
+            if not order:
+                front.count_route("unroutable")
+                self._reply(503, {
+                    "error": "no live replicas (all starting, draining "
+                             "or dead) — retry shortly",
+                    "code": "fleet_unavailable"})
+                return
+            rerouted_from: str | None = None
+            # primary + ONE failover candidate: a request is retried at
+            # most once, and only when its replica died before sending
+            # any reply (a received error reply is final — see module
+            # docstring on non-idempotent safety)
+            for rid in order[:2]:
+                url = front.registry.url(rid)
+                if url is None:
+                    continue
+                try:
+                    status, ctype, body, retry_after = self._forward(
+                        url, raw)
+                except (urllib.error.URLError, OSError,
+                        http.client.HTTPException) as e:
+                    reason_s = getattr(e, "reason", None) or e
+                    front._pool.drop(url)
+                    front._emit(front.registry.note_proxy_failure(
+                        rid, str(reason_s)))
+                    rerouted_from = rid
+                    continue
+                headers = {"X-Fleet-Replica": rid}
+                if rerouted_from is not None:
+                    headers["X-Fleet-Rerouted"] = rerouted_from
+                    front.count_failover(rerouted_from, rid)
+                if retry_after:
+                    headers["Retry-After"] = retry_after
+                elif status == 503 and front.registry.state(rid) in (
+                        "draining", "starting"):
+                    # a draining/booting replica's refusal is transient
+                    # by definition: tell the client when to come back
+                    headers["Retry-After"] = "1"
+                front.count_route(reason)
+                self._reply_raw(status, ctype, body, headers)
+                return
+            front.count_route("unroutable")
+            headers = {}
+            if rerouted_from is not None:
+                headers["X-Fleet-Rerouted"] = rerouted_from
+            self._reply(503, {
+                "error": "replica died mid-flight and the failover "
+                         "candidate was not reachable — retry shortly",
+                "code": "fleet_unavailable"}, headers)
+
+        def _forward(self, url: str, raw: bytes
+                     ) -> tuple[int, str, bytes, str | None]:
+            """One proxy attempt over a pooled keep-alive connection.
+            An HTTP error REPLY (the replica answered) returns like a
+            success — it is a pass-through payload, not a failover
+            trigger; only transport-level failures raise.  A POOLED
+            connection failing before any reply gets one retry on a
+            fresh connection to the SAME replica: a dropped keep-alive
+            is a connection artifact, not evidence against the replica
+            — treating it as death would degrade healthy members and
+            bounce their sessions."""
+            conn, fresh = front._pool.take(url)
+            while True:
+                try:
+                    conn.request("POST", "/v1/predict", body=raw,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    resp = conn.getresponse()
+                    body = resp.read()
+                except (OSError, http.client.HTTPException):
+                    conn.close()
+                    if fresh:
+                        raise
+                    conn, fresh = front._pool.fresh(url), True
+                    continue
+                if resp.will_close:
+                    conn.close()
+                else:
+                    front._pool.give(url, conn)
+                return (resp.status,
+                        resp.headers.get("Content-Type",
+                                         "application/json"),
+                        body, resp.headers.get("Retry-After"))
+
+        def _reply_raw(self, code: int, ctype: str, body: bytes,
+                       headers: dict) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+    return FleetHandler
+
+
+# -------------------------------------------------------------------- CLI
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(
+        prog="dptpu-fleet",
+        description="multi-replica serving front: consistent-hash "
+                    "session routing, health-driven membership, "
+                    "failover, autoscale")
+    mode = parser.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--attach", nargs="+", metavar="URL",
+                      help="route over existing dptpu-serve replicas "
+                           "(pure-router mode)")
+    mode.add_argument("--replicas", type=int, default=None,
+                      help="local mode: spawn N dptpu-serve children "
+                           "and supervise them")
+    parser.add_argument("--run-dir", default=None,
+                        help="local mode: the replicas' training run dir")
+    parser.add_argument("--torch", default=None, metavar="PTH",
+                        help="local mode: torch checkpoint instead of a "
+                             "run dir")
+    parser.add_argument("--fresh-init", default=None, metavar="SPEC",
+                        const="64", nargs="?",
+                        help="local mode: fresh-init replicas (dev/chaos "
+                             "only; see dptpu-serve --fresh-init)")
+    parser.add_argument("--serve-args", default="", metavar="ARGS",
+                        help="extra dptpu-serve flags for each replica, "
+                             "one shell-quoted string (e.g. "
+                             "'--warmup --aot-cache /c --max-batch 8')")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8800)
+    parser.add_argument("--poll-interval-s", type=float, default=1.0)
+    parser.add_argument("--target-p99-ms", type=float, default=250.0,
+                        help="the autoscale plan's latency target")
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--max-replicas", type=int, default=8)
+    parser.add_argument("--autoscale", action="store_true",
+                        help="local mode: actuate /fleet/plan with "
+                             "escalate/disarm hysteresis")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        help="respawn budget per local replica slot")
+    parser.add_argument("--workdir", default="./fleet",
+                        help="local mode: replica logs land here")
+    parser.add_argument("--events-dir", default=None, metavar="DIR",
+                        help="flight-recorder run dir for fleet events "
+                             "(replica_up/down, failover, "
+                             "scale_decision) — dptpu-doctor reads it")
+    args = parser.parse_args(argv)
+
+    log = None
+    if args.events_dir:
+        log = events_lib.configure(args.events_dir)
+    manager = None
+    n = 0
+    if args.replicas is not None:
+        n = args.replicas
+        src = []
+        if args.run_dir:
+            src = ["--run-dir", args.run_dir]
+        elif args.torch:
+            src = ["--torch", args.torch]
+        elif args.fresh_init:
+            src = ["--fresh-init", args.fresh_init]
+        else:
+            parser.error("local mode needs --run-dir, --torch or "
+                         "--fresh-init for the replicas")
+        template = ([sys.executable, "-m", "distributedpytorch_tpu.serve"]
+                    + src + shlex.split(args.serve_args))
+        manager = LocalManager(template, workdir=args.workdir,
+                               max_restarts=args.max_restarts)
+    front = FleetFront(attach=args.attach, manager=manager, replicas=n,
+                       poll_interval_s=args.poll_interval_s,
+                       target_p99_ms=args.target_p99_ms,
+                       min_replicas=args.min_replicas,
+                       max_replicas=args.max_replicas,
+                       autoscale=args.autoscale)
+    front.start()
+    httpd = ThreadingHTTPServer((args.host, args.port),
+                                make_fleet_handler(front))
+
+    def on_signal(signum, frame):
+        # shutdown() must come from another thread than serve_forever's
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    print(json.dumps({"fleet": f"http://{args.host}:{args.port}",
+                      "mode": front.mode,
+                      "replicas": (n if front.mode == "local"
+                                   else len(args.attach or [])),
+                      "autoscale": front.autoscale}), flush=True)
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        front.stop()
+        if log is not None:
+            events_lib.release(log)
+        print(json.dumps({"stopped": True, "health": front.health()}),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

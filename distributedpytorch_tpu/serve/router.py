@@ -1,0 +1,154 @@
+"""Routing math for the fleet front: consistent-hash ring + least-loaded.
+
+Pure, stdlib-only, no I/O and no locks — the fleet (serve/fleet.py) owns
+membership and concurrency; this module owns only the two routing
+questions, each a pure function of its inputs:
+
+* **Which replica owns this session?**  :class:`HashRing` — consistent
+  hashing with virtual nodes.  Sessions are generation- and cache-affine
+  by design (serve/sessions.py): a session's encoded features live in
+  ONE replica's HBM, so the router's job is to keep sending a session's
+  clicks where its features are.  A consistent hash makes membership
+  changes cheap: adding/removing one of N replicas moves only ~K/N of K
+  sessions (the property test in tests/test_fleet.py pins the bound),
+  and a moved session is not an error — its first click on the new
+  replica misses ``covers()`` and degrades to one counted re-encode.
+* **Which replica for a stateless request?**  :func:`least_loaded` —
+  pick the replica with the most queue headroom, tie-broken by p99 then
+  id, using the queue-depth/p99 signals every replica already exposes
+  on ``/healthz``.
+
+Hash points come from ``hashlib.blake2b`` over utf-8 bytes — NOT
+Python's ``hash()``, which is salted per process (PYTHONHASHSEED) and
+would send the same session to different replicas from different front
+processes.  Determinism across processes is a routing correctness
+property here, not a nicety: a restarted front must rebuild the SAME
+ring or every live session pays a spurious re-encode.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+#: virtual nodes per replica: enough that the max/min key-load ratio
+#: over a handful of replicas stays small (tests pin < 1.8 at 10k keys)
+#: while keeping the ring a few hundred points — lookups stay one
+#: bisect over a list that rebuilds in microseconds on membership change
+DEFAULT_VNODES = 96
+
+
+def _point(data: str) -> int:
+    """Stable 64-bit hash point for a ring position or a key."""
+    return int.from_bytes(
+        hashlib.blake2b(data.encode("utf-8"), digest_size=8).digest(),
+        "big")
+
+
+class HashRing:
+    """Consistent-hash ring over replica ids, with virtual nodes.
+
+    >>> ring = HashRing(["a", "b", "c"])
+    >>> ring.lookup("session-42")            # owning replica
+    >>> ring.candidates("session-42")        # failover order, all nodes
+
+    The ring is immutable-by-convention between :meth:`add`/:meth:`remove`
+    calls (the fleet rebuilds under its registry lock and swaps the whole
+    object in); lookups never mutate.
+    """
+
+    def __init__(self, nodes=(), vnodes: int = DEFAULT_VNODES):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = vnodes
+        self._nodes: set[str] = set()
+        #: sorted hash points and their parallel owner list (bisect keys)
+        self._points: list[int] = []
+        self._owners: list[str] = []
+        for n in nodes:
+            self.add(n)
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, node: str) -> bool:
+        return node in self._nodes
+
+    @property
+    def nodes(self) -> set[str]:
+        return set(self._nodes)
+
+    def add(self, node: str) -> None:
+        """Insert ``node``'s virtual points; idempotent."""
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for v in range(self.vnodes):
+            p = _point(f"{node}#{v}")
+            i = bisect.bisect_left(self._points, p)
+            self._points.insert(i, p)
+            self._owners.insert(i, node)
+
+    def remove(self, node: str) -> None:
+        """Drop ``node``'s virtual points; idempotent.  Only the removed
+        node's key ranges move (to each range's clockwise successor) —
+        the minimal-disruption property the whole design rides on."""
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        keep = [(p, o) for p, o in zip(self._points, self._owners)
+                if o != node]
+        self._points = [p for p, _ in keep]
+        self._owners = [o for _, o in keep]
+
+    def lookup(self, key: str) -> str | None:
+        """The replica owning ``key`` (first point clockwise), or None on
+        an empty ring."""
+        if not self._points:
+            return None
+        i = bisect.bisect_right(self._points, _point(key))
+        if i == len(self._points):
+            i = 0  # wrap: past the last point owns back to the first
+        return self._owners[i]
+
+    def candidates(self, key: str, n: int | None = None) -> list[str]:
+        """Distinct replicas in ring order starting at ``key``'s owner —
+        the failover sequence: a request whose primary died mid-flight
+        retries on ``candidates(key)[1]``.  ``n`` caps the list (default:
+        every node, each exactly once)."""
+        if not self._points:
+            return []
+        want = len(self._nodes) if n is None else min(n, len(self._nodes))
+        out: list[str] = []
+        seen: set[str] = set()
+        start = bisect.bisect_right(self._points, _point(key))
+        for off in range(len(self._points)):
+            owner = self._owners[(start + off) % len(self._points)]
+            if owner not in seen:
+                seen.add(owner)
+                out.append(owner)
+                if len(out) >= want:
+                    break
+        return out
+
+
+def least_loaded(loads: dict[str, dict]) -> list[str]:
+    """Replica ids ordered most-headroom-first for stateless routing.
+
+    ``loads`` maps replica id -> its last ``/healthz`` load signals:
+    ``queue_depth`` / ``queue_capacity`` (the service's bounded queue)
+    and ``p99_ms`` (its current tail).  Ordering: lowest queue FRACTION
+    first (an 8-deep queue on a 64-slot replica beats 3-deep on a
+    4-slot one), then lowest p99, then id — the id tiebreak keeps the
+    order deterministic for tests and for two fronts making the same
+    decision from the same snapshots.  Missing signals sort last within
+    their tier (an unknown load is assumed worst, never best)."""
+    def score(item):
+        rid, sig = item
+        depth = sig.get("queue_depth")
+        cap = sig.get("queue_capacity") or 0
+        frac = (depth / cap) if (depth is not None and cap) else float("inf")
+        p99 = sig.get("p99_ms")
+        return (frac, p99 if p99 is not None else float("inf"), rid)
+
+    return [rid for rid, _ in sorted(loads.items(), key=score)]

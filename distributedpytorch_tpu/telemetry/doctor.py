@@ -197,6 +197,11 @@ def detect_findings(tl: Timeline, path: str,
                 "traffic decides it",
             "flywheel_cycle":
                 "flywheel cycle left open: check flywheel.jsonl",
+            "replica_kill":
+                "replica declared dead and never rejoined the ring: "
+                "check its <slot>.log in the fleet workdir, and the "
+                "--max-restarts budget (a spent budget stops the "
+                "respawns; /fleet/plan still counts the lost capacity)",
         }[ep["type"]]
         findings.append(_finding(
             "critical", code,

@@ -66,7 +66,7 @@ EVENT_KEYS = ("v", "ts_wall", "ts_mono", "host", "pid", "generation",
 #: the emitting subsystems (the ``source`` field's closed set — the
 #: timeline's episode detectors key on these)
 SOURCES = ("trainer", "governor", "sentinel", "checkpoint", "preemption",
-           "supervisor", "serve", "flywheel", "chaos")
+           "supervisor", "serve", "flywheel", "chaos", "fleet")
 
 _RUN_RE = re.compile(r"run_(\d+)$")
 

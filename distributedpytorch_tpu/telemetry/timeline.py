@@ -51,7 +51,7 @@ _STALL_ARM = ("raise_prefetch", "flip_device_path", "arm_echo",
 #: episode types, closed set (doc + doctor rendering order)
 EPISODE_TYPES = ("divergence_rollback", "stall_ladder", "preempt_resume",
                  "crash_restart", "topology_replan", "canary",
-                 "flywheel_cycle")
+                 "flywheel_cycle", "replica_kill")
 
 
 def _read_jsonl(path: str) -> list[dict]:
@@ -271,6 +271,40 @@ def detect_episodes(events: list[dict]) -> tuple[list[dict], list[dict]]:
                                        if ev["kind"] == "swap_promote"
                                        else "rolled_back")
             _close(ep, ev)
+
+    # --- fleet replica kill -> respawn -> rejoin ------------------------
+    # replica_down opens (the fleet front declared a replica dead);
+    # the SAME replica's next replica_up closes (its respawn rejoined
+    # the ring — slot ids are stable, so same-id IS same-slot).  The
+    # front's failover events in between ride along as detail: how many
+    # in-flight requests the death actually touched.  A replica_up with
+    # no open episode is the normal boot lifecycle, not an orphan.
+    open_replica: dict[str, dict] = {}
+    for ev in events:
+        if ev["source"] != "fleet":
+            continue
+        rid = ev["payload"].get("replica")
+        if ev["kind"] == "replica_down":
+            ep = _open("replica_kill", ev, replica=rid,
+                       reason=ev["payload"].get("reason"), failovers=0)
+            episodes.append(ep)
+            open_replica[rid] = ep
+        elif ev["kind"] == "failover":
+            ep = open_replica.get(rid)
+            if ep is not None and not ep["resolved"]:
+                ep["events"].append(ev["seq"])
+                ep["detail"]["failovers"] += 1
+        elif ev["kind"] == "replica_up":
+            ep = open_replica.pop(rid, None)
+            if ep is not None and not ep["resolved"]:
+                _close(ep, ev)
+        elif ev["kind"] == "replica_removed":
+            # a drained/retired slot never comes back: the down episode
+            # (if any) resolves as a deliberate removal, not a recovery
+            ep = open_replica.pop(rid, None)
+            if ep is not None and not ep["resolved"]:
+                ep["detail"]["removed"] = True
+                _close(ep, ev)
 
     # --- flywheel cycles ------------------------------------------------
     for ev in events:

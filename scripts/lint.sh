@@ -64,7 +64,15 @@
 #      run dir must be diagnosable from any machine with no
 #      accelerator stack — and the recorder adds NO new jitted
 #      programs, so the jaxaudit contract set below is unchanged by it
-#      too) plus bench.py, the official record.
+#      too; serve/router.py + serve/fleet.py included — the fleet
+#      front is pure host code by contract (stdlib http + subprocess:
+#      routing hashes, the replica state machine, the health loop) and
+#      must STAY that way: no device touches, no jax imports at module
+#      scope, blocking I/O only outside the registry's lock (jaxrace
+#      JR004 pins that), and the front adds NO new jitted programs —
+#      the replicas it routes to own every compile, so the jaxaudit
+#      contract set below is unchanged by it as well) plus bench.py,
+#      the official record.
 #      `jaxlint --stats` then polices the suppressions themselves: a
 #      `# jaxlint:`/`# jaxguard:` disable whose rule no longer fires is
 #      a dead waiver waiting to swallow the next real finding — it

@@ -404,6 +404,10 @@ class TestVerifyCli:
 
 
 class TestStaleAotScenario:
+    @pytest.mark.slow  # tier-1 budget (PR 20): full chaos-runner boot
+    # matrix (~21s); fast gate:
+    # test_zero_compile_warm_boot_watchdog_verified + TestFallbackMatrix
+    # + TestVerifyCli
     def test_chaos_scenario_green_through_real_runner(self, tmp_path):
         """stale_aot_cache end to end: bitflip in flight, torn entry on
         disk, topology-mismatched manifest — every boot falls back

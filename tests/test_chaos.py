@@ -511,6 +511,32 @@ class TestScenariosEndToEnd:
         assert p2["param_digest_at_restore"] == p1["param_digest"]
         assert p2["final_step"] == 2 * p2["nb"]
 
+    @pytest.mark.slow  # a three-replica local fleet: N serve children
+    # booting fresh-init + one SIGKILL + one respawn (~minutes)
+    def test_replica_kill_under_load(self, tmp_path):
+        """The fleet scenario end-to-end: r0 SIGKILLs itself mid-burst,
+        no client ever sees an untyped error, its sessions rehash and
+        re-encode on the survivors, the supervisor respawns the slot and
+        the ring converges back to full count."""
+        from distributedpytorch_tpu.chaos import runner
+
+        report = runner.run_scenario("replica_kill_under_load",
+                                     work_dir=str(tmp_path / "w"),
+                                     strict=True)
+        assert report["ok"], report["invariants"]
+        f = report["phases"]["fleet"]
+        assert f["killed"] == "r0"  # the plan rode in r0's first boot
+        assert f["outcomes"]["untyped_error"] == 0, f["errors"]
+        assert (f["outcomes"]["completed"] + f["outcomes"]["typed_shed"]
+                == f["submitted"])
+        owned = sorted(sid for sid, owner in f["owners_pre"].items()
+                       if owner == "r0")
+        assert owned and f["moved_sessions"] == owned
+        assert f["health_final"]["live"] == 3
+        assert f["health_final"]["ring"] == ["r0", "r1", "r2"]
+        assert "replica_down" in f["event_kinds"]
+        assert report["recovery_s"] and report["recovery_s"] > 0
+
 
 class TestCLI:
     def test_list_and_plan(self):
@@ -528,7 +554,7 @@ class TestCLI:
                      "divergence_rollback", "crash_loop",
                      "preemption_storm", "input_stall_recovery",
                      "torn_pack", "stale_aot_cache",
-                     "poisoned_flywheel"):
+                     "poisoned_flywheel", "replica_kill_under_load"):
             assert name in r.stdout
         r = subprocess.run(
             [sys.executable, "-m", "distributedpytorch_tpu.chaos",

@@ -1,0 +1,842 @@
+"""Fleet front: ring math, membership state machine, autoscale, routing.
+
+Four layers, cheapest first:
+
+* pure routing math (HashRing / least_loaded) — the pinned-literal
+  determinism tests double as a cross-process contract: blake2b points
+  mean a restarted front rebuilds the SAME ring, so the literals here
+  must never drift;
+* the autoscale surface (scale_plan arithmetic + the governor's
+  escalate/disarm hysteresis), pure functions of load snapshots;
+* FleetRegistry's health-driven state machine and LocalManager's
+  spawn/respawn budget, stdlib-only;
+* the attach-mode front end-to-end over two real HTTP replicas sharing
+  one predictor: session affinity, byte-for-byte proxy pass-through,
+  drain rehashing, and one-shot failover with ``X-Fleet-Rerouted``.
+
+The ServeClient fleet-awareness satellite (Retry-After honored, typed
+draining errors, unparseable-5xx never replayed) runs against a scripted
+stdlib stub server — no jax, no service.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.serve import (
+    AutoscaleGovernor,
+    FleetFront,
+    FleetRegistry,
+    HashRing,
+    InferenceService,
+    QueueFullError,
+    ReplicaDrainingError,
+    ServeClient,
+    ServiceUnhealthyError,
+    SessionLaneFullError,
+    encode_array,
+    least_loaded,
+    scale_plan,
+)
+from distributedpytorch_tpu.serve.fleet import DEAD_AFTER, LocalManager
+
+
+def _image(h=90, w=120, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (h, w, 3)).astype(np.uint8)
+
+
+def _points(dx=0.0, dy=0.0):
+    return np.array([[30.0, 45.0], [95.0, 40.0],
+                     [60.0, 20.0], [55.0, 75.0]]) + np.array([dx, dy])
+
+
+# ------------------------------------------------------------------ ring
+
+class TestHashRing:
+    def test_pinned_lookups(self):
+        """Routing literals — blake2b points are a cross-process (and
+        cross-version) contract: if these drift, every live session on a
+        restarted front pays a spurious re-encode."""
+        ring = HashRing(["a", "b", "c"])
+        assert ring.lookup("session-42") == "c"
+        assert ring.candidates("session-42") == ["c", "b", "a"]
+        owners = {f"s{i}": HashRing(["r0", "r1", "r2"]).lookup(f"s{i}")
+                  for i in range(6)}
+        assert owners == {"s0": "r1", "s1": "r1", "s2": "r2",
+                          "s3": "r2", "s4": "r0", "s5": "r0"}
+
+    def test_determinism_across_processes(self):
+        """The same lookup from a fresh interpreter with a DIFFERENT
+        hash salt — the property PYTHONHASHSEED would break if the ring
+        used ``hash()``."""
+        prog = ("from distributedpytorch_tpu.serve.router import HashRing;"
+                "print(HashRing(['a','b','c']).lookup('session-42'))")
+        repo = __file__.rsplit("/tests/", 1)[0]
+        for seed in ("0", "12345"):
+            out = subprocess.run(
+                [sys.executable, "-c", prog], capture_output=True,
+                text=True, timeout=120,
+                env=dict(os.environ, PYTHONHASHSEED=seed), cwd=repo)
+            assert out.returncode == 0, out.stderr
+            assert out.stdout.strip() == "c"
+
+    def test_candidates_are_the_failover_order(self):
+        ring = HashRing(["a", "b", "c", "d"])
+        for key in ("k1", "k2", "session-xyz"):
+            cands = ring.candidates(key)
+            assert cands[0] == ring.lookup(key)
+            assert sorted(cands) == ["a", "b", "c", "d"]  # each once
+            assert ring.candidates(key, n=2) == cands[:2]
+
+    def test_empty_ring(self):
+        ring = HashRing()
+        assert ring.lookup("anything") is None
+        assert ring.candidates("anything") == []
+        assert len(ring) == 0
+
+    def test_add_remove_idempotent(self):
+        ring = HashRing(["a"])
+        ring.add("a")
+        assert len(ring) == 1
+        ring.remove("missing")
+        ring.remove("a")
+        ring.remove("a")
+        assert ring.lookup("k") is None
+
+    def test_vnodes_validated(self):
+        with pytest.raises(ValueError):
+            HashRing(vnodes=0)
+
+    def test_removal_moves_only_the_victims_keys(self):
+        """The minimal-disruption property, exact for removal: a key
+        changes owner iff the removed node owned it (survivors' ranges
+        are untouched — only the victim's ranges fall clockwise)."""
+        for n_nodes in (3, 5, 8):
+            nodes = [f"n{i}" for i in range(n_nodes)]
+            ring = HashRing(nodes)
+            keys = [f"key-{n_nodes}-{i}" for i in range(300)]
+            before = {k: ring.lookup(k) for k in keys}
+            ring.remove("n1")
+            for k in keys:
+                after = ring.lookup(k)
+                if before[k] == "n1":
+                    assert after != "n1"
+                else:
+                    assert after == before[k]
+
+    def test_membership_change_moves_at_most_k_over_n_plus_slack(self):
+        """The acceptance bound: adding/removing one of N replicas moves
+        <= K/N + slack of K keys.  Slack covers vnode variance (the
+        balance test below pins the ratio that implies it); everything
+        here is deterministic — blake2b, fixed keys — so this is a pin,
+        not a flaky sample."""
+        slack = 0.75  # moved <= (1 + slack) * K/N
+        for n_nodes in (3, 4, 6, 8):
+            nodes = [f"n{i}" for i in range(n_nodes)]
+            keys = [f"sess-{n_nodes}-{i}" for i in range(600)]
+            bound = (1.0 + slack) * len(keys) / n_nodes
+            # removal
+            ring = HashRing(nodes)
+            before = {k: ring.lookup(k) for k in keys}
+            ring.remove("n0")
+            moved = sum(1 for k in keys if ring.lookup(k) != before[k])
+            assert moved <= bound, (n_nodes, "remove", moved, bound)
+            # addition (back to N nodes): movers all land on the newcomer
+            ring = HashRing(nodes[1:])
+            before = {k: ring.lookup(k) for k in keys}
+            ring.add("n0")
+            movers = [k for k in keys if ring.lookup(k) != before[k]]
+            assert all(ring.lookup(k) == "n0" for k in movers)
+            assert len(movers) <= bound, (n_nodes, "add", len(movers))
+
+    def test_vnode_balance_ratio(self):
+        """Max/min key share over 3 replicas at 10k keys stays under
+        1.8 with the default vnode count (measured ~1.07 — the margin is
+        the pin's headroom, not an aspiration)."""
+        ring = HashRing(["a", "b", "c"])
+        counts = {"a": 0, "b": 0, "c": 0}
+        for i in range(10_000):
+            counts[ring.lookup(f"k{i}")] += 1
+        assert max(counts.values()) / min(counts.values()) < 1.8
+
+
+class TestLeastLoaded:
+    def test_orders_by_queue_fraction_not_depth(self):
+        # 8/64 deep beats 3/4 deep: headroom is a fraction
+        order = least_loaded({
+            "a": {"queue_depth": 8, "queue_capacity": 64, "p99_ms": 50.0},
+            "b": {"queue_depth": 3, "queue_capacity": 4, "p99_ms": 10.0},
+        })
+        assert order == ["a", "b"]
+
+    def test_p99_breaks_fraction_ties(self):
+        order = least_loaded({
+            "a": {"queue_depth": 1, "queue_capacity": 4, "p99_ms": 90.0},
+            "b": {"queue_depth": 1, "queue_capacity": 4, "p99_ms": 30.0},
+        })
+        assert order == ["b", "a"]
+
+    def test_missing_signals_sort_last_and_id_breaks_ties(self):
+        order = least_loaded({
+            "c": {},  # unknown load is assumed worst, never best
+            "b": {"queue_depth": 0, "queue_capacity": 4, "p99_ms": 5.0},
+            "a": {"queue_depth": 0, "queue_capacity": 4, "p99_ms": 5.0},
+        })
+        assert order == ["a", "b", "c"]
+
+
+# ------------------------------------------------------------- autoscale
+
+def _loads(qfrac: float, p99: float, n: int = 2, cap: int = 100):
+    return {f"r{i}": {"queue_depth": int(qfrac * cap),
+                      "queue_capacity": cap, "p99_ms": p99}
+            for i in range(n)}
+
+
+class TestScalePlan:
+    def test_no_signals_holds(self):
+        plan = scale_plan({"r0": {}}, n_live=1)
+        assert plan["recommended"] == 1 and plan["delta"] == 0
+        assert "no load signals" in plan["reason"]
+
+    def test_no_live_replicas_recommends_floor(self):
+        plan = scale_plan({}, n_live=0, min_replicas=2)
+        assert plan["recommended"] == 2
+        assert "no live replicas" in plan["reason"]
+
+    def test_queue_pressure_scales_up(self):
+        plan = scale_plan(_loads(qfrac=0.6, p99=50.0), n_live=2,
+                          target_p99_ms=250.0)
+        assert plan["pressure"] >= 1.0 and plan["delta"] > 0
+        assert "queue" in plan["reason"]
+
+    def test_p99_pressure_scales_up(self):
+        plan = scale_plan(_loads(qfrac=0.1, p99=400.0), n_live=2,
+                          target_p99_ms=250.0)
+        assert plan["delta"] > 0 and "p99" in plan["reason"]
+
+    def test_up_capped_at_doubling_and_max(self):
+        # enormous pressure: recommendation doubles, never explodes
+        plan = scale_plan(_loads(qfrac=5.0, p99=50.0), n_live=3,
+                          max_replicas=8)
+        assert plan["recommended"] == 6
+        plan = scale_plan(_loads(qfrac=5.0, p99=50.0), n_live=3,
+                          max_replicas=4)
+        assert plan["recommended"] == 4
+
+    def test_low_pressure_sheds_exactly_one(self):
+        plan = scale_plan(_loads(qfrac=0.02, p99=10.0, n=4), n_live=4)
+        assert plan["delta"] == -1  # stepwise: each removal rehashes
+
+    def test_low_pressure_at_floor_holds(self):
+        plan = scale_plan(_loads(qfrac=0.02, p99=10.0, n=1), n_live=1,
+                          min_replicas=1)
+        assert plan["delta"] == 0
+
+    def test_hold_band(self):
+        plan = scale_plan(_loads(qfrac=0.3, p99=150.0), n_live=2)
+        assert plan["delta"] == 0 and "hold band" in plan["reason"]
+
+
+class TestAutoscaleGovernor:
+    def _up(self):
+        return {"delta": 1, "recommended": 3}
+
+    def _down(self):
+        return {"delta": -1, "recommended": 1}
+
+    def _hold(self):
+        return {"delta": 0, "recommended": 2}
+
+    def test_scale_up_needs_consecutive_patience(self):
+        gov = AutoscaleGovernor(escalate_patience=3)
+        assert gov.tick(self._up()) is None
+        assert gov.tick(self._up()) is None
+        decision = gov.tick(self._up())
+        assert decision == {"action": "scale_up", "to": 3,
+                            "plan": self._up()}
+        assert gov.decisions == [decision]
+
+    def test_hold_zeroes_both_counters(self):
+        gov = AutoscaleGovernor(escalate_patience=3, disarm_patience=3)
+        gov.tick(self._up())
+        gov.tick(self._up())
+        gov.tick(self._hold())  # one slow batch must not spawn a replica
+        assert gov.tick(self._up()) is None
+        assert gov.tick(self._up()) is None
+        assert gov.tick(self._up())["action"] == "scale_up"
+
+    def test_scale_down_is_much_slower(self):
+        gov = AutoscaleGovernor(escalate_patience=2, disarm_patience=4)
+        for _ in range(3):
+            assert gov.tick(self._down()) is None
+        assert gov.tick(self._down())["action"] == "scale_down"
+
+    def test_direction_flip_resets_the_other_counter(self):
+        gov = AutoscaleGovernor(escalate_patience=2, disarm_patience=2)
+        gov.tick(self._down())
+        gov.tick(self._up())  # down streak broken
+        assert gov.tick(self._down()) is None  # must re-earn both ticks
+        assert gov.tick(self._down())["action"] == "scale_down"
+        snap = gov.snapshot()
+        assert snap["decisions"] == 1 and snap["down_ticks"] == 0
+
+
+# -------------------------------------------------------------- registry
+
+class TestFleetRegistry:
+    def test_starting_replicas_take_no_traffic(self):
+        reg = FleetRegistry()
+        evs = reg.add("r0", "http://x:1")
+        assert [e["kind"] for e in evs] == ["replica_starting"]
+        assert reg.state("r0") == "starting"
+        assert reg.candidates("sess") == []  # off-ring until healthy
+        assert reg.n_live() == 0
+
+    def test_poll_ok_promotes_to_healthy(self):
+        reg = FleetRegistry()
+        reg.add("r0", "http://x:1")
+        evs = reg.note_poll("r0", ok=True,
+                            signals={"queue_depth": 0, "p99_ms": 4.0})
+        assert [e["kind"] for e in evs] == ["replica_up"]
+        assert evs[0]["payload"]["from"] == "starting"
+        assert reg.candidates("sess") == ["r0"]
+        assert reg.live_loads()["r0"]["p99_ms"] == 4.0
+
+    def test_failures_degrade_then_kill(self):
+        reg = FleetRegistry()
+        reg.add("r0", "http://x:1")
+        reg.note_poll("r0", ok=True)
+        evs = reg.note_poll("r0", ok=False, reason="timeout")
+        assert [e["kind"] for e in evs] == ["replica_state"]
+        assert reg.state("r0") == "degraded"
+        # degraded stays IN the ring: evicting on a blip would rehash
+        assert reg.candidates("sess") == ["r0"]
+        kinds = []
+        for _ in range(DEAD_AFTER - 1):
+            kinds += [e["kind"] for e in
+                      reg.note_poll("r0", ok=False, reason="timeout")]
+        assert kinds == ["replica_down"]
+        assert reg.state("r0") == "dead"
+        assert reg.candidates("sess") == []
+
+    def test_one_good_poll_clears_the_failure_tally(self):
+        reg = FleetRegistry()
+        reg.add("r0", "http://x:1")
+        reg.note_poll("r0", ok=True)
+        for _ in range(DEAD_AFTER - 1):
+            reg.note_poll("r0", ok=False, reason="blip")
+        evs = reg.note_poll("r0", ok=True)
+        assert [e["kind"] for e in evs] == ["replica_up"]
+        reg.note_poll("r0", ok=False, reason="blip")
+        assert reg.state("r0") == "degraded"  # tally restarted, not dead
+
+    def test_boot_grace_then_boot_timeout(self):
+        reg = FleetRegistry()
+        reg.add("r0", "http://x:1")
+        for _ in range(DEAD_AFTER + 2):  # refusals during boot: not news
+            assert reg.note_poll("r0", ok=False, reason="refused",
+                                 boot_timeout_s=300.0) == []
+        assert reg.state("r0") == "starting"
+        evs = reg.note_poll("r0", ok=False, reason="refused",
+                            boot_timeout_s=0.0)
+        assert [e["kind"] for e in evs] == ["replica_down"]
+        assert "boot timeout" in evs[0]["payload"]["reason"]
+
+    def test_drain_leaves_ring_and_mutes_failures(self):
+        reg = FleetRegistry()
+        for rid in ("r0", "r1"):
+            reg.add(rid, f"http://x/{rid}")
+            reg.note_poll(rid, ok=True)
+        evs = reg.drain("r0")
+        assert [e["kind"] for e in evs] == ["replica_drain"]
+        assert reg.candidates("sess") == ["r1"]
+        assert "r0" not in reg.live_loads()
+        # a draining replica winding down is not news
+        assert reg.note_poll("r0", ok=False, reason="refused") == []
+        assert reg.state("r0") == "draining"
+
+    def test_respawn_readd_keeps_id_and_repoints_url(self):
+        reg = FleetRegistry()
+        reg.add("r0", "http://x:1")
+        reg.note_poll("r0", ok=True)
+        for _ in range(DEAD_AFTER):
+            reg.note_poll("r0", ok=False, reason="gone")
+        evs = reg.add("r0", "http://x:2")  # the slot's sessions come home
+        assert [e["kind"] for e in evs] == ["replica_respawn"]
+        assert reg.url("r0") == "http://x:2"
+        assert reg.state("r0") == "starting"
+
+    def test_proxy_failures_count_like_failed_polls(self):
+        reg = FleetRegistry()
+        reg.add("r0", "http://x:1")
+        reg.note_poll("r0", ok=True)
+        kinds = []
+        for _ in range(DEAD_AFTER):
+            kinds += [e["kind"] for e in
+                      reg.note_proxy_failure("r0", "connection refused")]
+        assert kinds == ["replica_state", "replica_down"]
+
+    def test_remove_and_unknown_ids(self):
+        reg = FleetRegistry()
+        reg.add("r0", "http://x:1")
+        assert [e["kind"] for e in reg.remove("r0")] == ["replica_removed"]
+        assert reg.remove("r0") == []
+        assert reg.note_poll("ghost", ok=True) == []
+        assert reg.drain("ghost") == []
+
+    def test_snapshot_shape(self):
+        reg = FleetRegistry(vnodes=8)
+        reg.add("r0", "http://x:1")
+        reg.note_poll("r0", ok=True, signals={"queue_depth": 1})
+        snap = reg.snapshot()
+        assert snap["vnodes"] == 8 and snap["ring"] == ["r0"]
+        r = snap["replicas"]["r0"]
+        assert r["state"] == "healthy" and r["url"] == "http://x:1"
+        assert r["signals"]["queue_depth"] == 1
+        assert r["state_age_s"] >= 0
+
+
+# --------------------------------------------------------- local manager
+
+class TestLocalManager:
+    """Real child processes, but trivial ones: a sleep loop stands in
+    for dptpu-serve (the manager never speaks HTTP — that is the health
+    loop's job)."""
+
+    @pytest.fixture()
+    def mgr(self, tmp_path):
+        seen = []
+        m = LocalManager(
+            [sys.executable, "-c",
+             "import time\nwhile True: time.sleep(0.1)"],
+            workdir=str(tmp_path / "fleet"), max_restarts=1,
+            child_env=lambda rid, restarts: seen.append((rid, restarts))
+            or {})
+        m.observed_child_env = seen
+        try:
+            yield m
+        finally:
+            m.stop_all(timeout_s=10.0)
+
+    def test_slots_spawn_and_die(self, mgr):
+        assert mgr.new_slot() == "r0"
+        assert mgr.new_slot() == "r1"
+        url = mgr.spawn("r0")
+        assert url.startswith("http://127.0.0.1:")
+        assert mgr.pid("r0") is not None and not mgr.exited("r0")
+        mgr.kill("r0", sig=signal.SIGKILL)
+        deadline = time.monotonic() + 10.0
+        while not mgr.exited("r0") and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert mgr.exited("r0") and mgr.pid("r0") is None
+
+    def test_respawn_budget_and_child_env_hook(self, mgr):
+        rid = mgr.new_slot()
+        mgr.spawn(rid)
+        mgr.kill(rid, sig=signal.SIGKILL)
+        assert mgr.can_respawn(rid)
+        assert mgr.respawn(rid) is not None
+        mgr.kill(rid, sig=signal.SIGKILL)
+        assert not mgr.can_respawn(rid)  # max_restarts=1: budget spent
+        assert mgr.respawn(rid) is None
+        # the chaos runner's injection point: (slot, restart#) per spawn
+        assert mgr.observed_child_env == [(rid, 0), (rid, 1)]
+
+    def test_retire_burns_the_budget(self, mgr):
+        rid = mgr.new_slot()
+        mgr.spawn(rid)
+        mgr.retire(rid)
+        assert not mgr.can_respawn(rid)
+        deadline = time.monotonic() + 10.0
+        while not mgr.exited(rid) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert mgr.exited(rid)
+
+
+# ------------------------------------------- client satellite (no jax)
+
+class _ScriptedHandler(BaseHTTPRequestHandler):
+    """Replies from a per-server script of (status, headers, body)."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):  # noqa: N802
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        self.server.hits += 1
+        status, headers, body = (self.server.script.pop(0)
+                                 if self.server.script
+                                 else (500, {}, b"script exhausted"))
+        self.send_response(status)
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def scripted_server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _ScriptedHandler)
+    httpd.script = []
+    httpd.hits = 0
+    threading.Thread(target=lambda: httpd.serve_forever(poll_interval=0.05),
+                     daemon=True).start()
+    try:
+        yield httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _ok_mask_reply(headers=None):
+    body = json.dumps(
+        {"mask": encode_array(np.zeros((4, 4), np.float32))}).encode()
+    return (200, dict(headers or {},
+                      **{"Content-Type": "application/json"}), body)
+
+
+def _err_reply(status, code, error="nope", retry_after=None):
+    headers = {"Content-Type": "application/json"}
+    if retry_after is not None:
+        headers["Retry-After"] = str(retry_after)
+    return (status, headers,
+            json.dumps({"error": error, "code": code}).encode())
+
+
+class TestServeClientFleetAwareness:
+    def test_draining_503_is_typed_and_names_its_horizon(
+            self, scripted_server):
+        httpd, url = scripted_server
+        httpd.script = [_err_reply(503, "fleet_unavailable",
+                                   "no live replicas", retry_after=1)]
+        client = ServeClient(url)
+        with pytest.raises(ReplicaDrainingError) as exc:
+            client.predict(_image(8, 8), _points())
+        # subclasses ServiceUnhealthyError: existing 503 handlers match
+        assert isinstance(exc.value, ServiceUnhealthyError)
+        assert exc.value.retry_after_s == 1.0
+
+    def test_plain_503_with_retry_after_refines_to_draining(
+            self, scripted_server):
+        # no fleet code in the body — the Retry-After alone marks the
+        # refusal advertised-transient (a draining replica's own 503)
+        httpd, url = scripted_server
+        httpd.script = [_err_reply(503, None, "draining", retry_after=2)]
+        with pytest.raises(ReplicaDrainingError) as exc:
+            ServeClient(url).predict(_image(8, 8), _points())
+        assert exc.value.retry_after_s == 2.0
+
+    def test_shed_retry_honors_retry_after(self, scripted_server):
+        httpd, url = scripted_server
+        httpd.script = [
+            _err_reply(503, "fleet_unavailable", retry_after="0.01"),
+            _ok_mask_reply(),
+        ]
+        client = ServeClient(url, shed_retries=2, retry_seed=0)
+        naps = []
+        client._retry._sleep = naps.append  # the injectable test seam
+        mask = client.predict(_image(8, 8), _points())
+        assert mask.shape == (4, 4) and httpd.hits == 2
+        # the advised horizon was napped on top of the jittered backoff
+        assert any(abs(n - 0.01) < 1e-9 for n in naps)
+
+    def test_unparseable_5xx_is_never_replayed(self, scripted_server):
+        # the request's server-side fate is unknown: retrying could
+        # duplicate effects, so it must surface untyped and un-retried
+        httpd, url = scripted_server
+        httpd.script = [(500, {"Content-Type": "text/html"},
+                         b"<html>bare proxy error</html>")]
+        client = ServeClient(url, shed_retries=3, retry_seed=0)
+        client._retry._sleep = lambda s: None
+        with pytest.raises(RuntimeError, match="unparseable"):
+            client.predict(_image(8, 8), _points())
+        assert httpd.hits == 1
+
+    def test_session_lane_code_survives_the_hop(self, scripted_server):
+        httpd, url = scripted_server
+        httpd.script = [_err_reply(429, "session_lane", "lane full")]
+        with pytest.raises(SessionLaneFullError) as exc:
+            ServeClient(url).predict(_image(8, 8), _points(),
+                                     session_id="s1")
+        assert isinstance(exc.value, QueueFullError)
+
+    def test_fleet_headers_surfaced_then_cleared(self, scripted_server):
+        httpd, url = scripted_server
+        httpd.script = [
+            _ok_mask_reply({"X-Fleet-Replica": "r1",
+                            "X-Fleet-Rerouted": "r0"}),
+            _ok_mask_reply(),
+        ]
+        client = ServeClient(url)
+        assert client.last_fleet == {"replica": None, "rerouted": None}
+        client.predict(_image(8, 8), _points())
+        assert client.last_fleet == {"replica": "r1", "rerouted": "r0"}
+        client.predict(_image(8, 8), _points())  # off-fleet reply resets
+        assert client.last_fleet == {"replica": None, "rerouted": None}
+
+
+# ---------------------------------------- attach-mode front, end to end
+
+class _KillableServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can sever ESTABLISHED connections too.
+    ``shutdown()`` only stops the accept loop — keep-alive connections
+    (the front's proxy pool holds some) would keep answering, which is
+    correct for a live process but wrong for simulating a SIGKILL."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._client_socks = []
+
+    def process_request(self, request, client_address):
+        self._client_socks.append(request)
+        super().process_request(request, client_address)
+
+    def kill(self):
+        self.shutdown()
+        self.server_close()
+        for s in self._client_socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def _http_replica(svc):
+    from distributedpytorch_tpu.serve.__main__ import (
+        _HealthCache,
+        make_handler,
+    )
+
+    httpd = _KillableServer(("127.0.0.1", 0),
+                            make_handler(svc, _HealthCache()))
+    threading.Thread(target=lambda: httpd.serve_forever(poll_interval=0.05),
+                     daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+@pytest.fixture(scope="module")
+def two_replicas(serve_split_predictor):
+    """Two real dptpu-serve HTTP replicas sharing one predictor (the
+    jitted programs compile once; the services are cheap)."""
+    services, httpds, urls = [], [], []
+    for _ in range(2):
+        svc = InferenceService(serve_split_predictor, max_batch=4,
+                               queue_depth=16, max_wait_s=0.002)
+        svc.start()
+        httpd, url = _http_replica(svc)
+        services.append(svc)
+        httpds.append(httpd)
+        urls.append(url)
+    try:
+        yield services, urls
+    finally:
+        for httpd in httpds:
+            httpd.shutdown()
+            httpd.server_close()
+        for svc in services:
+            svc.stop()
+
+
+@pytest.fixture()
+def front2(two_replicas):
+    """A fresh front per test: membership ops (drain, remove) must not
+    leak across tests; the front itself is just threads."""
+    _, urls = two_replicas
+    front = FleetFront(attach=urls, poll_interval_s=0.1,
+                       poll_timeout_s=5.0)
+    front.start()
+    url = front.serve_http("127.0.0.1", 0)
+    assert front.wait_live(2, timeout_s=60.0)
+    try:
+        yield front, url
+    finally:
+        front.stop()
+
+
+def _get_json(url, path):
+    with urllib.request.urlopen(url + path, timeout=30) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _post_json(url, path, body):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode("utf-8"), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode("utf-8"))
+
+
+class TestFleetFrontAttach:
+    def test_health_surface(self, front2):
+        front, url = front2
+        health = _get_json(url, "/healthz")
+        assert health["ok"] and health["mode"] == "attach"
+        assert health["live"] == 2
+        assert health["ring"] == ["a0", "a1"]
+        assert set(health["replicas"]) == {"a0", "a1"}
+        assert all(r["state"] == "healthy"
+                   for r in health["replicas"].values())
+
+    def test_session_affinity_and_proxy_parity(self, front2):
+        front, url = front2
+        client = ServeClient(url)
+        img, pts = _image(), _points()
+        masks, replicas = [], []
+        for _ in range(3):
+            masks.append(client.predict(img, pts, session_id="affine-1"))
+            replicas.append(client.last_fleet["replica"])
+            assert client.last_fleet["rerouted"] is None
+        # every click of a session lands on its ring owner
+        assert len(set(replicas)) == 1 and replicas[0] in ("a0", "a1")
+        assert replicas[0] == front.route_order("affine-1")[0][0]
+        # the hop is byte-transparent: same mask as a direct request to
+        # the owning replica
+        direct = ServeClient(front.registry.url(replicas[0])).predict(
+            img, pts, session_id="affine-parity")
+        assert masks[0].shape == direct.shape == img.shape[:2]
+        assert np.array_equal(masks[0], direct)
+        assert np.array_equal(masks[0], masks[2])
+
+    def test_stateless_requests_route_least_loaded(self, front2):
+        front, url = front2
+        client = ServeClient(url)
+        mask = client.predict(_image(seed=3), _points())
+        assert mask.shape == (90, 120)
+        assert client.last_fleet["replica"] in ("a0", "a1")
+
+    def test_malformed_body_still_routes_for_the_replicas_400(
+            self, front2):
+        # the front parses routing fields only: the replica's validator
+        # is the authoritative one, its 400 passes through with the
+        # fleet header attached
+        front, url = front2
+        req = urllib.request.Request(
+            url + "/v1/predict", data=b'{"image": "nope"}', method="POST",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc.value.code == 400
+        assert exc.value.headers.get("X-Fleet-Replica") in ("a0", "a1")
+
+    def test_plan_endpoint(self, front2):
+        front, url = front2
+        plan = _get_json(url, "/fleet/plan")
+        assert plan["replicas_live"] == 2
+        assert plan["recommended"] - 2 == plan["delta"]
+        assert plan["targets"]["max_replicas"] == 8
+        assert plan == front.plan()  # the HTTP body IS scale_plan's
+
+    def test_metrics_endpoint(self, front2):
+        front, url = front2
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            text = r.read().decode("utf-8")
+        assert "fleet_replicas_live" in text
+        assert "fleet_route_total" in text
+
+    def test_admin_validation(self, front2):
+        front, url = front2
+        status, body = _post_json(url, "/fleet/drain",
+                                  {"replica": "ghost"})
+        assert status == 404
+        status, body = _post_json(url, "/fleet/add", {})
+        assert status == 400
+        status, body = _post_json(url, "/fleet/nope", {})
+        assert status == 404
+
+    def test_drain_rehashes_sessions_to_the_survivor(self, front2):
+        front, url = front2
+        client = ServeClient(url)
+        img, pts = _image(seed=5), _points()
+        client.predict(img, pts, session_id="drain-me")
+        owner = client.last_fleet["replica"]
+        other = {"a0": "a1", "a1": "a0"}[owner]
+        status, health = _post_json(url, "/fleet/drain",
+                                    {"replica": owner})
+        assert status == 200
+        assert health["replicas"][owner]["state"] == "draining"
+        assert health["ring"] == [other]
+        # the moved session is not an error: it re-encodes and completes
+        mask = client.predict(img, pts, session_id="drain-me")
+        assert client.last_fleet["replica"] == other
+        assert mask.shape == img.shape[:2]
+
+    def test_failover_reroutes_once_and_declares_death(
+            self, two_replicas):
+        """Kill one replica's HTTP front mid-fleet: a session owned by
+        it survives via the next ring candidate with the rerouted
+        header, and the health loop converges the ring to the
+        survivor."""
+        services, urls = two_replicas
+        # a throwaway second front onto replica 1 so the shared fixture
+        # survives this test's kill
+        doomed_httpd, doomed_url = _http_replica(services[1])
+        front = FleetFront(attach=[urls[0], doomed_url],
+                           poll_interval_s=0.1, poll_timeout_s=5.0)
+        front.start()
+        url = front.serve_http("127.0.0.1", 0)
+        try:
+            assert front.wait_live(2, timeout_s=60.0)
+            # pick a session the doomed replica (a1) owns — host-side
+            # ring math, the same the front routes by
+            ring = HashRing(["a0", "a1"])
+            sid = next(f"victim-{i}" for i in range(64)
+                       if ring.lookup(f"victim-{i}") == "a1")
+            client = ServeClient(url)
+            img, pts = _image(seed=7), _points()
+            client.predict(img, pts, session_id=sid)
+            assert client.last_fleet == {"replica": "a1",
+                                         "rerouted": None}
+            doomed_httpd.kill()
+            mask = client.predict(img, pts, session_id=sid)
+            # one-shot failover: answered by the survivor, and the reply
+            # says who died
+            assert client.last_fleet == {"replica": "a0",
+                                         "rerouted": "a1"}
+            assert mask.shape == img.shape[:2]
+            deadline = time.monotonic() + 30.0
+            while (front.registry.state("a1") != "dead"
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert front.registry.state("a1") == "dead"
+            assert front.health()["ring"] == ["a0"]
+            # affinity is now unconditional: every session owns to a0
+            assert front.route_order(sid)[0] == ["a0"]
+        finally:
+            front.stop()
+            doomed_httpd.server_close()
+
+    def test_empty_fleet_answers_typed_503(self):
+        # a front with nothing live yet: the typed, advertised-transient
+        # refusal the client taxonomy names ReplicaDrainingError
+        front = FleetFront(attach=["http://127.0.0.1:9"],  # discard port
+                           poll_interval_s=0.1, poll_timeout_s=0.5)
+        front.start()
+        url = front.serve_http("127.0.0.1", 0)
+        try:
+            with pytest.raises(ReplicaDrainingError):
+                ServeClient(url).predict(_image(8, 8), _points())
+        finally:
+            front.stop()

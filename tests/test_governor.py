@@ -682,6 +682,10 @@ class TestTrainerFlip:
         finally:
             tr.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): flip-under-fit e2e
+    # (~10s); fast gate:
+    # test_observe_fit_reports_feed_and_applies_nothing + TestLadder
+    # units
     def test_flip_applies_and_fit_stays_finite(self, tmp_path):
         import dataclasses as dc
 

@@ -171,6 +171,9 @@ class TestEvalPreprocess:
 
 @pytest.mark.skipif(not HAVE_GRAIN, reason="grain not installed")
 class TestGrainInTrainer:
+    @pytest.mark.slow  # tier-1 budget (PR 20): full grain fit (~11s);
+    # fast gate: TestGrainLoader::test_bit_parity_with_dataloader +
+    # test_prepared.py TestGrainProcessWorkers
     def test_fit_with_grain_loader(self, fake_voc_root):
         import dataclasses
         import tempfile

@@ -226,6 +226,9 @@ class TestTrainStep:
         b = jax.tree.leaves(s_acc.params)[0]
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): full DANet accumulation
+    # smoke (~8s); fast gate: test_loss_decreases_and_state_advances +
+    # test_tp.py test_grad_accum_under_tp
     def test_grad_accumulation_smoke_with_bn_dropout(self, mesh,
                                                      state_and_model):
         # The full DANet path (BN stats carried through the scan, per-micro

@@ -394,6 +394,9 @@ class TestPredictCli:
                   "1,1 2,2 3,3 4,4", "optim.lr=1e-3"])
         assert "config.json" in capsys.readouterr().err
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): semantic run-dir CLI
+    # roundtrip (~8s); fast gate: test_end_to_end_from_run_dir +
+    # TestSerializedExport::test_instance_roundtrip_symbolic_batch
     def test_semantic_run_roundtrip(self, tmp_path):
         """A semantic-task run dir predicts a whole-image class map, both
         through SemanticPredictor and the task-dispatching CLI body."""

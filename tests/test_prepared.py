@@ -703,10 +703,11 @@ class TestCoalesceWire:
             Trainer(bad)
 
     @pytest.mark.parametrize("packbits", [
-        False,
-        # tier-1 budget (PR 7): the packbits-riding variant is slow-gated
-        # (~19s); the packed row keeps its unit gates (the roundtrip
-        # tests above, PR 18) and the plain coalesce parity stays
+        # tier-1 budget (PR 20): both full-fit parity rows are slow-gated
+        # (~26s / ~19s); fast gate: test_pack_unpack_roundtrip +
+        # test_pack_rejects_float_leaves +
+        # test_coalesce_requires_uint8_transfer
+        pytest.param(False, marks=pytest.mark.slow),
         pytest.param(True, marks=pytest.mark.slow),
     ])
     def test_coalesced_loss_matches_plain(self, tmp_path, packbits):

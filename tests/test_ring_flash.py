@@ -344,6 +344,8 @@ class TestRingPAMInModel:
             np.testing.assert_allclose(np.asarray(oa), np.asarray(ob),
                                        rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): sharded training loop
+    # (~9s); fast gate: test_ring_pam_matches_einsum (numerics parity)
     def test_ring_pam_trains_under_sharded_step(self):
         import optax
 

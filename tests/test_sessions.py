@@ -361,6 +361,8 @@ class TestHotSwap:
             assert not np.array_equal(before, fresh)
             assert svc.health()["swap"]["swaps"]["promoted"] == 1
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): three-predictor swap
+    # ladder (~7s); fast gate: test_promote_keeps_old_sessions_bitwise
     def test_double_swap_rejected_until_decided(self, split_predictor):
         pred2 = _make_split_predictor(seed=7)
         pred3 = _make_split_predictor(seed=8)

@@ -335,6 +335,10 @@ def _tiny_cfg(work):
 
 
 class TestGoodputEndToEnd:
+    @pytest.mark.slow  # tier-1 budget (PR 20): 3-step real fit (~15s);
+    # fast gate: TestGoodputAccountant units +
+    # test_telemetry_disabled_fit_still_works +
+    # TestInstrumentationOverhead
     def test_three_step_fit_breakdown_and_mfu(self, tmp_path):
         """The acceptance scenario: a 3-step CPU fake-data fit produces a
         goodput breakdown whose buckets sum to wall-clock (±5%) and an MFU

@@ -357,6 +357,10 @@ class TestDeviceAugment:
         stages_on = build_train_transform(flip=True).transforms
         assert any(isinstance(s, T.RandomHorizontalFlip) for s in stages_on)
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): full device-augment fit
+    # (~10s); fast gate: test_device_guidance.py
+    # test_e2e_device_guidance_with_device_augment +
+    # test_grain_augment.py TestDeviceAugment units
     def test_fit_with_device_augment(self, tiny_cfg, tmp_path):
         cfg = dataclasses.replace(
             tiny_cfg,

@@ -387,6 +387,9 @@ class TestTrainerIntegration:
         assert abs(m_bf16["jaccard"] - m_f32["jaccard"]) < 1e-2
         tr.close()
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): overlap is opt-in and its
+    # fit smoke is ~24s; fast gate:
+    # test_val_prepared_off_keeps_plain_path (default path stays tier-1)
     def test_val_overlap_smoke(self, fake_voc_root, tmp_path):
         """Thin tier-1 smoke: one overlapped fit completes with a val
         entry per epoch and a best checkpoint.  The serial-vs-overlap
